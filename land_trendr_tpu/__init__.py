@@ -1,0 +1,17 @@
+"""land_trendr_tpu — TPU-native LandTrendr temporal-segmentation framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of the reference repo
+``vicchu/land_trendr`` (a Hadoop-MapReduce, one-map-task-per-pixel Python
+implementation — SURVEY.md §2): per-pixel piecewise-linear temporal
+segmentation of Landsat spectral-index time series (despike → candidate
+vertex search → anchored least-squares fit → F-statistic model selection),
+executed as vmapped, jit-compiled kernels over HBM-resident
+``(tile_px, year)`` arrays, sharded data-parallel over a TPU mesh with no
+cross-pixel collectives (BASELINE.json north_star).
+"""
+
+from land_trendr_tpu.config import DEFAULT_PARAMS, LTParams
+
+__version__ = "0.1.0"
+
+__all__ = ["LTParams", "DEFAULT_PARAMS", "__version__"]

@@ -1,0 +1,1 @@
+"""io subpackage of land_trendr_tpu."""

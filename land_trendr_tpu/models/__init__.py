@@ -1,0 +1,1 @@
+"""models subpackage of land_trendr_tpu."""

@@ -1,0 +1,1 @@
+"""ops subpackage of land_trendr_tpu."""

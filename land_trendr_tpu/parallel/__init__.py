@@ -1,0 +1,1 @@
+"""parallel subpackage of land_trendr_tpu."""

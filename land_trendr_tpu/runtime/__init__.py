@@ -1,0 +1,1 @@
+"""runtime subpackage of land_trendr_tpu."""

@@ -1,0 +1,1 @@
+"""utils subpackage of land_trendr_tpu."""

@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Tests run on a *virtual 8-device CPU mesh* so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path via ``__graft_entry__.dryrun_multichip``).  The environment
+variables must be set before jax is imported anywhere, hence this top-level
+conftest.  x64 is enabled so the JAX kernel can be parity-checked against
+the float64 CPU oracle (SURVEY.md §7 step 2: exact-parity mode in float64 on
+CPU; float32 on TPU with documented tolerance).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(20260729)

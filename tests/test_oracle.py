@@ -1,0 +1,293 @@
+"""Behavioural tests for the CPU oracle (the normative algorithm spec).
+
+These encode the semantics in SURVEY.md §3.1 on the synthetic-series matrix
+from the build plan (§7 step 2): flat, single disturbance, disturbance +
+recovery, spikes, missing years, all-masked — plus parameter edge cases.
+"""
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.models.oracle import (
+    PixelSegmenter,
+    cull_by_angle,
+    despike,
+    f_stat_p_value,
+    find_candidate_vertices,
+    fit_to_vertices,
+    segment_series,
+)
+
+YEARS = np.arange(1984, 2022, dtype=np.float64)  # 38 years
+NY = len(YEARS)
+ALL = np.ones(NY, dtype=bool)
+P = LTParams()
+
+
+def seg(values, mask=None, params=P):
+    return segment_series(YEARS, np.asarray(values, float), ALL if mask is None else mask, params)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_params_validation():
+    with pytest.raises(ValueError):
+        LTParams(max_segments=0)
+    with pytest.raises(ValueError):
+        LTParams(spike_threshold=1.5)
+    with pytest.raises(ValueError):
+        LTParams(best_model_proportion=0.0)
+    p = LTParams.from_json(LTParams(max_segments=4).to_json())
+    assert p.max_segments == 4 and p.max_vertices == 5
+    with pytest.raises(ValueError):
+        LTParams.from_dict({"bogus": 1})
+
+
+def test_params_hashable_static():
+    assert hash(LTParams()) == hash(LTParams())
+    assert LTParams() != LTParams(max_segments=5)
+
+
+# ---------------------------------------------------------------------------
+# despike
+# ---------------------------------------------------------------------------
+
+
+def test_despike_flattens_pure_spike():
+    y = np.zeros(11)
+    y[5] = 10.0  # perfect symmetric spike: prop == 1
+    t = np.arange(11, dtype=float)
+    out = despike(t, y, 0.9)
+    assert abs(out[5]) < 1e-9
+    assert np.allclose(out[[i for i in range(11) if i != 5]], 0.0)
+
+
+def test_despike_threshold_one_is_noop():
+    y = np.zeros(11)
+    y[5] = 10.0
+    t = np.arange(11, dtype=float)
+    out = despike(t, y, 1.0)
+    assert np.array_equal(out, y)
+
+
+def test_despike_preserves_real_step():
+    # A persistent step is NOT a spike: values on both sides differ.
+    y = np.concatenate([np.zeros(6), np.ones(6)])
+    t = np.arange(12, dtype=float)
+    out = despike(t, y, 0.9)
+    assert np.allclose(out, y)  # crossing ≈ dev at the step edges → prop ≤ 0.5
+
+
+def test_despike_uneven_spacing_uses_interpolation():
+    t = np.array([0.0, 1.0, 4.0])
+    y = np.array([0.0, 10.0, 8.0])
+    # interp at t=1 is 2.0; dev=8, crossing=8 → prop=0 → no dampening
+    out = despike(t, y, 0.5)
+    assert np.array_equal(out, y)
+
+
+# ---------------------------------------------------------------------------
+# vertex search / cull
+# ---------------------------------------------------------------------------
+
+
+def test_candidate_search_finds_breakpoint():
+    t = np.arange(21, dtype=float)
+    y = np.where(t < 10, 0.0, (t - 10) * 2.0)  # hinge at index 10
+    verts = find_candidate_vertices(t, y, 3)
+    assert verts[0] == 0 and verts[-1] == 20
+    assert 10 in verts
+
+
+def test_candidate_search_caps_at_n_points():
+    t = np.arange(4, dtype=float)
+    y = np.array([0.0, 3.0, -2.0, 1.0])
+    verts = find_candidate_vertices(t, y, 10)
+    assert verts == [0, 1, 2, 3]
+
+
+def test_cull_keeps_sharpest_angles():
+    t = np.arange(21, dtype=float)
+    y = np.where(t < 10, 0.0, (t - 10) * 2.0)
+    verts = find_candidate_vertices(t, y, 6)
+    culled = cull_by_angle(t, y, verts, 3)
+    assert culled[0] == 0 and culled[-1] == 20
+    assert 10 in culled  # the real hinge survives the cull
+
+
+# ---------------------------------------------------------------------------
+# end-to-end segmentation
+# ---------------------------------------------------------------------------
+
+
+def test_flat_series_is_no_fit():
+    r = seg(np.full(NY, 0.3))
+    assert not r.model_valid
+    assert r.n_vertices == 0
+    assert np.allclose(r.fitted, 0.3)
+
+
+def test_pure_noise_is_no_fit():
+    rng = np.random.default_rng(7)
+    r = seg(rng.normal(0.0, 1.0, NY))
+    assert not r.model_valid  # no structure → F-test fails
+
+
+def test_single_disturbance_step():
+    # disturbance-positive convention: abrupt increase then plateau
+    y = np.where(YEARS < 2000, 0.1, 0.8)
+    r = seg(y)
+    assert r.model_valid
+    assert 2 <= r.n_vertices <= P.max_vertices
+    # one of the vertices must sit at the step (1999 or 2000)
+    vy = r.vertex_years[: r.n_vertices]
+    assert np.any((vy == 1999) | (vy == 2000))
+    assert r.rmse < 0.05
+    # fitted trajectory reproduces the plateau levels
+    assert abs(r.fitted[0] - 0.1) < 0.05 and abs(r.fitted[-1] - 0.8) < 0.05
+
+
+def test_disturbance_then_recovery():
+    # ramp up 1984-1994, abrupt disturbance 1995, slow recovery after
+    y = np.piecewise(
+        YEARS,
+        [YEARS < 1995, YEARS >= 1995],
+        [lambda x: 0.2, lambda x: np.maximum(0.9 - 0.02 * (x - 1995), 0.2)],
+    )
+    r = seg(y)
+    assert r.model_valid
+    assert r.rmse < 0.05
+    # must contain at least one negative-magnitude (recovery) segment
+    mags = r.seg_magnitude[: r.n_vertices - 1]
+    assert (mags < 0).any() and (mags > 0).any()
+
+
+def test_spike_does_not_create_vertex():
+    y = np.full(NY, 0.2)
+    y[10] = 0.9  # single-year spike
+    y_step = y + np.where(YEARS >= 2010, 0.5, 0.0)  # plus a real disturbance
+    r = seg(y_step)
+    if r.model_valid:
+        # despike should remove the 1994 spike; no vertex lands there
+        vy = r.vertex_years[: r.n_vertices]
+        assert 1994 not in vy
+
+
+def test_min_observations_gate():
+    mask = ALL.copy()
+    mask[5:] = False  # 5 valid < min_observations_needed=6
+    r = seg(np.linspace(0, 1, NY), mask)
+    assert not r.model_valid and r.n_vertices == 0
+
+
+def test_all_masked():
+    r = seg(np.linspace(0, 1, NY), np.zeros(NY, dtype=bool))
+    assert not r.model_valid
+    assert np.allclose(r.fitted, 0.0)
+
+
+def test_missing_years_still_fits():
+    y = np.where(YEARS < 2000, 0.1, 0.8)
+    mask = ALL.copy()
+    mask[3:20:4] = False
+    r = seg(y, mask)
+    assert r.model_valid
+    assert r.rmse < 0.06
+    # vertices must only sit on valid years
+    assert mask[r.vertex_indices[: r.n_vertices]].all()
+
+
+def test_recovery_rate_filter_blocks_fast_recovery():
+    # full-range recovery over 2 years: rate = range/2 per yr > 0.25*range
+    y = np.where(YEARS < 2000, 0.8, np.where(YEARS < 2002, 0.8 - 0.4 * (YEARS - 1999), 0.0))
+    strict = LTParams(recovery_threshold=0.25, p_val_threshold=1.0, best_model_proportion=1.0)
+    loose = LTParams(recovery_threshold=10.0, p_val_threshold=1.0, best_model_proportion=1.0)
+    r_strict = seg(y, params=strict)
+    r_loose = seg(y, params=loose)
+    # the loose fit can follow the fast recovery; the strict one cannot
+    sse_strict = np.sum((y - r_strict.fitted) ** 2)
+    sse_loose = np.sum((y - r_loose.fitted) ** 2)
+    assert sse_loose <= sse_strict
+    # strict: no fitted segment recovers faster than the limit (+ tolerance)
+    rates = r_strict.seg_rate[: max(r_strict.n_vertices - 1, 0)]
+    rng = np.ptp(r_strict.despiked)
+    assert (rates >= -0.25 * rng - 1e-9).all()
+
+
+def test_segment_attributes_consistent():
+    y = np.where(YEARS < 2000, 0.1, 0.8)
+    r = seg(y)
+    k = r.n_vertices
+    for s in range(k - 1):
+        assert r.seg_duration[s] == r.vertex_years[s + 1] - r.vertex_years[s]
+        np.testing.assert_allclose(
+            r.seg_magnitude[s], r.vertex_fit_vals[s + 1] - r.vertex_fit_vals[s]
+        )
+        np.testing.assert_allclose(
+            r.seg_rate[s], r.seg_magnitude[s] / r.seg_duration[s]
+        )
+    # padding is zeroed
+    assert (r.seg_duration[max(k - 1, 0):] == 0).all()
+    assert (r.vertex_indices[k:] == -1).all()
+
+
+def test_fitted_trajectory_is_continuous():
+    rng = np.random.default_rng(3)
+    y = np.cumsum(rng.normal(0, 0.1, NY)) + np.where(YEARS >= 2005, 1.0, 0.0)
+    r = seg(y, params=LTParams(p_val_threshold=1.0))
+    # piecewise-linear interpolation through vertex fit vals == fitted
+    k = r.n_vertices
+    interp = np.interp(YEARS, r.vertex_years[:k], r.vertex_fit_vals[:k])
+    np.testing.assert_allclose(r.fitted, interp, atol=1e-9)
+
+
+def test_f_stat_monotonic_in_fit_quality():
+    p_good = f_stat_p_value(ss0=10.0, sse=0.1, n=38, n_segments=2)
+    p_bad = f_stat_p_value(ss0=10.0, sse=8.0, n=38, n_segments=2)
+    assert p_good < p_bad
+    assert f_stat_p_value(10.0, 11.0, 38, 2) == 1.0  # worse than mean
+    assert f_stat_p_value(10.0, 0.0, 38, 2) == 0.0
+    assert f_stat_p_value(10.0, 1.0, 5, 3) == 1.0  # df2 < 1
+
+
+def test_more_segments_need_proportional_justification():
+    # best_model_proportion=1.0 → strictly prefer lowest p
+    y = np.where(YEARS < 2000, 0.1, 0.8)
+    r1 = seg(y, params=LTParams(best_model_proportion=1.0))
+    r2 = seg(y, params=LTParams(best_model_proportion=0.25))
+    assert r2.n_vertices >= r1.n_vertices  # leniency never removes segments
+
+
+def test_pixel_segmenter_facade():
+    ps = PixelSegmenter()
+    y = np.where(YEARS < 2000, 0.1, 0.8)
+    r = ps.segment(YEARS, y)
+    assert r.model_valid
+    # NaNs are auto-masked
+    y_nan = y.copy()
+    y_nan[4] = np.nan
+    r2 = ps.segment(YEARS, y_nan)
+    assert r2.model_valid
+    assert 4 not in r2.vertex_indices[: r2.n_vertices]
+
+
+def test_ftv_fits_second_index_to_vertices():
+    y1 = np.where(YEARS < 2000, 0.1, 0.8)
+    r = seg(y1)
+    y2 = np.where(YEARS < 2000, 0.5, 0.2) + 0.001 * (YEARS - 1984)
+    ftv = fit_to_vertices(YEARS, y2, ALL, r.vertex_indices, r.n_vertices, P)
+    assert ftv.shape == (NY,)
+    # FTV should track y2's levels reasonably
+    assert abs(ftv[0] - y2[0]) < 0.1 and abs(ftv[-1] - y2[-1]) < 0.1
+
+
+def test_deterministic():
+    rng = np.random.default_rng(11)
+    y = np.cumsum(rng.normal(0, 0.2, NY))
+    r1, r2 = seg(y), seg(y)
+    np.testing.assert_array_equal(r1.vertex_indices, r2.vertex_indices)
+    np.testing.assert_array_equal(r1.fitted, r2.fitted)
