@@ -419,7 +419,9 @@ def segment_series(
     """
     years = np.asarray(years, dtype=np.float64)
     values = np.asarray(values, dtype=np.float64)
-    mask = np.asarray(mask, dtype=bool)
+    # non-finite observations are invalid regardless of the caller's mask
+    # (the TPU kernel applies the identical guard)
+    mask = np.asarray(mask, dtype=bool) & np.isfinite(values)
     ny = len(years)
     valid_idx = np.flatnonzero(mask)
     n = len(valid_idx)
@@ -528,7 +530,7 @@ def fit_to_vertices(
     """
     years = np.asarray(years, dtype=np.float64)
     values = np.asarray(values, dtype=np.float64)
-    mask = np.asarray(mask, dtype=bool)
+    mask = np.asarray(mask, dtype=bool) & np.isfinite(values)
     valid_idx = np.flatnonzero(mask)
     if n_vertices < 2 or len(valid_idx) < 2:
         mean = float(np.mean(values[mask])) if mask.any() else 0.0

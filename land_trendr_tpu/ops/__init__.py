@@ -1,1 +1,5 @@
-"""ops subpackage of land_trendr_tpu."""
+"""ops subpackage: TPU compute kernels."""
+
+from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels, segment_pixel
+
+__all__ = ["SegOutputs", "jax_segment_pixels", "segment_pixel"]

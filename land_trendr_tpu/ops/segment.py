@@ -1,0 +1,519 @@
+"""``jax_segment_pixels`` — the batched TPU LandTrendr kernel.
+
+This operator replaces the reference's per-pixel execution path at the
+``LandTrendrMapper``/``PixelSegmenter`` plugin seam (SURVEY.md §2,
+BASELINE.json north_star): instead of one Hadoop map task per pixel, whole
+tiles of pixel time series live as HBM-resident ``(tile_px, year)`` arrays
+and the full pipeline — despike, candidate-vertex search, anchored
+piecewise-linear least squares, F-statistic model selection — runs as one
+vmapped, jit-compiled XLA program with **no cross-pixel collectives**.
+
+Semantics are defined by the CPU oracle
+(:mod:`land_trendr_tpu.models.oracle`); this kernel is its fixed-shape,
+branchless re-expression (SURVEY.md §7 design stance):
+
+* dynamic vertex insertion/removal → boolean vertex masks over the static
+  year axis, updated in ``lax.fori_loop``s with *fixed* trip counts and
+  no-op guards;
+* per-segment regressions → masked closed-form least squares driven by a
+  small ``(segments, years)`` membership matrix (a tiny matmul-shaped
+  contraction the TPU handles natively);
+* data-dependent branches → ``jnp.where`` selects; every division is
+  guarded so masked/degenerate lanes stay finite;
+* argmax/argmin tie-breaking matches the oracle exactly (first index).
+
+All math runs in the input dtype: float64 (with ``JAX_ENABLE_X64``) for
+exact-parity testing against the oracle on CPU, float32 on TPU.
+
+**Float32 tolerance contract** (SURVEY.md §7 step 2 "f32 on TPU with
+documented tolerance"): in float64 the kernel matches the oracle
+vertex-for-vertex.  In float32 the pipeline's argmax/argmin decisions
+(spike selection, deviation insertion, angle culls) sit on knife edges for
+noise-chasing candidates, and XLA fusion choices (which legally vary with
+batch size and platform) can flip them by one ulp; the F-test's far tail
+then amplifies a flipped cull into a different — *statistically
+equivalent* — model selection on strong-signal pixels.  Measured on
+synthetic disturbance stacks: fitted-model RMSE distributions agree to
+``|Δrmse| ≲ 0.02`` at p95 with no systematic bias, while exact vertex
+placement may differ on a large fraction of strong-signal (p ≪ 1e-10)
+pixels.  This mirrors the classic algorithm's own sensitivity to compiler
+flags.  Pipelines that need bit-exact vertex parity should run the f64
+path (CPU, or TPU with x64 at a large slowdown).
+
+Shape/naming conventions: ``NY`` = years (static), ``NC`` =
+``max_segments + 1 + vertex_count_overshoot`` candidate-vertex capacity,
+``NV`` = ``max_segments + 1`` final vertex capacity, ``NM`` =
+``max_segments`` model-family slots.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from land_trendr_tpu.config import LTParams
+
+__all__ = ["SegOutputs", "segment_pixel", "jax_segment_pixels"]
+
+_EPS_RATE = 1e-12  # must match oracle._segment_violates
+
+
+class SegOutputs(NamedTuple):
+    """Per-pixel outputs; mirrors ``oracle.SegmentationResult`` field for field.
+
+    Under :func:`jax_segment_pixels` every field gains a leading pixel axis.
+    """
+
+    n_vertices: jnp.ndarray      # () int32
+    vertex_indices: jnp.ndarray  # (NV,) int32, padded -1
+    vertex_years: jnp.ndarray    # (NV,)
+    vertex_src_vals: jnp.ndarray # (NV,)
+    vertex_fit_vals: jnp.ndarray # (NV,)
+    seg_magnitude: jnp.ndarray   # (NM,)
+    seg_duration: jnp.ndarray    # (NM,)
+    seg_rate: jnp.ndarray        # (NM,)
+    rmse: jnp.ndarray            # ()
+    p_of_f: jnp.ndarray          # ()
+    model_valid: jnp.ndarray     # () bool
+    fitted: jnp.ndarray          # (NY,)
+    despiked: jnp.ndarray        # (NY,)
+
+
+# ---------------------------------------------------------------------------
+# Stage 1 — despike (oracle.despike)
+# ---------------------------------------------------------------------------
+
+
+def _neighbour_indices(mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Nearest valid neighbour index on each side (prev=-1 / next=NY when none)."""
+    ny = mask.shape[0]
+    iota = jnp.arange(ny)
+    prev_incl = lax.cummax(jnp.where(mask, iota, -1))
+    prev = jnp.concatenate([jnp.array([-1]), prev_incl[:-1]])
+    next_incl = -lax.cummax(jnp.where(mask, -iota, -(ny))[::-1])[::-1]
+    nxt = jnp.concatenate([next_incl[1:], jnp.array([ny])])
+    return prev, nxt
+
+
+def _despike(
+    t: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, n_valid: jnp.ndarray,
+    params: LTParams,
+) -> jnp.ndarray:
+    """Iterative largest-spike dampening; trip count fixed at NY, guarded to
+    the oracle's ``n_valid`` iteration cap."""
+    ny = y.shape[0]
+    prev, nxt = _neighbour_indices(mask)
+    interior = mask & (prev >= 0) & (nxt < ny)
+    prev_c = jnp.clip(prev, 0, ny - 1)
+    nxt_c = jnp.clip(nxt, 0, ny - 1)
+
+    def body(it, y):
+        tp, tq = t[prev_c], t[nxt_c]
+        yp, yq = y[prev_c], y[nxt_c]
+        denom = jnp.where(interior, tq - tp, 1.0)
+        itp = yp + (yq - yp) * (t - tp) / denom
+        dev = jnp.abs(y - itp)
+        crossing = jnp.abs(yq - yp)
+        prop = jnp.where(dev > 0.0, jnp.maximum(0.0, 1.0 - crossing / jnp.where(dev > 0.0, dev, 1.0)), 0.0)
+        prop = jnp.where(interior, prop, -1.0)
+        i = jnp.argmax(prop)  # first max — matches oracle tie-break
+        do = (prop[i] > params.spike_threshold) & (it < n_valid)
+        delta = jnp.where(do, (itp[i] - y[i]) * prop[i], 0.0)
+        return y.at[i].add(delta)
+
+    if params.spike_threshold >= 1.0:
+        return y
+    return lax.fori_loop(0, ny, body, y)
+
+
+# ---------------------------------------------------------------------------
+# Masked closed-form least squares
+# ---------------------------------------------------------------------------
+
+
+def _masked_ols(t, y, member):
+    """OLS intercept/slope per row of a (K, NY) membership matrix.
+
+    Mean-centred formulation — identical to ``oracle._ols`` — so float64
+    results match the oracle bit-for-bit up to summation order.
+    """
+    m = member.astype(t.dtype)
+    n = jnp.sum(m, axis=-1)
+    n_safe = jnp.maximum(n, 1.0)
+    tm = jnp.sum(m * t, axis=-1) / n_safe
+    ym = jnp.sum(m * y, axis=-1) / n_safe
+    tc = (t - tm[:, None]) * m
+    stt = jnp.sum(tc * (t - tm[:, None]), axis=-1)
+    sty = jnp.sum(tc * (y - ym[:, None]), axis=-1)
+    ok = (n >= 2.0) & (stt > 0.0)
+    slope = jnp.where(ok, sty / jnp.where(ok, stt, 1.0), 0.0)
+    intercept = ym - slope * tm
+    return intercept, slope
+
+
+# ---------------------------------------------------------------------------
+# Stage 2 — candidate vertex search + angle cull
+# ---------------------------------------------------------------------------
+
+
+def _vertex_positions(vmask: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Sorted vertex positions, padded with NY (an out-of-range sentinel)."""
+    ny = vmask.shape[0]
+    return jnp.nonzero(vmask, size=size, fill_value=ny)[0]
+
+
+def _find_candidates(t, y, mask, vmask0, params: LTParams):
+    """Grow the vertex mask by max-deviation insertion (oracle
+    ``find_candidate_vertices``); NC-2 fixed iterations with no-op guards."""
+    ny = y.shape[0]
+    nc = params.max_candidates
+    iota = jnp.arange(ny)
+
+    def body(_, vmask):
+        vpos = _vertex_positions(vmask, nc)           # (NC,) padded NY
+        lo, hi = vpos[:-1], vpos[1:]                   # (NC-1,) segment bounds
+        member = (
+            (iota[None, :] >= lo[:, None])
+            & (iota[None, :] <= hi[:, None])
+            & mask[None, :]
+            & (hi[:, None] < ny)
+        )
+        c0, c1 = _masked_ols(t, y, member)
+        seg_of = jnp.clip(jnp.cumsum(vmask) - 1, 0, nc - 2)
+        dev = jnp.abs(y - (c0[seg_of] + c1[seg_of] * t))
+        eligible = mask & ~vmask & (iota > vpos[0]) & (iota < _last_vertex(vpos, ny))
+        dev = jnp.where(eligible, dev, -1.0)
+        i = jnp.argmax(dev)
+        do = dev[i] >= 0.0
+        return vmask | (jnp.zeros_like(vmask).at[i].set(True) & do)
+
+    return lax.fori_loop(0, nc - 2, body, vmask0)
+
+
+def _last_vertex(vpos: jnp.ndarray, ny: int) -> jnp.ndarray:
+    """Largest real (non-padded) vertex position."""
+    return jnp.max(jnp.where(vpos < ny, vpos, -1))
+
+
+def _vertex_angles(t, y, vpos, n_verts, t_lo, t_hi, y_lo, y_hi):
+    """Angle change at interior vertices on axis-scaled data (oracle
+    ``_vertex_angles``); padded / endpoint slots get +inf."""
+    ny = t.shape[0]
+    k = vpos.shape[0]
+    vpos_c = jnp.clip(vpos, 0, ny - 1)
+    t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, 1.0)
+    y_rng = jnp.where(y_hi > y_lo, y_hi - y_lo, 1.0)
+    xs = (t[vpos_c] - t_lo) / t_rng
+    ys = (y[vpos_c] - y_lo) / y_rng
+    j = jnp.arange(k)
+    interior = (j >= 1) & (j < n_verts - 1)
+    dx1 = jnp.where(interior, xs - jnp.roll(xs, 1), 1.0)
+    dx2 = jnp.where(interior, jnp.roll(xs, -1) - xs, 1.0)
+    s1 = (ys - jnp.roll(ys, 1)) / dx1
+    s2 = (jnp.roll(ys, -1) - ys) / dx2
+    ang = jnp.abs(jnp.arctan(s2) - jnp.arctan(s1))
+    return jnp.where(interior, ang, jnp.inf)
+
+
+def _remove_weakest(t, y, vmask, scale, size, keep_above):
+    """Drop the min-angle interior vertex while count > keep_above (one step)."""
+    ny = t.shape[0]
+    t_lo, t_hi, y_lo, y_hi = scale
+    vpos = _vertex_positions(vmask, size)
+    n_verts = jnp.sum(vmask)
+    ang = _vertex_angles(t, y, vpos, n_verts, t_lo, t_hi, y_lo, y_hi)
+    j = jnp.argmin(ang)  # first min — matches oracle tie-break
+    do = n_verts > keep_above
+    pos = jnp.clip(vpos[j], 0, ny - 1)
+    return jnp.where(
+        do, vmask.at[pos].set(False), vmask
+    )
+
+
+# ---------------------------------------------------------------------------
+# Stage 3 — anchored piecewise-linear fit (oracle.fit_model)
+# ---------------------------------------------------------------------------
+
+
+def _clamp_slope(slope, duration, y_range, params: LTParams):
+    """Recovery-rate constraints on a candidate slope (disturbance-positive)."""
+    limit = -params.recovery_threshold * y_range
+    clamped = jnp.maximum(slope, limit)
+    if params.prevent_one_year_recovery:
+        clamped = jnp.where(duration <= 1.0, 0.0, clamped)
+    active = (slope < 0.0) & (y_range > 0.0)
+    return jnp.where(active, clamped, slope)
+
+
+def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
+    """Anchored fit + point-to-point fallback for one vertex set.
+
+    Returns ``(fitted_valid, sse)`` where ``fitted_valid`` is the fitted
+    value at every (valid) year position and ``sse`` sums over valid years.
+    """
+    ny = t.shape[0]
+    nv = params.max_vertices
+    iota = jnp.arange(ny)
+    vpos = _vertex_positions(vmask, nv)
+    n_verts = jnp.sum(vmask)
+    vpos_c = jnp.clip(vpos, 0, ny - 1)
+
+    # --- segment 0: OLS over closed [v0, v1] ---
+    member0 = (iota >= vpos[0]) & (iota <= vpos[1]) & mask
+    c0, c1 = _masked_ols(t, y, member0[None, :])
+    c0, c1 = c0[0], c1[0]
+    dur0 = t[vpos_c[1]] - t[vpos_c[0]]
+    c1c = _clamp_slope(c1, dur0, y_range, params)
+    # intercept is ym - slope*tm for both the clamped and unclamped slope
+    m0 = member0.astype(t.dtype)
+    n0 = jnp.maximum(jnp.sum(m0), 1.0)
+    c0 = jnp.sum(m0 * y) / n0 - c1c * (jnp.sum(m0 * t) / n0)
+    fitted = jnp.where(member0, c0 + c1c * t, 0.0)
+    anchor_t = t[vpos_c[1]]
+    anchor_y = c0 + c1c * anchor_t
+
+    # --- segments 1..: slope-only regression through the anchor ---
+    def body(k, carry):
+        fitted, anchor_t, anchor_y = carry
+        a, b = vpos[k], vpos[k + 1]
+        active = (k + 1) < n_verts
+        member = (iota > a) & (iota <= b) & mask & active
+        m = member.astype(t.dtype)
+        dt = (t - anchor_t) * m
+        denom = jnp.sum(dt * dt)
+        slope = jnp.where(denom > 0.0, jnp.sum(dt * (y - anchor_y)) / jnp.where(denom > 0.0, denom, 1.0), 0.0)
+        b_c = jnp.clip(b, 0, ny - 1)
+        slope = _clamp_slope(slope, t[b_c] - anchor_t, y_range, params)
+        fitted = jnp.where(member, anchor_y + slope * (t - anchor_t), fitted)
+        new_anchor_y = anchor_y + slope * (t[b_c] - anchor_t)
+        anchor_t = jnp.where(active, t[b_c], anchor_t)
+        anchor_y = jnp.where(active, new_anchor_y, anchor_y)
+        return fitted, anchor_t, anchor_y
+
+    fitted, _, _ = lax.fori_loop(1, nv - 1, body, (fitted, anchor_t, anchor_y))
+
+    # --- point-to-point fallback ---
+    def p2p_body(k, carry):
+        p2p, ok = carry
+        a, b = vpos[k], vpos[k + 1]
+        active = (k + 1) < n_verts
+        a_c = jnp.clip(a, 0, ny - 1)
+        b_c = jnp.clip(b, 0, ny - 1)
+        dur = t[b_c] - t[a_c]
+        dy = y[b_c] - y[a_c]
+        # oracle._segment_violates
+        viol = (dy < 0.0) & (y_range > 0.0) & (dur > 0.0)
+        if params.prevent_one_year_recovery:
+            fast = dur <= 1.0
+        else:
+            fast = jnp.zeros((), dtype=bool)
+        viol = viol & (
+            fast | ((-dy) / jnp.where(dur > 0.0, dur, 1.0) > params.recovery_threshold * y_range + _EPS_RATE)
+        )
+        ok = ok & ~(viol & active)
+        member = (iota >= a) & (iota <= b) & mask & active
+        rate = jnp.where(dur > 0.0, dy / jnp.where(dur > 0.0, dur, 1.0), 0.0)
+        p2p = jnp.where(member, y[a_c] + rate * (t - t[a_c]), p2p)
+        return p2p, ok
+
+    p2p0 = jnp.where((iota == vpos[0]) & mask, y, 0.0)
+    p2p, p2p_ok = lax.fori_loop(0, nv - 1, p2p_body, (p2p0, jnp.array(True)))
+
+    span = mask  # vertices span the whole valid range in this pipeline
+    sse_reg = jnp.sum(jnp.where(span, (y - fitted) ** 2, 0.0))
+    sse_p2p = jnp.sum(jnp.where(span, (y - p2p) ** 2, 0.0))
+    use_p2p = p2p_ok & (sse_p2p < sse_reg)
+    fitted = jnp.where(use_p2p, p2p, fitted)
+    sse = jnp.where(use_p2p, sse_p2p, sse_reg)
+    return fitted, sse
+
+
+# ---------------------------------------------------------------------------
+# Stage 4 — F-statistic scoring (oracle.f_stat_p_value)
+# ---------------------------------------------------------------------------
+
+
+def _f_stat_p(ss0, sse, n, m):
+    """p-of-F with df1 = 2m-1, df2 = n-2m via the regularised incomplete beta."""
+    df1 = 2.0 * m - 1.0
+    df2 = n - 2.0 * m
+    invalid = (df2 < 1.0) | (ss0 <= 0.0) | (sse >= ss0)
+    perfect = (sse <= 0.0) & ~invalid
+    df1s = jnp.maximum(df1, 1.0)
+    df2s = jnp.maximum(df2, 1.0)
+    sse_s = jnp.where(perfect | invalid, 1.0, sse)
+    f = ((ss0 - sse_s) / df1s) / (sse_s / df2s)
+    f = jnp.maximum(f, 0.0)
+    x = df2s / (df2s + df1s * f)
+    p = jax.scipy.special.betainc(df2s / 2.0, df1s / 2.0, x)
+    return jnp.where(invalid, 1.0, jnp.where(perfect, 0.0, p))
+
+
+# ---------------------------------------------------------------------------
+# Top-level per-pixel kernel
+# ---------------------------------------------------------------------------
+
+
+def segment_pixel(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams,
+) -> SegOutputs:
+    """Full LandTrendr pipeline on one pixel (fixed shapes; vmap over pixels).
+
+    Mirrors ``oracle.segment_series`` decision for decision; see the module
+    docstring for the dynamic→static mapping.
+    """
+    dtype = jnp.result_type(values.dtype, jnp.float32)
+    t = years.astype(dtype)
+    v = values.astype(dtype)
+    mask = mask.astype(bool) & jnp.isfinite(v)
+    v = jnp.where(mask, v, 0.0)
+    ny = t.shape[0]
+    nv, nc, nm = params.max_vertices, params.max_candidates, params.max_segments
+    iota = jnp.arange(ny)
+
+    n_valid = jnp.sum(mask)
+    enough = n_valid >= params.min_observations_needed
+
+    # Stage 1 — despike
+    y = _despike(t, v, mask, n_valid, params)
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    y_lo = jnp.min(jnp.where(mask, y, big))
+    y_hi = jnp.max(jnp.where(mask, y, -big))
+    y_range = jnp.maximum(y_hi - y_lo, 0.0)
+
+    first_v = jnp.argmax(mask)
+    last_v = ny - 1 - jnp.argmax(mask[::-1])
+    t_lo, t_hi = t[first_v], t[last_v]
+    scale = (t_lo, t_hi, y_lo, y_hi)
+
+    # Stage 2 — candidates + cull
+    vmask0 = mask & ((iota == first_v) | (iota == last_v))
+    vmask = _find_candidates(t, y, mask, vmask0, params)
+    vmask = lax.fori_loop(
+        0,
+        params.vertex_count_overshoot,
+        lambda _, vm: _remove_weakest(t, y, vm, scale, nc, nv),
+        vmask,
+    )
+
+    # Stage 4 — model family: record, then prune weakest and refit
+    ss0 = jnp.sum(jnp.where(mask, (y - jnp.sum(jnp.where(mask, y, 0.0)) / jnp.maximum(n_valid, 1)) ** 2, 0.0))
+
+    def model_step(vm, _):
+        fitted, sse = _fit_model(t, y, mask, vm, y_range, params)
+        m = jnp.sum(vm) - 1  # segments in this model
+        p = _f_stat_p(ss0, sse, n_valid.astype(dtype), m.astype(dtype))
+        vm_next = _remove_weakest(t, y, vm, scale, nv, 2)
+        return vm_next, (vm, fitted, sse, p)
+
+    _, (vmasks, fitteds, sses, ps) = lax.scan(model_step, vmask, None, length=nm)
+
+    # Selection: most segments whose p is within best_model_proportion of best
+    p_best = jnp.min(ps)
+    qualify = ps <= p_best / params.best_model_proportion
+    chosen = jnp.argmax(qualify)  # first (= most segments) qualifying model
+    vmask_c = vmasks[chosen]
+    fitted_c = fitteds[chosen]
+    sse_c = sses[chosen]
+    p_c = ps[chosen]
+
+    model_valid = enough & (y_range > 0.0) & (p_c <= params.p_val_threshold)
+
+    # --- assemble outputs (flat no-fit model when not model_valid) ---
+    # The oracle's insufficient-data path never despikes, so its flat model
+    # statistics come from the RAW valid values; the p-threshold / constant
+    # no-fit paths run after despiking and use the despiked series
+    # (oracle._flat_result's despiked_valid argument).
+    raw = values.astype(dtype)
+    has_any = n_valid > 0
+    n_safe = jnp.maximum(n_valid, 1)
+    mean_desp = jnp.where(has_any, jnp.sum(jnp.where(mask, y, 0.0)) / n_safe, 0.0)
+    mean_raw = jnp.where(
+        has_any, jnp.sum(jnp.where(mask, raw, 0.0)) / n_safe, 0.0
+    )
+    mean = jnp.where(enough, mean_desp, mean_raw)
+    flat_src = jnp.where(enough, y, raw)
+
+    vpos = _vertex_positions(vmask_c, nv)
+    k = jnp.sum(vmask_c)
+    live = jnp.arange(nv) < k
+    vpos_c = jnp.clip(vpos, 0, ny - 1)
+    vertex_indices = jnp.where(live & model_valid, vpos_c, -1).astype(jnp.int32)
+    vertex_years = jnp.where(live & model_valid, t[vpos_c], 0.0)
+    vertex_src = jnp.where(live & model_valid, y[vpos_c], 0.0)
+    vfit = fitted_c[vpos_c]
+    vertex_fit = jnp.where(live & model_valid, vfit, 0.0)
+
+    sidx = jnp.arange(nm)
+    seg_live = (sidx < k - 1) & model_valid
+    mag = jnp.where(seg_live, vfit[1:] - vfit[:-1], 0.0)
+    dur = jnp.where(seg_live, t[vpos_c[1:]] - t[vpos_c[:-1]], 0.0)
+    rate = jnp.where(seg_live & (dur > 0.0), mag / jnp.where(dur > 0.0, dur, 1.0), 0.0)
+
+    # full-axis fitted trajectory: interp through vertices (padding repeats
+    # the last real vertex so the extension is flat, as np.interp does)
+    xp = jnp.where(live, t[vpos_c], t[jnp.clip(last_v, 0, ny - 1)])
+    last_fit = vfit[jnp.clip(k - 1, 0, nv - 1)]
+    fp = jnp.where(live, vfit, last_fit)
+    fitted_full = jnp.interp(t, xp, fp)
+    fitted_full = jnp.where(model_valid, fitted_full, mean)
+
+    rmse_fit = jnp.sqrt(sse_c / n_safe)
+    rmse_flat = jnp.sqrt(
+        jnp.sum(jnp.where(mask, (flat_src - mean) ** 2, 0.0)) / n_safe
+    )
+    rmse = jnp.where(model_valid, rmse_fit, jnp.where(has_any, rmse_flat, 0.0))
+
+    # despiked output: valid slots get the despiked series; invalid slots keep
+    # the raw input when a model fit happened, the flat mean otherwise
+    # (oracle.segment_series / oracle._flat_result — which keeps raw valid
+    # values on the insufficient-data path)
+    despiked_fit = jnp.where(mask, y, raw)
+    despiked_flat = jnp.where(mask, flat_src, mean)
+    despiked = jnp.where(model_valid, despiked_fit, despiked_flat)
+
+    return SegOutputs(
+        n_vertices=jnp.where(model_valid, k, 0).astype(jnp.int32),
+        vertex_indices=vertex_indices,
+        vertex_years=vertex_years,
+        vertex_src_vals=vertex_src,
+        vertex_fit_vals=vertex_fit,
+        seg_magnitude=mag,
+        seg_duration=dur,
+        seg_rate=rate,
+        rmse=rmse,
+        p_of_f=jnp.where(model_valid, p_c, 1.0),
+        model_valid=model_valid,
+        fitted=fitted_full,
+        despiked=despiked,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def jax_segment_pixels(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+) -> SegOutputs:
+    """Segment a batch of pixel time series on device.
+
+    Parameters
+    ----------
+    years : (NY,) shared year axis.
+    values : (PX, NY) spectral-index series, disturbance-positive convention.
+    mask : (PX, NY) bool validity mask.
+    params : static LTParams — one compilation per parameter set.
+
+    Returns
+    -------
+    SegOutputs with a leading PX axis on every field.
+    """
+    return jax.vmap(lambda v, m: segment_pixel(years, v, m, params))(values, mask)

@@ -11,13 +11,23 @@ CPU; float32 on TPU with documented tolerance).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "1"
+
+# The container's sitecustomize preloads jax (axon TPU platform) at
+# interpreter startup, before this conftest runs — so the env vars above are
+# not enough on their own.  Backends initialise lazily, though, so flipping
+# the config here (before any device is touched) still selects the virtual
+# 8-device CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 import pytest

@@ -1,0 +1,45 @@
+"""Float32 quality gate: the f32 kernel must be *statistically equivalent*
+to the f64 oracle even where exact vertex placement differs (the documented
+f32 tolerance contract in ``ops/segment.py``)."""
+
+import numpy as np
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.models.oracle import segment_series
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+YEARS = np.arange(1984, 2022, dtype=np.float64)
+NY = len(YEARS)
+
+
+def test_f32_statistical_equivalence(rng):
+    n_px = 256
+    base = np.where(
+        YEARS < 1996, 0.15, np.maximum(0.85 - 0.03 * (YEARS - 1996), 0.15)
+    )
+    vals = base[None, :] + rng.normal(0, 0.02, (n_px, NY))
+    mask = rng.random((n_px, NY)) > 0.1
+    params = LTParams()
+
+    out = jax_segment_pixels(
+        YEARS.astype(np.float32), vals.astype(np.float32), mask, params
+    )
+    rmse32 = np.asarray(out.rmse)
+    valid32 = np.asarray(out.model_valid)
+
+    d_rmse = []
+    valid_flips = 0
+    for i in range(n_px):
+        ref = segment_series(YEARS, vals[i], mask[i], params)
+        valid_flips += ref.model_valid != valid32[i]
+        if ref.model_valid and valid32[i]:
+            d_rmse.append(rmse32[i] - ref.rmse)
+    d_rmse = np.asarray(d_rmse)
+
+    # model_valid decisions agree except for rare knife-edge pixels
+    assert valid_flips <= max(2, n_px // 50)
+    # rmse distribution equivalent: no systematic bias, tight spread
+    assert abs(np.mean(d_rmse)) < 0.02
+    assert np.quantile(np.abs(d_rmse), 0.95) < 0.1
+    # the f32 fits are never catastrophically worse
+    assert np.max(d_rmse) < 0.25

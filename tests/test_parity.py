@@ -1,0 +1,171 @@
+"""Vertex-for-vertex parity: JAX kernel vs CPU oracle (the north-star
+correctness metric, BASELINE.json).
+
+Runs the kernel in float64 on CPU (exact-parity mode, SURVEY.md §7 step 2)
+over the synthetic-series matrix and a randomized fuzz sweep, asserting
+*exact* vertex placement and tight-tolerance floats.
+"""
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.models import oracle
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+YEARS = np.arange(1984, 2022, dtype=np.float64)
+NY = len(YEARS)
+ALL = np.ones(NY, dtype=bool)
+
+
+def run_both(values, mask=None, params=LTParams()):
+    mask = ALL if mask is None else mask
+    ref = oracle.segment_series(YEARS, values, mask, params)
+    out = jax_segment_pixels(
+        YEARS, values[None, :].astype(np.float64), mask[None, :], params
+    )
+    return ref, jax_tree_to_np_row(out)
+
+
+def jax_tree_to_np_row(out):
+    return {k: np.asarray(v)[0] for k, v in out._asdict().items()}
+
+
+def assert_parity(ref, got, atol=1e-8, ctx=""):
+    assert got["model_valid"] == ref.model_valid, f"{ctx} model_valid"
+    assert got["n_vertices"] == ref.n_vertices, f"{ctx} n_vertices"
+    np.testing.assert_array_equal(
+        got["vertex_indices"], ref.vertex_indices, err_msg=f"{ctx} vertex_indices"
+    )
+    for field in (
+        "vertex_years",
+        "vertex_src_vals",
+        "vertex_fit_vals",
+        "seg_magnitude",
+        "seg_duration",
+        "seg_rate",
+        "fitted",
+        "despiked",
+    ):
+        np.testing.assert_allclose(
+            got[field], getattr(ref, field), atol=atol, rtol=1e-7,
+            err_msg=f"{ctx} {field}",
+        )
+    np.testing.assert_allclose(got["rmse"], ref.rmse, atol=atol, err_msg=f"{ctx} rmse")
+    np.testing.assert_allclose(
+        got["p_of_f"], ref.p_of_f, atol=1e-9, err_msg=f"{ctx} p_of_f"
+    )
+
+
+# ---------------------------------------------------------------------------
+# structured synthetic matrix (SURVEY.md §7 step 2)
+# ---------------------------------------------------------------------------
+
+
+def _noisy(y, seed, sd=0.01):
+    return y + np.random.default_rng(seed).normal(0.0, sd, NY)
+
+
+CASES = {
+    "flat": np.full(NY, 0.3),
+    "flat_noisy": _noisy(np.full(NY, 0.3), 1),
+    "step": _noisy(np.where(YEARS < 2000, 0.1, 0.8), 2),
+    "ramp": _noisy(0.02 * (YEARS - 1984), 3),
+    "disturbance_recovery": _noisy(
+        np.where(YEARS < 1996, 0.15, np.maximum(0.85 - 0.03 * (YEARS - 1996), 0.15)), 4
+    ),
+    "spike": _noisy(np.where(YEARS == 2000, 0.9, 0.2), 5),
+    "double_disturbance": _noisy(
+        np.where(YEARS < 1992, 0.1, np.where(YEARS < 2008, 0.5, 0.9)), 6
+    ),
+    "noise_only": np.random.default_rng(7).normal(0.0, 1.0, NY),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_case_parity(name):
+    ref, got = run_both(CASES[name])
+    assert_parity(ref, got, ctx=name)
+
+
+def test_masked_parity():
+    mask = ALL.copy()
+    mask[3:25:4] = False
+    ref, got = run_both(CASES["step"], mask)
+    assert_parity(ref, got, ctx="masked step")
+
+
+def test_leading_trailing_masked():
+    mask = ALL.copy()
+    mask[:4] = False
+    mask[-5:] = False
+    ref, got = run_both(CASES["disturbance_recovery"], mask)
+    assert_parity(ref, got, ctx="trimmed")
+
+
+def test_below_min_obs_parity():
+    mask = np.zeros(NY, dtype=bool)
+    mask[:5] = True
+    ref, got = run_both(CASES["ramp"], mask)
+    assert_parity(ref, got, ctx="below min obs")
+
+
+def test_all_masked_parity():
+    ref, got = run_both(CASES["ramp"], np.zeros(NY, dtype=bool))
+    assert_parity(ref, got, ctx="all masked")
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        LTParams(max_segments=4),
+        LTParams(spike_threshold=0.5),
+        LTParams(vertex_count_overshoot=0),
+        LTParams(recovery_threshold=10.0),
+        LTParams(prevent_one_year_recovery=False),
+        LTParams(p_val_threshold=1.0, best_model_proportion=1.0),
+    ],
+)
+def test_param_sweep_parity(params):
+    ref, got = run_both(CASES["disturbance_recovery"], params=params)
+    assert_parity(ref, got, ctx=str(params))
+
+
+# ---------------------------------------------------------------------------
+# randomized fuzz
+# ---------------------------------------------------------------------------
+
+
+def test_fuzz_parity(rng):
+    n_total = 120
+    for trial in range(n_total):
+        kind = trial % 4
+        if kind == 0:  # random walk
+            y = np.cumsum(rng.normal(0, 0.1, NY))
+        elif kind == 1:  # step + noise
+            yr = rng.integers(1988, 2018)
+            y = np.where(YEARS < yr, 0.0, rng.uniform(0.3, 1.0)) + rng.normal(
+                0, 0.05, NY
+            )
+        elif kind == 2:  # disturbance + recovery + spikes
+            yr = rng.integers(1988, 2012)
+            y = np.where(
+                YEARS < yr, 0.2, np.maximum(0.9 - 0.04 * (YEARS - yr), 0.2)
+            ) + rng.normal(0, 0.03, NY)
+            y[rng.integers(0, NY)] += rng.uniform(0.3, 1.0)
+        else:  # smooth trend
+            y = 0.01 * (YEARS - 2000) + 0.3 * np.sin((YEARS - 1984) / 6.0)
+            y = y + rng.normal(0, 0.02, NY)
+        mask = rng.random(NY) > rng.uniform(0.0, 0.35)
+        ref, got = run_both(y, mask)
+        assert_parity(ref, got, ctx=f"fuzz {trial}")
+
+
+def test_batch_matches_per_pixel(rng):
+    ys = np.stack([CASES[k] for k in sorted(CASES)])
+    masks = np.ones_like(ys, dtype=bool)
+    out = jax_segment_pixels(YEARS, ys, masks, LTParams())
+    for i, k in enumerate(sorted(CASES)):
+        ref = oracle.segment_series(YEARS, ys[i], masks[i], LTParams())
+        got = {kk: np.asarray(v)[i] for kk, v in out._asdict().items()}
+        assert_parity(ref, got, ctx=f"batch {k}")
