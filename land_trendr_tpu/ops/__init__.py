@@ -1,5 +1,17 @@
 """ops subpackage: TPU compute kernels."""
 
+from land_trendr_tpu.ops.ftv import ftv_pixel, jax_fit_to_vertices
+from land_trendr_tpu.ops.indices import compute_index, qa_valid_mask, scale_sr, sr_valid_mask
 from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels, segment_pixel
 
-__all__ = ["SegOutputs", "jax_segment_pixels", "segment_pixel"]
+__all__ = [
+    "SegOutputs",
+    "jax_segment_pixels",
+    "segment_pixel",
+    "jax_fit_to_vertices",
+    "ftv_pixel",
+    "compute_index",
+    "qa_valid_mask",
+    "scale_sr",
+    "sr_valid_mask",
+]

@@ -1,0 +1,118 @@
+"""FTV — fitted-trajectory values for secondary indices (batched, on device).
+
+Classic LandTrendr (SURVEY.md §3.1 outputs) fits *other* spectral indices to
+the vertex years chosen by the segmentation index: the vertex set is fixed,
+and the target series is anchored-least-squares fitted through those years.
+The CPU oracle's :func:`land_trendr_tpu.models.oracle.fit_to_vertices` is the
+normative semantic spec; this module is its fixed-shape vmapped re-expression
+reusing the segmentation kernel's masked anchored fit.
+
+Mapping of the oracle's dynamic steps to static shapes:
+
+* ``np.searchsorted(valid_idx, vertex_indices)`` → ``jnp.searchsorted`` over
+  a fixed-size ``nonzero(mask, size=NY, fill=NY)`` position table;
+* ``sorted(set(...))`` dedup → scatter into a boolean vertex mask (duplicate
+  scatters coalesce for free);
+* the <2-vertices fallback → a mask of the first/last valid year selected by
+  ``jnp.where``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import _fit_model, _interp_through_vertices
+
+__all__ = ["ftv_pixel", "jax_fit_to_vertices"]
+
+
+def ftv_pixel(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    vertex_indices: jnp.ndarray,
+    n_vertices: jnp.ndarray,
+    params: LTParams,
+) -> jnp.ndarray:
+    """Fit one pixel's target series to an already-chosen vertex set.
+
+    Parameters
+    ----------
+    years : (NY,) shared year axis.
+    values : (NY,) target-index series (disturbance-positive convention).
+    mask : (NY,) bool validity of the *target* series.
+    vertex_indices : (NV,) stack-axis vertex indices from the segmentation
+        index's :class:`~land_trendr_tpu.ops.segment.SegOutputs`, padded -1.
+    n_vertices : () int — number of live entries in ``vertex_indices``.
+
+    Returns
+    -------
+    (NY,) fitted trajectory over the full year axis (flat mean of the valid
+    target values when there is no usable vertex set / too little data —
+    oracle ``fit_to_vertices`` fallback).
+    """
+    dtype = jnp.result_type(values.dtype, jnp.float32)
+    t = years.astype(dtype)
+    v = values.astype(dtype)
+    mask = mask.astype(bool) & jnp.isfinite(v)
+    v = jnp.where(mask, v, 0.0)
+    ny = t.shape[0]
+    nv = vertex_indices.shape[0]
+
+    n_valid = jnp.sum(mask)
+    n_safe = jnp.maximum(n_valid, 1)
+    valid_pos = jnp.nonzero(mask, size=ny, fill_value=ny)[0]
+
+    # stack-axis vertex index → nearest valid position at/after it (oracle's
+    # searchsorted + clip), then back to a full-axis index
+    pos = jnp.clip(jnp.searchsorted(valid_pos, vertex_indices), 0, n_safe - 1)
+    full = valid_pos[pos]                       # (NV,) full-axis indices
+    live = jnp.arange(nv) < n_vertices
+    vmask = jnp.zeros(ny, dtype=bool).at[full].max(live)  # dedup by scatter
+
+    # fallback to endpoints when the mapped set collapses below 2 vertices
+    first_v = jnp.argmax(mask)
+    last_v = ny - 1 - jnp.argmax(mask[::-1])
+    endpoints = (
+        jnp.zeros(ny, dtype=bool).at[first_v].set(True).at[last_v].set(True)
+        & mask
+    )
+    vmask = jnp.where(jnp.sum(vmask) >= 2, vmask, endpoints)
+
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    y_lo = jnp.min(jnp.where(mask, v, big))
+    y_hi = jnp.max(jnp.where(mask, v, -big))
+    y_range = jnp.maximum(y_hi - y_lo, 0.0)
+
+    fitted, _ = _fit_model(t, v, mask, vmask, y_range, params)
+    out = _interp_through_vertices(
+        t, vmask, fitted, t[jnp.clip(last_v, 0, ny - 1)], nv
+    )
+
+    mean = jnp.where(n_valid > 0, jnp.sum(jnp.where(mask, v, 0.0)) / n_safe, 0.0)
+    ok = (n_vertices >= 2) & (n_valid >= 2)
+    return jnp.where(ok, out, mean)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def jax_fit_to_vertices(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    vertex_indices: jnp.ndarray,
+    n_vertices: jnp.ndarray,
+    params: LTParams = LTParams(),
+) -> jnp.ndarray:
+    """Batched FTV: fit ``(PX, NY)`` target series to per-pixel vertex sets.
+
+    ``vertex_indices`` is ``(PX, NV)`` int32 (padded -1) and ``n_vertices``
+    ``(PX,)`` int32 — exactly the fields produced by
+    :func:`~land_trendr_tpu.ops.segment.jax_segment_pixels`.
+    """
+    return jax.vmap(
+        lambda v, m, vi, nv_: ftv_pixel(years, v, m, vi, nv_, params)
+    )(values, mask, vertex_indices, n_vertices)

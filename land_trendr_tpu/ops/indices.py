@@ -1,0 +1,172 @@
+"""Spectral-index math and QA masking for Landsat stacks.
+
+The reference computes NBR, NDVI and TCW from Landsat surface-reflectance
+bands on the driver side before dispatching per-pixel series (SURVEY.md §2
+layer L1, provenance ``[B]`` — index names confirmed by the reference's
+configs; the reference mount was empty, SURVEY.md §0, so formulas follow the
+standard published definitions the reference necessarily implements).
+
+Everything here is elementwise ``jax.numpy`` math over arrays of any shape
+(band images, whole stacks, per-pixel series) so it fuses into the
+surrounding jitted pipeline — on TPU the index computation is
+bandwidth-bound and XLA folds it into the same HBM pass that assembles the
+``(tile_px, year)`` kernel input.
+
+Sign convention (SURVEY.md §3.1 orientation note): LandTrendr fits
+*disturbance-positive* series.  NBR/NDVI/TCW all *decrease* under
+disturbance, so :func:`compute_index` flips their sign by default; the
+segment rasters the driver writes undo the flip where the reference's
+outputs are in natural orientation.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax.numpy as jnp
+
+__all__ = [
+    "BANDS",
+    "INDEX_NAMES",
+    "DISTURBANCE_SIGN",
+    "nbr",
+    "ndvi",
+    "tcw",
+    "compute_index",
+    "scale_sr",
+    "qa_valid_mask",
+    "sr_valid_mask",
+]
+
+#: Canonical Landsat surface-reflectance band names used throughout the
+#: framework (TM/ETM+/OLI harmonised six-band set).
+BANDS = ("blue", "green", "red", "nir", "swir1", "swir2")
+
+#: Tasseled-cap wetness coefficients for surface reflectance
+#: (Crist 1985, TM reflectance-factor coefficients — the set classic
+#: LandTrendr uses), in :data:`BANDS` order.
+_TCW_COEFFS = (0.0315, 0.2021, 0.3102, 0.1594, -0.6806, -0.6109)
+
+#: Sign multiplier that makes each index disturbance-positive.
+DISTURBANCE_SIGN = {"nbr": -1.0, "ndvi": -1.0, "tcw": -1.0}
+
+INDEX_NAMES = tuple(DISTURBANCE_SIGN)
+
+
+def _safe_ratio(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """``num / den`` with 0 where ``den`` is 0 (masked pixels stay finite)."""
+    ok = den != 0
+    return jnp.where(ok, num / jnp.where(ok, den, 1.0), 0.0)
+
+
+def nbr(nir: jnp.ndarray, swir2: jnp.ndarray) -> jnp.ndarray:
+    """Normalized Burn Ratio: (NIR − SWIR2) / (NIR + SWIR2)."""
+    return _safe_ratio(nir - swir2, nir + swir2)
+
+
+def ndvi(nir: jnp.ndarray, red: jnp.ndarray) -> jnp.ndarray:
+    """Normalized Difference Vegetation Index: (NIR − RED) / (NIR + RED)."""
+    return _safe_ratio(nir - red, nir + red)
+
+
+def tcw(
+    blue: jnp.ndarray,
+    green: jnp.ndarray,
+    red: jnp.ndarray,
+    nir: jnp.ndarray,
+    swir1: jnp.ndarray,
+    swir2: jnp.ndarray,
+) -> jnp.ndarray:
+    """Tasseled-cap wetness (Crist 1985 reflectance coefficients)."""
+    bands = (blue, green, red, nir, swir1, swir2)
+    out = _TCW_COEFFS[0] * bands[0]
+    for c, b in zip(_TCW_COEFFS[1:], bands[1:]):
+        out = out + c * b
+    return out
+
+
+def compute_index(
+    name: str,
+    bands: Mapping[str, jnp.ndarray],
+    disturbance_positive: bool = True,
+) -> jnp.ndarray:
+    """Compute a named spectral index from a band-name → array mapping.
+
+    Parameters
+    ----------
+    name : one of ``"nbr"``, ``"ndvi"``, ``"tcw"`` (case-insensitive).
+    bands : mapping with the required :data:`BANDS` entries; arrays of any
+        (mutually broadcastable) shape, reflectance-scaled floats.
+    disturbance_positive : flip the sign so disturbance is an increase
+        (LandTrendr's fitting convention).  Default True.
+    """
+    key = name.lower()
+    if key == "nbr":
+        out = nbr(bands["nir"], bands["swir2"])
+    elif key == "ndvi":
+        out = ndvi(bands["nir"], bands["red"])
+    elif key == "tcw":
+        out = tcw(*(bands[b] for b in BANDS))
+    else:
+        raise ValueError(f"unknown index {name!r}; expected one of {INDEX_NAMES}")
+    if disturbance_positive:
+        out = DISTURBANCE_SIGN[key] * out
+    return out
+
+
+def scale_sr(
+    dn: jnp.ndarray, scale: float = 2.75e-5, offset: float = -0.2
+) -> jnp.ndarray:
+    """Scale integer surface-reflectance DNs to reflectance floats.
+
+    Defaults to the Landsat Collection-2 convention (consistent with
+    :func:`qa_valid_mask`'s C2 QA_PIXEL layout); Collection-1 style data
+    uses ``scale=1e-4, offset=0.0``.
+    """
+    return dn.astype(jnp.float32) * scale + offset
+
+
+#: QA_PIXEL (CFMask) bit positions, Landsat Collection 2 layout.
+_QA_FILL = 1 << 0
+_QA_DILATED_CLOUD = 1 << 1
+_QA_CIRRUS = 1 << 2
+_QA_CLOUD = 1 << 3
+_QA_CLOUD_SHADOW = 1 << 4
+_QA_SNOW = 1 << 5
+
+#: Default rejection set: fill, cloud (incl. dilated + cirrus), shadow, snow.
+DEFAULT_QA_REJECT = (
+    _QA_FILL | _QA_DILATED_CLOUD | _QA_CIRRUS | _QA_CLOUD | _QA_CLOUD_SHADOW | _QA_SNOW
+)
+
+
+def qa_valid_mask(
+    qa: jnp.ndarray, reject_bits: int = DEFAULT_QA_REJECT
+) -> jnp.ndarray:
+    """True where the QA_PIXEL bitfield marks a usable observation.
+
+    An observation is valid when *none* of ``reject_bits`` are set.
+    """
+    return (qa.astype(jnp.int32) & reject_bits) == 0
+
+
+def sr_valid_mask(
+    bands: Mapping[str, jnp.ndarray],
+    lo: float = 0.0,
+    hi: float = 1.0,
+) -> jnp.ndarray:
+    """True where every reflectance band is finite and inside ``[lo, hi]``.
+
+    Catches saturated / fill values that slip past QA; ANDs across the
+    standard six bands present in ``bands``.
+    """
+    mask = None
+    for name in BANDS:
+        if name not in bands:
+            continue
+        b = bands[name]
+        ok = jnp.isfinite(b) & (b >= lo) & (b <= hi)
+        mask = ok if mask is None else (mask & ok)
+    if mask is None:
+        raise ValueError("sr_valid_mask needs at least one known band")
+    return mask

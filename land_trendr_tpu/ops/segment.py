@@ -323,7 +323,11 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
     p2p0 = jnp.where((iota == vpos[0]) & mask, y, 0.0)
     p2p, p2p_ok = lax.fori_loop(0, nv - 1, p2p_body, (p2p0, jnp.array(True)))
 
-    span = mask  # vertices span the whole valid range in this pipeline
+    # SSE over the vertex span only (oracle fit_model: "SSE comparisons use
+    # only the vertex span").  In the segmentation pipeline the vertices span
+    # the whole valid range so this equals a full-mask sum; FTV vertex sets
+    # may start/end inside the valid range, where the distinction matters.
+    span = mask & (iota >= vpos[0]) & (iota <= _last_vertex(vpos, ny))
     sse_reg = jnp.sum(jnp.where(span, (y - fitted) ** 2, 0.0))
     sse_p2p = jnp.sum(jnp.where(span, (y - p2p) ** 2, 0.0))
     use_p2p = p2p_ok & (sse_p2p < sse_reg)
@@ -335,6 +339,26 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
 # ---------------------------------------------------------------------------
 # Stage 4 — F-statistic scoring (oracle.f_stat_p_value)
 # ---------------------------------------------------------------------------
+
+
+def _interp_through_vertices(t, vmask, fitted, pad_t, size):
+    """Full-year trajectory through the live vertices of ``vmask``.
+
+    Padded vertex slots repeat ``(pad_t, last live vertex fit)`` so the
+    extension beyond the last vertex is flat — exactly ``np.interp``'s edge
+    behaviour, which the oracle relies on.  ``pad_t`` must be >= the last
+    live vertex's year so ``xp`` stays non-decreasing.
+    """
+    ny = t.shape[0]
+    vpos = _vertex_positions(vmask, size)
+    k = jnp.sum(vmask)
+    live = jnp.arange(size) < k
+    vpos_c = jnp.clip(vpos, 0, ny - 1)
+    vfit = fitted[vpos_c]
+    last_fit = vfit[jnp.clip(k - 1, 0, size - 1)]
+    xp = jnp.where(live, t[vpos_c], pad_t)
+    fp = jnp.where(live, vfit, last_fit)
+    return jnp.interp(t, xp, fp)
 
 
 def _f_stat_p(ss0, sse, n, m):
@@ -457,12 +481,9 @@ def segment_pixel(
     dur = jnp.where(seg_live, t[vpos_c[1:]] - t[vpos_c[:-1]], 0.0)
     rate = jnp.where(seg_live & (dur > 0.0), mag / jnp.where(dur > 0.0, dur, 1.0), 0.0)
 
-    # full-axis fitted trajectory: interp through vertices (padding repeats
-    # the last real vertex so the extension is flat, as np.interp does)
-    xp = jnp.where(live, t[vpos_c], t[jnp.clip(last_v, 0, ny - 1)])
-    last_fit = vfit[jnp.clip(k - 1, 0, nv - 1)]
-    fp = jnp.where(live, vfit, last_fit)
-    fitted_full = jnp.interp(t, xp, fp)
+    fitted_full = _interp_through_vertices(
+        t, vmask_c, fitted_c, t[jnp.clip(last_v, 0, ny - 1)], nv
+    )
     fitted_full = jnp.where(model_valid, fitted_full, mean)
 
     rmse_fit = jnp.sqrt(sse_c / n_safe)

@@ -1,0 +1,128 @@
+"""FTV parity: jax_fit_to_vertices vs the float64 CPU oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.models import oracle
+from land_trendr_tpu.ops.ftv import jax_fit_to_vertices
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+YEARS = np.arange(1984, 2024, dtype=np.float64)
+NY = len(YEARS)
+
+
+def _disturbance_series(rng, noise=0.01):
+    base = rng.uniform(-0.6, -0.2)
+    y = np.full(NY, base)
+    d = rng.integers(8, NY - 8)
+    y[d:] += rng.uniform(0.3, 0.8)
+    rec = rng.uniform(0.01, 0.04)
+    y[d:] -= rec * np.arange(NY - d)
+    return y + rng.normal(0.0, noise, NY)
+
+
+def _run_pair(rng, n_px=24, seg_noise=0.01, target_noise=0.02, mask_p=0.0):
+    params = LTParams()
+    seg = np.stack([_disturbance_series(rng, seg_noise) for _ in range(n_px)])
+    tgt = np.stack([_disturbance_series(rng, target_noise) for _ in range(n_px)])
+    seg_mask = np.ones((n_px, NY), dtype=bool)
+    tgt_mask = rng.random((n_px, NY)) >= mask_p
+    tgt_mask[:, 0] = tgt_mask[:, -1] = True
+
+    out = jax_segment_pixels(
+        jnp.asarray(YEARS), jnp.asarray(seg), jnp.asarray(seg_mask), params
+    )
+    vi = np.asarray(out.vertex_indices)
+    nv = np.asarray(out.n_vertices)
+
+    got = np.asarray(
+        jax_fit_to_vertices(
+            jnp.asarray(YEARS),
+            jnp.asarray(tgt),
+            jnp.asarray(tgt_mask),
+            jnp.asarray(vi),
+            jnp.asarray(nv),
+            params,
+        )
+    )
+    want = np.stack(
+        [
+            oracle.fit_to_vertices(YEARS, tgt[i], tgt_mask[i], vi[i], int(nv[i]), params)
+            for i in range(n_px)
+        ]
+    )
+    return got, want, nv
+
+
+def test_ftv_parity_full_mask(rng):
+    got, want, nv = _run_pair(rng)
+    assert (nv >= 2).any()  # fixture must exercise the real fit path
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-10)
+
+
+def test_ftv_parity_masked_target(rng):
+    got, want, _ = _run_pair(rng, mask_p=0.25)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-10)
+
+
+def test_ftv_no_vertices_falls_back_to_mean(rng):
+    params = LTParams()
+    tgt = _disturbance_series(rng)
+    mask = np.ones(NY, dtype=bool)
+    vi = np.full((1, params.max_vertices), -1, dtype=np.int32)
+    got = np.asarray(
+        jax_fit_to_vertices(
+            jnp.asarray(YEARS),
+            jnp.asarray(tgt[None]),
+            jnp.asarray(mask[None]),
+            jnp.asarray(vi),
+            jnp.asarray([0], dtype=np.int32),
+            params,
+        )
+    )[0]
+    np.testing.assert_allclose(got, np.full(NY, tgt.mean()), rtol=1e-12)
+    want = oracle.fit_to_vertices(YEARS, tgt, mask, vi[0], 0, params)
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_ftv_all_masked_target_is_zero(rng):
+    params = LTParams()
+    tgt = _disturbance_series(rng)
+    vi = np.zeros((1, params.max_vertices), dtype=np.int32)
+    vi[0, :2] = [0, NY - 1]
+    got = np.asarray(
+        jax_fit_to_vertices(
+            jnp.asarray(YEARS),
+            jnp.asarray(tgt[None]),
+            jnp.zeros((1, NY), dtype=bool),
+            jnp.asarray(vi),
+            jnp.asarray([2], dtype=np.int32),
+            params,
+        )
+    )[0]
+    np.testing.assert_allclose(got, 0.0)
+
+
+def test_ftv_vertices_collapse_to_endpoints(rng):
+    # target mask kills every year the vertex indices point at except one —
+    # the mapped vertex set collapses and the oracle falls back to endpoints.
+    params = LTParams()
+    tgt = _disturbance_series(rng)
+    mask = np.zeros(NY, dtype=bool)
+    mask[5:9] = True
+    vi = np.full((params.max_vertices,), -1, dtype=np.int32)
+    vi[0] = 7
+    vi[1] = 7
+    got = np.asarray(
+        jax_fit_to_vertices(
+            jnp.asarray(YEARS),
+            jnp.asarray(tgt[None]),
+            jnp.asarray(mask[None]),
+            jnp.asarray(vi[None]),
+            jnp.asarray([2], dtype=np.int32),
+            params,
+        )
+    )[0]
+    want = oracle.fit_to_vertices(YEARS, tgt, mask, vi, 2, params)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-10)

@@ -1,0 +1,104 @@
+"""Tests for spectral-index math and QA masking (ops/indices.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from land_trendr_tpu.ops import indices as ix
+
+
+def _bands(rng, shape=(4, 5)):
+    return {b: jnp.asarray(rng.uniform(0.01, 0.6, size=shape)) for b in ix.BANDS}
+
+
+def test_nbr_formula(rng):
+    b = _bands(rng)
+    got = np.asarray(ix.nbr(b["nir"], b["swir2"]))
+    want = (np.asarray(b["nir"]) - np.asarray(b["swir2"])) / (
+        np.asarray(b["nir"]) + np.asarray(b["swir2"])
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_ndvi_formula(rng):
+    b = _bands(rng)
+    got = np.asarray(ix.ndvi(b["nir"], b["red"]))
+    want = (np.asarray(b["nir"]) - np.asarray(b["red"])) / (
+        np.asarray(b["nir"]) + np.asarray(b["red"])
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_tcw_is_linear_combination(rng):
+    b = _bands(rng)
+    got = np.asarray(ix.tcw(*(b[k] for k in ix.BANDS)))
+    coeffs = [0.0315, 0.2021, 0.3102, 0.1594, -0.6806, -0.6109]
+    want = sum(c * np.asarray(b[k]) for c, k in zip(coeffs, ix.BANDS))
+    np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+def test_ratio_indices_zero_denominator_stay_finite():
+    z = jnp.zeros((3,))
+    assert np.all(np.asarray(ix.nbr(z, z)) == 0.0)
+    assert np.all(np.asarray(ix.ndvi(z, z)) == 0.0)
+
+
+@pytest.mark.parametrize("name", ix.INDEX_NAMES)
+def test_disturbance_positive_flip(rng, name):
+    b = _bands(rng)
+    natural = np.asarray(ix.compute_index(name, b, disturbance_positive=False))
+    flipped = np.asarray(ix.compute_index(name, b, disturbance_positive=True))
+    np.testing.assert_allclose(flipped, -natural, rtol=1e-12)
+
+
+def test_compute_index_unknown_name(rng):
+    with pytest.raises(ValueError, match="unknown index"):
+        ix.compute_index("evi", _bands(rng))
+
+
+def test_compute_index_disturbance_semantics():
+    # burn: NIR drops, SWIR2 rises → natural NBR falls → disturbance-positive
+    # NBR must RISE across the event.
+    pre = {"nir": jnp.asarray(0.4), "swir2": jnp.asarray(0.1)}
+    post = {"nir": jnp.asarray(0.15), "swir2": jnp.asarray(0.3)}
+    a = float(ix.compute_index("nbr", pre))
+    b = float(ix.compute_index("nbr", post))
+    assert b > a
+
+
+def test_scale_sr_collections():
+    dn = jnp.asarray([0, 5000, 10000], dtype=jnp.int16)
+    # default is the Collection-2 convention (matches qa_valid_mask's layout)
+    np.testing.assert_allclose(
+        np.asarray(ix.scale_sr(dn)), [-0.2, -0.0625, 0.075], atol=1e-7
+    )
+    c1 = np.asarray(ix.scale_sr(dn, scale=1e-4, offset=0.0))
+    np.testing.assert_allclose(c1, [0.0, 0.5, 1.0])
+
+
+def test_qa_valid_mask_bits():
+    # bit0 fill, bit3 cloud, bit4 shadow, bit5 snow
+    qa = jnp.asarray([0, 1, 1 << 3, 1 << 4, 1 << 5, 1 << 6])
+    got = np.asarray(ix.qa_valid_mask(qa))
+    # bit6 (clear) is not a reject bit → valid
+    np.testing.assert_array_equal(got, [True, False, False, False, False, True])
+
+
+def test_qa_valid_mask_custom_reject():
+    qa = jnp.asarray([1 << 5])
+    assert not bool(ix.qa_valid_mask(qa)[0])
+    assert bool(ix.qa_valid_mask(qa, reject_bits=1 << 3)[0])
+
+
+def test_sr_valid_mask_range_and_nan():
+    bands = {
+        "nir": jnp.asarray([0.5, 1.5, 0.5, 0.5]),
+        "red": jnp.asarray([0.2, 0.2, jnp.nan, -0.1]),
+    }
+    got = np.asarray(ix.sr_valid_mask(bands))
+    np.testing.assert_array_equal(got, [True, False, False, False])
+
+
+def test_sr_valid_mask_requires_known_band():
+    with pytest.raises(ValueError):
+        ix.sr_valid_mask({"thermal": jnp.zeros(2)})
