@@ -1,1 +1,15 @@
-"""io subpackage of land_trendr_tpu."""
+"""io subpackage: host-side raster I/O (GeoTIFF codec, synthetic stacks)."""
+
+from land_trendr_tpu.io.geotiff import GeoMeta, TiffInfo, read_geotiff, write_geotiff
+from land_trendr_tpu.io.synthetic import SceneSpec, SyntheticStack, make_stack, write_stack
+
+__all__ = [
+    "GeoMeta",
+    "TiffInfo",
+    "read_geotiff",
+    "write_geotiff",
+    "SceneSpec",
+    "SyntheticStack",
+    "make_stack",
+    "write_stack",
+]

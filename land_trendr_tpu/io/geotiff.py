@@ -1,0 +1,461 @@
+"""Minimal self-contained GeoTIFF codec (NumPy + zlib; no GDAL).
+
+The reference's raster layer reads Landsat GeoTIFF stacks and writes segment
+rasters through GDAL's Python bindings (SURVEY.md §2 layer L1, provenance
+``[B]`` behaviour / ``[K]`` library).  GDAL is not available in this
+environment (SURVEY.md §7 hard-part 5), so the framework vendors the small
+slice of TIFF 6.0 + GeoTIFF it actually needs:
+
+* classic TIFF, little- or big-endian, **read**: stripped or tiled layout,
+  uncompressed / Deflate (zlib) / raw-deflate, horizontal-differencing
+  predictor, chunky or planar multi-band, u/int 8/16/32, float32/64;
+* **write**: tiled (default) or stripped, Deflate or uncompressed, optional
+  horizontal predictor, any of the dtypes above, chunky band layout;
+* GeoTIFF georeferencing carried as an opaque-but-typed :class:`GeoMeta`
+  (pixel scale + tiepoint + the raw GeoKey directory blocks), round-tripped
+  losslessly so outputs inherit the input grid.
+
+This is host-side I/O: arrays land in NumPy and are fed to the TPU pipeline
+by the runtime driver.  BigTIFF is out of scope (a 5000×5000 int16 WRS-2
+band is ~50 MB — far under the 4 GB classic-TIFF limit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+from typing import BinaryIO, Mapping
+
+import numpy as np
+
+__all__ = ["GeoMeta", "TiffInfo", "read_geotiff", "write_geotiff"]
+
+# -- TIFF tag ids -----------------------------------------------------------
+_T_IMAGE_WIDTH = 256
+_T_IMAGE_LENGTH = 257
+_T_BITS_PER_SAMPLE = 258
+_T_COMPRESSION = 259
+_T_PHOTOMETRIC = 262
+_T_STRIP_OFFSETS = 273
+_T_SAMPLES_PER_PIXEL = 277
+_T_ROWS_PER_STRIP = 278
+_T_STRIP_BYTE_COUNTS = 279
+_T_PLANAR_CONFIG = 284
+_T_PREDICTOR = 317
+_T_TILE_WIDTH = 322
+_T_TILE_LENGTH = 323
+_T_TILE_OFFSETS = 324
+_T_TILE_BYTE_COUNTS = 325
+_T_SAMPLE_FORMAT = 339
+_T_MODEL_PIXEL_SCALE = 33550
+_T_MODEL_TIEPOINT = 33922
+_T_GEO_KEY_DIRECTORY = 34735
+_T_GEO_DOUBLE_PARAMS = 34736
+_T_GEO_ASCII_PARAMS = 34737
+_T_GDAL_NODATA = 42113
+
+_COMP_NONE = 1
+_COMP_DEFLATE_ADOBE = 8
+_COMP_DEFLATE_OLD = 32946
+
+# TIFF field types → (struct char, byte size)
+_FIELD_TYPES = {
+    1: ("B", 1),   # BYTE
+    2: ("s", 1),   # ASCII
+    3: ("H", 2),   # SHORT
+    4: ("I", 4),   # LONG
+    5: ("II", 8),  # RATIONAL (2×LONG)
+    6: ("b", 1),   # SBYTE
+    8: ("h", 2),   # SSHORT
+    9: ("i", 4),   # SLONG
+    11: ("f", 4),  # FLOAT
+    12: ("d", 8),  # DOUBLE
+}
+
+# (sample_format, bits) → numpy dtype char
+_DTYPES = {
+    (1, 8): "u1", (1, 16): "u2", (1, 32): "u4",
+    (2, 8): "i1", (2, 16): "i2", (2, 32): "i4",
+    (3, 32): "f4", (3, 64): "f8",
+}
+_DTYPE_TO_FORMAT = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class GeoMeta:
+    """Georeferencing sidecar, round-tripped verbatim between files.
+
+    ``pixel_scale`` is the GeoTIFF ModelPixelScale ``(sx, sy, sz)``;
+    ``tiepoint`` the first ModelTiepoint ``(i, j, k, x, y, z)``; the three
+    ``geo_*`` fields carry the GeoKey directory blocks untouched (the
+    framework never interprets projection parameters — it only preserves
+    them, which is all the segment-raster writer needs).
+    """
+
+    pixel_scale: tuple[float, ...] | None = None
+    tiepoint: tuple[float, ...] | None = None
+    geo_key_directory: tuple[int, ...] | None = None
+    geo_double_params: tuple[float, ...] | None = None
+    geo_ascii_params: str | None = None
+    nodata: float | None = None
+
+    def geotransform(self) -> tuple[float, float, float, float, float, float] | None:
+        """GDAL-style (x0, dx, 0, y0, 0, -dy) affine, when defined."""
+        if not self.pixel_scale or not self.tiepoint:
+            return None
+        sx, sy = self.pixel_scale[0], self.pixel_scale[1]
+        i, j, _k, x, y, _z = self.tiepoint[:6]
+        return (x - i * sx, sx, 0.0, y + j * sy, 0.0, -sy)
+
+
+@dataclasses.dataclass(frozen=True)
+class TiffInfo:
+    """Shape/layout facts about a decoded file (useful for tests/tools)."""
+
+    width: int
+    height: int
+    bands: int
+    dtype: np.dtype
+    tiled: bool
+    compression: int
+
+
+def _read_ifd(f: BinaryIO, bo: str, off: int) -> dict[int, tuple]:
+    f.seek(off)
+    (n,) = struct.unpack(bo + "H", f.read(2))
+    entries: dict[int, tuple] = {}
+    raw = f.read(n * 12)
+    for k in range(n):
+        tag, ftype, count = struct.unpack(bo + "HHI", raw[k * 12 : k * 12 + 8])
+        if ftype not in _FIELD_TYPES:
+            continue
+        ch, sz = _FIELD_TYPES[ftype]  # sz already totals both LONGs for RATIONAL
+        total = sz * count
+        if total <= 4:
+            payload = raw[k * 12 + 8 : k * 12 + 8 + total]
+        else:
+            (ptr,) = struct.unpack(bo + "I", raw[k * 12 + 8 : k * 12 + 12])
+            here = f.tell()
+            f.seek(ptr)
+            payload = f.read(total)
+            f.seek(here)
+        if ftype == 2:
+            entries[tag] = (payload.rstrip(b"\0").decode("ascii", "replace"),)
+        elif ftype == 5:
+            vals = struct.unpack(bo + "I" * (2 * count), payload)
+            entries[tag] = tuple(
+                vals[i] / vals[i + 1] if vals[i + 1] else 0.0
+                for i in range(0, 2 * count, 2)
+            )
+        else:
+            entries[tag] = struct.unpack(bo + ch * count, payload)
+    return entries
+
+
+def _decompress(buf: bytes, compression: int) -> bytes:
+    if compression == _COMP_NONE:
+        return buf
+    if compression in (_COMP_DEFLATE_ADOBE, _COMP_DEFLATE_OLD):
+        try:
+            return zlib.decompress(buf)
+        except zlib.error:
+            return zlib.decompress(buf, -15)  # raw deflate stream
+    raise ValueError(f"unsupported TIFF compression {compression}")
+
+
+def _unpredict(block: np.ndarray, predictor: int) -> np.ndarray:
+    """Undo horizontal differencing in place along the row axis."""
+    if predictor == 2:
+        np.cumsum(block, axis=-2, dtype=block.dtype, out=block)
+    return block
+
+
+def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
+    """Decode a GeoTIFF into ``(array, geo, info)``.
+
+    ``array`` is ``(height, width)`` for single-band files and
+    ``(bands, height, width)`` otherwise, in the file's native dtype.
+    """
+    with open(path, "rb") as f:
+        hdr = f.read(8)
+        if hdr[:2] == b"II":
+            bo = "<"
+        elif hdr[:2] == b"MM":
+            bo = ">"
+        else:
+            raise ValueError(f"{path}: not a TIFF (bad byte-order mark)")
+        magic, ifd_off = struct.unpack(bo + "HI", hdr[2:8])
+        if magic == 43:
+            raise ValueError(f"{path}: BigTIFF is not supported")
+        if magic != 42:
+            raise ValueError(f"{path}: not a TIFF (magic={magic})")
+        tags = _read_ifd(f, bo, ifd_off)
+
+        width = tags[_T_IMAGE_WIDTH][0]
+        height = tags[_T_IMAGE_LENGTH][0]
+        spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+        bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)
+        if len(set(bits)) != 1:
+            raise ValueError(f"{path}: mixed BitsPerSample {bits}")
+        fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
+        key = (fmt, bits[0])
+        if key not in _DTYPES:
+            raise ValueError(f"{path}: unsupported sample format/bits {key}")
+        dtype = np.dtype(bo + _DTYPES[key])
+        compression = tags.get(_T_COMPRESSION, (_COMP_NONE,))[0]
+        predictor = tags.get(_T_PREDICTOR, (1,))[0]
+        planar = tags.get(_T_PLANAR_CONFIG, (1,))[0]
+        tiled = _T_TILE_OFFSETS in tags
+
+        if tiled:
+            tw = tags[_T_TILE_WIDTH][0]
+            th = tags[_T_TILE_LENGTH][0]
+            offsets = tags[_T_TILE_OFFSETS]
+            counts = tags[_T_TILE_BYTE_COUNTS]
+            tiles_x = (width + tw - 1) // tw
+            tiles_y = (height + th - 1) // th
+            planes = spp if planar == 2 else 1
+            chunk_spp = 1 if planar == 2 else spp
+            out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
+            idx = 0
+            for p in range(planes):
+                for ty in range(tiles_y):
+                    for tx in range(tiles_x):
+                        raw = _block(f, offsets[idx], counts[idx], compression)
+                        block = np.frombuffer(raw, dtype=dtype, count=th * tw * chunk_spp)
+                        block = block.reshape(th, tw, chunk_spp).astype(
+                            dtype.newbyteorder("="), copy=True
+                        )
+                        _unpredict(block, predictor)
+                        y0, x0 = ty * th, tx * tw
+                        h = min(th, height - y0)
+                        w = min(tw, width - x0)
+                        if planar == 2:
+                            out[p, y0 : y0 + h, x0 : x0 + w] = block[:h, :w, 0]
+                        else:
+                            out[:, y0 : y0 + h, x0 : x0 + w] = np.moveaxis(
+                                block[:h, :w, :], -1, 0
+                            )
+                        idx += 1
+        else:
+            rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
+            offsets = tags[_T_STRIP_OFFSETS]
+            counts = tags[_T_STRIP_BYTE_COUNTS]
+            strips = (height + rps - 1) // rps
+            planes = spp if planar == 2 else 1
+            chunk_spp = 1 if planar == 2 else spp
+            out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
+            idx = 0
+            for p in range(planes):
+                for s in range(strips):
+                    y0 = s * rps
+                    h = min(rps, height - y0)
+                    raw = _block(f, offsets[idx], counts[idx], compression)
+                    block = np.frombuffer(raw, dtype=dtype, count=h * width * chunk_spp)
+                    block = block.reshape(h, width, chunk_spp).astype(
+                        dtype.newbyteorder("="), copy=True
+                    )
+                    _unpredict(block, predictor)
+                    if planar == 2:
+                        out[p, y0 : y0 + h] = block[:, :, 0]
+                    else:
+                        out[:, y0 : y0 + h] = np.moveaxis(block, -1, 0)
+                    idx += 1
+
+        nodata = None
+        if _T_GDAL_NODATA in tags:
+            try:
+                nodata = float(tags[_T_GDAL_NODATA][0])
+            except (TypeError, ValueError):
+                nodata = None
+        geo = GeoMeta(
+            pixel_scale=tags.get(_T_MODEL_PIXEL_SCALE),
+            tiepoint=tags.get(_T_MODEL_TIEPOINT),
+            geo_key_directory=tags.get(_T_GEO_KEY_DIRECTORY),
+            geo_double_params=tags.get(_T_GEO_DOUBLE_PARAMS),
+            geo_ascii_params=tags.get(_T_GEO_ASCII_PARAMS, (None,))[0],
+            nodata=nodata,
+        )
+        info = TiffInfo(
+            width=width,
+            height=height,
+            bands=spp,
+            dtype=np.dtype(_DTYPES[key]),
+            tiled=tiled,
+            compression=compression,
+        )
+        arr = out[0] if spp == 1 else out
+        return arr, geo, info
+
+
+def _block(f: BinaryIO, offset: int, count: int, compression: int) -> bytes:
+    f.seek(offset)
+    return _decompress(f.read(count), compression)
+
+
+# ---------------------------------------------------------------------------
+# Writer
+# ---------------------------------------------------------------------------
+
+
+def _predict(block: np.ndarray) -> np.ndarray:
+    """Apply horizontal differencing along the row axis (predictor 2)."""
+    out = block.copy()
+    out[..., 1:, :] = block[..., 1:, :] - block[..., :-1, :]
+    return out
+
+
+class _IfdBuilder:
+    """Accumulates IFD entries + out-of-line payloads for a little-endian file."""
+
+    def __init__(self) -> None:
+        self.entries: list[tuple[int, int, int, bytes]] = []  # tag,type,count,payload
+
+    def add(self, tag: int, ftype: int, values) -> None:
+        ch, sz = _FIELD_TYPES[ftype]
+        if ftype == 2:
+            payload = values.encode("ascii") + b"\0"
+            count = len(payload)
+        else:
+            vals = tuple(values)
+            count = len(vals)
+            payload = struct.pack("<" + ch * count, *vals)
+        self.entries.append((tag, ftype, count, payload))
+
+    def serialize(self, ifd_offset: int) -> bytes:
+        self.entries.sort(key=lambda e: e[0])
+        n = len(self.entries)
+        overflow_off = ifd_offset + 2 + n * 12 + 4
+        body = struct.pack("<H", n)
+        overflow = b""
+        for tag, ftype, count, payload in self.entries:
+            body += struct.pack("<HHI", tag, ftype, count)
+            if len(payload) <= 4:
+                body += payload.ljust(4, b"\0")
+            else:
+                body += struct.pack("<I", overflow_off + len(overflow))
+                # TIFF 6.0: value offsets must be even — pad odd payloads
+                overflow += payload + b"\0" * (len(payload) & 1)
+        body += struct.pack("<I", 0)  # no next IFD
+        return body + overflow
+
+
+def write_geotiff(
+    path: str,
+    array: np.ndarray,
+    geo: GeoMeta | None = None,
+    compress: str = "deflate",
+    tile: int | None = 256,
+    predictor: bool = True,
+    extra_ascii_tags: Mapping[int, str] | None = None,
+) -> None:
+    """Encode ``array`` (``(H, W)`` or ``(bands, H, W)``) as a GeoTIFF.
+
+    Always little-endian, chunky band layout; ``tile=None`` writes one strip
+    per 64 rows instead of tiles.  ``predictor`` enables horizontal
+    differencing for integer dtypes under deflate (better compression on
+    smooth rasters; ignored for floats and uncompressed files).
+    """
+    arr = np.asarray(array)
+    if arr.ndim == 2:
+        arr = arr[None]
+    if arr.ndim != 3:
+        raise ValueError(f"array must be (H, W) or (bands, H, W); got {arr.shape}")
+    if arr.dtype.newbyteorder("=") not in _DTYPE_TO_FORMAT:
+        raise ValueError(f"unsupported dtype {arr.dtype}")
+    arr = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+    spp, height, width = arr.shape
+    fmt, bits = _DTYPE_TO_FORMAT[arr.dtype.newbyteorder("=")]
+    if compress == "deflate":
+        comp_id = _COMP_DEFLATE_ADOBE
+    elif compress in (None, "none"):
+        comp_id = _COMP_NONE
+    else:
+        raise ValueError(f"unsupported compression {compress!r}")
+    use_pred = bool(predictor) and comp_id != _COMP_NONE and fmt in (1, 2)
+
+    chunky = np.moveaxis(arr, 0, -1)  # (H, W, S)
+    blocks: list[bytes] = []
+    if tile:
+        tw = th = int(tile)
+        tiles_x = (width + tw - 1) // tw
+        tiles_y = (height + th - 1) // th
+        for ty in range(tiles_y):
+            for tx in range(tiles_x):
+                full = np.zeros((th, tw, spp), dtype=arr.dtype)
+                y0, x0 = ty * th, tx * tw
+                h = min(th, height - y0)
+                w = min(tw, width - x0)
+                full[:h, :w] = chunky[y0 : y0 + h, x0 : x0 + w]
+                blocks.append(_encode_block(full, comp_id, use_pred))
+    else:
+        rps = 64
+        for y0 in range(0, height, rps):
+            blocks.append(
+                _encode_block(chunky[y0 : y0 + rps], comp_id, use_pred)
+            )
+
+    data_off = 8  # blocks start right after the 8-byte header
+    offsets: list[int] = []
+    counts: list[int] = []
+    pos = data_off
+    for b in blocks:
+        offsets.append(pos)
+        counts.append(len(b))
+        pos += len(b) + (len(b) & 1)  # keep every block offset word-aligned
+    ifd_off = pos
+
+    ifd = _IfdBuilder()
+    ifd.add(_T_IMAGE_WIDTH, 4, (width,))
+    ifd.add(_T_IMAGE_LENGTH, 4, (height,))
+    ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
+    ifd.add(_T_COMPRESSION, 3, (comp_id,))
+    ifd.add(_T_PHOTOMETRIC, 3, (1,))  # BlackIsZero
+    ifd.add(_T_SAMPLES_PER_PIXEL, 3, (spp,))
+    ifd.add(_T_PLANAR_CONFIG, 3, (1,))
+    ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
+    if use_pred:
+        ifd.add(_T_PREDICTOR, 3, (2,))
+    if tile:
+        ifd.add(_T_TILE_WIDTH, 3, (tw,))
+        ifd.add(_T_TILE_LENGTH, 3, (th,))
+        ifd.add(_T_TILE_OFFSETS, 4, offsets)
+        ifd.add(_T_TILE_BYTE_COUNTS, 4, counts)
+    else:
+        ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
+        ifd.add(_T_STRIP_OFFSETS, 4, offsets)
+        ifd.add(_T_STRIP_BYTE_COUNTS, 4, counts)
+    if geo:
+        if geo.pixel_scale:
+            ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
+        if geo.tiepoint:
+            ifd.add(_T_MODEL_TIEPOINT, 12, geo.tiepoint)
+        if geo.geo_key_directory:
+            ifd.add(_T_GEO_KEY_DIRECTORY, 3, geo.geo_key_directory)
+        if geo.geo_double_params:
+            ifd.add(_T_GEO_DOUBLE_PARAMS, 12, geo.geo_double_params)
+        if geo.geo_ascii_params:
+            ifd.add(_T_GEO_ASCII_PARAMS, 2, geo.geo_ascii_params)
+        if geo.nodata is not None:
+            nd = geo.nodata
+            ifd.add(_T_GDAL_NODATA, 2, ("%g" % nd))
+    for tag, text in (extra_ascii_tags or {}).items():
+        ifd.add(tag, 2, text)
+
+    with open(path, "wb") as f:
+        f.write(struct.pack("<2sHI", b"II", 42, ifd_off))
+        for b in blocks:
+            f.write(b)
+            if len(b) & 1:
+                f.write(b"\0")
+        f.write(ifd.serialize(ifd_off))
+
+
+def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:
+    if use_pred:
+        block = _predict(block)
+    raw = block.tobytes()
+    if comp_id == _COMP_NONE:
+        return raw
+    return zlib.compress(raw, 6)

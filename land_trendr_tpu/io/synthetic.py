@@ -1,0 +1,201 @@
+"""Synthetic Landsat annual-stack generator (test fixture + benchmark feed).
+
+The reference's inputs are Landsat WRS-2 scenes / ARD mosaics (SURVEY.md §1,
+provenance ``[B]``); none ship with this environment, so the framework
+generates physically-plausible stand-ins: a six-band surface-reflectance
+annual stack over a forest scene with patchy disturbance events (abrupt NBR
+loss at a per-patch year), exponential regrowth, per-year cloud masking via
+Collection-2 style QA bits, and sensor noise.  The generator also returns
+the ground truth (disturbance year/magnitude per pixel) so tests can score
+detection, and :func:`write_stack` materialises the stack as per-year
+multi-band GeoTIFFs for end-to-end driver tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from land_trendr_tpu.io.geotiff import GeoMeta, write_geotiff
+from land_trendr_tpu.ops.indices import BANDS
+
+__all__ = ["SceneSpec", "SyntheticStack", "make_stack", "write_stack"]
+
+# mean healthy-forest surface reflectance per band (blue..swir2)
+_FOREST_SR = {
+    "blue": 0.015, "green": 0.035, "red": 0.020,
+    "nir": 0.380, "swir1": 0.130, "swir2": 0.060,
+}
+# reflectance immediately after a stand-clearing disturbance
+_DISTURBED_SR = {
+    "blue": 0.045, "green": 0.070, "red": 0.085,
+    "nir": 0.180, "swir1": 0.280, "swir2": 0.230,
+}
+
+_C2_SCALE = 2.75e-5
+_C2_OFFSET = -0.2
+
+_QA_CLOUD = 1 << 3
+_QA_FILL = 1 << 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SceneSpec:
+    """Parameters of a synthetic scene."""
+
+    width: int = 256
+    height: int = 256
+    year_start: int = 1984
+    year_end: int = 2023
+    disturbance_fraction: float = 0.3   # fraction of pixels disturbed
+    patch_scale: int = 16               # disturbance patch size (px)
+    recovery_rate: float = 0.08         # fractional recovery per year
+    cloud_fraction: float = 0.08        # per-observation cloud probability
+    noise: float = 0.006                # reflectance noise sigma
+    seed: int = 20260729
+
+
+@dataclasses.dataclass
+class SyntheticStack:
+    """A generated stack plus its ground truth."""
+
+    years: np.ndarray                   # (NY,) int32
+    bands: dict[str, np.ndarray]        # name → (NY, H, W) float32 reflectance
+    qa: np.ndarray                      # (NY, H, W) uint16 QA_PIXEL bits
+    truth_year: np.ndarray              # (H, W) int32, -1 where undisturbed
+    truth_magnitude: np.ndarray         # (H, W) float32 NBR-loss magnitude
+
+    def dn(self, name: str) -> np.ndarray:
+        """Band as Collection-2 scaled int16 DNs (what real files carry).
+
+        Saturates at the int16 limits the way real C2 products do for
+        over-bright targets (clouds can exceed the representable range).
+        """
+        sr = self.bands[name]
+        dn = np.round((sr - _C2_OFFSET) / _C2_SCALE)
+        return np.clip(dn, -32768, 32767).astype(np.int16)
+
+
+def make_stack(spec: SceneSpec = SceneSpec()) -> SyntheticStack:
+    rng = np.random.default_rng(spec.seed)
+    years = np.arange(spec.year_start, spec.year_end + 1, dtype=np.int32)
+    ny = len(years)
+    h, w = spec.height, spec.width
+
+    # --- patchy disturbance map: threshold smoothed noise ------------------
+    gh = max(2, h // spec.patch_scale)
+    gw = max(2, w // spec.patch_scale)
+    field = rng.normal(size=(gh, gw))
+    # bilinear upsample to (h, w)
+    yi = np.linspace(0, gh - 1, h)
+    xi = np.linspace(0, gw - 1, w)
+    y0 = np.floor(yi).astype(int)
+    x0 = np.floor(xi).astype(int)
+    y1 = np.minimum(y0 + 1, gh - 1)
+    x1 = np.minimum(x0 + 1, gw - 1)
+    fy = (yi - y0)[:, None]
+    fx = (xi - x0)[None, :]
+    smooth = (
+        field[np.ix_(y0, x0)] * (1 - fy) * (1 - fx)
+        + field[np.ix_(y1, x0)] * fy * (1 - fx)
+        + field[np.ix_(y0, x1)] * (1 - fy) * fx
+        + field[np.ix_(y1, x1)] * fy * fx
+    )
+    thresh = np.quantile(smooth, 1.0 - spec.disturbance_fraction)
+    disturbed = smooth > thresh
+
+    # per-patch disturbance year: reuse the coarse grid so patches share one;
+    # keep events away from the series edges when the span allows it
+    lo = min(spec.year_start + 5, spec.year_end)
+    hi = max(spec.year_end - 5, lo + 1)
+    d_year_grid = rng.integers(lo, hi, size=(gh, gw))
+    d_year = d_year_grid[np.ix_(np.round(yi).astype(int), np.round(xi).astype(int))]
+    truth_year = np.where(disturbed, d_year, -1).astype(np.int32)
+
+    severity = rng.uniform(0.5, 1.0, size=(h, w)).astype(np.float32)
+    severity = np.where(disturbed, severity, 0.0)
+
+    # --- per-band trajectories --------------------------------------------
+    t = years[:, None, None].astype(np.float32)           # (NY,1,1)
+    since = np.clip(t - truth_year[None], 0.0, None)       # years since event
+    active = (truth_year[None] >= 0) & (t >= truth_year[None])
+    recovery = np.exp(-spec.recovery_rate * since, dtype=np.float32)
+    blend = np.where(active, severity[None] * recovery, 0.0).astype(np.float32)
+
+    bands: dict[str, np.ndarray] = {}
+    for name in BANDS:
+        base = _FOREST_SR[name]
+        post = _DISTURBED_SR[name]
+        series = base + (post - base) * blend
+        series = series + rng.normal(0.0, spec.noise, size=series.shape)
+        bands[name] = series.astype(np.float32)
+
+    nbr = lambda b: (b["nir"] - b["swir2"]) / (b["nir"] + b["swir2"])  # noqa: E731
+    pre = {k: np.full((h, w), _FOREST_SR[k], dtype=np.float32) for k in BANDS}
+    post = {
+        k: (_FOREST_SR[k] + (_DISTURBED_SR[k] - _FOREST_SR[k]) * severity)
+        for k in BANDS
+    }
+    truth_mag = np.where(disturbed, nbr(pre) - nbr(post), 0.0).astype(np.float32)
+
+    # --- clouds ------------------------------------------------------------
+    qa = np.zeros((ny, h, w), dtype=np.uint16)
+    cloudy = rng.random(size=(ny, h, w)) < spec.cloud_fraction
+    qa[cloudy] |= _QA_CLOUD
+    for name in BANDS:  # clouds read bright and cold
+        bands[name] = np.where(
+            cloudy, rng.uniform(0.4, 0.9, size=(ny, h, w)).astype(np.float32),
+            bands[name],
+        )
+
+    # --- fill margins -------------------------------------------------------
+    # Real ARD tiles have nodata margins where the scene footprint shifts
+    # year to year; emulate with a small per-year left/right fill strip so
+    # QA fill-bit rejection is exercised end to end.
+    margin = rng.integers(0, max(2, w // 32), size=ny)
+    cols = np.arange(w)
+    fill = (cols[None, None, :] < margin[:, None, None]) | (
+        cols[None, None, :] >= w - margin[:, None, None]
+    )
+    fill = np.broadcast_to(fill, (ny, h, w))
+    qa[fill] |= _QA_FILL
+    for name in BANDS:
+        bands[name] = np.where(fill, np.float32(_C2_OFFSET), bands[name])
+
+    return SyntheticStack(
+        years=years,
+        bands=bands,
+        qa=qa,
+        truth_year=truth_year,
+        truth_magnitude=truth_mag,
+    )
+
+
+def write_stack(
+    out_dir: str,
+    stack: SyntheticStack,
+    compress: str = "deflate",
+    tile: int | None = 256,
+) -> list[str]:
+    """Write one multi-band GeoTIFF per year (6 SR bands int16 + QA uint16).
+
+    Layout mirrors a per-year Landsat composite directory:
+    ``{out_dir}/LT_{year}.tif`` with bands in :data:`BANDS` order followed by
+    QA_PIXEL.  Returns the file paths in year order.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 500000.0, 5000000.0, 0.0),
+    )
+    paths = []
+    for i, year in enumerate(stack.years):
+        sr = np.stack([stack.dn(b)[i] for b in BANDS])          # (6, H, W) i16
+        qa = stack.qa[i].astype(np.int16)                        # QA bits fit
+        img = np.concatenate([sr, qa[None]], axis=0)
+        path = os.path.join(out_dir, f"LT_{int(year)}.tif")
+        write_geotiff(path, img, geo=geo, compress=compress, tile=tile)
+        paths.append(path)
+    return paths

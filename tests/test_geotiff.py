@@ -1,0 +1,167 @@
+"""GeoTIFF codec round-trip + cross-validation against Pillow."""
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io.geotiff import GeoMeta, read_geotiff, write_geotiff
+
+DTYPES = ["u1", "u2", "i2", "i4", "f4", "f8"]
+
+
+def _rand(rng, dtype, shape):
+    if np.dtype(dtype).kind == "f":
+        return rng.normal(size=shape).astype(dtype)
+    info = np.iinfo(dtype)
+    return rng.integers(info.min, info.max, size=shape, endpoint=True).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("compress", ["deflate", "none"])
+def test_roundtrip_single_band_tiled(tmp_path, rng, dtype, compress):
+    arr = _rand(rng, dtype, (70, 53))  # deliberately not tile-aligned
+    p = str(tmp_path / "x.tif")
+    write_geotiff(p, arr, compress=compress, tile=32)
+    got, _, info = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+    assert info.bands == 1 and info.tiled and info.dtype == np.dtype(dtype)
+
+
+@pytest.mark.parametrize("dtype", ["i2", "f4"])
+def test_roundtrip_multiband_stripped(tmp_path, rng, dtype):
+    arr = _rand(rng, dtype, (7, 130, 41))
+    p = str(tmp_path / "x.tif")
+    write_geotiff(p, arr, compress="deflate", tile=None)
+    got, _, info = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+    assert info.bands == 7 and not info.tiled
+
+
+def test_roundtrip_predictor_off(tmp_path, rng):
+    arr = _rand(rng, "i2", (64, 64))
+    p = str(tmp_path / "x.tif")
+    write_geotiff(p, arr, predictor=False)
+    got, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_predictor_improves_smooth_raster_compression(tmp_path):
+    y, x = np.mgrid[0:256, 0:256]
+    smooth = (y * 13 + x * 7).astype(np.int16)
+    p1, p2 = str(tmp_path / "p.tif"), str(tmp_path / "np.tif")
+    write_geotiff(p1, smooth, predictor=True)
+    write_geotiff(p2, smooth, predictor=False)
+    import os
+
+    assert os.path.getsize(p1) < os.path.getsize(p2)
+    np.testing.assert_array_equal(read_geotiff(p1)[0], smooth)
+
+
+def test_geo_metadata_roundtrip(tmp_path, rng):
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 512345.0, 5001234.0, 0.0),
+        geo_key_directory=(1, 1, 0, 3, 1024, 0, 1, 1, 1025, 0, 1, 1, 3072, 0, 1, 32610),
+        geo_double_params=(6378137.0,),
+        geo_ascii_params="WGS 84 / UTM zone 10N|",
+        nodata=-9999.0,
+    )
+    arr = _rand(rng, "i2", (32, 32))
+    p = str(tmp_path / "x.tif")
+    write_geotiff(p, arr, geo=geo)
+    _, got, _ = read_geotiff(p)
+    assert got.pixel_scale == geo.pixel_scale
+    assert got.tiepoint == geo.tiepoint
+    assert got.geo_key_directory == geo.geo_key_directory
+    assert got.geo_double_params == geo.geo_double_params
+    assert got.geo_ascii_params == geo.geo_ascii_params
+    assert got.nodata == geo.nodata
+    gt = got.geotransform()
+    assert gt == (512345.0, 30.0, 0.0, 5001234.0, 0.0, -30.0)
+
+
+def test_pillow_reads_our_files(tmp_path, rng):
+    from PIL import Image
+
+    arr = _rand(rng, "u1", (48, 60))
+    p = str(tmp_path / "x.tif")
+    write_geotiff(p, arr, compress="deflate", tile=32)
+    with Image.open(p) as im:
+        got = np.asarray(im)
+    np.testing.assert_array_equal(got, arr)
+
+
+@pytest.mark.parametrize("mode_dtype", [("L", "u1"), ("I", "i4"), ("F", "f4")])
+def test_we_read_pillow_files(tmp_path, rng, mode_dtype):
+    from PIL import Image
+
+    mode, dtype = mode_dtype
+    arr = _rand(rng, dtype, (33, 47))
+    p = str(tmp_path / "x.tif")
+    Image.fromarray(arr, mode=mode).save(p, compression="tiff_adobe_deflate")
+    got, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_reject_garbage_header(tmp_path):
+    p = str(tmp_path / "bad.tif")
+    with open(p, "wb") as f:
+        f.write(b"XX\x00\x00")
+    with pytest.raises(ValueError, match="byte-order"):
+        read_geotiff(p)
+
+
+def test_read_big_endian_file(tmp_path, rng):
+    # hand-built MM (big-endian) stripped uncompressed uint16 file
+    import struct
+
+    arr = _rand(rng, "u2", (5, 7))
+    data = arr.astype(">u2").tobytes()
+    entries = [
+        (256, 3, 1, 7),       # width
+        (257, 3, 1, 5),       # height
+        (258, 3, 1, 16),      # bits
+        (259, 3, 1, 1),       # no compression
+        (262, 3, 1, 1),       # photometric
+        (273, 4, 1, 8),       # strip offset (data right after header)
+        (277, 3, 1, 1),       # samples/pixel
+        (278, 3, 1, 5),       # rows/strip
+        (279, 4, 1, len(data)),
+        (339, 3, 1, 1),       # unsigned
+    ]
+    ifd_off = 8 + len(data)
+    buf = struct.pack(">2sHI", b"MM", 42, ifd_off) + data
+    buf += struct.pack(">H", len(entries))
+    for tag, ftype, count, val in entries:
+        if ftype == 3:
+            buf += struct.pack(">HHIHH", tag, ftype, count, val, 0)
+        else:
+            buf += struct.pack(">HHII", tag, ftype, count, val)
+    buf += struct.pack(">I", 0)
+    p = str(tmp_path / "be.tif")
+    with open(p, "wb") as f:
+        f.write(buf)
+    got, _, info = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+    assert info.dtype == np.dtype("u2")
+
+
+def test_read_rational_resolution_tags(tmp_path, rng):
+    # Pillow writes X/YResolution RATIONAL tags with dpi set — the reader
+    # must skip over them without miscounting their payload size.
+    from PIL import Image
+
+    arr = _rand(rng, "u1", (9, 11))
+    p = str(tmp_path / "dpi.tif")
+    Image.fromarray(arr, mode="L").save(p, dpi=(72, 72))
+    got, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_reject_bigtiff(tmp_path):
+    import struct
+
+    p = str(tmp_path / "big.tif")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<2sHI", b"II", 43, 0))
+    with pytest.raises(ValueError, match="BigTIFF"):
+        read_geotiff(p)

@@ -1,0 +1,77 @@
+"""Synthetic-stack generator: physical plausibility + file round-trip."""
+
+import numpy as np
+
+from land_trendr_tpu.io.geotiff import read_geotiff
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.ops.indices import BANDS
+
+
+def test_stack_shapes_and_truth():
+    spec = SceneSpec(width=64, height=48, cloud_fraction=0.05)
+    st = make_stack(spec)
+    ny = spec.year_end - spec.year_start + 1
+    assert st.years.shape == (ny,)
+    for b in BANDS:
+        assert st.bands[b].shape == (ny, 48, 64)
+    assert st.qa.shape == (ny, 48, 64)
+    frac = (st.truth_year >= 0).mean()
+    assert 0.2 < frac < 0.4  # ~disturbance_fraction
+    assert (st.truth_magnitude[st.truth_year >= 0] > 0).all()
+    assert (st.truth_magnitude[st.truth_year < 0] == 0).all()
+
+
+def test_disturbance_drops_nbr():
+    st = make_stack(SceneSpec(width=64, height=64, cloud_fraction=0.0, noise=0.0))
+    nir, swir2 = st.bands["nir"], st.bands["swir2"]
+    nbr = (nir - swir2) / (nir + swir2)
+    dist = st.truth_year >= 0
+    # pick disturbed pixels whose event is mid-series
+    yy = st.truth_year[dist]
+    sel = (yy > st.years[5]) & (yy < st.years[-5])
+    pre = nbr[0][dist][sel]
+    # NBR immediately after event (year index of event per pixel)
+    yidx = np.searchsorted(st.years, yy[sel])
+    cols = np.flatnonzero(dist.ravel())[sel]
+    post = nbr.reshape(len(st.years), -1)[yidx, cols]
+    assert (pre - post > 0.2).mean() > 0.95
+
+
+def test_fill_margins_marked_and_nodata():
+    st = make_stack(SceneSpec(width=128, height=32))
+    fill = (st.qa & 1) != 0
+    assert fill.any()  # some years have nonzero margins
+    # fill pixels carry the nodata reflectance (DN 0 after C2 encoding)
+    assert (st.dn("nir")[fill] == np.round(0.2 / 2.75e-5)).all() or (
+        st.bands["nir"][fill] == np.float32(-0.2)
+    ).all()
+
+
+def test_cloud_qa_marks_bright_pixels():
+    st = make_stack(SceneSpec(width=32, height=32, cloud_fraction=0.2))
+    cloudy = (st.qa & (1 << 3)) != 0
+    assert 0.15 < cloudy.mean() < 0.25
+    assert st.bands["blue"][cloudy].mean() > 10 * st.bands["blue"][~cloudy].mean()
+
+
+def test_dn_encoding_roundtrip():
+    st = make_stack(SceneSpec(width=16, height=16))
+    dn = st.dn("nir")
+    assert dn.dtype == np.int16
+    back = dn.astype(np.float32) * 2.75e-5 - 0.2
+    in_range = st.bands["nir"] <= 32767 * 2.75e-5 - 0.2  # clouds can saturate
+    assert in_range.mean() > 0.9
+    np.testing.assert_allclose(back[in_range], st.bands["nir"][in_range], atol=2.75e-5)
+
+
+def test_write_stack_roundtrip(tmp_path):
+    spec = SceneSpec(width=40, height=24, year_start=2000, year_end=2005)
+    st = make_stack(spec)
+    paths = write_stack(str(tmp_path), st, tile=16)
+    assert len(paths) == 6
+    arr, geo, info = read_geotiff(paths[0])
+    assert arr.shape == (7, 24, 40)  # 6 SR bands + QA
+    assert info.dtype == np.dtype("i2")
+    np.testing.assert_array_equal(arr[:6], np.stack([st.dn(b)[0] for b in BANDS]))
+    np.testing.assert_array_equal(arr[6].astype(np.uint16), st.qa[0])
+    assert geo.pixel_scale == (30.0, 30.0, 0.0)
