@@ -3,8 +3,12 @@
 from land_trendr_tpu.ops.ftv import ftv_pixel, jax_fit_to_vertices
 from land_trendr_tpu.ops.indices import compute_index, qa_valid_mask, scale_sr, sr_valid_mask
 from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels, segment_pixel
+from land_trendr_tpu.ops.tile import TileOutputs, process_tile_dn, process_tile_index
 
 __all__ = [
+    "TileOutputs",
+    "process_tile_dn",
+    "process_tile_index",
     "SegOutputs",
     "jax_segment_pixels",
     "segment_pixel",

@@ -29,6 +29,8 @@ __all__ = [
     "BANDS",
     "INDEX_NAMES",
     "DISTURBANCE_SIGN",
+    "INDEX_BANDS",
+    "required_bands",
     "nbr",
     "ndvi",
     "tcw",
@@ -51,6 +53,27 @@ _TCW_COEFFS = (0.0315, 0.2021, 0.3102, 0.1594, -0.6806, -0.6109)
 DISTURBANCE_SIGN = {"nbr": -1.0, "ndvi": -1.0, "tcw": -1.0}
 
 INDEX_NAMES = tuple(DISTURBANCE_SIGN)
+
+#: Bands each index actually reads.  Callers that feed the device (the
+#: runtime driver) ship only the union of the bands their index selection
+#: needs — masking on an unused band would drop usable observations, and
+#: every unused band is wasted host→HBM bandwidth.
+INDEX_BANDS = {
+    "nbr": ("nir", "swir2"),
+    "ndvi": ("nir", "red"),
+    "tcw": BANDS,
+}
+
+
+def required_bands(index: str, ftv_indices: tuple[str, ...] = ()) -> tuple[str, ...]:
+    """Union of bands needed by a primary index + FTV indices, BANDS-ordered."""
+    need: set[str] = set()
+    for name in (index, *ftv_indices):
+        key = name.lower()
+        if key not in INDEX_BANDS:
+            raise ValueError(f"unknown index {name!r}; expected one of {INDEX_NAMES}")
+        need.update(INDEX_BANDS[key])
+    return tuple(b for b in BANDS if b in need)
 
 
 def _safe_ratio(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,97 @@
+"""Fused tile-processing op: raw Landsat DNs in, segmentation out.
+
+The reference's driver computes the spectral index host-side before the
+per-pixel map tasks see the data (SURVEY.md §4 call stack (1): "read Landsat
+stack, compute index, mask" happens in the driver, through GDAL).  On TPU
+that order is wrong: HBM feeding is the projected bottleneck (SURVEY.md §7
+hard-part 4 — ~1.5 GB/s of int16 per chip at the 10M px/s target), so the
+framework ships the *narrowest* representation across PCIe/DCN — int16
+surface-reflectance DNs plus the uint16 QA bitfield — and fuses
+DN→reflectance scaling, index math, QA+range masking, and the full
+segmentation pipeline into one jitted program.  XLA folds the scaling and
+index arithmetic into the despike stage's first pass over the series; the
+bands never round-trip to HBM as float32.
+
+Feeding cost per pixel-year: 6 bands × 2 B + 2 B QA = 14 B as DNs versus
+8 B as a precomputed float32 index+mask — but the DN path lets one transfer
+serve *several* indices (NBR segmentation + NDVI/TCW FTV outputs), which
+the float path cannot, and keeps all math on device.  Both entry points are
+provided; the runtime driver uses the fused DN path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.ftv import jax_fit_to_vertices
+from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels
+
+__all__ = ["TileOutputs", "process_tile_dn", "process_tile_index"]
+
+
+class TileOutputs(NamedTuple):
+    """Segmentation of the primary index plus FTV fits of secondary indices."""
+
+    seg: SegOutputs
+    #: index name → (PX, NY) fitted-trajectory values (disturbance-positive
+    #: convention, matching the segmentation input sign).
+    ftv: dict[str, jnp.ndarray]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("index", "ftv_indices", "params", "scale", "offset", "reject_bits"),
+)
+def process_tile_dn(
+    years: jnp.ndarray,
+    dn_bands: Mapping[str, jnp.ndarray],
+    qa: jnp.ndarray,
+    index: str = "nbr",
+    ftv_indices: tuple[str, ...] = (),
+    params: LTParams = LTParams(),
+    scale: float = 2.75e-5,
+    offset: float = -0.2,
+    reject_bits: int = idx.DEFAULT_QA_REJECT,
+) -> TileOutputs:
+    """Segment one tile straight from Collection-2 style DNs.
+
+    Parameters
+    ----------
+    years : (NY,) shared year axis.
+    dn_bands : band name → (PX, NY) int16 DN arrays; must contain whatever
+        bands ``index`` and ``ftv_indices`` need (all six for TCW).
+    qa : (PX, NY) uint16 QA_PIXEL bitfield.
+    index : primary index driving the segmentation.
+    ftv_indices : secondary indices fitted to the chosen vertices
+        (classic LandTrendr FTV outputs, SURVEY.md §3.1 outputs).
+    params, scale, offset, reject_bits : static knobs; one compile per
+        combination.
+    """
+    sr = {name: idx.scale_sr(dn, scale, offset) for name, dn in dn_bands.items()}
+    mask = idx.qa_valid_mask(qa, reject_bits) & idx.sr_valid_mask(sr)
+    primary = idx.compute_index(index, sr)
+    seg = jax_segment_pixels(years, primary, mask, params)
+    ftv = {}
+    for name in ftv_indices:
+        series = idx.compute_index(name, sr)
+        ftv[name] = jax_fit_to_vertices(
+            years, series, mask, seg.vertex_indices, seg.n_vertices, params
+        )
+    return TileOutputs(seg=seg, ftv=ftv)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def process_tile_index(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+) -> SegOutputs:
+    """Segment a tile from a precomputed index series (debug / parity path)."""
+    return jax_segment_pixels(years, values, mask, params)

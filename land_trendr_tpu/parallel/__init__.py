@@ -1,1 +1,19 @@
-"""parallel subpackage of land_trendr_tpu."""
+"""parallel subpackage: device-mesh SPMD sharding of the pixel axis."""
+
+from land_trendr_tpu.parallel.mesh import (
+    PIXEL_AXIS,
+    make_mesh,
+    pad_to_multiple,
+    segment_pixels_sharded,
+    shard_pixels,
+    summarize_sharded,
+)
+
+__all__ = [
+    "PIXEL_AXIS",
+    "make_mesh",
+    "pad_to_multiple",
+    "segment_pixels_sharded",
+    "shard_pixels",
+    "summarize_sharded",
+]

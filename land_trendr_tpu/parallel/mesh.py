@@ -1,0 +1,163 @@
+"""Device-mesh data parallelism for the segmentation kernel.
+
+The reference's only parallelism strategy is data-parallel over pixels —
+one Hadoop map task per pixel with a shuffle to collect results (SURVEY.md
+§3 "Parallelism strategies"; BASELINE.json north_star: tiles shard over a
+TPU pod "with no cross-pixel collectives").  The TPU-native re-expression
+is SPMD sharding of the pixel axis over a 1-D ``jax.sharding.Mesh``:
+
+* the ``(PX, NY)`` value/mask arrays carry ``NamedSharding(mesh,
+  P("pixels", None))`` — each chip owns a contiguous pixel block;
+* the ``(NY,)`` year axis is replicated (it is shared by every pixel);
+* ``jax_segment_pixels`` is purely ``vmap``-ed elementwise over pixels, so
+  XLA partitions it with **zero cross-pixel data collectives** — exactly
+  the reference's communication structure, minus the Hadoop shuffle
+  (results stay sharded in HBM and are gathered host-side only when
+  materialised).  The single cross-shard exchange in the compiled program
+  is a 1-bit ``pred[]`` all-reduce: the convergence flag of ``betainc``'s
+  iterative lowering (loop control, not pixel data; asserted in
+  ``tests/test_parallel.py``);
+* the only collective in the whole framework is an optional ``psum``-shaped
+  metrics reduction (:func:`summarize_sharded`), mirroring SURVEY.md §5
+  "at most a psum-style metrics reduction".
+
+Multi-host note (SURVEY.md §5 distributed backend): on a multi-host pod the
+same program runs under ``jax.distributed`` with each host feeding its
+addressable shard of the pixel axis (``jax.make_array_from_process_local_
+data``); no device-side cross-host traffic is required, so all layout
+decisions here keep traffic off DCN entirely.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels
+
+__all__ = [
+    "PIXEL_AXIS",
+    "make_mesh",
+    "pad_to_multiple",
+    "shard_pixels",
+    "segment_pixels_sharded",
+    "summarize_sharded",
+]
+
+#: Name of the single mesh axis; everything shards along pixels.
+PIXEL_AXIS = "pixels"
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """A 1-D mesh over ``devices`` (default: all local devices).
+
+    One axis suffices because the workload has nothing to shard but data
+    (SURVEY.md §3: no model weights → TP/PP/EP are N/A; the 38-year
+    temporal axis stays whole and HBM-resident per pixel → SP is N/A).
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs, (PIXEL_AXIS,))
+
+
+def pad_to_multiple(
+    values: np.ndarray | jnp.ndarray,
+    mask: np.ndarray | jnp.ndarray,
+    multiple: int,
+) -> tuple[np.ndarray | jnp.ndarray, np.ndarray | jnp.ndarray, int]:
+    """Pad the pixel axis up to a multiple of ``multiple``.
+
+    Padded rows are fully masked (``mask=False``), which the kernel already
+    treats as the insufficient-data path, so they cost compute but never
+    produce NaNs or affect real pixels.  Returns ``(values, mask, n_real)``.
+    """
+    px = values.shape[0]
+    n_pad = (-px) % multiple
+    if n_pad == 0:
+        return values, mask, px
+    if isinstance(values, np.ndarray):
+        pad_v = np.zeros((n_pad,) + values.shape[1:], dtype=values.dtype)
+        pad_m = np.zeros((n_pad,) + mask.shape[1:], dtype=bool)
+        return (
+            np.concatenate([values, pad_v]),
+            np.concatenate([mask, pad_m]),
+            px,
+        )
+    pad_v = jnp.zeros((n_pad,) + values.shape[1:], dtype=values.dtype)
+    pad_m = jnp.zeros((n_pad,) + mask.shape[1:], dtype=bool)
+    return jnp.concatenate([values, pad_v]), jnp.concatenate([mask, pad_m]), px
+
+
+def shard_pixels(
+    mesh: Mesh, values, mask
+) -> tuple[jax.Array, jax.Array]:
+    """Place ``(PX, NY)`` arrays on the mesh, pixel axis sharded.
+
+    The pixel count must already be a multiple of the mesh size (use
+    :func:`pad_to_multiple`).
+    """
+    sh = NamedSharding(mesh, P(PIXEL_AXIS, None))
+    return jax.device_put(values, sh), jax.device_put(mask, sh)
+
+
+def segment_pixels_sharded(
+    years,
+    values,
+    mask,
+    params: LTParams = LTParams(),
+    mesh: Mesh | None = None,
+) -> SegOutputs:
+    """Sharded :func:`jax_segment_pixels` over a device mesh.
+
+    ``values``/``mask`` are ``(PX, NY)`` with ``PX`` a multiple of the mesh
+    size; host arrays are placed with :func:`shard_pixels` first so the
+    compiled program is SPMD from the start (no broadcast-then-reshard).
+    Outputs keep the pixel-axis sharding; scalar-per-pixel outputs (rmse,
+    p_of_f, ...) are sharded ``P("pixels")``.
+
+    This compiles to the *same* program as the single-device path plus a
+    partitioning annotation — XLA inserts no collectives because no op in
+    the kernel crosses the pixel axis (BASELINE north star: "no cross-pixel
+    collectives").
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    n_dev = math.prod(mesh.devices.shape)
+    if values.shape[0] % n_dev:
+        raise ValueError(
+            f"pixel count {values.shape[0]} not divisible by mesh size "
+            f"{n_dev}; use pad_to_multiple first"
+        )
+    if (
+        not isinstance(values, jax.Array)
+        or getattr(values.sharding, "mesh", None) != mesh
+    ):
+        values, mask = shard_pixels(mesh, values, mask)
+    years = jax.device_put(years, NamedSharding(mesh, P()))
+    return jax_segment_pixels(years, values, mask, params)
+
+
+def summarize_sharded(out: SegOutputs) -> dict[str, float]:
+    """Cross-pixel run metrics — the framework's one ``psum``-shaped
+    reduction (host-visible scalars; XLA emits the all-reduce over ICI).
+
+    Returns pixel counts and quality aggregates used by the runtime's
+    structured per-tile logs (SURVEY.md §5 observability).
+    """
+    valid = out.model_valid
+    n = valid.shape[0]
+    n_fit = jnp.sum(valid)
+    mean_p = jnp.where(n_fit > 0, jnp.sum(jnp.where(valid, out.p_of_f, 0.0)) / jnp.maximum(n_fit, 1), 1.0)
+    mean_rmse = jnp.sum(out.rmse) / n
+    return {
+        "pixels": float(n),
+        "fit_rate": float(n_fit / n),
+        "no_fit_rate": float(1.0 - n_fit / n),
+        "mean_p_of_f": float(mean_p),
+        "mean_rmse": float(mean_rmse),
+    }

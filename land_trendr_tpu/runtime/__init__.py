@@ -1,1 +1,24 @@
-"""runtime subpackage of land_trendr_tpu."""
+"""runtime subpackage: host driver, tile manifest, stack loading."""
+
+from land_trendr_tpu.runtime.driver import (
+    RunConfig,
+    TileSpec,
+    assemble_outputs,
+    plan_tiles,
+    run_stack,
+)
+from land_trendr_tpu.runtime.manifest import TileManifest, run_fingerprint
+from land_trendr_tpu.runtime.stack import RasterStack, load_stack_dir, stack_from_synthetic
+
+__all__ = [
+    "RunConfig",
+    "TileSpec",
+    "assemble_outputs",
+    "plan_tiles",
+    "run_stack",
+    "RasterStack",
+    "load_stack_dir",
+    "stack_from_synthetic",
+    "TileManifest",
+    "run_fingerprint",
+]

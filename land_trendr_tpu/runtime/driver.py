@@ -1,0 +1,312 @@
+"""The tile-run driver: stacks in, segment rasters out.
+
+This is the TPU-native replacement for the reference's L5+L4 layers
+(SURVEY.md §2): where the reference driver serialises one record per pixel
+and submits a Hadoop MapReduce job ("one map task per pixel", §4 call
+stacks 1-3), this driver cuts the scene into fixed-size tiles, feeds each
+as an HBM-resident ``(tile_px, year)`` batch to the fused device op
+(:func:`land_trendr_tpu.ops.tile.process_tile_dn`), and reassembles the
+per-pixel outputs into segment rasters on the input grid — the same
+stacks-in / rasters-out contract, with the process-spawn + text-shuffle
+overhead deleted.
+
+Design points (SURVEY.md §5 / §7):
+
+* **One compilation**: every tile — including edge tiles — is padded to the
+  same ``tile_size²`` pixel count with fully-masked rows, so the kernel
+  compiles once per run.
+* **Checkpoint/resume**: each finished tile persists via
+  :class:`~land_trendr_tpu.runtime.manifest.TileManifest`; a resumed run
+  skips them.  The manifest *is* the checkpoint.
+* **Failure handling**: tiles are independent; a failed tile is retried
+  ``max_retries`` times before the run aborts (Hadoop's task-retry
+  equivalent, minus speculative execution which a single SPMD program does
+  not need).
+* **Observability**: structured per-tile logs (px/sec, no-fit rate, mean
+  p-of-F) through :mod:`logging`, plus a run summary dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.geotiff import write_geotiff
+from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.tile import process_tile_dn
+from land_trendr_tpu.runtime.manifest import TileManifest, run_fingerprint
+from land_trendr_tpu.runtime.stack import RasterStack
+
+__all__ = ["RunConfig", "TileSpec", "plan_tiles", "run_stack", "assemble_outputs"]
+
+log = logging.getLogger("land_trendr_tpu.runtime")
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Everything that defines a segmentation run over one stack."""
+
+    index: str = "nbr"
+    ftv_indices: tuple[str, ...] = ()
+    params: LTParams = LTParams()
+    tile_size: int = 256
+    workdir: str = "lt_work"
+    out_dir: str = "lt_out"
+    resume: bool = True
+    max_retries: int = 2
+    write_fitted: bool = False  # include the (NY,) fitted trajectory raster
+    scale: float = 2.75e-5
+    offset: float = -0.2
+    reject_bits: int = idx.DEFAULT_QA_REJECT
+
+    def fingerprint(self, stack: RasterStack) -> str:
+        return run_fingerprint(
+            {
+                "index": self.index,
+                "ftv": list(self.ftv_indices),
+                "params": self.params.to_dict(),
+                "tile": self.tile_size,
+                "years": stack.years.tolist(),
+                "shape": list(stack.shape),
+                "scale": self.scale,
+                "offset": self.offset,
+                "reject_bits": self.reject_bits,
+                # changes the set of arrays each tile artifact carries, so a
+                # toggled resume must not reuse old artifacts
+                "write_fitted": self.write_fitted,
+            }
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TileSpec:
+    """One tile's window in the scene grid."""
+
+    tile_id: int
+    y0: int
+    x0: int
+    h: int
+    w: int
+
+
+def plan_tiles(height: int, width: int, tile_size: int) -> list[TileSpec]:
+    """Row-major fixed-grid tiling; edge tiles are smaller windows but are
+    padded to the full tile pixel count at feed time."""
+    tiles = []
+    tid = 0
+    for y0 in range(0, height, tile_size):
+        for x0 in range(0, width, tile_size):
+            tiles.append(
+                TileSpec(
+                    tile_id=tid,
+                    y0=y0,
+                    x0=x0,
+                    h=min(tile_size, height - y0),
+                    w=min(tile_size, width - x0),
+                )
+            )
+            tid += 1
+    return tiles
+
+
+def _feed_tile(
+    stack: RasterStack, t: TileSpec, tile_px: int, bands: tuple[str, ...]
+) -> tuple[dict[str, np.ndarray], np.ndarray]:
+    """Slice one tile into ``(tile_px, NY)`` arrays, padding with QA=fill.
+
+    Only ``bands`` (the union the index selection needs — see
+    :func:`~land_trendr_tpu.ops.indices.required_bands`) are cut and
+    shipped: range-masking on an unused band would drop usable
+    observations, and unused bands are wasted host→HBM bytes.  The
+    transpose puts the year axis innermost, the layout the kernel's
+    per-pixel scans want; padded rows carry the fill QA bit so the device
+    mask logic (not special-case host code) voids them.
+    """
+    ny = stack.n_years
+    px = t.h * t.w
+
+    def cut(a: np.ndarray) -> np.ndarray:
+        win = a[:, t.y0 : t.y0 + t.h, t.x0 : t.x0 + t.w]
+        return np.ascontiguousarray(win.reshape(ny, px).T)
+
+    dn = {name: cut(stack.dn_bands[name]) for name in bands}
+    qa = cut(stack.qa)
+    if px < tile_px:
+        pad = tile_px - px
+        dn = {
+            name: np.concatenate([a, np.zeros((pad, ny), a.dtype)]) for name, a in dn.items()
+        }
+        qa_pad = np.full((pad, ny), 1, dtype=qa.dtype)  # QA fill bit set
+        qa = np.concatenate([qa, qa_pad])
+    return dn, qa
+
+
+def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
+    """Device outputs → host npz payload, cropped back to the real window."""
+    px = t.h * t.w
+    seg = jax.tree_util.tree_map(np.asarray, out.seg)
+    arrays = {
+        "n_vertices": seg.n_vertices[:px],
+        "vertex_indices": seg.vertex_indices[:px],
+        "vertex_years": seg.vertex_years[:px],
+        "vertex_src_vals": seg.vertex_src_vals[:px],
+        "vertex_fit_vals": seg.vertex_fit_vals[:px],
+        "seg_magnitude": seg.seg_magnitude[:px],
+        "seg_duration": seg.seg_duration[:px],
+        "seg_rate": seg.seg_rate[:px],
+        "rmse": seg.rmse[:px],
+        "p_of_f": seg.p_of_f[:px],
+        "model_valid": seg.model_valid[:px],
+    }
+    if cfg.write_fitted:
+        arrays["fitted"] = seg.fitted[:px]
+    for name, arr in out.ftv.items():
+        arrays[f"ftv_{name}"] = np.asarray(arr)[:px]
+    return arrays
+
+
+def run_stack(
+    stack: RasterStack,
+    cfg: RunConfig,
+    tiles: Sequence[TileSpec] | None = None,
+) -> dict:
+    """Segment a whole stack tile by tile; returns the run summary.
+
+    Raster outputs are *not* written here — call :func:`assemble_outputs`
+    after (or on a later resume; assembly only needs the workdir).
+    """
+    if tiles is None:
+        tiles = plan_tiles(*stack.shape, cfg.tile_size)
+    tile_px = cfg.tile_size * cfg.tile_size
+    manifest = TileManifest(cfg.workdir, cfg.fingerprint(stack))
+    done = manifest.open(cfg.resume)
+    years = stack.years.astype(np.int32)
+    bands = idx.required_bands(cfg.index, cfg.ftv_indices)
+
+    t_run = time.perf_counter()
+    n_px = 0
+    n_fit = 0
+    skipped = 0
+    for t in tiles:
+        if t.tile_id in done:
+            skipped += 1
+            continue
+        dn, qa = _feed_tile(stack, t, tile_px, bands)
+        last_err: Exception | None = None
+        for attempt in range(cfg.max_retries + 1):
+            try:
+                t0 = time.perf_counter()
+                out = process_tile_dn(
+                    years,
+                    dn,
+                    qa,
+                    index=cfg.index,
+                    ftv_indices=cfg.ftv_indices,
+                    params=cfg.params,
+                    scale=cfg.scale,
+                    offset=cfg.offset,
+                    reject_bits=cfg.reject_bits,
+                )
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                break
+            except Exception as e:  # pragma: no cover - exercised via fault test
+                last_err = e
+                log.warning(
+                    "tile %d attempt %d/%d failed: %s",
+                    t.tile_id,
+                    attempt + 1,
+                    cfg.max_retries + 1,
+                    e,
+                )
+        else:
+            raise RuntimeError(
+                f"tile {t.tile_id} failed after {cfg.max_retries + 1} attempts"
+            ) from last_err
+
+        arrays = _tile_arrays(out, t, cfg)
+        px = t.h * t.w
+        fit = int(arrays["model_valid"].sum())
+        meta = {
+            "y0": t.y0,
+            "x0": t.x0,
+            "h": t.h,
+            "w": t.w,
+            "px_per_s": round(tile_px / dt, 1),
+            "no_fit_rate": round(1.0 - fit / px, 4),
+        }
+        manifest.record(t.tile_id, arrays, meta)
+        n_px += px
+        n_fit += fit
+        log.info(
+            "tile %d (%d,%d %dx%d): %.2fM px/s, no-fit %.1f%%",
+            t.tile_id, t.y0, t.x0, t.h, t.w,
+            meta["px_per_s"] / 1e6, 100 * meta["no_fit_rate"],
+        )
+
+    wall = time.perf_counter() - t_run
+    summary = {
+        "tiles": len(tiles),
+        "tiles_skipped_resume": skipped,
+        "pixels": n_px,
+        "fit_rate": (n_fit / n_px) if n_px else 0.0,
+        "wall_s": round(wall, 3),
+        "px_per_s": round(n_px / wall, 1) if n_px else 0.0,
+        "fingerprint": manifest.fingerprint,
+    }
+    log.info("run complete: %s", summary)
+    return summary
+
+
+def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:
+    """Mosaic per-tile artifacts into segment rasters (SURVEY.md §4 stack 3).
+
+    One multi-band GeoTIFF per product; band axis is the per-pixel vector
+    axis (vertex slot / segment slot / year).  Returns product → path.
+    """
+    tiles = plan_tiles(*stack.shape, cfg.tile_size)
+    manifest = TileManifest(cfg.workdir, cfg.fingerprint(stack))
+    done = manifest.open(resume=True)
+    missing = [t.tile_id for t in tiles if t.tile_id not in done]
+    if missing:
+        raise RuntimeError(
+            f"cannot assemble: {len(missing)} tiles missing from manifest "
+            f"(first few: {missing[:5]}); run run_stack first"
+        )
+
+    h, w = stack.shape
+    os.makedirs(cfg.out_dir, exist_ok=True)
+    # One product at a time: peak host memory is the largest single mosaic
+    # (e.g. the (NY, H, W) fitted raster), never the sum of all products.
+    # npz members are decompressed lazily per key, so each pass reads only
+    # its own product from every tile artifact.
+    products = sorted(manifest.load_tile(tiles[0].tile_id))
+    paths = {}
+    for name in products:
+        mosaic: np.ndarray | None = None
+        for t in tiles:
+            with np.load(manifest.tile_path(t.tile_id)) as z:
+                a = z[name]
+            if mosaic is None:
+                depth = 1 if a.ndim == 1 else a.shape[1]
+                mosaic = np.zeros((depth, h, w), dtype=a.dtype)
+            block = a.reshape(t.h, t.w, -1)
+            mosaic[:, t.y0 : t.y0 + t.h, t.x0 : t.x0 + t.w] = np.moveaxis(
+                block, -1, 0
+            )
+        assert mosaic is not None
+        if mosaic.dtype == np.bool_:
+            mosaic = mosaic.astype(np.uint8)
+        elif mosaic.dtype == np.float64:
+            mosaic = mosaic.astype(np.float32)
+        path = os.path.join(cfg.out_dir, f"{name}.tif")
+        write_geotiff(path, mosaic, geo=stack.geo)
+        paths[name] = path
+    return paths

@@ -1,0 +1,117 @@
+"""Completed-tile manifest: the framework's checkpoint/resume mechanism.
+
+The reference gets fault tolerance for free from Hadoop — failed map tasks
+are retried by the framework, and a restarted job recomputes everything
+(SURVEY.md §5 "Failure detection" / "Checkpoint/resume").  The TPU-native
+equivalent is deliberately simple because tiles are independent work units:
+each finished tile is persisted as one ``.npz`` plus an append-only JSONL
+manifest record; resume = skip every tile already in the manifest whose
+artifact exists and matches the run fingerprint.  A crashed run therefore
+loses at most the tile in flight.
+
+The fingerprint ties a manifest to (stack shape, year span, parameters,
+index selection, tile size) so stale workdirs from a different run are
+rejected instead of silently mixed in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+__all__ = ["TileManifest", "run_fingerprint"]
+
+
+def run_fingerprint(payload: dict) -> str:
+    """Stable short hash of the run-defining configuration."""
+    blob = json.dumps(payload, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class TileManifest:
+    """Append-only JSONL manifest of completed tiles in a work directory."""
+
+    workdir: str
+    fingerprint: str
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.workdir, "manifest.jsonl")
+
+    def tile_path(self, tile_id: int) -> str:
+        return os.path.join(self.workdir, f"tile_{tile_id:05d}.npz")
+
+    def open(self, resume: bool) -> set[int]:
+        """Prepare the workdir; return tile ids that can be skipped.
+
+        With ``resume=False`` any existing manifest is discarded.  With
+        ``resume=True`` the existing manifest must carry the same
+        fingerprint (else ValueError — the workdir belongs to a different
+        run) and only records whose ``.npz`` artifact is readable count as
+        done.
+        """
+        os.makedirs(self.workdir, exist_ok=True)
+        if not os.path.exists(self.path):
+            self._write_header()
+            return set()
+        if not resume:
+            os.remove(self.path)
+            self._write_header()
+            return set()
+
+        done: set[int] = set()
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("kind") == "header":
+                    if rec.get("fingerprint") != self.fingerprint:
+                        raise ValueError(
+                            f"workdir {self.workdir} belongs to a different "
+                            f"run (manifest fingerprint {rec.get('fingerprint')} "
+                            f"!= {self.fingerprint}); pass resume=False to "
+                            "discard it"
+                        )
+                    continue
+                if rec.get("kind") != "tile":
+                    continue
+                tid = int(rec["tile_id"])
+                if os.path.exists(self.tile_path(tid)):
+                    done.add(tid)
+        return done
+
+    def _write_header(self) -> None:
+        with open(self.path, "w") as f:
+            f.write(
+                json.dumps({"kind": "header", "fingerprint": self.fingerprint})
+                + "\n"
+            )
+
+    def record(self, tile_id: int, arrays: dict[str, np.ndarray], meta: dict) -> None:
+        """Persist one finished tile: artifact first, then the manifest line
+        (so a crash between the two leaves a recoverable, not corrupt, state)."""
+        # note: np.savez appends ".npz" unless the name already ends with it
+        tmp = self.tile_path(tile_id) + ".tmp.npz"
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, self.tile_path(tile_id))
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"kind": "tile", "tile_id": tile_id, **meta}) + "\n")
+
+    def load_tile(self, tile_id: int) -> dict[str, np.ndarray]:
+        with np.load(self.tile_path(tile_id)) as z:
+            return {k: z[k] for k in z.files}
+
+    def iter_records(self) -> Iterator[dict]:
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

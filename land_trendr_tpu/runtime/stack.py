@@ -1,0 +1,115 @@
+"""Host-side Landsat stack handling for the runtime driver.
+
+Replaces the reference driver's GDAL stack-enumeration step (SURVEY.md §2
+layer L1 / §4 call stack (1): "read Landsat stack, compute index, mask" in
+the driver process).  Unlike the reference, the loaded representation stays
+in the *narrow* on-disk dtype — int16 surface-reflectance DNs + uint16 QA —
+because index math and masking run fused on device
+(:mod:`land_trendr_tpu.ops.tile`); the host never materialises float32
+bands for the whole scene.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+import numpy as np
+
+from land_trendr_tpu.io.geotiff import GeoMeta, read_geotiff
+from land_trendr_tpu.io.synthetic import SyntheticStack
+from land_trendr_tpu.ops.indices import BANDS
+
+__all__ = ["RasterStack", "load_stack_dir", "stack_from_synthetic"]
+
+# A plausible acquisition year, not any 4-digit run: Landsat product ids put
+# path/row digits ("045030") before the date, so take the LAST match of a
+# standalone (19|20)xx group.
+_YEAR_RE = re.compile(r"(?<!\d)((?:19|20)\d{2})(?!\d)")
+
+
+@dataclasses.dataclass
+class RasterStack:
+    """An annual Landsat stack in device-feed layout.
+
+    ``dn_bands[name]`` is ``(NY, H, W)`` int16; ``qa`` is ``(NY, H, W)``
+    uint16; ``years`` is ``(NY,)`` int32 ascending.  ``geo`` carries the
+    grid so output rasters inherit it (SURVEY.md §2: outputs are written on
+    the input grid).
+    """
+
+    years: np.ndarray
+    dn_bands: dict[str, np.ndarray]
+    qa: np.ndarray
+    geo: GeoMeta | None = None
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self.qa.shape[1], self.qa.shape[2]
+
+    @property
+    def n_years(self) -> int:
+        return int(self.years.shape[0])
+
+
+def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
+    """Load a directory of per-year multi-band GeoTIFFs.
+
+    Expects one file per year whose name contains the 4-digit year (the
+    layout :func:`land_trendr_tpu.io.synthetic.write_stack` produces, and
+    the common convention for annual composites), bands ordered
+    ``blue, green, red, nir, swir1, swir2, QA_PIXEL``.
+    """
+    names = sorted(n for n in os.listdir(path) if re.search(pattern, n))
+    if not names:
+        raise FileNotFoundError(f"no rasters matching {pattern!r} in {path}")
+    entries = []
+    for n in names:
+        ms = _YEAR_RE.findall(n)
+        if not ms:
+            raise ValueError(f"cannot parse a plausible 4-digit year from {n!r}")
+        entries.append((int(ms[-1]), os.path.join(path, n)))
+    entries.sort()
+    years = np.array([y for y, _ in entries], dtype=np.int32)
+    if len(np.unique(years)) != len(years):
+        raise ValueError(f"duplicate years in {path}: {years.tolist()}")
+
+    dn_bands: dict[str, list[np.ndarray]] = {b: [] for b in BANDS}
+    qa_list = []
+    geo = None
+    shape = None
+    for year, fp in entries:
+        img, g, _info = read_geotiff(fp)
+        if img.ndim == 2:
+            img = img[None]
+        if img.shape[0] < len(BANDS) + 1:
+            raise ValueError(
+                f"{fp}: expected {len(BANDS) + 1} bands "
+                f"({', '.join(BANDS)}, QA_PIXEL); got {img.shape[0]}"
+            )
+        if shape is None:
+            shape, geo = img.shape[1:], g
+        elif img.shape[1:] != shape:
+            raise ValueError(f"{fp}: raster size {img.shape[1:]} != {shape}")
+        for i, b in enumerate(BANDS):
+            dn_bands[b].append(img[i].astype(np.int16, copy=False))
+        qa_list.append(img[len(BANDS)].astype(np.uint16, copy=False))
+
+    return RasterStack(
+        years=years,
+        dn_bands={b: np.stack(v) for b, v in dn_bands.items()},
+        qa=np.stack(qa_list),
+        geo=geo,
+    )
+
+
+def stack_from_synthetic(stack: SyntheticStack, geo: GeoMeta | None = None) -> RasterStack:
+    """Adapt an in-memory synthetic stack (tests / benchmarks) to the
+    driver's feed layout without a disk round-trip."""
+    return RasterStack(
+        years=stack.years.astype(np.int32),
+        dn_bands={b: stack.dn(b) for b in BANDS},
+        qa=stack.qa.astype(np.uint16),
+        geo=geo,
+    )

@@ -1,0 +1,130 @@
+"""Sharded-execution tests on the virtual 8-device CPU mesh.
+
+Checks the SPMD contract from SURVEY.md §3/§5: pixel-axis sharding over a
+1-D mesh, identical numbers to the single-device path ("no cross-pixel
+collectives" means sharding cannot change results), correct output
+shardings, and zero collectives in the compiled program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+from land_trendr_tpu.parallel import (
+    PIXEL_AXIS,
+    make_mesh,
+    pad_to_multiple,
+    segment_pixels_sharded,
+    shard_pixels,
+    summarize_sharded,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(7)
+    ny, px = 30, 64
+    years = np.arange(1990, 1990 + ny, dtype=np.int32)
+    base = 0.55 + 0.05 * rng.standard_normal((px, ny))
+    d_year = rng.integers(5, ny - 5, size=px)
+    mag = rng.uniform(0.2, 0.5, size=px)
+    after = np.arange(ny)[None, :] >= d_year[:, None]
+    vals = base - after * mag[:, None] * np.exp(
+        -0.1 * np.maximum(np.arange(ny)[None, :] - d_year[:, None], 0)
+    )
+    mask = rng.uniform(size=(px, ny)) > 0.1
+    return years, (-vals).astype(np.float64), mask
+
+
+def test_mesh_shape(mesh):
+    assert mesh.axis_names == (PIXEL_AXIS,)
+    assert mesh.devices.shape == (8,)
+
+
+def test_pad_to_multiple():
+    v = np.ones((13, 5), np.float32)
+    m = np.ones((13, 5), bool)
+    pv, pm, n = pad_to_multiple(v, m, 8)
+    assert pv.shape == (16, 5) and pm.shape == (16, 5) and n == 13
+    assert not pm[13:].any() and (pv[13:] == 0).all()
+    # already aligned → unchanged objects
+    pv2, pm2, n2 = pad_to_multiple(pv, pm, 8)
+    assert pv2 is pv and pm2 is pm and n2 == 16
+
+
+def test_sharded_matches_single_device(mesh, batch):
+    years, vals, mask = batch
+    ref = jax_segment_pixels(jnp.asarray(years), jnp.asarray(vals), jnp.asarray(mask))
+    out = segment_pixels_sharded(years, vals, mask, mesh=mesh)
+    for name, a, b in zip(ref._fields, ref, out):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"field {name}"
+        )
+
+
+def test_output_sharding_follows_pixel_axis(mesh, batch):
+    years, vals, mask = batch
+    out = segment_pixels_sharded(years, vals, mask, mesh=mesh)
+    # (PX, NY) field and scalar-per-pixel field both shard over pixels
+    assert out.fitted.sharding.is_equivalent_to(
+        NamedSharding(mesh, P(PIXEL_AXIS, None)), ndim=2
+    )
+    assert out.rmse.sharding.is_equivalent_to(
+        NamedSharding(mesh, P(PIXEL_AXIS)), ndim=1
+    )
+
+
+def test_no_collectives_in_compiled_program(mesh, batch):
+    years, vals, mask = batch
+    v, m = shard_pixels(mesh, jnp.asarray(vals), jnp.asarray(mask))
+    y = jax.device_put(jnp.asarray(years), NamedSharding(mesh, P()))
+    lowered = jax.jit(
+        lambda yy, vv, mm: jax_segment_pixels(yy, vv, mm, LTParams())
+    ).lower(y, v, m)
+    hlo = lowered.compile().as_text()
+    for coll in ("all-gather", "collective-permute", "all-to-all", "reduce-scatter"):
+        assert coll not in hlo, f"unexpected collective {coll} in compiled HLO"
+    # The only permitted all-reduce is the 1-bit convergence flag of
+    # betainc's iterative lowering (a while-loop termination check — control
+    # flow, not pixel data).  Any all-reduce over a numeric type would mean
+    # pixel data crossed shards.
+    for line in hlo.splitlines():
+        if "all-reduce(" in line:
+            assert "pred[]" in line, f"numeric all-reduce in HLO: {line.strip()}"
+
+
+def test_accepts_unsharded_device_array(mesh, batch):
+    """A single-device jax.Array (e.g. a previous op's output) must be
+    resharded, not crash on SingleDeviceSharding having no .mesh."""
+    years, vals, mask = batch
+    v = jax.device_put(jnp.asarray(vals), jax.devices()[0])
+    m = jax.device_put(jnp.asarray(mask), jax.devices()[0])
+    out = segment_pixels_sharded(years, v, m, mesh=mesh)
+    ref = jax_segment_pixels(jnp.asarray(years), jnp.asarray(vals), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(ref.fitted), np.asarray(out.fitted))
+
+
+def test_indivisible_batch_raises(mesh, batch):
+    years, vals, mask = batch
+    with pytest.raises(ValueError, match="not divisible"):
+        segment_pixels_sharded(years, vals[:13], mask[:13], mesh=mesh)
+
+
+def test_summarize_sharded(mesh, batch):
+    years, vals, mask = batch
+    out = segment_pixels_sharded(years, vals, mask, mesh=mesh)
+    s = summarize_sharded(out)
+    assert s["pixels"] == vals.shape[0]
+    assert 0.0 <= s["no_fit_rate"] <= 1.0
+    assert s["fit_rate"] + s["no_fit_rate"] == pytest.approx(1.0)
+    assert s["fit_rate"] > 0.5  # strong synthetic disturbances mostly fit

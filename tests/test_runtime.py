@@ -1,0 +1,244 @@
+"""End-to-end runtime-driver tests: synthetic stack → tiles → rasters.
+
+Covers the driver contract from SURVEY.md §2/§4 (stacks in, segment rasters
+out on the input grid), the manifest checkpoint/resume semantics (§5), the
+fused DN tile op against the precomputed-index path, and tile-level retry.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.geotiff import read_geotiff
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+from land_trendr_tpu.runtime import (
+    RunConfig,
+    TileManifest,
+    assemble_outputs,
+    load_stack_dir,
+    plan_tiles,
+    run_stack,
+    stack_from_synthetic,
+)
+
+SPEC = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+@pytest.fixture(scope="module")
+def synth():
+    return make_stack(SPEC)
+
+
+@pytest.fixture(scope="module")
+def rstack(synth):
+    return stack_from_synthetic(synth)
+
+
+def make_cfg(tmp, **kw):
+    kw.setdefault("params", PARAMS)
+    kw.setdefault("tile_size", 32)
+    return RunConfig(
+        workdir=os.path.join(tmp, "work"), out_dir=os.path.join(tmp, "out"), **kw
+    )
+
+
+def test_plan_tiles_covers_scene():
+    tiles = plan_tiles(40, 48, 32)
+    assert len(tiles) == 4
+    cover = np.zeros((40, 48), np.int32)
+    for t in tiles:
+        cover[t.y0 : t.y0 + t.h, t.x0 : t.x0 + t.w] += 1
+    assert (cover == 1).all()
+
+
+def test_run_and_assemble(tmp_path, synth, rstack):
+    cfg = make_cfg(tmp_path, ftv_indices=("ndvi",), write_fitted=True)
+    summary = run_stack(rstack, cfg)
+    assert summary["pixels"] == 40 * 48
+    assert summary["tiles"] == 4 and summary["tiles_skipped_resume"] == 0
+
+    paths = assemble_outputs(rstack, cfg)
+    for product in (
+        "n_vertices", "vertex_years", "vertex_fit_vals", "seg_magnitude",
+        "rmse", "p_of_f", "model_valid", "fitted", "ftv_ndvi",
+    ):
+        assert product in paths and os.path.exists(paths[product])
+
+    valid, _, _ = read_geotiff(paths["model_valid"])
+    vyears, _, _ = read_geotiff(paths["vertex_years"])
+    nverts, _, _ = read_geotiff(paths["n_vertices"])
+    assert valid.shape == (40, 48)
+    assert vyears.shape[0] == PARAMS.max_vertices
+    assert nverts.shape == (40, 48)
+
+    # ground truth: most disturbed pixels fit with a vertex near the event
+    disturbed = synth.truth_year >= 0
+    fit_on_disturbed = valid.astype(bool) & disturbed
+    assert fit_on_disturbed.sum() > 0.7 * disturbed.sum()
+    # for fitted disturbed pixels, some vertex year within ±2 of truth
+    yr = vyears[:, fit_on_disturbed]          # (NV, n_fit); 0 in dead slots
+    truth = synth.truth_year[fit_on_disturbed][None]
+    live = yr > 0
+    dist = np.where(live, np.abs(yr - truth), np.inf).min(axis=0)
+    assert (dist <= 2).mean() > 0.8
+
+    # fitted trajectories mosaic matches a direct kernel run on one window
+    fitted, _, _ = read_geotiff(paths["fitted"])
+    t = plan_tiles(40, 48, 32)[0]
+    sr = {b: idx.scale_sr(rstack.dn_bands[b][:, :32, :32].reshape(len(rstack.years), -1).T)
+          for b in idx.required_bands("nbr")}
+    mask = np.asarray(idx.qa_valid_mask(rstack.qa[:, :32, :32].reshape(len(rstack.years), -1).T)) & np.asarray(idx.sr_valid_mask(sr))
+    series = np.asarray(idx.compute_index("nbr", sr))
+    ref = jax_segment_pixels(rstack.years, series, mask, PARAMS)
+    got = fitted[:, :32, :32].reshape(len(rstack.years), -1).T
+    # The fused-DN program and the two-step path are different XLA programs;
+    # in float32 fusion differences can flip knife-edge argmax decisions on a
+    # small fraction of pixels (ops/segment.py float32 tolerance contract).
+    diff = np.abs(got - np.asarray(ref.fitted))
+    agree_px = (diff.max(axis=1) <= 1e-5).mean()
+    assert agree_px > 0.97, f"only {agree_px:.1%} of pixels agree bitwise-ish"
+    assert np.median(diff) < 1e-6
+
+
+def test_resume_skips_done_tiles(tmp_path, rstack, caplog):
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    with caplog.at_level(logging.INFO, logger="land_trendr_tpu.runtime"):
+        summary2 = run_stack(rstack, cfg)
+    assert summary2["tiles_skipped_resume"] == 4
+    assert summary2["pixels"] == 0
+
+
+def test_resume_rejects_foreign_workdir(tmp_path, rstack):
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    cfg2 = make_cfg(tmp_path, params=LTParams(max_segments=3))
+    with pytest.raises(ValueError, match="different\\s+run"):
+        run_stack(rstack, cfg2)
+    # resume=False discards and reruns
+    cfg3 = make_cfg(tmp_path, params=LTParams(max_segments=3), resume=False)
+    summary = run_stack(rstack, cfg3)
+    assert summary["pixels"] == 40 * 48
+
+
+def test_partial_manifest_resumes_missing_only(tmp_path, rstack):
+    cfg = make_cfg(tmp_path)
+    tiles = plan_tiles(*rstack.shape, cfg.tile_size)
+    run_stack(rstack, cfg, tiles=tiles[:2])  # only half the scene
+    with pytest.raises(RuntimeError, match="missing from manifest"):
+        assemble_outputs(rstack, cfg)
+    summary = run_stack(rstack, cfg)  # picks up the rest
+    assert summary["tiles_skipped_resume"] == 2
+    assert summary["pixels"] == sum(t.h * t.w for t in tiles[2:])
+    assemble_outputs(rstack, cfg)
+
+
+def test_manifest_ignores_missing_artifact(tmp_path, rstack):
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    manifest = TileManifest(cfg.workdir, cfg.fingerprint(rstack))
+    os.remove(manifest.tile_path(1))  # simulate lost artifact
+    summary = run_stack(rstack, cfg)
+    assert summary["tiles_skipped_resume"] == 3  # tile 1 recomputed
+    assemble_outputs(rstack, cfg)
+
+
+def test_manifest_jsonl_structure(tmp_path, rstack):
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    manifest = TileManifest(cfg.workdir, cfg.fingerprint(rstack))
+    recs = list(manifest.iter_records())
+    assert recs[0]["kind"] == "header"
+    tiles = [r for r in recs if r["kind"] == "tile"]
+    assert len(tiles) == 4
+    for r in tiles:
+        assert {"tile_id", "y0", "x0", "px_per_s", "no_fit_rate"} <= set(r)
+
+
+def test_fingerprint_covers_write_fitted(rstack):
+    """A toggled write_fitted must invalidate old artifacts (they lack or
+    carry extra arrays), so it participates in the run fingerprint."""
+    a = RunConfig(write_fitted=False).fingerprint(rstack)
+    b = RunConfig(write_fitted=True).fingerprint(rstack)
+    assert a != b
+
+
+def test_required_bands_subset_feeds_driver(tmp_path, rstack):
+    """NBR-only runs must not mask on (or ship) bands NBR never reads: a
+    pixel with garbage blue DNs but clean nir/swir2 still fits."""
+    bad = stack_from_synthetic(make_stack(SPEC))
+    bad.dn_bands["blue"][:] = -30000  # sr ≈ -1.0, far outside [0, 1]
+    cfg = make_cfg(tmp_path)
+    summary = run_stack(bad, cfg)
+    assert summary["fit_rate"] > 0.3  # unchanged from the clean run
+
+
+def test_year_parse_landsat_product_id(tmp_path, synth):
+    """Path/row digit runs ('045030') before the date must not win."""
+    stack_dir = os.path.join(tmp_path, "stack")
+    write_stack(stack_dir, synth)
+    for n in os.listdir(stack_dir):
+        year = n.split("_")[1].split(".")[0]
+        os.rename(
+            os.path.join(stack_dir, n),
+            os.path.join(stack_dir, f"LC08_L2SP_045030_{year}.tif"),
+        )
+    rstack = load_stack_dir(stack_dir)
+    np.testing.assert_array_equal(rstack.years, synth.years)
+
+
+def test_geotiff_roundtrip_driver(tmp_path, synth):
+    """Disk path: write per-year GeoTIFFs, load them back, run the driver."""
+    stack_dir = os.path.join(tmp_path, "stack")
+    write_stack(stack_dir, synth)
+    rstack = load_stack_dir(stack_dir)
+    assert rstack.n_years == len(synth.years)
+    assert rstack.shape == (SPEC.height, SPEC.width)
+    assert rstack.geo is not None and rstack.geo.pixel_scale is not None
+
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    paths = assemble_outputs(rstack, cfg)
+    valid, geo, _ = read_geotiff(paths["model_valid"])
+    assert valid.shape == (SPEC.height, SPEC.width)
+    # outputs inherit the input grid
+    assert geo.pixel_scale == rstack.geo.pixel_scale
+    assert geo.tiepoint == rstack.geo.tiepoint
+
+
+def test_retry_then_fail(tmp_path, rstack, monkeypatch):
+    cfg = make_cfg(tmp_path, max_retries=1)
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr("land_trendr_tpu.runtime.driver.process_tile_dn", boom)
+    with pytest.raises(RuntimeError, match="failed after 2 attempts"):
+        run_stack(rstack, cfg)
+    assert calls["n"] == 2
+
+
+def test_retry_recovers_from_transient_fault(tmp_path, rstack, monkeypatch):
+    from land_trendr_tpu.ops.tile import process_tile_dn as real_op
+
+    cfg = make_cfg(tmp_path, max_retries=2)
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient fault")
+        return real_op(*a, **k)
+
+    monkeypatch.setattr("land_trendr_tpu.runtime.driver.process_tile_dn", flaky)
+    summary = run_stack(rstack, cfg)
+    assert summary["pixels"] == 40 * 48
