@@ -1,0 +1,93 @@
+"""Headline benchmark: segmentation throughput in pixels/sec on one chip.
+
+Measures the north-star metric from BASELINE.json / SURVEY.md §6 — LandTrendr
+temporal segmentation of a 38+-year NBR stack, target ≥ 10M pixels/sec/chip —
+on whatever single device JAX provides (the real TPU chip under the driver;
+CPU when forced).  Prints exactly ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "pixels/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is value / 10e6 (the north-star target; the reference
+publishes no numbers of its own — BASELINE.json "published": {}).
+
+Methodology: realistic synthetic disturbance series (patchy events, regrowth,
+noise, ~8% masked observations) in float32; one untimed warm-up step compiles
+the kernel and an initial run; then ``REPS`` timed runs over fresh-ish data
+views with ``block_until_ready``; the reported value uses the best rep
+(steady-state throughput, host noise excluded).
+
+Env overrides: LT_BENCH_PX (default 262144 = 512²), LT_BENCH_YEARS (40),
+LT_BENCH_REPS (5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_series(px: int, ny: int, seed: int = 7) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Disturbance-positive NBR-like series + mask, float32."""
+    rng = np.random.default_rng(seed)
+    years = np.arange(1984, 1984 + ny, dtype=np.int32)
+    base = rng.uniform(0.55, 0.75, size=(px, 1)).astype(np.float32)
+    t = np.arange(ny, dtype=np.float32)[None, :]
+    disturbed = rng.uniform(size=(px, 1)) < 0.35
+    d_year = rng.integers(5, ny - 5, size=(px, 1))
+    mag = rng.uniform(0.15, 0.5, size=(px, 1)).astype(np.float32)
+    rec = rng.uniform(0.03, 0.15, size=(px, 1)).astype(np.float32)
+    dt = np.maximum(t - d_year, 0.0).astype(np.float32)
+    traj = base - np.where(disturbed & (t >= d_year), mag * np.exp(-rec * dt), 0.0)
+    traj += rng.normal(0.0, 0.012, size=(px, ny)).astype(np.float32)
+    mask = rng.uniform(size=(px, ny)) > 0.08
+    return years, (-traj).astype(np.float32), mask
+
+
+def main() -> int:
+    px = int(os.environ.get("LT_BENCH_PX", 512 * 512))
+    ny = int(os.environ.get("LT_BENCH_YEARS", 40))
+    reps = int(os.environ.get("LT_BENCH_REPS", 5))
+
+    import jax
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    dev = jax.devices()[0]
+    params = LTParams()
+    years_np, vals_np, mask_np = make_series(px, ny)
+    years = jax.device_put(years_np, dev)
+    vals = jax.device_put(vals_np, dev)
+    mask = jax.device_put(mask_np, dev)
+
+    # warm-up: compile + first run
+    out = jax_segment_pixels(years, vals, mask, params)
+    jax.block_until_ready(out)
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax_segment_pixels(years, vals, mask, params)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+
+    value = px / best
+    print(
+        json.dumps(
+            {
+                "metric": f"landtrendr_segmentation_throughput_{ny}yr_nbr",
+                "value": round(value, 1),
+                "unit": "pixels/sec/chip",
+                "vs_baseline": round(value / 10e6, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""``python -m land_trendr_tpu`` entry point."""
+
+import sys
+
+from land_trendr_tpu.cli import run
+
+sys.exit(run())

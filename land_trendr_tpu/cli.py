@@ -1,0 +1,166 @@
+"""Command-line driver — the framework's L5 entry point.
+
+Mirrors the reference's CLI/driver layer (SURVEY.md §2 L5: "parse config &
+CLI flags, enumerate input Landsat stack, launch the job, write segment
+rasters"), minus the Hadoop submission: ``segment`` runs the whole
+stacks-in / rasters-out pipeline in-process on the local TPU (or CPU).
+
+Commands
+--------
+``segment``   stack directory → segment rasters (the main pipeline)
+``params``    print the default algorithm parameters as JSON (a template
+              for ``--params-json``)
+``synth``     materialise a synthetic Landsat stack (fixtures / demos)
+
+Algorithm flags mirror the reference's parameter names (SURVEY.md §3.1
+table — config parity requirement from §5), e.g. ``--max-segments`` ↔
+``max_segments``.  ``--params-json`` loads a full :class:`LTParams` JSON
+first; individual flags then override.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.indices import INDEX_NAMES
+
+__all__ = ["main", "build_parser"]
+
+
+def _add_param_flags(p: argparse.ArgumentParser) -> None:
+    g = p.add_argument_group("algorithm parameters (reference names)")
+    g.add_argument("--params-json", type=str, default=None,
+                   help="path to an LTParams JSON file (flags override it)")
+    for f in dataclasses.fields(LTParams):
+        flag = "--" + f.name.replace("_", "-")
+        if f.type == "bool":
+            g.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                           default=None, metavar="BOOL")
+        else:
+            g.add_argument(flag, type=int if f.type == "int" else float, default=None)
+
+
+def _params_from_args(args: argparse.Namespace) -> LTParams:
+    base = {}
+    if args.params_json:
+        with open(args.params_json) as f:
+            base = json.load(f)
+    for f in dataclasses.fields(LTParams):
+        v = getattr(args, f.name, None)
+        if v is not None:
+            base[f.name] = v
+    return LTParams.from_dict(base)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="land_trendr_tpu",
+        description="TPU-native LandTrendr temporal segmentation",
+    )
+    p.add_argument("-v", "--verbose", action="store_true")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    seg = sub.add_parser("segment", help="segment a Landsat stack directory")
+    seg.add_argument("stack_dir", help="directory of per-year multi-band GeoTIFFs")
+    seg.add_argument("--index", default="nbr", choices=INDEX_NAMES,
+                     help="index driving the segmentation")
+    seg.add_argument("--ftv", default="", help="comma-separated FTV indices")
+    seg.add_argument("--tile-size", type=int, default=512)
+    seg.add_argument("--workdir", default="lt_work")
+    seg.add_argument("--out-dir", default="lt_out")
+    seg.add_argument("--no-resume", action="store_true",
+                     help="discard any existing workdir manifest")
+    seg.add_argument("--write-fitted", action="store_true",
+                     help="also write the full fitted-trajectory raster")
+    seg.add_argument("--max-retries", type=int, default=2)
+    seg.add_argument("--scale", type=float, default=2.75e-5,
+                     help="DN→reflectance scale (C2 default)")
+    seg.add_argument("--offset", type=float, default=-0.2,
+                     help="DN→reflectance offset (C2 default)")
+    _add_param_flags(seg)
+
+    par = sub.add_parser("params", help="print default LTParams JSON")
+    _add_param_flags(par)
+
+    syn = sub.add_parser("synth", help="write a synthetic Landsat stack")
+    syn.add_argument("out_dir")
+    syn.add_argument("--size", type=int, default=256)
+    syn.add_argument("--year-start", type=int, default=1984)
+    syn.add_argument("--year-end", type=int, default=2023)
+    syn.add_argument("--seed", type=int, default=20260729)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+
+    if args.cmd == "params":
+        print(_params_from_args(args).to_json())
+        return 0
+
+    if args.cmd == "synth":
+        from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+
+        spec = SceneSpec(
+            width=args.size, height=args.size,
+            year_start=args.year_start, year_end=args.year_end, seed=args.seed,
+        )
+        paths = write_stack(args.out_dir, make_stack(spec))
+        print(json.dumps({"files": len(paths), "out_dir": args.out_dir}))
+        return 0
+
+    if args.cmd == "segment":
+        # deferred: importing jax before arg validation makes --help slow
+        from land_trendr_tpu.runtime import (
+            RunConfig,
+            assemble_outputs,
+            load_stack_dir,
+            run_stack,
+        )
+
+        ftv = tuple(s for s in args.ftv.split(",") if s)
+        cfg = RunConfig(
+            index=args.index,
+            ftv_indices=ftv,
+            params=_params_from_args(args),
+            tile_size=args.tile_size,
+            workdir=args.workdir,
+            out_dir=args.out_dir,
+            resume=not args.no_resume,
+            max_retries=args.max_retries,
+            write_fitted=args.write_fitted,
+            scale=args.scale,
+            offset=args.offset,
+        )
+        stack = load_stack_dir(args.stack_dir)
+        summary = run_stack(stack, cfg)
+        paths = assemble_outputs(stack, cfg)
+        print(json.dumps({"summary": summary, "outputs": paths}, indent=2))
+        return 0
+
+    raise AssertionError(f"unhandled command {args.cmd!r}")
+
+
+def run() -> int:
+    """Console entry; exits quietly when stdout is a closed pipe (head, less)."""
+    try:
+        return main()
+    except BrokenPipeError:
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -142,18 +142,24 @@ def segment_pixels_sharded(
     return jax_segment_pixels(years, values, mask, params)
 
 
-def summarize_sharded(out: SegOutputs) -> dict[str, float]:
+def summarize_sharded(out: SegOutputs, n_real: int | None = None) -> dict[str, float]:
     """Cross-pixel run metrics — the framework's one ``psum``-shaped
     reduction (host-visible scalars; XLA emits the all-reduce over ICI).
 
     Returns pixel counts and quality aggregates used by the runtime's
-    structured per-tile logs (SURVEY.md §5 observability).
+    structured per-tile logs (SURVEY.md §5 observability).  Pass the
+    ``n_real`` from :func:`pad_to_multiple` so the fully-masked padding
+    rows (always no-fit) don't dilute the rates.
     """
     valid = out.model_valid
+    rmse = out.rmse
+    p_of_f = out.p_of_f
+    if n_real is not None:
+        valid, rmse, p_of_f = valid[:n_real], rmse[:n_real], p_of_f[:n_real]
     n = valid.shape[0]
     n_fit = jnp.sum(valid)
-    mean_p = jnp.where(n_fit > 0, jnp.sum(jnp.where(valid, out.p_of_f, 0.0)) / jnp.maximum(n_fit, 1), 1.0)
-    mean_rmse = jnp.sum(out.rmse) / n
+    mean_p = jnp.where(n_fit > 0, jnp.sum(jnp.where(valid, p_of_f, 0.0)) / jnp.maximum(n_fit, 1), 1.0)
+    mean_rmse = jnp.sum(rmse) / n
     return {
         "pixels": float(n),
         "fit_rate": float(n_fit / n),

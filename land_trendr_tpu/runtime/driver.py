@@ -149,26 +149,35 @@ def _feed_tile(
 
 
 def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
-    """Device outputs → host npz payload, cropped back to the real window."""
+    """Device outputs → host npz payload, cropped back to the real window.
+
+    The kernel fits in the disturbance-positive orientation
+    (``DISTURBANCE_SIGN`` flip, SURVEY.md §3.1 orientation note); written
+    products undo the flip so rasters carry the index's *natural* values —
+    healthy-forest NBR reads +0.7, and a disturbance is a ``seg_magnitude``
+    drop — matching the reference's output convention (indices.py contract).
+    Durations, rmse, p-of-F and vertex bookkeeping are sign-invariant.
+    """
     px = t.h * t.w
     seg = jax.tree_util.tree_map(np.asarray, out.seg)
+    sign = idx.DISTURBANCE_SIGN[cfg.index.lower()]
     arrays = {
         "n_vertices": seg.n_vertices[:px],
         "vertex_indices": seg.vertex_indices[:px],
         "vertex_years": seg.vertex_years[:px],
-        "vertex_src_vals": seg.vertex_src_vals[:px],
-        "vertex_fit_vals": seg.vertex_fit_vals[:px],
-        "seg_magnitude": seg.seg_magnitude[:px],
+        "vertex_src_vals": sign * seg.vertex_src_vals[:px],
+        "vertex_fit_vals": sign * seg.vertex_fit_vals[:px],
+        "seg_magnitude": sign * seg.seg_magnitude[:px],
         "seg_duration": seg.seg_duration[:px],
-        "seg_rate": seg.seg_rate[:px],
+        "seg_rate": sign * seg.seg_rate[:px],
         "rmse": seg.rmse[:px],
         "p_of_f": seg.p_of_f[:px],
         "model_valid": seg.model_valid[:px],
     }
     if cfg.write_fitted:
-        arrays["fitted"] = seg.fitted[:px]
+        arrays["fitted"] = sign * seg.fitted[:px]
     for name, arr in out.ftv.items():
-        arrays[f"ftv_{name}"] = np.asarray(arr)[:px]
+        arrays[f"ftv_{name}"] = idx.DISTURBANCE_SIGN[name.lower()] * np.asarray(arr)[:px]
     return arrays
 
 
@@ -287,7 +296,8 @@ def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:
     # (e.g. the (NY, H, W) fitted raster), never the sum of all products.
     # npz members are decompressed lazily per key, so each pass reads only
     # its own product from every tile artifact.
-    products = sorted(manifest.load_tile(tiles[0].tile_id))
+    with np.load(manifest.tile_path(tiles[0].tile_id)) as z:
+        products = sorted(z.files)  # zip directory only; nothing decompressed
     paths = {}
     for name in products:
         mosaic: np.ndarray | None = None

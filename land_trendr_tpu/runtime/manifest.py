@@ -57,6 +57,10 @@ class TileManifest:
         done.
         """
         os.makedirs(self.workdir, exist_ok=True)
+        # sweep temp artifacts orphaned by a crash mid-write
+        for n in os.listdir(self.workdir):
+            if n.endswith(".tmp.npz"):
+                os.remove(os.path.join(self.workdir, n))
         if not os.path.exists(self.path):
             self._write_header()
             return set()

@@ -1,0 +1,76 @@
+"""CLI tests: params round trip, flag→LTParams mapping, end-to-end segment.
+
+The ``segment`` subcommand is the reference's driver contract (SURVEY.md §2
+L5) — stack directory in, segment rasters + JSON run report out.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import build_parser, main
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.geotiff import read_geotiff
+
+
+def test_params_command_prints_defaults(capsys):
+    assert main(["params"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert LTParams.from_dict(out) == LTParams()
+
+
+def test_params_flags_override(capsys, tmp_path):
+    pj = tmp_path / "p.json"
+    pj.write_text(LTParams(max_segments=4).to_json())
+    assert main([
+        "params", "--params-json", str(pj),
+        "--spike-threshold", "0.8", "--prevent-one-year-recovery", "false",
+    ]) == 0
+    got = LTParams.from_dict(json.loads(capsys.readouterr().out))
+    assert got.max_segments == 4            # from JSON
+    assert got.spike_threshold == 0.8       # flag override
+    assert got.prevent_one_year_recovery is False
+
+
+def test_parser_rejects_unknown_index():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["segment", "x", "--index", "evi"])
+
+
+def test_synth_then_segment_end_to_end(tmp_path, capsys):
+    stack_dir = str(tmp_path / "stack")
+    assert main([
+        "synth", stack_dir, "--size", "48",
+        "--year-start", "1990", "--year-end", "2012", "--seed", "5",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["files"] == 23
+
+    out_dir = str(tmp_path / "out")
+    assert main([
+        "segment", stack_dir,
+        "--index", "nbr", "--ftv", "ndvi,tcw",
+        "--tile-size", "32",
+        "--workdir", str(tmp_path / "work"), "--out-dir", out_dir,
+        "--max-segments", "4", "--vertex-count-overshoot", "2",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["summary"]["pixels"] == 48 * 48
+    for product in ("vertex_years", "ftv_ndvi", "ftv_tcw", "model_valid"):
+        assert os.path.exists(rep["outputs"][product])
+    valid, _, _ = read_geotiff(rep["outputs"]["model_valid"])
+    assert valid.shape == (48, 48)
+    assert 0.0 < valid.mean() <= 1.0
+
+    # rerun resumes: all tiles skipped, same outputs
+    assert main([
+        "segment", stack_dir,
+        "--index", "nbr", "--ftv", "ndvi,tcw",
+        "--tile-size", "32",
+        "--workdir", str(tmp_path / "work"), "--out-dir", out_dir,
+        "--max-segments", "4", "--vertex-count-overshoot", "2",
+    ]) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert rep2["summary"]["tiles_skipped_resume"] == rep["summary"]["tiles"]

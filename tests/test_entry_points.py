@@ -1,0 +1,65 @@
+"""Contract tests for the repo-root entry points (bench.py, __graft_entry__).
+
+The driver consumes both: bench.py must print exactly one JSON line with the
+agreed schema; entry() must be jittable single-chip; dryrun_multichip(n)
+must compile and run the fully-sharded step (here on the virtual 8-device
+CPU mesh the conftest provides).
+"""
+
+import json
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.fitted.shape == (args[1].shape[0], args[1].shape[1])
+    assert np.asarray(out.model_valid).mean() > 0.5
+
+
+@pytest.mark.parametrize("n", [2, 8])
+def test_dryrun_multichip(n):
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(n)  # asserts internally
+
+
+def test_dryrun_rejects_oversized_mesh():
+    import __graft_entry__ as g
+
+    with pytest.raises(RuntimeError, match="need 64 devices"):
+        g.dryrun_multichip(64)
+
+
+def test_bench_emits_single_json_line():
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "JAX_PLATFORMS": "cpu",
+            "LT_BENCH_PX": "64",
+            "LT_BENCH_YEARS": "12",
+            "LT_BENCH_REPS": "1",
+        },
+        cwd=".",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be one JSON line, got: {proc.stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "pixels/sec/chip"
+    assert rec["value"] > 0
+    # both fields are independently rounded (value to 0.1, ratio to 1e-4)
+    assert rec["vs_baseline"] == pytest.approx(rec["value"] / 10e6, abs=1.1e-4)

@@ -128,3 +128,17 @@ def test_summarize_sharded(mesh, batch):
     assert 0.0 <= s["no_fit_rate"] <= 1.0
     assert s["fit_rate"] + s["no_fit_rate"] == pytest.approx(1.0)
     assert s["fit_rate"] > 0.5  # strong synthetic disturbances mostly fit
+
+
+def test_summarize_excludes_padding(mesh, batch):
+    years, vals, mask = batch
+    v, m, n_real = pad_to_multiple(vals[:61], mask[:61], 8)
+    out = segment_pixels_sharded(years, v, m, mesh=mesh)
+    diluted = summarize_sharded(out)
+    s = summarize_sharded(out, n_real=n_real)
+    assert s["pixels"] == 61
+    assert s["fit_rate"] > diluted["fit_rate"]  # padding rows never fit
+    # real-pixel rate == the padded run's validity over the real rows
+    assert s["fit_rate"] == pytest.approx(
+        float(np.asarray(out.model_valid)[:61].mean())
+    )

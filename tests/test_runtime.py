@@ -97,7 +97,9 @@ def test_run_and_assemble(tmp_path, synth, rstack):
     mask = np.asarray(idx.qa_valid_mask(rstack.qa[:, :32, :32].reshape(len(rstack.years), -1).T)) & np.asarray(idx.sr_valid_mask(sr))
     series = np.asarray(idx.compute_index("nbr", sr))
     ref = jax_segment_pixels(rstack.years, series, mask, PARAMS)
-    got = fitted[:, :32, :32].reshape(len(rstack.years), -1).T
+    # rasters are written in natural NBR orientation; the kernel fits the
+    # disturbance-positive flip, so undo it for comparison
+    got = -fitted[:, :32, :32].reshape(len(rstack.years), -1).T
     # The fused-DN program and the two-step path are different XLA programs;
     # in float32 fusion differences can flip knife-edge argmax decisions on a
     # small fraction of pixels (ops/segment.py float32 tolerance contract).
@@ -160,6 +162,38 @@ def test_manifest_jsonl_structure(tmp_path, rstack):
     assert len(tiles) == 4
     for r in tiles:
         assert {"tile_id", "y0", "x0", "px_per_s", "no_fit_rate"} <= set(r)
+
+
+def test_output_rasters_natural_orientation(tmp_path, synth, rstack):
+    """Written products undo the disturbance-positive flip: healthy-forest
+    NBR fits read ≈ +0.7, and disturbance segments have negative magnitude."""
+    cfg = make_cfg(tmp_path, ftv_indices=("ndvi",))
+    run_stack(rstack, cfg)
+    paths = assemble_outputs(rstack, cfg)
+    valid, _, _ = read_geotiff(paths["model_valid"])
+    vfit, _, _ = read_geotiff(paths["vertex_fit_vals"])
+    mag, _, _ = read_geotiff(paths["seg_magnitude"])
+    nv, _, _ = read_geotiff(paths["n_vertices"])
+    fit = valid.astype(bool)
+    # first vertex fit value: natural NBR, overwhelmingly positive on forest
+    assert np.median(vfit[0][fit]) > 0.3
+    # disturbed fitted pixels: strongest segment magnitude is a *drop*
+    dist_fit = fit & (synth.truth_year >= 0)
+    strongest = np.take_along_axis(mag, np.abs(mag).argmax(axis=0)[None], axis=0)[0]
+    assert (strongest[dist_fit] < 0).mean() > 0.8
+    # FTV rasters also natural: NDVI fits positive on fitted forest pixels
+    ftv, _, _ = read_geotiff(paths["ftv_ndvi"])
+    assert np.median(ftv[:, fit]) > 0.2
+
+
+def test_crash_orphan_tmp_swept(tmp_path, rstack):
+    cfg = make_cfg(tmp_path)
+    run_stack(rstack, cfg)
+    orphan = os.path.join(cfg.workdir, "tile_00099.npz.tmp.npz")
+    with open(orphan, "wb") as f:
+        f.write(b"partial garbage")
+    run_stack(rstack, cfg)  # resume sweeps temp artifacts
+    assert not os.path.exists(orphan)
 
 
 def test_fingerprint_covers_write_fitted(rstack):
