@@ -56,6 +56,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.utils.profiling import (
+    SCOPE_ANGLE_CULL,
+    SCOPE_DESPIKE,
+    SCOPE_MODEL_FAMILY,
+    SCOPE_MODEL_SELECT,
+    SCOPE_VERTEX_SEARCH,
+)
 
 __all__ = ["SegOutputs", "segment_pixel", "jax_segment_pixels"]
 
@@ -406,7 +413,8 @@ def segment_pixel(
     enough = n_valid >= params.min_observations_needed
 
     # Stage 1 — despike
-    y = _despike(t, v, mask, n_valid, params)
+    with jax.named_scope(SCOPE_DESPIKE):
+        y = _despike(t, v, mask, n_valid, params)
     big = jnp.asarray(jnp.finfo(dtype).max, dtype)
     y_lo = jnp.min(jnp.where(mask, y, big))
     y_hi = jnp.max(jnp.where(mask, y, -big))
@@ -418,14 +426,16 @@ def segment_pixel(
     scale = (t_lo, t_hi, y_lo, y_hi)
 
     # Stage 2 — candidates + cull
-    vmask0 = mask & ((iota == first_v) | (iota == last_v))
-    vmask = _find_candidates(t, y, mask, vmask0, params)
-    vmask = lax.fori_loop(
-        0,
-        params.vertex_count_overshoot,
-        lambda _, vm: _remove_weakest(t, y, vm, scale, nc, nv),
-        vmask,
-    )
+    with jax.named_scope(SCOPE_VERTEX_SEARCH):
+        vmask0 = mask & ((iota == first_v) | (iota == last_v))
+        vmask = _find_candidates(t, y, mask, vmask0, params)
+    with jax.named_scope(SCOPE_ANGLE_CULL):
+        vmask = lax.fori_loop(
+            0,
+            params.vertex_count_overshoot,
+            lambda _, vm: _remove_weakest(t, y, vm, scale, nc, nv),
+            vmask,
+        )
 
     # Stage 4 — model family: record, then prune weakest and refit
     ss0 = jnp.sum(jnp.where(mask, (y - jnp.sum(jnp.where(mask, y, 0.0)) / jnp.maximum(n_valid, 1)) ** 2, 0.0))
@@ -437,16 +447,18 @@ def segment_pixel(
         vm_next = _remove_weakest(t, y, vm, scale, nv, 2)
         return vm_next, (vm, fitted, sse, p)
 
-    _, (vmasks, fitteds, sses, ps) = lax.scan(model_step, vmask, None, length=nm)
+    with jax.named_scope(SCOPE_MODEL_FAMILY):
+        _, (vmasks, fitteds, sses, ps) = lax.scan(model_step, vmask, None, length=nm)
 
     # Selection: most segments whose p is within best_model_proportion of best
-    p_best = jnp.min(ps)
-    qualify = ps <= p_best / params.best_model_proportion
-    chosen = jnp.argmax(qualify)  # first (= most segments) qualifying model
-    vmask_c = vmasks[chosen]
-    fitted_c = fitteds[chosen]
-    sse_c = sses[chosen]
-    p_c = ps[chosen]
+    with jax.named_scope(SCOPE_MODEL_SELECT):
+        p_best = jnp.min(ps)
+        qualify = ps <= p_best / params.best_model_proportion
+        chosen = jnp.argmax(qualify)  # first (= most segments) qualifying model
+        vmask_c = vmasks[chosen]
+        fitted_c = fitteds[chosen]
+        sse_c = sses[chosen]
+        p_c = ps[chosen]
 
     model_valid = enough & (y_range > 0.0) & (p_c <= params.p_val_threshold)
 

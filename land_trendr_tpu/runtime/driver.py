@@ -43,6 +43,7 @@ from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.tile import process_tile_dn
 from land_trendr_tpu.runtime.manifest import TileManifest, run_fingerprint
 from land_trendr_tpu.runtime.stack import RasterStack
+from land_trendr_tpu.utils.profiling import StageTimer
 
 __all__ = ["RunConfig", "TileSpec", "plan_tiles", "run_stack", "assemble_outputs"]
 
@@ -200,6 +201,7 @@ def run_stack(
     bands = idx.required_bands(cfg.index, cfg.ftv_indices)
 
     t_run = time.perf_counter()
+    timer = StageTimer()
     n_px = 0
     n_fit = 0
     skipped = 0
@@ -207,23 +209,25 @@ def run_stack(
         if t.tile_id in done:
             skipped += 1
             continue
-        dn, qa = _feed_tile(stack, t, tile_px, bands)
+        with timer.stage("feed"):
+            dn, qa = _feed_tile(stack, t, tile_px, bands)
         last_err: Exception | None = None
         for attempt in range(cfg.max_retries + 1):
             try:
                 t0 = time.perf_counter()
-                out = process_tile_dn(
-                    years,
-                    dn,
-                    qa,
-                    index=cfg.index,
-                    ftv_indices=cfg.ftv_indices,
-                    params=cfg.params,
-                    scale=cfg.scale,
-                    offset=cfg.offset,
-                    reject_bits=cfg.reject_bits,
-                )
-                jax.block_until_ready(out)
+                with timer.stage("compute"):
+                    out = process_tile_dn(
+                        years,
+                        dn,
+                        qa,
+                        index=cfg.index,
+                        ftv_indices=cfg.ftv_indices,
+                        params=cfg.params,
+                        scale=cfg.scale,
+                        offset=cfg.offset,
+                        reject_bits=cfg.reject_bits,
+                    )
+                    jax.block_until_ready(out)
                 dt = time.perf_counter() - t0
                 break
             except Exception as e:  # pragma: no cover - exercised via fault test
@@ -240,18 +244,19 @@ def run_stack(
                 f"tile {t.tile_id} failed after {cfg.max_retries + 1} attempts"
             ) from last_err
 
-        arrays = _tile_arrays(out, t, cfg)
-        px = t.h * t.w
-        fit = int(arrays["model_valid"].sum())
-        meta = {
-            "y0": t.y0,
-            "x0": t.x0,
-            "h": t.h,
-            "w": t.w,
-            "px_per_s": round(tile_px / dt, 1),
-            "no_fit_rate": round(1.0 - fit / px, 4),
-        }
-        manifest.record(t.tile_id, arrays, meta)
+        with timer.stage("write"):
+            arrays = _tile_arrays(out, t, cfg)
+            px = t.h * t.w
+            fit = int(arrays["model_valid"].sum())
+            meta = {
+                "y0": t.y0,
+                "x0": t.x0,
+                "h": t.h,
+                "w": t.w,
+                "px_per_s": round(tile_px / dt, 1),
+                "no_fit_rate": round(1.0 - fit / px, 4),
+            }
+            manifest.record(t.tile_id, arrays, meta)
         n_px += px
         n_fit += fit
         log.info(
@@ -268,6 +273,7 @@ def run_stack(
         "fit_rate": (n_fit / n_px) if n_px else 0.0,
         "wall_s": round(wall, 3),
         "px_per_s": round(n_px / wall, 1) if n_px else 0.0,
+        "stage_s": timer.summary(),
         "fingerprint": manifest.fingerprint,
     }
     log.info("run complete: %s", summary)

@@ -1,0 +1,146 @@
+"""Tracing and profiling harness (SURVEY.md §5 "Tracing/profiling").
+
+The reference's only observability is Hadoop job counters and task logs;
+the TPU-native answer is device-level traces plus stage attribution:
+
+* the segmentation kernel's stages are wrapped in ``jax.named_scope``
+  (``lt_despike``, ``lt_vertex_search``, ``lt_angle_cull``,
+  ``lt_model_family``, ``lt_model_select`` — :mod:`land_trendr_tpu.ops.
+  segment`), so compiled-HLO op metadata and profiler timelines attribute
+  time to algorithm stages, not fused-op soup;
+* :func:`trace` wraps ``jax.profiler.trace`` — the resulting logdir opens
+  in TensorBoard's profile plugin or Perfetto;
+* :func:`profile_op` is the one-call version: warm up (compile), then
+  trace N steady-state iterations;
+* :class:`StageTimer` is the host-side complement for driver-loop phases —
+  the runtime driver wraps feed / compute / write with it and merges the
+  totals into its run summary (``stage_s`` key), where a device trace
+  can't see Python time.
+
+Nothing here is TPU-only; the same calls profile the CPU backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+
+__all__ = ["trace", "profile_op", "StageTimer", "STAGE_SCOPES"]
+
+#: named_scope labels emitted by the segmentation kernel, in pipeline order.
+#: Single source of truth — :mod:`land_trendr_tpu.ops.segment` imports these.
+SCOPE_DESPIKE = "lt_despike"
+SCOPE_VERTEX_SEARCH = "lt_vertex_search"
+SCOPE_ANGLE_CULL = "lt_angle_cull"
+SCOPE_MODEL_FAMILY = "lt_model_family"
+SCOPE_MODEL_SELECT = "lt_model_select"
+STAGE_SCOPES = (
+    SCOPE_DESPIKE,
+    SCOPE_VERTEX_SEARCH,
+    SCOPE_ANGLE_CULL,
+    SCOPE_MODEL_FAMILY,
+    SCOPE_MODEL_SELECT,
+)
+
+
+@contextlib.contextmanager
+def trace(
+    logdir: str, *, perfetto: bool = False, perfetto_link: bool = False
+) -> Iterator[str]:
+    """Capture a device+host profiler trace under ``logdir``.
+
+    Thin wrapper over ``jax.profiler.trace`` that creates the directory and
+    yields its path; view with ``tensorboard --logdir <logdir>`` (profile
+    plugin).  ``perfetto=True`` additionally writes a ``*.perfetto-trace``
+    file loadable in ui.perfetto.dev; ``perfetto_link=True`` also blocks at
+    exit printing a clickable link (interactive use only).
+    """
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(
+        logdir,
+        create_perfetto_trace=perfetto or perfetto_link,
+        create_perfetto_link=perfetto_link,
+    ):
+        yield logdir
+
+
+def profile_op(
+    fn: Callable[..., Any],
+    *args: Any,
+    logdir: str,
+    iters: int = 3,
+    **kwargs: Any,
+) -> dict[str, float]:
+    """Warm up ``fn`` (one untraced call — compilation stays out of the
+    trace), then trace ``iters`` steady-state calls.
+
+    Returns ``{"wall_s_per_iter": ..., "logdir_bytes": ...}`` so callers can
+    sanity-check that the trace actually captured something;
+    ``logdir_bytes`` counts only bytes written by *this* trace (a reused
+    logdir's stale files are excluded).
+    """
+
+    def _tree_bytes() -> int:
+        return sum(
+            os.path.getsize(os.path.join(root, f))
+            for root, _, files in os.walk(logdir)
+            for f in files
+        )
+
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    before = _tree_bytes() if os.path.isdir(logdir) else 0
+    with trace(logdir):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    return {
+        "wall_s_per_iter": dt / iters,
+        "logdir_bytes": float(_tree_bytes() - before),
+    }
+
+
+class StageTimer:
+    """Accumulating wall-clock timer for host-side driver phases.
+
+    The runtime driver wraps its feed / compute / write phases so the run
+    summary reports where host time went — the host-side complement to the
+    device trace (device kernels show up there, Python/NumPy time here).
+
+    >>> timer = StageTimer()
+    >>> with timer.stage("feed"):
+    ...     pass
+    >>> timer.totals()["feed"] >= 0.0
+    True
+    """
+
+    def __init__(self) -> None:
+        self._acc: dict[str, float] = {}
+        self._n: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._acc[name] = self._acc.get(name, 0.0) + dt
+            self._n[name] = self._n.get(name, 0) + 1
+
+    def totals(self) -> dict[str, float]:
+        """Stage → accumulated seconds."""
+        return dict(self._acc)
+
+    def counts(self) -> dict[str, int]:
+        return dict(self._n)
+
+    def summary(self) -> dict[str, float]:
+        """Flat ``{stage}_s`` dict, rounded — ready to merge into run logs."""
+        return {f"{k}_s": round(v, 4) for k, v in self._acc.items()}
