@@ -8,6 +8,13 @@ from land_trendr_tpu.parallel.mesh import (
     shard_pixels,
     summarize_sharded,
 )
+from land_trendr_tpu.parallel.multihost import (
+    feed_global,
+    gather_local_rows,
+    host_share,
+    init_distributed,
+    is_primary_host,
+)
 
 __all__ = [
     "PIXEL_AXIS",
@@ -16,4 +23,9 @@ __all__ = [
     "segment_pixels_sharded",
     "shard_pixels",
     "summarize_sharded",
+    "feed_global",
+    "gather_local_rows",
+    "host_share",
+    "init_distributed",
+    "is_primary_host",
 ]

@@ -1,0 +1,142 @@
+"""Multi-host (pod-scale) input feeding over DCN.
+
+The reference scales out through Hadoop: the driver ships per-pixel records
+to map tasks over the cluster network and collects them through the shuffle
+(SURVEY.md §2 L4, §4 call stack (1)).  The TPU-native equivalent keeps the
+*same* host-side data distribution idea — each host feeds only its own
+slice of the scene — but the "shuffle" disappears: every host places its
+local pixel block directly into a globally-sharded ``jax.Array``, the SPMD
+program runs with **zero device-side cross-host traffic** (no cross-pixel
+collectives — BASELINE north star), and results come back per-host from
+each host's addressable shards.  DCN carries only coordination and each
+host's input reads; ICI carries nothing but the optional metrics ``psum``
+(SURVEY.md §5 "Distributed communication backend").
+
+The v5e-256 scale-out config (BASELINE configs[5]) maps to:
+
+* one process per host, ``init_distributed`` before any device use;
+* a 1-D global mesh over all chips in the pod (``make_mesh()`` — device
+  order follows ``jax.devices()``, so each host's addressable chips own a
+  contiguous block of the pixel axis);
+* the driver calls :func:`host_share` to learn which tiles it feeds, then
+  :func:`feed_global` to assemble the global batch from its local rows.
+
+Everything here degrades to single-process: ``init_distributed`` is a
+no-op without a coordinator, and ``feed_global`` on one process is just
+``device_put`` with a sharding.  Tests exercise the same code path on the
+virtual 8-device CPU mesh (one process owning all shards — exactly how a
+single-host multi-chip machine runs it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence, TypeVar
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from land_trendr_tpu.parallel.mesh import PIXEL_AXIS
+
+__all__ = [
+    "init_distributed",
+    "is_primary_host",
+    "host_share",
+    "feed_global",
+    "gather_local_rows",
+]
+
+_T = TypeVar("_T")
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialise ``jax.distributed`` when running multi-process.
+
+    Call once per process before touching any device.  Explicit arguments
+    win; with none, ``jax.distributed.initialize()`` runs its cluster
+    auto-detection (TPU pod metadata, GKE, SLURM, ``JAX_COORDINATOR_*``
+    env vars) — so a pod driver calls this with no args.  Returns True when
+    distributed mode came up; when no cluster is detected *and* nothing was
+    requested explicitly, returns False (the single-process no-op), keeping
+    the same call portable from laptop CPU to pod.  An explicitly-requested
+    coordinator that fails to connect still raises.
+    """
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS") is not None
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception:
+        if explicit:
+            raise
+        return False  # no cluster detected → single-process mode
+    return True
+
+
+def is_primary_host() -> bool:
+    """True on the process that should write manifests / assemble rasters."""
+    return jax.process_index() == 0
+
+
+def host_share(items: Sequence[_T]) -> list[_T]:
+    """The contiguous slice of ``items`` (e.g. tile specs) this host feeds.
+
+    Hosts take near-equal contiguous blocks in process order — contiguous so
+    a host's input reads stay sequential on its local storage view.  The
+    remainder spreads one-per-host from process 0 (``np.array_split``
+    semantics, computed with plain slicing so items pass through untouched).
+    """
+    n, i = jax.process_count(), jax.process_index()
+    q, r = divmod(len(items), n)
+    start = i * q + min(i, r)
+    stop = start + q + (1 if i < r else 0)
+    return list(items[start:stop])
+
+
+def feed_global(
+    mesh: Mesh,
+    local_values: np.ndarray,
+    local_mask: np.ndarray,
+) -> tuple[jax.Array, jax.Array]:
+    """Assemble globally-sharded ``(PX_global, NY)`` arrays from this host's
+    local pixel rows.
+
+    ``local_values``/``local_mask`` are the rows for *this host's* pixels
+    only (``PX_global = PX_local × process_count``; every host must pass the
+    same local row count — pad with fully-masked rows via
+    ``pad_to_multiple`` first).  Each host's rows land on its own
+    addressable devices — the placement is pure host→local-device transfer,
+    nothing crosses DCN.
+    """
+    sharding = NamedSharding(mesh, P(PIXEL_AXIS, None))
+    vals = jax.make_array_from_process_local_data(sharding, local_values)
+    mask = jax.make_array_from_process_local_data(sharding, local_mask)
+    return vals, mask
+
+
+def gather_local_rows(out: jax.Array) -> np.ndarray:
+    """This host's rows of a pixel-sharded output, as one NumPy block.
+
+    The inverse of :func:`feed_global`: concatenates the host's addressable
+    shards in pixel order (shard index = row order on a 1-D mesh).  Each
+    host persists its own rows (per-host manifests); no host ever
+    materialises the global array, so result collection scales like the
+    reference's distributed output writes rather than a single-point
+    gather.
+    """
+    shards = sorted(
+        out.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

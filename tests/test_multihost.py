@@ -1,0 +1,125 @@
+"""Multi-host feeding path (SURVEY.md §5 distributed backend).
+
+Single-process here, but the *same* code path a pod runs: a process feeds
+its local pixel rows into a globally-sharded array, the SPMD program runs,
+and the process reads back exactly its addressable rows.  On the virtual
+8-device CPU mesh this process owns every shard, which is how a one-host
+multi-chip machine runs in production too.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+from land_trendr_tpu.parallel import (
+    feed_global,
+    gather_local_rows,
+    host_share,
+    init_distributed,
+    is_primary_host,
+    make_mesh,
+    pad_to_multiple,
+)
+
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+def _series(rng, px, ny=24):
+    years = np.arange(1990, 1990 + ny, dtype=np.int32)
+    t = np.arange(ny, dtype=np.float64)[None, :]
+    d = rng.integers(5, ny - 5, size=(px, 1))
+    vals = 0.6 - np.where(t >= d, 0.3, 0.0) + rng.normal(0, 0.01, (px, ny))
+    mask = rng.uniform(size=(px, ny)) > 0.1
+    return years, -vals, mask
+
+
+def test_init_distributed_single_process_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert init_distributed() is False  # no coordinator → no-op
+    assert is_primary_host()
+
+
+def test_host_share_partitions_in_order():
+    tiles = list(range(10))
+    share = host_share(tiles)
+    # single process → the whole list, order preserved
+    assert share == tiles
+
+
+def test_host_share_preserves_item_types():
+    """Tuple items (e.g. (y0, x0) tile coords) come back as the same hashable
+    tuples, usable as dict/set keys."""
+    tiles = [(0, 0), (0, 1), (1, 0)]
+    share = host_share(tiles)
+    assert share == tiles
+    assert all(isinstance(t, tuple) for t in share)
+    assert set(share) == set(tiles)  # hashable
+
+
+def test_feed_global_places_local_rows(rng):
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    years, vals, mask = _series(rng, px=2 * n_dev)
+    gvals, gmask = feed_global(mesh, vals, mask)
+    assert gvals.shape == vals.shape
+    assert gvals.sharding.is_fully_addressable
+    np.testing.assert_array_equal(np.asarray(gvals), vals)
+    np.testing.assert_array_equal(np.asarray(gmask), mask)
+    # pixel axis is actually sharded: each device holds px/n_dev rows
+    shard_rows = {s.data.shape[0] for s in gvals.addressable_shards}
+    assert shard_rows == {vals.shape[0] // n_dev}
+
+
+def test_multihost_feed_matches_unsharded(rng):
+    """Segmentation through the multi-host feed path matches the plain
+    single-device call: every discrete decision (vertices, model choice) and
+    the fitted trajectories are identical; only ``betainc``'s far-tail p
+    values (1e-15-scale, decision-irrelevant) may wobble with XLA's
+    partition-dependent fusion choices."""
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    years, vals, mask = _series(rng, px=3 * n_dev - 1)
+    vals_p, mask_p, n_real = pad_to_multiple(vals, mask, n_dev)
+    gvals, gmask = feed_global(mesh, vals_p, mask_p)
+    out_sh = jax_segment_pixels(years, gvals, gmask, PARAMS)
+    out_ref = jax_segment_pixels(years, vals_p, mask_p, PARAMS)
+    for field in (
+        "n_vertices", "vertex_indices", "vertex_years", "model_valid",
+        "fitted", "despiked", "seg_duration",
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_sh, field)),
+            np.asarray(getattr(out_ref, field)),
+            err_msg=field,
+        )
+    np.testing.assert_allclose(
+        np.asarray(out_sh.rmse), np.asarray(out_ref.rmse), rtol=1e-9
+    )
+    # p-of-F agrees at the decision level (same pixels pass the threshold)
+    np.testing.assert_array_equal(
+        np.asarray(out_sh.p_of_f) <= PARAMS.p_val_threshold,
+        np.asarray(out_ref.p_of_f) <= PARAMS.p_val_threshold,
+    )
+
+
+def test_gather_local_rows_roundtrip(rng):
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    years, vals, mask = _series(rng, px=2 * n_dev)
+    gvals, gmask = feed_global(mesh, vals, mask)
+    out = jax_segment_pixels(years, gvals, gmask, PARAMS)
+    local = gather_local_rows(out.rmse)
+    # single process owns all shards → local rows == global rows, in order
+    np.testing.assert_array_equal(local, np.asarray(out.rmse))
+
+
+def test_feed_global_rejects_indivisible(rng):
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    if n_dev == 1:
+        pytest.skip("needs a multi-device mesh")
+    years, vals, mask = _series(rng, px=n_dev + 1)
+    with pytest.raises(ValueError):
+        feed_global(mesh, vals, mask)
