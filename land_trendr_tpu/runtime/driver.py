@@ -32,6 +32,7 @@ import dataclasses
 import logging
 import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 import jax
@@ -189,6 +190,29 @@ def run_stack(
 ) -> dict:
     """Segment a whole stack tile by tile; returns the run summary.
 
+    The tile loop is a depth-1 software pipeline over three resources that
+    would otherwise idle each other (SURVEY.md §7 step 4 "host
+    prefetch/double-buffering"): JAX dispatch is asynchronous, so tile
+    ``i``'s device program runs while the host slices tile ``i+1``'s input
+    (feed) and a single background writer thread compresses and persists
+    tile ``i-1``'s artifacts.  ``block_until_ready`` on tile ``i`` happens
+    only after tile ``i+1`` has been fed and dispatched.  The write queue
+    has depth 1 (each tile's write is collected before the next is
+    submitted — backpressure and fail-fast for writer errors), so at most
+    three tiles are live at once and host memory stays bounded.
+
+    A tile that fails — at dispatch or when its result is awaited — is
+    retried synchronously up to ``max_retries`` times before the run
+    aborts; the writer thread's errors surface at the end of the run.
+
+    Throughput note: with the input already device-resident the kernel runs
+    at hundreds of M px/s/chip (bench.py); end-to-end the driver is bound
+    by host→HBM feeding of ~6 B/pixel-year (two int16 bands + QA for NBR —
+    SURVEY.md §7 hard-part 4), i.e. ~2.4 GB/s per chip at the 10M px/s
+    target, within PCIe-class bandwidth.  ``stage_s`` in the summary shows
+    where a given run actually spent host time (``compute_s`` includes
+    waiting out transfers on bandwidth-limited links).
+
     Raster outputs are *not* written here — call :func:`assemble_outputs`
     after (or on a later resume; assembly only needs the workdir).
     """
@@ -199,24 +223,17 @@ def run_stack(
     done = manifest.open(cfg.resume)
     years = stack.years.astype(np.int32)
     bands = idx.required_bands(cfg.index, cfg.ftv_indices)
+    todo = [t for t in tiles if t.tile_id not in done]
 
     t_run = time.perf_counter()
     timer = StageTimer()
-    n_px = 0
-    n_fit = 0
-    skipped = 0
-    for t in tiles:
-        if t.tile_id in done:
-            skipped += 1
-            continue
-        with timer.stage("feed"):
-            dn, qa = _feed_tile(stack, t, tile_px, bands)
-        last_err: Exception | None = None
-        for attempt in range(cfg.max_retries + 1):
-            try:
-                t0 = time.perf_counter()
-                with timer.stage("compute"):
-                    out = process_tile_dn(
+
+    def _dispatch(dn, qa):
+        """Async-dispatch one tile; returns ``(out, None)`` or ``(None, exc)``."""
+        try:
+            with timer.stage("dispatch"):
+                return (
+                    process_tile_dn(
                         years,
                         dn,
                         qa,
@@ -226,24 +243,16 @@ def run_stack(
                         scale=cfg.scale,
                         offset=cfg.offset,
                         reject_bits=cfg.reject_bits,
-                    )
-                    jax.block_until_ready(out)
-                dt = time.perf_counter() - t0
-                break
-            except Exception as e:  # pragma: no cover - exercised via fault test
-                last_err = e
-                log.warning(
-                    "tile %d attempt %d/%d failed: %s",
-                    t.tile_id,
-                    attempt + 1,
-                    cfg.max_retries + 1,
-                    e,
+                    ),
+                    None,
                 )
-        else:
-            raise RuntimeError(
-                f"tile {t.tile_id} failed after {cfg.max_retries + 1} attempts"
-            ) from last_err
+        except Exception as e:  # exercised via fault-injection tests
+            return None, e
 
+    def _write_job(t: TileSpec, out, dt: float) -> tuple[int, int]:
+        # "write" accumulates from the writer thread only; every other stage
+        # name is main-thread-only, so StageTimer's per-key accumulation
+        # never races.
         with timer.stage("write"):
             arrays = _tile_arrays(out, t, cfg)
             px = t.h * t.w
@@ -253,22 +262,95 @@ def run_stack(
                 "x0": t.x0,
                 "h": t.h,
                 "w": t.w,
+                # dispatch + result-wait wall time: device compute + any
+                # transfer stalls; host work overlapped by the pipeline is
+                # excluded (an estimate, not a device-profile number)
                 "px_per_s": round(tile_px / dt, 1),
                 "no_fit_rate": round(1.0 - fit / px, 4),
             }
             manifest.record(t.tile_id, arrays, meta)
-        n_px += px
-        n_fit += fit
         log.info(
             "tile %d (%d,%d %dx%d): %.2fM px/s, no-fit %.1f%%",
             t.tile_id, t.y0, t.x0, t.h, t.w,
             meta["px_per_s"] / 1e6, 100 * meta["no_fit_rate"],
         )
+        return px, fit
+
+    writer = ThreadPoolExecutor(max_workers=1, thread_name_prefix="lt-writer")
+    prev_write = None  # depth-1 write queue: at most one job queued or running
+    n_px = 0
+    n_fit = 0
+
+    def _collect_write(fut) -> None:
+        """Backpressure + fail-fast: re-raises writer errors at the next tile."""
+        nonlocal n_px, n_fit
+        px, fit = fut.result()
+        n_px += px
+        n_fit += fit
+
+    def _finish(pending) -> None:
+        """Await one in-flight tile (retrying on failure) and queue its write."""
+        nonlocal prev_write
+        t, out, err, dn, qa, dt_dispatch = pending
+        attempt = 1
+        while True:
+            if err is None:
+                try:
+                    t0 = time.perf_counter()
+                    with timer.stage("compute"):
+                        jax.block_until_ready(out)
+                    dt = dt_dispatch + (time.perf_counter() - t0)
+                    break
+                except Exception as e:  # device-side failure surfaces here
+                    err = e
+            log.warning(
+                "tile %d attempt %d/%d failed: %s",
+                t.tile_id, attempt, cfg.max_retries + 1, err,
+            )
+            if attempt > cfg.max_retries:
+                raise RuntimeError(
+                    f"tile {t.tile_id} failed after {attempt} attempts"
+                ) from err
+            attempt += 1
+            t0 = time.perf_counter()
+            out, err = _dispatch(dn, qa)
+            dt_dispatch = time.perf_counter() - t0
+        if prev_write is not None:
+            _collect_write(prev_write)
+        prev_write = writer.submit(_write_job, t, out, dt)
+
+    try:
+        pending = None
+        for t in todo:
+            with timer.stage("feed"):
+                dn, qa = _feed_tile(stack, t, tile_px, bands)
+            t0 = time.perf_counter()
+            out, err = _dispatch(dn, qa)
+            dt_dispatch = time.perf_counter() - t0
+            if pending is not None:
+                _finish(pending)
+                pending = None
+            if err is not None:
+                # synchronous dispatch failure: resolve (retry or abort) now
+                # rather than dispatching further tiles behind a known fault
+                _finish((t, out, err, dn, qa, dt_dispatch))
+            else:
+                pending = (t, out, err, dn, qa, dt_dispatch)
+        if pending is not None:
+            _finish(pending)
+        if prev_write is not None:
+            _collect_write(prev_write)
+            prev_write = None
+    finally:
+        writer.shutdown(wait=True)
+        if prev_write is not None and (exc := prev_write.exception()):
+            # a compute abort is already propagating; surface, don't mask
+            log.error("tile write also failed during abort: %s", exc)
 
     wall = time.perf_counter() - t_run
     summary = {
         "tiles": len(tiles),
-        "tiles_skipped_resume": skipped,
+        "tiles_skipped_resume": len(tiles) - len(todo),
         "pixels": n_px,
         "fit_rate": (n_fit / n_px) if n_px else 0.0,
         "wall_s": round(wall, 3),

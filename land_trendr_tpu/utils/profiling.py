@@ -112,6 +112,8 @@ class StageTimer:
     The runtime driver wraps its feed / compute / write phases so the run
     summary reports where host time went — the host-side complement to the
     device trace (device kernels show up there, Python/NumPy time here).
+    Safe across threads as long as each stage *name* is only ever updated
+    from one thread (per-key read-modify-write is not locked).
 
     >>> timer = StageTimer()
     >>> with timer.stage("feed"):

@@ -276,3 +276,32 @@ def test_retry_recovers_from_transient_fault(tmp_path, rstack, monkeypatch):
     monkeypatch.setattr("land_trendr_tpu.runtime.driver.process_tile_dn", flaky)
     summary = run_stack(rstack, cfg)
     assert summary["pixels"] == 40 * 48
+
+
+def test_writer_failure_fails_fast(tmp_path, rstack, monkeypatch):
+    """A persistent artifact-write failure aborts within a couple of tiles
+    (depth-1 write queue backpressure), not at the end of the whole run."""
+    from land_trendr_tpu.runtime.manifest import TileManifest
+
+    cfg = make_cfg(tmp_path)
+    computed = {"n": 0}
+
+    def bad_record(self, tile_id, arrays, meta):
+        raise OSError("disk full (injected)")
+
+    monkeypatch.setattr(TileManifest, "record", bad_record)
+
+    from land_trendr_tpu.ops.tile import process_tile_dn as real_op
+
+    def counting_op(*a, **k):
+        computed["n"] += 1
+        return real_op(*a, **k)
+
+    monkeypatch.setattr(
+        "land_trendr_tpu.runtime.driver.process_tile_dn", counting_op
+    )
+    with pytest.raises(OSError, match="disk full"):
+        run_stack(rstack, cfg)
+    # 4-tile run: failure of tile 0's write surfaces while tile 1/2 are in
+    # flight — well before all tiles are computed
+    assert computed["n"] <= 3
