@@ -29,6 +29,8 @@ from typing import BinaryIO, Mapping
 
 import numpy as np
 
+from land_trendr_tpu.io import native
+
 __all__ = ["GeoMeta", "TiffInfo", "read_geotiff", "write_geotiff"]
 
 # -- TIFF tag ids -----------------------------------------------------------
@@ -208,26 +210,70 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
         planar = tags.get(_T_PLANAR_CONFIG, (1,))[0]
         tiled = _T_TILE_OFFSETS in tags
 
+        planes = spp if planar == 2 else 1
+        chunk_spp = 1 if planar == 2 else spp
+        out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
         if tiled:
             tw = tags[_T_TILE_WIDTH][0]
             th = tags[_T_TILE_LENGTH][0]
             offsets = tags[_T_TILE_OFFSETS]
             counts = tags[_T_TILE_BYTE_COUNTS]
+            blk_rows, blk_w = th, tw
+        else:
+            rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
+            offsets = tags[_T_STRIP_OFFSETS]
+            counts = tags[_T_STRIP_BYTE_COUNTS]
+            # clamp: RowsPerStrip may legally exceed height (e.g. 2^32-1 =
+            # "everything in one strip"); the buffer needs only real rows
+            blk_rows, blk_w = min(rps, height), width
+
+        # Native fast path: fused inflate+unpredict across all blocks at
+        # once, threaded in C++ (native/lt_native.cc).  Any failure — or an
+        # unsupported layout — silently drops to the NumPy-per-block path,
+        # which is the behavioural reference.
+        nat_blocks = None
+        if (
+            native.available()
+            and bo == "<"
+            # predictor 2 is integer differencing; float files tagged with
+            # it (nonstandard) must keep NumPy's float-cumsum semantics
+            and (predictor == 1 or (predictor == 2 and dtype.kind in "iu"))
+        ):
+            f.seek(0)
+            try:
+                nat_blocks = native.decode_blocks(
+                    f.read(),
+                    np.asarray(offsets, dtype=np.uint64),
+                    np.asarray(counts, dtype=np.uint64),
+                    compression=compression,
+                    predictor=predictor,
+                    rows=blk_rows,
+                    width=blk_w,
+                    spp=chunk_spp,
+                    dtype=dtype.newbyteorder("="),
+                )
+            except native.NativeCodecError:
+                nat_blocks = None
+
+        def get_block(idx: int, rows_actual: int) -> np.ndarray:
+            """Decoded block idx as (rows_actual, blk_w, chunk_spp)."""
+            if nat_blocks is not None:
+                return nat_blocks[idx][:rows_actual]
+            raw = _block(f, offsets[idx], counts[idx], compression)
+            b = np.frombuffer(raw, dtype=dtype, count=rows_actual * blk_w * chunk_spp)
+            b = b.reshape(rows_actual, blk_w, chunk_spp).astype(
+                dtype.newbyteorder("="), copy=True
+            )
+            return _unpredict(b, predictor)
+
+        if tiled:
             tiles_x = (width + tw - 1) // tw
             tiles_y = (height + th - 1) // th
-            planes = spp if planar == 2 else 1
-            chunk_spp = 1 if planar == 2 else spp
-            out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
             idx = 0
             for p in range(planes):
                 for ty in range(tiles_y):
                     for tx in range(tiles_x):
-                        raw = _block(f, offsets[idx], counts[idx], compression)
-                        block = np.frombuffer(raw, dtype=dtype, count=th * tw * chunk_spp)
-                        block = block.reshape(th, tw, chunk_spp).astype(
-                            dtype.newbyteorder("="), copy=True
-                        )
-                        _unpredict(block, predictor)
+                        block = get_block(idx, th)  # file tiles are full-size
                         y0, x0 = ty * th, tx * tw
                         h = min(th, height - y0)
                         w = min(tw, width - x0)
@@ -239,24 +285,13 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
                             )
                         idx += 1
         else:
-            rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
-            offsets = tags[_T_STRIP_OFFSETS]
-            counts = tags[_T_STRIP_BYTE_COUNTS]
             strips = (height + rps - 1) // rps
-            planes = spp if planar == 2 else 1
-            chunk_spp = 1 if planar == 2 else spp
-            out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
             idx = 0
             for p in range(planes):
                 for s in range(strips):
                     y0 = s * rps
                     h = min(rps, height - y0)
-                    raw = _block(f, offsets[idx], counts[idx], compression)
-                    block = np.frombuffer(raw, dtype=dtype, count=h * width * chunk_spp)
-                    block = block.reshape(h, width, chunk_spp).astype(
-                        dtype.newbyteorder("="), copy=True
-                    )
-                    _unpredict(block, predictor)
+                    block = get_block(idx, h)
                     if planar == 2:
                         out[p, y0 : y0 + h] = block[:, :, 0]
                     else:
@@ -376,7 +411,7 @@ def write_geotiff(
     use_pred = bool(predictor) and comp_id != _COMP_NONE and fmt in (1, 2)
 
     chunky = np.moveaxis(arr, 0, -1)  # (H, W, S)
-    blocks: list[bytes] = []
+    block_arrays: list[np.ndarray] = []
     if tile:
         tw = th = int(tile)
         tiles_x = (width + tw - 1) // tw
@@ -388,13 +423,12 @@ def write_geotiff(
                 h = min(th, height - y0)
                 w = min(tw, width - x0)
                 full[:h, :w] = chunky[y0 : y0 + h, x0 : x0 + w]
-                blocks.append(_encode_block(full, comp_id, use_pred))
+                block_arrays.append(full)
     else:
         rps = 64
         for y0 in range(0, height, rps):
-            blocks.append(
-                _encode_block(chunky[y0 : y0 + rps], comp_id, use_pred)
-            )
+            block_arrays.append(np.ascontiguousarray(chunky[y0 : y0 + rps]))
+    blocks = _encode_all(block_arrays, comp_id, use_pred)
 
     data_off = 8  # blocks start right after the 8-byte header
     offsets: list[int] = []
@@ -459,3 +493,30 @@ def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:
     if comp_id == _COMP_NONE:
         return raw
     return zlib.compress(raw, 6)
+
+
+def _encode_all(
+    block_arrays: list[np.ndarray], comp_id: int, use_pred: bool
+) -> list[bytes]:
+    """Encode blocks via the native library when possible (equal-geometry
+    deflate blocks — always true for the tiled layout), else per-block NumPy.
+
+    Both paths produce byte-identical output: same zlib level, same
+    predictor arithmetic — the native path is acceleration only.
+    """
+    if (
+        native.available()
+        and comp_id != _COMP_NONE
+        and block_arrays
+        and len({b.shape for b in block_arrays}) == 1
+        and not (use_pred and block_arrays[0].dtype.itemsize == 8)
+    ):
+        try:
+            return native.encode_blocks(
+                np.stack(block_arrays),  # fresh stack → safe to mutate
+                predictor=2 if use_pred else 1,
+                in_place=True,
+            )
+        except native.NativeCodecError:
+            pass
+    return [_encode_block(b, comp_id, use_pred) for b in block_arrays]

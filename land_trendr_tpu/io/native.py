@@ -1,0 +1,194 @@
+"""ctypes binding for the native raster-codec library (native/lt_native.cc).
+
+The reference's raster layer is Python over GDAL's native C++ core
+(SURVEY.md §2 L1 / §3 "Native components"); this module is the rebuild's
+equivalent seam.  The GeoTIFF codec (:mod:`land_trendr_tpu.io.geotiff`)
+calls :func:`decode_blocks` / :func:`encode_blocks` when the shared
+library is present, getting fused inflate+unpredict (and predict+deflate)
+hot loops threaded across TIFF blocks; when it isn't — or when
+``LT_NO_NATIVE=1`` — the pure-NumPy path runs instead with identical
+results, so the native layer is a pure acceleration, never a behaviour
+fork.
+
+Search order for the library: ``LT_NATIVE_LIB`` env var, then
+``native/liblt_native.so`` relative to the repo root, then a copy next to
+this file.  Build with ``make -C native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+
+__all__ = [
+    "available",
+    "lib_path",
+    "decode_blocks",
+    "encode_blocks",
+    "NativeCodecError",
+]
+
+_ERR_NAMES = {
+    -1: "inflate failed (corrupt deflate stream?)",
+    -2: "deflate failed",
+    -3: "bad argument",
+    -4: "block data out of file bounds / short",
+}
+_ABI_VERSION = 1
+
+
+class NativeCodecError(RuntimeError):
+    """A native codec call returned an error code."""
+
+
+def _candidates() -> list[Path]:
+    out = []
+    env = os.environ.get("LT_NATIVE_LIB")
+    if env:
+        out.append(Path(env))
+    here = Path(__file__).resolve()
+    out.append(here.parents[2] / "native" / "liblt_native.so")
+    out.append(here.parent / "liblt_native.so")
+    return out
+
+
+def _load() -> tuple[ctypes.CDLL | None, str | None]:
+    if os.environ.get("LT_NO_NATIVE") == "1":
+        return None, None
+    if sys.byteorder != "little":  # codec assumes little-endian samples
+        return None, None
+    for p in _candidates():
+        if not p.is_file():
+            continue
+        try:
+            lib = ctypes.CDLL(str(p))
+            if lib.lt_native_abi_version() != _ABI_VERSION:
+                continue
+            _declare(lib)
+        except (OSError, AttributeError):
+            # unloadable, or a library without our symbols (wrong
+            # LT_NATIVE_LIB / stale pre-ABI build) — keep probing/fall back
+            continue
+        return lib, str(p)
+    return None, None
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.lt_decode_blocks.restype = ctypes.c_int
+    lib.lt_decode_blocks.argtypes = [
+        u8p, ctypes.c_uint64, u64p, u64p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
+    ]
+    lib.lt_encode_blocks.restype = ctypes.c_int
+    lib.lt_encode_blocks.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+        ctypes.c_uint64, u64p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.lt_deflate_bound.restype = ctypes.c_uint64
+    lib.lt_deflate_bound.argtypes = [ctypes.c_uint64]
+
+
+_LIB, _LIB_PATH = _load()
+
+
+def available() -> bool:
+    """True when the native library is loaded and usable."""
+    return _LIB is not None
+
+
+def lib_path() -> str | None:
+    return _LIB_PATH
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _u64(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def decode_blocks(
+    file_data: bytes | np.ndarray,
+    offsets: np.ndarray,
+    counts: np.ndarray,
+    *,
+    compression: int,
+    predictor: int,
+    rows: int,
+    width: int,
+    spp: int,
+    dtype: np.dtype,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Decode TIFF blocks → ``(n_blocks, rows, width, spp)`` native-endian.
+
+    ``file_data`` is the whole file image; ``offsets``/``counts`` the block
+    byte ranges from the IFD.  Raises :class:`NativeCodecError` on any
+    per-block failure (caller falls back to the NumPy path).
+    """
+    assert _LIB is not None
+    dtype = np.dtype(dtype)
+    if predictor == 2 and dtype.kind not in "iu":
+        raise NativeCodecError("predictor 2 requires an integer dtype")
+    buf = np.frombuffer(file_data, dtype=np.uint8)
+    offs = np.ascontiguousarray(offsets, dtype=np.uint64)
+    cnts = np.ascontiguousarray(counts, dtype=np.uint64)
+    n = len(offs)
+    # zeros, not empty: a short last strip legally fills only its real rows
+    out = np.zeros((n, rows, width, spp), dtype=dtype)
+    rc = _LIB.lt_decode_blocks(
+        _u8(buf), ctypes.c_uint64(buf.size), _u64(offs), _u64(cnts),
+        n, compression, predictor, rows, width, spp, dtype.itemsize,
+        _u8(out.view(np.uint8).reshape(-1)), n_threads,
+    )
+    if rc != 0:
+        raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))
+    return out
+
+
+def encode_blocks(
+    blocks: np.ndarray,
+    *,
+    predictor: int,
+    level: int = 6,
+    n_threads: int = 0,
+    in_place: bool = False,
+) -> list[bytes]:
+    """Deflate-encode ``(n_blocks, rows, width, spp)`` blocks → bytes list.
+
+    Applies TIFF predictor 2 first when ``predictor == 2`` — the native
+    differencing mutates its input buffer, so the input is copied unless
+    ``in_place=True`` (pass it when the stack is a throwaway, as the
+    GeoTIFF writer does).  Without the predictor the input is never
+    written to.
+    """
+    assert _LIB is not None
+    blocks = np.ascontiguousarray(blocks)
+    if predictor == 2 and blocks.dtype.kind not in "iu":
+        raise NativeCodecError("predictor 2 requires an integer dtype")
+    n, rows, width, spp = blocks.shape
+    block_bytes = rows * width * spp * blocks.dtype.itemsize
+    bound = int(_LIB.lt_deflate_bound(ctypes.c_uint64(block_bytes)))
+    scratch = blocks if (in_place or predictor != 2) else blocks.copy()
+    scratch = scratch.view(np.uint8).reshape(-1)
+    out = np.empty(n * bound, dtype=np.uint8)
+    sizes = np.zeros(n, dtype=np.uint64)
+    rc = _LIB.lt_encode_blocks(
+        _u8(scratch), n, predictor, rows, width, spp,
+        blocks.dtype.itemsize, _u8(out), ctypes.c_uint64(bound),
+        _u64(sizes), level, n_threads,
+    )
+    if rc != 0:
+        raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))
+    return [
+        out[i * bound : i * bound + int(sizes[i])].tobytes() for i in range(n)
+    ]

@@ -1,0 +1,231 @@
+// lt_native — native raster-codec hot loops for land_trendr_tpu.
+//
+// The reference's raster layer leans on GDAL's C++ core under Python
+// bindings (SURVEY.md §2 L1, §3 "Native components": the only native code
+// in the reference stack is third-party GDAL + the Hadoop JVM).  This
+// library is the rebuild's equivalent native layer: the GeoTIFF codec's
+// per-block hot loops — inflate + horizontal-predictor undo on decode,
+// predictor apply + deflate on encode — fused in C++ and threaded across
+// blocks, behind a C ABI consumed via ctypes (land_trendr_tpu/io/native.py).
+// The pure-NumPy path in io/geotiff.py remains the behavioural reference
+// and the fallback when this library isn't built.
+//
+// Threading: blocks are independent (same unit of work the TIFF format
+// defines), pulled off an atomic counter by a small thread pool.  On the
+// CONUS-scale ingest path (SURVEY.md §7 hard-part 4) decode bandwidth is
+// what keeps the host ahead of the TPU's ~2.4 GB/s/chip appetite.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC, links zlib + pthread)
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr int kCompNone = 1;
+constexpr int kCompDeflateAdobe = 8;
+constexpr int kCompDeflateOld = 32946;
+
+constexpr int kOk = 0;
+constexpr int kErrInflate = -1;
+constexpr int kErrDeflate = -2;
+constexpr int kErrBadArg = -3;
+constexpr int kErrShortData = -4;
+
+// Inflate `src` into exactly `dst_len` bytes of `dst`.  TIFF deflate blocks
+// are zlib streams in practice, but raw-deflate files exist (old code 32946
+// writers) — retry headerless on a header error, mirroring the Python
+// codec's zlib.decompress fallback.
+int inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
+                  size_t dst_len) {
+  for (int window : {MAX_WBITS, -MAX_WBITS}) {
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, window) != Z_OK) return kErrInflate;
+    zs.next_in = const_cast<Bytef*>(src);
+    zs.avail_in = static_cast<uInt>(src_len);
+    zs.next_out = dst;
+    zs.avail_out = static_cast<uInt>(dst_len);
+    int rc = inflate(&zs, Z_FINISH);
+    inflateEnd(&zs);
+    if (rc == Z_STREAM_END || (rc == Z_OK && zs.avail_out == 0)) return kOk;
+    // only fall through to raw-deflate on an immediate header rejection
+    if (window == MAX_WBITS && rc == Z_DATA_ERROR && zs.total_in < 2) continue;
+    return kErrInflate;
+  }
+  return kErrInflate;
+}
+
+// Undo TIFF predictor 2 (horizontal differencing): within each row, each
+// pixel's sample accumulates the previous pixel's same sample.  Arithmetic
+// is modular in the sample width — unsigned of matching width reproduces
+// NumPy's wrapping cumsum for both signed and unsigned dtypes.
+template <typename T>
+void unpredict_rows(uint8_t* data, int rows, int width, int spp) {
+  for (int r = 0; r < rows; ++r) {
+    T* row = reinterpret_cast<T*>(data) + static_cast<size_t>(r) * width * spp;
+    for (int x = 1; x < width; ++x)
+      for (int s = 0; s < spp; ++s)
+        row[x * spp + s] = static_cast<T>(row[x * spp + s] +
+                                          row[(x - 1) * spp + s]);
+  }
+}
+
+template <typename T>
+void predict_rows(uint8_t* data, int rows, int width, int spp) {
+  for (int r = 0; r < rows; ++r) {
+    T* row = reinterpret_cast<T*>(data) + static_cast<size_t>(r) * width * spp;
+    for (int x = width - 1; x >= 1; --x)
+      for (int s = 0; s < spp; ++s)
+        row[x * spp + s] = static_cast<T>(row[x * spp + s] -
+                                          row[(x - 1) * spp + s]);
+  }
+}
+
+void apply_predictor(uint8_t* data, int rows, int width, int spp,
+                     int elem_size, bool undo) {
+  switch (elem_size) {
+    case 1:
+      undo ? unpredict_rows<uint8_t>(data, rows, width, spp)
+           : predict_rows<uint8_t>(data, rows, width, spp);
+      break;
+    case 2:
+      undo ? unpredict_rows<uint16_t>(data, rows, width, spp)
+           : predict_rows<uint16_t>(data, rows, width, spp);
+      break;
+    case 4:
+      undo ? unpredict_rows<uint32_t>(data, rows, width, spp)
+           : predict_rows<uint32_t>(data, rows, width, spp);
+      break;
+  }
+}
+
+int pick_threads(int n_blocks, int n_threads) {
+  if (n_threads <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    n_threads = hc ? static_cast<int>(hc) : 1;
+  }
+  if (n_threads > n_blocks) n_threads = n_blocks;
+  return n_threads < 1 ? 1 : n_threads;
+}
+
+template <typename Fn>
+int run_blocks(int n_blocks, int n_threads, Fn&& per_block) {
+  n_threads = pick_threads(n_blocks, n_threads);
+  std::atomic<int> next{0};
+  std::atomic<int> status{kOk};
+  auto worker = [&]() {
+    int i;
+    while ((i = next.fetch_add(1)) < n_blocks) {
+      if (status.load(std::memory_order_relaxed) != kOk) return;
+      int rc = per_block(i);
+      if (rc != kOk) status.store(rc, std::memory_order_relaxed);
+    }
+  };
+  if (n_threads == 1) {
+    worker();
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+  }
+  return status.load();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ABI version — bump on any signature change; the ctypes binding checks it.
+int lt_native_abi_version() { return 1; }
+
+// Decode n_blocks TIFF blocks from a memory-mapped/loaded file image.
+//
+//   file_data/file_len  whole file bytes
+//   offsets/counts      per-block byte ranges (uint64, from the IFD)
+//   compression         TIFF tag 259 value (1, 8, or 32946)
+//   predictor           TIFF tag 317 value (1 or 2)
+//   rows/width/spp      decoded block geometry (rows*width*spp samples)
+//   elem_size           bytes per sample (1, 2, 4, or 8)
+//   out                 n_blocks contiguous decoded blocks, caller-allocated
+//   n_threads           0 = hardware concurrency
+//
+// Returns 0 or a negative error code.  Little-endian samples only (the
+// Python layer routes big-endian files to the NumPy path).
+int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
+                     const uint64_t* offsets, const uint64_t* counts,
+                     int n_blocks, int compression, int predictor, int rows,
+                     int width, int spp, int elem_size, uint8_t* out,
+                     int n_threads) {
+  if (n_blocks < 0 || rows <= 0 || width <= 0 || spp <= 0) return kErrBadArg;
+  if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
+    return kErrBadArg;
+  if (compression != kCompNone && compression != kCompDeflateAdobe &&
+      compression != kCompDeflateOld)
+    return kErrBadArg;
+  if (predictor == 2 && elem_size == 8) return kErrBadArg;  // floats only
+  const size_t block_bytes =
+      static_cast<size_t>(rows) * width * spp * elem_size;
+
+  return run_blocks(n_blocks, n_threads, [&](int i) -> int {
+    if (offsets[i] + counts[i] > file_len) return kErrShortData;
+    const uint8_t* src = file_data + offsets[i];
+    uint8_t* dst = out + static_cast<size_t>(i) * block_bytes;
+    if (compression == kCompNone) {
+      // short last strip is legal: the file stores only the real rows
+      size_t n = counts[i] < block_bytes ? counts[i] : block_bytes;
+      std::memcpy(dst, src, n);
+    } else {
+      int rc = inflate_block(src, counts[i], dst, block_bytes);
+      if (rc != kOk) return rc;
+    }
+    if (predictor == 2)
+      apply_predictor(dst, rows, width, spp, elem_size, /*undo=*/true);
+    return kOk;
+  });
+}
+
+// Encode n_blocks equal-geometry blocks with optional predictor + deflate.
+//
+//   blocks       n_blocks contiguous input blocks (modified in place when
+//                predictor=2 — pass a scratch copy)
+//   out          caller-allocated, n_blocks * bound bytes
+//   bound        per-block output capacity (>= lt_deflate_bound(block_bytes))
+//   out_sizes    per-block compressed byte counts (written)
+//   level        zlib level (6 matches the Python writer)
+int lt_encode_blocks(uint8_t* blocks, int n_blocks, int predictor, int rows,
+                     int width, int spp, int elem_size, uint8_t* out,
+                     uint64_t bound, uint64_t* out_sizes, int level,
+                     int n_threads) {
+  if (n_blocks < 0 || rows <= 0 || width <= 0 || spp <= 0) return kErrBadArg;
+  if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
+    return kErrBadArg;
+  if (predictor == 2 && elem_size == 8) return kErrBadArg;
+  const size_t block_bytes =
+      static_cast<size_t>(rows) * width * spp * elem_size;
+  if (bound < compressBound(static_cast<uLong>(block_bytes))) return kErrBadArg;
+
+  return run_blocks(n_blocks, n_threads, [&](int i) -> int {
+    uint8_t* src = blocks + static_cast<size_t>(i) * block_bytes;
+    if (predictor == 2)
+      apply_predictor(src, rows, width, spp, elem_size, /*undo=*/false);
+    uLongf dst_len = static_cast<uLongf>(bound);
+    int rc = compress2(out + static_cast<size_t>(i) * bound, &dst_len, src,
+                       static_cast<uLong>(block_bytes), level);
+    if (rc != Z_OK) return kErrDeflate;
+    out_sizes[i] = dst_len;
+    return kOk;
+  });
+}
+
+uint64_t lt_deflate_bound(uint64_t n) {
+  return compressBound(static_cast<uLong>(n));
+}
+
+}  // extern "C"
