@@ -239,6 +239,14 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             # it (nonstandard) must keep NumPy's float-cumsum semantics
             and (predictor == 1 or (predictor == 2 and dtype.kind in "iu"))
         ):
+            if tiled:
+                brows = np.full(len(offsets), blk_rows, dtype=np.uint64)
+            else:
+                n_strips = (height + rps - 1) // rps
+                per_plane = np.minimum(
+                    rps, height - rps * np.arange(n_strips, dtype=np.int64)
+                )
+                brows = np.tile(per_plane, planes).astype(np.uint64)
             f.seek(0)
             try:
                 nat_blocks = native.decode_blocks(
@@ -251,6 +259,7 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
                     width=blk_w,
                     spp=chunk_spp,
                     dtype=dtype.newbyteorder("="),
+                    block_rows=brows,
                 )
             except native.NativeCodecError:
                 nat_blocks = None
@@ -411,24 +420,28 @@ def write_geotiff(
     use_pred = bool(predictor) and comp_id != _COMP_NONE and fmt in (1, 2)
 
     chunky = np.moveaxis(arr, 0, -1)  # (H, W, S)
-    block_arrays: list[np.ndarray] = []
     if tile:
         tw = th = int(tile)
-        tiles_x = (width + tw - 1) // tw
-        tiles_y = (height + th - 1) // th
-        for ty in range(tiles_y):
-            for tx in range(tiles_x):
-                full = np.zeros((th, tw, spp), dtype=arr.dtype)
-                y0, x0 = ty * th, tx * tw
-                h = min(th, height - y0)
-                w = min(tw, width - x0)
-                full[:h, :w] = chunky[y0 : y0 + h, x0 : x0 + w]
-                block_arrays.append(full)
+
+        def gen_blocks():
+            tiles_x = (width + tw - 1) // tw
+            tiles_y = (height + th - 1) // th
+            for ty in range(tiles_y):
+                for tx in range(tiles_x):
+                    full = np.zeros((th, tw, spp), dtype=arr.dtype)
+                    y0, x0 = ty * th, tx * tw
+                    h = min(th, height - y0)
+                    w = min(tw, width - x0)
+                    full[:h, :w] = chunky[y0 : y0 + h, x0 : x0 + w]
+                    yield full
     else:
         rps = 64
-        for y0 in range(0, height, rps):
-            block_arrays.append(np.ascontiguousarray(chunky[y0 : y0 + rps]))
-    blocks = _encode_all(block_arrays, comp_id, use_pred)
+
+        def gen_blocks():
+            for y0 in range(0, height, rps):
+                yield np.ascontiguousarray(chunky[y0 : y0 + rps])
+
+    blocks = _encode_all(gen_blocks(), comp_id, use_pred)
 
     data_off = 8  # blocks start right after the 8-byte header
     offsets: list[int] = []
@@ -495,28 +508,51 @@ def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:
     return zlib.compress(raw, 6)
 
 
-def _encode_all(
-    block_arrays: list[np.ndarray], comp_id: int, use_pred: bool
-) -> list[bytes]:
-    """Encode blocks via the native library when possible (equal-geometry
-    deflate blocks — always true for the tiled layout), else per-block NumPy.
+#: blocks per native-encode batch: bounds transient memory to CHUNK blocks
+#: (e.g. 16 × 256²×spp samples) while amortising the ctypes call + thread
+#: spawn over enough independent work to keep the pool busy.
+_ENCODE_CHUNK = 16
 
+
+def _encode_all(block_iter, comp_id: int, use_pred: bool) -> list[bytes]:
+    """Encode a stream of blocks, in chunks through the native library when
+    possible, else per-block NumPy.
+
+    Blocks are consumed lazily — peak transient memory is one chunk, not
+    the whole raster.  Equal-shape runs batch together (always true for the
+    tiled layout; the strip layout's short last strip flushes a chunk).
     Both paths produce byte-identical output: same zlib level, same
     predictor arithmetic — the native path is acceleration only.
     """
-    if (
-        native.available()
-        and comp_id != _COMP_NONE
-        and block_arrays
-        and len({b.shape for b in block_arrays}) == 1
-        and not (use_pred and block_arrays[0].dtype.itemsize == 8)
-    ):
-        try:
-            return native.encode_blocks(
-                np.stack(block_arrays),  # fresh stack → safe to mutate
-                predictor=2 if use_pred else 1,
-                in_place=True,
-            )
-        except native.NativeCodecError:
-            pass
-    return [_encode_block(b, comp_id, use_pred) for b in block_arrays]
+    if not (native.available() and comp_id != _COMP_NONE):
+        return [_encode_block(b, comp_id, use_pred) for b in block_iter]
+
+    out: list[bytes] = []
+    chunk: list[np.ndarray] = []
+
+    def flush() -> None:
+        if not chunk:
+            return
+        if use_pred and chunk[0].dtype.itemsize == 8:
+            out.extend(_encode_block(b, comp_id, use_pred) for b in chunk)
+        else:
+            try:
+                out.extend(
+                    native.encode_blocks(
+                        np.stack(chunk),  # fresh stack → safe to mutate
+                        predictor=2 if use_pred else 1,
+                        in_place=True,
+                    )
+                )
+            except native.NativeCodecError:
+                out.extend(_encode_block(b, comp_id, use_pred) for b in chunk)
+        chunk.clear()
+
+    for b in block_iter:
+        if chunk and b.shape != chunk[0].shape:
+            flush()
+        chunk.append(b)
+        if len(chunk) >= _ENCODE_CHUNK:
+            flush()
+    flush()
+    return out

@@ -38,7 +38,7 @@ _ERR_NAMES = {
     -3: "bad argument",
     -4: "block data out of file bounds / short",
 }
-_ABI_VERSION = 1
+_ABI_VERSION = 2
 
 
 class NativeCodecError(RuntimeError):
@@ -82,7 +82,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     u64p = ctypes.POINTER(ctypes.c_uint64)
     lib.lt_decode_blocks.restype = ctypes.c_int
     lib.lt_decode_blocks.argtypes = [
-        u8p, ctypes.c_uint64, u64p, u64p,
+        u8p, ctypes.c_uint64, u64p, u64p, u64p,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p, ctypes.c_int,
     ]
@@ -127,13 +127,18 @@ def decode_blocks(
     width: int,
     spp: int,
     dtype: np.dtype,
+    block_rows: np.ndarray | None = None,
     n_threads: int = 0,
 ) -> np.ndarray:
     """Decode TIFF blocks → ``(n_blocks, rows, width, spp)`` native-endian.
 
     ``file_data`` is the whole file image; ``offsets``/``counts`` the block
-    byte ranges from the IFD.  Raises :class:`NativeCodecError` on any
-    per-block failure (caller falls back to the NumPy path).
+    byte ranges from the IFD.  ``block_rows`` gives each block's REAL row
+    count (default: all full) — a legally-short last strip decodes its real
+    rows, while a block whose payload ends short of its expected size is
+    corrupt and raises, exactly like the NumPy path's ``frombuffer``.
+    Raises :class:`NativeCodecError` on any per-block failure (caller falls
+    back to the NumPy path).
     """
     assert _LIB is not None
     dtype = np.dtype(dtype)
@@ -143,12 +148,18 @@ def decode_blocks(
     offs = np.ascontiguousarray(offsets, dtype=np.uint64)
     cnts = np.ascontiguousarray(counts, dtype=np.uint64)
     n = len(offs)
+    if block_rows is None:
+        brows = np.full(n, rows, dtype=np.uint64)
+    else:
+        brows = np.ascontiguousarray(block_rows, dtype=np.uint64)
+        if len(brows) != n:
+            raise NativeCodecError("block_rows length mismatch")
     # zeros, not empty: a short last strip legally fills only its real rows
     out = np.zeros((n, rows, width, spp), dtype=dtype)
     rc = _LIB.lt_decode_blocks(
         _u8(buf), ctypes.c_uint64(buf.size), _u64(offs), _u64(cnts),
-        n, compression, predictor, rows, width, spp, dtype.itemsize,
-        _u8(out.view(np.uint8).reshape(-1)), n_threads,
+        _u64(brows), n, compression, predictor, rows, width, spp,
+        dtype.itemsize, _u8(out.view(np.uint8).reshape(-1)), n_threads,
     )
     if rc != 0:
         raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))

@@ -40,7 +40,11 @@ constexpr int kErrShortData = -4;
 // Inflate `src` into exactly `dst_len` bytes of `dst`.  TIFF deflate blocks
 // are zlib streams in practice, but raw-deflate files exist (old code 32946
 // writers) — retry headerless on a header error, mirroring the Python
-// codec's zlib.decompress fallback.
+// codec's zlib.decompress fallback.  A stream that ends short of `dst_len`
+// is an error (truncated block): the caller passes the exact expected size,
+// including legally-short last strips, so partial fill always means
+// corruption — matching the NumPy path's frombuffer failure.  Extra stream
+// data beyond `dst_len` is tolerated like NumPy's frombuffer(count=...).
 int inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
                   size_t dst_len) {
   for (int window : {MAX_WBITS, -MAX_WBITS}) {
@@ -53,7 +57,10 @@ int inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
     zs.avail_out = static_cast<uInt>(dst_len);
     int rc = inflate(&zs, Z_FINISH);
     inflateEnd(&zs);
-    if (rc == Z_STREAM_END || (rc == Z_OK && zs.avail_out == 0)) return kOk;
+    if ((rc == Z_STREAM_END || rc == Z_OK || rc == Z_BUF_ERROR) &&
+        zs.avail_out == 0)
+      return kOk;
+    if (rc == Z_STREAM_END) return kErrShortData;  // truncated block
     // only fall through to raw-deflate on an immediate header rejection
     if (window == MAX_WBITS && rc == Z_DATA_ERROR && zs.total_in < 2) continue;
     return kErrInflate;
@@ -143,26 +150,30 @@ int run_blocks(int n_blocks, int n_threads, Fn&& per_block) {
 extern "C" {
 
 // ABI version — bump on any signature change; the ctypes binding checks it.
-int lt_native_abi_version() { return 1; }
+int lt_native_abi_version() { return 2; }
 
 // Decode n_blocks TIFF blocks from a memory-mapped/loaded file image.
 //
 //   file_data/file_len  whole file bytes
 //   offsets/counts      per-block byte ranges (uint64, from the IFD)
+//   block_rows          per-block REAL row count (uint64; < `rows` only for
+//                       a legally-short last strip) — the decoded payload
+//                       must cover exactly block_rows*width*spp samples or
+//                       the block is treated as corrupt
 //   compression         TIFF tag 259 value (1, 8, or 32946)
 //   predictor           TIFF tag 317 value (1 or 2)
-//   rows/width/spp      decoded block geometry (rows*width*spp samples)
+//   rows/width/spp      decoded block slot geometry (rows*width*spp samples)
 //   elem_size           bytes per sample (1, 2, 4, or 8)
-//   out                 n_blocks contiguous decoded blocks, caller-allocated
+//   out                 n_blocks contiguous decoded slots, caller-allocated
 //   n_threads           0 = hardware concurrency
 //
 // Returns 0 or a negative error code.  Little-endian samples only (the
 // Python layer routes big-endian files to the NumPy path).
 int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
                      const uint64_t* offsets, const uint64_t* counts,
-                     int n_blocks, int compression, int predictor, int rows,
-                     int width, int spp, int elem_size, uint8_t* out,
-                     int n_threads) {
+                     const uint64_t* block_rows, int n_blocks,
+                     int compression, int predictor, int rows, int width,
+                     int spp, int elem_size, uint8_t* out, int n_threads) {
   if (n_blocks < 0 || rows <= 0 || width <= 0 || spp <= 0) return kErrBadArg;
   if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
     return kErrBadArg;
@@ -170,23 +181,25 @@ int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
       compression != kCompDeflateOld)
     return kErrBadArg;
   if (predictor == 2 && elem_size == 8) return kErrBadArg;  // floats only
-  const size_t block_bytes =
-      static_cast<size_t>(rows) * width * spp * elem_size;
+  const size_t row_bytes = static_cast<size_t>(width) * spp * elem_size;
+  const size_t slot_bytes = static_cast<size_t>(rows) * row_bytes;
 
   return run_blocks(n_blocks, n_threads, [&](int i) -> int {
     if (offsets[i] + counts[i] > file_len) return kErrShortData;
+    if (block_rows[i] > static_cast<uint64_t>(rows)) return kErrBadArg;
+    const size_t want = block_rows[i] * row_bytes;
     const uint8_t* src = file_data + offsets[i];
-    uint8_t* dst = out + static_cast<size_t>(i) * block_bytes;
+    uint8_t* dst = out + static_cast<size_t>(i) * slot_bytes;
     if (compression == kCompNone) {
-      // short last strip is legal: the file stores only the real rows
-      size_t n = counts[i] < block_bytes ? counts[i] : block_bytes;
-      std::memcpy(dst, src, n);
+      if (counts[i] < want) return kErrShortData;
+      std::memcpy(dst, src, want);
     } else {
-      int rc = inflate_block(src, counts[i], dst, block_bytes);
+      int rc = inflate_block(src, counts[i], dst, want);
       if (rc != kOk) return rc;
     }
     if (predictor == 2)
-      apply_predictor(dst, rows, width, spp, elem_size, /*undo=*/true);
+      apply_predictor(dst, static_cast<int>(block_rows[i]), width, spp,
+                      elem_size, /*undo=*/true);
     return kOk;
   });
 }

@@ -20,12 +20,15 @@ NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 
 @pytest.fixture(scope="session", autouse=True)
 def built_lib():
-    """Build liblt_native.so if a toolchain exists; reload the binding."""
-    lib = os.path.join(NATIVE_DIR, "liblt_native.so")
-    if not os.path.exists(lib):
-        if shutil.which("make") is None or shutil.which("g++") is None:
-            pytest.skip("no C++ toolchain; native codec untestable")
+    """(Re)build liblt_native.so if a toolchain exists; reload the binding.
+
+    ``make`` is mtime-incremental, so this also refreshes a stale .so left
+    over from an older ABI (which ``_load`` would refuse).
+    """
+    if shutil.which("make") is not None and shutil.which("g++") is not None:
         subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+    elif not os.path.exists(os.path.join(NATIVE_DIR, "liblt_native.so")):
+        pytest.skip("no C++ toolchain; native codec untestable")
     if not native.available():
         native._LIB, native._LIB_PATH = native._load()
     if not native.available():
@@ -171,3 +174,34 @@ def test_roundtrip_through_driver_products(tmp_path, rng):
     back, _, info = gt.read_geotiff(path)
     np.testing.assert_array_equal(back, arr)
     assert info.bands == 7
+
+
+def test_truncated_deflate_block_raises(tmp_path, rng):
+    """A deflate stream that inflates short of its expected size is corrupt
+    and must raise — not silently zero-fill (parity with NumPy frombuffer)."""
+    import zlib
+
+    good = rng.integers(-500, 500, size=(8, 8, 1), dtype=np.int16)
+    full = zlib.compress(good.tobytes(), 6)
+    short = zlib.compress(good.tobytes()[: good.nbytes // 2], 6)
+    data = full + short
+    offsets = np.array([0, len(full)])
+    counts = np.array([len(full), len(short)])
+    with pytest.raises(native.NativeCodecError):
+        native.decode_blocks(
+            data, offsets, counts,
+            compression=8, predictor=1, rows=8, width=8, spp=1,
+            dtype=np.dtype("i2"),
+        )
+
+
+def test_short_last_strip_deflate_roundtrip(tmp_path, rng):
+    """Legally-short deflate last strip (height not a strip multiple) still
+    decodes through the native path."""
+    arr = rng.integers(-999, 999, size=(70, 33), dtype=np.int16)  # 64+6 rows
+    path = str(tmp_path / "s.tif")
+    gt.write_geotiff(path, arr, tile=None)
+    assert native.available()
+    back, _, info = gt.read_geotiff(path)
+    assert not info.tiled
+    np.testing.assert_array_equal(back, arr)
