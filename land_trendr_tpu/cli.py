@@ -8,6 +8,10 @@ stacks-in / rasters-out pipeline in-process on the local TPU (or CPU).
 Commands
 --------
 ``segment``   stack directory → segment rasters (the main pipeline)
+``pixel``     segment ONE time series through the CPU oracle and/or the JAX
+              kernel — the single-pixel debug/parity path (SURVEY.md §4
+              call stack (4): construct the segmenter directly, bypassing
+              the job machinery)
 ``params``    print the default algorithm parameters as JSON (a template
               for ``--params-json``)
 ``synth``     materialise a synthetic Landsat stack (fixtures / demos)
@@ -84,6 +88,21 @@ def build_parser() -> argparse.ArgumentParser:
                      help="DN→reflectance offset (C2 default)")
     _add_param_flags(seg)
 
+    pix = sub.add_parser(
+        "pixel", help="segment one series (single-pixel debug/parity path)"
+    )
+    pix.add_argument(
+        "series",
+        help="JSON file with {years: [...], values: [...], mask?: [...]}; "
+        "'-' reads stdin; values use the index's natural sign with "
+        "--index, or are taken as-is (disturbance-positive) without it",
+    )
+    pix.add_argument("--engine", choices=("oracle", "jax", "both"),
+                     default="both")
+    pix.add_argument("--index", default=None, choices=INDEX_NAMES,
+                     help="flip sign per this index's disturbance convention")
+    _add_param_flags(pix)
+
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
 
@@ -94,6 +113,101 @@ def build_parser() -> argparse.ArgumentParser:
     syn.add_argument("--year-end", type=int, default=2023)
     syn.add_argument("--seed", type=int, default=20260729)
     return p
+
+
+#: value-carrying fields that flip with the index's disturbance sign —
+#: must match the driver's raster convention (runtime/driver.py _tile_arrays)
+_SIGNED_FIELDS = (
+    "vertex_src_vals", "vertex_fit_vals", "seg_magnitude", "seg_rate",
+    "fitted", "despiked",
+)
+
+
+def _result_to_dict(res, sign: float = 1.0) -> dict:
+    """SegmentationResult / one-pixel SegOutputs → plain-JSON dict.
+
+    ``sign`` undoes the disturbance-positive input flip so printed values
+    match the index's natural orientation — the same convention the
+    segment pipeline's rasters use.
+    """
+    import numpy as np
+
+    out = {}
+    for name in (
+        "n_vertices", "vertex_indices", "vertex_years", "vertex_src_vals",
+        "vertex_fit_vals", "seg_magnitude", "seg_duration", "seg_rate",
+        "rmse", "p_of_f", "model_valid", "fitted", "despiked",
+    ):
+        v = np.asarray(getattr(res, name))
+        if name in _SIGNED_FIELDS:
+            v = sign * v
+        out[name] = v.item() if v.ndim == 0 else v.tolist()
+    out["model_valid"] = bool(out["model_valid"])
+    out["n_vertices"] = int(out["n_vertices"])
+    return out
+
+
+def _run_pixel(args: argparse.Namespace) -> int:
+    """Single-pixel debug path: one series through oracle and/or kernel."""
+    import numpy as np
+
+    if args.series == "-":
+        payload = json.load(sys.stdin)
+    else:
+        with open(args.series) as f:
+            payload = json.load(f)
+    years = np.asarray(payload["years"], dtype=np.int32)
+    values = np.asarray(payload["values"], dtype=np.float64)
+    mask = (
+        np.asarray(payload["mask"], dtype=bool)
+        if "mask" in payload
+        else np.isfinite(values)
+    )
+    if years.shape != values.shape or years.shape != mask.shape:
+        raise SystemExit("years/values/mask must have identical lengths")
+    sign = 1.0
+    if args.index:
+        from land_trendr_tpu.ops.indices import DISTURBANCE_SIGN
+
+        sign = DISTURBANCE_SIGN[args.index.lower()]
+        values = sign * values
+    params = _params_from_args(args)
+
+    result: dict = {"params": params.to_dict()}
+    if args.engine in ("oracle", "both"):
+        from land_trendr_tpu.models.oracle import PixelSegmenter
+
+        result["oracle"] = _result_to_dict(
+            PixelSegmenter(params).segment(years, values, mask), sign
+        )
+    if args.engine in ("jax", "both"):
+        from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+        out = jax_segment_pixels(years, values[None, :], mask[None, :], params)
+        result["jax"] = _result_to_dict(
+            type(out)(*(np.asarray(f)[0] for f in out)), sign
+        )
+        result["jax"]["dtype"] = str(np.asarray(out.fitted).dtype)
+    if args.engine == "both":
+        o, j = result["oracle"], result["jax"]
+        result["parity"] = {
+            "vertex_indices_equal": o["vertex_indices"] == j["vertex_indices"],
+            "model_valid_equal": o["model_valid"] == j["model_valid"],
+            "max_abs_fitted_delta": float(
+                np.max(np.abs(np.asarray(o["fitted"]) - np.asarray(j["fitted"])))
+            ),
+            "kernel_dtype": j["dtype"],
+        }
+        if j["dtype"] != "float64":
+            # exact vertex parity is a float64 contract (ops/segment.py
+            # docstring); f32 knife-edges may pick equivalent models
+            result["parity"]["note"] = (
+                "kernel ran in float32 (JAX_ENABLE_X64 unset): expect "
+                "~1e-6 fitted deltas and possible equivalent-model vertex "
+                "differences; exact parity requires x64"
+            )
+    print(json.dumps(result, indent=2))
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -107,6 +221,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "params":
         print(_params_from_args(args).to_json())
         return 0
+
+    if args.cmd == "pixel":
+        return _run_pixel(args)
 
     if args.cmd == "synth":
         from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack

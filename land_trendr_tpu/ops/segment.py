@@ -442,13 +442,18 @@ def segment_pixel(
 
     def model_step(vm, _):
         fitted, sse = _fit_model(t, y, mask, vm, y_range, params)
+        del fitted  # only the chosen model's trajectory is needed — it is
+        # recomputed after selection, so the scan stacks NY bools + 2
+        # scalars per model instead of an NY-float trajectory (≈5× less
+        # stacked HBM; _fit_model is deterministic, so the recomputation
+        # is exact)
         m = jnp.sum(vm) - 1  # segments in this model
         p = _f_stat_p(ss0, sse, n_valid.astype(dtype), m.astype(dtype))
         vm_next = _remove_weakest(t, y, vm, scale, nv, 2)
-        return vm_next, (vm, fitted, sse, p)
+        return vm_next, (vm, p)
 
     with jax.named_scope(SCOPE_MODEL_FAMILY):
-        _, (vmasks, fitteds, sses, ps) = lax.scan(model_step, vmask, None, length=nm)
+        _, (vmasks, ps) = lax.scan(model_step, vmask, None, length=nm)
 
     # Selection: most segments whose p is within best_model_proportion of best
     with jax.named_scope(SCOPE_MODEL_SELECT):
@@ -456,8 +461,7 @@ def segment_pixel(
         qualify = ps <= p_best / params.best_model_proportion
         chosen = jnp.argmax(qualify)  # first (= most segments) qualifying model
         vmask_c = vmasks[chosen]
-        fitted_c = fitteds[chosen]
-        sse_c = sses[chosen]
+        fitted_c, sse_c = _fit_model(t, y, mask, vmask_c, y_range, params)
         p_c = ps[chosen]
 
     model_valid = enough & (y_range > 0.0) & (p_c <= params.p_val_threshold)

@@ -74,3 +74,48 @@ def test_synth_then_segment_end_to_end(tmp_path, capsys):
     ]) == 0
     rep2 = json.loads(capsys.readouterr().out)
     assert rep2["summary"]["tiles_skipped_resume"] == rep["summary"]["tiles"]
+
+
+def test_pixel_command_parity(tmp_path, capsys):
+    """The single-pixel debug path runs both engines and reports parity."""
+    import json as _json
+
+    import numpy as np
+
+    ny = 24
+    years = list(range(1995, 1995 + ny))
+    t = np.arange(ny)
+    vals = (0.62 - np.where(t >= 10, 0.3 * np.exp(-0.1 * (t - 10)), 0.0)
+            + np.sin(t) * 0.004)
+    series = tmp_path / "px.json"
+    series.write_text(_json.dumps({
+        "years": years, "values": vals.tolist(),
+    }))
+    rc = main([
+        "pixel", str(series), "--index", "nbr",
+        "--max-segments", "4", "--vertex-count-overshoot", "2",
+    ])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["oracle"]["model_valid"] and out["jax"]["model_valid"]
+    assert out["parity"]["vertex_indices_equal"]
+    assert out["parity"]["max_abs_fitted_delta"] < 1e-9
+    # disturbance year (index 10) is among the oracle's vertices
+    assert 10 in out["oracle"]["vertex_indices"]
+
+
+def test_pixel_command_stdin_nofit(monkeypatch, capsys):
+    """Insufficient observations → clean no-fit result via stdin."""
+    import io as _io
+    import json as _json
+
+    payload = _json.dumps({
+        "years": [2000, 2001, 2002],
+        "values": [0.5, 0.6, 0.4],
+    })
+    monkeypatch.setattr("sys.stdin", _io.StringIO(payload))
+    rc = main(["pixel", "-", "--engine", "oracle"])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["oracle"]["model_valid"] is False
+    assert out["oracle"]["n_vertices"] == 0

@@ -1,0 +1,104 @@
+"""NaN-propagation guards (SURVEY.md §5 "Race detection/sanitizers").
+
+The reference's map tasks share nothing, so there is nothing to race; the
+TPU rebuild's analogous hazard is NaN/Inf leaking out of guarded divisions
+in masked/degenerate lanes.  ``jax_debug_nans`` turns any NaN produced by
+a primitive into an immediate error, so running the kernel under it on
+adversarial inputs proves every division/log/sqrt is properly guarded —
+the sanitizer pass of this framework.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.ftv import jax_fit_to_vertices
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+@pytest.fixture()
+def debug_nans():
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+
+def _years(ny=20):
+    return np.arange(2000, 2000 + ny, dtype=np.int32)
+
+
+ADVERSARIAL = {
+    "all_masked": lambda rng, ny: (
+        rng.normal(size=(4, ny)),
+        np.zeros((4, ny), bool),
+    ),
+    "single_valid_year": lambda rng, ny: (
+        rng.normal(size=(4, ny)),
+        np.eye(4, ny, dtype=bool),
+    ),
+    "two_valid_years": lambda rng, ny: (
+        rng.normal(size=(4, ny)),
+        np.eye(4, ny, dtype=bool) | np.eye(4, ny, k=5, dtype=bool),
+    ),
+    "constant_series": lambda rng, ny: (
+        np.full((4, ny), 0.37),
+        np.ones((4, ny), bool),
+    ),
+    "exact_min_observations": lambda rng, ny: (
+        rng.normal(size=(4, ny)),
+        np.tile(np.arange(ny) < PARAMS.min_observations_needed, (4, 1)),
+    ),
+    "huge_values": lambda rng, ny: (
+        rng.normal(size=(4, ny)) * 1e30,
+        rng.uniform(size=(4, ny)) > 0.2,
+    ),
+    "tiny_values": lambda rng, ny: (
+        rng.normal(size=(4, ny)) * 1e-30,
+        rng.uniform(size=(4, ny)) > 0.2,
+    ),
+    "nan_inputs_masked_out": lambda rng, ny: (
+        np.where(rng.uniform(size=(4, ny)) > 0.5, np.nan, 0.5),
+        np.ones((4, ny), bool),  # kernel must drop non-finite itself
+    ),
+    "inf_inputs_masked_out": lambda rng, ny: (
+        np.where(rng.uniform(size=(4, ny)) > 0.5, np.inf, 0.5),
+        np.ones((4, ny), bool),
+    ),
+    "alternating_mask": lambda rng, ny: (
+        rng.normal(size=(4, ny)),
+        np.tile(np.arange(ny) % 2 == 0, (4, 1)),
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(ADVERSARIAL))
+def test_segment_no_nan_under_debug_nans(rng, debug_nans, case):
+    ny = 20
+    vals, mask = ADVERSARIAL[case](rng, ny)
+    out = jax_segment_pixels(
+        _years(ny), np.asarray(vals, np.float64), np.asarray(mask), PARAMS
+    )
+    jax.block_until_ready(out)
+    for name, field in out._asdict().items():
+        assert np.isfinite(np.asarray(field, np.float64)).all(), name
+
+
+def test_ftv_no_nan_under_debug_nans(rng, debug_nans):
+    ny = 20
+    years = _years(ny)
+    vals = rng.normal(size=(6, ny))
+    mask = rng.uniform(size=(6, ny)) > 0.2
+    seg = jax_segment_pixels(years, vals, mask, PARAMS)
+    # secondary index with its own pathologies: constants and all-masked rows
+    sec = np.full((6, ny), 2.5)
+    sec_mask = mask.copy()
+    sec_mask[0] = False
+    ftv = jax_fit_to_vertices(
+        years, sec, sec_mask, seg.vertex_indices, seg.n_vertices, PARAMS
+    )
+    assert np.isfinite(np.asarray(ftv)).all()
