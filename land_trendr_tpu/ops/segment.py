@@ -64,7 +64,12 @@ from land_trendr_tpu.utils.profiling import (
     SCOPE_VERTEX_SEARCH,
 )
 
-__all__ = ["SegOutputs", "segment_pixel", "jax_segment_pixels"]
+__all__ = [
+    "SegOutputs",
+    "segment_pixel",
+    "jax_segment_pixels",
+    "jax_segment_pixels_chunked",
+]
 
 _EPS_RATE = 1e-12  # must match oracle._segment_violates
 
@@ -531,6 +536,41 @@ def segment_pixel(
         fitted=fitted_full,
         despiked=despiked,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("params", "chunk"))
+def jax_segment_pixels_chunked(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+    chunk: int = 262144,
+) -> SegOutputs:
+    """:func:`jax_segment_pixels` with HBM bounded by ``chunk`` pixels.
+
+    The kernel's transient working set is linear in the pixel axis (the
+    model-family scan and vertex bookkeeping), so one huge batch can exceed
+    HBM where many chunks do not — e.g. a 4M-pixel 40-year batch needs
+    >16 GB transient on v5e while 16 × 256K chunks stream through
+    comfortably.  ``lax.map`` runs the chunks *sequentially inside one
+    compiled program*: outputs for all pixels accumulate in HBM (they are
+    what the caller asked for) while per-chunk temporaries are reused.
+
+    The pixel count must be a multiple of ``chunk`` (pad with fully-masked
+    rows — :func:`land_trendr_tpu.parallel.pad_to_multiple`); per-pixel
+    results are bit-identical to the unchunked kernel's.
+    """
+    px = values.shape[0]
+    if px % chunk:
+        raise ValueError(
+            f"pixel count {px} not a multiple of chunk {chunk}; pad first"
+        )
+    v = values.reshape(px // chunk, chunk, values.shape[1])
+    m = mask.reshape(px // chunk, chunk, mask.shape[1])
+    out = lax.map(
+        lambda vm: jax_segment_pixels(years, vm[0], vm[1], params), (v, m)
+    )
+    return SegOutputs(*(o.reshape(px, *o.shape[2:]) for o in out))
 
 
 @functools.partial(jax.jit, static_argnames=("params",))

@@ -169,3 +169,34 @@ def test_batch_matches_per_pixel(rng):
         ref = oracle.segment_series(YEARS, ys[i], masks[i], LTParams())
         got = {kk: np.asarray(v)[i] for kk, v in out._asdict().items()}
         assert_parity(ref, got, ctx=f"batch {k}")
+
+
+def test_chunked_matches_unchunked(rng):
+    """lax.map chunking is pure scheduling: per-pixel outputs are identical."""
+    from land_trendr_tpu.ops.segment import (
+        jax_segment_pixels,
+        jax_segment_pixels_chunked,
+    )
+
+    ny, px = 18, 24
+    years = np.arange(2000, 2000 + ny, dtype=np.int32)
+    t = np.arange(ny)
+    d = rng.integers(4, ny - 4, size=(px, 1))
+    vals = -(0.6 - np.where(t[None, :] >= d, 0.25, 0.0)
+             + rng.normal(0, 0.01, (px, ny)))
+    mask = rng.uniform(size=(px, ny)) > 0.1
+    params = LTParams(max_segments=3, vertex_count_overshoot=2)
+    ref = jax_segment_pixels(years, vals, mask, params)
+    chunked = jax_segment_pixels_chunked(years, vals, mask, params, chunk=8)
+    for name, a, b in zip(ref._fields, ref, chunked):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_chunked_rejects_indivisible(rng):
+    from land_trendr_tpu.ops.segment import jax_segment_pixels_chunked
+
+    years = np.arange(2000, 2018, dtype=np.int32)
+    vals = rng.normal(size=(10, 18))
+    mask = np.ones((10, 18), bool)
+    with pytest.raises(ValueError, match="not a multiple"):
+        jax_segment_pixels_chunked(years, vals, mask, LTParams(), chunk=4)
