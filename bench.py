@@ -22,18 +22,34 @@ instead of inflating it.  If the batch does not fit in HBM the benchmark
 halves it and retries (the kernel's working set scales linearly with the
 pixel axis).
 
+Robustness (round-1 failure mode: TPU backend init both *erroring* with
+``UNAVAILABLE: TPU backend setup/compile error`` and *hanging* >9 min at 0%
+CPU): the measurement runs in a CHILD process so a hung backend init is
+killable; the parent retries with backoff on init errors/hangs and, if every
+attempt fails, still prints one parseable JSON diagnostic line (value 0 +
+"error") instead of a bare traceback.
+
 Env overrides: LT_BENCH_PX (default 1048576), LT_BENCH_YEARS (40),
-LT_BENCH_REPS (5).
+LT_BENCH_REPS (5), LT_BENCH_ATTEMPTS (4), LT_BENCH_TIMEOUT (seconds per
+attempt, default 900 — TPU first-compile alone can take tens of seconds),
+LT_BENCH_PLATFORM (force a JAX platform, e.g. "cpu" for smoke tests — set
+via ``jax.config``, because this container's interpreter boot hook selects
+``jax_platforms="axon,cpu"`` programmatically, which outranks the
+JAX_PLATFORMS env var).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
+
+_EXIT_INIT_HANG = 3
 
 
 def make_series(px: int, ny: int, seed: int = 7) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -58,7 +74,43 @@ def _is_oom(e: Exception) -> bool:
     return "memory" in s.lower() or "RESOURCE_EXHAUSTED" in s
 
 
-def _run_once(px: int, ny: int, reps: int) -> float:
+def _first_device(init_timeout: float):
+    """``jax.devices()[0]`` under a watchdog: a hung backend init kills the
+    process with a distinctive exit code instead of stalling forever (the
+    observed round-1 failure mode — init parked at 0% CPU for >9 min)."""
+    import jax
+
+    forced = os.environ.get("LT_BENCH_PLATFORM")
+    if forced:
+        jax.config.update("jax_platforms", forced)
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(init_timeout):
+            print(
+                f"bench: backend init exceeded {init_timeout:.0f}s watchdog",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(_EXIT_INIT_HANG)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        t0 = time.perf_counter()
+        dev = jax.devices()[0]
+        print(
+            f"bench: backend up in {time.perf_counter() - t0:.1f}s "
+            f"(platform={dev.platform})",
+            file=sys.stderr,
+            flush=True,
+        )
+        return dev
+    finally:
+        done.set()
+
+
+def _run_once(dev, px: int, ny: int, reps: int) -> float:
     """Time the kernel at one batch size; returns best-rep seconds.
 
     Raises on device/validity failure so the caller can back off.
@@ -68,7 +120,6 @@ def _run_once(px: int, ny: int, reps: int) -> float:
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.ops.segment import jax_segment_pixels
 
-    dev = jax.devices()[0]
     params = LTParams()
     years_np, vals_np, mask_np = make_series(px, ny)
     years = jax.device_put(years_np, dev)
@@ -96,16 +147,20 @@ def _run_once(px: int, ny: int, reps: int) -> float:
     return best
 
 
-def main() -> int:
+def _child_main() -> int:
+    """One measurement attempt; prints the JSON result line on success."""
     px = int(os.environ.get("LT_BENCH_PX", 1048576))
     ny = int(os.environ.get("LT_BENCH_YEARS", 40))
     reps = int(os.environ.get("LT_BENCH_REPS", 5))
+    init_timeout = float(os.environ.get("LT_BENCH_TIMEOUT", 900)) * 0.5
+
+    dev = _first_device(init_timeout)
 
     best = None
     last_err: Exception | None = None
     for _ in range(4):  # back off on OOM: kernel memory is linear in px
         try:
-            best = _run_once(px, ny, reps)
+            best = _run_once(dev, px, ny, reps)
             break
         except Exception as e:
             last_err = e
@@ -117,18 +172,87 @@ def main() -> int:
         raise RuntimeError(f"benchmark failed at px={px}") from last_err
 
     value = px / best
-    print(
-        json.dumps(
-            {
-                "metric": f"landtrendr_segmentation_throughput_{ny}yr_nbr",
-                "value": round(value, 1),
-                "unit": "pixels/sec/chip",
-                "vs_baseline": round(value / 10e6, 4),
-            }
-        )
-    )
+    print(_result_line(ny, value), flush=True)
     return 0
 
 
+def _result_line(ny: int, value: float, error: str | None = None) -> str:
+    """The ONE output line — shared by success and diagnostic paths so the
+    metric name / schema can never desynchronize between them."""
+    rec = {
+        "metric": f"landtrendr_segmentation_throughput_{ny}yr_nbr",
+        "value": round(value, 1),
+        "unit": "pixels/sec/chip",
+        "vs_baseline": round(value / 10e6, 4),
+    }
+    if error is not None:
+        rec["error"] = error[-2000:]
+    return json.dumps(rec)
+
+
+def main() -> int:
+    """Parent: run the measurement in a child with retries + watchdog."""
+    ny = int(os.environ.get("LT_BENCH_YEARS", 40))
+    attempts = int(os.environ.get("LT_BENCH_ATTEMPTS", 4))
+    timeout = float(os.environ.get("LT_BENCH_TIMEOUT", 900))
+    env = dict(os.environ, LT_BENCH_CHILD="1")
+
+    failures: list[str] = []
+    for attempt in range(attempts):
+        if attempt:
+            backoff = min(15 * (2 ** (attempt - 1)), 120)
+            print(
+                f"bench: attempt {attempt} failed; retrying in {backoff}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as e:
+            # keep the child's progress lines — they localize the hang
+            # (init vs compile vs run)
+            tail = ""
+            if e.stderr:
+                err_text = (
+                    e.stderr.decode(errors="replace")
+                    if isinstance(e.stderr, bytes)
+                    else e.stderr
+                )
+                sys.stderr.write(err_text)
+                tail = " | ".join(err_text.strip().splitlines()[-2:])
+            failures.append(
+                f"attempt {attempt + 1}: killed after {timeout:.0f}s {tail}"
+            )
+            continue
+        sys.stderr.write(proc.stderr)
+        if proc.returncode == 0:
+            # forward exactly the child's one JSON line
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    return 0
+            failures.append(f"attempt {attempt + 1}: rc=0 but no JSON line")
+            continue
+        if proc.returncode == _EXIT_INIT_HANG:
+            failures.append(f"attempt {attempt + 1}: backend init hang (watchdog)")
+            continue
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        failures.append(f"attempt {attempt + 1}: rc={proc.returncode} {' | '.join(tail)}")
+        # UNAVAILABLE / init errors were observed to be transient — retry all
+
+    print(_result_line(ny, 0.0, error="; ".join(failures)), flush=True)
+    return 1
+
+
 if __name__ == "__main__":
+    if os.environ.get("LT_BENCH_CHILD") == "1":
+        sys.exit(_child_main())
     sys.exit(main())

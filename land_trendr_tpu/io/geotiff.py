@@ -23,6 +23,7 @@ band is ~50 MB — far under the 4 GB classic-TIFF limit).
 from __future__ import annotations
 
 import dataclasses
+import mmap
 import struct
 import zlib
 from typing import BinaryIO, Mapping
@@ -247,10 +248,16 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
                     rps, height - rps * np.arange(n_strips, dtype=np.int64)
                 )
                 brows = np.tile(per_plane, planes).astype(np.uint64)
-            f.seek(0)
+            # mmap keeps peak host memory at the decoded array, not whole-file
+            # bytes + decoded array, for scene-scale rasters
+            try:
+                buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            except (ValueError, OSError):  # empty file / non-mmappable stream
+                f.seek(0)
+                buf = f.read()
             try:
                 nat_blocks = native.decode_blocks(
-                    f.read(),
+                    buf,
                     np.asarray(offsets, dtype=np.uint64),
                     np.asarray(counts, dtype=np.uint64),
                     compression=compression,
@@ -263,6 +270,15 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
                 )
             except native.NativeCodecError:
                 nat_blocks = None
+            finally:
+                if isinstance(buf, mmap.mmap):
+                    try:
+                        buf.close()
+                    except BufferError:
+                        # a propagating exception's traceback can still pin
+                        # the frombuffer view; don't mask it — the mmap is
+                        # freed with the object
+                        pass
 
         def get_block(idx: int, rows_actual: int) -> np.ndarray:
             """Decoded block idx as (rows_actual, blk_w, chunk_spp)."""

@@ -557,8 +557,12 @@ def jax_segment_pixels_chunked(
     what the caller asked for) while per-chunk temporaries are reused.
 
     The pixel count must be a multiple of ``chunk`` (pad with fully-masked
-    rows — :func:`land_trendr_tpu.parallel.pad_to_multiple`); per-pixel
-    results are bit-identical to the unchunked kernel's.
+    rows — :func:`land_trendr_tpu.parallel.pad_to_multiple`).  Per-pixel
+    *decisions* (vertex placement, model selection, validity) are identical
+    to the unchunked kernel's; float outputs are numerically identical up to
+    compilation-order rounding (``lax.map`` legally re-fuses reductions, so
+    fields like ``p_of_f`` may differ at the last ulp, ~1e-15 relative).
+    The f32 tolerance contract in the module docstring applies unchanged.
     """
     px = values.shape[0]
     if px % chunk:

@@ -30,6 +30,7 @@ single-host multi-chip machine runs it).
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Sequence, TypeVar
 
@@ -49,6 +50,39 @@ __all__ = [
 
 _T = TypeVar("_T")
 
+_log = logging.getLogger(__name__)
+
+def _cluster_env_detected() -> bool:
+    """True when the environment says this process is part of a multi-host
+    cluster: a failed ``jax.distributed.initialize`` there must raise, not
+    fall back to single-process mode — N hosts silently each computing the
+    full scene would race on the same outputs.
+
+    Single-host markers don't count: ``TPU_WORKER_HOSTNAMES`` with one entry
+    is how a lone v5e host (or the axon tunnel) presents, and a SLURM job
+    with one task is just a batch wrapper.
+    """
+    for k in (
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+    ):
+        if os.environ.get(k):
+            return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    if os.environ.get("SLURM_JOB_ID"):
+        ntasks = os.environ.get("SLURM_NTASKS") or os.environ.get(
+            "SLURM_NPROCS", "1"
+        )
+        try:
+            if int(ntasks) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
+
 
 def init_distributed(
     coordinator_address: str | None = None,
@@ -64,13 +98,16 @@ def init_distributed(
     distributed mode came up; when no cluster is detected *and* nothing was
     requested explicitly, returns False (the single-process no-op), keeping
     the same call portable from laptop CPU to pod.  An explicitly-requested
-    coordinator that fails to connect still raises.
+    coordinator that fails to connect still raises, as does a failure in an
+    environment carrying cluster markers (SLURM / TPU pod metadata /
+    coordinator env vars) — falling back there would leave every host
+    computing the full scene and racing on the same outputs.
     """
     explicit = (
         coordinator_address is not None
         or num_processes is not None
         or process_id is not None
-        or os.environ.get("JAX_COORDINATOR_ADDRESS") is not None
+        or _cluster_env_detected()
     )
     try:
         jax.distributed.initialize(
@@ -78,9 +115,17 @@ def init_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except Exception:
+    except Exception as e:
         if explicit:
             raise
+        _log.warning(
+            "jax.distributed.initialize() found no cluster (%s: %s); "
+            "running SINGLE-PROCESS. If this host is part of a pod, outputs "
+            "will conflict — pass coordinator_address/num_processes/"
+            "process_id explicitly.",
+            type(e).__name__,
+            e,
+        )
         return False  # no cluster detected → single-process mode
     return True
 

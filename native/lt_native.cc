@@ -185,7 +185,10 @@ int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
   const size_t slot_bytes = static_cast<size_t>(rows) * row_bytes;
 
   return run_blocks(n_blocks, n_threads, [&](int i) -> int {
-    if (offsets[i] + counts[i] > file_len) return kErrShortData;
+    // Overflow-safe: offsets[i] + counts[i] can wrap in uint64 for corrupt
+    // or malicious IFD entries, bypassing a naive sum check.
+    if (offsets[i] > file_len || counts[i] > file_len - offsets[i])
+      return kErrShortData;
     if (block_rows[i] > static_cast<uint64_t>(rows)) return kErrBadArg;
     const size_t want = block_rows[i] * row_bytes;
     const uint8_t* src = file_data + offsets[i];

@@ -172,7 +172,12 @@ def test_batch_matches_per_pixel(rng):
 
 
 def test_chunked_matches_unchunked(rng):
-    """lax.map chunking is pure scheduling: per-pixel outputs are identical."""
+    """lax.map chunking is pure scheduling: per-pixel decisions are identical.
+
+    Discrete fields (vertex placement, counts, validity) must match exactly;
+    float fields may differ only by compilation-order rounding (lax.map
+    re-fuses reductions), so they are compared at ~last-ulp tolerance.
+    """
     from land_trendr_tpu.ops.segment import (
         jax_segment_pixels,
         jax_segment_pixels_chunked,
@@ -188,8 +193,15 @@ def test_chunked_matches_unchunked(rng):
     params = LTParams(max_segments=3, vertex_count_overshoot=2)
     ref = jax_segment_pixels(years, vals, mask, params)
     chunked = jax_segment_pixels_chunked(years, vals, mask, params, chunk=8)
+    exact = {"n_vertices", "vertex_indices", "model_valid"}
     for name, a, b in zip(ref._fields, ref, chunked):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+        a, b = np.asarray(a), np.asarray(b)
+        if name in exact:
+            np.testing.assert_array_equal(a, b, err_msg=name)
+        else:
+            np.testing.assert_allclose(
+                a, b, rtol=1e-12, atol=1e-14, err_msg=name
+            )
 
 
 def test_chunked_rejects_indivisible(rng):
