@@ -1,0 +1,92 @@
+"""Worker process for the true multi-process ``jax.distributed`` test.
+
+Run as: ``python _distributed_worker.py <coordinator> <num_procs> <proc_id>
+<out_npz>``.  Each worker owns 4 virtual CPU devices; together the
+processes form one 8-device global mesh.  The worker takes its
+``host_share`` of a deterministic synthetic scene, feeds it through
+``feed_global`` (its rows land only on its addressable devices), runs the
+sharded segmentation program SPMD, and saves the rows it gathers back —
+exactly the v5e-256 pod flow (SURVEY.md §5 distributed backend,
+BASELINE configs[5]) scaled down to two localhost processes over the
+loopback DCN.
+"""
+
+import sys
+
+import jax
+
+# Must beat the sitecustomize's jax_platforms="axon,cpu" config selection
+# *before* any device/backend touch, or a down TPU tunnel hangs the worker.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def make_scene(px: int, ny: int):
+    rng = np.random.default_rng(99)
+    years = np.arange(1990, 1990 + ny, dtype=np.int32)
+    t = np.arange(ny, dtype=np.float64)[None, :]
+    d = rng.integers(5, ny - 5, size=(px, 1))
+    vals = 0.6 - np.where(t >= d, 0.3, 0.0) + rng.normal(0, 0.01, (px, ny))
+    mask = rng.uniform(size=(px, ny)) > 0.1
+    return years, -vals, mask
+
+
+def main() -> int:
+    coordinator, num_procs, proc_id, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+    )
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+    from land_trendr_tpu.parallel import (
+        feed_global,
+        gather_local_rows,
+        host_share,
+        init_distributed,
+        is_primary_host,
+        make_mesh,
+    )
+
+    assert init_distributed(coordinator, num_procs, proc_id) is True
+    assert jax.process_count() == num_procs
+    assert jax.process_index() == proc_id
+    assert is_primary_host() == (proc_id == 0)
+
+    n_global = len(jax.devices())
+    n_local = len(jax.local_devices())
+    assert n_global == num_procs * n_local, (n_global, n_local)
+
+    px_global = 2 * n_global  # 2 rows per device
+    years, vals, mask = make_scene(px_global, ny=24)
+
+    # each host feeds only its own contiguous row block
+    rows = host_share(list(range(px_global)))
+    assert len(rows) == px_global // num_procs
+    mesh = make_mesh()
+    gvals, gmask = feed_global(mesh, vals[rows], mask[rows])
+    assert not gvals.sharding.is_fully_addressable  # genuinely multi-process
+
+    params = LTParams(max_segments=4, vertex_count_overshoot=2)
+    out = jax_segment_pixels(years, gvals, gmask, params)
+    jax.block_until_ready(out)
+
+    np.savez(
+        out_path,
+        rows=np.asarray(rows, dtype=np.int64),
+        rmse=gather_local_rows(out.rmse),
+        vertex_indices=gather_local_rows(out.vertex_indices),
+        n_vertices=gather_local_rows(out.n_vertices),
+        model_valid=gather_local_rows(out.model_valid),
+        fitted=gather_local_rows(out.fitted),
+    )
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

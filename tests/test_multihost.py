@@ -123,3 +123,86 @@ def test_feed_global_rejects_indivisible(rng):
     years, vals, mask = _series(rng, px=n_dev + 1)
     with pytest.raises(ValueError):
         feed_global(mesh, vals, mask)
+
+
+# ---------------------------------------------------------------------------
+# TRUE multi-process jax.distributed (VERDICT round-1 missing item #2)
+# ---------------------------------------------------------------------------
+
+
+def test_two_process_distributed_matches_single(tmp_path):
+    """Two real processes + localhost coordinator, 4 virtual CPU devices
+    each: init_distributed → host_share → feed_global → sharded segment →
+    gather_local_rows, per-process rows vs a single-process run."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "_distributed_worker.py")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coordinator = f"localhost:{port}"
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", "")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env["PYTHONPATH"]
+    )
+
+    procs = []
+    outs = [str(tmp_path / f"worker{i}.npz") for i in range(2)]
+    for i in range(2):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker, coordinator, "2", str(i), outs[i]],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    for i, p in enumerate(procs):
+        try:
+            _, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {i} timed out")
+        assert p.returncode == 0, f"worker {i} failed:\n{err[-4000:]}"
+
+    # single-process reference on the SAME deterministic scene
+    from tests._distributed_worker import make_scene
+
+    years, vals, mask = make_scene(16, ny=24)  # 2 procs × 4 devs × 2 rows
+    params = LTParams(max_segments=4, vertex_count_overshoot=2)
+    ref = jax_segment_pixels(years, vals, mask, params)
+
+    seen_rows = []
+    for i in range(2):
+        got = np.load(outs[i])
+        rows = got["rows"]
+        seen_rows.extend(rows.tolist())
+        np.testing.assert_array_equal(
+            got["vertex_indices"], np.asarray(ref.vertex_indices)[rows],
+            err_msg=f"worker {i} vertex_indices",
+        )
+        np.testing.assert_array_equal(
+            got["n_vertices"], np.asarray(ref.n_vertices)[rows]
+        )
+        np.testing.assert_array_equal(
+            got["model_valid"], np.asarray(ref.model_valid)[rows]
+        )
+        np.testing.assert_array_equal(
+            got["fitted"], np.asarray(ref.fitted)[rows]
+        )
+        np.testing.assert_allclose(
+            got["rmse"], np.asarray(ref.rmse)[rows], rtol=1e-9
+        )
+    # the two host shares tile the scene exactly
+    assert sorted(seen_rows) == list(range(16))
