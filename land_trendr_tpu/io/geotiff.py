@@ -6,18 +6,21 @@ rasters through GDAL's Python bindings (SURVEY.md §2 layer L1, provenance
 environment (SURVEY.md §7 hard-part 5), so the framework vendors the small
 slice of TIFF 6.0 + GeoTIFF it actually needs:
 
-* classic TIFF, little- or big-endian, **read**: stripped or tiled layout,
-  uncompressed / Deflate (zlib) / raw-deflate, horizontal-differencing
-  predictor, chunky or planar multi-band, u/int 8/16/32, float32/64;
+* classic TIFF **and BigTIFF**, little- or big-endian, **read**: stripped
+  or tiled layout, uncompressed / Deflate (zlib) / raw-deflate / LZW,
+  horizontal-differencing predictor, chunky or planar multi-band,
+  u/int 8/16/32, float32/64;
 * **write**: tiled (default) or stripped, Deflate or uncompressed, optional
   horizontal predictor, any of the dtypes above, chunky band layout;
+  classic by default, switching to BigTIFF automatically when the encoded
+  file would overflow 4 GB addressing (CONUS ARD mosaic products,
+  SURVEY.md §7 hard-part 5);
 * GeoTIFF georeferencing carried as an opaque-but-typed :class:`GeoMeta`
   (pixel scale + tiepoint + the raw GeoKey directory blocks), round-tripped
   losslessly so outputs inherit the input grid.
 
 This is host-side I/O: arrays land in NumPy and are fed to the TPU pipeline
-by the runtime driver.  BigTIFF is out of scope (a 5000×5000 int16 WRS-2
-band is ~50 MB — far under the 4 GB classic-TIFF limit).
+by the runtime driver.
 """
 
 from __future__ import annotations
@@ -59,6 +62,7 @@ _T_GEO_ASCII_PARAMS = 34737
 _T_GDAL_NODATA = 42113
 
 _COMP_NONE = 1
+_COMP_LZW = 5
 _COMP_DEFLATE_ADOBE = 8
 _COMP_DEFLATE_OLD = 32946
 
@@ -74,6 +78,9 @@ _FIELD_TYPES = {
     9: ("i", 4),   # SLONG
     11: ("f", 4),  # FLOAT
     12: ("d", 8),  # DOUBLE
+    13: ("I", 4),  # IFD
+    16: ("Q", 8),  # LONG8 (BigTIFF)
+    17: ("q", 8),  # SLONG8 (BigTIFF)
 }
 
 # (sample_format, bits) → numpy dtype char
@@ -122,23 +129,39 @@ class TiffInfo:
     dtype: np.dtype
     tiled: bool
     compression: int
+    big: bool = False
 
 
-def _read_ifd(f: BinaryIO, bo: str, off: int) -> dict[int, tuple]:
+def _read_ifd(f: BinaryIO, bo: str, off: int, big: bool = False) -> dict[int, tuple]:
+    """Parse one IFD; ``big`` selects BigTIFF layout (u64 entry count,
+    20-byte entries with 8-byte inline values, u64 value offsets)."""
     f.seek(off)
-    (n,) = struct.unpack(bo + "H", f.read(2))
+    if big:
+        (n,) = struct.unpack(bo + "Q", f.read(8))
+        # the on-disk u64 count is untrusted: a truncated/corrupt file must
+        # fail parsing, not attempt an exabyte read (classic TIFF's u16
+        # field caps itself; mirror that bound here)
+        if n > 0xFFFF:
+            raise ValueError(f"corrupt BigTIFF IFD: implausible entry count {n}")
+        esz, inline, ptr_fmt = 20, 8, "Q"
+        head_fmt = bo + "HHQ"
+    else:
+        (n,) = struct.unpack(bo + "H", f.read(2))
+        esz, inline, ptr_fmt = 12, 4, "I"
+        head_fmt = bo + "HHI"
     entries: dict[int, tuple] = {}
-    raw = f.read(n * 12)
+    raw = f.read(n * esz)
     for k in range(n):
-        tag, ftype, count = struct.unpack(bo + "HHI", raw[k * 12 : k * 12 + 8])
+        tag, ftype, count = struct.unpack(head_fmt, raw[k * esz : k * esz + esz - inline])
         if ftype not in _FIELD_TYPES:
             continue
         ch, sz = _FIELD_TYPES[ftype]  # sz already totals both LONGs for RATIONAL
         total = sz * count
-        if total <= 4:
-            payload = raw[k * 12 + 8 : k * 12 + 8 + total]
+        val_off = k * esz + (esz - inline)
+        if total <= inline:
+            payload = raw[val_off : val_off + total]
         else:
-            (ptr,) = struct.unpack(bo + "I", raw[k * 12 + 8 : k * 12 + 12])
+            (ptr,) = struct.unpack(bo + ptr_fmt, raw[val_off : val_off + inline])
             here = f.tell()
             f.seek(ptr)
             payload = f.read(total)
@@ -156,6 +179,68 @@ def _read_ifd(f: BinaryIO, bo: str, off: int) -> dict[int, tuple]:
     return entries
 
 
+def _lzw_decode(data: bytes) -> bytes:
+    """TIFF 6.0 LZW (compression 5): MSB-first bit packing, ClearCode=256,
+    EOI=257, 9→12-bit codes with the spec's "early change" width bumps.
+
+    Pure-Python behavioural reference for ``lt_native.cc::lzw_decode`` (the
+    threaded fast path); real Landsat C2 distribution files commonly ship
+    LZW-compressed, which GDAL handled for the reference for free
+    (SURVEY.md §2 L1).
+    """
+    CLEAR, EOI = 256, 257
+    out = bytearray()
+    table: list[bytes] = []
+    code_bits = 9
+    next_code = 258
+    prev: bytes | None = None
+    bitpos = 0
+    total_bits = len(data) * 8
+
+    def read_code() -> int:
+        nonlocal bitpos
+        if bitpos + code_bits > total_bits:
+            return EOI
+        byte0 = bitpos >> 3
+        chunk = int.from_bytes(data[byte0 : byte0 + 4].ljust(4, b"\0"), "big")
+        val = (chunk >> (32 - code_bits - (bitpos & 7))) & ((1 << code_bits) - 1)
+        bitpos += code_bits
+        return val
+
+    while True:
+        code = read_code()
+        if code == EOI:
+            break
+        if code == CLEAR:
+            table = [bytes([i]) for i in range(256)] + [b"", b""]
+            code_bits = 9
+            next_code = 258
+            code = read_code()
+            if code == EOI:
+                break
+            if code >= 256:
+                raise ValueError("corrupt LZW: literal must follow clear")
+            entry = table[code]
+            out += entry
+            prev = entry
+            continue
+        if prev is None or next_code >= 4096:
+            raise ValueError("corrupt LZW: missing clear code")
+        if code < next_code:
+            entry = table[code]
+        elif code == next_code:
+            entry = prev + prev[:1]  # KwKwK
+        else:
+            raise ValueError("corrupt LZW: code beyond table")
+        out += entry
+        table.append(prev + entry[:1])
+        next_code += 1
+        if next_code == (1 << code_bits) - 1 and code_bits < 12:
+            code_bits += 1
+        prev = entry
+    return bytes(out)
+
+
 def _decompress(buf: bytes, compression: int) -> bytes:
     if compression == _COMP_NONE:
         return buf
@@ -164,6 +249,8 @@ def _decompress(buf: bytes, compression: int) -> bytes:
             return zlib.decompress(buf)
         except zlib.error:
             return zlib.decompress(buf, -15)  # raw deflate stream
+    if compression == _COMP_LZW:
+        return _lzw_decode(buf)
     raise ValueError(f"unsupported TIFF compression {compression}")
 
 
@@ -181,19 +268,28 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
     ``(bands, height, width)`` otherwise, in the file's native dtype.
     """
     with open(path, "rb") as f:
-        hdr = f.read(8)
+        hdr = f.read(16)
         if hdr[:2] == b"II":
             bo = "<"
         elif hdr[:2] == b"MM":
             bo = ">"
         else:
             raise ValueError(f"{path}: not a TIFF (bad byte-order mark)")
-        magic, ifd_off = struct.unpack(bo + "HI", hdr[2:8])
-        if magic == 43:
-            raise ValueError(f"{path}: BigTIFF is not supported")
-        if magic != 42:
+        (magic,) = struct.unpack(bo + "H", hdr[2:4])
+        if magic == 42:
+            big = False
+            (ifd_off,) = struct.unpack(bo + "I", hdr[4:8])
+        elif magic == 43:
+            big = True
+            offsize, pad = struct.unpack(bo + "HH", hdr[4:8])
+            if offsize != 8 or pad != 0:
+                raise ValueError(
+                    f"{path}: BigTIFF with offset size {offsize} (only 8 supported)"
+                )
+            (ifd_off,) = struct.unpack(bo + "Q", hdr[8:16])
+        else:
             raise ValueError(f"{path}: not a TIFF (magic={magic})")
-        tags = _read_ifd(f, bo, ifd_off)
+        tags = _read_ifd(f, bo, ifd_off, big)
 
         width = tags[_T_IMAGE_WIDTH][0]
         height = tags[_T_IMAGE_LENGTH][0]
@@ -344,6 +440,7 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             dtype=np.dtype(_DTYPES[key]),
             tiled=tiled,
             compression=compression,
+            big=big,
         )
         arr = out[0] if spp == 1 else out
         return arr, geo, info
@@ -367,9 +464,12 @@ def _predict(block: np.ndarray) -> np.ndarray:
 
 
 class _IfdBuilder:
-    """Accumulates IFD entries + out-of-line payloads for a little-endian file."""
+    """Accumulates IFD entries + out-of-line payloads for a little-endian
+    file; ``big=True`` emits the BigTIFF layout (u64 count, 20-byte entries,
+    8-byte inline values, u64 offsets)."""
 
-    def __init__(self) -> None:
+    def __init__(self, big: bool = False) -> None:
+        self.big = big
         self.entries: list[tuple[int, int, int, bytes]] = []  # tag,type,count,payload
 
     def add(self, tag: int, ftype: int, values) -> None:
@@ -386,18 +486,25 @@ class _IfdBuilder:
     def serialize(self, ifd_offset: int) -> bytes:
         self.entries.sort(key=lambda e: e[0])
         n = len(self.entries)
-        overflow_off = ifd_offset + 2 + n * 12 + 4
-        body = struct.pack("<H", n)
+        if self.big:
+            esz, inline, ptr_fmt = 20, 8, "Q"
+            body = struct.pack("<Q", n)
+            head_fmt = "<HHQ"
+        else:
+            esz, inline, ptr_fmt = 12, 4, "I"
+            body = struct.pack("<H", n)
+            head_fmt = "<HHI"
+        overflow_off = ifd_offset + len(body) + n * esz + struct.calcsize("<" + ptr_fmt)
         overflow = b""
         for tag, ftype, count, payload in self.entries:
-            body += struct.pack("<HHI", tag, ftype, count)
-            if len(payload) <= 4:
-                body += payload.ljust(4, b"\0")
+            body += struct.pack(head_fmt, tag, ftype, count)
+            if len(payload) <= inline:
+                body += payload.ljust(inline, b"\0")
             else:
-                body += struct.pack("<I", overflow_off + len(overflow))
+                body += struct.pack("<" + ptr_fmt, overflow_off + len(overflow))
                 # TIFF 6.0: value offsets must be even — pad odd payloads
                 overflow += payload + b"\0" * (len(payload) & 1)
-        body += struct.pack("<I", 0)  # no next IFD
+        body += struct.pack("<" + ptr_fmt, 0)  # no next IFD
         return body + overflow
 
 
@@ -409,6 +516,7 @@ def write_geotiff(
     tile: int | None = 256,
     predictor: bool = True,
     extra_ascii_tags: Mapping[int, str] | None = None,
+    bigtiff: bool | str = "auto",
 ) -> None:
     """Encode ``array`` (``(H, W)`` or ``(bands, H, W)``) as a GeoTIFF.
 
@@ -416,6 +524,12 @@ def write_geotiff(
     per 64 rows instead of tiles.  ``predictor`` enables horizontal
     differencing for integer dtypes under deflate (better compression on
     smooth rasters; ignored for floats and uncompressed files).
+
+    ``bigtiff``: ``"auto"`` (default) switches to the BigTIFF layout (u64
+    offsets) exactly when the encoded file would overflow classic TIFF's
+    4 GB addressing — e.g. the CONUS ARD mosaic products of the scale-out
+    config (SURVEY.md §7 hard-part 5); ``True``/``False`` force the choice
+    (forcing ``False`` on an oversized file raises).
     """
     arr = np.asarray(array)
     if arr.ndim == 2:
@@ -459,7 +573,16 @@ def write_geotiff(
 
     blocks = _encode_all(gen_blocks(), comp_id, use_pred)
 
-    data_off = 8  # blocks start right after the 8-byte header
+    data_bytes = sum(len(b) + (len(b) & 1) for b in blocks)
+    if bigtiff == "auto":
+        # worst-case size: header + aligned data + IFD bound (offset/count
+        # arrays dominate); stay a comfortable margin under 2^32
+        worst = 16 + data_bytes + 4096 + 16 * len(blocks)
+        big = worst > 2**32 - 2**16
+    else:
+        big = bool(bigtiff)
+
+    data_off = 16 if big else 8  # blocks start right after the header
     offsets: list[int] = []
     counts: list[int] = []
     pos = data_off
@@ -468,8 +591,14 @@ def write_geotiff(
         counts.append(len(b))
         pos += len(b) + (len(b) & 1)  # keep every block offset word-aligned
     ifd_off = pos
+    # check before the offsets are packed as u32 below
+    if not big and ifd_off + 4096 + 16 * len(blocks) > 2**32 - 1:
+        raise ValueError(
+            f"{path}: encoded size exceeds classic TIFF's 4 GB addressing; "
+            "use bigtiff=True (or the default bigtiff='auto')"
+        )
 
-    ifd = _IfdBuilder()
+    ifd = _IfdBuilder(big)
     ifd.add(_T_IMAGE_WIDTH, 4, (width,))
     ifd.add(_T_IMAGE_LENGTH, 4, (height,))
     ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
@@ -480,15 +609,16 @@ def write_geotiff(
     ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
     if use_pred:
         ifd.add(_T_PREDICTOR, 3, (2,))
+    off_type = 16 if big else 4  # LONG8 under BigTIFF
     if tile:
         ifd.add(_T_TILE_WIDTH, 3, (tw,))
         ifd.add(_T_TILE_LENGTH, 3, (th,))
-        ifd.add(_T_TILE_OFFSETS, 4, offsets)
-        ifd.add(_T_TILE_BYTE_COUNTS, 4, counts)
+        ifd.add(_T_TILE_OFFSETS, off_type, offsets)
+        ifd.add(_T_TILE_BYTE_COUNTS, off_type, counts)
     else:
         ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
-        ifd.add(_T_STRIP_OFFSETS, 4, offsets)
-        ifd.add(_T_STRIP_BYTE_COUNTS, 4, counts)
+        ifd.add(_T_STRIP_OFFSETS, off_type, offsets)
+        ifd.add(_T_STRIP_BYTE_COUNTS, off_type, counts)
     if geo:
         if geo.pixel_scale:
             ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
@@ -507,7 +637,10 @@ def write_geotiff(
         ifd.add(tag, 2, text)
 
     with open(path, "wb") as f:
-        f.write(struct.pack("<2sHI", b"II", 42, ifd_off))
+        if big:
+            f.write(struct.pack("<2sHHHQ", b"II", 43, 8, 0, ifd_off))
+        else:
+            f.write(struct.pack("<2sHI", b"II", 42, ifd_off))
         for b in blocks:
             f.write(b)
             if len(b) & 1:

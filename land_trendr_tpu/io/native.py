@@ -37,8 +37,9 @@ _ERR_NAMES = {
     -2: "deflate failed",
     -3: "bad argument",
     -4: "block data out of file bounds / short",
+    -5: "corrupt LZW stream",
 }
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 class NativeCodecError(RuntimeError):

@@ -28,6 +28,7 @@
 namespace {
 
 constexpr int kCompNone = 1;
+constexpr int kCompLzw = 5;
 constexpr int kCompDeflateAdobe = 8;
 constexpr int kCompDeflateOld = 32946;
 
@@ -36,6 +37,7 @@ constexpr int kErrInflate = -1;
 constexpr int kErrDeflate = -2;
 constexpr int kErrBadArg = -3;
 constexpr int kErrShortData = -4;
+constexpr int kErrLzw = -5;
 
 // Inflate `src` into exactly `dst_len` bytes of `dst`.  TIFF deflate blocks
 // are zlib streams in practice, but raw-deflate files exist (old code 32946
@@ -66,6 +68,108 @@ int inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
     return kErrInflate;
   }
   return kErrInflate;
+}
+
+// TIFF 6.0 LZW (compression 5): MSB-first bit packing, ClearCode=256,
+// EOI=257, code width 9→12 bits with the spec's "early change" (width bumps
+// when the next free code reaches 511/1023/2047).  Mirrors the Python
+// reference decoder in io/geotiff.py::_lzw_decode byte for byte; like
+// inflate_block, a stream that fills less than dst_len is corrupt and
+// extra decoded bytes beyond dst_len are tolerated (NumPy frombuffer
+// count=... semantics).
+int lzw_decode(const uint8_t* src, size_t src_len, uint8_t* dst,
+               size_t dst_len) {
+  constexpr int kClear = 256, kEoi = 257, kTable = 1 << 12;
+  static_assert(kTable == 4096, "TIFF LZW is 12-bit");
+  uint16_t prefix[kTable];
+  uint8_t suffix[kTable];
+  uint8_t firstb[kTable];
+  uint16_t length[kTable];
+  for (int c = 0; c < 256; ++c) {
+    prefix[c] = 0;
+    suffix[c] = static_cast<uint8_t>(c);
+    firstb[c] = static_cast<uint8_t>(c);
+    length[c] = 1;
+  }
+  length[kClear] = length[kEoi] = 0;
+
+  size_t out = 0;
+  size_t bitpos = 0;
+  const size_t total_bits = src_len * 8;
+  int code_bits = 9;
+  int next_code = 258;
+  int prev = -1;
+
+  auto read_code = [&]() -> int {
+    if (bitpos + static_cast<size_t>(code_bits) > total_bits) return kEoi;
+    size_t byte0 = bitpos >> 3;
+    uint32_t chunk = 0;
+    for (size_t k = 0; k < 4; ++k)
+      chunk = (chunk << 8) |
+              (byte0 + k < src_len ? src[byte0 + k] : 0u);
+    int shift = 32 - code_bits - static_cast<int>(bitpos & 7);
+    bitpos += code_bits;
+    return static_cast<int>((chunk >> shift) & ((1u << code_bits) - 1));
+  };
+  // Sequences decode last-byte-first; stage in a stack buffer, then copy
+  // the prefix that still fits (frombuffer count=... tolerance).
+  uint8_t tmp[kTable];
+  auto emit = [&](int code) {
+    int len = length[code];
+    int c = code, k = len;
+    while (k > 1) {
+      tmp[--k] = suffix[c];
+      c = prefix[c];
+    }
+    tmp[0] = suffix[c];
+    // `out` keeps counting past dst_len (overlong streams are tolerated,
+    // final fill is checked at return); only the copy is clamped, and only
+    // while there is room — out may already be past the end here.
+    if (out < dst_len) {
+      size_t n = static_cast<size_t>(len);
+      if (out + n > dst_len) n = dst_len - out;
+      std::memcpy(dst + out, tmp, n);
+    }
+    out += static_cast<size_t>(len);
+  };
+
+  while (true) {
+    int code = read_code();
+    if (code == kEoi) break;
+    if (code == kClear) {
+      code_bits = 9;
+      next_code = 258;
+      code = read_code();
+      if (code == kEoi) break;
+      if (code >= 256) return kErrLzw;  // first post-clear code is a literal
+      if (out < dst_len) dst[out] = static_cast<uint8_t>(code);
+      ++out;
+      prev = code;
+      continue;
+    }
+    if (prev < 0 || next_code >= kTable) return kErrLzw;  // no leading clear / table overflow
+    if (code < next_code) {
+      // existing entry; new table slot = prev_seq + first byte of code_seq
+      prefix[next_code] = static_cast<uint16_t>(prev);
+      suffix[next_code] = firstb[code];
+      firstb[next_code] = firstb[prev];
+      length[next_code] = static_cast<uint16_t>(length[prev] + 1);
+      emit(code);
+    } else if (code == next_code) {
+      // KwKwK: entry = prev_seq + first byte of prev_seq, emitted itself
+      prefix[next_code] = static_cast<uint16_t>(prev);
+      suffix[next_code] = firstb[prev];
+      firstb[next_code] = firstb[prev];
+      length[next_code] = static_cast<uint16_t>(length[prev] + 1);
+      emit(code);
+    } else {
+      return kErrLzw;  // code beyond table: corrupt stream
+    }
+    ++next_code;
+    if (next_code == (1 << code_bits) - 1 && code_bits < 12) ++code_bits;
+    prev = code;
+  }
+  return out >= dst_len ? kOk : kErrShortData;
 }
 
 // Undo TIFF predictor 2 (horizontal differencing): within each row, each
@@ -149,8 +253,9 @@ int run_blocks(int n_blocks, int n_threads, Fn&& per_block) {
 
 extern "C" {
 
-// ABI version — bump on any signature change; the ctypes binding checks it.
-int lt_native_abi_version() { return 2; }
+// ABI version — bump on any signature or behaviour-surface change (v3 adds
+// LZW decode support); the ctypes binding checks it.
+int lt_native_abi_version() { return 3; }
 
 // Decode n_blocks TIFF blocks from a memory-mapped/loaded file image.
 //
@@ -178,7 +283,7 @@ int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
   if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
     return kErrBadArg;
   if (compression != kCompNone && compression != kCompDeflateAdobe &&
-      compression != kCompDeflateOld)
+      compression != kCompDeflateOld && compression != kCompLzw)
     return kErrBadArg;
   if (predictor == 2 && elem_size == 8) return kErrBadArg;  // floats only
   const size_t row_bytes = static_cast<size_t>(width) * spp * elem_size;
@@ -196,6 +301,9 @@ int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
     if (compression == kCompNone) {
       if (counts[i] < want) return kErrShortData;
       std::memcpy(dst, src, want);
+    } else if (compression == kCompLzw) {
+      int rc = lzw_decode(src, counts[i], dst, want);
+      if (rc != kOk) return rc;
     } else {
       int rc = inflate_block(src, counts[i], dst, want);
       if (rc != kOk) return rc;

@@ -43,3 +43,54 @@ def test_f32_statistical_equivalence(rng):
     assert np.quantile(np.abs(d_rmse), 0.95) < 0.1
     # the f32 fits are never catastrophically worse
     assert np.max(d_rmse) < 0.25
+
+
+def _mixed_population(rng, px, ny=40):
+    """Small-scale version of tools/parity_f32.py::make_population."""
+    years = np.arange(1984, 1984 + ny, dtype=np.int32)
+    t = np.arange(ny, dtype=np.float64)[None, :]
+    kind = rng.integers(0, 5, size=(px, 1))
+    base = rng.uniform(0.45, 0.75, size=(px, 1))
+    d_year = rng.integers(4, ny - 4, size=(px, 1))
+    mag = rng.uniform(0.1, 0.5, size=(px, 1))
+    rec = rng.uniform(0.02, 0.15, size=(px, 1))
+    dt = np.maximum(t - d_year, 0.0)
+    disturbance = np.where(t >= d_year, mag * np.exp(-rec * dt), 0.0)
+    step = np.where(t >= d_year, mag, 0.0)
+    trend = rng.uniform(-0.01, 0.01, size=(px, 1)) * t
+    walk = np.cumsum(rng.normal(0, 0.03, size=(px, ny)), axis=1)
+    traj = base - np.where(
+        kind == 0, disturbance,
+        np.where(kind == 1, step,
+                 np.where(kind == 2, trend,
+                          np.where(kind == 3, walk * 0.2, 0.0))),
+    )
+    traj += rng.normal(0.0, 0.012, size=(px, ny))
+    mask = rng.uniform(size=(px, ny)) > 0.08
+    return years, -traj, mask
+
+
+def test_f32_exact_vertex_agreement_floor(rng):
+    """Gate on the measured f32-vs-f64 exact-vertex agreement rate
+    (PARITY_f32.json artifact: ≳99.99% over 1M pixels with the log-space
+    model-selection score; floor set at 99.5% for sample noise).
+
+    This is the regression guard for the float32 selection hardening in
+    ``_f_stat_p_and_logp`` — before it, betainc underflow dropped
+    agreement to ~99.7% with systematic model-family misselection on
+    strong-signal pixels."""
+    px = 8192
+    years, vals, mask = _mixed_population(rng, px)
+    params = LTParams()
+    out64 = jax_segment_pixels(years, vals, mask, params)
+    out32 = jax_segment_pixels(years, vals.astype(np.float32), mask, params)
+
+    agree = (
+        (np.asarray(out64.model_valid) == np.asarray(out32.model_valid))
+        & (np.asarray(out64.n_vertices) == np.asarray(out32.n_vertices))
+        & (np.asarray(out64.vertex_indices) == np.asarray(out32.vertex_indices)).all(
+            axis=1
+        )
+    )
+    rate = agree.mean()
+    assert rate >= 0.995, f"f32 exact-vertex agreement {rate:.4%} below floor"
