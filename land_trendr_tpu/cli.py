@@ -28,6 +28,7 @@ import argparse
 import dataclasses
 import json
 import logging
+import os
 import sys
 
 from land_trendr_tpu.config import LTParams
@@ -67,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native LandTrendr temporal segmentation",
     )
     p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument(
+        "--platform",
+        default=os.environ.get("LT_PLATFORM"),
+        help="force the JAX platform (e.g. 'cpu', 'tpu'); defaults to the "
+        "LT_PLATFORM env var, else JAX's own selection.  Needed because an "
+        "interpreter boot hook may pin jax_platforms programmatically, "
+        "which outranks the JAX_PLATFORMS env var — without this a CPU run "
+        "on a machine whose TPU is unreachable hangs in backend init",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     seg = sub.add_parser("segment", help="segment a Landsat stack directory")
@@ -217,6 +227,12 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
         stream=sys.stderr,
     )
+
+    if args.platform:
+        # must land before any jax.devices() call anywhere below
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.cmd == "params":
         print(_params_from_args(args).to_json())

@@ -135,6 +135,8 @@ class TiffInfo:
 def _read_ifd(f: BinaryIO, bo: str, off: int, big: bool = False) -> dict[int, tuple]:
     """Parse one IFD; ``big`` selects BigTIFF layout (u64 entry count,
     20-byte entries with 8-byte inline values, u64 value offsets)."""
+    f.seek(0, 2)
+    file_size = f.tell()
     f.seek(off)
     if big:
         (n,) = struct.unpack(bo + "Q", f.read(8))
@@ -157,6 +159,14 @@ def _read_ifd(f: BinaryIO, bo: str, off: int, big: bool = False) -> dict[int, tu
             continue
         ch, sz = _FIELD_TYPES[ftype]  # sz already totals both LONGs for RATIONAL
         total = sz * count
+        # the on-disk count is untrusted: an out-of-line payload can never be
+        # larger than the file itself, so a corrupt huge count must fail
+        # parsing here, not drive f.read() into a multi-TB allocation
+        if total > file_size:
+            raise ValueError(
+                f"corrupt TIFF IFD: tag {tag} payload {total} bytes exceeds "
+                f"file size {file_size}"
+            )
         val_off = k * esz + (esz - inline)
         if total <= inline:
             payload = raw[val_off : val_off + total]
@@ -216,6 +226,8 @@ def _lzw_decode(data: bytes) -> bytes:
             code_bits = 9
             next_code = 258
             code = read_code()
+            while code == CLEAR:  # libtiff tolerates consecutive Clear codes
+                code = read_code()
             if code == EOI:
                 break
             if code >= 256:
@@ -456,6 +468,10 @@ def _block(f: BinaryIO, offset: int, count: int, compression: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 
+class _ClassicOverflow(Exception):
+    """Encoded file does not fit classic TIFF's u32 addressing."""
+
+
 def _predict(block: np.ndarray) -> np.ndarray:
     """Apply horizontal differencing along the row axis (predictor 2)."""
     out = block.copy()
@@ -573,68 +589,86 @@ def write_geotiff(
 
     blocks = _encode_all(gen_blocks(), comp_id, use_pred)
 
-    data_bytes = sum(len(b) + (len(b) & 1) for b in blocks)
+    def layout(big: bool) -> tuple[list[int], list[int], int, bytes]:
+        """Exact file layout for one format choice: block offsets/counts,
+        IFD offset, and the fully serialized IFD (including all out-of-line
+        payloads — geo keys, ascii tags, offset/count arrays), so the 4 GB
+        decision below is based on real sizes, not a heuristic bound."""
+        data_off = 16 if big else 8  # blocks start right after the header
+        offsets: list[int] = []
+        counts: list[int] = []
+        pos = data_off
+        for b in blocks:
+            offsets.append(pos)
+            counts.append(len(b))
+            pos += len(b) + (len(b) & 1)  # keep block offsets word-aligned
+        ifd_off = pos
+        try:
+            ifd_bytes = _build_ifd(big, ifd_off, offsets, counts)
+        except struct.error as e:
+            # a block or payload offset overflowed u32 — either while packing
+            # the offset arrays in add() or an out-of-line pointer in
+            # serialize(); both mean "does not fit classic"
+            raise _ClassicOverflow(str(e)) from e
+        if not big and ifd_off + len(ifd_bytes) > 2**32 - 1:
+            raise _ClassicOverflow(f"file ends at {ifd_off + len(ifd_bytes)} bytes")
+        return offsets, counts, ifd_off, ifd_bytes
+
+    def _build_ifd(big: bool, ifd_off: int, offsets, counts) -> bytes:
+        ifd = _IfdBuilder(big)
+        ifd.add(_T_IMAGE_WIDTH, 4, (width,))
+        ifd.add(_T_IMAGE_LENGTH, 4, (height,))
+        ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
+        ifd.add(_T_COMPRESSION, 3, (comp_id,))
+        ifd.add(_T_PHOTOMETRIC, 3, (1,))  # BlackIsZero
+        ifd.add(_T_SAMPLES_PER_PIXEL, 3, (spp,))
+        ifd.add(_T_PLANAR_CONFIG, 3, (1,))
+        ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
+        if use_pred:
+            ifd.add(_T_PREDICTOR, 3, (2,))
+        off_type = 16 if big else 4  # LONG8 under BigTIFF
+        if tile:
+            ifd.add(_T_TILE_WIDTH, 3, (tw,))
+            ifd.add(_T_TILE_LENGTH, 3, (th,))
+            ifd.add(_T_TILE_OFFSETS, off_type, offsets)
+            ifd.add(_T_TILE_BYTE_COUNTS, off_type, counts)
+        else:
+            ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
+            ifd.add(_T_STRIP_OFFSETS, off_type, offsets)
+            ifd.add(_T_STRIP_BYTE_COUNTS, off_type, counts)
+        if geo:
+            if geo.pixel_scale:
+                ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
+            if geo.tiepoint:
+                ifd.add(_T_MODEL_TIEPOINT, 12, geo.tiepoint)
+            if geo.geo_key_directory:
+                ifd.add(_T_GEO_KEY_DIRECTORY, 3, geo.geo_key_directory)
+            if geo.geo_double_params:
+                ifd.add(_T_GEO_DOUBLE_PARAMS, 12, geo.geo_double_params)
+            if geo.geo_ascii_params:
+                ifd.add(_T_GEO_ASCII_PARAMS, 2, geo.geo_ascii_params)
+            if geo.nodata is not None:
+                ifd.add(_T_GDAL_NODATA, 2, ("%g" % geo.nodata))
+        for tag, text in (extra_ascii_tags or {}).items():
+            ifd.add(tag, 2, text)
+        return ifd.serialize(ifd_off)
+
     if bigtiff == "auto":
-        # worst-case size: header + aligned data + IFD bound (offset/count
-        # arrays dominate); stay a comfortable margin under 2^32
-        worst = 16 + data_bytes + 4096 + 16 * len(blocks)
-        big = worst > 2**32 - 2**16
+        try:
+            big = False
+            offsets, counts, ifd_off, ifd_bytes = layout(False)
+        except _ClassicOverflow:
+            big = True
+            offsets, counts, ifd_off, ifd_bytes = layout(True)
     else:
         big = bool(bigtiff)
-
-    data_off = 16 if big else 8  # blocks start right after the header
-    offsets: list[int] = []
-    counts: list[int] = []
-    pos = data_off
-    for b in blocks:
-        offsets.append(pos)
-        counts.append(len(b))
-        pos += len(b) + (len(b) & 1)  # keep every block offset word-aligned
-    ifd_off = pos
-    # check before the offsets are packed as u32 below
-    if not big and ifd_off + 4096 + 16 * len(blocks) > 2**32 - 1:
-        raise ValueError(
-            f"{path}: encoded size exceeds classic TIFF's 4 GB addressing; "
-            "use bigtiff=True (or the default bigtiff='auto')"
-        )
-
-    ifd = _IfdBuilder(big)
-    ifd.add(_T_IMAGE_WIDTH, 4, (width,))
-    ifd.add(_T_IMAGE_LENGTH, 4, (height,))
-    ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
-    ifd.add(_T_COMPRESSION, 3, (comp_id,))
-    ifd.add(_T_PHOTOMETRIC, 3, (1,))  # BlackIsZero
-    ifd.add(_T_SAMPLES_PER_PIXEL, 3, (spp,))
-    ifd.add(_T_PLANAR_CONFIG, 3, (1,))
-    ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
-    if use_pred:
-        ifd.add(_T_PREDICTOR, 3, (2,))
-    off_type = 16 if big else 4  # LONG8 under BigTIFF
-    if tile:
-        ifd.add(_T_TILE_WIDTH, 3, (tw,))
-        ifd.add(_T_TILE_LENGTH, 3, (th,))
-        ifd.add(_T_TILE_OFFSETS, off_type, offsets)
-        ifd.add(_T_TILE_BYTE_COUNTS, off_type, counts)
-    else:
-        ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
-        ifd.add(_T_STRIP_OFFSETS, off_type, offsets)
-        ifd.add(_T_STRIP_BYTE_COUNTS, off_type, counts)
-    if geo:
-        if geo.pixel_scale:
-            ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
-        if geo.tiepoint:
-            ifd.add(_T_MODEL_TIEPOINT, 12, geo.tiepoint)
-        if geo.geo_key_directory:
-            ifd.add(_T_GEO_KEY_DIRECTORY, 3, geo.geo_key_directory)
-        if geo.geo_double_params:
-            ifd.add(_T_GEO_DOUBLE_PARAMS, 12, geo.geo_double_params)
-        if geo.geo_ascii_params:
-            ifd.add(_T_GEO_ASCII_PARAMS, 2, geo.geo_ascii_params)
-        if geo.nodata is not None:
-            nd = geo.nodata
-            ifd.add(_T_GDAL_NODATA, 2, ("%g" % nd))
-    for tag, text in (extra_ascii_tags or {}).items():
-        ifd.add(tag, 2, text)
+        try:
+            offsets, counts, ifd_off, ifd_bytes = layout(big)
+        except _ClassicOverflow as e:
+            raise ValueError(
+                f"{path}: encoded size exceeds classic TIFF's 4 GB addressing "
+                f"({e}); use bigtiff=True (or the default bigtiff='auto')"
+            ) from e
 
     with open(path, "wb") as f:
         if big:
@@ -645,7 +679,7 @@ def write_geotiff(
             f.write(b)
             if len(b) & 1:
                 f.write(b"\0")
-        f.write(ifd.serialize(ifd_off))
+        f.write(ifd_bytes)
 
 
 def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:

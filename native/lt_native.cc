@@ -139,7 +139,9 @@ int lzw_decode(const uint8_t* src, size_t src_len, uint8_t* dst,
     if (code == kClear) {
       code_bits = 9;
       next_code = 258;
-      code = read_code();
+      do {  // libtiff tolerates consecutive Clear codes
+        code = read_code();
+      } while (code == kClear);
       if (code == kEoi) break;
       if (code >= 256) return kErrLzw;  // first post-clear code is a literal
       if (out < dst_len) dst[out] = static_cast<uint8_t>(code);

@@ -335,3 +335,148 @@ def test_classic_overflow_forced_raises(tmp_path, rng, monkeypatch):
     monkeypatch.setattr(gt, "_encode_all", fake_encode)
     with pytest.raises(ValueError, match="4 GB"):
         gt.write_geotiff(str(tmp_path / "x.tif"), arr, bigtiff=False)
+
+
+# ---------------------------------------------------------------------------
+# Round-2 advisor hardening (ADVICE.md r2)
+# ---------------------------------------------------------------------------
+
+
+def _pack_lzw(codes, width=9):
+    """MSB-first bit-pack fixed-width LZW codes (all test streams stay 9-bit)."""
+    bits = "".join(format(c, f"0{width}b") for c in codes)
+    bits += "0" * (-len(bits) % 8)
+    return bytes(int(bits[i : i + 8], 2) for i in range(0, len(bits), 8))
+
+
+def test_lzw_consecutive_clear_codes():
+    """libtiff tolerates Clear immediately followed by another Clear; rare
+    but legal streams from other encoders must read (ADVICE r2)."""
+    from land_trendr_tpu.io.geotiff import _lzw_decode
+
+    # leading double clear: CLEAR CLEAR 'A' 'B' EOI
+    assert _lzw_decode(_pack_lzw([256, 256, 65, 66, 257])) == b"AB"
+    # mid-stream double clear: CLEAR 'A' CLEAR CLEAR 'B' EOI
+    assert _lzw_decode(_pack_lzw([256, 65, 256, 256, 66, 257])) == b"AB"
+
+
+def test_lzw_consecutive_clear_codes_native(tmp_path):
+    """Same tolerance in the C++ fast path, exercised through a hand-built
+    LZW TIFF read both natively and via the pure-Python reference."""
+    import struct
+
+    from land_trendr_tpu.io import native
+    from land_trendr_tpu.io.geotiff import _IfdBuilder
+
+    if not native.available():
+        pytest.skip("native library not built")
+
+    stream = _pack_lzw([256, 256, 65, 256, 256, 66, 257])  # decodes to b"AB"
+    ifd = _IfdBuilder()
+    ifd.add(256, 4, (2,))            # ImageWidth
+    ifd.add(257, 4, (1,))            # ImageLength
+    ifd.add(258, 3, (8,))            # BitsPerSample
+    ifd.add(259, 3, (5,))            # Compression: LZW
+    ifd.add(262, 3, (1,))            # Photometric
+    ifd.add(273, 4, (8,))            # StripOffsets
+    ifd.add(277, 3, (1,))            # SamplesPerPixel
+    ifd.add(278, 3, (1,))            # RowsPerStrip
+    ifd.add(279, 4, (len(stream),))  # StripByteCounts
+    ifd.add(339, 3, (1,))            # SampleFormat
+
+    p = str(tmp_path / "dclear.tif")
+    ifd_off = 8 + len(stream) + (len(stream) & 1)
+    with open(p, "wb") as f:
+        f.write(struct.pack("<2sHI", b"II", 42, ifd_off))
+        f.write(stream.ljust(ifd_off - 8, b"\0"))
+        f.write(ifd.serialize(ifd_off))
+
+    got_nat, _, info = read_geotiff(p)
+    assert info.compression == 5
+    saved = native._LIB
+    try:
+        native._LIB = None
+        got_py, _, _ = read_geotiff(p)
+    finally:
+        native._LIB = saved
+    np.testing.assert_array_equal(got_nat, np.array([[65, 66]], dtype=np.uint8))
+    np.testing.assert_array_equal(got_nat, got_py)
+
+
+def test_reject_huge_ifd_payload_count(tmp_path):
+    """A corrupt entry whose payload exceeds the file size fails with a clean
+    parse error, not a multi-GB read attempt (ADVICE r2)."""
+    import struct
+
+    p = str(tmp_path / "corrupt.tif")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<2sHI", b"II", 42, 8))
+        f.write(struct.pack("<H", 1))
+        # one LONG entry claiming 2^30 values → 4 GB payload in a 26-byte file
+        f.write(struct.pack("<HHII", 256, 4, 2**30, 8))
+        f.write(struct.pack("<I", 0))
+    with pytest.raises(ValueError, match="exceeds"):
+        read_geotiff(p)
+
+
+def test_bigtiff_auto_accounts_for_ifd_payloads(tmp_path, rng, monkeypatch):
+    """Near the 4 GB boundary, large out-of-line IFD payloads (e.g. a big
+    ascii tag) must flip bigtiff='auto' to the BigTIFF layout instead of
+    overflowing classic offsets at serialize time (ADVICE r2)."""
+    import struct
+
+    import land_trendr_tpu.io.geotiff as gt
+
+    arr = _rand(rng, "u2", (64, 64))
+    real_encode = gt._encode_all
+
+    def fake_encode(blocks, comp_id, use_pred):
+        out = real_encode(blocks, comp_id, use_pred)
+
+        class HugeBytes(bytes):
+            def __len__(self):
+                return 2**32 - 2**20  # data alone still fits classic
+
+        return [HugeBytes(out[0])]
+
+    monkeypatch.setattr(gt, "_encode_all", fake_encode)
+    # 2 MB ascii payload pushes the serialized IFD past 2^32
+    p = str(tmp_path / "auto.tif")
+    gt.write_geotiff(
+        p, arr, extra_ascii_tags={42112: "x" * 2**21}, bigtiff="auto"
+    )
+    with open(p, "rb") as f:
+        hdr = f.read(4)
+    assert struct.unpack("<H", hdr[2:4])[0] == 43  # switched to BigTIFF
+
+
+def test_bigtiff_auto_switches_on_block_offset_overflow(tmp_path, rng, monkeypatch):
+    """Multiple blocks whose later offsets exceed u32 — the packing of the
+    offset ARRAY (not just the IFD tail) must trigger the auto-switch, not
+    escape as a raw struct.error (code-review r3)."""
+    import struct
+
+    import land_trendr_tpu.io.geotiff as gt
+
+    arr = _rand(rng, "u2", (64, 64))
+    real_encode = gt._encode_all
+
+    def fake_encode(blocks, comp_id, use_pred):
+        out = real_encode(blocks, comp_id, use_pred)
+
+        class HugeBytes(bytes):
+            def __len__(self):
+                return 2**31  # three of these put block 3's offset past 2^32
+
+        return [HugeBytes(out[0])] * 3
+
+    monkeypatch.setattr(gt, "_encode_all", fake_encode)
+    p = str(tmp_path / "multi.tif")
+    gt.write_geotiff(p, arr, bigtiff="auto")
+    with open(p, "rb") as f:
+        hdr = f.read(4)
+    assert struct.unpack("<H", hdr[2:4])[0] == 43  # switched to BigTIFF
+
+    # forcing classic on the same data keeps the friendly error
+    with pytest.raises(ValueError, match="4 GB"):
+        gt.write_geotiff(str(tmp_path / "forced.tif"), arr, bigtiff=False)

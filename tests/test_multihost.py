@@ -140,10 +140,6 @@ def test_two_process_distributed_matches_single(tmp_path):
     import sys
 
     worker = os.path.join(os.path.dirname(__file__), "_distributed_worker.py")
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-    coordinator = f"localhost:{port}"
 
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -155,26 +151,57 @@ def test_two_process_distributed_matches_single(tmp_path):
         + env["PYTHONPATH"]
     )
 
-    procs = []
     outs = [str(tmp_path / f"worker{i}.npz") for i in range(2)]
-    for i in range(2):
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, worker, coordinator, "2", str(i), outs[i]],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
+
+    def launch_once() -> tuple[bool, str]:
+        """One full launch on a fresh ephemeral port.  Returns (retryable,
+        error).  The bind/close/reuse port pick is a TOCTOU race — another
+        process can claim the port before worker 0 binds it — so a
+        bind-failure outcome is retried by the caller on a new port."""
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        coordinator = f"localhost:{port}"
+        procs = []
+        for i in range(2):
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, worker, coordinator, "2", str(i), outs[i]],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
             )
-        )
-    for i, p in enumerate(procs):
-        try:
-            _, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
+        def reap_all() -> None:
             for q in procs:
-                q.kill()
-            pytest.fail(f"worker {i} timed out")
-        assert p.returncode == 0, f"worker {i} failed:\n{err[-4000:]}"
+                if q.poll() is None:
+                    q.kill()
+                q.communicate()  # drain pipes so nothing blocks on PIPE
+
+        for i, p in enumerate(procs):
+            try:
+                _, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                reap_all()
+                return False, f"worker {i} timed out"
+            if p.returncode != 0:
+                # the sibling is still dialing a coordinator that will never
+                # exist — kill it before the retry races it on outs[]
+                reap_all()
+                lowered = err.lower()
+                retryable = "address already in use" in lowered or "bind" in lowered
+                return retryable, f"worker {i} failed:\n{err[-4000:]}"
+        return False, ""
+
+    for _attempt in range(3):
+        retryable, error = launch_once()
+        if not error:
+            break
+        if not retryable:
+            pytest.fail(error)
+    else:
+        pytest.fail(f"all port attempts raced: {error}")
 
     # single-process reference on the SAME deterministic scene
     from tests._distributed_worker import make_scene

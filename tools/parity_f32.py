@@ -15,11 +15,17 @@ taxonomy:
 * ``placement``   — same count, at least one vertex index differs;
 * ``exact``       — identical vertex_indices + n_vertices + model_valid.
 
-Usage: python tools/parity_f32.py [n_pixels] [out.json]
-(default 1,048,576 pixels in 64K chunks; runs on CPU — f32 rounding there
-is the same IEEE arithmetic the TPU's VPU applies outside the MXU, while
-fusion-order effects remain platform-specific and are covered by the f32
-tolerance contract in ops/segment.py.)
+Usage: python tools/parity_f32.py [n_pixels] [out.json] [--platform=cpu]
+(default 1,048,576 pixels in 64K chunks.  --platform defaults to cpu — f32
+rounding there is the same IEEE arithmetic the TPU's VPU applies outside
+the MXU — but fusion-order effects ARE platform-specific, so the number
+the north star cares about is --platform=tpu on real hardware; the
+``platform`` field in the artifact records which one was measured.  The
+f32 tolerance contract itself lives in ops/segment.py.)
+
+NOTE: the f64 side requires x64 support; on TPU (no native f64) the f64
+reference pass still runs through XLA's f64 emulation, which is slow but
+correct — the tool warns and proceeds.
 """
 
 from __future__ import annotations
@@ -30,7 +36,23 @@ import time
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+_platform = "cpu"
+_argv = sys.argv[1:]
+_i = 0
+while _i < len(_argv):
+    if _argv[_i].startswith("--platform"):
+        if "=" in _argv[_i]:
+            _platform = _argv[_i].split("=", 1)[1]
+            del _argv[_i]
+        else:
+            if _i + 1 >= len(_argv):
+                sys.exit("--platform requires a value (e.g. --platform=tpu)")
+            _platform = _argv[_i + 1]
+            del _argv[_i : _i + 2]
+        continue
+    _i += 1
+sys.argv[1:] = _argv
+jax.config.update("jax_platforms", _platform)
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
@@ -81,6 +103,16 @@ def main() -> int:
 
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    plat = jax.devices()[0].platform
+    if plat != "cpu":
+        print(
+            f"parity_f32: platform={plat} has no native f64 — the f64 "
+            "reference pass runs under XLA's f64 emulation (slow but "
+            "correct); expect a long runtime",
+            file=sys.stderr,
+            flush=True,
+        )
 
     params = LTParams()
     counts = {"exact": 0, "valid_flip": 0, "count_diff": 0, "placement": 0}
