@@ -496,7 +496,13 @@ class _IfdBuilder:
         else:
             vals = tuple(values)
             count = len(vals)
-            payload = struct.pack("<" + ch * count, *vals)
+            try:
+                payload = struct.pack("<" + ch * count, *vals)
+            except struct.error as e:
+                raise ValueError(
+                    f"TIFF tag {tag}: value out of range for field type "
+                    f"{ftype}: {e}"
+                ) from e
         self.entries.append((tag, ftype, count, payload))
 
     def serialize(self, ifd_offset: int) -> bytes:
@@ -603,13 +609,15 @@ def write_geotiff(
             counts.append(len(b))
             pos += len(b) + (len(b) & 1)  # keep block offsets word-aligned
         ifd_off = pos
-        try:
-            ifd_bytes = _build_ifd(big, ifd_off, offsets, counts)
-        except struct.error as e:
-            # a block or payload offset overflowed u32 — either while packing
-            # the offset arrays in add() or an out-of-line pointer in
-            # serialize(); both mean "does not fit classic"
-            raise _ClassicOverflow(str(e)) from e
+        # classic-u32 bounds are checked EXPLICITLY here and at serialize
+        # time only — a struct.error from tag *values* (e.g. an out-of-range
+        # geo key SHORT) is a genuine input error in both layouts and
+        # propagates as-is instead of masquerading as "file too big"
+        if not big and offsets and offsets[-1] + counts[-1] > 2**32 - 1:
+            raise _ClassicOverflow(
+                f"block data ends at {offsets[-1] + counts[-1]} bytes"
+            )
+        ifd_bytes = _build_ifd(big, ifd_off, offsets, counts)
         if not big and ifd_off + len(ifd_bytes) > 2**32 - 1:
             raise _ClassicOverflow(f"file ends at {ifd_off + len(ifd_bytes)} bytes")
         return offsets, counts, ifd_off, ifd_bytes
@@ -651,7 +659,13 @@ def write_geotiff(
                 ifd.add(_T_GDAL_NODATA, 2, ("%g" % geo.nodata))
         for tag, text in (extra_ascii_tags or {}).items():
             ifd.add(tag, 2, text)
-        return ifd.serialize(ifd_off)
+        try:
+            return ifd.serialize(ifd_off)
+        except struct.error as e:
+            if big:
+                raise  # not a 4 GB problem: bad tag values
+            # an out-of-line payload pointer overflowed classic's u32
+            raise _ClassicOverflow(str(e)) from e
 
     if bigtiff == "auto":
         try:

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Opportunistic TPU bench: retry all round long, commit-ready artifact on
+# first success (VERDICT r2 next-round item #1: "adapt to the environment
+# instead of timing out against it").
+cd /root/repo
+LOG=/root/repo/BENCH_r03_attempts.log
+for i in $(seq 1 40); do
+  echo "[$(date -u +%FT%TZ)] attempt $i starting" >> "$LOG"
+  out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=3600 python bench.py 2>>"$LOG")
+  echo "[$(date -u +%FT%TZ)] attempt $i result: $out" >> "$LOG"
+  val=$(echo "$out" | python -c "import sys,json;print(json.loads(sys.stdin.readline())['value'])" 2>/dev/null)
+  if [ -n "$val" ] && [ "$val" != "0.0" ] && [ "$val" != "0" ]; then
+    echo "$out" > /root/repo/BENCH_r03.json
+    echo "[$(date -u +%FT%TZ)] SUCCESS — BENCH_r03.json written" >> "$LOG"
+    exit 0
+  fi
+  sleep 900
+done
+echo "[$(date -u +%FT%TZ)] exhausted all attempts without a TPU number" >> "$LOG"
+exit 1

@@ -40,7 +40,7 @@ _platform = "cpu"
 _argv = sys.argv[1:]
 _i = 0
 while _i < len(_argv):
-    if _argv[_i].startswith("--platform"):
+    if _argv[_i] == "--platform" or _argv[_i].startswith("--platform="):
         if "=" in _argv[_i]:
             _platform = _argv[_i].split("=", 1)[1]
             del _argv[_i]
@@ -105,11 +105,11 @@ def main() -> int:
     from land_trendr_tpu.ops.segment import jax_segment_pixels
 
     plat = jax.devices()[0].platform
-    if plat != "cpu":
+    if plat == "tpu":
         print(
-            f"parity_f32: platform={plat} has no native f64 — the f64 "
-            "reference pass runs under XLA's f64 emulation (slow but "
-            "correct); expect a long runtime",
+            "parity_f32: TPUs have no native f64 — the f64 reference pass "
+            "runs under XLA's f64 emulation (slow but correct); expect a "
+            "long runtime",
             file=sys.stderr,
             flush=True,
         )

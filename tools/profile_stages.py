@@ -1,0 +1,195 @@
+"""Per-stage kernel time attribution from a JAX profiler trace.
+
+VERDICT r2 next-round item #7: commit a CPU stage-share profile so the
+kernel-efficiency question ("does ``_despike``'s fixed-NY loop or
+``_find_candidates``' membership recompute dominate?") is answered with a
+measurement instead of a guess.  Not a TPU substitute — a slack-finder.
+
+How it works (the named_scope → trace join):
+
+1. compile the kernel for the profiled shape and parse the *optimized* HLO
+   text: every instruction line carries ``metadata={op_name="...
+   lt_<stage>..."}``, giving an instruction-name → stage map that survives
+   XLA fusion (fusions inherit their root's op_name);
+2. run :func:`land_trendr_tpu.utils.profiling.profile_op` (warm-up
+   excluded, N steady-state iterations) and parse the resulting
+   ``*.xplane.pb`` with a minimal vendored schema mirror
+   (``tools/_proto/lt_xplane.proto`` — the tensorboard plugin's generated
+   protos are incompatible with this environment's protobuf);
+3. trace spans nest (a ``while`` thunk contains its body's fusion spans),
+   so per-event SELF time is computed with an interval stack before
+   aggregating by stage — no double counting;
+4. stage shares are reported over kernel-attributed self time; runtime /
+   scheduler spans (ThunkExecutor etc.) are reported separately.
+
+Usage: python tools/profile_stages.py [px] [out.json] [--platform=cpu]
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import re
+import sys
+import tempfile
+
+import jax
+
+_platform = "cpu"
+_argv = sys.argv[1:]
+_i = 0
+while _i < len(_argv):
+    if _argv[_i] == "--platform" or _argv[_i].startswith("--platform="):
+        if "=" in _argv[_i]:
+            _platform = _argv[_i].split("=", 1)[1]
+            del _argv[_i]
+        else:
+            if _i + 1 >= len(_argv):
+                sys.exit("--platform requires a value (e.g. --platform=tpu)")
+            _platform = _argv[_i + 1]
+            del _argv[_i : _i + 2]
+        continue
+    _i += 1
+sys.argv[1:] = _argv
+jax.config.update("jax_platforms", _platform)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "_proto"))
+
+
+def build_scope_map(hlo_text: str, scopes: tuple[str, ...]) -> dict[str, str]:
+    """instruction name → first matching lt_* scope in its op_name."""
+    out: dict[str, str] = {}
+    inst = re.compile(r"%?([\w.-]+)\s*=")
+    opname = re.compile(r'op_name="([^"]*)"')
+    for line in hlo_text.splitlines():
+        o = opname.search(line)
+        if not o:
+            continue
+        m = inst.search(line)
+        if not m:
+            continue
+        for s in scopes:
+            if s in o.group(1):
+                out[m.group(1)] = s
+                break
+    return out
+
+
+def self_times(plane) -> dict[str, float]:
+    """Event-name → self seconds across all lines, nesting-aware."""
+    acc: collections.Counter[str] = collections.Counter()
+    for line in plane.lines:
+        evs = sorted(
+            (
+                (ev.offset_ps, ev.duration_ps, plane.event_metadata[ev.metadata_id].name)
+                for ev in line.events
+                if not plane.event_metadata[ev.metadata_id].name.startswith("end:")
+            ),
+            key=lambda t: (t[0], -t[1]),
+        )
+        stack: list[list] = []  # [end_ps, name, self_ps]
+        for off, dur, name in evs:
+            while stack and stack[-1][0] <= off:
+                end, n, s = stack.pop()
+                acc[n] += s
+            if stack:
+                stack[-1][2] -= dur  # child time is not parent self time
+            stack.append([off + dur, name, dur])
+        while stack:
+            end, n, s = stack.pop()
+            acc[n] += s
+    return {k: v / 1e12 for k, v in acc.items()}
+
+
+def main() -> int:
+    px = int(sys.argv[1]) if len(sys.argv) > 1 else 65_536
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "PROFILE_r03.json"
+    iters = int(os.environ.get("LT_PROFILE_ITERS", 3))
+
+    import numpy as np
+
+    from bench import make_series
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+    from land_trendr_tpu.utils.profiling import STAGE_SCOPES, profile_op
+
+    import lt_xplane_pb2
+
+    params = LTParams()
+    years, vals, mask = make_series(px, 40)
+
+    print(f"profile_stages: compiling for px={px} ...", file=sys.stderr, flush=True)
+    compiled = jax.jit(jax_segment_pixels, static_argnums=3).lower(
+        years, vals, mask, params
+    ).compile()
+    scope_map = build_scope_map(compiled.as_text(), tuple(STAGE_SCOPES))
+    print(
+        f"profile_stages: {len(scope_map)} instructions mapped to stages",
+        file=sys.stderr,
+        flush=True,
+    )
+
+    logdir = tempfile.mkdtemp(prefix="lt_profile_")
+    r = profile_op(jax_segment_pixels, years, vals, mask, params, logdir=logdir, iters=iters)
+
+    pbs = sorted(
+        glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not pbs:
+        sys.exit(f"no xplane.pb under {logdir}")
+    xs = lt_xplane_pb2.XSpace()
+    with open(pbs[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+
+    stage_s: collections.Counter[str] = collections.Counter()
+    runtime_s = 0.0
+    unmapped: collections.Counter[str] = collections.Counter()
+    for plane in xs.planes:
+        if not plane.lines:
+            continue
+        for name, secs in self_times(plane).items():
+            if name in scope_map:
+                stage_s[scope_map[name]] += secs
+            elif re.match(r"[\w-]+(\.\d+)?$", name) and (
+                "fusion" in name
+                or name.startswith(("while", "wrapped_", "copy", "bitcast", "convert"))
+            ):
+                unmapped[name] += secs
+            else:
+                runtime_s += secs
+
+    kernel_total = sum(stage_s.values())
+    unmapped_total = sum(unmapped.values())
+    record = {
+        "n_pixels": px,
+        "n_years": 40,
+        "platform": jax.devices()[0].platform,
+        "iters": iters,
+        "wall_s_per_iter": round(r["wall_s_per_iter"], 4),
+        "pixels_per_sec": round(px / r["wall_s_per_iter"], 1),
+        "stage_share": {
+            k: round(v / kernel_total, 4) for k, v in stage_s.most_common()
+        },
+        "stage_self_s_total": {
+            k: round(v, 4) for k, v in stage_s.most_common()
+        },
+        "kernel_attributed_s": round(kernel_total, 4),
+        "unmapped_kernel_s": round(unmapped_total, 4),
+        "unmapped_top": {
+            k: round(v, 4) for k, v in unmapped.most_common(5)
+        },
+        "runtime_overhead_s": round(runtime_s, 4),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
