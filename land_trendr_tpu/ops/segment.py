@@ -123,19 +123,35 @@ def _despike(
     t: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, n_valid: jnp.ndarray,
     params: LTParams,
 ) -> jnp.ndarray:
-    """Iterative largest-spike dampening; trip count fixed at NY, guarded to
-    the oracle's ``n_valid`` iteration cap."""
+    """Iterative largest-spike dampening (oracle.despike).
+
+    Early-exit ``while_loop`` (profile-driven, PROFILE_r03.json: despike was
+    33% of kernel time as a fixed NY-trip ``fori_loop``): the oracle stops
+    at the first iteration where no spike exceeds the threshold, and a
+    no-op iteration leaves ``y`` unchanged so every later iteration is also
+    a no-op — stopping there is exact.  Typical series carry 0–3 spikes, so
+    the loop runs ~spikes+1 trips instead of NY.  Under vmap the batch runs
+    until its LAST pixel converges — still far below NY in practice — and
+    the oracle's ``n_valid`` cap bounds the worst case.
+    """
     ny = y.shape[0]
+    if params.spike_threshold >= 1.0:
+        return y
     prev, nxt = _neighbour_indices(mask)
     interior = mask & (prev >= 0) & (nxt < ny)
     prev_c = jnp.clip(prev, 0, ny - 1)
     nxt_c = jnp.clip(nxt, 0, ny - 1)
+    # loop-invariant hoists; the body keeps the oracle's exact
+    # multiply-then-divide order, so hoisting the subtractions (bit-exact
+    # gathers) cannot move a single ulp
+    tp, tq = t[prev_c], t[nxt_c]
+    dtp = t - tp
+    denom = jnp.where(interior, tq - tp, 1.0)
 
-    def body(it, y):
-        tp, tq = t[prev_c], t[nxt_c]
+    def body(carry):
+        it, y, _ = carry
         yp, yq = y[prev_c], y[nxt_c]
-        denom = jnp.where(interior, tq - tp, 1.0)
-        itp = yp + (yq - yp) * (t - tp) / denom
+        itp = yp + (yq - yp) * dtp / denom
         dev = jnp.abs(y - itp)
         crossing = jnp.abs(yq - yp)
         prop = jnp.where(dev > 0.0, jnp.maximum(0.0, 1.0 - crossing / jnp.where(dev > 0.0, dev, 1.0)), 0.0)
@@ -143,11 +159,14 @@ def _despike(
         i = jnp.argmax(prop)  # first max — matches oracle tie-break
         do = (prop[i] > params.spike_threshold) & (it < n_valid)
         delta = jnp.where(do, (itp[i] - y[i]) * prop[i], 0.0)
-        return y.at[i].add(delta)
+        return it + 1, y.at[i].add(delta), do
 
-    if params.spike_threshold >= 1.0:
-        return y
-    return lax.fori_loop(0, ny, body, y)
+    def cond(carry):
+        it, _, cont = carry
+        return cont & (it < ny)
+
+    _, y, _ = lax.while_loop(cond, body, (jnp.asarray(0), y, jnp.asarray(True)))
+    return y
 
 
 # ---------------------------------------------------------------------------
@@ -188,30 +207,59 @@ def _vertex_positions(vmask: jnp.ndarray, size: int) -> jnp.ndarray:
 
 def _find_candidates(t, y, mask, vmask0, params: LTParams):
     """Grow the vertex mask by max-deviation insertion (oracle
-    ``find_candidate_vertices``); NC-2 fixed iterations with no-op guards."""
+    ``find_candidate_vertices``); NC-2 fixed iterations with no-op guards.
+
+    Incremental formulation (profile-driven, PROFILE_r03.json: the full
+    (NC-1, NY) membership-OLS recompute per insertion made vertex search
+    the kernel's largest stage at 37.5%): per-segment OLS coefficients live
+    in NY-slot caches keyed by the segment's START position.  Inserting a
+    vertex at ``i`` into segment ``[lo, hi]`` refits only the two halves
+    ``[lo, i]`` / ``[i, hi]``; every other segment's coefficients — the
+    same ``_masked_ols`` arithmetic over the same members — are reused
+    unchanged, so every deviation/argmax decision is identical to the full
+    recompute (and to the oracle)."""
     ny = y.shape[0]
     nc = params.max_candidates
     iota = jnp.arange(ny)
+    dtype = y.dtype
 
-    def body(_, vmask):
-        vpos = _vertex_positions(vmask, nc)           # (NC,) padded NY
-        lo, hi = vpos[:-1], vpos[1:]                   # (NC-1,) segment bounds
+    def fit_two(los, his):
+        """(2,) c0/c1 for two segments [los[k], his[k]] (masked years)."""
         member = (
-            (iota[None, :] >= lo[:, None])
-            & (iota[None, :] <= hi[:, None])
+            (iota[None, :] >= los[:, None])
+            & (iota[None, :] <= his[:, None])
             & mask[None, :]
-            & (hi[:, None] < ny)
         )
-        c0, c1 = _masked_ols(t, y, member)
-        seg_of = jnp.clip(jnp.cumsum(vmask) - 1, 0, nc - 2)
-        dev = jnp.abs(y - (c0[seg_of] + c1[seg_of] * t))
+        return _masked_ols(t, y, member)
+
+    # initial cache: the single segment [first vertex, last vertex]
+    lo0 = jnp.argmax(vmask0)
+    hi0 = ny - 1 - jnp.argmax(vmask0[::-1])
+    c0i, c1i = fit_two(jnp.stack([lo0, lo0]), jnp.stack([hi0, hi0]))
+    c0v = jnp.zeros(ny, dtype).at[lo0].set(c0i[0])
+    c1v = jnp.zeros(ny, dtype).at[lo0].set(c1i[0])
+
+    def body(_, carry):
+        vmask, c0v, c1v = carry
+        # segment of year j = the one starting at the largest vertex <= j
+        seg_start = jnp.clip(lax.cummax(jnp.where(vmask, iota, -1)), 0, ny - 1)
+        dev = jnp.abs(y - (c0v[seg_start] + c1v[seg_start] * t))
+        vpos = _vertex_positions(vmask, nc)
         eligible = mask & ~vmask & (iota > vpos[0]) & (iota < _last_vertex(vpos, ny))
         dev = jnp.where(eligible, dev, -1.0)
         i = jnp.argmax(dev)
         do = dev[i] >= 0.0
-        return vmask | (jnp.zeros_like(vmask).at[i].set(True) & do)
+        # split [lo, hi] at i: refit just the two halves
+        lo = seg_start[i]
+        hi = jnp.clip(jnp.min(jnp.where(vmask & (iota > i), iota, ny)), 0, ny - 1)
+        c0n, c1n = fit_two(jnp.stack([lo, i]), jnp.stack([i, hi]))
+        c0v = jnp.where(do, c0v.at[lo].set(c0n[0]).at[i].set(c0n[1]), c0v)
+        c1v = jnp.where(do, c1v.at[lo].set(c1n[0]).at[i].set(c1n[1]), c1v)
+        vmask = vmask | (jnp.zeros_like(vmask).at[i].set(True) & do)
+        return vmask, c0v, c1v
 
-    return lax.fori_loop(0, nc - 2, body, vmask0)
+    vmask, _, _ = lax.fori_loop(0, nc - 2, body, (vmask0, c0v, c1v))
+    return vmask
 
 
 def _last_vertex(vpos: jnp.ndarray, ny: int) -> jnp.ndarray:

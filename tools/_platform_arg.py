@@ -1,0 +1,31 @@
+"""Shared ``--platform`` pre-parse for the measurement tools.
+
+Must run BEFORE any jax.config use, so the tools call this at import time
+rather than using argparse (which they reserve for positional args).
+Accepts ``--platform=tpu`` and ``--platform tpu``; exact flag match only.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def pop_platform_arg(default: str = "cpu") -> str:
+    """Remove ``--platform[=| ]VALUE`` from ``sys.argv`` and return VALUE."""
+    platform = default
+    argv = sys.argv[1:]
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--platform" or argv[i].startswith("--platform="):
+            if "=" in argv[i]:
+                platform = argv[i].split("=", 1)[1]
+                del argv[i]
+            else:
+                if i + 1 >= len(argv):
+                    sys.exit("--platform requires a value (e.g. --platform=tpu)")
+                platform = argv[i + 1]
+                del argv[i : i + 2]
+            continue
+        i += 1
+    sys.argv[1:] = argv
+    return platform

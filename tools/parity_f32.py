@@ -31,28 +31,16 @@ correct — the tool warns and proceeds.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
 
-_platform = "cpu"
-_argv = sys.argv[1:]
-_i = 0
-while _i < len(_argv):
-    if _argv[_i] == "--platform" or _argv[_i].startswith("--platform="):
-        if "=" in _argv[_i]:
-            _platform = _argv[_i].split("=", 1)[1]
-            del _argv[_i]
-        else:
-            if _i + 1 >= len(_argv):
-                sys.exit("--platform requires a value (e.g. --platform=tpu)")
-            _platform = _argv[_i + 1]
-            del _argv[_i : _i + 2]
-        continue
-    _i += 1
-sys.argv[1:] = _argv
-jax.config.update("jax_platforms", _platform)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _platform_arg import pop_platform_arg  # noqa: E402
+
+jax.config.update("jax_platforms", pop_platform_arg())
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

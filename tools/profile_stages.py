@@ -37,26 +37,13 @@ import tempfile
 
 import jax
 
-_platform = "cpu"
-_argv = sys.argv[1:]
-_i = 0
-while _i < len(_argv):
-    if _argv[_i] == "--platform" or _argv[_i].startswith("--platform="):
-        if "=" in _argv[_i]:
-            _platform = _argv[_i].split("=", 1)[1]
-            del _argv[_i]
-        else:
-            if _i + 1 >= len(_argv):
-                sys.exit("--platform requires a value (e.g. --platform=tpu)")
-            _platform = _argv[_i + 1]
-            del _argv[_i : _i + 2]
-        continue
-    _i += 1
-sys.argv[1:] = _argv
-jax.config.update("jax_platforms", _platform)
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "_proto"))
+
+from _platform_arg import pop_platform_arg  # noqa: E402
+
+jax.config.update("jax_platforms", pop_platform_arg())
 
 
 def build_scope_map(hlo_text: str, scopes: tuple[str, ...]) -> dict[str, str]:
@@ -164,6 +151,17 @@ def main() -> int:
 
     kernel_total = sum(stage_s.values())
     unmapped_total = sum(unmapped.values())
+    if kernel_total == 0.0:
+        # a backend whose trace event names don't match HLO instruction
+        # names (or an XLA that drops op_name) yields zero attribution —
+        # report it as a diagnostic instead of dividing by zero after the
+        # expensive profile run
+        print(
+            "profile_stages: WARNING — no trace event mapped to any stage; "
+            "shares unavailable on this backend",
+            file=sys.stderr,
+            flush=True,
+        )
     record = {
         "n_pixels": px,
         "n_years": 40,
@@ -173,7 +171,7 @@ def main() -> int:
         "pixels_per_sec": round(px / r["wall_s_per_iter"], 1),
         "stage_share": {
             k: round(v / kernel_total, 4) for k, v in stage_s.most_common()
-        },
+        } if kernel_total > 0.0 else None,
         "stage_self_s_total": {
             k: round(v, 4) for k, v in stage_s.most_common()
         },
