@@ -38,6 +38,7 @@ from land_trendr_tpu.io import native
 __all__ = ["GeoMeta", "TiffInfo", "read_geotiff", "write_geotiff"]
 
 # -- TIFF tag ids -----------------------------------------------------------
+_T_NEW_SUBFILE_TYPE = 254
 _T_IMAGE_WIDTH = 256
 _T_IMAGE_LENGTH = 257
 _T_BITS_PER_SAMPLE = 258
@@ -132,9 +133,16 @@ class TiffInfo:
     big: bool = False
 
 
-def _read_ifd(f: BinaryIO, bo: str, off: int, big: bool = False) -> dict[int, tuple]:
+def _read_ifd(
+    f: BinaryIO, bo: str, off: int, big: bool = False
+) -> tuple[dict[int, tuple], int]:
     """Parse one IFD; ``big`` selects BigTIFF layout (u64 entry count,
-    20-byte entries with 8-byte inline values, u64 value offsets)."""
+    20-byte entries with 8-byte inline values, u64 value offsets).
+
+    Returns ``(tags, next_ifd_offset)`` — 0 when this is the last IFD, so
+    multi-page files (e.g. pre-stacked per-year series written one band
+    per page) can be walked instead of silently truncated to page 1.
+    """
     f.seek(0, 2)
     file_size = f.tell()
     f.seek(off)
@@ -186,7 +194,22 @@ def _read_ifd(f: BinaryIO, bo: str, off: int, big: bool = False) -> dict[int, tu
             )
         else:
             entries[tag] = struct.unpack(bo + ch * count, payload)
-    return entries
+    f.seek(off + (8 if big else 2) + n * esz)
+    ptr_sz = 8 if big else 4
+    raw_next = f.read(ptr_sz)
+    next_off = (
+        struct.unpack(bo + ("Q" if big else "I"), raw_next)[0]
+        if len(raw_next) == ptr_sz
+        else 0
+    )
+    # untrusted trailer: a garbage pointer must fail the codec's ValueError
+    # taxonomy here, not as a struct.error/KeyError while parsing junk
+    if next_off and not (8 <= next_off < file_size):
+        raise ValueError(
+            f"corrupt TIFF: next-IFD offset {next_off} outside file "
+            f"(size {file_size})"
+        )
+    return entries, next_off
 
 
 def _lzw_decode(data: bytes) -> bytes:
@@ -278,6 +301,15 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
 
     ``array`` is ``(height, width)`` for single-band files and
     ``(bands, height, width)`` otherwise, in the file's native dtype.
+
+    Multi-page files (an IFD chain) are read page by page into ONE
+    allocation and stacked along the band axis — the layout some
+    pre-stacked per-year products use (one band per page).  Overview and
+    mask pages (NewSubfileType reduced-resolution/mask bits — what COGs
+    and gdaladdo produce) are skipped, so Cloud-Optimized GeoTIFFs read
+    as their full-resolution image.  Full-resolution pages must agree in
+    size and dtype; a mismatch raises instead of silently truncating to
+    page 1.
     """
     with open(path, "rb") as f:
         hdr = f.read(16)
@@ -301,161 +333,228 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             (ifd_off,) = struct.unpack(bo + "Q", hdr[8:16])
         else:
             raise ValueError(f"{path}: not a TIFF (magic={magic})")
-        tags = _read_ifd(f, bo, ifd_off, big)
 
-        width = tags[_T_IMAGE_WIDTH][0]
-        height = tags[_T_IMAGE_LENGTH][0]
-        spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
-        bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)
-        if len(set(bits)) != 1:
-            raise ValueError(f"{path}: mixed BitsPerSample {bits}")
-        fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
-        key = (fmt, bits[0])
-        if key not in _DTYPES:
-            raise ValueError(f"{path}: unsupported sample format/bits {key}")
-        dtype = np.dtype(bo + _DTYPES[key])
-        compression = tags.get(_T_COMPRESSION, (_COMP_NONE,))[0]
-        predictor = tags.get(_T_PREDICTOR, (1,))[0]
-        planar = tags.get(_T_PLANAR_CONFIG, (1,))[0]
-        tiled = _T_TILE_OFFSETS in tags
+        # pass 1 — walk the chain (tags only, cheap) so the output can be
+        # allocated ONCE; decoding into slices keeps multi-page peak memory
+        # at the decoded array, same as single-page
+        page_tags: list[dict[int, tuple]] = []
+        seen: set[int] = set()
+        off = ifd_off
+        while off:
+            if off in seen:
+                raise ValueError(f"{path}: cyclic IFD chain at offset {off}")
+            seen.add(off)
+            tags, off = _read_ifd(f, bo, off, big)
+            subtype = tags.get(_T_NEW_SUBFILE_TYPE, (0,))[0]
+            if subtype & 0x5:  # reduced-resolution overview (1) / mask (4)
+                continue
+            page_tags.append(tags)
+        if not page_tags:
+            raise ValueError(f"{path}: no full-resolution pages in IFD chain")
 
-        planes = spp if planar == 2 else 1
-        chunk_spp = 1 if planar == 2 else spp
-        out = np.zeros((spp, height, width), dtype=dtype.newbyteorder("="))
-        if tiled:
-            tw = tags[_T_TILE_WIDTH][0]
-            th = tags[_T_TILE_LENGTH][0]
-            offsets = tags[_T_TILE_OFFSETS]
-            counts = tags[_T_TILE_BYTE_COUNTS]
-            blk_rows, blk_w = th, tw
-        else:
-            rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
-            offsets = tags[_T_STRIP_OFFSETS]
-            counts = tags[_T_STRIP_BYTE_COUNTS]
-            # clamp: RowsPerStrip may legally exceed height (e.g. 2^32-1 =
-            # "everything in one strip"); the buffer needs only real rows
-            blk_rows, blk_w = min(rps, height), width
+        def geometry(tags):
+            w = tags[_T_IMAGE_WIDTH][0]
+            h = tags[_T_IMAGE_LENGTH][0]
+            spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+            bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)[0]
+            fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
+            return w, h, spp, (fmt, bits)
 
-        # Native fast path: fused inflate+unpredict across all blocks at
-        # once, threaded in C++ (native/lt_native.cc).  Any failure — or an
-        # unsupported layout — silently drops to the NumPy-per-block path,
-        # which is the behavioural reference.
-        nat_blocks = None
-        if (
-            native.available()
-            and bo == "<"
-            # predictor 2 is integer differencing; float files tagged with
-            # it (nonstandard) must keep NumPy's float-cumsum semantics
-            and (predictor == 1 or (predictor == 2 and dtype.kind in "iu"))
-        ):
-            if tiled:
-                brows = np.full(len(offsets), blk_rows, dtype=np.uint64)
-            else:
-                n_strips = (height + rps - 1) // rps
-                per_plane = np.minimum(
-                    rps, height - rps * np.arange(n_strips, dtype=np.int64)
+        w0, h0, _, key0 = geometry(page_tags[0])
+        total_spp = 0
+        for k, tags in enumerate(page_tags):
+            w, h, spp, key = geometry(tags)
+            if (w, h, key) != (w0, h0, key0):
+                raise ValueError(
+                    f"{path}: page {k} is {h}×{w}/format{key}, page 0 is "
+                    f"{h0}×{w0}/format{key0} — refusing to stack "
+                    "mismatched pages"
                 )
-                brows = np.tile(per_plane, planes).astype(np.uint64)
-            # mmap keeps peak host memory at the decoded array, not whole-file
-            # bytes + decoded array, for scene-scale rasters
-            try:
-                buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-            except (ValueError, OSError):  # empty file / non-mmappable stream
-                f.seek(0)
-                buf = f.read()
-            try:
-                nat_blocks = native.decode_blocks(
-                    buf,
-                    np.asarray(offsets, dtype=np.uint64),
-                    np.asarray(counts, dtype=np.uint64),
-                    compression=compression,
-                    predictor=predictor,
-                    rows=blk_rows,
-                    width=blk_w,
-                    spp=chunk_spp,
-                    dtype=dtype.newbyteorder("="),
-                    block_rows=brows,
-                )
-            except native.NativeCodecError:
-                nat_blocks = None
-            finally:
-                if isinstance(buf, mmap.mmap):
-                    try:
-                        buf.close()
-                    except BufferError:
-                        # a propagating exception's traceback can still pin
-                        # the frombuffer view; don't mask it — the mmap is
-                        # freed with the object
-                        pass
+            total_spp += spp
+        if key0 not in _DTYPES:
+            raise ValueError(f"{path}: unsupported sample format/bits {key0}")
+        out = np.zeros((total_spp, h0, w0), dtype=np.dtype(_DTYPES[key0]))
 
-        def get_block(idx: int, rows_actual: int) -> np.ndarray:
-            """Decoded block idx as (rows_actual, blk_w, chunk_spp)."""
-            if nat_blocks is not None:
-                return nat_blocks[idx][:rows_actual]
-            raw = _block(f, offsets[idx], counts[idx], compression)
-            b = np.frombuffer(raw, dtype=dtype, count=rows_actual * blk_w * chunk_spp)
-            b = b.reshape(rows_actual, blk_w, chunk_spp).astype(
-                dtype.newbyteorder("="), copy=True
-            )
-            return _unpredict(b, predictor)
-
-        if tiled:
-            tiles_x = (width + tw - 1) // tw
-            tiles_y = (height + th - 1) // th
-            idx = 0
-            for p in range(planes):
-                for ty in range(tiles_y):
-                    for tx in range(tiles_x):
-                        block = get_block(idx, th)  # file tiles are full-size
-                        y0, x0 = ty * th, tx * tw
-                        h = min(th, height - y0)
-                        w = min(tw, width - x0)
-                        if planar == 2:
-                            out[p, y0 : y0 + h, x0 : x0 + w] = block[:h, :w, 0]
-                        else:
-                            out[:, y0 : y0 + h, x0 : x0 + w] = np.moveaxis(
-                                block[:h, :w, :], -1, 0
-                            )
-                        idx += 1
-        else:
-            strips = (height + rps - 1) // rps
-            idx = 0
-            for p in range(planes):
-                for s in range(strips):
-                    y0 = s * rps
-                    h = min(rps, height - y0)
-                    block = get_block(idx, h)
-                    if planar == 2:
-                        out[p, y0 : y0 + h] = block[:, :, 0]
-                    else:
-                        out[:, y0 : y0 + h] = np.moveaxis(block, -1, 0)
-                    idx += 1
-
-        nodata = None
-        if _T_GDAL_NODATA in tags:
-            try:
-                nodata = float(tags[_T_GDAL_NODATA][0])
-            except (TypeError, ValueError):
-                nodata = None
-        geo = GeoMeta(
-            pixel_scale=tags.get(_T_MODEL_PIXEL_SCALE),
-            tiepoint=tags.get(_T_MODEL_TIEPOINT),
-            geo_key_directory=tags.get(_T_GEO_KEY_DIRECTORY),
-            geo_double_params=tags.get(_T_GEO_DOUBLE_PARAMS),
-            geo_ascii_params=tags.get(_T_GEO_ASCII_PARAMS, (None,))[0],
-            nodata=nodata,
-        )
-        info = TiffInfo(
-            width=width,
-            height=height,
-            bands=spp,
-            dtype=np.dtype(_DTYPES[key]),
-            tiled=tiled,
-            compression=compression,
-            big=big,
-        )
-        arr = out[0] if spp == 1 else out
+        geo: GeoMeta | None = None
+        info: TiffInfo | None = None
+        band0 = 0
+        for tags in page_tags:
+            spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+            g, inf = _decode_ifd(f, path, bo, big, tags, out[band0 : band0 + spp])
+            band0 += spp
+            if geo is None:
+                geo, info = g, inf
+        assert info is not None
+        info = dataclasses.replace(info, bands=total_spp)
+        arr = out[0] if total_spp == 1 else out
         return arr, geo, info
+
+
+def _decode_ifd(
+    f: BinaryIO,
+    path: str,
+    bo: str,
+    big: bool,
+    tags: dict[int, tuple],
+    out: np.ndarray,
+) -> tuple[GeoMeta, TiffInfo]:
+    """Decode one IFD's raster into the preallocated ``(spp, H, W)`` view
+    ``out`` (native byte order); returns the page's geo/info."""
+    width = tags[_T_IMAGE_WIDTH][0]
+    height = tags[_T_IMAGE_LENGTH][0]
+    spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+    bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)
+    if len(set(bits)) != 1:
+        raise ValueError(f"{path}: mixed BitsPerSample {bits}")
+    fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
+    key = (fmt, bits[0])
+    if key not in _DTYPES:
+        raise ValueError(f"{path}: unsupported sample format/bits {key}")
+    dtype = np.dtype(bo + _DTYPES[key])
+    compression = tags.get(_T_COMPRESSION, (_COMP_NONE,))[0]
+    predictor = tags.get(_T_PREDICTOR, (1,))[0]
+    planar = tags.get(_T_PLANAR_CONFIG, (1,))[0]
+    tiled = _T_TILE_OFFSETS in tags
+
+    planes = spp if planar == 2 else 1
+    chunk_spp = 1 if planar == 2 else spp
+    if out.shape != (spp, height, width):
+        raise ValueError(
+            f"{path}: output view {out.shape} != page shape {(spp, height, width)}"
+        )
+    if tiled:
+        tw = tags[_T_TILE_WIDTH][0]
+        th = tags[_T_TILE_LENGTH][0]
+        offsets = tags[_T_TILE_OFFSETS]
+        counts = tags[_T_TILE_BYTE_COUNTS]
+        blk_rows, blk_w = th, tw
+    else:
+        rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
+        offsets = tags[_T_STRIP_OFFSETS]
+        counts = tags[_T_STRIP_BYTE_COUNTS]
+        # clamp: RowsPerStrip may legally exceed height (e.g. 2^32-1 =
+        # "everything in one strip"); the buffer needs only real rows
+        blk_rows, blk_w = min(rps, height), width
+
+    # Native fast path: fused inflate+unpredict across all blocks at
+    # once, threaded in C++ (native/lt_native.cc).  Any failure — or an
+    # unsupported layout — silently drops to the NumPy-per-block path,
+    # which is the behavioural reference.
+    nat_blocks = None
+    if (
+        native.available()
+        and bo == "<"
+        # predictor 2 is integer differencing; float files tagged with
+        # it (nonstandard) must keep NumPy's float-cumsum semantics
+        and (predictor == 1 or (predictor == 2 and dtype.kind in "iu"))
+    ):
+        if tiled:
+            brows = np.full(len(offsets), blk_rows, dtype=np.uint64)
+        else:
+            n_strips = (height + rps - 1) // rps
+            per_plane = np.minimum(
+                rps, height - rps * np.arange(n_strips, dtype=np.int64)
+            )
+            brows = np.tile(per_plane, planes).astype(np.uint64)
+        # mmap keeps peak host memory at the decoded array, not whole-file
+        # bytes + decoded array, for scene-scale rasters
+        try:
+            buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError):  # empty file / non-mmappable stream
+            f.seek(0)
+            buf = f.read()
+        try:
+            nat_blocks = native.decode_blocks(
+                buf,
+                np.asarray(offsets, dtype=np.uint64),
+                np.asarray(counts, dtype=np.uint64),
+                compression=compression,
+                predictor=predictor,
+                rows=blk_rows,
+                width=blk_w,
+                spp=chunk_spp,
+                dtype=dtype.newbyteorder("="),
+                block_rows=brows,
+            )
+        except native.NativeCodecError:
+            nat_blocks = None
+        finally:
+            if isinstance(buf, mmap.mmap):
+                try:
+                    buf.close()
+                except BufferError:
+                    # a propagating exception's traceback can still pin
+                    # the frombuffer view; don't mask it — the mmap is
+                    # freed with the object
+                    pass
+
+    def get_block(idx: int, rows_actual: int) -> np.ndarray:
+        """Decoded block idx as (rows_actual, blk_w, chunk_spp)."""
+        if nat_blocks is not None:
+            return nat_blocks[idx][:rows_actual]
+        raw = _block(f, offsets[idx], counts[idx], compression)
+        b = np.frombuffer(raw, dtype=dtype, count=rows_actual * blk_w * chunk_spp)
+        b = b.reshape(rows_actual, blk_w, chunk_spp).astype(
+            dtype.newbyteorder("="), copy=True
+        )
+        return _unpredict(b, predictor)
+
+    if tiled:
+        tiles_x = (width + tw - 1) // tw
+        tiles_y = (height + th - 1) // th
+        idx = 0
+        for p in range(planes):
+            for ty in range(tiles_y):
+                for tx in range(tiles_x):
+                    block = get_block(idx, th)  # file tiles are full-size
+                    y0, x0 = ty * th, tx * tw
+                    h = min(th, height - y0)
+                    w = min(tw, width - x0)
+                    if planar == 2:
+                        out[p, y0 : y0 + h, x0 : x0 + w] = block[:h, :w, 0]
+                    else:
+                        out[:, y0 : y0 + h, x0 : x0 + w] = np.moveaxis(
+                            block[:h, :w, :], -1, 0
+                        )
+                    idx += 1
+    else:
+        strips = (height + rps - 1) // rps
+        idx = 0
+        for p in range(planes):
+            for s in range(strips):
+                y0 = s * rps
+                h = min(rps, height - y0)
+                block = get_block(idx, h)
+                if planar == 2:
+                    out[p, y0 : y0 + h] = block[:, :, 0]
+                else:
+                    out[:, y0 : y0 + h] = np.moveaxis(block, -1, 0)
+                idx += 1
+
+    nodata = None
+    if _T_GDAL_NODATA in tags:
+        try:
+            nodata = float(tags[_T_GDAL_NODATA][0])
+        except (TypeError, ValueError):
+            nodata = None
+    geo = GeoMeta(
+        pixel_scale=tags.get(_T_MODEL_PIXEL_SCALE),
+        tiepoint=tags.get(_T_MODEL_TIEPOINT),
+        geo_key_directory=tags.get(_T_GEO_KEY_DIRECTORY),
+        geo_double_params=tags.get(_T_GEO_DOUBLE_PARAMS),
+        geo_ascii_params=tags.get(_T_GEO_ASCII_PARAMS, (None,))[0],
+        nodata=nodata,
+    )
+    info = TiffInfo(
+        width=width,
+        height=height,
+        bands=spp,
+        dtype=np.dtype(_DTYPES[key]),
+        tiled=tiled,
+        compression=compression,
+        big=big,
+    )
+    return geo, info
 
 
 def _block(f: BinaryIO, offset: int, count: int, compression: int) -> bytes:

@@ -21,7 +21,13 @@ import numpy as np
 from land_trendr_tpu.io.geotiff import GeoMeta, write_geotiff
 from land_trendr_tpu.ops.indices import BANDS
 
-__all__ = ["SceneSpec", "SyntheticStack", "make_stack", "write_stack"]
+__all__ = [
+    "SceneSpec",
+    "SyntheticStack",
+    "make_stack",
+    "write_stack",
+    "write_stack_c2",
+]
 
 # mean healthy-forest surface reflectance per band (blue..swir2)
 _FOREST_SR = {
@@ -197,5 +203,57 @@ def write_stack(
         img = np.concatenate([sr, qa[None]], axis=0)
         path = os.path.join(out_dir, f"LT_{int(year)}.tif")
         write_geotiff(path, img, geo=geo, compress=compress, tile=tile)
+        paths.append(path)
+    return paths
+
+
+#: canonical band name → C2 SR band number, by sensor generation (inverse
+#: of runtime.stack's ingest tables)
+_C2_NUM_TM = {"blue": 1, "green": 2, "red": 3, "nir": 4, "swir1": 5, "swir2": 7}
+_C2_NUM_OLI = {"blue": 2, "green": 3, "red": 4, "nir": 5, "swir1": 6, "swir2": 7}
+
+
+def write_stack_c2(
+    out_dir: str,
+    stack: SyntheticStack,
+    compress: str = "deflate",
+    tile: int | None = 256,
+) -> list[str]:
+    """Write the USGS Collection-2 Level-2 per-band layout.
+
+    One single-band GeoTIFF per SR band plus ``QA_PIXEL`` per year, named
+    with real product ids (``LT05_L2SP_045030_YYYYMMDD_..._SR_B5.TIF``) —
+    the layout :func:`land_trendr_tpu.runtime.load_stack_dir_c2` ingests.
+    Years before 2013 use the LT05 sensor prefix and TM band numbering,
+    2013+ use LC08/OLI numbering, so fixtures exercise the mixed-sensor
+    mapping a real 1984– archive has.  Returns file paths, year-major.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 500000.0, 5000000.0, 0.0),
+    )
+    paths = []
+    for i, year in enumerate(stack.years):
+        year = int(year)
+        sensor, nums = (
+            ("LC08", _C2_NUM_OLI) if year >= 2013 else ("LT05", _C2_NUM_TM)
+        )
+        date = f"{year}0715"
+        stem = f"{sensor}_L2SP_045030_{date}_{date}_02_T1"
+        for b in BANDS:
+            path = os.path.join(out_dir, f"{stem}_SR_B{nums[b]}.TIF")
+            write_geotiff(
+                path, stack.dn(b)[i], geo=geo, compress=compress, tile=tile
+            )
+            paths.append(path)
+        path = os.path.join(out_dir, f"{stem}_QA_PIXEL.TIF")
+        write_geotiff(
+            path,
+            stack.qa[i].astype(np.uint16),
+            geo=geo,
+            compress=compress,
+            tile=tile,
+        )
         paths.append(path)
     return paths

@@ -8,7 +8,12 @@ from land_trendr_tpu.runtime.driver import (
     run_stack,
 )
 from land_trendr_tpu.runtime.manifest import TileManifest, run_fingerprint
-from land_trendr_tpu.runtime.stack import RasterStack, load_stack_dir, stack_from_synthetic
+from land_trendr_tpu.runtime.stack import (
+    RasterStack,
+    load_stack_dir,
+    load_stack_dir_c2,
+    stack_from_synthetic,
+)
 
 __all__ = [
     "RunConfig",
@@ -18,6 +23,7 @@ __all__ = [
     "run_stack",
     "RasterStack",
     "load_stack_dir",
+    "load_stack_dir_c2",
     "stack_from_synthetic",
     "TileManifest",
     "run_fingerprint",

@@ -205,13 +205,17 @@ def run_stack(
     retried synchronously up to ``max_retries`` times before the run
     aborts; the writer thread's errors surface at the end of the run.
 
-    Throughput note: with the input already device-resident the kernel runs
-    at hundreds of M px/s/chip (bench.py); end-to-end the driver is bound
-    by host→HBM feeding of ~6 B/pixel-year (two int16 bands + QA for NBR —
-    SURVEY.md §7 hard-part 4), i.e. ~2.4 GB/s per chip at the 10M px/s
-    target, within PCIe-class bandwidth.  ``stage_s`` in the summary shows
-    where a given run actually spent host time (``compute_s`` includes
-    waiting out transfers on bandwidth-limited links).
+    Throughput note: no TPU number has been captured yet (the TPU backend
+    in the build environment has failed to initialize every round —
+    BENCH_r03_attempts.log); the only measured kernel rates are CPU
+    diagnostics (BENCH_r03_cpu.json, PROFILE_r03.json: ~24 k px/s on one
+    core) and the scene-scale end-to-end split in SCENE_r03.json.  The
+    *design* target is host→HBM feed-bound operation: ~6 B/pixel-year
+    (two int16 bands + QA for NBR — SURVEY.md §7 hard-part 4) is
+    ~2.4 GB/s per chip at the 10M px/s north star, within PCIe-class
+    bandwidth.  ``stage_s`` in the summary shows where a given run
+    actually spent host time (``compute_s`` includes waiting out
+    transfers on bandwidth-limited links).
 
     Raster outputs are *not* written here — call :func:`assemble_outputs`
     after (or on a later resume; assembly only needs the workdir).

@@ -21,19 +21,52 @@ from land_trendr_tpu.io.geotiff import GeoMeta, read_geotiff
 from land_trendr_tpu.io.synthetic import SyntheticStack
 from land_trendr_tpu.ops.indices import BANDS
 
-__all__ = ["RasterStack", "load_stack_dir", "stack_from_synthetic"]
+__all__ = [
+    "RasterStack",
+    "load_stack_dir",
+    "load_stack_dir_c2",
+    "stack_from_synthetic",
+]
 
 # A plausible acquisition year, not any 4-digit run: Landsat product ids put
 # path/row digits ("045030") before the date, so take the LAST match of a
 # standalone (19|20)xx group.
 _YEAR_RE = re.compile(r"(?<!\d)((?:19|20)\d{2})(?!\d)")
 
+# Landsat Collection-2 Level-2 per-band file name, e.g.
+# ``LC08_L2SP_045030_20200715_20200912_02_T1_SR_B5.TIF`` — the layout the
+# USGS distributes (one file per band + QA_PIXEL), which the GDAL-based
+# reference ingests through its stack enumeration (SURVEY.md §2 L1).
+_C2_RE = re.compile(
+    r"^(?P<sensor>L[COTEM]\d{2})_[A-Z0-9]{4}_(?P<pathrow>\d{6})_"
+    r"(?P<date>\d{8})_\d{8}_\d{2}_(?:T1|T2|RT)_"
+    r"(?P<prod>SR_B\d|QA_PIXEL)\.tiff?$",
+    re.IGNORECASE,
+)
+
+#: SR band number → canonical band name, by sensor generation:
+#: TM/ETM+ (LT04/LT05/LE07) vs OLI (LC08/LC09, numbering shifted by one).
+_C2_TM_BANDS = {1: "blue", 2: "green", 3: "red", 4: "nir", 5: "swir1", 7: "swir2"}
+_C2_OLI_BANDS = {2: "blue", 3: "green", 4: "red", 5: "nir", 6: "swir1", 7: "swir2"}
+
+
+def _c2_band_name(sensor: str, prod: str) -> str | None:
+    """Canonical band name for an ``SR_B<n>``/``QA_PIXEL`` product, or None
+    for bands the pipeline does not use (e.g. OLI's coastal B1)."""
+    if prod.upper() == "QA_PIXEL":
+        return "qa"
+    n = int(prod[-1])
+    table = _C2_OLI_BANDS if sensor.upper() in ("LC08", "LC09") else _C2_TM_BANDS
+    return table.get(n)
+
 
 @dataclasses.dataclass
 class RasterStack:
     """An annual Landsat stack in device-feed layout.
 
-    ``dn_bands[name]`` is ``(NY, H, W)`` int16; ``qa`` is ``(NY, H, W)``
+    ``dn_bands[name]`` is ``(NY, H, W)`` int16 or uint16 (real C2 SR files
+    are uint16 — DNs up to 43636 — and keep that dtype; the device-side
+    ``scale_sr`` conversion is dtype-agnostic); ``qa`` is ``(NY, H, W)``
     uint16; ``years`` is ``(NY,)`` int32 ascending.  ``geo`` carries the
     grid so output rasters inherit it (SURVEY.md §2: outputs are written on
     the input grid).
@@ -54,16 +87,27 @@ class RasterStack:
 
 
 def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
-    """Load a directory of per-year multi-band GeoTIFFs.
+    """Load a directory of Landsat rasters, auto-detecting the layout.
 
-    Expects one file per year whose name contains the 4-digit year (the
-    layout :func:`land_trendr_tpu.io.synthetic.write_stack` produces, and
-    the common convention for annual composites), bands ordered
-    ``blue, green, red, nir, swir1, swir2, QA_PIXEL``.
+    Two layouts are understood:
+
+    * **pre-stacked**: one multi-band file per year whose name contains the
+      4-digit year (the layout :func:`land_trendr_tpu.io.synthetic.
+      write_stack` produces, and the common convention for annual
+      composites), bands ordered ``blue, green, red, nir, swir1, swir2,
+      QA_PIXEL``;
+    * **Collection-2 per-band**: the USGS distribution layout — one file
+      per band per acquisition (``*_SR_B2..B7.TIF`` + ``*_QA_PIXEL.TIF``)
+      — detected by product-id file names and delegated to
+      :func:`load_stack_dir_c2`.
     """
-    names = sorted(n for n in os.listdir(path) if re.search(pattern, n))
+    names = sorted(
+        n for n in os.listdir(path) if re.search(pattern, n, re.IGNORECASE)
+    )
     if not names:
         raise FileNotFoundError(f"no rasters matching {pattern!r} in {path}")
+    if any(_C2_RE.match(n) for n in names):
+        return load_stack_dir_c2(path, pattern=pattern)
     entries = []
     for n in names:
         ms = _YEAR_RE.findall(n)
@@ -93,8 +137,109 @@ def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
         elif img.shape[1:] != shape:
             raise ValueError(f"{fp}: raster size {img.shape[1:]} != {shape}")
         for i, b in enumerate(BANDS):
-            dn_bands[b].append(img[i].astype(np.int16, copy=False))
+            band_img = img[i]
+            if band_img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
+                band_img = band_img.astype(np.int16, copy=False)
+            dn_bands[b].append(band_img)  # keep 16-bit dtypes as stored
         qa_list.append(img[len(BANDS)].astype(np.uint16, copy=False))
+
+    return RasterStack(
+        years=years,
+        dn_bands={b: np.stack(v) for b, v in dn_bands.items()},
+        qa=np.stack(qa_list),
+        geo=geo,
+    )
+
+
+def load_stack_dir_c2(path: str, pattern: str | None = None) -> RasterStack:
+    """Load a directory of Landsat Collection-2 Level-2 per-band files.
+
+    The real USGS distribution layout (SURVEY.md §2 L1 — the reference's
+    GDAL ingest reads it file by file): per acquisition, one GeoTIFF per
+    surface-reflectance band (``*_SR_B2..B7.TIF``; TM/ETM+ numbering
+    ``B1..B5,B7``) plus ``*_QA_PIXEL.TIF``.  Files group by acquisition
+    YEAR; the band mapping follows each file's own sensor prefix, so a
+    time series that switches from LT05 to LC08 mid-archive loads
+    correctly.  SR DNs keep their on-disk integer dtype — real C2 SR is
+    **uint16** (valid DN 7273–43636) and must not be narrowed to int16.
+
+    One acquisition per year, from one WRS-2 path/row, is required
+    (LandTrendr is an annual-series algorithm — composite first if you
+    have more); multiple dates per year or mixed path/rows raise with the
+    offending values listed.  ``pattern`` (regex on file names, the same
+    argument :func:`load_stack_dir` takes) pre-filters the directory, e.g.
+    to select one path/row.
+    """
+    groups: dict[int, dict[str, tuple[str, str]]] = {}
+    dates: dict[int, set[str]] = {}
+    pathrows: set[str] = set()
+    for n in sorted(os.listdir(path)):
+        if pattern is not None and not re.search(pattern, n, re.IGNORECASE):
+            continue
+        m = _C2_RE.match(n)
+        if not m:
+            continue
+        band = _c2_band_name(m["sensor"], m["prod"])
+        if band is None:
+            continue  # e.g. OLI coastal B1 — unused
+        pathrows.add(m["pathrow"])
+        year = int(m["date"][:4])
+        dates.setdefault(year, set()).add(m["date"])
+        g = groups.setdefault(year, {})
+        if band in g and g[band][1] != m["date"]:
+            continue  # second acquisition; reported via the dates check below
+        g[band] = (os.path.join(path, n), m["date"])
+    if not groups:
+        raise FileNotFoundError(f"no Collection-2 per-band rasters in {path}")
+    if len(pathrows) > 1:
+        raise ValueError(
+            f"{path}: multiple WRS-2 path/rows {sorted(pathrows)} in one "
+            "stack — pass pattern=... to select one scene"
+        )
+    multi = {y: sorted(d) for y, d in dates.items() if len(d) > 1}
+    if multi:
+        raise ValueError(
+            f"{path}: multiple acquisitions per year {multi} — LandTrendr "
+            "takes one (composited) image per year; pre-composite or prune"
+        )
+
+    years = np.array(sorted(groups), dtype=np.int32)
+    needed = (*BANDS, "qa")
+    dn_bands: dict[str, list[np.ndarray]] = {b: [] for b in BANDS}
+    qa_list = []
+    geo = None
+    shape = None
+    for year in years.tolist():
+        g = groups[year]
+        missing = [b for b in needed if b not in g]
+        if missing:
+            raise ValueError(
+                f"{path}: year {year} is missing bands {missing} "
+                f"(have {sorted(g)})"
+            )
+        for b in needed:
+            fp, _date = g[b]
+            img, gmeta, _info = read_geotiff(fp)
+            if img.ndim != 2:
+                raise ValueError(
+                    f"{fp}: expected a single-band raster; got {img.shape}"
+                )
+            if shape is None:
+                shape, geo = img.shape, gmeta
+            elif img.shape != shape:
+                raise ValueError(f"{fp}: raster size {img.shape} != {shape}")
+            if b == "qa":
+                qa_list.append(img.astype(np.uint16, copy=False))
+            elif img.dtype in (np.dtype(np.int16), np.dtype(np.uint16)):
+                # keep the on-disk dtype: real C2 SR is uint16 with valid
+                # DNs up to 43636 — an int16 cast would wrap bright pixels
+                # (snow, cloud edge) negative with no error
+                dn_bands[b].append(img)
+            else:
+                raise ValueError(
+                    f"{fp}: SR band dtype {img.dtype} unsupported "
+                    "(expected int16 or uint16 DNs)"
+                )
 
     return RasterStack(
         years=years,

@@ -480,3 +480,107 @@ def test_bigtiff_auto_switches_on_block_offset_overflow(tmp_path, rng, monkeypat
     # forcing classic on the same data keeps the friendly error
     with pytest.raises(ValueError, match="4 GB"):
         gt.write_geotiff(str(tmp_path / "forced.tif"), arr, bigtiff=False)
+
+
+# ---------------------------------------------------------------------------
+# Multi-page IFD chains (VERDICT r2 item #3: multi-IFD tolerance)
+# ---------------------------------------------------------------------------
+
+
+def test_multipage_reads_all_pages(tmp_path, rng):
+    """A multi-page file (one band per IFD) stacks pages on the band axis
+    instead of silently truncating to page 1."""
+    from PIL import Image
+
+    pages = [rng.integers(0, 255, size=(33, 47)).astype(np.uint8) for _ in range(3)]
+    p = str(tmp_path / "multi.tif")
+    ims = [Image.fromarray(a, mode="L") for a in pages]
+    ims[0].save(p, save_all=True, append_images=ims[1:])
+
+    got, _, info = read_geotiff(p)
+    assert info.bands == 3
+    np.testing.assert_array_equal(got, np.stack(pages))
+
+
+def test_multipage_mismatched_pages_error(tmp_path, rng):
+    """Pages of different sizes raise loudly rather than mis-stacking."""
+    from PIL import Image
+
+    a = rng.integers(0, 255, size=(16, 16)).astype(np.uint8)
+    b = rng.integers(0, 255, size=(8, 24)).astype(np.uint8)
+    p = str(tmp_path / "mismatch.tif")
+    Image.fromarray(a, mode="L").save(
+        p, save_all=True, append_images=[Image.fromarray(b, mode="L")]
+    )
+    with pytest.raises(ValueError, match="mismatched pages"):
+        read_geotiff(p)
+
+
+def test_multipage_skips_overview_pages(tmp_path, rng):
+    """COG-style files carry reduced-resolution overview IFDs
+    (NewSubfileType bit 0x1) — they must be skipped, not stacked or
+    mis-matched (code-review r3)."""
+    import struct
+
+    from land_trendr_tpu.io.geotiff import _IfdBuilder
+
+    full = rng.integers(0, 255, size=(16, 20)).astype(np.uint8)
+    ovr = full[::2, ::2].copy()  # 8×10 overview
+
+    def page(ifd_off, arr, data_off, subtype, next_off):
+        ifd = _IfdBuilder()
+        if subtype:
+            ifd.add(254, 4, (subtype,))     # NewSubfileType
+        ifd.add(256, 4, (arr.shape[1],))
+        ifd.add(257, 4, (arr.shape[0],))
+        ifd.add(258, 3, (8,))
+        ifd.add(259, 3, (1,))
+        ifd.add(262, 3, (1,))
+        ifd.add(273, 4, (data_off,))
+        ifd.add(277, 3, (1,))
+        ifd.add(278, 4, (arr.shape[0],))
+        ifd.add(279, 4, (arr.size,))
+        ifd.add(339, 3, (1,))
+        body = ifd.serialize(ifd_off)
+        # overwrite the next-IFD pointer (serialize writes 0)
+        # next-ptr sits right after count + entries, before overflow data
+        n = struct.unpack("<H", body[:2])[0]
+        ptr_at = 2 + n * 12
+        return body[:ptr_at] + struct.pack("<I", next_off) + body[ptr_at + 4 :]
+
+    p = str(tmp_path / "cog.tif")
+    d0 = 8
+    d1 = d0 + full.size
+    ifd0_off = d1 + ovr.size
+    # compute page-0 IFD size to place page 1 after it
+    probe = page(ifd0_off, full, d0, 0, 0)
+    ifd1_off = ifd0_off + len(probe)
+    with open(p, "wb") as f:
+        f.write(struct.pack("<2sHI", b"II", 42, ifd0_off))
+        f.write(full.tobytes())
+        f.write(ovr.tobytes())
+        f.write(page(ifd0_off, full, d0, 0, ifd1_off))
+        f.write(page(ifd1_off, ovr, d0 + full.size, 1, 0))
+
+    got, _, info = read_geotiff(p)
+    assert info.bands == 1
+    np.testing.assert_array_equal(got, full)
+
+
+def test_corrupt_next_ifd_pointer(tmp_path, rng):
+    """A garbage next-IFD trailer fails with the codec's ValueError
+    taxonomy, not struct.error/KeyError (code-review r3)."""
+    arr = _rand(rng, "u2", (8, 8))
+    p = str(tmp_path / "trailer.tif")
+    write_geotiff(p, arr, tile=None, compress="none")
+    # classic header: IFD offset at byte 4; patch its next-IFD pointer
+    import struct
+
+    with open(p, "r+b") as f:
+        (ifd_off,) = struct.unpack("<I", f.read(8)[4:8])
+        f.seek(ifd_off)
+        (n,) = struct.unpack("<H", f.read(2))
+        f.seek(ifd_off + 2 + n * 12)
+        f.write(struct.pack("<I", 2**31))  # far past EOF
+    with pytest.raises(ValueError, match="next-IFD"):
+        read_geotiff(p)

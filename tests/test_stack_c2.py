@@ -1,0 +1,166 @@
+"""Collection-2 per-band layout ingestion (VERDICT r2 item #3).
+
+The real USGS distribution ships one file per band (``*_SR_B5.TIF``,
+``*_QA_PIXEL.TIF``); these tests pin the per-band loader against the
+pre-stacked loader on the same synthetic scene, the mixed-sensor band
+mapping (TM vs OLI numbering), auto-detection, and the loud-error paths.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack, write_stack_c2
+from land_trendr_tpu.ops.indices import BANDS
+from land_trendr_tpu.runtime import load_stack_dir, load_stack_dir_c2
+
+
+@pytest.fixture(scope="module")
+def scene():
+    # spans the 2013 sensor switch so both band numberings are exercised
+    return make_stack(SceneSpec(width=16, height=12, year_start=2009, year_end=2016))
+
+
+def test_c2_matches_prestacked(tmp_path, scene):
+    d_stacked = str(tmp_path / "stacked")
+    d_c2 = str(tmp_path / "c2")
+    write_stack(d_stacked, scene)
+    write_stack_c2(d_c2, scene)
+
+    a = load_stack_dir(d_stacked)
+    b = load_stack_dir_c2(d_c2)
+    np.testing.assert_array_equal(a.years, b.years)
+    np.testing.assert_array_equal(a.qa, b.qa)
+    for band in BANDS:
+        np.testing.assert_array_equal(a.dn_bands[band], b.dn_bands[band])
+    assert b.geo is not None and b.geo.pixel_scale == (30.0, 30.0, 0.0)
+
+
+def test_c2_autodetected_by_load_stack_dir(tmp_path, scene):
+    d = str(tmp_path / "c2auto")
+    write_stack_c2(d, scene)
+    got = load_stack_dir(d)  # no explicit c2 call
+    np.testing.assert_array_equal(got.years, scene.years)
+
+
+def test_c2_missing_band_errors(tmp_path, scene):
+    d = str(tmp_path / "c2gap")
+    paths = write_stack_c2(d, scene)
+    os.remove([p for p in paths if p.endswith("_SR_B4.TIF")][0])  # a TM nir
+    with pytest.raises(ValueError, match="missing bands.*nir"):
+        load_stack_dir_c2(d)
+
+
+def test_c2_multiple_acquisitions_per_year_error(tmp_path, scene):
+    d = str(tmp_path / "c2dup")
+    paths = write_stack_c2(d, scene)
+    # duplicate one band under a second acquisition date in the same year
+    src = paths[0]
+    dup = os.path.join(d, os.path.basename(src).replace("0715", "0816"))
+    with open(src, "rb") as f, open(dup, "wb") as g:
+        g.write(f.read())
+    with pytest.raises(ValueError, match="multiple acquisitions"):
+        load_stack_dir_c2(d)
+
+
+def test_c2_empty_dir_errors(tmp_path):
+    d = str(tmp_path / "empty")
+    os.makedirs(d)
+    with pytest.raises(FileNotFoundError):
+        load_stack_dir_c2(d)
+
+
+def test_c2_unused_bands_ignored(tmp_path, scene):
+    """OLI's coastal B1 (and thermal-era extras) are skipped, not errors."""
+    d = str(tmp_path / "c2extra")
+    write_stack_c2(d, scene)
+    extra = os.path.join(d, "LC08_L2SP_045030_20160715_20160715_02_T1_SR_B1.TIF")
+    from land_trendr_tpu.io.geotiff import write_geotiff
+
+    write_geotiff(extra, np.zeros((12, 16), dtype=np.int16))
+    got = load_stack_dir_c2(d)
+    np.testing.assert_array_equal(got.years, scene.years)
+
+
+def test_c2_cli_segment_runs(tmp_path, scene):
+    """End-to-end: the segment CLI ingests a per-band C2 directory."""
+    import json
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "c2cli")
+    out = str(tmp_path / "out")
+    write_stack_c2(d, scene)
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "land_trendr_tpu", "--platform", "cpu",
+            "segment", d, "--out-dir", out,
+            "--workdir", str(tmp_path / "work"), "--tile-size", "16",
+        ],
+        capture_output=True,
+        text=True,
+        env=dict(
+            os.environ,
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+                + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            ),
+        ),
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    payload = json.loads(r.stdout)
+    assert payload["summary"]["tiles"] >= 1
+    assert payload["summary"]["pixels"] == 16 * 12
+    assert os.path.exists(os.path.join(out, "rmse.tif"))
+
+
+def test_c2_uint16_sr_preserved(tmp_path):
+    """Real C2 SR files are uint16 with valid DNs up to 43636 — the loader
+    must keep the dtype, not wrap bright pixels negative (code-review r3)."""
+    from land_trendr_tpu.io.geotiff import write_geotiff
+    from land_trendr_tpu.ops.indices import scale_sr
+
+    d = str(tmp_path / "u16")
+    os.makedirs(d)
+    stem = "LC08_L2SP_045030_20200715_20200912_02_T1"
+    nums = {"blue": 2, "green": 3, "red": 4, "nir": 5, "swir1": 6, "swir2": 7}
+    bright = np.full((4, 4), 43636, dtype=np.uint16)  # reflectance ~1.0
+    for b in BANDS:
+        write_geotiff(os.path.join(d, f"{stem}_SR_B{nums[b]}.TIF"), bright)
+    write_geotiff(
+        os.path.join(d, f"{stem}_QA_PIXEL.TIF"),
+        np.zeros((4, 4), dtype=np.uint16),
+    )
+    got = load_stack_dir_c2(d)
+    assert got.dn_bands["nir"].dtype == np.uint16
+    sr = np.asarray(scale_sr(got.dn_bands["nir"]))
+    np.testing.assert_allclose(sr, 43636 * 2.75e-5 - 0.2, rtol=1e-5)  # ~1.0
+
+
+def test_c2_rt_tier_accepted(tmp_path, scene):
+    """The USGS RT (real-time) collection tier must not silently vanish."""
+    d = str(tmp_path / "rt")
+    paths = write_stack_c2(d, scene)
+    for p in paths:
+        os.rename(p, p.replace("_T1_", "_RT_"))
+    got = load_stack_dir_c2(d)
+    np.testing.assert_array_equal(got.years, scene.years)
+
+
+def test_c2_mixed_pathrows_error_and_pattern_select(tmp_path, scene):
+    """Two WRS-2 scenes in one directory error loudly; a pattern filter
+    selects one (code-review r3: pathrow was captured but unused)."""
+    d = str(tmp_path / "two_scenes")
+    paths = write_stack_c2(d, scene)
+    for p in paths:  # duplicate every file under the adjacent path/row
+        dst = p.replace("_045030_", "_045031_")
+        with open(p, "rb") as fsrc, open(dst, "wb") as fdst:
+            fdst.write(fsrc.read())
+    with pytest.raises(ValueError, match="path/rows"):
+        load_stack_dir_c2(d)
+    got = load_stack_dir_c2(d, pattern=r"_045030_")
+    np.testing.assert_array_equal(got.years, scene.years)
+    # and through the auto-detecting entry point with the same pattern
+    got2 = load_stack_dir(str(d), pattern=r"_045030_.*\.tif$")
+    np.testing.assert_array_equal(got2.years, scene.years)

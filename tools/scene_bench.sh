@@ -1,0 +1,16 @@
+#!/bin/bash
+# Config #3 scene-scale end-to-end benchmark (VERDICT r2 item #2):
+# synthetic full-WRS-2-size stack -> run_stack -> assemble_outputs on CPU.
+set -e
+cd /root/repo
+D=/root/repo/.scene_r03
+LOG=$D/scene.log
+mkdir -p "$D"
+echo "[$(date -u +%FT%TZ)] synth start" >> "$LOG"
+python -m land_trendr_tpu --platform cpu synth "$D/stack" --size 5000 \
+  >> "$LOG" 2>&1
+echo "[$(date -u +%FT%TZ)] segment start" >> "$LOG"
+/usr/bin/time -v python -m land_trendr_tpu --platform cpu segment "$D/stack" \
+  --workdir "$D/work" --out-dir "$D/out" --tile-size 512 \
+  > "$D/summary.json" 2> "$D/time.txt"
+echo "[$(date -u +%FT%TZ)] segment done rc=$?" >> "$LOG"
