@@ -114,20 +114,43 @@ def _run_once(dev, px: int, ny: int, reps: int) -> float:
     """Time the kernel at one batch size; returns best-rep seconds.
 
     Raises on device/validity failure so the caller can back off.
+
+    Batches larger than ``LT_BENCH_CHUNK`` (default 256K px) run through
+    the chunked kernel: transient HBM stays bounded at one chunk while
+    outputs for the whole batch accumulate — the production path the tile
+    driver uses for ≥1024² tiles, and the configuration a real chip should
+    be benched in (the unchunked 1M-px batch was the round-1/2 OOM-backoff
+    trigger).
     """
     import jax
 
     from land_trendr_tpu.config import LTParams
-    from land_trendr_tpu.ops.segment import jax_segment_pixels
+    from land_trendr_tpu.ops.segment import (
+        jax_segment_pixels,
+        jax_segment_pixels_chunked,
+    )
+    from land_trendr_tpu.parallel.mesh import pad_to_multiple
 
     params = LTParams()
     years_np, vals_np, mask_np = make_series(px, ny)
+    chunk = int(os.environ.get("LT_BENCH_CHUNK", 262144))
+    if px > chunk:
+        # indivisible px pads up with fully-masked rows (never a silent
+        # fallback to the unchunked kernel — that is the OOM path);
+        # throughput still counts only the real pixels
+        vals_np, mask_np, _ = pad_to_multiple(vals_np, mask_np, chunk)
+
+        def run(y, v, m, p):
+            return jax_segment_pixels_chunked(y, v, m, p, chunk)
+    else:
+        run = jax_segment_pixels
+
     years = jax.device_put(years_np, dev)
     vals = jax.device_put(vals_np, dev)
     mask = jax.device_put(mask_np, dev)
 
     # warm-up: compile + first run, with a host fetch proving it executed
-    out = jax_segment_pixels(years, vals, mask, params)
+    out = run(years, vals, mask, params)
     jax.block_until_ready(out)
     probe = np.asarray(out.rmse[: min(px, 64)])
     if not np.isfinite(probe).all():
@@ -136,7 +159,7 @@ def _run_once(dev, px: int, ny: int, reps: int) -> float:
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = jax_segment_pixels(years, vals, mask, params)
+        out = run(years, vals, mask, params)
         jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
 

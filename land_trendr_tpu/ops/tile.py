@@ -30,7 +30,12 @@ import jax.numpy as jnp
 from land_trendr_tpu.config import LTParams
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.ftv import jax_fit_to_vertices
-from land_trendr_tpu.ops.segment import SegOutputs, jax_segment_pixels
+from land_trendr_tpu.ops.segment import (
+    SegOutputs,
+    jax_segment_pixels,
+    jax_segment_pixels_chunked,
+)
+from land_trendr_tpu.parallel.mesh import pad_to_multiple
 
 __all__ = ["TileOutputs", "process_tile_dn", "process_tile_index"]
 
@@ -46,7 +51,10 @@ class TileOutputs(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("index", "ftv_indices", "params", "scale", "offset", "reject_bits"),
+    static_argnames=(
+        "index", "ftv_indices", "params", "scale", "offset", "reject_bits",
+        "chunk",
+    ),
 )
 def process_tile_dn(
     years: jnp.ndarray,
@@ -58,25 +66,40 @@ def process_tile_dn(
     scale: float = 2.75e-5,
     offset: float = -0.2,
     reject_bits: int = idx.DEFAULT_QA_REJECT,
+    chunk: int | None = None,
 ) -> TileOutputs:
     """Segment one tile straight from Collection-2 style DNs.
 
     Parameters
     ----------
     years : (NY,) shared year axis.
-    dn_bands : band name → (PX, NY) int16 DN arrays; must contain whatever
-        bands ``index`` and ``ftv_indices`` need (all six for TCW).
+    dn_bands : band name → (PX, NY) int16/uint16 DN arrays; must contain
+        whatever bands ``index`` and ``ftv_indices`` need (all six for TCW).
     qa : (PX, NY) uint16 QA_PIXEL bitfield.
     index : primary index driving the segmentation.
     ftv_indices : secondary indices fitted to the chosen vertices
         (classic LandTrendr FTV outputs, SURVEY.md §3.1 outputs).
     params, scale, offset, reject_bits : static knobs; one compile per
         combination.
+    chunk : when set and PX > chunk, the segmentation runs through
+        :func:`jax_segment_pixels_chunked` so transient HBM is bounded by
+        ``chunk`` pixels (large tiles, e.g. tile_size >= 1024 — the kernel's
+        working set is linear in PX).  PX is padded to the next chunk
+        multiple with fully-masked rows and cropped back, so results are
+        identical to the unchunked path (see the chunked kernel's
+        contract).
     """
     sr = {name: idx.scale_sr(dn, scale, offset) for name, dn in dn_bands.items()}
     mask = idx.qa_valid_mask(qa, reject_bits) & idx.sr_valid_mask(sr)
     primary = idx.compute_index(index, sr)
-    seg = jax_segment_pixels(years, primary, mask, params)
+    px = primary.shape[0]
+    if chunk is not None and px > chunk:
+        primary_p, mask_p, _ = pad_to_multiple(primary, mask, chunk)
+        seg = jax_segment_pixels_chunked(years, primary_p, mask_p, params, chunk)
+        if primary_p.shape[0] != px:
+            seg = SegOutputs(*(o[:px] for o in seg))
+    else:
+        seg = jax_segment_pixels(years, primary, mask, params)
     ftv = {}
     for name in ftv_indices:
         series = idx.compute_index(name, sr)

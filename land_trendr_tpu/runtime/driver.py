@@ -67,6 +67,11 @@ class RunConfig:
     scale: float = 2.75e-5
     offset: float = -0.2
     reject_bits: int = idx.DEFAULT_QA_REJECT
+    #: transient-HBM bound for large tiles: tiles with more pixels than this
+    #: run the segmentation through the chunked kernel (the kernel's working
+    #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
+    #: what a 256² tile needs by 16×).  ``None`` disables chunking.
+    chunk_px: int | None = 262_144
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -83,6 +88,9 @@ class RunConfig:
                 # changes the set of arrays each tile artifact carries, so a
                 # toggled resume must not reuse old artifacts
                 "write_fitted": self.write_fitted,
+                # chunking changes f32 fusion choices (~0.003% knife-edge
+                # decision flips) — a resume must not mix chunkings
+                "chunk_px": self.chunk_px,
             }
         )
 
@@ -247,6 +255,7 @@ def run_stack(
                         scale=cfg.scale,
                         offset=cfg.offset,
                         reject_bits=cfg.reject_bits,
+                        chunk=cfg.chunk_px,
                     ),
                     None,
                 )

@@ -86,6 +86,19 @@ class RasterStack:
         return int(self.years.shape[0])
 
 
+def _stack_years(name: str, arrs: list[np.ndarray]) -> np.ndarray:
+    """``np.stack`` with a dtype-uniformity guard: a mixed int16/uint16 year
+    list would silently promote to int32 — double the documented
+    ~6 B/pixel-year feed and outside RasterStack's 16-bit contract."""
+    dtypes = sorted({str(a.dtype) for a in arrs})
+    if len(dtypes) > 1:
+        raise ValueError(
+            f"band {name!r}: mixed DN dtypes across years {dtypes} — "
+            "re-export the archive with one dtype"
+        )
+    return np.stack(arrs)
+
+
 def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
     """Load a directory of Landsat rasters, auto-detecting the layout.
 
@@ -145,7 +158,7 @@ def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
 
     return RasterStack(
         years=years,
-        dn_bands={b: np.stack(v) for b, v in dn_bands.items()},
+        dn_bands={b: _stack_years(b, v) for b, v in dn_bands.items()},
         qa=np.stack(qa_list),
         geo=geo,
     )
@@ -243,7 +256,7 @@ def load_stack_dir_c2(path: str, pattern: str | None = None) -> RasterStack:
 
     return RasterStack(
         years=years,
-        dn_bands={b: np.stack(v) for b, v in dn_bands.items()},
+        dn_bands={b: _stack_years(b, v) for b, v in dn_bands.items()},
         qa=np.stack(qa_list),
         geo=geo,
     )

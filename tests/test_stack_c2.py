@@ -164,3 +164,30 @@ def test_c2_mixed_pathrows_error_and_pattern_select(tmp_path, scene):
     # and through the auto-detecting entry point with the same pattern
     got2 = load_stack_dir(str(d), pattern=r"_045030_.*\.tif$")
     np.testing.assert_array_equal(got2.years, scene.years)
+
+
+def test_c2_mixed_dtype_years_rejected(tmp_path):
+    """int16 and uint16 SR files across years must not silently promote to
+    int32 at np.stack (code-review r3)."""
+    from land_trendr_tpu.io.geotiff import write_geotiff
+
+    d = str(tmp_path / "mixdt")
+    os.makedirs(d)
+    nums_tm = {"blue": 1, "green": 2, "red": 3, "nir": 4, "swir1": 5, "swir2": 7}
+    nums_oli = {"blue": 2, "green": 3, "red": 4, "nir": 5, "swir1": 6, "swir2": 7}
+    for year, sensor, nums, dt in (
+        (2010, "LT05", nums_tm, np.int16),
+        (2014, "LC08", nums_oli, np.uint16),
+    ):
+        stem = f"{sensor}_L2SP_045030_{year}0715_{year}0715_02_T1"
+        for b in BANDS:
+            write_geotiff(
+                os.path.join(d, f"{stem}_SR_B{nums[b]}.TIF"),
+                np.full((4, 4), 9000, dtype=dt),
+            )
+        write_geotiff(
+            os.path.join(d, f"{stem}_QA_PIXEL.TIF"),
+            np.zeros((4, 4), dtype=np.uint16),
+        )
+    with pytest.raises(ValueError, match="mixed DN dtypes"):
+        load_stack_dir_c2(d)
