@@ -10,8 +10,9 @@ slice of TIFF 6.0 + GeoTIFF it actually needs:
   or tiled layout, uncompressed / Deflate (zlib) / raw-deflate / LZW,
   horizontal-differencing predictor, chunky or planar multi-band,
   u/int 8/16/32, float32/64;
-* **write**: tiled (default) or stripped, Deflate or uncompressed, optional
-  horizontal predictor, any of the dtypes above, chunky band layout;
+* **write**: tiled (default) or stripped, Deflate, LZW, or uncompressed,
+  optional horizontal predictor, any of the dtypes above, chunky band
+  layout;
   classic by default, switching to BigTIFF automatically when the encoded
   file would overflow 4 GB addressing (CONUS ARD mosaic products,
   SURVEY.md §7 hard-part 5);
@@ -273,6 +274,72 @@ def _lzw_decode(data: bytes) -> bytes:
         if next_code == (1 << code_bits) - 1 and code_bits < 12:
             code_bits += 1
         prev = entry
+    return bytes(out)
+
+
+def _lzw_encode(data: bytes) -> bytes:
+    """TIFF 6.0 LZW encoder: MSB-first packing, ClearCode first, 9→12-bit
+    codes with the spec's "early change" width bumps, Clear + reset when
+    the table fills (code 4094, libtiff's limit).
+
+    Inverse of :func:`_lzw_decode`; outputs are validated round-trip
+    against both our decoder and Pillow's (tests/test_geotiff.py), which
+    pins the width-bump timing empirically.  The dictionary is
+    ``(prefix_code << 8 | byte) → code`` so each input byte is one dict
+    probe — O(n) overall.
+    """
+    CLEAR, EOI = 256, 257
+    out = bytearray()
+    buf = 0
+    nbits = 0
+    code_bits = 9
+
+    def emit(code: int) -> None:
+        nonlocal buf, nbits
+        buf = (buf << code_bits) | code
+        nbits += code_bits
+        while nbits >= 8:
+            nbits -= 8
+            out.append((buf >> nbits) & 0xFF)
+        buf &= (1 << nbits) - 1  # drop drained bits: keep buf a small int
+
+    table: dict[int, int] = {}
+    next_code = 258
+    emit(CLEAR)
+    prev = -1
+    for b in data:
+        if prev < 0:
+            prev = b
+            continue
+        key = (prev << 8) | b
+        code = table.get(key)
+        if code is not None:
+            prev = code
+            continue
+        emit(prev)
+        table[key] = next_code
+        next_code += 1
+        prev = b
+        # the decoder's table lags one add behind the encoder's, and its
+        # "early change" bump fires at (1<<bits)-1 — so the encoder bumps
+        # at (1<<bits): both sides widen before the same emitted code
+        if next_code == (1 << code_bits) and code_bits < 12:
+            code_bits += 1
+        elif next_code >= 4094:  # table full: clear and restart
+            emit(CLEAR)
+            table.clear()
+            next_code = 258
+            code_bits = 9
+    if prev >= 0:
+        emit(prev)
+        # the decoder's add for this final code catches its count up to
+        # ours and can trigger its early-change bump — EOI must be written
+        # at the width the decoder will read it with
+        if next_code == (1 << code_bits) - 1 and code_bits < 12:
+            code_bits += 1
+    emit(EOI)
+    if nbits:
+        out.append((buf << (8 - nbits)) & 0xFF)
     return bytes(out)
 
 
@@ -642,9 +709,10 @@ def write_geotiff(
     """Encode ``array`` (``(H, W)`` or ``(bands, H, W)``) as a GeoTIFF.
 
     Always little-endian, chunky band layout; ``tile=None`` writes one strip
-    per 64 rows instead of tiles.  ``predictor`` enables horizontal
-    differencing for integer dtypes under deflate (better compression on
-    smooth rasters; ignored for floats and uncompressed files).
+    per 64 rows instead of tiles.  ``compress`` is ``"deflate"`` (default),
+    ``"lzw"``, or ``"none"``.  ``predictor`` enables horizontal
+    differencing for integer dtypes under deflate/LZW (better compression
+    on smooth rasters; ignored for floats and uncompressed files).
 
     ``bigtiff``: ``"auto"`` (default) switches to the BigTIFF layout (u64
     offsets) exactly when the encoded file would overflow classic TIFF's
@@ -664,6 +732,8 @@ def write_geotiff(
     fmt, bits = _DTYPE_TO_FORMAT[arr.dtype.newbyteorder("=")]
     if compress == "deflate":
         comp_id = _COMP_DEFLATE_ADOBE
+    elif compress == "lzw":
+        comp_id = _COMP_LZW
     elif compress in (None, "none"):
         comp_id = _COMP_NONE
     else:
@@ -801,6 +871,8 @@ def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:
     raw = block.tobytes()
     if comp_id == _COMP_NONE:
         return raw
+    if comp_id == _COMP_LZW:
+        return _lzw_encode(raw)
     return zlib.compress(raw, 6)
 
 
@@ -818,9 +890,11 @@ def _encode_all(block_iter, comp_id: int, use_pred: bool) -> list[bytes]:
     the whole raster.  Equal-shape runs batch together (always true for the
     tiled layout; the strip layout's short last strip flushes a chunk).
     Both paths produce byte-identical output: same zlib level, same
-    predictor arithmetic — the native path is acceleration only.
+    predictor arithmetic — the native path is acceleration only.  The
+    native library encodes deflate only; LZW writes go per-block through
+    :func:`_lzw_encode`.
     """
-    if not (native.available() and comp_id != _COMP_NONE):
+    if not (native.available() and comp_id == _COMP_DEFLATE_ADOBE):
         return [_encode_block(b, comp_id, use_pred) for b in block_iter]
 
     out: list[bytes] = []
