@@ -83,6 +83,15 @@ class SyntheticStack:
         dn = np.round((sr - _C2_OFFSET) / _C2_SCALE)
         return np.clip(dn, -32768, 32767).astype(np.int16)
 
+    def dn_year(self, name: str, i: int) -> np.ndarray:
+        """One year's ``(H, W)`` slice of :meth:`dn` — same arithmetic on
+        the slice only, so writers stay O(H·W) in both extra time and
+        memory per file instead of converting the whole cube per year
+        (O(NY²) time) or holding all band cubes at once (≈+50% peak)."""
+        sr = self.bands[name][i]
+        dn = np.round((sr - _C2_OFFSET) / _C2_SCALE)
+        return np.clip(dn, -32768, 32767).astype(np.int16)
+
 
 def make_stack(spec: SceneSpec = SceneSpec()) -> SyntheticStack:
     rng = np.random.default_rng(spec.seed)
@@ -198,7 +207,7 @@ def write_stack(
     )
     paths = []
     for i, year in enumerate(stack.years):
-        sr = np.stack([stack.dn(b)[i] for b in BANDS])          # (6, H, W) i16
+        sr = np.stack([stack.dn_year(b, i) for b in BANDS])     # (6, H, W) i16
         qa = stack.qa[i].astype(np.int16)                        # QA bits fit
         img = np.concatenate([sr, qa[None]], axis=0)
         path = os.path.join(out_dir, f"LT_{int(year)}.tif")
@@ -244,7 +253,7 @@ def write_stack_c2(
         for b in BANDS:
             path = os.path.join(out_dir, f"{stem}_SR_B{nums[b]}.TIF")
             write_geotiff(
-                path, stack.dn(b)[i], geo=geo, compress=compress, tile=tile
+                path, stack.dn_year(b, i), geo=geo, compress=compress, tile=tile
             )
             paths.append(path)
         path = os.path.join(out_dir, f"{stem}_QA_PIXEL.TIF")

@@ -364,32 +364,52 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
 
     fitted, _, _ = lax.fori_loop(1, nv - 1, body, (fitted, anchor_t, anchor_y))
 
-    # --- point-to-point fallback ---
-    def p2p_body(k, carry):
-        p2p, ok = carry
-        a, b = vpos[k], vpos[k + 1]
-        active = (k + 1) < n_verts
-        a_c = jnp.clip(a, 0, ny - 1)
-        b_c = jnp.clip(b, 0, ny - 1)
-        dur = t[b_c] - t[a_c]
-        dy = y[b_c] - y[a_c]
-        # oracle._segment_violates
-        viol = (dy < 0.0) & (y_range > 0.0) & (dur > 0.0)
-        if params.prevent_one_year_recovery:
-            fast = dur <= 1.0
-        else:
-            fast = jnp.zeros((), dtype=bool)
-        viol = viol & (
-            fast | ((-dy) / jnp.where(dur > 0.0, dur, 1.0) > params.recovery_threshold * y_range + _EPS_RATE)
+    # --- point-to-point fallback (vectorized over segments) ---
+    # Per-element arithmetic is identical to the former per-segment
+    # fori_loop (same gathers, same multiply/divide order), so f64 oracle
+    # parity is preserved; the loop's "later segment wins at shared vertex
+    # years" overwrite order is reproduced by ``seg_of`` assigning a vertex
+    # year to the segment STARTING at it.
+    ks = jnp.arange(nv - 1)
+    a_s, b_s = vpos[:-1], vpos[1:]                  # (NV-1,) segment bounds
+    active_s = (ks + 1) < n_verts
+    a_sc = jnp.clip(a_s, 0, ny - 1)
+    b_sc = jnp.clip(b_s, 0, ny - 1)
+    dur_s = t[b_sc] - t[a_sc]
+    dy_s = y[b_sc] - y[a_sc]
+    # oracle._segment_violates
+    viol_s = (dy_s < 0.0) & (y_range > 0.0) & (dur_s > 0.0)
+    if params.prevent_one_year_recovery:
+        fast_s = dur_s <= 1.0
+    else:
+        fast_s = jnp.zeros_like(viol_s)
+    viol_s = viol_s & (
+        fast_s
+        | (
+            (-dy_s) / jnp.where(dur_s > 0.0, dur_s, 1.0)
+            > params.recovery_threshold * y_range + _EPS_RATE
         )
-        ok = ok & ~(viol & active)
-        member = (iota >= a) & (iota <= b) & mask & active
-        rate = jnp.where(dur > 0.0, dy / jnp.where(dur > 0.0, dur, 1.0), 0.0)
-        p2p = jnp.where(member, y[a_c] + rate * (t - t[a_c]), p2p)
-        return p2p, ok
-
+    )
+    p2p_ok = ~jnp.any(viol_s & active_s)
+    rate_s = jnp.where(dur_s > 0.0, dy_s / jnp.where(dur_s > 0.0, dur_s, 1.0), 0.0)
+    # the loop's overwrite order gives a shared vertex year to the segment
+    # STARTING at it — except the last vertex, which only its preceding
+    # segment contains; min(·, n_verts-2) reproduces that
+    seg_of = jnp.clip(
+        jnp.minimum(jnp.cumsum(vmask) - 1, n_verts - 2), 0, nv - 2
+    )
+    member_y = (
+        (iota >= vpos[0])
+        & (iota <= _last_vertex(vpos, ny))
+        & mask
+        & active_s[seg_of]
+    )
     p2p0 = jnp.where((iota == vpos[0]) & mask, y, 0.0)
-    p2p, p2p_ok = lax.fori_loop(0, nv - 1, p2p_body, (p2p0, jnp.array(True)))
+    p2p = jnp.where(
+        member_y,
+        y[a_sc[seg_of]] + rate_s[seg_of] * (t - t[a_sc[seg_of]]),
+        p2p0,
+    )
 
     # SSE over the vertex span only (oracle fit_model: "SSE comparisons use
     # only the vertex span").  In the segmentation pipeline the vertices span
