@@ -92,6 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--write-fitted", action="store_true",
                      help="also write the full fitted-trajectory raster")
     seg.add_argument("--max-retries", type=int, default=2)
+    seg.add_argument(
+        "--mesh",
+        action="store_true",
+        help="shard every tile's pixel axis over ALL local devices "
+        "(jax.sharding 1-D mesh, zero cross-pixel collectives); default "
+        "runs on the single default device",
+    )
     seg.add_argument("--scale", type=float, default=2.75e-5,
                      help="DN→reflectance scale (C2 default)")
     seg.add_argument("--offset", type=float, default=-0.2,
@@ -275,8 +282,17 @@ def main(argv: list[str] | None = None) -> int:
             scale=args.scale,
             offset=args.offset,
         )
+        mesh = None
+        if args.mesh:
+            import jax
+
+            from land_trendr_tpu.parallel import make_mesh
+
+            # local devices only: tiles are the cross-host unit (run_stack
+            # rejects non-addressable meshes)
+            mesh = make_mesh(jax.local_devices())
         stack = load_stack_dir(args.stack_dir)
-        summary = run_stack(stack, cfg)
+        summary = run_stack(stack, cfg, mesh=mesh)
         paths = assemble_outputs(stack, cfg)
         print(json.dumps({"summary": summary, "outputs": paths}, indent=2))
         return 0

@@ -89,7 +89,9 @@ class RunConfig:
                 # toggled resume must not reuse old artifacts
                 "write_fitted": self.write_fitted,
                 # chunking changes f32 fusion choices (~0.003% knife-edge
-                # decision flips) — a resume must not mix chunkings
+                # decision flips) — a resume must not mix chunkings.  The
+                # mesh device count is checked separately via the manifest
+                # header's context (assembly must stay mesh-blind).
                 "chunk_px": self.chunk_px,
             }
         )
@@ -195,8 +197,24 @@ def run_stack(
     stack: RasterStack,
     cfg: RunConfig,
     tiles: Sequence[TileSpec] | None = None,
+    mesh: "jax.sharding.Mesh | None" = None,
 ) -> dict:
     """Segment a whole stack tile by tile; returns the run summary.
+
+    ``mesh`` (a 1-D :func:`land_trendr_tpu.parallel.make_mesh` mesh over
+    THIS PROCESS's devices — ``make_mesh(jax.local_devices())``) shards
+    every tile's pixel axis over those chips: inputs are placed with
+    ``NamedSharding(mesh, P("pixels", None))`` and XLA partitions the
+    vmapped kernel with zero cross-pixel collectives — one tile then uses
+    all local chips instead of one.  On a multi-host pod, tiles (not
+    shards) are the cross-host unit: each process takes its
+    :func:`~land_trendr_tpu.parallel.host_share` of the tiles and runs
+    them on its own local mesh; a shared-filesystem workdir makes the
+    manifest/assembly global, mirroring the reference's HDFS-backed job
+    state.  A mesh spanning other processes' devices is rejected.  With a
+    mesh, the per-device pixel slice must itself satisfy ``chunk_px``
+    (chunking cannot be combined with a sharded pixel axis); oversized
+    combinations raise instead of silently exceeding the HBM bound.
 
     The tile loop is a depth-1 software pipeline over three resources that
     would otherwise idle each other (SURVEY.md §7 step 4 "host
@@ -231,11 +249,55 @@ def run_stack(
     if tiles is None:
         tiles = plan_tiles(*stack.shape, cfg.tile_size)
     tile_px = cfg.tile_size * cfg.tile_size
-    manifest = TileManifest(cfg.workdir, cfg.fingerprint(stack))
+    n_mesh = int(mesh.devices.size) if mesh is not None else 1
+    manifest = TileManifest(
+        cfg.workdir, cfg.fingerprint(stack), context={"mesh_devices": n_mesh}
+    )
     done = manifest.open(cfg.resume)
     years = stack.years.astype(np.int32)
     bands = idx.required_bands(cfg.index, cfg.ftv_indices)
     todo = [t for t in tiles if t.tile_id not in done]
+    n_resume_skipped = len(tiles) - len(todo)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from land_trendr_tpu.parallel import PIXEL_AXIS, host_share
+
+        # Tiles are the CROSS-HOST work unit (host_share below); the mesh
+        # shards one tile's pixels over this process's chips only.  A mesh
+        # spanning other processes' devices would make device_put treat
+        # each host's different tile as shards of one global array — a
+        # silent cross-host mix — so it is rejected outright.
+        me = jax.process_index()
+        if any(d.process_index != me for d in mesh.devices.flat):
+            raise ValueError(
+                "run_stack needs an ADDRESSABLE mesh — build it with "
+                "make_mesh(jax.local_devices()); tiles are distributed "
+                "across hosts by host_share, not by sharding one tile "
+                "over the pod"
+            )
+        # multi-host: this process feeds only its share of the tiles;
+        # single-process this is the identity
+        todo = host_share(todo)
+        px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
+        # _feed_tile pads to feed_px with the QA fill bit, which also
+        # covers the divisibility the sharded pixel axis needs
+        feed_px = tile_px + (-tile_px) % n_mesh
+        # chunking a sharded pixel axis would reshard (lax.map reshapes),
+        # so the per-device slice itself must satisfy the HBM bound
+        chunk = None
+        if cfg.chunk_px is not None and tile_px / n_mesh > cfg.chunk_px:
+            raise ValueError(
+                f"per-device pixel slice {tile_px // n_mesh} exceeds "
+                f"chunk_px={cfg.chunk_px}: reduce tile_size (or raise "
+                "chunk_px if the devices' HBM allows it) — chunking "
+                "cannot be combined with a sharded pixel axis"
+            )
+    else:
+        px_sharding = None
+        feed_px = tile_px
+        chunk = cfg.chunk_px
 
     t_run = time.perf_counter()
     timer = StageTimer()
@@ -244,6 +306,11 @@ def run_stack(
         """Async-dispatch one tile; returns ``(out, None)`` or ``(None, exc)``."""
         try:
             with timer.stage("dispatch"):
+                if px_sharding is not None:
+                    dn = {
+                        k: jax.device_put(v, px_sharding) for k, v in dn.items()
+                    }
+                    qa = jax.device_put(qa, px_sharding)
                 return (
                     process_tile_dn(
                         years,
@@ -255,7 +322,7 @@ def run_stack(
                         scale=cfg.scale,
                         offset=cfg.offset,
                         reject_bits=cfg.reject_bits,
-                        chunk=cfg.chunk_px,
+                        chunk=chunk,
                     ),
                     None,
                 )
@@ -336,7 +403,7 @@ def run_stack(
         pending = None
         for t in todo:
             with timer.stage("feed"):
-                dn, qa = _feed_tile(stack, t, tile_px, bands)
+                dn, qa = _feed_tile(stack, t, feed_px, bands)
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
             dt_dispatch = time.perf_counter() - t0
@@ -363,13 +430,14 @@ def run_stack(
     wall = time.perf_counter() - t_run
     summary = {
         "tiles": len(tiles),
-        "tiles_skipped_resume": len(tiles) - len(todo),
+        "tiles_skipped_resume": n_resume_skipped,
         "pixels": n_px,
         "fit_rate": (n_fit / n_px) if n_px else 0.0,
         "wall_s": round(wall, 3),
         "px_per_s": round(n_px / wall, 1) if n_px else 0.0,
         "stage_s": timer.summary(),
         "fingerprint": manifest.fingerprint,
+        "mesh_devices": n_mesh,
     }
     log.info("run complete: %s", summary)
     return summary

@@ -35,10 +35,18 @@ def run_fingerprint(payload: dict) -> str:
 
 @dataclasses.dataclass
 class TileManifest:
-    """Append-only JSONL manifest of completed tiles in a work directory."""
+    """Append-only JSONL manifest of completed tiles in a work directory.
+
+    ``context`` carries execution facts that must not be MIXED across a
+    resume (e.g. ``{"mesh_devices": 8}`` — partitioning legally flips rare
+    f32 knife-edge decisions) but that post-hoc consumers like raster
+    assembly don't know and don't need: when ``context`` is None the
+    header's context is not checked.
+    """
 
     workdir: str
     fingerprint: str
+    context: dict | None = None
 
     @property
     def path(self) -> str:
@@ -62,9 +70,17 @@ class TileManifest:
             if n.endswith(".tmp.npz"):
                 os.remove(os.path.join(self.workdir, n))
         if not os.path.exists(self.path):
-            self._write_header()
-            return set()
+            # multiple processes of one pod run share a workdir; exclusive
+            # create means exactly one writes the header and the rest fall
+            # through to validate it like any resume
+            try:
+                self._write_header(exclusive=True)
+                return set()
+            except FileExistsError:
+                pass
         if not resume:
+            # inherently single-process (or externally coordinated): two
+            # processes discarding concurrently would race the rewrite
             os.remove(self.path)
             self._write_header()
             return set()
@@ -84,6 +100,16 @@ class TileManifest:
                             f"!= {self.fingerprint}); pass resume=False to "
                             "discard it"
                         )
+                    # headers written before context existed were all
+                    # single-device runs — treat a missing key as that
+                    stored = rec.get("context", {"mesh_devices": 1})
+                    if self.context is not None and stored != self.context:
+                        raise ValueError(
+                            f"workdir {self.workdir} was produced under a "
+                            f"different execution context "
+                            f"({stored} != {self.context}); "
+                            "pass resume=False to discard it"
+                        )
                     continue
                 if rec.get("kind") != "tile":
                     continue
@@ -92,12 +118,12 @@ class TileManifest:
                     done.add(tid)
         return done
 
-    def _write_header(self) -> None:
-        with open(self.path, "w") as f:
-            f.write(
-                json.dumps({"kind": "header", "fingerprint": self.fingerprint})
-                + "\n"
-            )
+    def _write_header(self, exclusive: bool = False) -> None:
+        hdr = {"kind": "header", "fingerprint": self.fingerprint}
+        if self.context is not None:
+            hdr["context"] = self.context
+        with open(self.path, "x" if exclusive else "w") as f:
+            f.write(json.dumps(hdr) + "\n")
 
     def record(self, tile_id: int, arrays: dict[str, np.ndarray], meta: dict) -> None:
         """Persist one finished tile: artifact first, then the manifest line

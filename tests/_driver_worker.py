@@ -1,0 +1,60 @@
+"""Worker process for the true multi-process DRIVER test.
+
+Run as: ``python _driver_worker.py <coordinator> <num_procs> <proc_id>
+<workdir> <summary_json>``.  Each worker owns 4 virtual CPU devices.  The
+worker joins the ``jax.distributed`` cluster, builds the SAME deterministic
+synthetic stack as its peers, and calls the real production entry point —
+``run_stack`` with a LOCAL device mesh over a SHARED workdir.  Inside
+``run_stack``, ``host_share`` hands each process its half of the tiles and
+the shared manifest accumulates every tile: the v5e-pod driver flow
+(SURVEY.md §5 — per-host input feeding; tiles, not shards, cross hosts)
+scaled down to two localhost processes.
+"""
+
+import json
+import sys
+
+import jax
+
+# Must beat the sitecustomize's jax_platforms="axon,cpu" config selection
+# *before* any device/backend touch, or a down TPU tunnel hangs the worker.
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    coordinator, num_procs, proc_id, workdir, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        sys.argv[4],
+        sys.argv[5],
+    )
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.parallel import init_distributed, make_mesh
+    from land_trendr_tpu.runtime import RunConfig, run_stack, stack_from_synthetic
+
+    assert init_distributed(coordinator, num_procs, proc_id) is True
+    assert jax.process_count() == num_procs
+
+    mesh = make_mesh(jax.local_devices())  # local chips; tiles cross hosts
+    scene = make_stack(
+        SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    )
+    rs = stack_from_synthetic(scene)
+    cfg = RunConfig(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=20,  # 2×3 grid → 6 tiles, 3 per process
+        workdir=workdir,
+        out_dir=workdir + "_out",
+    )
+    summary = run_stack(rs, cfg, mesh=mesh)
+    with open(out_path, "w") as f:
+        json.dump(summary, f)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -233,3 +233,102 @@ def test_two_process_distributed_matches_single(tmp_path):
         )
     # the two host shares tile the scene exactly
     assert sorted(seen_rows) == list(range(16))
+
+
+def test_two_process_driver_shares_tiles(tmp_path):
+    """TRUE multi-process DRIVER run: two jax.distributed processes, each
+    with a 4-device local mesh, run ``run_stack`` over a SHARED workdir;
+    ``host_share`` splits the 6 tiles 3/3, the shared manifest accumulates
+    all of them, and assembly (in this process) mosaics the full scene."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "_driver_worker.py")
+    workdir = str(tmp_path / "shared_work")
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", "")
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env["PYTHONPATH"]
+    )
+
+    summaries = [str(tmp_path / f"summary{i}.json") for i in range(2)]
+
+    def launch_once() -> tuple[bool, str]:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, f"localhost:{port}", "2", str(i),
+                 workdir, summaries[i]],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+            for i in range(2)
+        ]
+
+        def reap_all() -> None:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+                q.communicate()
+
+        for i, p in enumerate(procs):
+            try:
+                _, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                reap_all()
+                return False, f"worker {i} timed out"
+            if p.returncode != 0:
+                reap_all()
+                lowered = err.lower()
+                retryable = "address already in use" in lowered or "bind" in lowered
+                return retryable, f"worker {i} failed:\n{err[-4000:]}"
+        return False, ""
+
+    for _attempt in range(3):
+        retryable, error = launch_once()
+        if not error:
+            break
+        if not retryable:
+            pytest.fail(error)
+    else:
+        pytest.fail(f"all port attempts raced: {error}")
+
+    # each process did exactly half the scene on its own 4-device mesh
+    per_proc = [json.load(open(p)) for p in summaries]
+    assert [s["mesh_devices"] for s in per_proc] == [4, 4]
+    assert sorted(s["pixels"] for s in per_proc) == [960, 960]  # 3 tiles each
+    assert sum(s["pixels"] for s in per_proc) == 40 * 48
+
+    # assembly from the shared workdir sees ALL tiles (mesh-blind consumer)
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import (
+        RunConfig,
+        assemble_outputs,
+        stack_from_synthetic,
+    )
+    from land_trendr_tpu.io.geotiff import read_geotiff
+
+    scene = make_stack(
+        SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    )
+    rs = stack_from_synthetic(scene)
+    cfg = RunConfig(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=20, workdir=workdir, out_dir=str(tmp_path / "out"),
+    )
+    paths = assemble_outputs(rs, cfg)
+    valid, _, _ = read_geotiff(paths["model_valid"])
+    assert valid.shape == (40, 48)
+    # both processes' halves contributed fitted pixels
+    assert valid[:, :20].any() and valid[:, 40:].any()
