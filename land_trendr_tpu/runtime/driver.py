@@ -250,15 +250,10 @@ def run_stack(
         tiles = plan_tiles(*stack.shape, cfg.tile_size)
     tile_px = cfg.tile_size * cfg.tile_size
     n_mesh = int(mesh.devices.size) if mesh is not None else 1
-    manifest = TileManifest(
-        cfg.workdir, cfg.fingerprint(stack), context={"mesh_devices": n_mesh}
-    )
-    done = manifest.open(cfg.resume)
-    years = stack.years.astype(np.int32)
-    bands = idx.required_bands(cfg.index, cfg.ftv_indices)
-    todo = [t for t in tiles if t.tile_id not in done]
-    n_resume_skipped = len(tiles) - len(todo)
 
+    # validate the mesh configuration BEFORE touching the workdir, so a
+    # rejected run cannot stamp a fresh manifest with a bad context
+    share = list(tiles)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -277,16 +272,8 @@ def run_stack(
                 "across hosts by host_share, not by sharding one tile "
                 "over the pod"
             )
-        # multi-host: this process feeds only its share of the tiles;
-        # single-process this is the identity
-        todo = host_share(todo)
-        px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
-        # _feed_tile pads to feed_px with the QA fill bit, which also
-        # covers the divisibility the sharded pixel axis needs
-        feed_px = tile_px + (-tile_px) % n_mesh
         # chunking a sharded pixel axis would reshard (lax.map reshapes),
         # so the per-device slice itself must satisfy the HBM bound
-        chunk = None
         if cfg.chunk_px is not None and tile_px / n_mesh > cfg.chunk_px:
             raise ValueError(
                 f"per-device pixel slice {tile_px // n_mesh} exceeds "
@@ -294,10 +281,30 @@ def run_stack(
                 "chunk_px if the devices' HBM allows it) — chunking "
                 "cannot be combined with a sharded pixel axis"
             )
+        # Each process takes its share of the FULL deterministic tile list
+        # (identical on every process), THEN filters resume-done tiles.
+        # Sharing the post-resume list instead would race: processes that
+        # open the shared manifest at different times would partition
+        # different lists, leaving tiles in nobody's share.
+        share = host_share(share)
+        px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
+        # _feed_tile pads to feed_px with the QA fill bit, which also
+        # covers the divisibility the sharded pixel axis needs
+        feed_px = tile_px + (-tile_px) % n_mesh
+        chunk = None
     else:
         px_sharding = None
         feed_px = tile_px
         chunk = cfg.chunk_px
+
+    manifest = TileManifest(
+        cfg.workdir, cfg.fingerprint(stack), context={"mesh_devices": n_mesh}
+    )
+    done = manifest.open(cfg.resume)
+    years = stack.years.astype(np.int32)
+    bands = idx.required_bands(cfg.index, cfg.ftv_indices)
+    todo = [t for t in share if t.tile_id not in done]
+    n_resume_skipped = len(share) - len(todo)
 
     t_run = time.perf_counter()
     timer = StageTimer()

@@ -65,10 +65,21 @@ class TileManifest:
         done.
         """
         os.makedirs(self.workdir, exist_ok=True)
-        # sweep temp artifacts orphaned by a crash mid-write
+        # sweep temp artifacts orphaned by a crash mid-write — but only
+        # STALE ones: in a shared pod workdir a peer process may be inside
+        # record() right now, and deleting its live tmp would abort its
+        # os.replace.  10 minutes is far beyond any tile write.
+        import time as _time
+
+        now = _time.time()
         for n in os.listdir(self.workdir):
             if n.endswith(".tmp.npz"):
-                os.remove(os.path.join(self.workdir, n))
+                p = os.path.join(self.workdir, n)
+                try:
+                    if now - os.path.getmtime(p) > 600:
+                        os.remove(p)
+                except OSError:
+                    pass  # a peer finished (replaced) or swept it first
         if not os.path.exists(self.path):
             # multiple processes of one pod run share a workdir; exclusive
             # create means exactly one writes the header and the rest fall
@@ -128,8 +139,9 @@ class TileManifest:
     def record(self, tile_id: int, arrays: dict[str, np.ndarray], meta: dict) -> None:
         """Persist one finished tile: artifact first, then the manifest line
         (so a crash between the two leaves a recoverable, not corrupt, state)."""
-        # note: np.savez appends ".npz" unless the name already ends with it
-        tmp = self.tile_path(tile_id) + ".tmp.npz"
+        # note: np.savez appends ".npz" unless the name already ends with it;
+        # the pid keeps concurrent pod processes' tmp files distinct
+        tmp = f"{self.tile_path(tile_id)}.{os.getpid()}.tmp.npz"
         np.savez_compressed(tmp, **arrays)
         os.replace(tmp, self.tile_path(tile_id))
         with open(self.path, "a") as f:

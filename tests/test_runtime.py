@@ -187,13 +187,23 @@ def test_output_rasters_natural_orientation(tmp_path, synth, rstack):
 
 
 def test_crash_orphan_tmp_swept(tmp_path, rstack):
+    """STALE tmp artifacts (a crashed writer's leftovers) are swept on
+    resume; FRESH ones survive — in a shared pod workdir they may be a
+    peer process's in-flight write (code-review r3)."""
+    import time
+
     cfg = make_cfg(tmp_path)
     run_stack(rstack, cfg)
-    orphan = os.path.join(cfg.workdir, "tile_00099.npz.tmp.npz")
-    with open(orphan, "wb") as f:
-        f.write(b"partial garbage")
-    run_stack(rstack, cfg)  # resume sweeps temp artifacts
-    assert not os.path.exists(orphan)
+    stale = os.path.join(cfg.workdir, "tile_00099.npz.123.tmp.npz")
+    fresh = os.path.join(cfg.workdir, "tile_00098.npz.456.tmp.npz")
+    for p in (stale, fresh):
+        with open(p, "wb") as f:
+            f.write(b"partial garbage")
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    run_stack(rstack, cfg)  # resume sweeps only the stale artifact
+    assert not os.path.exists(stale)
+    assert os.path.exists(fresh)
 
 
 def test_fingerprint_covers_write_fitted(rstack):

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Config #4 multi-index artifact (VERDICT r2 item #4): 1024^2 scene, NBR
+# segmentation + NDVI/TCW FTV rasters, spot-validated against the oracle.
+set -e
+cd /root/repo
+D=/root/repo/.mi_r03
+LOG=$D/mi.log
+mkdir -p "$D"
+echo "[$(date -u +%FT%TZ)] synth start" >> "$LOG"
+python -m land_trendr_tpu --platform cpu synth "$D/stack" --size 1024 >> "$LOG" 2>&1
+echo "[$(date -u +%FT%TZ)] segment start" >> "$LOG"
+python tools/run_segment_measured.py --platform cpu segment "$D/stack" \
+  --ftv ndvi,tcw --tile-size 512 \
+  --workdir "$D/work" --out-dir "$D/out" \
+  > "$D/summary.json" 2> "$D/time.txt"
+echo "[$(date -u +%FT%TZ)] validate start" >> "$LOG"
+python tools/validate_ftv.py "$D/stack" "$D/out" --samples=64 \
+  --out="$D/ftv_validation.json" >> "$LOG" 2>&1
+echo "[$(date -u +%FT%TZ)] done" >> "$LOG"

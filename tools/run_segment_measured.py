@@ -1,0 +1,31 @@
+"""Run the segment CLI in-process and report peak RSS alongside.
+
+Substitute for ``/usr/bin/time -v`` (not present in this image): the run
+summary goes to stdout exactly as the CLI prints it; a one-line JSON
+``{"peak_rss_mib": ...}`` goes to stderr at exit.
+
+Usage: python tools/run_segment_measured.py <cli args...>
+  e.g. python tools/run_segment_measured.py --platform cpu segment X --out-dir Y
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from land_trendr_tpu.cli import main as cli_main
+
+    rc = cli_main(sys.argv[1:])
+    peak_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KiB on Linux
+    print(json.dumps({"peak_rss_mib": round(peak_kib / 1024, 1)}), file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,7 @@ echo "[$(date -u +%FT%TZ)] synth start" >> "$LOG"
 python -m land_trendr_tpu --platform cpu synth "$D/stack" --size 5000 \
   >> "$LOG" 2>&1
 echo "[$(date -u +%FT%TZ)] segment start" >> "$LOG"
-/usr/bin/time -v python -m land_trendr_tpu --platform cpu segment "$D/stack" \
+python tools/run_segment_measured.py --platform cpu segment "$D/stack" \
   --workdir "$D/work" --out-dir "$D/out" --tile-size 512 \
   > "$D/summary.json" 2> "$D/time.txt"
 echo "[$(date -u +%FT%TZ)] segment done rc=$?" >> "$LOG"

@@ -1,0 +1,131 @@
+"""Spot-validate FTV rasters of a finished run against the float64 oracle.
+
+VERDICT r2 item #4 (config #4): a multi-index run writes NBR segmentation
+plus NDVI/TCW fitted-trajectory rasters; this tool re-derives sampled
+pixels' FTV series from first principles — input DNs → reflectance →
+index series + QA/range mask → ``oracle.fit_to_vertices`` through the
+run's own vertex rasters — and compares against what the run wrote.
+
+The run computes FTV in float32 on device; the oracle is float64, so
+agreement is expected at f32 precision (~1e-5 absolute on reflectance-
+scale indices), not bitwise.
+
+Usage:
+  python tools/validate_ftv.py STACK_DIR OUT_DIR [--indices=ndvi,tcw]
+         [--samples=64] [--out=FTV_VALIDATION.json] [--platform=cpu]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _platform_arg import pop_platform_arg  # noqa: E402
+
+jax.config.update("jax_platforms", pop_platform_arg())
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    opts = dict(
+        a[2:].split("=", 1) for a in sys.argv[1:] if a.startswith("--") and "=" in a
+    )
+    if len(args) != 2:
+        sys.exit(__doc__)
+    stack_dir, out_dir = args
+    indices = tuple(opts.get("indices", "ndvi,tcw").split(","))
+    n_samples = int(opts.get("samples", 64))
+    out_path = opts.get("out", "FTV_VALIDATION.json")
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.geotiff import read_geotiff
+    from land_trendr_tpu.models.oracle import fit_to_vertices
+    from land_trendr_tpu.ops import indices as idx
+    from land_trendr_tpu.runtime import load_stack_dir
+
+    params = LTParams()
+    stack = load_stack_dir(stack_dir)
+    h, w = stack.shape
+    ny = stack.n_years
+    years = stack.years.astype(np.float64)
+
+    vi_r, _, _ = read_geotiff(os.path.join(out_dir, "vertex_indices.tif"))
+    nv_r, _, _ = read_geotiff(os.path.join(out_dir, "n_vertices.tif"))
+    ftv_r = {}
+    for name in indices:
+        ftv_r[name], _, _ = read_geotiff(os.path.join(out_dir, f"ftv_{name}.tif"))
+        assert ftv_r[name].shape == (ny, h, w), ftv_r[name].shape
+
+    rng = np.random.default_rng(7)
+    ys = rng.integers(0, h, size=n_samples)
+    xs = rng.integers(0, w, size=n_samples)
+
+    report: dict = {
+        "stack_dir": stack_dir,
+        "out_dir": out_dir,
+        "n_samples": n_samples,
+        "indices": {},
+    }
+    ok = True
+    for name in indices:
+        need = idx.required_bands(name)
+        sign = idx.DISTURBANCE_SIGN[name]
+        deltas = []
+        for y, x in zip(ys, xs):
+            sr = {
+                b: np.asarray(
+                    idx.scale_sr(stack.dn_bands[b][:, y, x].astype(np.float64))
+                )
+                for b in need
+            }
+            # the mask the run used ANDs QA with range validity over the
+            # bands the RUN loaded (primary nbr + all ftv indices)
+            run_bands = idx.required_bands("nbr", indices)
+            sr_all = {
+                b: np.asarray(
+                    idx.scale_sr(stack.dn_bands[b][:, y, x].astype(np.float64))
+                )
+                for b in run_bands
+            }
+            mask = np.asarray(
+                idx.qa_valid_mask(stack.qa[:, y, x])
+            ) & np.asarray(idx.sr_valid_mask(sr_all))
+            # compute_index already applies the disturbance-positive flip
+            series = np.asarray(idx.compute_index(name, sr))
+            vi = vi_r[:, y, x].astype(np.int64)
+            nv = int(nv_r[y, x])
+            ref = fit_to_vertices(years, series, mask, vi, nv, params)
+            got = sign * ftv_r[name][:, y, x].astype(np.float64)
+            deltas.append(np.abs(got - ref).max())
+        deltas = np.asarray(deltas)
+        rec = {
+            "max_abs_delta": float(deltas.max()),
+            "p99_abs_delta": float(np.percentile(deltas, 99)),
+            "median_abs_delta": float(np.median(deltas)),
+            "tolerance": 1e-3,
+            "pass": bool((deltas <= 1e-3).all()),
+        }
+        ok &= rec["pass"]
+        report["indices"][name] = rec
+        print(f"ftv_{name}: max|Δ|={rec['max_abs_delta']:.2e} "
+              f"p99={rec['p99_abs_delta']:.2e} pass={rec['pass']}",
+              file=sys.stderr)
+
+    report["pass"] = ok
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
