@@ -146,9 +146,23 @@ def _read_ifd(
     """
     f.seek(0, 2)
     file_size = f.tell()
+    if not (0 <= off < file_size):
+        raise ValueError(
+            f"corrupt TIFF: IFD offset {off} outside file (size {file_size})"
+        )
     f.seek(off)
+
+    def read_exact(n: int) -> bytes:
+        buf = f.read(n)
+        if len(buf) != n:
+            raise ValueError(
+                f"corrupt TIFF: truncated at offset {f.tell()} "
+                f"(wanted {n} bytes, got {len(buf)})"
+            )
+        return buf
+
     if big:
-        (n,) = struct.unpack(bo + "Q", f.read(8))
+        (n,) = struct.unpack(bo + "Q", read_exact(8))
         # the on-disk u64 count is untrusted: a truncated/corrupt file must
         # fail parsing, not attempt an exabyte read (classic TIFF's u16
         # field caps itself; mirror that bound here)
@@ -157,11 +171,11 @@ def _read_ifd(
         esz, inline, ptr_fmt = 20, 8, "Q"
         head_fmt = bo + "HHQ"
     else:
-        (n,) = struct.unpack(bo + "H", f.read(2))
+        (n,) = struct.unpack(bo + "H", read_exact(2))
         esz, inline, ptr_fmt = 12, 4, "I"
         head_fmt = bo + "HHI"
     entries: dict[int, tuple] = {}
-    raw = f.read(n * esz)
+    raw = read_exact(n * esz)
     for k in range(n):
         tag, ftype, count = struct.unpack(head_fmt, raw[k * esz : k * esz + esz - inline])
         if ftype not in _FIELD_TYPES:
@@ -181,9 +195,14 @@ def _read_ifd(
             payload = raw[val_off : val_off + total]
         else:
             (ptr,) = struct.unpack(bo + ptr_fmt, raw[val_off : val_off + inline])
+            if ptr + total > file_size:
+                raise ValueError(
+                    f"corrupt TIFF: tag {tag} payload at {ptr} runs past "
+                    f"file size {file_size}"
+                )
             here = f.tell()
             f.seek(ptr)
-            payload = f.read(total)
+            payload = read_exact(total)
             f.seek(here)
         if ftype == 2:
             entries[tag] = (payload.rstrip(b"\0").decode("ascii", "replace"),)
@@ -350,10 +369,28 @@ def _decompress(buf: bytes, compression: int) -> bytes:
         try:
             return zlib.decompress(buf)
         except zlib.error:
-            return zlib.decompress(buf, -15)  # raw deflate stream
+            try:
+                return zlib.decompress(buf, -15)  # raw deflate stream
+            except zlib.error as e:
+                # keep the corrupt-file ValueError taxonomy — zlib.error
+                # must not escape to callers
+                raise ValueError(f"corrupt deflate block: {e}") from e
     if compression == _COMP_LZW:
         return _lzw_decode(buf)
     raise ValueError(f"unsupported TIFF compression {compression}")
+
+
+def _tag1(path: str, tags: dict[int, tuple], tag: int, default=None):
+    """First value of a tag; missing → ``default`` (or ValueError when
+    required), present-but-empty (count=0) → ValueError."""
+    vals = tags.get(tag)
+    if vals is None:
+        if default is None:
+            raise ValueError(f"{path}: corrupt TIFF IFD (missing tag {tag})")
+        return default
+    if not vals:
+        raise ValueError(f"{path}: corrupt TIFF IFD (empty tag {tag})")
+    return vals[0]
 
 
 def _unpredict(block: np.ndarray, predictor: int) -> np.ndarray:
@@ -380,6 +417,8 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
     """
     with open(path, "rb") as f:
         hdr = f.read(16)
+        if len(hdr) < 8:
+            raise ValueError(f"{path}: not a TIFF (truncated header)")
         if hdr[:2] == b"II":
             bo = "<"
         elif hdr[:2] == b"MM":
@@ -392,6 +431,8 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             (ifd_off,) = struct.unpack(bo + "I", hdr[4:8])
         elif magic == 43:
             big = True
+            if len(hdr) < 16:
+                raise ValueError(f"{path}: not a BigTIFF (truncated header)")
             offsize, pad = struct.unpack(bo + "HH", hdr[4:8])
             if offsize != 8 or pad != 0:
                 raise ValueError(
@@ -412,7 +453,7 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
                 raise ValueError(f"{path}: cyclic IFD chain at offset {off}")
             seen.add(off)
             tags, off = _read_ifd(f, bo, off, big)
-            subtype = tags.get(_T_NEW_SUBFILE_TYPE, (0,))[0]
+            subtype = _tag1(path, tags, _T_NEW_SUBFILE_TYPE, 0)
             if subtype & 0x5:  # reduced-resolution overview (1) / mask (4)
                 continue
             page_tags.append(tags)
@@ -420,11 +461,23 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             raise ValueError(f"{path}: no full-resolution pages in IFD chain")
 
         def geometry(tags):
-            w = tags[_T_IMAGE_WIDTH][0]
-            h = tags[_T_IMAGE_LENGTH][0]
-            spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
-            bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)[0]
-            fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
+            w = _tag1(path, tags, _T_IMAGE_WIDTH)
+            h = _tag1(path, tags, _T_IMAGE_LENGTH)
+            if _T_TILE_OFFSETS in tags:
+                # tiled layout needs its companion tags too
+                for req in (_T_TILE_WIDTH, _T_TILE_LENGTH, _T_TILE_BYTE_COUNTS):
+                    _tag1(path, tags, req)
+            elif _T_STRIP_OFFSETS in tags:
+                _tag1(path, tags, _T_STRIP_BYTE_COUNTS)
+            else:
+                raise ValueError(
+                    f"{path}: corrupt TIFF IFD (no strip or tile offsets)"
+                )
+            spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
+            if spp < 1:
+                raise ValueError(f"{path}: corrupt TIFF IFD (SamplesPerPixel={spp})")
+            bits = _tag1(path, tags, _T_BITS_PER_SAMPLE, 1)
+            fmt = _tag1(path, tags, _T_SAMPLE_FORMAT, 1)
             return w, h, spp, (fmt, bits)
 
         w0, h0, _, key0 = geometry(page_tags[0])
@@ -440,13 +493,25 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
             total_spp += spp
         if key0 not in _DTYPES:
             raise ValueError(f"{path}: unsupported sample format/bits {key0}")
+        # untrusted dimensions: deflate/LZW top out near ~1032:1, so a
+        # decoded size beyond file_size × 64Ki (or an absolute 1 TiB) can
+        # only come from corrupt width/height tags — fail before np.zeros
+        # attempts a garbage-driven multi-TB allocation
+        f.seek(0, 2)
+        fsize = f.tell()
+        decoded = total_spp * h0 * w0 * np.dtype(_DTYPES[key0]).itemsize
+        if decoded > min((fsize + 4096) * 65536, 2**40):
+            raise ValueError(
+                f"{path}: corrupt TIFF dimensions {total_spp}×{h0}×{w0} "
+                f"({decoded} decoded bytes from a {fsize}-byte file)"
+            )
         out = np.zeros((total_spp, h0, w0), dtype=np.dtype(_DTYPES[key0]))
 
         geo: GeoMeta | None = None
         info: TiffInfo | None = None
         band0 = 0
         for tags in page_tags:
-            spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+            spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
             g, inf = _decode_ifd(f, path, bo, big, tags, out[band0 : band0 + spp])
             band0 += spp
             if geo is None:
@@ -467,20 +532,20 @@ def _decode_ifd(
 ) -> tuple[GeoMeta, TiffInfo]:
     """Decode one IFD's raster into the preallocated ``(spp, H, W)`` view
     ``out`` (native byte order); returns the page's geo/info."""
-    width = tags[_T_IMAGE_WIDTH][0]
-    height = tags[_T_IMAGE_LENGTH][0]
-    spp = tags.get(_T_SAMPLES_PER_PIXEL, (1,))[0]
+    width = _tag1(path, tags, _T_IMAGE_WIDTH)
+    height = _tag1(path, tags, _T_IMAGE_LENGTH)
+    spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
     bits = tags.get(_T_BITS_PER_SAMPLE, (1,) * spp)
     if len(set(bits)) != 1:
         raise ValueError(f"{path}: mixed BitsPerSample {bits}")
-    fmt = tags.get(_T_SAMPLE_FORMAT, (1,) * spp)[0]
+    fmt = _tag1(path, tags, _T_SAMPLE_FORMAT, 1)
     key = (fmt, bits[0])
     if key not in _DTYPES:
         raise ValueError(f"{path}: unsupported sample format/bits {key}")
     dtype = np.dtype(bo + _DTYPES[key])
-    compression = tags.get(_T_COMPRESSION, (_COMP_NONE,))[0]
-    predictor = tags.get(_T_PREDICTOR, (1,))[0]
-    planar = tags.get(_T_PLANAR_CONFIG, (1,))[0]
+    compression = _tag1(path, tags, _T_COMPRESSION, _COMP_NONE)
+    predictor = _tag1(path, tags, _T_PREDICTOR, 1)
+    planar = _tag1(path, tags, _T_PLANAR_CONFIG, 1)
     tiled = _T_TILE_OFFSETS in tags
 
     planes = spp if planar == 2 else 1
@@ -490,18 +555,41 @@ def _decode_ifd(
             f"{path}: output view {out.shape} != page shape {(spp, height, width)}"
         )
     if tiled:
-        tw = tags[_T_TILE_WIDTH][0]
-        th = tags[_T_TILE_LENGTH][0]
+        tw = _tag1(path, tags, _T_TILE_WIDTH)
+        th = _tag1(path, tags, _T_TILE_LENGTH)
+        if tw < 1 or th < 1:
+            raise ValueError(f"{path}: corrupt tile size {th}×{tw}")
         offsets = tags[_T_TILE_OFFSETS]
         counts = tags[_T_TILE_BYTE_COUNTS]
         blk_rows, blk_w = th, tw
+        n_blocks = planes * ((width + tw - 1) // tw) * ((height + th - 1) // th)
     else:
-        rps = tags.get(_T_ROWS_PER_STRIP, (height,))[0]
+        rps = _tag1(path, tags, _T_ROWS_PER_STRIP, height)
+        if rps < 1:
+            raise ValueError(f"{path}: corrupt RowsPerStrip {rps}")
         offsets = tags[_T_STRIP_OFFSETS]
         counts = tags[_T_STRIP_BYTE_COUNTS]
         # clamp: RowsPerStrip may legally exceed height (e.g. 2^32-1 =
         # "everything in one strip"); the buffer needs only real rows
         blk_rows, blk_w = min(rps, height), width
+        n_blocks = planes * ((height + rps - 1) // rps)
+
+    # untrusted block tables: the layout dictates how many blocks the
+    # decode loops index, and every block must lie inside the file —
+    # validate once here so neither decode path can seek/read garbage
+    f.seek(0, 2)
+    fsize = f.tell()
+    if len(offsets) < n_blocks or len(counts) < n_blocks:
+        raise ValueError(
+            f"{path}: corrupt block table ({len(offsets)} offsets / "
+            f"{len(counts)} counts for {n_blocks} blocks)"
+        )
+    for o, c in zip(offsets[:n_blocks], counts[:n_blocks]):
+        if o < 0 or c < 0 or o + c > fsize:
+            raise ValueError(
+                f"{path}: corrupt block table entry ({o}+{c} vs file "
+                f"size {fsize})"
+            )
 
     # Native fast path: fused inflate+unpredict across all blocks at
     # once, threaded in C++ (native/lt_native.cc).  Any failure — or an

@@ -12,14 +12,22 @@ each host's addressable shards.  DCN carries only coordination and each
 host's input reads; ICI carries nothing but the optional metrics ``psum``
 (SURVEY.md §5 "Distributed communication backend").
 
-The v5e-256 scale-out config (BASELINE configs[5]) maps to:
+The v5e-256 scale-out config (BASELINE configs[5]) maps to two layers:
 
-* one process per host, ``init_distributed`` before any device use;
-* a 1-D global mesh over all chips in the pod (``make_mesh()`` — device
-  order follows ``jax.devices()``, so each host's addressable chips own a
-  contiguous block of the pixel axis);
-* the driver calls :func:`host_share` to learn which tiles it feeds, then
-  :func:`feed_global` to assemble the global batch from its local rows.
+* **row-sharded batches** (this module's ``feed_global`` /
+  ``gather_local_rows``): one global mesh over all chips, each host placing
+  its contiguous rows — the right shape when one batch spans the pod;
+* **the production tile driver** (:func:`land_trendr_tpu.runtime.
+  run_stack` with ``mesh=make_mesh(jax.local_devices())``): tiles are the
+  cross-host unit — each process takes its :func:`host_share` of the tile
+  list and shards each tile's pixels over its OWN chips only, with the
+  shared-filesystem manifest as the global job state (the reference's
+  HDFS-backed bookkeeping).  No device-side cross-host traffic exists at
+  all in this mode; ``tests/test_multihost.py``'s two-process driver test
+  runs exactly this flow.
+
+Common to both: one process per host and ``init_distributed`` before any
+device use.
 
 Everything here degrades to single-process: ``init_distributed`` is a
 no-op without a coordinator, and ``feed_global`` on one process is just

@@ -105,8 +105,12 @@ def test_we_read_pillow_files(tmp_path, rng, mode_dtype):
 def test_reject_garbage_header(tmp_path):
     p = str(tmp_path / "bad.tif")
     with open(p, "wb") as f:
-        f.write(b"XX\x00\x00")
+        f.write(b"XX\x00\x00\x00\x00\x00\x00")  # 8 bytes, wrong magic
     with pytest.raises(ValueError, match="byte-order"):
+        read_geotiff(p)
+    with open(p, "wb") as f:
+        f.write(b"II")  # shorter than any TIFF header
+    with pytest.raises(ValueError, match="truncated"):
         read_geotiff(p)
 
 
