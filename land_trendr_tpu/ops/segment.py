@@ -52,6 +52,23 @@ Shape/naming conventions: ``NY`` = years (static), ``NC`` =
 ``max_segments + 1 + vertex_count_overshoot`` candidate-vertex capacity,
 ``NV`` = ``max_segments + 1`` final vertex capacity, ``NM`` =
 ``max_segments`` model-family slots.
+
+**Why no hand-written Pallas kernels (a reasoned decision, not an
+omission).**  SURVEY.md §3 classifies a Pallas inner-loop kernel as "a
+performance choice, not a parity obligation", and the measured profile
+(PROFILE_r03.json) says the choice is currently against: the kernel is
+NOT a large-matmul workload (nothing maps to the MXU — the biggest
+contraction is a (NC−1, NY)≈(9, 40) masked OLS), so a Pallas rewrite
+could only win by (a) pinning the (px_block, NY) series in VMEM across
+all four stages and (b) hand-laying series on the lane axis.  (a) is
+already what XLA does here — the whole pipeline is one fused jit program
+whose intermediates are loop carries, and the driver's chunked/sharded
+paths bound the working set; (b) would fight the gather-heavy stages
+(despike neighbours, vertex gathers), which Mosaic handles no better
+than XLA today.  The stage-level named_scopes keep the door open: if a
+TPU profile ever shows one stage dominated by layout/fusion overheads
+rather than math, that stage is the Pallas candidate, and the f64 oracle
+parity suite defines exactly what any such kernel must reproduce.
 """
 
 from __future__ import annotations
