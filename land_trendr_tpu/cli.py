@@ -91,6 +91,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="discard any existing workdir manifest")
     seg.add_argument("--write-fitted", action="store_true",
                      help="also write the full fitted-trajectory raster")
+    seg.add_argument("--out-compress", default="deflate",
+                     choices=("deflate", "lzw", "none"),
+                     help="output raster compression")
     seg.add_argument("--max-retries", type=int, default=2)
     seg.add_argument(
         "--mesh",
@@ -281,6 +284,7 @@ def main(argv: list[str] | None = None) -> int:
             write_fitted=args.write_fitted,
             scale=args.scale,
             offset=args.offset,
+            out_compress=args.out_compress,
         )
         mesh = None
         if args.mesh:

@@ -67,6 +67,18 @@ class RunConfig:
     scale: float = 2.75e-5
     offset: float = -0.2
     reject_bits: int = idx.DEFAULT_QA_REJECT
+    #: output raster compression: "deflate" (default), "lzw" (what most
+    #: GDAL-era pipelines emit), or "none"
+    out_compress: str = "deflate"
+
+    def __post_init__(self) -> None:
+        # fail fast: an invalid choice must not surface only at
+        # assemble_outputs, after the whole run's compute
+        if self.out_compress not in ("deflate", "lzw", "none"):
+            raise ValueError(
+                f"out_compress={self.out_compress!r} not one of "
+                "'deflate', 'lzw', 'none'"
+            )
     #: transient-HBM bound for large tiles: tiles with more pixels than this
     #: run the segmentation through the chunked kernel (the kernel's working
     #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
@@ -493,6 +505,6 @@ def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:
         elif mosaic.dtype == np.float64:
             mosaic = mosaic.astype(np.float32)
         path = os.path.join(cfg.out_dir, f"{name}.tif")
-        write_geotiff(path, mosaic, geo=stack.geo)
+        write_geotiff(path, mosaic, geo=stack.geo, compress=cfg.out_compress)
         paths[name] = path
     return paths

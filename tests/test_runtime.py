@@ -402,3 +402,14 @@ def test_mesh_resume_context_rejected(tmp_path, rstack):
     with pytest.raises(ValueError, match="execution context"):
         run_stack(rstack, cfg)  # same cfg, no mesh
     assemble_outputs(rstack, cfg)  # context-free consumer: OK
+
+
+def test_output_compression_choice(tmp_path, rstack):
+    """assemble_outputs honors RunConfig.out_compress (GDAL-era pipelines
+    commonly emit LZW); rasters decode identically either way."""
+    cfg = make_cfg(tmp_path, out_compress="lzw")
+    run_stack(rstack, cfg)
+    paths = assemble_outputs(rstack, cfg)
+    rmse, _, info = read_geotiff(paths["rmse"])
+    assert info.compression == 5  # LZW on disk
+    assert rmse.shape == (40, 48)
