@@ -615,9 +615,11 @@ def segment_pixel(
         fitted, sse = _fit_model(t, y, mask, vm, y_range, params)
         del fitted  # only the chosen model's trajectory is needed — it is
         # recomputed after selection, so the scan stacks NY bools + 2
-        # scalars per model instead of an NY-float trajectory (≈5× less
-        # stacked HBM; _fit_model is deterministic, so the recomputation
-        # is exact)
+        # scalars per model instead of an NY-float trajectory.  The
+        # alternative (stack all NM trajectories, select after scoring)
+        # was MEASURED 16% slower end-to-end on CPU (scan-stack write
+        # traffic outweighs one extra _fit_model); _fit_model is
+        # deterministic, so the recomputation is exact.
         m = jnp.sum(vm) - 1  # segments in this model
         if exact_mode:
             p = _f_stat_p(ss0, sse, n_valid.astype(dtype), m.astype(dtype))
