@@ -149,6 +149,13 @@ def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
             shape, geo = img.shape[1:], g
         elif img.shape[1:] != shape:
             raise ValueError(f"{fp}: raster size {img.shape[1:]} != {shape}")
+        if img.dtype.kind == "f":
+            raise ValueError(
+                f"{fp}: float bands — the stack loaders take Collection-2 "
+                "scaled integer DNs (int16/uint16), not reflectance floats; "
+                "an implicit cast would zero the data.  Re-export as DNs "
+                "(reflectance = DN * 2.75e-5 - 0.2)"
+            )
         for i, b in enumerate(BANDS):
             band_img = img[i]
             if band_img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):

@@ -413,3 +413,16 @@ def test_output_compression_choice(tmp_path, rstack):
     rmse, _, info = read_geotiff(paths["rmse"])
     assert info.compression == 5  # LZW on disk
     assert rmse.shape == (40, 48)
+
+
+def test_float_stack_rejected_loudly(tmp_path):
+    """A float-reflectance pre-stacked file must error, not silently cast
+    reflectance [-0.2, 1] to int16 zeros."""
+    from land_trendr_tpu.io.geotiff import write_geotiff
+
+    d = str(tmp_path / "float_stack")
+    os.makedirs(d)
+    arr = np.random.default_rng(0).uniform(0, 1, (7, 8, 8)).astype(np.float32)
+    write_geotiff(os.path.join(d, "LT_2001.tif"), arr)
+    with pytest.raises(ValueError, match="integer DNs"):
+        load_stack_dir(d)
