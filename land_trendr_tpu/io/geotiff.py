@@ -978,11 +978,10 @@ def _encode_all(block_iter, comp_id: int, use_pred: bool) -> list[bytes]:
     the whole raster.  Equal-shape runs batch together (always true for the
     tiled layout; the strip layout's short last strip flushes a chunk).
     Both paths produce byte-identical output: same zlib level, same
-    predictor arithmetic — the native path is acceleration only.  The
-    native library encodes deflate only; LZW writes go per-block through
-    :func:`_lzw_encode`.
+    predictor arithmetic, same LZW code stream — the native path is
+    acceleration only.
     """
-    if not (native.available() and comp_id == _COMP_DEFLATE_ADOBE):
+    if not (native.available() and comp_id in (_COMP_DEFLATE_ADOBE, _COMP_LZW)):
         return [_encode_block(b, comp_id, use_pred) for b in block_iter]
 
     out: list[bytes] = []
@@ -999,6 +998,7 @@ def _encode_all(block_iter, comp_id: int, use_pred: bool) -> list[bytes]:
                     native.encode_blocks(
                         np.stack(chunk),  # fresh stack → safe to mutate
                         predictor=2 if use_pred else 1,
+                        compression=comp_id,
                         in_place=True,
                     )
                 )

@@ -39,7 +39,7 @@ _ERR_NAMES = {
     -4: "block data out of file bounds / short",
     -5: "corrupt LZW stream",
 }
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 
 class NativeCodecError(RuntimeError):
@@ -89,7 +89,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.lt_encode_blocks.restype = ctypes.c_int
     lib.lt_encode_blocks.argtypes = [
-        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
         ctypes.c_uint64, u64p, ctypes.c_int, ctypes.c_int,
     ]
@@ -171,13 +171,16 @@ def encode_blocks(
     blocks: np.ndarray,
     *,
     predictor: int,
+    compression: int = 8,
     level: int = 6,
     n_threads: int = 0,
     in_place: bool = False,
 ) -> list[bytes]:
-    """Deflate-encode ``(n_blocks, rows, width, spp)`` blocks → bytes list.
+    """Encode ``(n_blocks, rows, width, spp)`` blocks → bytes list.
 
-    Applies TIFF predictor 2 first when ``predictor == 2`` — the native
+    ``compression`` is the TIFF tag value: 8 (deflate, default) or 5 (LZW
+    — byte-identical to the Python ``_lzw_encode`` reference).  Applies
+    TIFF predictor 2 first when ``predictor == 2`` — the native
     differencing mutates its input buffer, so the input is copied unless
     ``in_place=True`` (pass it when the stack is a throwaway, as the
     GeoTIFF writer does).  Without the predictor the input is never
@@ -187,15 +190,20 @@ def encode_blocks(
     blocks = np.ascontiguousarray(blocks)
     if predictor == 2 and blocks.dtype.kind not in "iu":
         raise NativeCodecError("predictor 2 requires an integer dtype")
+    if compression not in (8, 5):
+        raise NativeCodecError(f"unsupported encode compression {compression}")
     n, rows, width, spp = blocks.shape
     block_bytes = rows * width * spp * blocks.dtype.itemsize
-    bound = int(_LIB.lt_deflate_bound(ctypes.c_uint64(block_bytes)))
+    if compression == 8:
+        bound = int(_LIB.lt_deflate_bound(ctypes.c_uint64(block_bytes)))
+    else:
+        bound = 2 * block_bytes + 64  # 12-bit codes for 8-bit symbols
     scratch = blocks if (in_place or predictor != 2) else blocks.copy()
     scratch = scratch.view(np.uint8).reshape(-1)
     out = np.empty(n * bound, dtype=np.uint8)
     sizes = np.zeros(n, dtype=np.uint64)
     rc = _LIB.lt_encode_blocks(
-        _u8(scratch), n, predictor, rows, width, spp,
+        _u8(scratch), n, compression, predictor, rows, width, spp,
         blocks.dtype.itemsize, _u8(out), ctypes.c_uint64(bound),
         _u64(sizes), level, n_threads,
     )

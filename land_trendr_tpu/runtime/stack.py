@@ -149,18 +149,17 @@ def load_stack_dir(path: str, pattern: str = r"\.tif$") -> RasterStack:
             shape, geo = img.shape[1:], g
         elif img.shape[1:] != shape:
             raise ValueError(f"{fp}: raster size {img.shape[1:]} != {shape}")
-        if img.dtype.kind == "f":
+        if img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
+            # whitelist, not best-effort casting: float reflectance would
+            # zero out, and wider integers (int32 DN exports) would wrap
+            # bright pixels negative — both silently
             raise ValueError(
-                f"{fp}: float bands — the stack loaders take Collection-2 "
-                "scaled integer DNs (int16/uint16), not reflectance floats; "
-                "an implicit cast would zero the data.  Re-export as DNs "
-                "(reflectance = DN * 2.75e-5 - 0.2)"
+                f"{fp}: dtype {img.dtype} — the stack loaders take "
+                "Collection-2 scaled 16-bit DNs (int16/uint16); re-export "
+                "as DNs (reflectance = DN * 2.75e-5 - 0.2)"
             )
         for i, b in enumerate(BANDS):
-            band_img = img[i]
-            if band_img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
-                band_img = band_img.astype(np.int16, copy=False)
-            dn_bands[b].append(band_img)  # keep 16-bit dtypes as stored
+            dn_bands[b].append(img[i])  # keep the 16-bit dtype as stored
         qa_list.append(img[len(BANDS)].astype(np.uint16, copy=False))
 
     return RasterStack(

@@ -21,6 +21,7 @@
 #include <cstdint>
 #include <cstring>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include <zlib.h>
@@ -174,6 +175,75 @@ int lzw_decode(const uint8_t* src, size_t src_len, uint8_t* dst,
   return out >= dst_len ? kOk : kErrShortData;
 }
 
+// TIFF 6.0 LZW encoder — mirrors geotiff._lzw_encode decision for decision
+// (greedy longest-match, early-change width bumps at (1<<bits) on the
+// encoder side, the terminal-code bump before EOI, Clear+reset at 4094),
+// so outputs are byte-identical to the Python reference (tests assert it).
+// The dictionary is (prefix_code<<8 | byte) → code in a hash map — one
+// probe per input byte, O(n) overall.
+int lzw_encode(const uint8_t* src, size_t n, uint8_t* dst, size_t cap,
+               uint64_t* out_len) {
+  constexpr int kClear = 256, kEoi = 257;
+  uint32_t buf = 0;
+  int nbits = 0;
+  int code_bits = 9;
+  size_t out = 0;
+  auto emit = [&](int code) -> bool {
+    buf = (buf << code_bits) | static_cast<uint32_t>(code);
+    nbits += code_bits;
+    while (nbits >= 8) {
+      nbits -= 8;
+      if (out >= cap) return false;
+      dst[out++] = static_cast<uint8_t>((buf >> nbits) & 0xFF);
+    }
+    buf &= (1u << nbits) - 1;
+    return true;
+  };
+  std::unordered_map<uint32_t, int> table;
+  table.reserve(4096);
+  int next_code = 258;
+  if (!emit(kClear)) return kErrLzw;
+  int prev = -1;
+  for (size_t i = 0; i < n; ++i) {
+    const int b = src[i];
+    if (prev < 0) {
+      prev = b;
+      continue;
+    }
+    const uint32_t key = (static_cast<uint32_t>(prev) << 8) | b;
+    auto it = table.find(key);
+    if (it != table.end()) {
+      prev = it->second;
+      continue;
+    }
+    if (!emit(prev)) return kErrLzw;
+    table.emplace(key, next_code);
+    ++next_code;
+    prev = b;
+    if (next_code == (1 << code_bits) && code_bits < 12) {
+      ++code_bits;  // decoder lags one add; it bumps at (1<<bits)-1
+    } else if (next_code >= 4094) {
+      if (!emit(kClear)) return kErrLzw;
+      table.clear();
+      next_code = 258;
+      code_bits = 9;
+    }
+  }
+  if (prev >= 0) {
+    if (!emit(prev)) return kErrLzw;
+    // the decoder's add for this final code can trigger its bump — EOI
+    // must be written at the width it will be read with
+    if (next_code == (1 << code_bits) - 1 && code_bits < 12) ++code_bits;
+  }
+  if (!emit(kEoi)) return kErrLzw;
+  if (nbits) {
+    if (out >= cap) return kErrLzw;
+    dst[out++] = static_cast<uint8_t>((buf << (8 - nbits)) & 0xFF);
+  }
+  *out_len = out;
+  return kOk;
+}
+
 // Undo TIFF predictor 2 (horizontal differencing): within each row, each
 // pixel's sample accumulates the previous pixel's same sample.  Arithmetic
 // is modular in the sample width — unsigned of matching width reproduces
@@ -255,9 +325,10 @@ int run_blocks(int n_blocks, int n_threads, Fn&& per_block) {
 
 extern "C" {
 
-// ABI version — bump on any signature or behaviour-surface change (v3 adds
-// LZW decode support); the ctypes binding checks it.
-int lt_native_abi_version() { return 3; }
+// ABI version — bump on any signature or behaviour-surface change (v3 added
+// LZW decode; v4 adds a compression arg to lt_encode_blocks for LZW
+// encode); the ctypes binding checks it.
+int lt_native_abi_version() { return 4; }
 
 // Decode n_blocks TIFF blocks from a memory-mapped/loaded file image.
 //
@@ -317,30 +388,44 @@ int lt_decode_blocks(const uint8_t* file_data, uint64_t file_len,
   });
 }
 
-// Encode n_blocks equal-geometry blocks with optional predictor + deflate.
+// Encode n_blocks equal-geometry blocks with optional predictor + deflate
+// or LZW.
 //
 //   blocks       n_blocks contiguous input blocks (modified in place when
 //                predictor=2 — pass a scratch copy)
+//   compression  8 (deflate) or 5 (LZW)
 //   out          caller-allocated, n_blocks * bound bytes
-//   bound        per-block output capacity (>= lt_deflate_bound(block_bytes))
+//   bound        per-block output capacity (deflate:
+//                >= lt_deflate_bound(block_bytes); LZW: >= 2*block_bytes+64
+//                — 12-bit codes for 8-bit symbols is the worst case)
 //   out_sizes    per-block compressed byte counts (written)
-//   level        zlib level (6 matches the Python writer)
-int lt_encode_blocks(uint8_t* blocks, int n_blocks, int predictor, int rows,
-                     int width, int spp, int elem_size, uint8_t* out,
-                     uint64_t bound, uint64_t* out_sizes, int level,
-                     int n_threads) {
+//   level        zlib level (6 matches the Python writer; ignored for LZW)
+int lt_encode_blocks(uint8_t* blocks, int n_blocks, int compression,
+                     int predictor, int rows, int width, int spp,
+                     int elem_size, uint8_t* out, uint64_t bound,
+                     uint64_t* out_sizes, int level, int n_threads) {
   if (n_blocks < 0 || rows <= 0 || width <= 0 || spp <= 0) return kErrBadArg;
   if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
     return kErrBadArg;
   if (predictor == 2 && elem_size == 8) return kErrBadArg;
+  if (compression != kCompDeflateAdobe && compression != kCompLzw)
+    return kErrBadArg;
   const size_t block_bytes =
       static_cast<size_t>(rows) * width * spp * elem_size;
-  if (bound < compressBound(static_cast<uLong>(block_bytes))) return kErrBadArg;
+  if (compression == kCompDeflateAdobe) {
+    if (bound < compressBound(static_cast<uLong>(block_bytes)))
+      return kErrBadArg;
+  } else {
+    if (bound < 2 * block_bytes + 64) return kErrBadArg;
+  }
 
   return run_blocks(n_blocks, n_threads, [&](int i) -> int {
     uint8_t* src = blocks + static_cast<size_t>(i) * block_bytes;
     if (predictor == 2)
       apply_predictor(src, rows, width, spp, elem_size, /*undo=*/false);
+    if (compression == kCompLzw)
+      return lzw_encode(src, block_bytes, out + static_cast<size_t>(i) * bound,
+                        bound, &out_sizes[i]);
     uLongf dst_len = static_cast<uLongf>(bound);
     int rc = compress2(out + static_cast<size_t>(i) * bound, &dst_len, src,
                        static_cast<uLong>(block_bytes), level);

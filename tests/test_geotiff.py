@@ -662,3 +662,27 @@ def test_lzw_encode_terminal_boundary_and_speed():
     dt = time.perf_counter() - t0
     assert dt < 5.0, f"encode of 256 KiB took {dt:.1f}s — quadratic regression"
     assert len(enc) > 0
+
+
+def test_lzw_writer_native_and_python_identical_files(tmp_path, rng):
+    """The native LZW encode path and the pure-Python reference produce
+    byte-identical files (the codec's acceleration-only contract)."""
+    from land_trendr_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    arr = _rand(rng, "u2", (3, 90, 77))
+    p_nat = str(tmp_path / "nat.tif")
+    p_py = str(tmp_path / "py.tif")
+    write_geotiff(p_nat, arr, compress="lzw")
+    saved = native._LIB
+    try:
+        native._LIB = None
+        write_geotiff(p_py, arr, compress="lzw")
+    finally:
+        native._LIB = saved
+    with open(p_nat, "rb") as a, open(p_py, "rb") as b:
+        assert a.read() == b.read()
+    got, _, info = read_geotiff(p_nat)
+    assert info.compression == 5
+    np.testing.assert_array_equal(got, arr)

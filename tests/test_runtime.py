@@ -424,5 +424,18 @@ def test_float_stack_rejected_loudly(tmp_path):
     os.makedirs(d)
     arr = np.random.default_rng(0).uniform(0, 1, (7, 8, 8)).astype(np.float32)
     write_geotiff(os.path.join(d, "LT_2001.tif"), arr)
-    with pytest.raises(ValueError, match="integer DNs"):
+    with pytest.raises(ValueError, match="16-bit DNs"):
+        load_stack_dir(d)
+
+
+def test_int32_stack_rejected_loudly(tmp_path):
+    """Wide-integer DN exports (int32) must error, not wrap DN 43000 to
+    -22536 via a silent int16 cast (code-review r3)."""
+    from land_trendr_tpu.io.geotiff import write_geotiff
+
+    d = str(tmp_path / "i32_stack")
+    os.makedirs(d)
+    arr = np.full((7, 8, 8), 43000, dtype=np.int32)
+    write_geotiff(os.path.join(d, "LT_2001.tif"), arr)
+    with pytest.raises(ValueError, match="16-bit DNs"):
         load_stack_dir(d)
