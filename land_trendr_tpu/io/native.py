@@ -29,6 +29,7 @@ __all__ = [
     "lib_path",
     "decode_blocks",
     "encode_blocks",
+    "gather_tile",
     "NativeCodecError",
 ]
 
@@ -39,7 +40,7 @@ _ERR_NAMES = {
     -4: "block data out of file bounds / short",
     -5: "corrupt LZW stream",
 }
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 
 class NativeCodecError(RuntimeError):
@@ -95,6 +96,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.lt_deflate_bound.restype = ctypes.c_uint64
     lib.lt_deflate_bound.argtypes = [ctypes.c_uint64]
+    lib.lt_gather_tile.restype = ctypes.c_int
+    lib.lt_gather_tile.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
+        ctypes.c_int,
+    ]
 
 
 _LIB, _LIB_PATH = _load()
@@ -212,3 +219,34 @@ def encode_blocks(
     return [
         out[i * bound : i * bound + int(sizes[i])].tobytes() for i in range(n)
     ]
+
+
+def gather_tile(
+    cube: np.ndarray,
+    y0: int,
+    x0: int,
+    h: int,
+    w: int,
+    *,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """Window a ``(NY, H, W)`` cube into the ``(h*w, NY)`` device-feed
+    layout — the host feed path's transpose, threaded (SURVEY.md §7
+    hard-part 4).  Identical to
+    ``np.ascontiguousarray(cube[:, y0:y0+h, x0:x0+w].reshape(NY, h*w).T)``.
+    """
+    assert _LIB is not None
+    if not cube.flags["C_CONTIGUOUS"] or cube.dtype.byteorder not in "=|<":
+        # copying the whole cube to gather one window would be slower than
+        # the NumPy fallback this accelerates — make the caller decide
+        raise NativeCodecError("gather_tile needs a C-contiguous native-endian cube")
+    ny, height, width = cube.shape
+    out = np.empty((h * w, ny), dtype=cube.dtype)
+    rc = _LIB.lt_gather_tile(
+        _u8(cube.view(np.uint8).reshape(-1)), ny, height, width,
+        y0, x0, h, w, cube.dtype.itemsize,
+        _u8(out.view(np.uint8).reshape(-1)), n_threads,
+    )
+    if rc != 0:
+        raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))
+    return out

@@ -39,6 +39,7 @@ import jax
 import numpy as np
 
 from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io import native
 from land_trendr_tpu.io.geotiff import write_geotiff
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.tile import process_tile_dn
@@ -49,6 +50,9 @@ from land_trendr_tpu.utils.profiling import StageTimer
 __all__ = ["RunConfig", "TileSpec", "plan_tiles", "run_stack", "assemble_outputs"]
 
 log = logging.getLogger("land_trendr_tpu.runtime")
+
+#: one-time warning latch for the native feed-gather fallback
+_warned_gather_fallback = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +74,11 @@ class RunConfig:
     #: output raster compression: "deflate" (default), "lzw" (what most
     #: GDAL-era pipelines emit), or "none"
     out_compress: str = "deflate"
+    #: transient-HBM bound for large tiles: tiles with more pixels than this
+    #: run the segmentation through the chunked kernel (the kernel's working
+    #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
+    #: what a 256² tile needs by 16×).  ``None`` disables chunking.
+    chunk_px: int | None = 262_144
 
     def __post_init__(self) -> None:
         # fail fast: an invalid choice must not surface only at
@@ -79,11 +88,6 @@ class RunConfig:
                 f"out_compress={self.out_compress!r} not one of "
                 "'deflate', 'lzw', 'none'"
             )
-    #: transient-HBM bound for large tiles: tiles with more pixels than this
-    #: run the segmentation through the chunked kernel (the kernel's working
-    #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
-    #: what a 256² tile needs by 16×).  ``None`` disables chunking.
-    chunk_px: int | None = 262_144
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -157,6 +161,20 @@ def _feed_tile(
     px = t.h * t.w
 
     def cut(a: np.ndarray) -> np.ndarray:
+        # the feed path's hot transpose (SURVEY.md §7 hard-part 4): the
+        # threaded native gather sustains ~2.3 GB/s/core vs NumPy's ~1;
+        # both produce identical arrays
+        if native.available():
+            try:
+                return native.gather_tile(a, t.y0, t.x0, t.h, t.w)
+            except native.NativeCodecError as e:
+                global _warned_gather_fallback
+                if not _warned_gather_fallback:
+                    _warned_gather_fallback = True
+                    log.warning(
+                        "native gather_tile unavailable (%s); feeding via "
+                        "the slower NumPy path for this run", e,
+                    )
         win = a[:, t.y0 : t.y0 + t.h, t.x0 : t.x0 + t.w]
         return np.ascontiguousarray(win.reshape(ny, px).T)
 

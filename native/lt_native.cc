@@ -288,6 +288,24 @@ void apply_predictor(uint8_t* data, int rows, int width, int spp,
   }
 }
 
+// see lt_gather_tile: one thread's row block of the feed-layout transpose
+template <typename T>
+void gather_rows(const uint8_t* src, int ny, int height, int width, int y0,
+                 int x0, int w, uint8_t* dst, int y_begin, int y_end) {
+  const T* s = reinterpret_cast<const T*>(src);
+  T* d = reinterpret_cast<T*>(dst);
+  const size_t plane = static_cast<size_t>(height) * width;
+  for (int y = y_begin; y < y_end; ++y) {
+    const size_t row_base = static_cast<size_t>(y0 + y) * width + x0;
+    T* drow = d + static_cast<size_t>(y) * w * ny;
+    for (int x = 0; x < w; ++x) {
+      const T* col = s + row_base + x;
+      T* dpx = drow + static_cast<size_t>(x) * ny;
+      for (int n = 0; n < ny; ++n) dpx[n] = col[static_cast<size_t>(n) * plane];
+    }
+  }
+}
+
 int pick_threads(int n_blocks, int n_threads) {
   if (n_threads <= 0) {
     unsigned hc = std::thread::hardware_concurrency();
@@ -326,9 +344,40 @@ int run_blocks(int n_blocks, int n_threads, Fn&& per_block) {
 extern "C" {
 
 // ABI version — bump on any signature or behaviour-surface change (v3 added
-// LZW decode; v4 adds a compression arg to lt_encode_blocks for LZW
-// encode); the ctypes binding checks it.
-int lt_native_abi_version() { return 4; }
+// LZW decode; v4 added a compression arg to lt_encode_blocks for LZW
+// encode; v5 adds lt_gather_tile); the ctypes binding checks it.
+int lt_native_abi_version() { return 5; }
+
+// Gather one tile window into device-feed layout: a (NY, H, W) cube's
+// window (y0, x0, h, w) becomes the (h*w, NY) array the kernel wants —
+// the host feed path's hot transpose (SURVEY.md §7 hard-part 4:
+// ~2.4 GB/s/chip at the 10M px/s target; NumPy's strided-transpose copy
+// measures ~1 GB/s/core).  Threaded over output row blocks: writes are
+// fully sequential, reads are NY interleaved sequential streams the
+// prefetcher handles well.
+int lt_gather_tile(const uint8_t* src, int ny, int height, int width, int y0,
+                   int x0, int h, int w, int elem_size, uint8_t* dst,
+                   int n_threads) {
+  if (ny <= 0 || height <= 0 || width <= 0 || h <= 0 || w <= 0)
+    return kErrBadArg;
+  if (y0 < 0 || x0 < 0 || y0 + h > height || x0 + w > width) return kErrBadArg;
+  if (elem_size != 1 && elem_size != 2 && elem_size != 4 && elem_size != 8)
+    return kErrBadArg;
+  // split rows into blocks, one work item each
+  const int block = 16;
+  const int n_blocks = (h + block - 1) / block;
+  return run_blocks(n_blocks, n_threads, [&](int i) -> int {
+    const int yb = i * block;
+    const int ye = yb + block < h ? yb + block : h;
+    switch (elem_size) {
+      case 1: gather_rows<uint8_t>(src, ny, height, width, y0, x0, w, dst, yb, ye); break;
+      case 2: gather_rows<uint16_t>(src, ny, height, width, y0, x0, w, dst, yb, ye); break;
+      case 4: gather_rows<uint32_t>(src, ny, height, width, y0, x0, w, dst, yb, ye); break;
+      default: gather_rows<uint64_t>(src, ny, height, width, y0, x0, w, dst, yb, ye); break;
+    }
+    return kOk;
+  });
+}
 
 // Decode n_blocks TIFF blocks from a memory-mapped/loaded file image.
 //

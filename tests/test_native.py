@@ -205,3 +205,33 @@ def test_short_last_strip_deflate_roundtrip(tmp_path, rng):
     back, _, info = gt.read_geotiff(path)
     assert not info.tiled
     np.testing.assert_array_equal(back, arr)
+
+
+def test_gather_tile_matches_numpy(rng):
+    """The threaded feed-path gather equals the NumPy slice+transpose on
+    interior, edge, and single-row windows, all dtypes."""
+    from land_trendr_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    for dtype in (np.uint16, np.int16, np.uint8, np.float32):
+        if np.dtype(dtype).kind == "f":
+            cube = rng.normal(size=(11, 60, 70)).astype(dtype)
+        else:
+            cube = rng.integers(0, 200, size=(11, 60, 70)).astype(dtype)
+        for (y0, x0, h, w) in ((0, 0, 32, 32), (28, 38, 32, 32), (5, 7, 13, 29), (59, 0, 1, 70)):
+            ref = np.ascontiguousarray(
+                cube[:, y0 : y0 + h, x0 : x0 + w].reshape(11, h * w).T
+            )
+            got = native.gather_tile(cube, y0, x0, h, w)
+            np.testing.assert_array_equal(got, ref, err_msg=str((dtype, y0, x0)))
+
+
+def test_gather_tile_rejects_out_of_bounds(rng):
+    from land_trendr_tpu.io import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    cube = np.zeros((4, 16, 16), np.int16)
+    with pytest.raises(native.NativeCodecError):
+        native.gather_tile(cube, 8, 8, 16, 16)  # window past the edge
