@@ -195,11 +195,25 @@ def _child_main() -> int:
         raise RuntimeError(f"benchmark failed at px={px}") from last_err
 
     value = px / best
-    print(_result_line(ny, value), flush=True)
+    chunk = int(os.environ.get("LT_BENCH_CHUNK", 262144))
+    print(
+        _result_line(
+            ny,
+            value,
+            extra={
+                "px": px,
+                "platform": os.environ.get("LT_BENCH_PLATFORM") or "default",
+                "chunked": px > chunk,
+            },
+        ),
+        flush=True,
+    )
     return 0
 
 
-def _result_line(ny: int, value: float, error: str | None = None) -> str:
+def _result_line(
+    ny: int, value: float, error: str | None = None, extra: dict | None = None
+) -> str:
     """The ONE output line — shared by success and diagnostic paths so the
     metric name / schema can never desynchronize between them."""
     rec = {
@@ -208,6 +222,8 @@ def _result_line(ny: int, value: float, error: str | None = None) -> str:
         "unit": "pixels/sec/chip",
         "vs_baseline": round(value / 10e6, 4),
     }
+    if extra:
+        rec.update(extra)
     if error is not None:
         rec["error"] = error[-2000:]
     return json.dumps(rec)
