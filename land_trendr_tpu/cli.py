@@ -94,6 +94,10 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--out-compress", default="deflate",
                      choices=("deflate", "lzw", "none"),
                      help="output raster compression")
+    seg.add_argument("--trace", default=None, metavar="LOGDIR",
+                     help="capture a jax.profiler device+host trace of the "
+                     "run under LOGDIR (open with TensorBoard's profile "
+                     "plugin, or feed to tools/profile_stages.py)")
     seg.add_argument("--max-retries", type=int, default=2)
     seg.add_argument(
         "--mesh",
@@ -296,7 +300,13 @@ def main(argv: list[str] | None = None) -> int:
             # rejects non-addressable meshes)
             mesh = make_mesh(jax.local_devices())
         stack = load_stack_dir(args.stack_dir)
-        summary = run_stack(stack, cfg, mesh=mesh)
+        if args.trace:
+            from land_trendr_tpu.utils.profiling import trace
+
+            with trace(args.trace):
+                summary = run_stack(stack, cfg, mesh=mesh)
+        else:
+            summary = run_stack(stack, cfg, mesh=mesh)
         paths = assemble_outputs(stack, cfg)
         print(json.dumps({"summary": summary, "outputs": paths}, indent=2))
         return 0

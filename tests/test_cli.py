@@ -119,3 +119,28 @@ def test_pixel_command_stdin_nofit(monkeypatch, capsys):
     out = _json.loads(capsys.readouterr().out)
     assert out["oracle"]["model_valid"] is False
     assert out["oracle"]["n_vertices"] == 0
+
+
+def test_segment_trace_flag(tmp_path):
+    """--trace captures a profiler trace of the run (xplane.pb on disk)."""
+    import glob
+    import subprocess
+    import sys
+
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+
+    d = str(tmp_path / "stack")
+    write_stack(d, make_stack(SceneSpec(width=16, height=16, year_start=2000, year_end=2012)))
+    logdir = str(tmp_path / "trace")
+    r = subprocess.run(
+        [sys.executable, "-m", "land_trendr_tpu", "--platform", "cpu",
+         "segment", d, "--out-dir", str(tmp_path / "out"),
+         "--workdir", str(tmp_path / "work"), "--tile-size", "16",
+         "--trace", logdir],
+        capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep))),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
