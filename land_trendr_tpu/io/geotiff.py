@@ -574,9 +574,13 @@ def _decode_ifd(
         blk_rows, blk_w = min(rps, height), width
         n_blocks = planes * ((height + rps - 1) // rps)
 
-    # untrusted block tables: the layout dictates how many blocks the
-    # decode loops index, and every block must lie inside the file —
-    # validate once here so neither decode path can seek/read garbage
+    # untrusted block tables AND block geometry: the layout dictates how
+    # many blocks the decode loops index, every block must lie inside the
+    # file, and the block SLOT allocation (n_blocks × blk_rows × blk_w —
+    # which corrupt TileWidth/TileLength tags can inflate far beyond the
+    # image size) must pass the same plausibility budget as the page —
+    # otherwise the native fast path np.zeros's from garbage dimensions
+    # and dies with MemoryError instead of a clean parse error
     f.seek(0, 2)
     fsize = f.tell()
     if len(offsets) < n_blocks or len(counts) < n_blocks:
@@ -584,7 +588,18 @@ def _decode_ifd(
             f"{path}: corrupt block table ({len(offsets)} offsets / "
             f"{len(counts)} counts for {n_blocks} blocks)"
         )
-    for o, c in zip(offsets[:n_blocks], counts[:n_blocks]):
+    offsets = offsets[:n_blocks]
+    counts = counts[:n_blocks]
+    slot_bytes = (
+        n_blocks * blk_rows * blk_w * chunk_spp * dtype.itemsize
+    )
+    if slot_bytes > min((fsize + 4096) * 65536, 2**40):
+        raise ValueError(
+            f"{path}: corrupt block geometry ({n_blocks} blocks × "
+            f"{blk_rows}×{blk_w}×{chunk_spp} = {slot_bytes} decoded bytes "
+            f"from a {fsize}-byte file)"
+        )
+    for o, c in zip(offsets, counts):
         if o < 0 or c < 0 or o + c > fsize:
             raise ValueError(
                 f"{path}: corrupt block table entry ({o}+{c} vs file "

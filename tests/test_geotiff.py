@@ -686,3 +686,24 @@ def test_lzw_writer_native_and_python_identical_files(tmp_path, rng):
     got, _, info = read_geotiff(p_nat)
     assert info.compression == 5
     np.testing.assert_array_equal(got, arr)
+
+
+def test_corrupt_tile_geometry_rejected(tmp_path, rng):
+    """Inflated TileWidth/TileLength tags must fail as a corrupt-TIFF
+    ValueError before any decode-path allocation — not a MemoryError from
+    np.zeros on garbage dimensions (code-review r3, reproduced under a
+    4 GiB rlimit)."""
+    import struct
+
+    arr = _rand(rng, "u2", (40, 40))
+    p = str(tmp_path / "t.tif")
+    write_geotiff(p, arr, tile=32)
+    blob = bytearray(open(p, "rb").read())
+    # patch TileWidth (322) and TileLength (323) SHORT values to 60000
+    for tag in (322, 323):
+        i = blob.find(struct.pack("<HH", tag, 3))
+        assert i > 0
+        blob[i + 8 : i + 10] = struct.pack("<H", 60000)
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(ValueError, match="corrupt block geometry"):
+        read_geotiff(p)
