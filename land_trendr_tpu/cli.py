@@ -33,6 +33,7 @@ import sys
 
 from land_trendr_tpu.config import LTParams
 from land_trendr_tpu.ops.indices import INDEX_NAMES
+from land_trendr_tpu.runtime.manifest import ARTIFACT_COMPRESS
 
 __all__ = ["main", "build_parser"]
 
@@ -94,6 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--out-compress", default="deflate",
                      choices=("deflate", "lzw", "none"),
                      help="output raster compression")
+    seg.add_argument("--manifest-compress", default="none",
+                     choices=ARTIFACT_COMPRESS,
+                     help="per-tile checkpoint artifact compression: 'none' "
+                     "(fastest; default) or 'deflate' (zlib-1, smaller "
+                     "workdir)")
     seg.add_argument("--trace", default=None, metavar="LOGDIR",
                      help="capture a jax.profiler device+host trace of the "
                      "run under LOGDIR (open with TensorBoard's profile "
@@ -289,6 +295,7 @@ def main(argv: list[str] | None = None) -> int:
             scale=args.scale,
             offset=args.offset,
             out_compress=args.out_compress,
+            manifest_compress=args.manifest_compress,
         )
         mesh = None
         if args.mesh:

@@ -5,6 +5,7 @@ out on the input grid), the manifest checkpoint/resume semantics (§5), the
 fused DN tile op against the precomputed-index path, and tile-level retry.
 """
 
+import dataclasses
 import json
 import logging
 import os
@@ -296,7 +297,7 @@ def test_writer_failure_fails_fast(tmp_path, rstack, monkeypatch):
     cfg = make_cfg(tmp_path)
     computed = {"n": 0}
 
-    def bad_record(self, tile_id, arrays, meta):
+    def bad_record(self, tile_id, arrays, meta, **kw):
         raise OSError("disk full (injected)")
 
     monkeypatch.setattr(TileManifest, "record", bad_record)
@@ -413,6 +414,49 @@ def test_output_compression_choice(tmp_path, rstack):
     rmse, _, info = read_geotiff(paths["rmse"])
     assert info.compression == 5  # LZW on disk
     assert rmse.shape == (40, 48)
+
+
+def test_manifest_compress_roundtrip(tmp_path):
+    """Both artifact compressions round-trip bit-identically through
+    np.load; 'deflate' actually shrinks the file; bad values are rejected
+    at RunConfig construction and at record()."""
+    rng = np.random.default_rng(3)
+    arrays = {
+        "a": rng.integers(0, 50, (500, 7)).astype(np.int32),
+        "b": rng.normal(size=(500, 6)).astype(np.float32),
+        "c": rng.random(500) < 0.5,
+    }
+    sizes = {}
+    for mode in ("none", "deflate"):
+        m = TileManifest(os.path.join(tmp_path, mode), "f" * 16)
+        m.open(resume=False)
+        m.record(7, arrays, {"y0": 0}, compress=mode)
+        got = m.load_tile(7)
+        assert set(got) == set(arrays)
+        for k in arrays:
+            np.testing.assert_array_equal(got[k], arrays[k])
+        sizes[mode] = os.path.getsize(m.tile_path(7))
+    assert sizes["deflate"] < sizes["none"]
+    with pytest.raises(ValueError, match="compress"):
+        m.record(8, arrays, {}, compress="lzma")
+    with pytest.raises(ValueError, match="manifest_compress"):
+        RunConfig(manifest_compress="best")
+
+
+def test_manifest_compress_resume_mixes(tmp_path, rstack):
+    """manifest_compress is a pure speed/size trade: a run checkpointed
+    with 'deflate' resumes (and assembles) under 'none' — same fingerprint,
+    artifacts readable either way."""
+    cfg = make_cfg(tmp_path, manifest_compress="deflate")
+    two = plan_tiles(40, 48, 32)[:2]
+    first = run_stack(rstack, cfg, tiles=two)
+    assert first["pixels"] == sum(t.h * t.w for t in two)
+    cfg2 = dataclasses.replace(cfg, manifest_compress="none")
+    rest = run_stack(rstack, cfg2)
+    assert rest["tiles_skipped_resume"] == 2
+    paths = assemble_outputs(rstack, cfg2)
+    valid, _, _ = read_geotiff(paths["model_valid"])
+    assert valid.shape == (40, 48)
 
 
 def test_float_stack_rejected_loudly(tmp_path):
