@@ -1,0 +1,150 @@
+"""Host feed/write path throughput benchmark -> HOSTPATH_r03.json.
+
+SURVEY.md §7 hard-part 4: at the 10M px/s/chip north star the host must
+gather ~6 B/pixel-year of DN+QA into device-feed layout (~2.4 GB/s for a
+40-year NBR stack) and persist the per-tile outputs.  The TPU chip has
+been env-blocked all rounds (BENCH_r03_attempts.log), but every byte of
+this path is host code — measurable anywhere.  This tool times the three
+host stages in isolation on real-shaped data and reports, per stage, the
+single-core px/s and the cores needed to sustain the north star, so the
+"feed-bound by design" claim in runtime/driver.py rests on a number.
+
+Stages measured (one 512² tile × 40 years, NBR band set):
+  feed.native   - lt_gather_tile (threaded C++; here 1 thread = 1 core)
+  feed.numpy    - the pure-NumPy fallback gather
+  write.none    - manifest artifact, uncompressed npz (the default)
+  write.deflate - manifest artifact, zlib-1 streamed zip
+  write.zlib6   - np.savez_compressed (the pre-round-3 behaviour)
+
+Payload realism: the write payload is produced by the actual kernel on
+synthetic imagery (ops/tile.process_tile_dn), so compression ratios
+reflect real segmentation outputs, not random bytes.
+
+Usage: PYTHONPATH=. python tools/host_path_bench.py [--out HOSTPATH_r03.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io import native
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.tile import process_tile_dn
+from land_trendr_tpu.runtime.driver import RunConfig, TileSpec, _feed_tile, _tile_arrays
+from land_trendr_tpu.runtime.manifest import TileManifest
+from land_trendr_tpu.runtime.stack import stack_from_synthetic
+
+NY = 40
+TILE = 512
+NORTH_STAR_PX_S = 10e6
+
+
+def time_fn(fn, reps: int, warmup: int = 1) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="HOSTPATH_r03.json")
+    ap.add_argument("--scene", type=int, default=2048,
+                    help="synthetic scene edge (>= 512 + gather offsets)")
+    args = ap.parse_args()
+
+    spec = SceneSpec(width=args.scene, height=args.scene,
+                     year_start=1984, year_end=1984 + NY - 1, seed=7)
+    stack = stack_from_synthetic(make_stack(spec))
+    bands = idx.required_bands("nbr")
+    t = TileSpec(tile_id=0, y0=256, x0=256, h=TILE, w=TILE)
+    feed_bytes = (len(bands) + 1) * 2 * TILE * TILE * NY  # DN bands + QA, int16
+
+    result: dict = {
+        "description": __doc__.split("\n\n")[1].replace("\n", " "),
+        "platform": "host (cpu)",
+        "nproc": os.cpu_count(),
+        "tile": {"size": TILE, "years": NY, "bands": sorted(bands) + ["qa"]},
+        "north_star_px_s": NORTH_STAR_PX_S,
+        "stages": {},
+    }
+
+    def add(name: str, seconds: float, nbytes: int, px: int) -> None:
+        px_s = px / seconds
+        result["stages"][name] = {
+            "s_per_tile": round(seconds, 4),
+            "mb_per_s": round(nbytes / seconds / 1e6, 1),
+            "px_per_s_per_core": round(px_s, 1),
+            "cores_for_north_star": round(NORTH_STAR_PX_S / px_s, 2),
+        }
+
+    # --- feed ---------------------------------------------------------
+    px = TILE * TILE
+    sec = time_fn(lambda: _feed_tile(stack, t, px, bands), reps=10)
+    add("feed.native" if native.available() else "feed.numpy", sec, feed_bytes, px)
+    if native.available():
+        os.environ["LT_NO_NATIVE"] = "1"  # module already loaded; force via monkeypatch
+        orig = native._LIB
+        native._LIB = None
+        try:
+            sec = time_fn(lambda: _feed_tile(stack, t, px, bands), reps=5)
+            add("feed.numpy", sec, feed_bytes, px)
+        finally:
+            native._LIB = orig
+            del os.environ["LT_NO_NATIVE"]
+
+    # --- real kernel payload for the write stage ----------------------
+    dn, qa = _feed_tile(stack, t, px, bands)
+    out = process_tile_dn(np.asarray(stack.years, np.int32), dn, qa,
+                          index="nbr", ftv_indices=(), params=LTParams())
+    jax.block_until_ready(out)
+    cfg = RunConfig()
+    arrays = _tile_arrays(out, t, cfg)
+    payload = int(sum(a.nbytes for a in arrays.values()))
+    result["tile"]["write_payload_mb"] = round(payload / 1e6, 1)
+
+    workdir = os.path.join(os.path.dirname(args.out) or ".", ".hostpath_bench")
+    sizes = {}
+    for mode in ("none", "deflate"):
+        m = TileManifest(os.path.join(workdir, mode), "b" * 16)
+        m.open(resume=False)
+        sec = time_fn(lambda: m.record(0, arrays, {}, compress=mode), reps=3)
+        add(f"write.{mode}", sec, payload, px)
+        sizes[mode] = os.path.getsize(m.tile_path(0))
+
+    def zlib6():
+        np.savez_compressed(os.path.join(workdir, "z6.npz"), **arrays)
+
+    os.makedirs(workdir, exist_ok=True)
+    sec = time_fn(zlib6, reps=3)
+    add("write.zlib6", sec, payload, px)
+    sizes["zlib6"] = os.path.getsize(os.path.join(workdir, "z6.npz"))
+    result["artifact_bytes"] = sizes
+
+    import shutil
+
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result["stages"], indent=2))
+
+
+if __name__ == "__main__":
+    main()
