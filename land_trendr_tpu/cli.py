@@ -133,6 +133,38 @@ def build_parser() -> argparse.ArgumentParser:
                      help="flip sign per this index's disturbance convention")
     _add_param_flags(pix)
 
+    chg = sub.add_parser(
+        "change",
+        help="derive change maps (yod/mag/dur/rate/preval/dsnr) from "
+        "segment rasters — the standard LandTrendr post-processing layer "
+        "(an extension beyond the reference's segment-raster surface)",
+    )
+    chg.add_argument("seg_dir", help="out-dir of a finished `segment` run")
+    chg.add_argument("--dest", default="lt_change", help="output directory")
+    chg.add_argument("--index", default="nbr", choices=INDEX_NAMES,
+                     help="index the segmentation ran on (sets the "
+                     "disturbance direction)")
+    chg.add_argument("--kind", default="disturbance",
+                     choices=("disturbance", "recovery"))
+    chg.add_argument("--sort", default="greatest",
+                     choices=("greatest", "newest", "oldest"),
+                     help="which qualifying segment becomes the map")
+    chg.add_argument("--min-mag", type=float, default=0.0,
+                     help="minimum |magnitude| in index units")
+    chg.add_argument("--min-dur", type=float, default=0.0)
+    chg.add_argument("--max-dur", type=float, default=float("inf"),
+                     help="maximum duration in years (classic fast-"
+                     "disturbance filter: 4)")
+    chg.add_argument("--min-preval", type=float, default=float("-inf"),
+                     help="minimum fitted value at the segment start")
+    chg.add_argument("--max-p", type=float, default=1.0,
+                     help="extra p-of-F cap on top of the run's threshold")
+    chg.add_argument("--year-min", type=float, default=float("-inf"))
+    chg.add_argument("--year-max", type=float, default=float("inf"))
+    chg.add_argument("--mmu", type=int, default=1,
+                     help="minimum mapping unit: drop 4-connected changed "
+                     "patches smaller than this many pixels")
+
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
 
@@ -270,6 +302,26 @@ def main(argv: list[str] | None = None) -> int:
         )
         paths = write_stack(args.out_dir, make_stack(spec))
         print(json.dumps({"files": len(paths), "out_dir": args.out_dir}))
+        return 0
+
+    if args.cmd == "change":
+        from land_trendr_tpu.ops.change import ChangeFilter, write_change_maps
+
+        filt = ChangeFilter(
+            kind=args.kind,
+            sort=args.sort,
+            min_mag=args.min_mag,
+            min_dur=args.min_dur,
+            max_dur=args.max_dur,
+            min_preval=args.min_preval,
+            max_p=args.max_p,
+            year_min=args.year_min,
+            year_max=args.year_max,
+        )
+        paths = write_change_maps(
+            args.seg_dir, args.dest, index=args.index, filt=filt, mmu=args.mmu
+        )
+        print(json.dumps({"outputs": paths}, indent=2))
         return 0
 
     if args.cmd == "segment":

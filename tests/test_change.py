@@ -1,0 +1,173 @@
+"""Change-map product layer (ops/change.py).
+
+Unit tests pin the segment-selection semantics on hand-built arrays;
+the end-to-end test drives synthetic imagery with known disturbance
+years through segment -> assemble -> change and checks the year-of-
+detection map against the scene truth.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.geotiff import read_geotiff
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.ops.change import (
+    CHANGE_PRODUCTS,
+    ChangeFilter,
+    mmu_sieve,
+    select_change,
+    write_change_maps,
+)
+from land_trendr_tpu.runtime import (
+    RunConfig,
+    assemble_outputs,
+    run_stack,
+    stack_from_synthetic,
+)
+
+SIGN = -1.0  # NBR disturbance direction (idx.DISTURBANCE_SIGN["nbr"])
+
+
+def one_pixel(
+    vyears=(1990.0, 2000.0, 2005.0, 2015.0),
+    vfits=(0.6, 0.1, 0.5, 0.45),
+    valid=True,
+    p=0.01,
+    rmse=0.05,
+):
+    """(1, NV)/(1, NM) arrays for a fit with NV=4 vertices / NM=3 segments.
+
+    Default trajectory: big disturbance 1990->2000 (-0.5), recovery
+    2000->2005 (+0.4), slow small disturbance 2005->2015 (-0.05).
+    """
+    vy = np.asarray([vyears], np.float32)
+    vf = np.asarray([vfits], np.float32)
+    mag = vf[:, 1:] - vf[:, :-1]
+    dur = vy[:, 1:] - vy[:, :-1]
+    rate = np.where(dur > 0, mag / np.where(dur > 0, dur, 1), 0)
+    return dict(
+        vertex_years=vy,
+        vertex_fit_vals=vf,
+        seg_magnitude=mag.astype(np.float32),
+        seg_duration=dur.astype(np.float32),
+        seg_rate=rate.astype(np.float32),
+        model_valid=np.asarray([valid]),
+        p_of_f=np.asarray([p], np.float32),
+        rmse=np.asarray([rmse], np.float32),
+    )
+
+
+def run(filt=ChangeFilter(), **kw):
+    out = select_change(**one_pixel(**kw), sign=SIGN, filt=filt)
+    return {k: np.asarray(v)[0] for k, v in out.items()}
+
+
+def test_greatest_disturbance_default():
+    got = run()
+    assert bool(got["mask"])
+    assert got["yod"] == 1991          # first year after the 1990 vertex
+    assert got["mag"] == pytest.approx(-0.5)   # natural orientation drop
+    assert got["dur"] == pytest.approx(10.0)
+    assert got["preval"] == pytest.approx(0.6)
+    assert got["rate"] == pytest.approx(-0.05)
+    assert got["dsnr"] == pytest.approx(0.5 / 0.05)
+
+
+def test_sort_newest_oldest():
+    # two qualifying disturbances: 1990 (big) and 2005 (small)
+    assert run(filt=ChangeFilter(sort="newest"))["yod"] == 2006
+    assert run(filt=ChangeFilter(sort="oldest"))["yod"] == 1991
+    assert run(filt=ChangeFilter(sort="greatest"))["yod"] == 1991
+
+
+def test_recovery_kind():
+    got = run(filt=ChangeFilter(kind="recovery"))
+    assert bool(got["mask"])
+    assert got["yod"] == 2001
+    assert got["mag"] == pytest.approx(0.4)
+
+
+def test_filters_gate_segments():
+    # min_mag excludes the small 2005 disturbance
+    assert run(filt=ChangeFilter(sort="newest", min_mag=0.1))["yod"] == 1991
+    # max_dur=4 excludes BOTH (10y and 10y) disturbances
+    assert not bool(run(filt=ChangeFilter(max_dur=4))["mask"])
+    # year window selects the late one
+    assert run(filt=ChangeFilter(year_min=2000))["yod"] == 2006
+    # preval: late disturbance starts at 0.5 < 0.55
+    assert run(filt=ChangeFilter(min_preval=0.55))["yod"] == 1991
+    assert not bool(
+        run(filt=ChangeFilter(min_preval=0.65))["mask"]
+    )
+    # p cap and model_valid gate everything
+    assert not bool(run(p=0.2, filt=ChangeFilter(max_p=0.1))["mask"])
+    assert not bool(run(valid=False)["mask"])
+    # non-change outputs are zeroed on unchanged pixels
+    got = run(valid=False)
+    for k in CHANGE_PRODUCTS:
+        assert not np.any(got[k])
+
+
+def test_filter_validation():
+    with pytest.raises(ValueError, match="kind"):
+        ChangeFilter(kind="both")
+    with pytest.raises(ValueError, match="sort"):
+        ChangeFilter(sort="biggest")
+
+
+def test_mmu_sieve_4_connectivity():
+    m = np.zeros((8, 8), bool)
+    m[0:3, 0:3] = True       # 9-px patch: kept at mmu=9
+    m[6, 6] = True           # isolated: dropped
+    m[4, 4] = True           # diagonal to nothing relevant: dropped
+    out = mmu_sieve(m, 9)
+    assert out[0:3, 0:3].all()
+    assert not out[6, 6] and not out[4, 4]
+    # mmu<=1 is identity (same object semantics fine)
+    assert mmu_sieve(m, 1).sum() == m.sum()
+
+
+def test_end_to_end_change_maps(tmp_path):
+    spec = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    synth = make_stack(spec)
+    rstack = stack_from_synthetic(synth)
+    cfg = RunConfig(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=32,
+        workdir=os.path.join(tmp_path, "work"),
+        out_dir=os.path.join(tmp_path, "out"),
+    )
+    run_stack(rstack, cfg)
+    assemble_outputs(rstack, cfg)
+
+    dest = os.path.join(tmp_path, "change")
+    paths = write_change_maps(
+        cfg.out_dir, dest, index="nbr", filt=ChangeFilter(min_mag=0.05)
+    )
+    assert set(paths) == set(CHANGE_PRODUCTS)
+    yod, _, _ = read_geotiff(paths["yod"])
+    mask, _, _ = read_geotiff(paths["mask"])
+    mask = mask.astype(bool)
+    assert yod.shape == (40, 48)
+
+    disturbed = synth.truth_year >= 0
+    # most truly-disturbed pixels are flagged, with yod within 2y of truth
+    hit = mask & disturbed
+    assert hit.sum() > 0.6 * disturbed.sum()
+    err = np.abs(yod[hit] - (synth.truth_year[hit] + 1))
+    assert np.median(err) <= 1
+    assert (err <= 2).mean() > 0.8
+    # flagged-but-undisturbed stays a modest fraction (noise-chased fits)
+    assert (mask & ~disturbed).sum() < 0.25 * mask.sum()
+
+    # mmu sieve never adds pixels and only removes whole small patches
+    paths2 = write_change_maps(
+        cfg.out_dir, os.path.join(tmp_path, "change_mmu"), index="nbr",
+        filt=ChangeFilter(min_mag=0.05), mmu=5,
+    )
+    mask2, _, _ = read_geotiff(paths2["mask"])
+    mask2 = mask2.astype(bool)
+    assert (mask2 <= mask).all() and mask2.sum() < mask.sum() + 1
