@@ -64,3 +64,32 @@ def test_bench_emits_single_json_line():
     assert rec["value"] > 0
     # both fields are independently rounded (value to 0.1, ratio to 1e-4)
     assert rec["vs_baseline"] == pytest.approx(rec["value"] / 10e6, abs=1.1e-4)
+
+
+def test_bench_chain_mode_emits_single_json_line():
+    """The accelerator-default chain mode (lax.scan of data-dependent
+    kernel applications) must run end to end; the driver's round-end TPU
+    bench takes this path."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "JAX_PLATFORMS": "cpu",
+            "LT_BENCH_PX": "64",
+            "LT_BENCH_YEARS": "12",
+            "LT_BENCH_REPS": "2",
+            "LT_BENCH_MODE": "chain",
+            "LT_BENCH_CHAIN_K": "3",
+        },
+        cwd=".",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be one JSON line, got: {proc.stdout!r}"
+    rec = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["mode"] == "chain" and rec["chain_k"] == 3
+    assert rec["value"] > 0
