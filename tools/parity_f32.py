@@ -16,6 +16,7 @@ taxonomy:
 * ``exact``       — identical vertex_indices + n_vertices + model_valid.
 
 Usage: python tools/parity_f32.py [n_pixels] [out.json] [--platform=cpu]
+                                  [--f64-on-cpu]
 (default 1,048,576 pixels in 64K chunks.  --platform defaults to cpu — f32
 rounding there is the same IEEE arithmetic the TPU's VPU applies outside
 the MXU — but fusion-order effects ARE platform-specific, so the number
@@ -23,9 +24,15 @@ the north star cares about is --platform=tpu on real hardware; the
 ``platform`` field in the artifact records which one was measured.  The
 f32 tolerance contract itself lives in ops/segment.py.)
 
-NOTE: the f64 side requires x64 support; on TPU (no native f64) the f64
-reference pass still runs through XLA's f64 emulation, which is slow but
-correct — the tool warns and proceeds.
+``--f64-on-cpu`` (use with ``--platform=axon,cpu`` or the container
+default): the f32 pass runs on the first accelerator device while the f64
+reference pass runs on the host CPU backend — the configuration that
+answers the real question (TPU-f32 vs exact f64) without paying for
+XLA's f64 emulation on a chip with no native f64.
+
+NOTE: otherwise the f64 side runs wherever the default device is; on TPU
+that means f64 emulation, which is slow but correct — the tool warns and
+proceeds.
 """
 
 from __future__ import annotations
@@ -84,6 +91,9 @@ def make_population(px: int, ny: int, seed: int) -> tuple[np.ndarray, np.ndarray
 
 
 def main() -> int:
+    split = "--f64-on-cpu" in sys.argv
+    if split:
+        sys.argv.remove("--f64-on-cpu")
     px_total = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
     out_path = sys.argv[2] if len(sys.argv) > 2 else "PARITY_f32.json"
     ny = 40
@@ -92,15 +102,24 @@ def main() -> int:
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.ops.segment import jax_segment_pixels
 
-    plat = jax.devices()[0].platform
-    if plat == "tpu":
-        print(
-            "parity_f32: TPUs have no native f64 — the f64 reference pass "
-            "runs under XLA's f64 emulation (slow but correct); expect a "
-            "long runtime",
-            file=sys.stderr,
-            flush=True,
-        )
+    acc_dev = jax.devices()[0]
+    plat = acc_dev.platform
+    if split:
+        cpu_dev = jax.devices("cpu")[0]
+        platform_label = f"f32:{plat}/f64:cpu"
+        print(f"parity_f32: split devices — {platform_label}",
+              file=sys.stderr, flush=True)
+    else:
+        cpu_dev = None
+        platform_label = plat
+        if plat == "tpu":
+            print(
+                "parity_f32: TPUs have no native f64 — the f64 reference "
+                "pass runs under XLA's f64 emulation (slow but correct); "
+                "expect a long runtime (or pass --f64-on-cpu)",
+                file=sys.stderr,
+                flush=True,
+            )
 
     params = LTParams()
     counts = {"exact": 0, "valid_flip": 0, "count_diff": 0, "placement": 0}
@@ -115,10 +134,25 @@ def main() -> int:
         years, vals, mask = make_population(n, ny, seed)
         seed += 1
 
-        out64 = jax_segment_pixels(years, vals, mask, params)
-        out32 = jax_segment_pixels(
-            years, vals.astype(np.float32), mask, params
-        )
+        if split:
+            # committed placement: jit runs each pass on its input's device
+            out64 = jax_segment_pixels(
+                jax.device_put(years, cpu_dev),
+                jax.device_put(vals, cpu_dev),
+                jax.device_put(mask, cpu_dev),
+                params,
+            )
+            out32 = jax_segment_pixels(
+                jax.device_put(years, acc_dev),
+                jax.device_put(vals.astype(np.float32), acc_dev),
+                jax.device_put(mask, acc_dev),
+                params,
+            )
+        else:
+            out64 = jax_segment_pixels(years, vals, mask, params)
+            out32 = jax_segment_pixels(
+                years, vals.astype(np.float32), mask, params
+            )
 
         vi64 = np.asarray(out64.vertex_indices)
         vi32 = np.asarray(out32.vertex_indices)
@@ -157,7 +191,7 @@ def main() -> int:
     record = {
         "n_pixels": px_total,
         "n_years": ny,
-        "platform": jax.devices()[0].platform,
+        "platform": platform_label,
         "exact_vertex_agreement": counts["exact"] / total,
         "taxonomy": {
             k: {"count": v, "rate": v / total} for k, v in counts.items()
