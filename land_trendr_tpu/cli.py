@@ -100,6 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="per-tile checkpoint artifact compression: 'none' "
                      "(fastest; default) or 'deflate' (zlib-1, smaller "
                      "workdir)")
+    seg.add_argument("--write-workers", type=int, default=1,
+                     help="background tile-writer threads (scale up on "
+                     "device-rate hosts; memory stays bounded at "
+                     "write_workers+2 live tiles)")
     seg.add_argument("--trace", default=None, metavar="LOGDIR",
                      help="capture a jax.profiler device+host trace of the "
                      "run under LOGDIR (open with TensorBoard's profile "
@@ -348,6 +352,7 @@ def main(argv: list[str] | None = None) -> int:
             offset=args.offset,
             out_compress=args.out_compress,
             manifest_compress=args.manifest_compress,
+            write_workers=args.write_workers,
         )
         mesh = None
         if args.mesh:

@@ -32,6 +32,7 @@ import dataclasses
 import logging
 import os
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
@@ -84,6 +85,12 @@ class RunConfig:
     #: "deflate" (zlib-1, for constrained workdir storage).  A pure
     #: speed/size trade: resume reads either, so it is not fingerprinted.
     manifest_compress: str = "none"
+    #: background tile-writer threads.  One writer sustains ~0.64M px/s
+    #: (HOSTPATH_r03.json write.none) — enough to overlap a CPU run but
+    #: ~16× short of the 10M px/s north star, so device-rate hosts scale
+    #: the writer pool instead.  Host memory stays bounded: at most
+    #: ``write_workers + 2`` tiles are live at once.
+    write_workers: int = 1
     #: transient-HBM bound for large tiles: tiles with more pixels than this
     #: run the segmentation through the chunked kernel (the kernel's working
     #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
@@ -103,6 +110,8 @@ class RunConfig:
                 f"manifest_compress={self.manifest_compress!r} not one of "
                 f"{ARTIFACT_COMPRESS}"
             )
+        if self.write_workers < 1:
+            raise ValueError(f"write_workers={self.write_workers} must be >= 1")
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -265,28 +274,35 @@ def run_stack(
     would otherwise idle each other (SURVEY.md §7 step 4 "host
     prefetch/double-buffering"): JAX dispatch is asynchronous, so tile
     ``i``'s device program runs while the host slices tile ``i+1``'s input
-    (feed) and a single background writer thread compresses and persists
-    tile ``i-1``'s artifacts.  ``block_until_ready`` on tile ``i`` happens
-    only after tile ``i+1`` has been fed and dispatched.  The write queue
-    has depth 1 (each tile's write is collected before the next is
-    submitted — backpressure and fail-fast for writer errors), so at most
-    three tiles are live at once and host memory stays bounded.
+    (feed) and a pool of ``cfg.write_workers`` background writer threads
+    persists earlier tiles' artifacts.  ``block_until_ready`` on tile
+    ``i`` happens only after tile ``i+1`` has been fed and dispatched.
+    The write queue is bounded at ``write_workers`` in-flight jobs (the
+    oldest is collected before a new one is submitted — backpressure and
+    fail-fast for writer errors), so at most ``write_workers + 2`` tiles
+    are live at once and host memory stays bounded.
 
     A tile that fails — at dispatch or when its result is awaited — is
     retried synchronously up to ``max_retries`` times before the run
-    aborts; the writer thread's errors surface at the end of the run.
+    aborts; a writer error fails the run fast, re-raised within at most
+    ``write_workers`` subsequent tiles by the queue's backpressure
+    collection.
 
-    Throughput note: no TPU number has been captured yet (the TPU backend
-    in the build environment has failed to initialize every round —
-    BENCH_r03_attempts.log); the only measured kernel rates are CPU
-    diagnostics (BENCH_r03_cpu.json, PROFILE_r03.json: ~24 k px/s on one
-    core) and the scene-scale end-to-end split in SCENE_r03.json.  The
-    *design* target is host→HBM feed-bound operation: ~6 B/pixel-year
-    (two int16 bands + QA for NBR — SURVEY.md §7 hard-part 4) is
-    ~2.4 GB/s per chip at the 10M px/s north star, within PCIe-class
-    bandwidth.  ``stage_s`` in the summary shows where a given run
-    actually spent host time (``compute_s`` includes waiting out
-    transfers on bandwidth-limited links).
+    Throughput note: the kernel has executed end to end on a real TPU v5
+    lite chip (round 3, TPU_PROBE_r03.md), but no trustworthy TPU
+    throughput number exists yet (the tunnel's timing artifacts are
+    documented there); the measured kernel rates are CPU diagnostics
+    (BENCH_r03_cpu.json, PROFILE_r03.json: ~24 k px/s on one core) and
+    the scene-scale end-to-end split in SCENE_r03.json.  The *design*
+    target is host→HBM feed-bound operation: ~6 B/pixel-year (two int16
+    bands + QA for NBR — SURVEY.md §7 hard-part 4) is ~2.4 GB/s per chip
+    at the 10M px/s north star, within PCIe-class bandwidth; the
+    measured host-stage budget (HOSTPATH_r03.json: native gather 4.1M
+    px/s/core, uncompressed artifact write 0.64M px/s/core) says that
+    rate rides a handful of feed cores plus parallel writers.
+    ``stage_s`` in the summary shows where a given run actually spent
+    host time (``compute_s`` includes waiting out transfers on
+    bandwidth-limited links).
 
     Raster outputs are *not* written here — call :func:`assemble_outputs`
     after (or on a later resume; assembly only needs the workdir).
@@ -382,9 +398,9 @@ def run_stack(
             return None, e
 
     def _write_job(t: TileSpec, out, dt: float) -> tuple[int, int]:
-        # "write" accumulates from the writer thread only; every other stage
-        # name is main-thread-only, so StageTimer's per-key accumulation
-        # never races.
+        # StageTimer accumulation is locked, so concurrent writer threads
+        # may share the "write" key; with write_workers > 1 the summed
+        # write_s can legitimately exceed wall time.
         with timer.stage("write"):
             arrays = _tile_arrays(out, t, cfg)
             px = t.h * t.w
@@ -410,8 +426,10 @@ def run_stack(
         )
         return px, fit
 
-    writer = ThreadPoolExecutor(max_workers=1, thread_name_prefix="lt-writer")
-    prev_write = None  # depth-1 write queue: at most one job queued or running
+    writer = ThreadPoolExecutor(
+        max_workers=cfg.write_workers, thread_name_prefix="lt-writer"
+    )
+    pending_writes: deque = deque()  # bounded at write_workers in flight
     n_px = 0
     n_fit = 0
 
@@ -422,9 +440,13 @@ def run_stack(
         n_px += px
         n_fit += fit
 
+    def _drain_writes(limit: int) -> None:
+        """Collect oldest write jobs until at most ``limit`` stay in flight."""
+        while len(pending_writes) > limit:
+            _collect_write(pending_writes.popleft())
+
     def _finish(pending) -> None:
         """Await one in-flight tile (retrying on failure) and queue its write."""
-        nonlocal prev_write
         t, out, err, dn, qa, dt_dispatch = pending
         attempt = 1
         while True:
@@ -449,9 +471,8 @@ def run_stack(
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
             dt_dispatch = time.perf_counter() - t0
-        if prev_write is not None:
-            _collect_write(prev_write)
-        prev_write = writer.submit(_write_job, t, out, dt)
+        _drain_writes(cfg.write_workers - 1)
+        pending_writes.append(writer.submit(_write_job, t, out, dt))
 
     try:
         pending = None
@@ -472,14 +493,13 @@ def run_stack(
                 pending = (t, out, err, dn, qa, dt_dispatch)
         if pending is not None:
             _finish(pending)
-        if prev_write is not None:
-            _collect_write(prev_write)
-            prev_write = None
+        _drain_writes(0)
     finally:
         writer.shutdown(wait=True)
-        if prev_write is not None and (exc := prev_write.exception()):
-            # a compute abort is already propagating; surface, don't mask
-            log.error("tile write also failed during abort: %s", exc)
+        for fut in pending_writes:
+            if (exc := fut.exception()):
+                # a compute abort is already propagating; surface, don't mask
+                log.error("tile write also failed during abort: %s", exc)
 
     wall = time.perf_counter() - t_run
     summary = {

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Any, Callable, Iterator
 
@@ -112,8 +113,9 @@ class StageTimer:
     The runtime driver wraps its feed / compute / write phases so the run
     summary reports where host time went — the host-side complement to the
     device trace (device kernels show up there, Python/NumPy time here).
-    Safe across threads as long as each stage *name* is only ever updated
-    from one thread (per-key read-modify-write is not locked).
+    Thread-safe: accumulation holds a lock, so concurrent writers (the
+    driver's ``write_workers`` pool) may share one stage name; their
+    accumulated seconds then sum ACROSS threads and can exceed wall time.
 
     >>> timer = StageTimer()
     >>> with timer.stage("feed"):
@@ -125,6 +127,7 @@ class StageTimer:
     def __init__(self) -> None:
         self._acc: dict[str, float] = {}
         self._n: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
@@ -133,8 +136,9 @@ class StageTimer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self._acc[name] = self._acc.get(name, 0.0) + dt
-            self._n[name] = self._n.get(name, 0) + 1
+            with self._lock:
+                self._acc[name] = self._acc.get(name, 0.0) + dt
+                self._n[name] = self._n.get(name, 0) + 1
 
     def totals(self) -> dict[str, float]:
         """Stage → accumulated seconds."""

@@ -416,6 +416,42 @@ def test_output_compression_choice(tmp_path, rstack):
     assert rmse.shape == (40, 48)
 
 
+def test_parallel_writers_match_single(tmp_path, rstack):
+    """write_workers=3 produces the same manifest + rasters as the default
+    single writer (writes are per-tile independent; only scheduling
+    changes), and memory-bounding backpressure still collects every job."""
+    cfg1 = make_cfg(os.path.join(tmp_path, "a"))
+    cfg3 = make_cfg(os.path.join(tmp_path, "b"), write_workers=3)
+    s1 = run_stack(rstack, cfg1)
+    s3 = run_stack(rstack, cfg3)
+    assert s1["pixels"] == s3["pixels"] and s1["fit_rate"] == s3["fit_rate"]
+    p1 = assemble_outputs(rstack, cfg1)
+    p3 = assemble_outputs(rstack, cfg3)
+    assert set(p1) == set(p3)
+    for name in ("rmse", "vertex_years", "model_valid"):
+        a, _, _ = read_geotiff(p1[name])
+        b, _, _ = read_geotiff(p3[name])
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="write_workers"):
+        RunConfig(write_workers=0)
+
+
+def test_writer_failure_fails_fast_parallel(tmp_path, rstack, monkeypatch):
+    """With several writer threads, a persistent artifact-write failure
+    still aborts within a bounded number of tiles (backpressure collects
+    the oldest in-flight job before each new submission)."""
+    from land_trendr_tpu.runtime.manifest import TileManifest
+
+    cfg = make_cfg(tmp_path, write_workers=2)
+
+    def bad_record(self, tile_id, arrays, meta, **kw):
+        raise OSError("disk full (injected)")
+
+    monkeypatch.setattr(TileManifest, "record", bad_record)
+    with pytest.raises(OSError, match="disk full"):
+        run_stack(rstack, cfg)
+
+
 def test_manifest_compress_roundtrip(tmp_path):
     """Both artifact compressions round-trip bit-identically through
     np.load; 'deflate' actually shrinks the file; bad values are rejected

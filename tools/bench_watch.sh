@@ -11,7 +11,16 @@
 cd /root/repo
 LOG=/root/repo/BENCH_r03_attempts.log
 for i in $(seq 1 120); do
-  echo "[$(date -u +%FT%TZ)] attempt $i starting" >> "$LOG"
+  # cheap 120 s init probe first: during the init-hang regime a full bench
+  # attempt blocks 15-30 min before its watchdog fires, which would lower
+  # the real poll cadence below the window length; only a probed-up
+  # backend gets the full bench budget
+  if ! timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "[$(date -u +%FT%TZ)] probe $i: backend not up" >> "$LOG"
+    sleep 300
+    continue
+  fi
+  echo "[$(date -u +%FT%TZ)] attempt $i starting (probe green)" >> "$LOG"
   out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=1800 LT_BENCH_PX=65536 LT_BENCH_REPS=3 python bench.py 2>>"$LOG")
   echo "[$(date -u +%FT%TZ)] attempt $i result: $out" >> "$LOG"
   val=$(echo "$out" | python -c "import sys,json;print(json.loads(sys.stdin.readline())['value'])" 2>/dev/null)
