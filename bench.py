@@ -312,6 +312,10 @@ def _child_main() -> int:
     extra = {
         "px": px,
         "platform": os.environ.get("LT_BENCH_PLATFORM") or "default",
+        # the ACTUAL platform measured (the axon plugin can fail init and
+        # fall back to cpu — a consumer must be able to tell a TPU number
+        # from a fallback-CPU number without trusting env vars)
+        "device_platform": dev.platform,
         "chunked": px > chunk,
         "mode": mode,
     }

@@ -37,6 +37,42 @@ def test_c2_matches_prestacked(tmp_path, scene):
     assert b.geo is not None and b.geo.pixel_scale == (30.0, 30.0, 0.0)
 
 
+def test_band_subset_loading(tmp_path, scene):
+    """bands=... loads only the requested cubes (plus QA) in BOTH layouts,
+    identical to the full load's cubes; unknown names error."""
+    d_stacked = str(tmp_path / "stacked")
+    d_c2 = str(tmp_path / "c2")
+    write_stack(d_stacked, scene)
+    write_stack_c2(d_c2, scene)
+    full = load_stack_dir(d_stacked)
+
+    for d in (d_stacked, d_c2):
+        sub = load_stack_dir(d, bands=("nir", "swir2"))
+        assert set(sub.dn_bands) == {"nir", "swir2"}
+        for band in ("nir", "swir2"):
+            np.testing.assert_array_equal(sub.dn_bands[band], full.dn_bands[band])
+        np.testing.assert_array_equal(sub.qa, full.qa)
+    with pytest.raises(ValueError, match="unknown band"):
+        load_stack_dir(d_stacked, bands=("nir", "thermal"))
+
+
+def test_c2_band_subset_skips_unused_files(tmp_path, scene):
+    """With a subset, the C2 loader never opens the unused bands' files —
+    a download containing ONLY the needed bands loads fine."""
+    d_c2 = str(tmp_path / "c2")
+    write_stack_c2(d_c2, scene)
+    keep = ("nir", "swir2")
+    # corrupt every file of an unused band: the loader must not read them
+    for n in os.listdir(d_c2):
+        up = n.upper()
+        # red band: TM numbering B3, OLI numbering B4
+        if ("LT05" in up and "_SR_B3" in up) or ("LC08" in up and "_SR_B4" in up):
+            with open(os.path.join(d_c2, n), "wb") as f:
+                f.write(b"not a tiff")
+    sub = load_stack_dir_c2(d_c2, bands=keep)
+    assert set(sub.dn_bands) == set(keep)
+
+
 def test_c2_autodetected_by_load_stack_dir(tmp_path, scene):
     d = str(tmp_path / "c2auto")
     write_stack_c2(d, scene)

@@ -15,7 +15,7 @@ for i in $(seq 1 120); do
   # attempt blocks 15-30 min before its watchdog fires, which would lower
   # the real poll cadence below the window length; only a probed-up
   # backend gets the full bench budget
-  if ! timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if ! timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
     echo "[$(date -u +%FT%TZ)] probe $i: backend not up" >> "$LOG"
     sleep 300
     continue
@@ -23,7 +23,14 @@ for i in $(seq 1 120); do
   echo "[$(date -u +%FT%TZ)] attempt $i starting (probe green)" >> "$LOG"
   out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=1800 LT_BENCH_PX=65536 LT_BENCH_REPS=3 python bench.py 2>>"$LOG")
   echo "[$(date -u +%FT%TZ)] attempt $i result: $out" >> "$LOG"
-  val=$(echo "$out" | python -c "import sys,json;print(json.loads(sys.stdin.readline())['value'])" 2>/dev/null)
+  # accept only a real accelerator measurement: value > 0 AND the record's
+  # device_platform is not cpu (the axon plugin can fail init and fall
+  # back to the cpu backend, which must not become BENCH_r03.json)
+  val=$(echo "$out" | python -c "
+import sys, json
+r = json.loads(sys.stdin.readline())
+print(r['value'] if r.get('device_platform') not in (None, 'cpu') else 0.0)
+" 2>/dev/null)
   if [ -n "$val" ] && [ "$val" != "0.0" ] && [ "$val" != "0" ]; then
     echo "$out" > /root/repo/BENCH_r03.json
     echo "[$(date -u +%FT%TZ)] SUCCESS — BENCH_r03.json written" >> "$LOG"
