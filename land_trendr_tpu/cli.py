@@ -104,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="background tile-writer threads (scale up on "
                      "device-rate hosts; memory stays bounded at "
                      "write_workers+2 live tiles)")
+    seg.add_argument("--composite", default=None, choices=("medoid",),
+                     help="collapse multi-acquisition years in a C2 "
+                     "per-band archive to per-pixel QA-masked medoid "
+                     "composites (default: require one acquisition/year)")
     seg.add_argument("--trace", default=None, metavar="LOGDIR",
                      help="capture a jax.profiler device+host trace of the "
                      "run under LOGDIR (open with TensorBoard's profile "
@@ -369,7 +373,13 @@ def main(argv: list[str] | None = None) -> int:
         from land_trendr_tpu.ops.indices import required_bands
 
         stack = load_stack_dir(
-            args.stack_dir, bands=required_bands(args.index, ftv)
+            args.stack_dir,
+            bands=required_bands(args.index, ftv),
+            composite=args.composite,
+            # composite validity masks must match the run's own masking
+            reject_bits=cfg.reject_bits,
+            scale=cfg.scale,
+            offset=cfg.offset,
         )
         if args.trace:
             from land_trendr_tpu.utils.profiling import trace

@@ -113,7 +113,13 @@ def _use_bands(bands) -> tuple[str, ...]:
 
 
 def load_stack_dir(
-    path: str, pattern: str = r"\.tif$", bands=None
+    path: str,
+    pattern: str = r"\.tif$",
+    bands=None,
+    composite: str | None = None,
+    reject_bits: int | None = None,
+    scale: float = 2.75e-5,
+    offset: float = -0.2,
 ) -> RasterStack:
     """Load a directory of Landsat rasters, auto-detecting the layout.
 
@@ -134,6 +140,8 @@ def load_stack_dir(
     less host memory at scene scale; the CLI passes
     :func:`~land_trendr_tpu.ops.indices.required_bands` automatically).
     The per-band C2 layout additionally skips reading the unused files.
+    ``composite`` ("medoid") applies to the C2 layout only, where multiple
+    acquisitions per year can occur — see :func:`load_stack_dir_c2`.
     """
     names = sorted(
         n for n in os.listdir(path) if re.search(pattern, n, re.IGNORECASE)
@@ -141,7 +149,20 @@ def load_stack_dir(
     if not names:
         raise FileNotFoundError(f"no rasters matching {pattern!r} in {path}")
     if any(_C2_RE.match(n) for n in names):
-        return load_stack_dir_c2(path, pattern=pattern, bands=bands)
+        return load_stack_dir_c2(
+            path,
+            pattern=pattern,
+            bands=bands,
+            composite=composite,
+            reject_bits=reject_bits,
+            scale=scale,
+            offset=offset,
+        )
+    if composite is not None:
+        raise ValueError(
+            "composite applies to the Collection-2 per-band layout; the "
+            "pre-stacked layout is one image per year by construction"
+        )
     use = _use_bands(bands)
     entries = []
     for n in names:
@@ -205,7 +226,13 @@ def load_stack_dir(
 
 
 def load_stack_dir_c2(
-    path: str, pattern: str | None = None, bands=None
+    path: str,
+    pattern: str | None = None,
+    bands=None,
+    composite: str | None = None,
+    reject_bits: int | None = None,
+    scale: float = 2.75e-5,
+    offset: float = -0.2,
 ) -> RasterStack:
     """Load a directory of Landsat Collection-2 Level-2 per-band files.
 
@@ -218,15 +245,24 @@ def load_stack_dir_c2(
     correctly.  SR DNs keep their on-disk integer dtype — real C2 SR is
     **uint16** (valid DN 7273–43636) and must not be narrowed to int16.
 
-    One acquisition per year, from one WRS-2 path/row, is required
-    (LandTrendr is an annual-series algorithm — composite first if you
-    have more); multiple dates per year or mixed path/rows raise with the
-    offending values listed.  ``pattern`` (regex on file names, the same
-    argument :func:`load_stack_dir` takes) pre-filters the directory, e.g.
-    to select one path/row.
+    LandTrendr is an annual-series algorithm, so each year must collapse
+    to one image.  By default (``composite=None``) exactly one
+    acquisition per year is required and multiple dates raise with the
+    offending values listed; ``composite="medoid"`` instead builds the
+    per-pixel QA-masked medoid composite of each multi-acquisition year
+    on device (:func:`land_trendr_tpu.ops.composite.medoid_composite` —
+    an extension beyond the reference, which tells users to composite
+    first).  ``reject_bits``/``scale``/``offset`` feed the composite's
+    validity masks and should match the run's ``RunConfig`` values so
+    selection and segmentation mask identically (None → the C2
+    defaults).  One WRS-2 path/row is required either way; ``pattern``
+    (regex on file names, the same argument :func:`load_stack_dir`
+    takes) pre-filters the directory, e.g. to select one path/row.
     """
-    groups: dict[int, dict[str, tuple[str, str]]] = {}
-    dates: dict[int, set[str]] = {}
+    if composite not in (None, "medoid"):
+        raise ValueError(f"composite={composite!r} not None|'medoid'")
+    # year -> date -> band -> path
+    groups: dict[int, dict[str, dict[str, str]]] = {}
     pathrows: set[str] = set()
     for n in sorted(os.listdir(path)):
         if pattern is not None and not re.search(pattern, n, re.IGNORECASE):
@@ -239,11 +275,9 @@ def load_stack_dir_c2(
             continue  # e.g. OLI coastal B1 — unused
         pathrows.add(m["pathrow"])
         year = int(m["date"][:4])
-        dates.setdefault(year, set()).add(m["date"])
-        g = groups.setdefault(year, {})
-        if band in g and g[band][1] != m["date"]:
-            continue  # second acquisition; reported via the dates check below
-        g[band] = (os.path.join(path, n), m["date"])
+        groups.setdefault(year, {}).setdefault(m["date"], {})[band] = os.path.join(
+            path, n
+        )
     if not groups:
         raise FileNotFoundError(f"no Collection-2 per-band rasters in {path}")
     if len(pathrows) > 1:
@@ -251,58 +285,99 @@ def load_stack_dir_c2(
             f"{path}: multiple WRS-2 path/rows {sorted(pathrows)} in one "
             "stack — pass pattern=... to select one scene"
         )
-    multi = {y: sorted(d) for y, d in dates.items() if len(d) > 1}
-    if multi:
+    multi = {y: sorted(d) for y, d in groups.items() if len(d) > 1}
+    if multi and composite is None:
         raise ValueError(
             f"{path}: multiple acquisitions per year {multi} — LandTrendr "
-            "takes one (composited) image per year; pre-composite or prune"
+            "takes one image per year; pre-composite, prune, or pass "
+            "composite='medoid'"
         )
 
     years = np.array(sorted(groups), dtype=np.int32)
     needed = (*_use_bands(bands), "qa")  # unused bands' files never read
     # preallocated cubes, filled per (year, band): peak memory is one stack
-    # plus one band file (see load_stack_dir's note)
+    # plus one year's acquisitions (see load_stack_dir's note)
     dn_cubes: dict[str, np.ndarray] = {}
     qa_cube: np.ndarray | None = None
     geo = None
     shape = None
-    for k, year in enumerate(years.tolist()):
-        g = groups[year]
-        missing = [b for b in needed if b not in g]
-        if missing:
+
+    def read_band(fp: str, b: str) -> np.ndarray:
+        nonlocal shape, geo
+        img, gmeta, _info = read_geotiff(fp)
+        if img.ndim != 2:
             raise ValueError(
-                f"{path}: year {year} is missing bands {missing} "
-                f"(have {sorted(g)})"
+                f"{fp}: expected a single-band raster; got {img.shape}"
             )
-        for b in needed:
-            fp, _date = g[b]
-            img, gmeta, _info = read_geotiff(fp)
-            if img.ndim != 2:
+        if shape is None:
+            shape, geo = img.shape, gmeta
+        elif img.shape != shape:
+            raise ValueError(f"{fp}: raster size {img.shape} != {shape}")
+        if b == "qa":
+            return img.astype(np.uint16, copy=False)
+        if img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
+            # keep the on-disk dtype: real C2 SR is uint16 with valid DNs
+            # up to 43636 — an int16 cast would wrap bright pixels (snow,
+            # cloud edge) negative with no error
+            raise ValueError(
+                f"{fp}: SR band dtype {img.dtype} unsupported "
+                "(expected int16 or uint16 DNs)"
+            )
+        return img
+
+    for k, year in enumerate(years.tolist()):
+        by_date = groups[year]
+        for date in sorted(by_date):
+            missing = [b for b in needed if b not in by_date[date]]
+            if missing:
                 raise ValueError(
-                    f"{fp}: expected a single-band raster; got {img.shape}"
+                    f"{path}: acquisition {date} is missing bands {missing} "
+                    f"(have {sorted(by_date[date])})"
                 )
-            if shape is None:
-                shape, geo = img.shape, gmeta
-            elif img.shape != shape:
-                raise ValueError(f"{fp}: raster size {img.shape} != {shape}")
+        dates = sorted(by_date)
+        if len(dates) == 1:
+            per_band = {b: read_band(by_date[dates[0]][b], b) for b in needed}
+        else:
+            # stack the year's acquisitions and medoid-composite on device
+            from land_trendr_tpu.ops.composite import medoid_composite
+            from land_trendr_tpu.ops.indices import DEFAULT_QA_REJECT
+
+            stacks = {}
+            for b in needed:
+                imgs = [read_band(by_date[d][b], b) for d in dates]
+                # within-year uniformity: np.stack would silently promote
+                # a mixed int16/uint16 year to int32 (same hazard
+                # _check_year_dtype blocks across years)
+                dtypes = sorted({str(a.dtype) for a in imgs})
+                if b != "qa" and len(dtypes) > 1:
+                    raise ValueError(
+                        f"band {b!r}: mixed DN dtypes across year {year}'s "
+                        f"acquisitions {dtypes} — re-export the archive "
+                        "with one dtype"
+                    )
+                stacks[b] = np.stack(imgs)
+            comp_dn, comp_qa = medoid_composite(
+                {b: stacks[b] for b in needed if b != "qa"},
+                stacks["qa"],
+                reject_bits=(
+                    DEFAULT_QA_REJECT if reject_bits is None else reject_bits
+                ),
+                scale=scale,
+                offset=offset,
+            )
+            per_band = {**comp_dn, "qa": comp_qa}
+        for b in needed:
+            img = per_band[b]
             if b == "qa":
                 if qa_cube is None:
                     qa_cube = np.empty((len(years), *shape), np.uint16)
-                qa_cube[k] = img.astype(np.uint16, copy=False)
-            elif img.dtype in (np.dtype(np.int16), np.dtype(np.uint16)):
-                # keep the on-disk dtype: real C2 SR is uint16 with valid
-                # DNs up to 43636 — an int16 cast would wrap bright pixels
-                # (snow, cloud edge) negative with no error
+                qa_cube[k] = img
+            else:
                 if b not in dn_cubes:
                     dn_cubes[b] = np.empty((len(years), *shape), img.dtype)
                 else:
                     _check_year_dtype(b, dn_cubes[b], img)
                 dn_cubes[b][k] = img
-            else:
-                raise ValueError(
-                    f"{fp}: SR band dtype {img.dtype} unsupported "
-                    "(expected int16 or uint16 DNs)"
-                )
 
     assert qa_cube is not None  # needed bands are enforced per year
     return RasterStack(

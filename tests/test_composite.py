@@ -1,0 +1,180 @@
+"""Annual medoid compositing (ops/composite.py + C2 loader integration).
+
+Unit tests pin the selection semantics (masked median, distance argmin,
+first-index ties, fill on all-cloudy); the loader tests pin the
+multi-acquisition C2 path end to end, including the default loud error.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io.geotiff import GeoMeta, write_geotiff
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack_c2
+from land_trendr_tpu.ops.composite import medoid_composite, medoid_indices
+from land_trendr_tpu.runtime import load_stack_dir, load_stack_dir_c2
+
+
+def idx_of(vals, valid=None):
+    """medoid_indices on a (nd, px=1, nb=1) column."""
+    sr = np.asarray(vals, np.float32)[:, None, None]
+    v = np.ones(sr.shape[:2], bool) if valid is None else np.asarray(valid)[:, None]
+    c, ok = medoid_indices(sr, v)
+    return int(np.asarray(c)[0]), bool(np.asarray(ok)[0])
+
+
+def test_medoid_picks_median_observation():
+    assert idx_of([0.0, 1.0, 10.0]) == (1, True)
+
+
+def test_medoid_tie_breaks_to_first():
+    # sorted [0,2,2] -> median 2; dates 1 and 2 both at distance 0
+    assert idx_of([0.0, 2.0, 2.0]) == (1, True)
+
+
+def test_medoid_excludes_invalid_dates():
+    # date 1 invalid: median of {0,10} = 5, both remaining tie -> first valid
+    assert idx_of([0.0, 1.0, 10.0], valid=[True, False, True]) == (0, True)
+
+
+def test_medoid_all_invalid_flags_pixel():
+    assert idx_of([1.0, 2.0, 3.0], valid=[False, False, False]) == (0, False)
+
+
+def test_medoid_multiband_distance():
+    # band sums decide: date0 = (0,0), date1 = (3,3), date2 = (4,4)
+    # median = (3,3) -> date1 exact
+    sr = np.asarray(
+        [[[0.0, 0.0]], [[3.0, 3.0]], [[4.0, 4.0]]], np.float32
+    )  # (3, 1, 2)
+    c, ok = medoid_indices(sr, np.ones((3, 1), bool))
+    assert int(np.asarray(c)[0]) == 1
+
+
+def test_medoid_composite_copies_observation():
+    """Composite values come verbatim from the chosen acquisition; QA is
+    the chosen date's QA; all-cloudy pixels get the fill QA."""
+    rng = np.random.default_rng(5)
+    nd, h, w = 3, 4, 4
+    base = rng.integers(7500, 9000, (h, w)).astype(np.uint16)
+    dn = {
+        "nir": np.stack([base, base, base + 500]),
+        "swir2": np.stack([base + 1, base + 1, base + 700]),
+    }
+    qa = np.zeros((nd, h, w), np.uint16)  # all clear
+    qa[0, 0, 0] = 1 << 3  # date0 cloudy at (0,0)
+    qa[:, 1, 1] = 1 << 3  # all dates cloudy at (1,1)
+
+    out_dn, out_qa = medoid_composite(dn, qa)
+    # typical pixel: dates 0/1 identical and median -> first (date 0)
+    assert out_dn["nir"][2, 2] == base[2, 2]
+    assert out_dn["nir"].dtype == np.uint16
+    # (0,0): date0 excluded; among {1,2} tie -> date1 -> still base
+    assert out_dn["nir"][0, 0] == base[0, 0]
+    assert out_qa[0, 0] == 0
+    # (1,1): nothing valid -> fill QA, DN 0
+    assert out_qa[1, 1] == 1 and out_dn["nir"][1, 1] == 0
+    # chosen QA propagates (clear everywhere else)
+    assert (out_qa[2:, :] == 0).all()
+
+
+def test_medoid_excludes_saturated_qa_clear_dates():
+    """A QA-clear but radiometrically saturated acquisition (reflectance
+    outside [0,1] — sr_valid_mask's job in the segmentation feed) must not
+    win the medoid over a usable acquisition."""
+    nd, h, w = 2, 2, 2
+    sat = np.full((h, w), 60000, np.uint16)       # 60000*2.75e-5-0.2 = 1.45
+    good = np.full((h, w), 20000, np.uint16)      # 0.35 reflectance
+    dn = {"nir": np.stack([sat, good]), "swir2": np.stack([sat, good])}
+    qa = np.zeros((nd, h, w), np.uint16)          # both QA-clear
+    out_dn, out_qa = medoid_composite(dn, qa)
+    np.testing.assert_array_equal(out_dn["nir"], good)
+    assert (out_qa == 0).all()
+
+
+def test_c2_mixed_dtype_within_year_rejected(tmp_path):
+    """One year with an int16 and a uint16 acquisition must error loudly,
+    not silently promote the composite stack to int32."""
+    d = str(tmp_path / "arc")
+    os.makedirs(d)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 500000.0, 5000000.0, 0.0),
+    )
+    base = np.full((4, 4), 9000, np.int16)
+    for date, dtype in (("20100610", np.int16), ("20100712", np.uint16)):
+        stem = f"LT05_L2SP_045030_{date}_{date}_02_T1"
+        for n in (4, 7):
+            write_geotiff(
+                os.path.join(d, f"{stem}_SR_B{n}.TIF"),
+                base.astype(dtype), geo=geo,
+            )
+        write_geotiff(
+            os.path.join(d, f"{stem}_QA_PIXEL.TIF"),
+            np.zeros((4, 4), np.uint16), geo=geo,
+        )
+    with pytest.raises(ValueError, match="mixed DN dtypes across year"):
+        load_stack_dir_c2(d, bands=("nir", "swir2"), composite="medoid")
+
+
+def _write_multidate_archive(d, h=6, w=8):
+    """Year 2010 with 3 acquisitions (2 identical + 1 outlier), year 2011
+    with 1.  Returns the base DN grid for assertions."""
+    os.makedirs(d, exist_ok=True)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 500000.0, 5000000.0, 0.0),
+    )
+    rng = np.random.default_rng(9)
+    base = rng.integers(7500, 9000, (h, w)).astype(np.int16)
+    nums = {"nir": 4, "swir2": 7}  # TM numbering (LT05)
+    qa_clear = np.zeros((h, w), np.uint16)
+    qa_cloud = np.full((h, w), 1 << 3, np.uint16)
+
+    def write_acq(date, dn_delta, qa):
+        stem = f"LT05_L2SP_045030_{date}_{date}_02_T1"
+        for b, n in nums.items():
+            write_geotiff(
+                os.path.join(d, f"{stem}_SR_B{n}.TIF"),
+                (base + dn_delta).astype(np.int16), geo=geo,
+            )
+        write_geotiff(os.path.join(d, f"{stem}_QA_PIXEL.TIF"), qa, geo=geo)
+
+    write_acq("20100610", 0, qa_clear)
+    write_acq("20100712", 0, qa_clear)
+    write_acq("20100830", 500, qa_cloud)  # outlier AND cloudy everywhere
+    write_acq("20110715", 7, qa_clear)
+    return base
+
+
+def test_c2_multidate_requires_composite(tmp_path):
+    d = str(tmp_path / "arc")
+    _write_multidate_archive(d)
+    with pytest.raises(ValueError, match="composite"):
+        load_stack_dir_c2(d, bands=("nir", "swir2"))
+
+
+def test_c2_medoid_composite_end_to_end(tmp_path):
+    d = str(tmp_path / "arc")
+    base = _write_multidate_archive(d)
+    s = load_stack_dir(d, bands=("nir", "swir2"), composite="medoid")
+    np.testing.assert_array_equal(s.years, [2010, 2011])
+    # 2010 composite = the identical clear acquisitions' values
+    np.testing.assert_array_equal(s.dn_bands["nir"][0], base)
+    assert (np.asarray(s.qa[0]) == 0).all()
+    # 2011 passthrough (single acquisition)
+    np.testing.assert_array_equal(s.dn_bands["nir"][1], base + 7)
+    # composite rejected for the pre-stacked layout and for bad values
+    with pytest.raises(ValueError, match="not None"):
+        load_stack_dir_c2(d, composite="mean")
+
+
+def test_composite_rejected_for_prestacked(tmp_path):
+    from land_trendr_tpu.io.synthetic import write_stack
+
+    scene = make_stack(SceneSpec(width=8, height=6, year_start=2010, year_end=2012))
+    d = str(tmp_path / "stacked")
+    write_stack(d, scene)
+    with pytest.raises(ValueError, match="pre-stacked"):
+        load_stack_dir(d, composite="medoid")
