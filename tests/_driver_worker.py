@@ -1,7 +1,9 @@
 """Worker process for the true multi-process DRIVER test.
 
 Run as: ``python _driver_worker.py <coordinator> <num_procs> <proc_id>
-<workdir> <summary_json>``.  Each worker owns 4 virtual CPU devices.  The
+<workdir> <summary_json> [size] [tile]``.  Each worker owns 4 virtual CPU
+devices (``size``/``tile`` default to the test's tiny 48×40/20 scene;
+``tools/multihost_bench.py`` passes larger ones for its artifact).  The
 worker joins the ``jax.distributed`` cluster, builds the SAME deterministic
 synthetic stack as its peers, and calls the real production entry point —
 ``run_stack`` with a LOCAL device mesh over a SHARED workdir.  Inside
@@ -29,6 +31,8 @@ def main() -> int:
         sys.argv[4],
         sys.argv[5],
     )
+    size = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+    tile = int(sys.argv[7]) if len(sys.argv) > 7 else 20
 
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
@@ -39,13 +43,16 @@ def main() -> int:
     assert jax.process_count() == num_procs
 
     mesh = make_mesh(jax.local_devices())  # local chips; tiles cross hosts
-    scene = make_stack(
-        SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    spec = (
+        SceneSpec(width=size, height=size, year_start=1990, year_end=2013, seed=11)
+        if size
+        else SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
     )
+    scene = make_stack(spec)
     rs = stack_from_synthetic(scene)
     cfg = RunConfig(
         params=LTParams(max_segments=4, vertex_count_overshoot=2),
-        tile_size=20,  # 2×3 grid → 6 tiles, 3 per process
+        tile_size=tile,  # default: 2×3 grid → 6 tiles, 3 per process
         workdir=workdir,
         out_dir=workdir + "_out",
     )

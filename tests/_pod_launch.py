@@ -1,0 +1,109 @@
+"""Shared launcher for true multi-process ``jax.distributed`` pod runs.
+
+Used by the two-process tests in ``tests/test_multihost.py`` and by
+``tools/multihost_bench.py`` so the ephemeral-port pick, process reaping,
+and bind-race retry classification live in exactly one place.
+
+The bind/close/reuse port pick is a TOCTOU race — another process can
+claim the port between the probe's ``close()`` and worker 0's bind — so
+that outcome raises :class:`PodBindRace` for the caller to retry on a
+fresh port; any other failure raises ``RuntimeError`` with the worker's
+stderr tail.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Callable, Sequence
+
+__all__ = ["PodBindRace", "launch_pod", "pod_env"]
+
+
+class PodBindRace(RuntimeError):
+    """A worker lost the ephemeral-port race; retry on a fresh port."""
+
+
+def pod_env(devices_per_proc: int = 4) -> dict:
+    """Env for a worker: N virtual CPU devices + repo on PYTHONPATH."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices_per_proc}"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _launch_once(
+    worker: str,
+    argv_for: Callable[[int], Sequence[str]],
+    n_procs: int,
+    env: dict,
+    timeout: float,
+) -> None:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coordinator = f"localhost:{port}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, *map(str, argv_for(i))],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(n_procs)
+    ]
+
+    def reap_all() -> None:
+        for q in procs:
+            if q.poll() is None:
+                # the sibling may still be dialing a coordinator that will
+                # never exist — kill it before any retry races it on outputs
+                q.kill()
+            q.communicate()  # drain pipes so nothing blocks on PIPE
+
+    for i, p in enumerate(procs):
+        try:
+            _, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            reap_all()
+            raise RuntimeError(f"worker {i} timed out after {timeout:.0f}s")
+        if p.returncode != 0:
+            reap_all()
+            lowered = err.lower()
+            if "address already in use" in lowered or "bind" in lowered:
+                raise PodBindRace(f"worker {i} lost the port race")
+            raise RuntimeError(f"worker {i} failed:\n{err[-4000:]}")
+
+
+def launch_pod(
+    worker: str,
+    argv_for: Callable[[int], Sequence[str]],
+    n_procs: int = 2,
+    env: dict | None = None,
+    timeout: float = 600.0,
+    attempts: int = 3,
+    before_attempt: Callable[[], None] | None = None,
+) -> None:
+    """Run ``n_procs`` workers to completion, retrying port races.
+
+    ``argv_for(i)`` returns process ``i``'s argv AFTER the coordinator
+    address (which is always argv[1]).  ``before_attempt`` (if given) runs
+    before every attempt — e.g. to reset a shared workdir a failed
+    attempt may have partially written.
+    """
+    env = pod_env() if env is None else env
+    last: Exception | None = None
+    for _ in range(attempts):
+        if before_attempt is not None:
+            before_attempt()
+        try:
+            _launch_once(worker, argv_for, n_procs, env, timeout)
+            return
+        except PodBindRace as e:
+            last = e
+    raise RuntimeError(f"all {attempts} coordinator port attempts raced") from last

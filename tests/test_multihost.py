@@ -135,73 +135,12 @@ def test_two_process_distributed_matches_single(tmp_path):
     each: init_distributed → host_share → feed_global → sharded segment →
     gather_local_rows, per-process rows vs a single-process run."""
     import os
-    import socket
-    import subprocess
-    import sys
+
+    from tests._pod_launch import launch_pod
 
     worker = os.path.join(os.path.dirname(__file__), "_distributed_worker.py")
-
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("PYTHONPATH", "")
-    env["PYTHONPATH"] = (
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        + os.pathsep
-        + env["PYTHONPATH"]
-    )
-
     outs = [str(tmp_path / f"worker{i}.npz") for i in range(2)]
-
-    def launch_once() -> tuple[bool, str]:
-        """One full launch on a fresh ephemeral port.  Returns (retryable,
-        error).  The bind/close/reuse port pick is a TOCTOU race — another
-        process can claim the port before worker 0 binds it — so a
-        bind-failure outcome is retried by the caller on a new port."""
-        with socket.socket() as s:
-            s.bind(("localhost", 0))
-            port = s.getsockname()[1]
-        coordinator = f"localhost:{port}"
-        procs = []
-        for i in range(2):
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, worker, coordinator, "2", str(i), outs[i]],
-                    env=env,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                    text=True,
-                )
-            )
-        def reap_all() -> None:
-            for q in procs:
-                if q.poll() is None:
-                    q.kill()
-                q.communicate()  # drain pipes so nothing blocks on PIPE
-
-        for i, p in enumerate(procs):
-            try:
-                _, err = p.communicate(timeout=600)
-            except subprocess.TimeoutExpired:
-                reap_all()
-                return False, f"worker {i} timed out"
-            if p.returncode != 0:
-                # the sibling is still dialing a coordinator that will never
-                # exist — kill it before the retry races it on outs[]
-                reap_all()
-                lowered = err.lower()
-                retryable = "address already in use" in lowered or "bind" in lowered
-                return retryable, f"worker {i} failed:\n{err[-4000:]}"
-        return False, ""
-
-    for _attempt in range(3):
-        retryable, error = launch_once()
-        if not error:
-            break
-        if not retryable:
-            pytest.fail(error)
-    else:
-        pytest.fail(f"all port attempts raced: {error}")
+    launch_pod(worker, lambda i: ["2", str(i), outs[i]])
 
     # single-process reference on the SAME deterministic scene
     from tests._distributed_worker import make_scene
@@ -242,66 +181,19 @@ def test_two_process_driver_shares_tiles(tmp_path):
     all of them, and assembly (in this process) mosaics the full scene."""
     import json
     import os
-    import socket
-    import subprocess
-    import sys
+    import shutil
+
+    from tests._pod_launch import launch_pod
 
     worker = os.path.join(os.path.dirname(__file__), "_driver_worker.py")
     workdir = str(tmp_path / "shared_work")
-
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("PYTHONPATH", "")
-    env["PYTHONPATH"] = (
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        + os.pathsep
-        + env["PYTHONPATH"]
-    )
-
     summaries = [str(tmp_path / f"summary{i}.json") for i in range(2)]
-
-    def launch_once() -> tuple[bool, str]:
-        with socket.socket() as s:
-            s.bind(("localhost", 0))
-            port = s.getsockname()[1]
-        procs = [
-            subprocess.Popen(
-                [sys.executable, worker, f"localhost:{port}", "2", str(i),
-                 workdir, summaries[i]],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True,
-            )
-            for i in range(2)
-        ]
-
-        def reap_all() -> None:
-            for q in procs:
-                if q.poll() is None:
-                    q.kill()
-                q.communicate()
-
-        for i, p in enumerate(procs):
-            try:
-                _, err = p.communicate(timeout=600)
-            except subprocess.TimeoutExpired:
-                reap_all()
-                return False, f"worker {i} timed out"
-            if p.returncode != 0:
-                reap_all()
-                lowered = err.lower()
-                retryable = "address already in use" in lowered or "bind" in lowered
-                return retryable, f"worker {i} failed:\n{err[-4000:]}"
-        return False, ""
-
-    for _attempt in range(3):
-        retryable, error = launch_once()
-        if not error:
-            break
-        if not retryable:
-            pytest.fail(error)
-    else:
-        pytest.fail(f"all port attempts raced: {error}")
+    launch_pod(
+        worker,
+        lambda i: ["2", str(i), workdir, summaries[i]],
+        # a lost-port-race attempt may have part-written the shared workdir
+        before_attempt=lambda: shutil.rmtree(workdir, ignore_errors=True),
+    )
 
     # each process did exactly half the scene on its own 4-device mesh
     per_proc = [json.load(open(p)) for p in summaries]

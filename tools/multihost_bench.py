@@ -1,0 +1,148 @@
+"""Pod-flow artifact: a TRUE 2-process distributed driver run at scale.
+
+Launches two ``jax.distributed`` processes (4 virtual CPU devices each —
+one 8-device cluster over a localhost coordinator), each running the real
+production entry point ``run_stack`` with its LOCAL mesh over a SHARED
+workdir: ``host_share`` splits the tiles between processes, the shared
+manifest accumulates all of them (the v5e-pod flow of SURVEY.md §5 —
+tiles, not shards, cross hosts), and this parent process then assembles
+the full-scene rasters from the shared workdir and validates them
+pixel-for-pixel against a single-process single-device reference run.
+
+Writes MULTIHOST_r03.json.  Usage:
+    PYTHONPATH=. python tools/multihost_bench.py [--size 512] [--tile 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from tests._pod_launch import launch_pod  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--tile", type=int, default=128)
+    ap.add_argument("--out", default="MULTIHOST_r03.json")
+    ap.add_argument("--workroot", default=".multihost_bench")
+    args = ap.parse_args()
+    if args.size <= 0 or args.tile <= 0:
+        ap.error("--size and --tile must be positive")
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.geotiff import read_geotiff
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import (
+        RunConfig,
+        assemble_outputs,
+        run_stack,
+        stack_from_synthetic,
+    )
+
+    # a fresh workroot every run: resumed tiles would zero the throughput
+    # numbers (run_stack counts only freshly processed pixels) and stale
+    # manifests from an aborted attempt would poison the comparison
+    shutil.rmtree(args.workroot, ignore_errors=True)
+    os.makedirs(args.workroot)
+    shared = os.path.join(args.workroot, "shared_work")
+    summaries = [os.path.join(args.workroot, f"summary{i}.json") for i in range(2)]
+
+    worker = os.path.join(REPO, "tests", "_driver_worker.py")
+    t0 = time.perf_counter()
+    launch_pod(
+        worker,
+        lambda i: ["2", str(i), shared, summaries[i], str(args.size), str(args.tile)],
+        timeout=3600,
+        before_attempt=lambda: shutil.rmtree(shared, ignore_errors=True),
+    )
+    pod_wall = time.perf_counter() - t0
+    per_proc = [json.load(open(p)) for p in summaries]
+
+    spec = SceneSpec(
+        width=args.size, height=args.size, year_start=1990, year_end=2013, seed=11
+    )
+    rs = stack_from_synthetic(make_stack(spec))
+    params = LTParams(max_segments=4, vertex_count_overshoot=2)
+    cfg_pod = RunConfig(
+        params=params, tile_size=args.tile,
+        workdir=shared, out_dir=os.path.join(args.workroot, "pod_out"),
+    )
+    pod_paths = assemble_outputs(rs, cfg_pod)
+
+    # single-process single-device reference on the same scene
+    cfg_ref = RunConfig(
+        params=params, tile_size=args.tile,
+        workdir=os.path.join(args.workroot, "ref_work"),
+        out_dir=os.path.join(args.workroot, "ref_out"),
+    )
+    t0 = time.perf_counter()
+    run_stack(rs, cfg_ref)
+    ref_wall = time.perf_counter() - t0
+    ref_paths = assemble_outputs(rs, cfg_ref)
+
+    agreement = {}
+    for name in ("model_valid", "n_vertices", "vertex_years", "rmse"):
+        a, _, _ = read_geotiff(pod_paths[name])
+        b, _, _ = read_geotiff(ref_paths[name])
+        if name == "rmse":
+            same = np.isclose(a, b, rtol=1e-5, atol=1e-6)
+        else:
+            same = a == b
+        agreement[name] = round(float(np.mean(same)), 6)
+
+    # validate BEFORE writing: a failed run must not leave a
+    # complete-looking artifact on disk
+    total_px = sum(s["pixels"] for s in per_proc)
+    assert total_px == args.size * args.size, (total_px, args.size**2)
+    assert min(agreement.values()) > 0.999, agreement
+
+    rec = {
+        "description": (
+            "True 2-process jax.distributed DRIVER run (SURVEY.md §5 pod "
+            "flow scaled to localhost): each process runs run_stack on its "
+            "own 4-device local mesh over a SHARED workdir; host_share "
+            "splits tiles; assembly mosaics the union; rasters compared "
+            "pixel-for-pixel to a single-process single-device reference."
+        ),
+        "platform": "cpu (8 virtual devices across 2 processes)",
+        "scene": {"size": args.size, "years": 24, "tile": args.tile},
+        "pod": {
+            "wall_s": round(pod_wall, 1),
+            "per_process": [
+                {k: s[k] for k in ("pixels", "tiles_skipped_resume", "mesh_devices", "px_per_s")}
+                for s in per_proc
+            ],
+        },
+        "reference_wall_s": round(ref_wall, 1),
+        "raster_agreement_fraction": agreement,
+        "note": (
+            "mesh-vs-single-device execution may legally flip rare f32 "
+            "knife-edge decisions (ops/segment.py tolerance contract); "
+            "agreement is expected ~1.0 but not bit-contractual"
+        ),
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps(rec, indent=2))
+    shutil.rmtree(args.workroot, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
