@@ -76,7 +76,11 @@ def _launch_once(
             reap_all()
             lowered = err.lower()
             if "address already in use" in lowered or "bind" in lowered:
-                raise PodBindRace(f"worker {i} lost the port race")
+                # keep the stderr tail: if this classification misfires (or
+                # retries exhaust), the real error must still be readable
+                raise PodBindRace(
+                    f"worker {i} lost the port race:\n{err[-4000:]}"
+                )
             raise RuntimeError(f"worker {i} failed:\n{err[-4000:]}")
 
 

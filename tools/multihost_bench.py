@@ -106,10 +106,16 @@ def main() -> int:
         agreement[name] = round(float(np.mean(same)), 6)
 
     # validate BEFORE writing: a failed run must not leave a
-    # complete-looking artifact on disk
+    # complete-looking artifact on disk (explicit raises — a bare assert
+    # vanishes under python -O)
     total_px = sum(s["pixels"] for s in per_proc)
-    assert total_px == args.size * args.size, (total_px, args.size**2)
-    assert min(agreement.values()) > 0.999, agreement
+    if total_px != args.size * args.size:
+        raise RuntimeError(
+            f"pod processed {total_px} px, expected {args.size**2} "
+            "(resume-skipped tiles? stale workroot?)"
+        )
+    if min(agreement.values()) <= 0.999:
+        raise RuntimeError(f"raster agreement too low: {agreement}")
 
     rec = {
         "description": (

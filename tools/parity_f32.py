@@ -55,39 +55,13 @@ import numpy as np  # noqa: E402
 
 def make_population(px: int, ny: int, seed: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Mixed-regime synthetic series (disturbance/recovery, steps, trends,
-    spikes, noise) with realistic masking — float64 master copies."""
-    rng = np.random.default_rng(seed)
-    years = np.arange(1984, 1984 + ny, dtype=np.int32)
-    t = np.arange(ny, dtype=np.float64)[None, :]
-    kind = rng.integers(0, 5, size=(px, 1))
+    spikes, noise) with realistic masking — float64 master copies.  The
+    generator itself lives in tools/_population.py (shared with
+    parity_paramspace.py); this tool uses its defaults, which are this
+    function's historical literal values and RNG draw order."""
+    from _population import make_population as shared
 
-    base = rng.uniform(0.45, 0.75, size=(px, 1))
-    noise = rng.normal(0.0, 0.012, size=(px, ny))
-
-    d_year = rng.integers(4, ny - 4, size=(px, 1))
-    mag = rng.uniform(0.1, 0.5, size=(px, 1))
-    rec = rng.uniform(0.02, 0.15, size=(px, 1))
-    dt = np.maximum(t - d_year, 0.0)
-    disturbance = np.where(t >= d_year, mag * np.exp(-rec * dt), 0.0)
-
-    step = np.where(t >= d_year, mag, 0.0)
-    trend = rng.uniform(-0.01, 0.01, size=(px, 1)) * t
-    walk = np.cumsum(rng.normal(0, 0.03, size=(px, ny)), axis=1)
-
-    traj = base - np.where(
-        kind == 0, disturbance,
-        np.where(kind == 1, step,
-                 np.where(kind == 2, trend,
-                          np.where(kind == 3, walk * 0.2, 0.0))),
-    )
-    # sprinkle single-year spikes on ~20% of pixels
-    spike_rows = rng.uniform(size=(px, 1)) < 0.2
-    spike_col = rng.integers(0, ny, size=(px,))
-    spike_amp = rng.uniform(0.2, 0.8, size=(px,))
-    traj[np.arange(px), spike_col] += np.where(spike_rows[:, 0], spike_amp, 0.0)
-    traj += noise
-    mask = rng.uniform(size=(px, ny)) > 0.08
-    return years, -traj, mask  # disturbance-positive convention
+    return shared(np.random.default_rng(seed), px, ny)  # disturbance-positive convention
 
 
 def main() -> int:

@@ -1,0 +1,154 @@
+"""Joint parameter-space parity fuzz: random LTParams × random series.
+
+The suite's parity fuzz (tests/test_parity.py) randomizes series shape
+heavily but varies parameters one axis at a time around the defaults.
+This tool closes the gap for the north-star vertex-for-vertex contract:
+every trial draws a RANDOM JOINT parameter combination (segments,
+despike, overshoot, recovery constraints, selection thresholds, min-obs)
+plus a fresh mixed-regime pixel batch, runs the float64 kernel against
+the float64 oracle, and demands exact vertex agreement (indices, counts,
+model_valid) on every pixel.
+
+Writes PARITY_PARAMS_r03.json with the sampled space and any mismatch's
+full repro (trial seed + params).  Usage:
+    PYTHONPATH=. python tools/parity_paramspace.py [trials] [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # _population
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def sample_params(rng: np.random.Generator, ny: int):
+    """A random valid LTParams whose candidate capacity fits the series."""
+    from land_trendr_tpu.config import LTParams
+
+    max_segments = int(rng.integers(1, 7))
+    # keep candidate capacity comfortably under the year count
+    max_overshoot = max(0, min(4, ny - (max_segments + 1) - 4))
+    return LTParams(
+        max_segments=max_segments,
+        vertex_count_overshoot=int(rng.integers(0, max_overshoot + 1)),
+        spike_threshold=float(rng.uniform(0.3, 1.0)),
+        recovery_threshold=float(rng.choice([0.1, 0.25, 1.0, 10.0])),
+        prevent_one_year_recovery=bool(rng.integers(0, 2)),
+        p_val_threshold=float(rng.choice([0.01, 0.05, 0.15, 1.0])),
+        best_model_proportion=float(rng.uniform(0.3, 1.0)),
+        min_observations_needed=int(rng.integers(3, 11)),
+    )
+
+
+def make_batch(rng: np.random.Generator, px: int, ny: int):
+    """Mixed-regime float64 series via the shared generator
+    (tools/_population.py), with this tool's wider knobs: closer-to-edge
+    disturbance years, smaller minimum magnitudes, elementwise spikes,
+    and a per-trial random masking rate."""
+    from _population import make_population as shared
+
+    return shared(
+        rng, px, ny,
+        base_lo=0.4, base_hi=0.8, noise=0.01,
+        d_margin_lo=2, d_margin_hi=2,
+        mag_lo=0.05, rec_hi=0.2,
+        spike="elementwise",
+        mask_drop=float(rng.uniform(0.02, 0.35)),
+    )
+
+
+def main() -> int:
+    trials = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "PARITY_PARAMS_r03.json"
+    px = 64
+
+    from land_trendr_tpu.models import oracle
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    t0 = time.time()
+    total = 0
+    exact = 0
+    mismatches = []
+    for trial in range(trials):
+        rng = np.random.default_rng(1000 + trial)
+        ny = int(rng.choice([16, 24, 40]))
+        params = sample_params(rng, ny)
+        years, vals, mask = make_batch(rng, px, ny)
+
+        out = jax_segment_pixels(years, vals, mask, params)
+        vi = np.asarray(out.vertex_indices)
+        nv = np.asarray(out.n_vertices)
+        mv = np.asarray(out.model_valid)
+        for i in range(px):
+            ref = oracle.PixelSegmenter(params).segment(years, vals[i], mask[i])
+            ok = (
+                bool(ref.model_valid) == bool(mv[i])
+                and int(ref.n_vertices) == int(nv[i])
+                and np.array_equal(np.asarray(ref.vertex_indices), vi[i])
+            )
+            total += 1
+            exact += ok
+            if not ok and len(mismatches) < 10:
+                mismatches.append(
+                    {"trial": trial, "pixel": i, "ny": ny,
+                     "params": params.to_dict()}
+                )
+        if (trial + 1) % 16 == 0:
+            print(
+                f"  {trial + 1}/{trials} trials, {exact}/{total} exact "
+                f"({time.time() - t0:.0f}s)",
+                file=sys.stderr, flush=True,
+            )
+        if (trial + 1) % 8 == 0:
+            # every (params, ny) combo is a fresh kernel compilation; after
+            # ~80 accumulated executables XLA:CPU's LLVM engine dies with
+            # 'Cannot allocate memory' (JIT code region, not system RAM) —
+            # drop the caches, the next trial recompiles its own kernel
+            jax.clear_caches()
+
+    rec = {
+        "description": (
+            "Joint parameter-space parity fuzz: random LTParams "
+            "combinations x mixed-regime series, float64 kernel vs "
+            "float64 oracle, exact vertex_indices/n_vertices/model_valid "
+            "per pixel (north-star vertex-for-vertex contract)."
+        ),
+        "trials": trials,
+        "pixels_per_trial": px,
+        "pixels_total": total,
+        "exact": exact,
+        "exact_rate": exact / total,
+        "sampled_space": {
+            "max_segments": "1..6",
+            "vertex_count_overshoot": "0..4 (capped by ny)",
+            "spike_threshold": "[0.3, 1.0]",
+            "recovery_threshold": "{0.1, 0.25, 1.0, 10.0}",
+            "prevent_one_year_recovery": "{False, True}",
+            "p_val_threshold": "{0.01, 0.05, 0.15, 1.0}",
+            "best_model_proportion": "[0.3, 1.0]",
+            "min_observations_needed": "3..10",
+            "n_years": "{16, 24, 40}",
+        },
+        "mismatches": mismatches,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    print(json.dumps({k: rec[k] for k in ("pixels_total", "exact_rate", "elapsed_s")}))
+    return 0 if exact == total else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
