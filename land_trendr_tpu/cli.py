@@ -108,6 +108,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="collapse multi-acquisition years in a C2 "
                      "per-band archive to per-pixel QA-masked medoid "
                      "composites (default: require one acquisition/year)")
+    seg.add_argument("--out-overviews", default=0,
+                     type=lambda s: s if s == "auto" else int(s),
+                     help="overview pyramid levels on output rasters: an "
+                     "integer or 'auto' (until the smaller dimension "
+                     "drops under 256); default 0 = none")
     seg.add_argument("--trace", default=None, metavar="LOGDIR",
                      help="capture a jax.profiler device+host trace of the "
                      "run under LOGDIR (open with TensorBoard's profile "
@@ -357,6 +362,7 @@ def main(argv: list[str] | None = None) -> int:
             out_compress=args.out_compress,
             manifest_compress=args.manifest_compress,
             write_workers=args.write_workers,
+            out_overviews=args.out_overviews,
         )
         mesh = None
         if args.mesh:

@@ -91,6 +91,13 @@ class RunConfig:
     #: the writer pool instead.  Host memory stays bounded: at most
     #: ``write_workers + 2`` tiles are live at once.
     write_workers: int = 1
+    #: overview pyramid levels on output rasters (0 = none, N = that many
+    #: 2× reductions, "auto" = until the smaller dimension < 256) — the
+    #: gdaladdo-style reduced pages GIS viewers expect on scene-scale
+    #: rasters.  Nearest-neighbour decimation: several products are
+    #: categorical (model_valid, n_vertices, vertex slots), where
+    #: averaging would fabricate values.
+    out_overviews: int | str = 0
     #: transient-HBM bound for large tiles: tiles with more pixels than this
     #: run the segmentation through the chunked kernel (the kernel's working
     #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
@@ -112,6 +119,12 @@ class RunConfig:
             )
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
+        if self.out_overviews != "auto" and (
+            not isinstance(self.out_overviews, int) or self.out_overviews < 0
+        ):
+            raise ValueError(
+                f"out_overviews={self.out_overviews!r} must be >= 0 or 'auto'"
+            )
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -560,6 +573,12 @@ def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:
         elif mosaic.dtype == np.float64:
             mosaic = mosaic.astype(np.float32)
         path = os.path.join(cfg.out_dir, f"{name}.tif")
-        write_geotiff(path, mosaic, geo=stack.geo, compress=cfg.out_compress)
+        write_geotiff(
+            path,
+            mosaic,
+            geo=stack.geo,
+            compress=cfg.out_compress,
+            overviews=cfg.out_overviews,
+        )
         paths[name] = path
     return paths

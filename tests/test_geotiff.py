@@ -707,3 +707,69 @@ def test_corrupt_tile_geometry_rejected(tmp_path, rng):
     open(p, "wb").write(bytes(blob))
     with pytest.raises(ValueError, match="corrupt block geometry"):
         read_geotiff(p)
+
+
+def _walk_pages(path):
+    """(height, width, subfile_type) per IFD page, via raw chain walk."""
+    import struct
+
+    with open(path, "rb") as f:
+        data = f.read()
+    off = struct.unpack("<I", data[4:8])[0]
+    pages = []
+    while off:
+        n = struct.unpack("<H", data[off : off + 2])[0]
+        w = h = None
+        sub = 0
+        for i in range(n):
+            e = data[off + 2 + 12 * i : off + 14 + 12 * i]
+            tag, ftype, cnt = struct.unpack("<HHI", e[:8])
+            if tag == 256:
+                w = struct.unpack("<I", e[8:12])[0]
+            elif tag == 257:
+                h = struct.unpack("<I", e[8:12])[0]
+            elif tag == 254:
+                sub = struct.unpack("<I", e[8:12])[0]
+        pages.append((h, w, sub))
+        off = struct.unpack("<I", data[off + 2 + 12 * n : off + 6 + 12 * n])[0]
+    return pages
+
+
+def test_overview_pyramid_pages(tmp_path, rng):
+    """overviews=N appends N halved ReducedImage pages; the reader skips
+    them, so the full-resolution round trip is unchanged."""
+    a = rng.integers(-100, 4000, (2, 130, 97)).astype(np.int16)
+    p = str(tmp_path / "ov.tif")
+    write_geotiff(p, a, overviews=2, tile=64)
+    back, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(back, a)
+    pages = _walk_pages(p)
+    assert pages == [(130, 97, 0), (65, 49, 1), (33, 25, 1)]
+
+
+def test_overview_auto_and_resampling(tmp_path, rng):
+    """'auto' stops under 256; average-resampled overviews stay in dtype
+    and near the full-resolution local means."""
+    a = (np.arange(600 * 520, dtype=np.float32).reshape(1, 600, 520) % 97.0)
+    p = str(tmp_path / "ov_auto.tif")
+    write_geotiff(p, a, overviews="auto", resampling="average")
+    pages = _walk_pages(p)
+    # 'auto' halves until the smaller dimension drops under 256
+    assert [d[:2] for d in pages] == [(600, 520), (300, 260), (150, 130)]
+    back, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(back, a[0])  # single band reads 2-D
+    with pytest.raises(ValueError, match="resampling"):
+        write_geotiff(p, a, overviews=1, resampling="cubic")
+    with pytest.raises(ValueError, match="overviews"):
+        write_geotiff(p, a, overviews=-2)
+
+
+def test_overview_strips_and_single_page_unchanged(tmp_path, rng):
+    """Strip layout carries overviews too; overviews=0 writes a single
+    page byte-identical to the pre-overview writer's output shape."""
+    a = rng.integers(0, 255, (1, 70, 40)).astype(np.uint8)
+    p = str(tmp_path / "ov_strips.tif")
+    write_geotiff(p, a, overviews=1, tile=None)
+    assert [d[2] for d in _walk_pages(p)] == [0, 1]
+    back, _, _ = read_geotiff(p)
+    np.testing.assert_array_equal(back, a[0])  # single band reads 2-D

@@ -452,6 +452,24 @@ def test_writer_failure_fails_fast_parallel(tmp_path, rstack, monkeypatch):
         run_stack(rstack, cfg)
 
 
+def test_output_overviews(tmp_path, rstack):
+    """out_overviews appends ReducedImage pyramid pages to every product
+    raster; the reader (and therefore resume/change tooling) still sees
+    the full-resolution data."""
+    from tests.test_geotiff import _walk_pages
+
+    cfg = make_cfg(tmp_path, out_overviews=1)
+    run_stack(rstack, cfg)
+    paths = assemble_outputs(rstack, cfg)
+    pages = _walk_pages(paths["rmse"])
+    assert [p[:2] for p in pages] == [(40, 48), (20, 24)]
+    assert [p[2] for p in pages] == [0, 1]
+    rmse, _, _ = read_geotiff(paths["rmse"])
+    assert rmse.shape == (40, 48)
+    with pytest.raises(ValueError, match="out_overviews"):
+        RunConfig(out_overviews=-1)
+
+
 def test_manifest_compress_roundtrip(tmp_path):
     """Both artifact compressions round-trip bit-identically through
     np.load; 'deflate' actually shrinks the file; bad values are rejected
