@@ -136,14 +136,30 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pix.add_argument(
         "series",
+        nargs="?",
+        default=None,
         help="JSON file with {years: [...], values: [...], mask?: [...]}; "
         "'-' reads stdin; values use the index's natural sign with "
-        "--index, or are taken as-is (disturbance-positive) without it",
+        "--index, or are taken as-is (disturbance-positive) without it. "
+        "Omit when using --from-stack.",
     )
+    pix.add_argument("--from-stack", default=None, metavar="DIR",
+                     help="pull the series from a stack directory instead "
+                     "of JSON: computes --index at pixel (--x, --y) with "
+                     "the standard QA+range masking (debug a suspicious "
+                     "pixel of a real scene)")
+    pix.add_argument("--x", type=int, default=None, help="column (with --from-stack)")
+    pix.add_argument("--y", type=int, default=None, help="row (with --from-stack)")
+    pix.add_argument("--scale", type=float, default=2.75e-5,
+                     help="DN→reflectance scale for --from-stack (C2 default)")
+    pix.add_argument("--offset", type=float, default=-0.2,
+                     help="DN→reflectance offset for --from-stack (C2 default)")
     pix.add_argument("--engine", choices=("oracle", "jax", "both"),
                      default="both")
     pix.add_argument("--index", default=None, choices=INDEX_NAMES,
-                     help="flip sign per this index's disturbance convention")
+                     help="flip sign per this index's disturbance "
+                     "convention; with --from-stack it also selects the "
+                     "index to compute (defaulting to nbr)")
     _add_param_flags(pix)
 
     chg = sub.add_parser(
@@ -222,22 +238,58 @@ def _result_to_dict(res, sign: float = 1.0) -> dict:
     return out
 
 
+def _pixel_from_stack(args: argparse.Namespace):
+    """(years, natural-orientation series, mask) for one stack pixel,
+    through the SAME index/masking path the tile feed applies."""
+    import numpy as np
+
+    from land_trendr_tpu.ops import indices as idx
+    from land_trendr_tpu.runtime import load_stack_dir
+
+    if args.x is None or args.y is None:
+        raise SystemExit("--from-stack needs --x and --y")
+    index = (args.index or "nbr").lower()
+    stack = load_stack_dir(args.from_stack, bands=idx.required_bands(index))
+    h, w = stack.shape
+    if not (0 <= args.y < h and 0 <= args.x < w):
+        raise SystemExit(f"pixel ({args.x}, {args.y}) outside raster {w}x{h}")
+    dn = {
+        b: a[:, args.y, args.x] for b, a in stack.dn_bands.items()
+    }  # (NY,) per band
+    sr = {b: idx.scale_sr(v, args.scale, args.offset) for b, v in dn.items()}
+    qa = stack.qa[:, args.y, args.x]
+    mask = np.asarray(idx.qa_valid_mask(qa)) & np.asarray(idx.sr_valid_mask(sr))
+    # NATURAL orientation here: _run_pixel's shared sign handling applies
+    # the disturbance-positive flip exactly once, like the JSON path
+    series = np.asarray(
+        idx.compute_index(index, sr, disturbance_positive=False),
+        dtype=np.float64,
+    )
+    return stack.years, series, mask, index
+
+
 def _run_pixel(args: argparse.Namespace) -> int:
     """Single-pixel debug path: one series through oracle and/or kernel."""
     import numpy as np
 
-    if args.series == "-":
-        payload = json.load(sys.stdin)
+    if (args.series is None) == (args.from_stack is None):
+        raise SystemExit("pass exactly one of SERIES or --from-stack DIR")
+    if args.from_stack:
+        years, values, mask, index = _pixel_from_stack(args)
+        args.index = index  # sign handling below follows the JSON path
     else:
-        with open(args.series) as f:
-            payload = json.load(f)
-    years = np.asarray(payload["years"], dtype=np.int32)
-    values = np.asarray(payload["values"], dtype=np.float64)
-    mask = (
-        np.asarray(payload["mask"], dtype=bool)
-        if "mask" in payload
-        else np.isfinite(values)
-    )
+        if args.series == "-":
+            payload = json.load(sys.stdin)
+        else:
+            with open(args.series) as f:
+                payload = json.load(f)
+        years = np.asarray(payload["years"], dtype=np.int32)
+        values = np.asarray(payload["values"], dtype=np.float64)
+        mask = (
+            np.asarray(payload["mask"], dtype=bool)
+            if "mask" in payload
+            else np.isfinite(values)
+        )
     if years.shape != values.shape or years.shape != mask.shape:
         raise SystemExit("years/values/mask must have identical lengths")
     sign = 1.0

@@ -104,6 +104,42 @@ def test_pixel_command_parity(tmp_path, capsys):
     assert 10 in out["oracle"]["vertex_indices"]
 
 
+def test_pixel_from_stack(tmp_path, capsys):
+    """--from-stack pulls a real pixel's series through the standard
+    index/masking path and runs the parity engines on it."""
+    import json as _json
+
+    assert main(["synth", str(tmp_path / "stack"), "--size", "24",
+                 "--year-start", "1990", "--year-end", "2013"]) == 0
+    capsys.readouterr()
+    rc = main([
+        "pixel", "--from-stack", str(tmp_path / "stack"),
+        "--x", "5", "--y", "7", "--index", "nbr",
+        "--max-segments", "4", "--vertex-count-overshoot", "2",
+    ])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert "parity" in out and "oracle" in out and "jax" in out
+    assert len(out["oracle"]["fitted"]) == 24
+    # natural-orientation output: the BULK of a vegetated pixel's NBR
+    # series is positive (a bare max>0 would pass on a negated series too)
+    import numpy as np
+
+    assert np.median(out["oracle"]["despiked"]) > 0
+
+    # exactly one source; coordinates validated
+    import pytest
+
+    with pytest.raises(SystemExit):
+        main(["pixel", "--from-stack", str(tmp_path / "stack")])
+    with pytest.raises(SystemExit):
+        main(["pixel", "--from-stack", str(tmp_path / "stack"),
+              "--x", "999", "--y", "0"])
+    with pytest.raises(SystemExit):
+        main(["pixel", "a.json", "--from-stack", str(tmp_path / "stack"),
+              "--x", "1", "--y", "1"])
+
+
 def test_pixel_command_stdin_nofit(monkeypatch, capsys):
     """Insufficient observations → clean no-fit result via stdin."""
     import io as _io

@@ -773,3 +773,9 @@ def test_overview_strips_and_single_page_unchanged(tmp_path, rng):
     assert [d[2] for d in _walk_pages(p)] == [0, 1]
     back, _, _ = read_geotiff(p)
     np.testing.assert_array_equal(back, a[0])  # single band reads 2-D
+
+    p0 = str(tmp_path / "ov_none.tif")
+    write_geotiff(p0, a, overviews=0, tile=None)
+    assert _walk_pages(p0) == [(70, 40, 0)]  # default path: single page
+    back0, _, _ = read_geotiff(p0)
+    np.testing.assert_array_equal(back0, a[0])
