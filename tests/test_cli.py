@@ -180,3 +180,40 @@ def test_segment_trace_flag(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_kitchen_sink_cli_chain(tmp_path, capsys):
+    """synth → segment with every round-3 option engaged (band-subset
+    loader, FTV, parallel writers, uncompressed manifest, overview
+    pyramids) → change maps with filters + MMU: the cross-feature
+    interfaces hold in one chained run."""
+    import json as _json
+
+    assert main(["synth", str(tmp_path / "stack"), "--size", "64",
+                 "--year-start", "1990", "--year-end", "2013"]) == 0
+    capsys.readouterr()
+    assert main([
+        "segment", str(tmp_path / "stack"),
+        "--workdir", str(tmp_path / "work"),
+        "--out-dir", str(tmp_path / "out"),
+        "--tile-size", "32", "--ftv", "ndvi",
+        "--write-workers", "2", "--manifest-compress", "deflate",
+        "--out-overviews", "1",
+        "--max-segments", "4", "--vertex-count-overshoot", "2",
+    ]) == 0
+    seg_out = _json.loads(capsys.readouterr().out)
+    assert seg_out["summary"]["pixels"] == 64 * 64
+    assert "ftv_ndvi" in seg_out["outputs"]
+
+    assert main([
+        "change", str(tmp_path / "out"), "--dest", str(tmp_path / "chg"),
+        "--min-mag", "0.05", "--max-dur", "15", "--mmu", "3",
+    ]) == 0
+    chg_out = _json.loads(capsys.readouterr().out)
+    assert set(chg_out["outputs"]) == {
+        "mask", "yod", "mag", "dur", "rate", "preval", "dsnr"
+    }
+    from tests.test_geotiff import _walk_pages
+
+    # overview page rides on the segment rasters
+    assert [p[2] for p in _walk_pages(str(tmp_path / "out" / "rmse.tif"))] == [0, 1]
