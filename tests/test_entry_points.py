@@ -93,3 +93,29 @@ def test_bench_chain_mode_emits_single_json_line():
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["mode"] == "chain" and rec["chain_k"] == 3
     assert rec["value"] > 0
+
+
+def test_bench_chain_mode_through_chunked_kernel():
+    """Chain mode over the CHUNKED kernel — the exact configuration the
+    driver's round-end bench hits with its 1M-px default (px > chunk)."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={
+            "PATH": "/usr/bin:/bin",
+            "JAX_PLATFORMS": "cpu",
+            "LT_BENCH_PX": "1024",
+            "LT_BENCH_CHUNK": "256",
+            "LT_BENCH_YEARS": "12",
+            "LT_BENCH_REPS": "1",
+            "LT_BENCH_MODE": "chain",
+            "LT_BENCH_CHAIN_K": "2",
+        },
+        cwd=".",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["chunked"] is True and rec["mode"] == "chain"
+    assert rec["value"] > 0
