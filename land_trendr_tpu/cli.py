@@ -351,6 +351,12 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
+    # persistent compile cache shared with bench/parity/watchers so a CLI
+    # run inside a TPU window never pays an already-paid compile
+    from land_trendr_tpu.utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     if args.cmd == "params":
         print(_params_from_args(args).to_json())
         return 0

@@ -48,6 +48,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _platform_arg import pop_platform_arg  # noqa: E402
 
 jax.config.update("jax_platforms", pop_platform_arg())
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from land_trendr_tpu.utils.compilation_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -45,6 +45,10 @@ from _platform_arg import pop_platform_arg  # noqa: E402
 
 jax.config.update("jax_platforms", pop_platform_arg())
 
+from land_trendr_tpu.utils.compilation_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 
 def build_scope_map(hlo_text: str, scopes: tuple[str, ...]) -> dict[str, str]:
     """instruction name → first matching lt_* scope in its op_name."""

@@ -1,13 +1,24 @@
 #!/bin/bash
 # Second-stage TPU work, queued behind the bench watcher: the moment
-# BENCH_r03.json exists (bench_watch.sh got a throughput number inside an
-# availability window), use the next green window for the f32-vs-f64
-# parity artifact the north star cares about (tools/parity_f32.py
-# --f64-on-cpu: f32 pass on the chip, f64 reference on host CPU).
+# BENCH_r${LT_ROUND}.json holds a real accelerator number (bench_watch.sh
+# succeeded inside an availability window), use the next green window for
+# the f32-vs-f64 parity artifact the north star cares about
+# (tools/parity_f32.py --f64-on-cpu: f32 pass on the chip, f64 reference
+# on host CPU), then a TPU profile trace for the Pallas decision rule
+# (tools/profile_stages.py — see ops/segment.py "Performance choice").
+# Both inherit the persistent compile cache through their entry points.
 cd /root/repo
-LOG=/root/repo/BENCH_r03_attempts.log
+R="${LT_ROUND:-04}"
+LOG=/root/repo/BENCH_r${R}_attempts.log
+BENCH=/root/repo/BENCH_r${R}.json
 for i in $(seq 1 200); do
-  if [ ! -f /root/repo/BENCH_r03.json ]; then
+  # gate on a REAL bench success (device_platform != cpu), not mere file
+  # existence — rounds 1-3 committed rc=124 diagnostic artifacts too
+  if ! python -c "
+import json, sys
+r = json.load(open('$BENCH'))
+sys.exit(0 if r.get('device_platform') not in (None, 'cpu') and r.get('value', 0) > 0 else 1)
+" 2>/dev/null; then
     sleep 300
     continue
   fi
@@ -19,6 +30,27 @@ for i in $(seq 1 200); do
   if timeout 2400 python tools/parity_f32.py 65536 PARITY_f32_tpu.json \
        --f64-on-cpu >> "$LOG" 2>&1; then
     echo "[$(date -u +%FT%TZ)] followup: PARITY_f32_tpu.json written" >> "$LOG"
+    git -C /root/repo add PARITY_f32_tpu.json >> "$LOG" 2>&1 && \
+      git -C /root/repo commit -m "TPU-platform f32 parity artifact (watcher)" \
+        -- PARITY_f32_tpu.json >> "$LOG" 2>&1
+    # third-stage: a real TPU kernel profile (the artifact the Pallas
+    # decision rule in ops/segment.py waits on); best-effort.  Re-probe
+    # first (parity can take tens of minutes; the window may be gone) and
+    # accept only a record whose OWN platform field is non-cpu — the
+    # axon,cpu fallback must not be committed as a TPU profile.
+    PROF=PROFILE_tpu_r${R}.json
+    if timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1 \
+       && timeout 2400 python tools/profile_stages.py 65536 "$PROF" \
+            --platform=axon,cpu >>"$LOG" 2>&1 \
+       && python -c "
+import json, sys
+sys.exit(0 if json.load(open('$PROF')).get('platform') != 'cpu' else 1)
+" 2>/dev/null; then
+      echo "[$(date -u +%FT%TZ)] followup: $PROF written" >> "$LOG"
+      git -C /root/repo add "$PROF" >> "$LOG" 2>&1 && \
+        git -C /root/repo commit -m "TPU stage profile artifact (watcher)" \
+          -- "$PROF" >> "$LOG" 2>&1
+    fi
     exit 0
   fi
   echo "[$(date -u +%FT%TZ)] followup: parity attempt failed; will retry" >> "$LOG"
