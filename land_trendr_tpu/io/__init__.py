@@ -1,11 +1,18 @@
 """io subpackage: host-side raster I/O (GeoTIFF codec, synthetic stacks)."""
 
-from land_trendr_tpu.io.geotiff import GeoMeta, TiffInfo, read_geotiff, write_geotiff
+from land_trendr_tpu.io.geotiff import (
+    GeoMeta,
+    GeoTiffStreamWriter,
+    TiffInfo,
+    read_geotiff,
+    write_geotiff,
+)
 from land_trendr_tpu.io.synthetic import SceneSpec, SyntheticStack, make_stack, write_stack
 
 __all__ = [
     "GeoMeta",
     "TiffInfo",
+    "GeoTiffStreamWriter",
     "read_geotiff",
     "write_geotiff",
     "SceneSpec",

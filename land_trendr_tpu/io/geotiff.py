@@ -741,6 +741,16 @@ class _ClassicOverflow(Exception):
     """Encoded file does not fit classic TIFF's u32 addressing."""
 
 
+def _resolve_compress(compress: str | None) -> int:
+    if compress == "deflate":
+        return _COMP_DEFLATE_ADOBE
+    if compress == "lzw":
+        return _COMP_LZW
+    if compress in (None, "none"):
+        return _COMP_NONE
+    raise ValueError(f"unsupported compression {compress!r}")
+
+
 def _predict(block: np.ndarray) -> np.ndarray:
     """Apply horizontal differencing along the row axis (predictor 2)."""
     out = block.copy()
@@ -801,6 +811,76 @@ class _IfdBuilder:
                 overflow += payload + b"\0" * (len(payload) & 1)
         body += struct.pack("<" + ptr_fmt, next_off)
         return body + overflow
+
+
+def _page_ifd(
+    big: bool,
+    is_overview: bool,
+    pw: int,
+    ph: int,
+    spp: int,
+    bits: int,
+    fmt: int,
+    comp_id: int,
+    use_pred: bool,
+    tile: int | None,
+    offsets,
+    counts,
+    geo: "GeoMeta | None",
+    extra_ascii_tags: Mapping[int, str] | None,
+    ifd_off: int,
+    next_off: int,
+) -> bytes:
+    """Serialize one page's IFD (shared by the one-shot and streaming
+    writers).  Geo/extra tags belong to the full-resolution page only —
+    pass ``geo=None`` / ``extra_ascii_tags=None`` for overview pages.
+    Raises :class:`_ClassicOverflow` when a classic-layout pointer
+    overflows u32 (a 4 GB problem, not a tag-value problem)."""
+    ifd = _IfdBuilder(big)
+    if is_overview:
+        ifd.add(_T_NEW_SUBFILE_TYPE, 4, (1,))  # reduced-resolution page
+    ifd.add(_T_IMAGE_WIDTH, 4, (pw,))
+    ifd.add(_T_IMAGE_LENGTH, 4, (ph,))
+    ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
+    ifd.add(_T_COMPRESSION, 3, (comp_id,))
+    ifd.add(_T_PHOTOMETRIC, 3, (1,))  # BlackIsZero
+    ifd.add(_T_SAMPLES_PER_PIXEL, 3, (spp,))
+    ifd.add(_T_PLANAR_CONFIG, 3, (1,))
+    ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
+    if use_pred:
+        ifd.add(_T_PREDICTOR, 3, (2,))
+    off_type = 16 if big else 4  # LONG8 under BigTIFF
+    if tile:
+        ifd.add(_T_TILE_WIDTH, 3, (int(tile),))
+        ifd.add(_T_TILE_LENGTH, 3, (int(tile),))
+        ifd.add(_T_TILE_OFFSETS, off_type, offsets)
+        ifd.add(_T_TILE_BYTE_COUNTS, off_type, counts)
+    else:
+        ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
+        ifd.add(_T_STRIP_OFFSETS, off_type, offsets)
+        ifd.add(_T_STRIP_BYTE_COUNTS, off_type, counts)
+    if geo is not None:
+        if geo.pixel_scale:
+            ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
+        if geo.tiepoint:
+            ifd.add(_T_MODEL_TIEPOINT, 12, geo.tiepoint)
+        if geo.geo_key_directory:
+            ifd.add(_T_GEO_KEY_DIRECTORY, 3, geo.geo_key_directory)
+        if geo.geo_double_params:
+            ifd.add(_T_GEO_DOUBLE_PARAMS, 12, geo.geo_double_params)
+        if geo.geo_ascii_params:
+            ifd.add(_T_GEO_ASCII_PARAMS, 2, geo.geo_ascii_params)
+        if geo.nodata is not None:
+            ifd.add(_T_GDAL_NODATA, 2, ("%g" % geo.nodata))
+    for tag, text in (extra_ascii_tags or {}).items():
+        ifd.add(tag, 2, text)
+    try:
+        return ifd.serialize(ifd_off, next_off)
+    except struct.error as e:
+        if big:
+            raise  # not a 4 GB problem: bad tag values
+        # an out-of-line payload pointer overflowed classic's u32
+        raise _ClassicOverflow(str(e)) from e
 
 
 def _overview_pyramid(
@@ -883,14 +963,7 @@ def write_geotiff(
     arr = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
     spp, height, width = arr.shape
     fmt, bits = _DTYPE_TO_FORMAT[arr.dtype.newbyteorder("=")]
-    if compress == "deflate":
-        comp_id = _COMP_DEFLATE_ADOBE
-    elif compress == "lzw":
-        comp_id = _COMP_LZW
-    elif compress in (None, "none"):
-        comp_id = _COMP_NONE
-    else:
-        raise ValueError(f"unsupported compression {compress!r}")
+    comp_id = _resolve_compress(compress)
     use_pred = bool(predictor) and comp_id != _COMP_NONE and fmt in (1, 2)
 
     chunky = np.moveaxis(arr, 0, -1)  # (H, W, S)
@@ -938,52 +1011,24 @@ def write_geotiff(
         big: bool, page_i: int, ifd_off: int, next_off: int, offsets, counts
     ) -> bytes:
         ph, pw = page_shapes[page_i]
-        ifd = _IfdBuilder(big)
-        if page_i:
-            ifd.add(_T_NEW_SUBFILE_TYPE, 4, (1,))  # reduced-resolution page
-        ifd.add(_T_IMAGE_WIDTH, 4, (pw,))
-        ifd.add(_T_IMAGE_LENGTH, 4, (ph,))
-        ifd.add(_T_BITS_PER_SAMPLE, 3, (bits,) * spp)
-        ifd.add(_T_COMPRESSION, 3, (comp_id,))
-        ifd.add(_T_PHOTOMETRIC, 3, (1,))  # BlackIsZero
-        ifd.add(_T_SAMPLES_PER_PIXEL, 3, (spp,))
-        ifd.add(_T_PLANAR_CONFIG, 3, (1,))
-        ifd.add(_T_SAMPLE_FORMAT, 3, (fmt,) * spp)
-        if use_pred:
-            ifd.add(_T_PREDICTOR, 3, (2,))
-        off_type = 16 if big else 4  # LONG8 under BigTIFF
-        if tile:
-            ifd.add(_T_TILE_WIDTH, 3, (int(tile),))
-            ifd.add(_T_TILE_LENGTH, 3, (int(tile),))
-            ifd.add(_T_TILE_OFFSETS, off_type, offsets)
-            ifd.add(_T_TILE_BYTE_COUNTS, off_type, counts)
-        else:
-            ifd.add(_T_ROWS_PER_STRIP, 3, (64,))
-            ifd.add(_T_STRIP_OFFSETS, off_type, offsets)
-            ifd.add(_T_STRIP_BYTE_COUNTS, off_type, counts)
-        if geo and page_i == 0:  # georeferencing describes the full page
-            if geo.pixel_scale:
-                ifd.add(_T_MODEL_PIXEL_SCALE, 12, geo.pixel_scale)
-            if geo.tiepoint:
-                ifd.add(_T_MODEL_TIEPOINT, 12, geo.tiepoint)
-            if geo.geo_key_directory:
-                ifd.add(_T_GEO_KEY_DIRECTORY, 3, geo.geo_key_directory)
-            if geo.geo_double_params:
-                ifd.add(_T_GEO_DOUBLE_PARAMS, 12, geo.geo_double_params)
-            if geo.geo_ascii_params:
-                ifd.add(_T_GEO_ASCII_PARAMS, 2, geo.geo_ascii_params)
-            if geo.nodata is not None:
-                ifd.add(_T_GDAL_NODATA, 2, ("%g" % geo.nodata))
-        if page_i == 0:
-            for tag, text in (extra_ascii_tags or {}).items():
-                ifd.add(tag, 2, text)
-        try:
-            return ifd.serialize(ifd_off, next_off)
-        except struct.error as e:
-            if big:
-                raise  # not a 4 GB problem: bad tag values
-            # an out-of-line payload pointer overflowed classic's u32
-            raise _ClassicOverflow(str(e)) from e
+        return _page_ifd(
+            big,
+            page_i > 0,
+            pw,
+            ph,
+            spp,
+            bits,
+            fmt,
+            comp_id,
+            use_pred,
+            tile,
+            offsets,
+            counts,
+            geo if page_i == 0 else None,  # georeferencing: full page only
+            extra_ascii_tags if page_i == 0 else None,
+            ifd_off,
+            next_off,
+        )
 
     def layout(big: bool):
         """Exact file layout for one format choice: per-page block
@@ -1057,6 +1102,325 @@ def write_geotiff(
                     f.write(b"\0")
         for blob in ifd_blobs:
             f.write(blob)
+
+
+class _StreamLevel:
+    """Per-page bookkeeping for :class:`GeoTiffStreamWriter`: grid shape,
+    partially-filled block buffers, and the offset/count tables the IFD
+    needs at close."""
+
+    __slots__ = ("ph", "pw", "nby", "nbx", "partial", "filled", "offsets", "counts")
+
+    def __init__(self, ph: int, pw: int, tile: int) -> None:
+        self.ph, self.pw = ph, pw
+        self.nby = (ph + tile - 1) // tile
+        self.nbx = (pw + tile - 1) // tile
+        self.partial: dict[int, np.ndarray] = {}  # block idx -> (t, t, spp) buf
+        self.filled: dict[int, int] = {}  # block idx -> real pixels covered
+        self.offsets: list[int] = [0] * (self.nby * self.nbx)
+        self.counts: list[int] = [0] * (self.nby * self.nbx)
+
+    def real_area(self, idx: int, tile: int) -> int:
+        ty, tx = divmod(idx, self.nbx)
+        return min(tile, self.ph - ty * tile) * min(tile, self.pw - tx * tile)
+
+
+class GeoTiffStreamWriter:
+    """Incremental tiled GeoTIFF writer: windows in, blocks out, IFD at close.
+
+    The one-shot :func:`write_geotiff` needs the whole ``(bands, H, W)``
+    mosaic in host memory — fine at WRS-2 scene scale, impossible at the
+    CONUS ARD mosaic scale of BASELINE configs[4] (one float32 band at
+    ~9e9 px is ~36 GB).  This writer bounds host memory by O(open blocks):
+    callers push non-overlapping ``(h, w, bands)`` windows in any order;
+    every 256×256 block whose real coverage completes is compressed and
+    appended to the file immediately (native-batched), and ``close()``
+    writes the IFD chain at EOF and patches the header's first-IFD
+    pointer — a layout every TIFF reader follows (offsets are explicit;
+    nothing requires IFDs to precede data).
+
+    Overviews build incrementally: each window cascades a nearest-
+    decimated copy (global-parity aligned, so the result is pixel-
+    identical to :func:`write_geotiff`'s ``resampling="nearest"`` pyramid)
+    into the next level's block grid.  ``"average"`` resampling would need
+    neighbor rows across window boundaries, so it stays a one-shot-writer
+    feature.
+
+    Memory: completed blocks leave immediately; a partial block lives
+    until its real area is covered.  Row-major windows whose size is a
+    multiple of 256 complete every block they touch on arrival (zero
+    buffering); unaligned windows buffer at most one block-row per level.
+
+    BigTIFF choice: the exact-layout probe of the one-shot writer needs
+    every block encoded up front, which streaming exists to avoid — so
+    ``bigtiff="auto"`` here picks classic only when a *worst-case* encoded
+    bound (incompressible data through the chosen codec, plus IFD tables)
+    fits u32 addressing with margin.  The bound errs toward BigTIFF; both
+    layouts round-trip through :func:`read_geotiff`.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        height: int,
+        width: int,
+        bands: int,
+        dtype,
+        geo: GeoMeta | None = None,
+        compress: str = "deflate",
+        tile: int = 256,
+        predictor: bool = True,
+        extra_ascii_tags: Mapping[int, str] | None = None,
+        bigtiff: bool | str = "auto",
+        overviews: int | str = 0,
+        resampling: str = "nearest",
+        allow_partial: bool = False,
+    ) -> None:
+        dt = np.dtype(dtype)
+        if dt.newbyteorder("=") not in _DTYPE_TO_FORMAT:
+            raise ValueError(f"unsupported dtype {dt}")
+        if not tile or int(tile) <= 0:
+            raise ValueError("GeoTiffStreamWriter is tiled-only (tile >= 1)")
+        if resampling != "nearest":
+            raise ValueError(
+                "streaming overviews are nearest-only (average needs "
+                "cross-window neighbor rows); use write_geotiff for average"
+            )
+        self.path = path
+        self.height, self.width, self.spp = int(height), int(width), int(bands)
+        self.dtype = dt.newbyteorder("<")
+        self.fmt, self.bits = _DTYPE_TO_FORMAT[dt.newbyteorder("=")]
+        self.comp_id = _resolve_compress(compress)
+        self.tile = int(tile)
+        self.use_pred = bool(predictor) and self.comp_id != _COMP_NONE and self.fmt in (1, 2)
+        self.geo = geo
+        self.extra_ascii_tags = extra_ascii_tags
+        self.allow_partial = allow_partial
+
+        if overviews == "auto":
+            n_levels, d = 0, min(self.height, self.width)
+            while d >= 256:
+                n_levels += 1
+                d //= 2
+        else:
+            n_levels = int(overviews)
+            if n_levels < 0:
+                raise ValueError(f"overviews={overviews!r} must be >= 0 or 'auto'")
+        self.levels: list[_StreamLevel] = [
+            _StreamLevel(self.height, self.width, self.tile)
+        ]
+        ph, pw = self.height, self.width
+        for _ in range(n_levels):
+            if min(ph, pw) < 2:  # matches _overview_pyramid's stop rule
+                break
+            ph, pw = (ph + 1) // 2, (pw + 1) // 2
+            self.levels.append(_StreamLevel(ph, pw, self.tile))
+
+        self.big = self._pick_layout(bigtiff)
+        self._pending: list[tuple[int, int, np.ndarray]] = []  # (level, idx, buf)
+        self._closed = False
+        self._f: BinaryIO = open(path, "wb")
+        if self.big:
+            self._f.write(struct.pack("<2sHHHQ", b"II", 43, 8, 0, 0))
+            self._pos = 16
+        else:
+            self._f.write(struct.pack("<2sHI", b"II", 42, 0))
+            self._pos = 8
+
+    # -- layout ------------------------------------------------------------
+
+    def _pick_layout(self, bigtiff: bool | str) -> bool:
+        if bigtiff != "auto":
+            return bool(bigtiff)
+        t = self.tile
+        n_blocks = sum(lv.nby * lv.nbx for lv in self.levels)
+        raw_block = t * t * self.spp * self.dtype.itemsize
+        if self.comp_id == _COMP_DEFLATE_ADOBE:
+            # zlib worst case: stored blocks, ~5 bytes / 16 KB + header
+            worst_block = raw_block + raw_block // 1000 + 64
+        elif self.comp_id == _COMP_LZW:
+            # 12-bit codes for 8-bit-novel data: 1.5x + table resets
+            worst_block = raw_block * 3 // 2 + 64
+        else:
+            worst_block = raw_block + 1  # odd-length pad
+        ifd_bound = 4096 + 16 * n_blocks + 2 * len(self.levels) * 512
+        end = 16 + n_blocks * worst_block + ifd_bound
+        return end > 2**32 - 2**20
+
+    # -- write path --------------------------------------------------------
+
+    def write(self, y0: int, x0: int, window: np.ndarray) -> None:
+        """Scatter one non-overlapping ``(h, w)`` / ``(h, w, bands)`` window
+        (top-left at ``(y0, x0)``) into the block grids of every level."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        win = np.asarray(window)
+        if win.ndim == 2:
+            win = win[..., None]
+        if win.ndim != 3 or win.shape[2] != self.spp:
+            raise ValueError(
+                f"window must be (h, w) or (h, w, {self.spp}); got {win.shape}"
+            )
+        win = win.astype(self.dtype, copy=False)
+        for lvl_i, lvl in enumerate(self.levels):
+            h, w = win.shape[:2]
+            if h == 0 or w == 0:
+                break
+            if y0 + h > lvl.ph or x0 + w > lvl.pw or y0 < 0 or x0 < 0:
+                raise ValueError(
+                    f"window {win.shape} at ({y0},{x0}) exceeds level {lvl_i} "
+                    f"extent ({lvl.ph},{lvl.pw})"
+                )
+            self._scatter(lvl_i, y0, x0, win)
+            if lvl_i + 1 == len(self.levels):
+                break
+            # nearest cascade, global-parity aligned: level L+1 row r is
+            # global level-L row 2r, so keep local rows where (y0+i) is even
+            sy, sx = y0 & 1, x0 & 1
+            win = win[sy::2, sx::2]
+            y0, x0 = (y0 + sy) // 2, (x0 + sx) // 2
+        self._flush_pending()
+
+    def _scatter(self, lvl_i: int, y0: int, x0: int, win: np.ndarray) -> None:
+        lvl = self.levels[lvl_i]
+        t = self.tile
+        h, w = win.shape[:2]
+        for ty in range(y0 // t, (y0 + h - 1) // t + 1):
+            for tx in range(x0 // t, (x0 + w - 1) // t + 1):
+                idx = ty * lvl.nbx + tx
+                by, bx = ty * t, tx * t
+                ys, xs = max(y0, by), max(x0, bx)
+                ye, xe = min(y0 + h, by + t), min(x0 + w, bx + t)
+                buf = lvl.partial.get(idx)
+                if buf is None:
+                    if lvl.counts[idx] or lvl.filled.get(idx):
+                        raise ValueError(
+                            f"level {lvl_i} block {idx} written twice "
+                            "(windows must not overlap)"
+                        )
+                    buf = np.zeros((t, t, self.spp), dtype=self.dtype)
+                    lvl.partial[idx] = buf
+                buf[ys - by : ye - by, xs - bx : xe - bx] = win[
+                    ys - y0 : ye - y0, xs - x0 : xe - x0
+                ]
+                filled = lvl.filled.get(idx, 0) + (ye - ys) * (xe - xs)
+                lvl.filled[idx] = filled
+                if filled == lvl.real_area(idx, t):
+                    self._pending.append((lvl_i, idx, buf))
+                    del lvl.partial[idx]
+
+    def _flush_pending(self, force: bool = False) -> None:
+        if not self._pending or (len(self._pending) < _ENCODE_CHUNK and not force):
+            return
+        blobs = _encode_all(
+            (buf for _, _, buf in self._pending), self.comp_id, self.use_pred
+        )
+        for (lvl_i, idx, _), blob in zip(self._pending, blobs):
+            lvl = self.levels[lvl_i]
+            lvl.offsets[idx] = self._pos
+            lvl.counts[idx] = len(blob)
+            self._f.write(blob)
+            self._pos += len(blob)
+            if len(blob) & 1:  # keep offsets word-aligned
+                self._f.write(b"\0")
+                self._pos += 1
+        self._pending.clear()
+
+    # -- close -------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        try:
+            # incomplete = partially-touched AND never-touched blocks alike
+            incomplete = [
+                (i, idx)
+                for i, lvl in enumerate(self.levels)
+                for idx in range(lvl.nby * lvl.nbx)
+                if lvl.filled.get(idx, 0) != lvl.real_area(idx, self.tile)
+            ]
+            if incomplete and not self.allow_partial:
+                raise ValueError(
+                    f"{len(incomplete)} block(s) not fully covered at close "
+                    f"(first few: {incomplete[:5]}); pass allow_partial=True "
+                    "to zero-fill"
+                )
+            for lvl_i, idx in incomplete:
+                lvl = self.levels[lvl_i]
+                buf = lvl.partial.pop(
+                    idx, None
+                )  # never-touched blocks become all-zero
+                if buf is None:
+                    buf = np.zeros((self.tile, self.tile, self.spp), self.dtype)
+                self._pending.append((lvl_i, idx, buf))
+            self._flush_pending(force=True)
+
+            def build(ifd_positions: list[int]) -> list[bytes]:
+                blobs = []
+                for i, lvl in enumerate(self.levels):
+                    nxt = (
+                        ifd_positions[i + 1] if i + 1 < len(self.levels) else 0
+                    )
+                    blobs.append(
+                        _page_ifd(
+                            self.big,
+                            i > 0,
+                            lvl.pw,
+                            lvl.ph,
+                            self.spp,
+                            self.bits,
+                            self.fmt,
+                            self.comp_id,
+                            self.use_pred,
+                            self.tile,
+                            lvl.offsets,
+                            lvl.counts,
+                            self.geo if i == 0 else None,
+                            self.extra_ascii_tags if i == 0 else None,
+                            ifd_positions[i],
+                            nxt,
+                        )
+                    )
+                return blobs
+
+            # IFD blob lengths are offset-independent: measure, place, re-emit
+            sizes = [len(b) for b in build([0] * len(self.levels))]
+            positions, cur = [], self._pos
+            for s in sizes:
+                positions.append(cur)
+                cur += s
+            if not self.big and cur > 2**32 - 1:
+                raise ValueError(
+                    f"{self.path}: streamed file ends at {cur} bytes, past "
+                    "classic TIFF addressing — the bigtiff='auto' bound "
+                    "should have chosen BigTIFF; force bigtiff=True"
+                )
+            for blob in build(positions):
+                self._f.write(blob)
+            self._f.seek(8 if self.big else 4)
+            ptr = struct.pack("<Q" if self.big else "<I", positions[0])
+            self._f.write(ptr)
+        finally:
+            self._closed = True
+            self._f.close()
+
+    def abort(self) -> None:
+        """Release the file handle WITHOUT completeness checks or IFD
+        emission — for error paths that must not mask an in-flight
+        exception (the half-written file is left for the caller to
+        unlink)."""
+        if not self._closed:
+            self._closed = True
+            self._f.close()
+
+    def __enter__(self) -> "GeoTiffStreamWriter":
+        return self
+
+    def __exit__(self, exc_type, *_) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
 
 
 def _encode_block(block: np.ndarray, comp_id: int, use_pred: bool) -> bytes:
