@@ -104,10 +104,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="background tile-writer threads (scale up on "
                      "device-rate hosts; memory stays bounded at "
                      "write_workers+2 live tiles)")
+    seg.add_argument("--feed-workers", type=int, default=1,
+                     help="background tile-feed threads over the threaded "
+                     "native gather (~4.1M px/s each; ~3 sustain the 10M "
+                     "px/s target); prefetch depth is feed_workers+1")
     seg.add_argument("--composite", default=None, choices=("medoid",),
                      help="collapse multi-acquisition years in a C2 "
                      "per-band archive to per-pixel QA-masked medoid "
-                     "composites (default: require one acquisition/year)")
+                     "composites (default: require one acquisition/year). "
+                     "NOTE: medoid distance uses only the bands this run "
+                     "loads (e.g. nir+swir2 for NBR), not the standard "
+                     "6-band medoid — so the chosen acquisition can differ "
+                     "between runs with different --index/--ftv selections")
     seg.add_argument("--out-overviews", default=0,
                      type=lambda s: s if s == "auto" else int(s),
                      help="overview pyramid levels on output rasters: an "
@@ -420,6 +428,7 @@ def main(argv: list[str] | None = None) -> int:
             out_compress=args.out_compress,
             manifest_compress=args.manifest_compress,
             write_workers=args.write_workers,
+            feed_workers=args.feed_workers,
             out_overviews=args.out_overviews,
         )
         mesh = None

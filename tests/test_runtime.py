@@ -436,6 +436,41 @@ def test_parallel_writers_match_single(tmp_path, rstack):
         RunConfig(write_workers=0)
 
 
+def test_parallel_feeders_match_single(tmp_path, rstack):
+    """feed_workers=3 (prefetch depth 4) produces the same manifest +
+    rasters as the default: feeds are per-tile independent reads, only
+    their scheduling changes — and the bounded prefetch queue must still
+    consume every tile exactly once, in order."""
+    cfg1 = make_cfg(os.path.join(tmp_path, "a"))
+    cfg3 = make_cfg(os.path.join(tmp_path, "b"), feed_workers=3)
+    s1 = run_stack(rstack, cfg1)
+    s3 = run_stack(rstack, cfg3)
+    assert s1["pixels"] == s3["pixels"] and s1["fit_rate"] == s3["fit_rate"]
+    p1 = assemble_outputs(rstack, cfg1)
+    p3 = assemble_outputs(rstack, cfg3)
+    for name in ("rmse", "vertex_years", "model_valid"):
+        a, _, _ = read_geotiff(p1[name])
+        b, _, _ = read_geotiff(p3[name])
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="feed_workers"):
+        RunConfig(feed_workers=0)
+
+
+def test_feed_failure_aborts_run(tmp_path, rstack, monkeypatch):
+    """A feed error inside the worker pool propagates out of run_stack
+    (not swallowed by the executor) and the writer pool shuts down."""
+    import land_trendr_tpu.runtime.driver as drv
+
+    cfg = make_cfg(tmp_path, feed_workers=2)
+
+    def bad_feed(stack, t, tile_px, bands):
+        raise OSError("stack read failed (injected)")
+
+    monkeypatch.setattr(drv, "_feed_tile", bad_feed)
+    with pytest.raises(OSError, match="stack read failed"):
+        run_stack(rstack, cfg)
+
+
 def test_writer_failure_fails_fast_parallel(tmp_path, rstack, monkeypatch):
     """With several writer threads, a persistent artifact-write failure
     still aborts within a bounded number of tiles (backpressure collects

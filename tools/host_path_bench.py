@@ -109,6 +109,35 @@ def main() -> None:
             native._LIB = orig
             del os.environ["LT_NO_NATIVE"]
 
+    # --- feed scaling: the run_stack feed POOL's aggregate rate --------
+    # (VERDICT r3 item #3: the 2.4-cores-at-north-star feed budget must be
+    # code, not arithmetic — RunConfig.feed_workers is that code; this
+    # measures its aggregate throughput at several worker counts.  On a
+    # 1-core box the curve is flat by construction; on a device-rate host
+    # it scales with cores because the native gather releases the GIL.)
+    from concurrent.futures import ThreadPoolExecutor
+
+    grid = args.scene // TILE
+    feed_tiles = [
+        TileSpec(tile_id=i, y0=(i // grid) * TILE, x0=(i % grid) * TILE,
+                 h=TILE, w=TILE)
+        for i in range(min(8, grid * grid))
+    ]
+    scaling = {}
+    for workers in (1, 2, 4):
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            list(ex.map(lambda tt: _feed_tile(stack, tt, px, bands), feed_tiles))
+            t0 = time.perf_counter()
+            list(ex.map(lambda tt: _feed_tile(stack, tt, px, bands), feed_tiles))
+            sec = time.perf_counter() - t0
+        scaling[str(workers)] = round(len(feed_tiles) * px / sec, 1)
+    result["feed_scaling_px_s_aggregate"] = scaling
+    result["feed_scaling_note"] = (
+        f"aggregate px/s feeding {len(feed_tiles)} distinct tiles through "
+        "the RunConfig.feed_workers thread pool; flat on a 1-core box, "
+        "scales with cores where the threaded native gather has them"
+    )
+
     # --- real kernel payload for the write stage ----------------------
     dn, qa = _feed_tile(stack, t, px, bands)
     out = process_tile_dn(np.asarray(stack.years, np.int32), dn, qa,
@@ -127,6 +156,20 @@ def main() -> None:
         sec = time_fn(lambda: m.record(0, arrays, {}, compress=mode), reps=3)
         add(f"write.{mode}", sec, payload, px)
         sizes[mode] = os.path.getsize(m.tile_path(0))
+    if native.available():
+        # the 'none' row above used the native store-zip writer; measure
+        # the Python np.savez fallback too so the artifact records both
+        # (single-core ~parity is EXPECTED — the native writer's value is
+        # releasing the GIL through the payload so write_workers scale)
+        m = TileManifest(os.path.join(workdir, "none_py"), "b" * 16)
+        m.open(resume=False)
+        orig = native._LIB
+        native._LIB = None
+        try:
+            sec = time_fn(lambda: m.record(0, arrays, {}, compress="none"), reps=3)
+        finally:
+            native._LIB = orig
+        add("write.none_python_fallback", sec, payload, px)
 
     def zlib6():
         np.savez_compressed(os.path.join(workdir, "z6.npz"), **arrays)
