@@ -215,17 +215,24 @@ def _run_chained(dev, px: int, ny: int, reps: int, k: int) -> float:
 
     years = jax.device_put(years_np, dev)
     mask = jax.device_put(mask_np, dev)
-    # every rep gets a DISTINCT input (tiny masked-safe offset, transferred
-    # before timing starts): byte-identical (program, inputs) replays are
-    # exactly what a caching tunnel runtime could service without running
-    # anything, and best-of-reps would then select the bogus rep
-    vals_reps = [
-        jax.device_put(vals_np + np.float32(1e-6) * i, dev)
-        for i in range(reps + 1)
-    ]
+    vals0 = jax.device_put(vals_np, dev)
 
-    # warm-up: compile + first chain; float() is the sync (see docstring)
-    r = float(chained(years, vals_reps[0], mask, k))
+    # every rep gets a DISTINCT input (tiny masked-safe offset): byte-
+    # identical (program, inputs) replays are exactly what a caching tunnel
+    # runtime could service without running anything, and best-of-reps
+    # would then select the bogus rep.  The offset is applied ON DEVICE
+    # (ADVICE r3: pre-placing reps+1 full batches held ~640 MB HBM at the
+    # default 1M px × 40 y, shrinking the largest runnable batch), so at
+    # most two copies are ever resident: the base and one derived input.
+    @jax.jit
+    def perturb(v, i):
+        return v + jnp.float32(1e-6) * i
+
+    # warm-up: compile both programs + first chain; float() is the sync
+    # (see docstring).  The timed window includes one perturb (elementwise,
+    # O(px·ny) — noise against K full kernel applications, and the chain
+    # value is documented as a lower bound anyway).
+    r = float(chained(years, perturb(vals0, 0), mask, k))
     if not np.isfinite(r):
         raise RuntimeError("warm-up chain produced non-finite probe")
     _mark_warmup_done()
@@ -233,7 +240,7 @@ def _run_chained(dev, px: int, ny: int, reps: int, k: int) -> float:
     best = float("inf")
     for i in range(reps):
         t0 = time.perf_counter()
-        r = float(chained(years, vals_reps[i + 1], mask, k))
+        r = float(chained(years, perturb(vals0, i + 1), mask, k))
         best = min(best, time.perf_counter() - t0)
         if not np.isfinite(r):
             raise RuntimeError("timed chain produced non-finite probe")

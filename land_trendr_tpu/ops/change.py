@@ -172,13 +172,76 @@ def select_change(
     }
 
 
+def _run_lengths_arange(lengths: np.ndarray) -> np.ndarray:
+    """``[0..l0-1, 0..l1-1, ...]`` without a Python loop."""
+    csum = np.cumsum(lengths)
+    ids = np.arange(int(csum[-1]), dtype=np.int64)
+    return ids - np.repeat(csum - lengths, lengths)
+
+
+def label4(mask: np.ndarray) -> tuple[np.ndarray, int]:
+    """4-connected component labeling, pure NumPy (no scipy dependency —
+    ADVICE r3: the lazy ``scipy.ndimage`` import was the repo's only
+    undeclared dependency).
+
+    Two-pass run-based algorithm: horizontal True-runs are found
+    vectorized from row-wise sign changes; a union-find merges runs that
+    overlap column-wise in adjacent rows (4-connectivity); pixels are
+    painted from run labels vectorized.  Python-side work is O(runs +
+    overlaps) on run *endpoints* — never per pixel.
+
+    Returns ``(labels, n)`` with background 0 and components 1..n,
+    matching ``scipy.ndimage.label`` with the 4-connected structure.
+    """
+    h, w = mask.shape
+    d = np.diff(
+        np.pad(mask.astype(np.int8), ((0, 0), (1, 1))), axis=1
+    )  # (h, w+1)
+    starts = np.argwhere(d == 1)
+    if len(starts) == 0:
+        return np.zeros((h, w), np.int32), 0
+    rows, s = starts[:, 0], starts[:, 1]
+    e = np.argwhere(d == -1)[:, 1]  # row-major ⇒ pairs with starts 1:1
+    n = len(s)
+
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]  # path halving
+            i = parent[i]
+        return i
+
+    row_start = np.searchsorted(rows, np.arange(h + 1))
+    for r in range(1, h):
+        a0, a1 = row_start[r - 1], row_start[r]
+        b0, b1 = row_start[r], row_start[r + 1]
+        if a0 == a1 or b0 == b1:
+            continue
+        # runs within a row are sorted and disjoint: run a overlaps run b
+        # iff  s_a < e_b  and  e_a > s_b  — a contiguous index range
+        lo = np.searchsorted(e[a0:a1], s[b0:b1], side="right")
+        hi = np.searchsorted(s[a0:a1], e[b0:b1], side="left")
+        for j in range(b1 - b0):
+            for ai in range(lo[j], hi[j]):
+                ra, rb = find(a0 + ai), find(b0 + j)
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+
+    roots = np.fromiter((find(i) for i in range(n)), np.int64, n)
+    _, lab = np.unique(roots, return_inverse=True)
+    lengths = e - s
+    flat_idx = np.repeat(rows * w + s, lengths) + _run_lengths_arange(lengths)
+    out = np.zeros(h * w, np.int32)
+    out[flat_idx] = np.repeat(lab.astype(np.int32) + 1, lengths)
+    return out.reshape(h, w), int(lab.max()) + 1
+
+
 def mmu_sieve(mask: np.ndarray, mmu: int) -> np.ndarray:
     """Drop 4-connected changed patches smaller than ``mmu`` pixels."""
     if mmu <= 1:
         return mask
-    from scipy import ndimage
-
-    labels, n = ndimage.label(mask, structure=[[0, 1, 0], [1, 1, 1], [0, 1, 0]])
+    labels, n = label4(np.asarray(mask))
     if n == 0:
         return mask
     counts = np.bincount(labels.ravel())

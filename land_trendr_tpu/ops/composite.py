@@ -112,9 +112,17 @@ def medoid_composite(
             idx.qa_valid_mask(qa_flat[:, start:end], reject_bits=reject_bits)
             & idx.sr_valid_mask(scaled)
         )
+        n_real = end - start
+        if start and n_real < chunk_px:
+            # pad the ragged FINAL chunk (fully masked, sliced off below) so
+            # one compiled shape serves the whole loop — otherwise every
+            # distinct raster size costs an extra XLA compile (ADVICE r3)
+            pad = chunk_px - n_real
+            sr = np.pad(sr, ((0, 0), (0, pad), (0, 0)))
+            valid = np.pad(valid, ((0, 0), (0, pad)))
         c, o = medoid_indices(jnp.asarray(sr, jnp.float32), jnp.asarray(valid))
-        choice[start:end] = np.asarray(c)
-        ok[start:end] = np.asarray(o)
+        choice[start:end] = np.asarray(c)[:n_real]
+        ok[start:end] = np.asarray(o)[:n_real]
 
     out_dn = {}
     for b in bands:

@@ -69,6 +69,33 @@ than XLA today.  The stage-level named_scopes keep the door open: if a
 TPU profile ever shows one stage dominated by layout/fusion overheads
 rather than math, that stage is the Pallas candidate, and the f64 oracle
 parity suite defines exactly what any such kernel must reproduce.
+
+**The TPU-profile trigger for that revisit is mechanical, not a
+judgment call** (VERDICT r3 next-round item #7 — the paragraph above is
+reasoned from CPU profiles only).  Recipe, runnable inside any hardware
+window (``tools/tpu_followup.sh`` runs it automatically after a bench
+success)::
+
+    python tools/profile_stages.py 65536 PROFILE_tpu_rNN.json \
+        --platform=axon,cpu
+
+Decision rule, applied to the resulting record: prototype a stage in
+Pallas IF AND ONLY IF either
+
+(a) the stage's TPU ``stage_share`` exceeds 1.5× its CPU share
+    (PROFILE_r03.json is the CPU baseline) AND the excess is carried by
+    layout/copy/transpose fusions rather than math — visible as
+    ``fusion``/``copy``/``transpose`` entries for that stage in the HLO
+    dump the tool prints with ``LT_PROFILE_DUMP_HLO=1``; or
+(b) ``unmapped_kernel_s`` + runtime spans exceed 30% of
+    ``kernel_attributed_s`` — overhead no stage owns, i.e. scheduling/
+    layout glue a fused Pallas pipeline would collapse.
+
+Any Pallas prototype must pass ``tests/test_parity.py`` and the
+parameter-space suite in f64 mode bit-for-bit and keep every
+``tests/test_f32_quality.py`` gate; otherwise the prototype is rejected
+regardless of speed.  If neither trigger fires on a real TPU profile,
+the no-Pallas decision above stands as *measured*, not assumed.
 """
 
 from __future__ import annotations

@@ -130,6 +130,35 @@ def test_mmu_sieve_4_connectivity():
     assert mmu_sieve(m, 1).sum() == m.sum()
 
 
+def test_label4_matches_scipy_reference():
+    """The pure-NumPy run-based labeler (ADVICE r3: drop the undeclared
+    scipy dependency) must agree with scipy.ndimage.label component-for-
+    component on random masks — same partition, same count (label NUMBERING
+    may differ; compare via component pixel sets through a relabel)."""
+    ndimage = pytest.importorskip("scipy.ndimage")
+    from land_trendr_tpu.ops.change import label4
+
+    rng = np.random.default_rng(77)
+    structure = [[0, 1, 0], [1, 1, 1], [0, 1, 0]]
+    for density in (0.05, 0.35, 0.65, 0.95):
+        m = rng.uniform(size=(61, 83)) < density
+        got, n_got = label4(m)
+        ref, n_ref = ndimage.label(m, structure=structure)
+        assert n_got == n_ref
+        assert (got > 0).sum() == (ref > 0).sum() == m.sum()
+        # same partition: each got-label maps to exactly one ref-label and
+        # vice versa
+        pairs = np.unique(np.stack([got[m], ref[m]]), axis=1)
+        assert pairs.shape[1] == n_got
+        assert len(np.unique(pairs[0])) == n_got
+        assert len(np.unique(pairs[1])) == n_ref
+    # degenerate shapes
+    assert label4(np.zeros((4, 5), bool))[1] == 0
+    one = np.ones((1, 7), bool)
+    lab, n = label4(one)
+    assert n == 1 and (lab == 1).all()
+
+
 def test_end_to_end_change_maps(tmp_path):
     spec = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
     synth = make_stack(spec)

@@ -96,3 +96,48 @@ def test_f32_exact_vertex_agreement_floor(rng):
     )
     rate = agree.mean()
     assert rate >= 0.999, f"f32 exact-vertex agreement {rate:.4%} below floor"
+
+
+def test_f32_tail_magnitude(rng):
+    """Gate the f32 error tail's MAGNITUDE, not just its frequency
+    (VERDICT r3 weak #3: a kernel change could keep ≥99.9% exact agreement
+    while fattening the numerical tail on the agreeing pixels, and nothing
+    would fail).
+
+    Among pixels whose vertex decisions agree exactly with f64, the
+    fitted-trajectory and rmse deltas are pure rounding accumulation.
+    Measured on this test's own deterministic population (8192 px,
+    consistent with PARITY_f32.json's 1M-px artifact: fitted p99 1.1e-6):
+
+        fitted |Δ|: p99 9.4e-7, p99.9 2.2e-6, max 7.3e-6
+        rmse   |Δ|: p99 9.2e-8, p99.9 4.6e-7, max 2.2e-6
+
+    Gates sit ~4× above the measured values — far below any
+    physically-meaningful reflectance difference (1 DN ≈ 2.75e-5), yet
+    tight enough that an extra rounding stage (e.g. a reordered
+    accumulation or a dropped compensated sum) fails loudly."""
+    px = 8192
+    years, vals, mask = _mixed_population(rng, px)
+    params = LTParams()
+    out64 = jax_segment_pixels(years, vals, mask, params)
+    out32 = jax_segment_pixels(years, vals.astype(np.float32), mask, params)
+
+    agree = (
+        (np.asarray(out64.model_valid) == np.asarray(out32.model_valid))
+        & (np.asarray(out64.n_vertices) == np.asarray(out32.n_vertices))
+        & (np.asarray(out64.vertex_indices) == np.asarray(out32.vertex_indices)).all(
+            axis=1
+        )
+    )
+    assert agree.mean() >= 0.999  # population sanity; the floor test owns this
+
+    d_fit = np.abs(
+        np.asarray(out32.fitted, np.float64) - np.asarray(out64.fitted)
+    )[agree]
+    d_rmse = np.abs(
+        np.asarray(out32.rmse, np.float64) - np.asarray(out64.rmse)
+    )[agree]
+    assert np.quantile(d_fit, 0.99) < 4e-6, "fitted-trajectory p99 tail fattened"
+    assert np.quantile(d_fit, 0.999) < 1e-5, "fitted-trajectory p99.9 tail fattened"
+    assert np.quantile(d_rmse, 0.99) < 5e-7, "rmse p99 tail fattened"
+    assert np.quantile(d_rmse, 0.999) < 2e-6, "rmse p99.9 tail fattened"

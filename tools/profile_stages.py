@@ -116,6 +116,12 @@ def main() -> int:
     compiled = jax.jit(jax_segment_pixels, static_argnums=3).lower(
         years, vals, mask, params
     ).compile()
+    if os.environ.get("LT_PROFILE_DUMP_HLO"):
+        # the optimized HLO the Pallas decision rule inspects for layout/
+        # copy/transpose fusions (ops/segment.py "TPU-profile trigger")
+        with open(out_path + ".hlo.txt", "w") as f:
+            f.write(compiled.as_text())
+        print(f"profile_stages: HLO dumped to {out_path}.hlo.txt", file=sys.stderr)
     scope_map = build_scope_map(compiled.as_text(), tuple(STAGE_SCOPES))
     print(
         f"profile_stages: {len(scope_map)} instructions mapped to stages",
