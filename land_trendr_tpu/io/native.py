@@ -30,6 +30,7 @@ __all__ = [
     "decode_blocks",
     "encode_blocks",
     "gather_tile",
+    "write_store_zip",
     "NativeCodecError",
 ]
 
@@ -40,7 +41,7 @@ _ERR_NAMES = {
     -4: "block data out of file bounds / short",
     -5: "corrupt LZW stream",
 }
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 
 class NativeCodecError(RuntimeError):
@@ -101,6 +102,12 @@ def _declare(lib: ctypes.CDLL) -> None:
         u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p,
         ctypes.c_int,
+    ]
+    u8pp = ctypes.POINTER(u8p)
+    lib.lt_write_store_zip.restype = ctypes.c_int
+    lib.lt_write_store_zip.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        u8pp, u64p, u8pp, u64p, u8pp, u64p, ctypes.c_int,
     ]
 
 
@@ -250,3 +257,61 @@ def gather_tile(
     if rc != 0:
         raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))
     return out
+
+
+def write_store_zip(
+    path: str, arrays: dict[str, np.ndarray], *, n_threads: int = 0
+) -> None:
+    """Write ``arrays`` as a STORE-mode ``.npz`` through the native writer.
+
+    ``np.load`` reads the result like any ``np.savez`` output; the .npy
+    member headers are rendered here (tiny) and the C++ side computes
+    member CRC32s threaded and streams one sequential buffered write —
+    the manifest write stage without Python's ``zipfile`` byte-shuffling
+    or the GIL in the hot path (HOSTPATH_r03.json: the store-mode write
+    was the single most core-hungry host stage at the north-star rate).
+
+    Raises :class:`NativeCodecError` when the library is absent or the
+    payload needs zip64 (any member or the file ≥ 4 GB) — callers fall
+    back to ``np.savez``/``zipfile``.
+    """
+    if _LIB is None:
+        raise NativeCodecError("native library not loaded")
+    import io as _io
+
+    names: list[bytes] = []
+    heads: list[np.ndarray] = []
+    datas: list[np.ndarray] = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        buf = _io.BytesIO()
+        np.lib.format.write_array_header_1_0(
+            buf, np.lib.format.header_data_from_array_1_0(arr)
+        )
+        names.append(f"{name}.npy".encode("ascii"))
+        # write_array_header_1_0 emits magic + version + header already
+        heads.append(np.frombuffer(buf.getvalue(), dtype=np.uint8))
+        datas.append(arr.view(np.uint8).reshape(-1))
+
+    n = len(names)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    name_bufs = [np.frombuffer(b, dtype=np.uint8) for b in names]
+
+    def ptr_array(bufs):
+        return (u8p * n)(*[_u8(b) for b in bufs])
+
+    def len_array(bufs):
+        return np.array([b.size for b in bufs], dtype=np.uint64)
+
+    name_lens, head_lens, data_lens = (
+        len_array(name_bufs), len_array(heads), len_array(datas)
+    )
+    rc = _LIB.lt_write_store_zip(
+        path.encode(), n,
+        ptr_array(name_bufs), _u64(name_lens),
+        ptr_array(heads), _u64(head_lens),
+        ptr_array(datas), _u64(data_lens),
+        n_threads,
+    )
+    if rc != 0:
+        raise NativeCodecError(_ERR_NAMES.get(rc, f"error {rc}"))

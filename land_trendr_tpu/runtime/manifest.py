@@ -25,6 +25,8 @@ from typing import Iterator
 
 import numpy as np
 
+from land_trendr_tpu.io import native
+
 __all__ = ["TileManifest", "run_fingerprint"]
 
 #: valid tile-artifact compression choices (see :meth:`TileManifest.record`)
@@ -42,8 +44,24 @@ def _write_npz(path: str, arrays: dict[str, np.ndarray], compress: str) -> None:
     ``"deflate"`` uses zlib level 1 (~2.3× faster than level 6, within a
     few % of its size on real segmentation outputs) for runs where the
     workdir lives on constrained storage.
+
+    The ``"none"`` path routes through the native store-zip writer when
+    the library is built: threaded CRC32 + one sequential buffered C
+    write that never touches the GIL mid-payload, so several
+    ``RunConfig.write_workers`` threads can be inside their artifacts
+    simultaneously on multi-core hosts (Python's zipfile re-acquires the
+    GIL between every chunked write/CRC call).  Single-core throughput is
+    ~parity with ``np.savez`` — the point is pool scaling, not one
+    thread.  Falls back to ``np.savez`` (identical readers) when the
+    library is absent or the artifact would need zip64.
     """
     if compress == "none":
+        if native.available():
+            try:
+                native.write_store_zip(path, arrays)
+                return
+            except native.NativeCodecError:
+                pass  # zip64-scale artifact or transient failure
         np.savez(path, **arrays)
         return
     with zipfile.ZipFile(

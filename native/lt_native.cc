@@ -17,8 +17,10 @@
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC, links zlib + pthread)
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 #include <unordered_map>
@@ -345,8 +347,9 @@ extern "C" {
 
 // ABI version — bump on any signature or behaviour-surface change (v3 added
 // LZW decode; v4 added a compression arg to lt_encode_blocks for LZW
-// encode; v5 adds lt_gather_tile); the ctypes binding checks it.
-int lt_native_abi_version() { return 5; }
+// encode; v5 adds lt_gather_tile; v6 adds lt_write_store_zip); the ctypes
+// binding checks it.
+int lt_native_abi_version() { return 6; }
 
 // Gather one tile window into device-feed layout: a (NY, H, W) cube's
 // window (y0, x0, h, w) becomes the (h*w, NY) array the kernel wants —
@@ -486,6 +489,139 @@ int lt_encode_blocks(uint8_t* blocks, int n_blocks, int compression,
 
 uint64_t lt_deflate_bound(uint64_t n) {
   return compressBound(static_cast<uLong>(n));
+}
+
+// Write a STORE-mode (method 0) ZIP from pre-assembled members — the
+// manifest's per-tile .npz artifact without Python's zipfile in the hot
+// path.  Each member i is the concatenation of a prefix (the .npy header
+// the Python side renders) and a payload (the raw array bytes); CRC32 runs
+// threaded across members (zlib crc32 releases nothing — there is no GIL
+// here — and it is the only non-I/O cost of a stored zip), then one
+// sequential buffered pass writes local headers + data + central
+// directory.  Classic (non-zip64) layout only: any member or the whole
+// file reaching u32 limits returns kErrBadArg and the caller falls back to
+// Python's zipfile (which force-flags zip64).  np.load reads the result
+// like any np.savez output.
+//
+//   path                        output file (created/truncated; caller
+//                               handles atomic-rename)
+//   n                           member count
+//   name_ptrs/name_lens         member names (ASCII, include ".npy")
+//   head_ptrs/head_lens         per-member prefix bytes
+//   data_ptrs/data_lens         per-member payload bytes
+//   n_threads                   CRC threading (0 = hardware)
+int lt_write_store_zip(const char* path, int n,
+                       const uint8_t* const* name_ptrs,
+                       const uint64_t* name_lens,
+                       const uint8_t* const* head_ptrs,
+                       const uint64_t* head_lens,
+                       const uint8_t* const* data_ptrs,
+                       const uint64_t* data_lens, int n_threads) {
+  constexpr uint64_t kU32Max = 0xFFFFFFFFull;
+  constexpr uint64_t kU16Max = 0xFFFFull;
+  // classic zip only: the EOCD member counts are u16, so n past that must
+  // fall back to Python's zipfile (zip64), not truncate silently
+  if (!path || n <= 0 || static_cast<uint64_t>(n) > kU16Max)
+    return kErrBadArg;
+
+  std::vector<uint64_t> sizes(n), offsets(n);
+  std::vector<uint32_t> crcs(n);
+  uint64_t pos = 0;
+  for (int i = 0; i < n; ++i) {
+    if (!name_ptrs[i] || name_lens[i] == 0 || name_lens[i] > kU16Max)
+      return kErrBadArg;
+    sizes[i] = head_lens[i] + data_lens[i];
+    if (sizes[i] > kU32Max) return kErrBadArg;
+    offsets[i] = pos;
+    pos += 30 + name_lens[i] + sizes[i];  // local header + name + data
+    if (pos > kU32Max) return kErrBadArg;
+  }
+
+  int rc = run_blocks(n, n_threads, [&](int i) -> int {
+    uLong c = crc32(0L, Z_NULL, 0);
+    // crc32's uInt length caps each call at 4 GB-1; sizes[i] <= u32 max,
+    // but chunk anyway so the bound never binds
+    const uint8_t* parts[2] = {head_ptrs[i], data_ptrs[i]};
+    const uint64_t lens[2] = {head_lens[i], data_lens[i]};
+    for (int p = 0; p < 2; ++p) {
+      uint64_t done = 0;
+      while (done < lens[p]) {
+        uInt step = static_cast<uInt>(
+            std::min<uint64_t>(lens[p] - done, 1u << 30));
+        c = crc32(c, parts[p] + done, step);
+        done += step;
+      }
+    }
+    crcs[i] = static_cast<uint32_t>(c);
+    return kOk;
+  });
+  if (rc != kOk) return rc;
+
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return kErrBadArg;
+  std::vector<uint8_t> big_buf(1 << 20);
+  std::setvbuf(f, reinterpret_cast<char*>(big_buf.data()), _IOFBF,
+               big_buf.size());
+
+  auto put16 = [&](uint32_t v) {
+    uint8_t b[2] = {static_cast<uint8_t>(v), static_cast<uint8_t>(v >> 8)};
+    std::fwrite(b, 1, 2, f);
+  };
+  auto put32 = [&](uint32_t v) {
+    uint8_t b[4] = {static_cast<uint8_t>(v), static_cast<uint8_t>(v >> 8),
+                    static_cast<uint8_t>(v >> 16),
+                    static_cast<uint8_t>(v >> 24)};
+    std::fwrite(b, 1, 4, f);
+  };
+
+  for (int i = 0; i < n; ++i) {
+    put32(0x04034b50);          // local file header
+    put16(20); put16(0); put16(0);  // version, flags, method=store
+    put16(0); put16(0);         // mod time/date (fixed: reproducible files)
+    put32(crcs[i]);
+    put32(static_cast<uint32_t>(sizes[i]));  // compressed == uncompressed
+    put32(static_cast<uint32_t>(sizes[i]));
+    put16(static_cast<uint32_t>(name_lens[i]));
+    put16(0);                   // extra len
+    std::fwrite(name_ptrs[i], 1, name_lens[i], f);
+    if (head_lens[i]) std::fwrite(head_ptrs[i], 1, head_lens[i], f);
+    if (data_lens[i]) std::fwrite(data_ptrs[i], 1, data_lens[i], f);
+  }
+  const uint64_t cd_off = pos;
+  uint64_t cd_size = 0;
+  for (int i = 0; i < n; ++i) {
+    put32(0x02014b50);          // central directory header
+    put16(20); put16(20); put16(0); put16(0);  // made-by, need, flags, method
+    put16(0); put16(0);         // time/date
+    put32(crcs[i]);
+    put32(static_cast<uint32_t>(sizes[i]));
+    put32(static_cast<uint32_t>(sizes[i]));
+    put16(static_cast<uint32_t>(name_lens[i]));
+    put16(0); put16(0);         // extra, comment
+    put16(0); put16(0);         // disk, internal attrs
+    put32(0);                   // external attrs
+    put32(static_cast<uint32_t>(offsets[i]));
+    std::fwrite(name_ptrs[i], 1, name_lens[i], f);
+    cd_size += 46 + name_lens[i];
+  }
+  if (cd_off + cd_size + 22 > kU32Max) {  // end record offsets must fit too
+    std::fclose(f);
+    std::remove(path);
+    return kErrBadArg;
+  }
+  put32(0x06054b50);            // end of central directory
+  put16(0); put16(0);
+  put16(static_cast<uint32_t>(n));
+  put16(static_cast<uint32_t>(n));
+  put32(static_cast<uint32_t>(cd_size));
+  put32(static_cast<uint32_t>(cd_off));
+  put16(0);                     // comment len
+  if (std::fflush(f) != 0 || std::ferror(f)) {
+    std::fclose(f);
+    std::remove(path);
+    return kErrDeflate;  // an I/O failure, surfaced as a generic write error
+  }
+  return std::fclose(f) == 0 ? kOk : kErrDeflate;
 }
 
 }  // extern "C"
