@@ -122,3 +122,72 @@ def test_structured_corruption_never_crashes_unhandled(
         read_geotiff(q)
     except ValueError:
         pass
+
+
+@st.composite
+def window_partitions(draw):
+    """A raster plus a random rectangular partition of it: random column
+    cuts per row-band, so windows are ragged, unaligned, and exhaustive."""
+    h = draw(st.integers(1, 80))
+    w = draw(st.integers(1, 80))
+    bands = draw(st.integers(1, 3))
+    seed = draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 256, size=(h, w, bands)).astype(np.uint8)
+
+    def cuts(n, lo=1, hi=40):
+        out, pos = [0], 0
+        while pos < n:
+            pos = min(n, pos + int(rng.integers(lo, hi + 1)))
+            out.append(pos)
+        return out
+
+    wins = []
+    ys = cuts(h)
+    for y0, y1 in zip(ys, ys[1:]):
+        xs = cuts(w)
+        for x0, x1 in zip(xs, xs[1:]):
+            wins.append((y0, x0, y1 - y0, x1 - x0))
+    order = rng.permutation(len(wins))
+    return arr, [wins[i] for i in order]
+
+
+@settings(
+    max_examples=30,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    data=window_partitions(),
+    compress=st.sampled_from(["deflate", "none"]),
+    tile=st.sampled_from([16, 64]),
+    overviews=st.sampled_from([0, 2]),
+)
+def test_stream_writer_partition_property(
+    tmp_path_factory, data, compress, tile, overviews
+):
+    """ANY exhaustive rectangular partition, pushed in ANY order, decodes
+    identically to the one-shot writer — including the overview pages
+    (checked via the multi-page walker, since read_geotiff skips them)."""
+    from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
+
+    from test_geotiff import _walk_pages
+
+    arr, wins = data
+    h, w, bands = arr.shape
+    d = tmp_path_factory.mktemp("sprop")
+    ps, po = str(d / "s.tif"), str(d / "o.tif")
+    with GeoTiffStreamWriter(
+        ps, h, w, bands, np.uint8, compress=compress, tile=tile,
+        overviews=overviews,
+    ) as wr:
+        for y0, x0, wh, ww in wins:
+            wr.write(y0, x0, arr[y0 : y0 + wh, x0 : x0 + ww])
+    write_geotiff(
+        po, np.moveaxis(arr, -1, 0), compress=compress, tile=tile,
+        overviews=overviews, resampling="nearest",
+    )
+    got_s, _, _ = read_geotiff(ps)
+    got_o, _, _ = read_geotiff(po)
+    np.testing.assert_array_equal(got_s, got_o)
+    assert _walk_pages(ps) == _walk_pages(po)
