@@ -211,6 +211,18 @@ def build_parser() -> argparse.ArgumentParser:
     syn.add_argument("--year-start", type=int, default=1984)
     syn.add_argument("--year-end", type=int, default=2023)
     syn.add_argument("--seed", type=int, default=20260729)
+
+    inf = sub.add_parser(
+        "info",
+        help="inspect rasters header-only (the gdalinfo seam): shape, "
+        "dtype, layout, compression, georeferencing — no pixel decode, "
+        "O(tags) even on a multi-GB mosaic",
+    )
+    inf.add_argument("paths", nargs="+", help="GeoTIFF file(s)")
+    inf.add_argument("--window", default=None, metavar="Y0,X0,H,W",
+                     help="also decode this window and report value stats "
+                     "(min/max/mean over finite samples) — a bounded-memory "
+                     "spot check on rasters too big to read whole")
     return p
 
 
@@ -345,6 +357,52 @@ def _run_pixel(args: argparse.Namespace) -> int:
     return 0
 
 
+def _run_info(args) -> int:
+    """Header-only raster inspection; one JSON document for all paths."""
+    import numpy as np
+
+    from land_trendr_tpu.io.geotiff import read_geotiff_info, read_geotiff_window
+
+    _COMP_NAMES = {1: "none", 5: "lzw", 8: "deflate", 32946: "deflate-old"}
+    win = None
+    if args.window:
+        try:
+            y0, x0, h, w = (int(v) for v in args.window.split(","))
+        except ValueError:
+            print(f"--window {args.window!r} is not Y0,X0,H,W", file=sys.stderr)
+            return 2
+        win = (y0, x0, h, w)
+
+    out = {}
+    for path in args.paths:
+        geo, info = read_geotiff_info(path)
+        rec = {
+            "height": info.height,
+            "width": info.width,
+            "bands": info.bands,
+            "dtype": str(info.dtype),
+            "layout": "tiled" if info.tiled else "strips",
+            "compression": _COMP_NAMES.get(info.compression, info.compression),
+            "bigtiff": info.big,
+            "file_bytes": os.path.getsize(path),
+            "geotransform": geo.geotransform(),
+            "nodata": geo.nodata,
+        }
+        if win is not None:
+            a = np.asarray(read_geotiff_window(path, *win), dtype=np.float64)
+            finite = a[np.isfinite(a)]
+            rec["window"] = {
+                "y0_x0_h_w": list(win),
+                "min": float(finite.min()) if finite.size else None,
+                "max": float(finite.max()) if finite.size else None,
+                "mean": float(finite.mean()) if finite.size else None,
+                "finite_frac": round(float(finite.size / a.size), 6) if a.size else None,
+            }
+        out[path] = rec
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -368,6 +426,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "params":
         print(_params_from_args(args).to_json())
         return 0
+
+    if args.cmd == "info":
+        return _run_info(args)
 
     if args.cmd == "pixel":
         return _run_pixel(args)

@@ -5,6 +5,8 @@ from land_trendr_tpu.io.geotiff import (
     GeoTiffStreamWriter,
     TiffInfo,
     read_geotiff,
+    read_geotiff_info,
+    read_geotiff_window,
     write_geotiff,
 )
 from land_trendr_tpu.io.synthetic import SceneSpec, SyntheticStack, make_stack, write_stack
@@ -14,6 +16,8 @@ __all__ = [
     "TiffInfo",
     "GeoTiffStreamWriter",
     "read_geotiff",
+    "read_geotiff_info",
+    "read_geotiff_window",
     "write_geotiff",
     "SceneSpec",
     "SyntheticStack",

@@ -132,6 +132,9 @@ class TiffInfo:
     tiled: bool
     compression: int
     big: bool = False
+    #: rows per block (TileLength / RowsPerStrip) — the natural window-read
+    #: granularity; set by header-only inspection (read_geotiff_info)
+    block_rows: int | None = None
 
 
 def _read_ifd(
@@ -400,6 +403,100 @@ def _unpredict(block: np.ndarray, predictor: int) -> np.ndarray:
     return block
 
 
+def _walk_full_pages(
+    f: BinaryIO, path: str
+) -> tuple[str, bool, list[dict[int, tuple]]]:
+    """Parse the header and walk the IFD chain (tags only — no block data);
+    returns ``(byte_order, big, full_resolution_page_tags)``.  Overview and
+    mask pages (NewSubfileType reduced/mask bits) are skipped, as COGs and
+    gdaladdo expect."""
+    hdr = f.read(16)
+    if len(hdr) < 8:
+        raise ValueError(f"{path}: not a TIFF (truncated header)")
+    if hdr[:2] == b"II":
+        bo = "<"
+    elif hdr[:2] == b"MM":
+        bo = ">"
+    else:
+        raise ValueError(f"{path}: not a TIFF (bad byte-order mark)")
+    (magic,) = struct.unpack(bo + "H", hdr[2:4])
+    if magic == 42:
+        big = False
+        (ifd_off,) = struct.unpack(bo + "I", hdr[4:8])
+    elif magic == 43:
+        big = True
+        if len(hdr) < 16:
+            raise ValueError(f"{path}: not a BigTIFF (truncated header)")
+        offsize, pad = struct.unpack(bo + "HH", hdr[4:8])
+        if offsize != 8 or pad != 0:
+            raise ValueError(
+                f"{path}: BigTIFF with offset size {offsize} (only 8 supported)"
+            )
+        (ifd_off,) = struct.unpack(bo + "Q", hdr[8:16])
+    else:
+        raise ValueError(f"{path}: not a TIFF (magic={magic})")
+
+    page_tags: list[dict[int, tuple]] = []
+    seen: set[int] = set()
+    off = ifd_off
+    while off:
+        if off in seen:
+            raise ValueError(f"{path}: cyclic IFD chain at offset {off}")
+        seen.add(off)
+        tags, off = _read_ifd(f, bo, off, big)
+        subtype = _tag1(path, tags, _T_NEW_SUBFILE_TYPE, 0)
+        if subtype & 0x5:  # reduced-resolution overview (1) / mask (4)
+            continue
+        page_tags.append(tags)
+    if not page_tags:
+        raise ValueError(f"{path}: no full-resolution pages in IFD chain")
+    return bo, big, page_tags
+
+
+def _pages_geometry(
+    path: str, page_tags: list[dict[int, tuple]]
+) -> tuple[int, int, tuple, int]:
+    """Validate the full-resolution pages agree in size/format (stacking
+    mismatched pages would silently cast/truncate) and that each carries a
+    complete block layout; returns ``(width, height, dtype_key,
+    total_samples_per_pixel)``."""
+
+    def geometry(tags):
+        w = _tag1(path, tags, _T_IMAGE_WIDTH)
+        h = _tag1(path, tags, _T_IMAGE_LENGTH)
+        if _T_TILE_OFFSETS in tags:
+            # tiled layout needs its companion tags too
+            for req in (_T_TILE_WIDTH, _T_TILE_LENGTH, _T_TILE_BYTE_COUNTS):
+                _tag1(path, tags, req)
+        elif _T_STRIP_OFFSETS in tags:
+            _tag1(path, tags, _T_STRIP_BYTE_COUNTS)
+        else:
+            raise ValueError(
+                f"{path}: corrupt TIFF IFD (no strip or tile offsets)"
+            )
+        spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
+        if spp < 1:
+            raise ValueError(f"{path}: corrupt TIFF IFD (SamplesPerPixel={spp})")
+        bits = _tag1(path, tags, _T_BITS_PER_SAMPLE, 1)
+        fmt = _tag1(path, tags, _T_SAMPLE_FORMAT, 1)
+        return w, h, spp, (fmt, bits)
+
+    w0, h0, _, key0 = geometry(page_tags[0])
+    total_spp = 0
+    for k, tags in enumerate(page_tags):
+        w, h, spp, key = geometry(tags)
+        if (w, h, key) != (w0, h0, key0):
+            raise ValueError(
+                f"{path}: page {k} is {h}×{w}/format{key}, page 0 is "
+                f"{h0}×{w0}/format{key0} — refusing to stack "
+                "mismatched pages"
+            )
+        total_spp += spp
+    if key0 not in _DTYPES:
+        raise ValueError(f"{path}: unsupported sample format/bits {key0}")
+    return w0, h0, key0, total_spp
+
+
 def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
     """Decode a GeoTIFF into ``(array, geo, info)``.
 
@@ -416,83 +513,8 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
     page 1.
     """
     with open(path, "rb") as f:
-        hdr = f.read(16)
-        if len(hdr) < 8:
-            raise ValueError(f"{path}: not a TIFF (truncated header)")
-        if hdr[:2] == b"II":
-            bo = "<"
-        elif hdr[:2] == b"MM":
-            bo = ">"
-        else:
-            raise ValueError(f"{path}: not a TIFF (bad byte-order mark)")
-        (magic,) = struct.unpack(bo + "H", hdr[2:4])
-        if magic == 42:
-            big = False
-            (ifd_off,) = struct.unpack(bo + "I", hdr[4:8])
-        elif magic == 43:
-            big = True
-            if len(hdr) < 16:
-                raise ValueError(f"{path}: not a BigTIFF (truncated header)")
-            offsize, pad = struct.unpack(bo + "HH", hdr[4:8])
-            if offsize != 8 or pad != 0:
-                raise ValueError(
-                    f"{path}: BigTIFF with offset size {offsize} (only 8 supported)"
-                )
-            (ifd_off,) = struct.unpack(bo + "Q", hdr[8:16])
-        else:
-            raise ValueError(f"{path}: not a TIFF (magic={magic})")
-
-        # pass 1 — walk the chain (tags only, cheap) so the output can be
-        # allocated ONCE; decoding into slices keeps multi-page peak memory
-        # at the decoded array, same as single-page
-        page_tags: list[dict[int, tuple]] = []
-        seen: set[int] = set()
-        off = ifd_off
-        while off:
-            if off in seen:
-                raise ValueError(f"{path}: cyclic IFD chain at offset {off}")
-            seen.add(off)
-            tags, off = _read_ifd(f, bo, off, big)
-            subtype = _tag1(path, tags, _T_NEW_SUBFILE_TYPE, 0)
-            if subtype & 0x5:  # reduced-resolution overview (1) / mask (4)
-                continue
-            page_tags.append(tags)
-        if not page_tags:
-            raise ValueError(f"{path}: no full-resolution pages in IFD chain")
-
-        def geometry(tags):
-            w = _tag1(path, tags, _T_IMAGE_WIDTH)
-            h = _tag1(path, tags, _T_IMAGE_LENGTH)
-            if _T_TILE_OFFSETS in tags:
-                # tiled layout needs its companion tags too
-                for req in (_T_TILE_WIDTH, _T_TILE_LENGTH, _T_TILE_BYTE_COUNTS):
-                    _tag1(path, tags, req)
-            elif _T_STRIP_OFFSETS in tags:
-                _tag1(path, tags, _T_STRIP_BYTE_COUNTS)
-            else:
-                raise ValueError(
-                    f"{path}: corrupt TIFF IFD (no strip or tile offsets)"
-                )
-            spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
-            if spp < 1:
-                raise ValueError(f"{path}: corrupt TIFF IFD (SamplesPerPixel={spp})")
-            bits = _tag1(path, tags, _T_BITS_PER_SAMPLE, 1)
-            fmt = _tag1(path, tags, _T_SAMPLE_FORMAT, 1)
-            return w, h, spp, (fmt, bits)
-
-        w0, h0, _, key0 = geometry(page_tags[0])
-        total_spp = 0
-        for k, tags in enumerate(page_tags):
-            w, h, spp, key = geometry(tags)
-            if (w, h, key) != (w0, h0, key0):
-                raise ValueError(
-                    f"{path}: page {k} is {h}×{w}/format{key}, page 0 is "
-                    f"{h0}×{w0}/format{key0} — refusing to stack "
-                    "mismatched pages"
-                )
-            total_spp += spp
-        if key0 not in _DTYPES:
-            raise ValueError(f"{path}: unsupported sample format/bits {key0}")
+        bo, big, page_tags = _walk_full_pages(f, path)
+        w0, h0, key0, total_spp = _pages_geometry(path, page_tags)
         # untrusted dimensions: deflate/LZW top out near ~1032:1, so a
         # decoded size beyond file_size × 64Ki (or an absolute 1 TiB) can
         # only come from corrupt width/height tags — fail before np.zeros
@@ -522,6 +544,70 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
         return arr, geo, info
 
 
+def read_geotiff_info(path: str) -> tuple[GeoMeta, TiffInfo]:
+    """Header-only inspection (the ``gdalinfo`` seam): geo + shape/layout
+    facts from the IFD chain alone.  No block data is read or decoded, so
+    this is O(tags) even on a multi-GB mosaic — the cheap first step of
+    any windowed-read workflow."""
+    with open(path, "rb") as f:
+        bo, big, page_tags = _walk_full_pages(f, path)
+        width, height, key, total_spp = _pages_geometry(path, page_tags)
+        tags = page_tags[0]
+        tiled = _T_TILE_OFFSETS in tags
+        if tiled:
+            block_rows = _tag1(path, tags, _T_TILE_LENGTH)
+        else:
+            block_rows = min(
+                _tag1(path, tags, _T_ROWS_PER_STRIP, height), height
+            )
+        info = TiffInfo(
+            width=width,
+            height=height,
+            bands=total_spp,
+            dtype=np.dtype(_DTYPES[key]),
+            tiled=tiled,
+            compression=_tag1(path, tags, _T_COMPRESSION, _COMP_NONE),
+            big=big,
+            block_rows=block_rows,
+        )
+        return _page_geo(tags), info
+
+
+def read_geotiff_window(
+    path: str, y0: int, x0: int, h: int, w: int
+) -> np.ndarray:
+    """Random-access window read: decode ONLY the blocks intersecting
+    ``(y0, x0, h, w)`` of every full-resolution page — I/O and decode cost
+    scale with the window, not the raster (GDAL's ReadAsArray-with-window
+    seam; the piece that lets change maps and inspection tooling run over
+    CONUS-scale mosaics in bounded memory).
+
+    Returns ``(h, w)`` for single-band files, ``(bands, h, w)`` otherwise
+    (multi-page band stacking as in :func:`read_geotiff`).  Georeferencing
+    is the FULL raster's — offset by ``(y0, x0)`` pixels when a window
+    transform is needed (``GeoMeta.geotransform``)."""
+    with open(path, "rb") as f:
+        bo, big, page_tags = _walk_full_pages(f, path)
+        width, height, key, total_spp = _pages_geometry(path, page_tags)
+        # bounds BEFORE allocation: a typo'd window must fail with this
+        # error, not a garbage-driven MemoryError from np.zeros
+        if y0 < 0 or x0 < 0 or h < 1 or w < 1 or y0 + h > height or x0 + w > width:
+            raise ValueError(
+                f"{path}: window {(y0, x0, h, w)} outside raster "
+                f"{(height, width)}"
+            )
+        spps = [_tag1(path, t, _T_SAMPLES_PER_PIXEL, 1) for t in page_tags]
+        out = np.zeros((total_spp, h, w), dtype=np.dtype(_DTYPES[key]))
+        band0 = 0
+        for tags, spp in zip(page_tags, spps):
+            _decode_ifd(
+                f, path, bo, big, tags, out[band0 : band0 + spp],
+                window=(y0, x0, h, w),
+            )
+            band0 += spp
+    return out[0] if total_spp == 1 else out
+
+
 def _decode_ifd(
     f: BinaryIO,
     path: str,
@@ -529,9 +615,15 @@ def _decode_ifd(
     big: bool,
     tags: dict[int, tuple],
     out: np.ndarray,
+    window: tuple[int, int, int, int] | None = None,
 ) -> tuple[GeoMeta, TiffInfo]:
     """Decode one IFD's raster into the preallocated ``(spp, H, W)`` view
-    ``out`` (native byte order); returns the page's geo/info."""
+    ``out`` (native byte order); returns the page's geo/info.
+
+    ``window=(y0, x0, h, w)`` decodes ONLY the blocks intersecting that
+    region into an ``(spp, h, w)`` view — the random-access read path
+    (GDAL ReadAsArray-with-window equivalent): I/O and decode cost scale
+    with the window, not the raster."""
     width = _tag1(path, tags, _T_IMAGE_WIDTH)
     height = _tag1(path, tags, _T_IMAGE_LENGTH)
     spp = _tag1(path, tags, _T_SAMPLES_PER_PIXEL, 1)
@@ -550,9 +642,16 @@ def _decode_ifd(
 
     planes = spp if planar == 2 else 1
     chunk_spp = 1 if planar == 2 else spp
-    if out.shape != (spp, height, width):
+    if window is None:
+        window = (0, 0, height, width)
+    wy, wx, wh, ww = window
+    if wy < 0 or wx < 0 or wh < 1 or ww < 1 or wy + wh > height or wx + ww > width:
         raise ValueError(
-            f"{path}: output view {out.shape} != page shape {(spp, height, width)}"
+            f"{path}: window {window} outside raster {(height, width)}"
+        )
+    if out.shape != (spp, wh, ww):
+        raise ValueError(
+            f"{path}: output view {out.shape} != window shape {(spp, wh, ww)}"
         )
     if tiled:
         tw = _tag1(path, tags, _T_TILE_WIDTH)
@@ -562,7 +661,18 @@ def _decode_ifd(
         offsets = tags[_T_TILE_OFFSETS]
         counts = tags[_T_TILE_BYTE_COUNTS]
         blk_rows, blk_w = th, tw
-        n_blocks = planes * ((width + tw - 1) // tw) * ((height + th - 1) // th)
+        tiles_x = (width + tw - 1) // tw
+        tiles_y = (height + th - 1) // th
+        n_blocks = planes * tiles_x * tiles_y
+        # blocks intersecting the window, with their grid coordinates —
+        # the unit the decode below pays for
+        coords: list[tuple] = [
+            (p, ty, tx)
+            for p in range(planes)
+            for ty in range(wy // th, (wy + wh - 1) // th + 1)
+            for tx in range(wx // tw, (wx + ww - 1) // tw + 1)
+        ]
+        sel = [p * tiles_y * tiles_x + ty * tiles_x + tx for p, ty, tx in coords]
     else:
         rps = _tag1(path, tags, _T_ROWS_PER_STRIP, height)
         if rps < 1:
@@ -572,15 +682,22 @@ def _decode_ifd(
         # clamp: RowsPerStrip may legally exceed height (e.g. 2^32-1 =
         # "everything in one strip"); the buffer needs only real rows
         blk_rows, blk_w = min(rps, height), width
-        n_blocks = planes * ((height + rps - 1) // rps)
+        strips = (height + rps - 1) // rps
+        n_blocks = planes * strips
+        coords = [
+            (p, s)
+            for p in range(planes)
+            for s in range(wy // rps, (wy + wh - 1) // rps + 1)
+        ]
+        sel = [p * strips + s for p, s in coords]
 
     # untrusted block tables AND block geometry: the layout dictates how
-    # many blocks the decode loops index, every block must lie inside the
-    # file, and the block SLOT allocation (n_blocks × blk_rows × blk_w —
-    # which corrupt TileWidth/TileLength tags can inflate far beyond the
-    # image size) must pass the same plausibility budget as the page —
-    # otherwise the native fast path np.zeros's from garbage dimensions
-    # and dies with MemoryError instead of a clean parse error
+    # many blocks the decode loops index, every selected block must lie
+    # inside the file, and the block SLOT allocation (len(sel) × blk_rows
+    # × blk_w — which corrupt TileWidth/TileLength tags can inflate far
+    # beyond the image size) must pass the same plausibility budget as the
+    # page — otherwise the native fast path np.zeros's from garbage
+    # dimensions and dies with MemoryError instead of a clean parse error
     f.seek(0, 2)
     fsize = f.tell()
     if len(offsets) < n_blocks or len(counts) < n_blocks:
@@ -588,18 +705,18 @@ def _decode_ifd(
             f"{path}: corrupt block table ({len(offsets)} offsets / "
             f"{len(counts)} counts for {n_blocks} blocks)"
         )
-    offsets = offsets[:n_blocks]
-    counts = counts[:n_blocks]
+    sel_offsets = [offsets[i] for i in sel]
+    sel_counts = [counts[i] for i in sel]
     slot_bytes = (
-        n_blocks * blk_rows * blk_w * chunk_spp * dtype.itemsize
+        len(sel) * blk_rows * blk_w * chunk_spp * dtype.itemsize
     )
     if slot_bytes > min((fsize + 4096) * 65536, 2**40):
         raise ValueError(
-            f"{path}: corrupt block geometry ({n_blocks} blocks × "
+            f"{path}: corrupt block geometry ({len(sel)} blocks × "
             f"{blk_rows}×{blk_w}×{chunk_spp} = {slot_bytes} decoded bytes "
             f"from a {fsize}-byte file)"
         )
-    for o, c in zip(offsets, counts):
+    for o, c in zip(sel_offsets, sel_counts):
         if o < 0 or c < 0 or o + c > fsize:
             raise ValueError(
                 f"{path}: corrupt block table entry ({o}+{c} vs file "
@@ -619,13 +736,11 @@ def _decode_ifd(
         and (predictor == 1 or (predictor == 2 and dtype.kind in "iu"))
     ):
         if tiled:
-            brows = np.full(len(offsets), blk_rows, dtype=np.uint64)
+            brows = np.full(len(sel), blk_rows, dtype=np.uint64)
         else:
-            n_strips = (height + rps - 1) // rps
-            per_plane = np.minimum(
-                rps, height - rps * np.arange(n_strips, dtype=np.int64)
+            brows = np.array(
+                [min(rps, height - s * rps) for _, s in coords], dtype=np.uint64
             )
-            brows = np.tile(per_plane, planes).astype(np.uint64)
         # mmap keeps peak host memory at the decoded array, not whole-file
         # bytes + decoded array, for scene-scale rasters
         try:
@@ -636,8 +751,8 @@ def _decode_ifd(
         try:
             nat_blocks = native.decode_blocks(
                 buf,
-                np.asarray(offsets, dtype=np.uint64),
-                np.asarray(counts, dtype=np.uint64),
+                np.asarray(sel_offsets, dtype=np.uint64),
+                np.asarray(sel_counts, dtype=np.uint64),
                 compression=compression,
                 predictor=predictor,
                 rows=blk_rows,
@@ -658,64 +773,42 @@ def _decode_ifd(
                     # freed with the object
                     pass
 
-    def get_block(idx: int, rows_actual: int) -> np.ndarray:
-        """Decoded block idx as (rows_actual, blk_w, chunk_spp)."""
+    def get_block(pos: int, rows_actual: int) -> np.ndarray:
+        """Decoded selected block ``pos`` as (rows_actual, blk_w, chunk_spp)."""
         if nat_blocks is not None:
-            return nat_blocks[idx][:rows_actual]
-        raw = _block(f, offsets[idx], counts[idx], compression)
+            return nat_blocks[pos][:rows_actual]
+        raw = _block(f, sel_offsets[pos], sel_counts[pos], compression)
         b = np.frombuffer(raw, dtype=dtype, count=rows_actual * blk_w * chunk_spp)
         b = b.reshape(rows_actual, blk_w, chunk_spp).astype(
             dtype.newbyteorder("="), copy=True
         )
         return _unpredict(b, predictor)
 
-    if tiled:
-        tiles_x = (width + tw - 1) // tw
-        tiles_y = (height + th - 1) // th
-        idx = 0
-        for p in range(planes):
-            for ty in range(tiles_y):
-                for tx in range(tiles_x):
-                    block = get_block(idx, th)  # file tiles are full-size
-                    y0, x0 = ty * th, tx * tw
-                    h = min(th, height - y0)
-                    w = min(tw, width - x0)
-                    if planar == 2:
-                        out[p, y0 : y0 + h, x0 : x0 + w] = block[:h, :w, 0]
-                    else:
-                        out[:, y0 : y0 + h, x0 : x0 + w] = np.moveaxis(
-                            block[:h, :w, :], -1, 0
-                        )
-                    idx += 1
-    else:
-        strips = (height + rps - 1) // rps
-        idx = 0
-        for p in range(planes):
-            for s in range(strips):
-                y0 = s * rps
-                h = min(rps, height - y0)
-                block = get_block(idx, h)
-                if planar == 2:
-                    out[p, y0 : y0 + h] = block[:, :, 0]
-                else:
-                    out[:, y0 : y0 + h] = np.moveaxis(block, -1, 0)
-                idx += 1
+    for pos, coord in enumerate(coords):
+        if tiled:
+            p, ty, tx = coord
+            by, bx = ty * th, tx * tw
+            bh = min(th, height - by)
+            bw = min(tw, width - bx)
+            block = get_block(pos, th)  # file tiles are full-size
+        else:
+            p, s = coord
+            by, bx = s * rps, 0
+            bh = min(rps, height - by)
+            bw = width
+            block = get_block(pos, bh)
+        # block ∩ window, placed window-relative (full reads: the whole block)
+        ys, xs = max(wy, by), max(wx, bx)
+        ye, xe = min(wy + wh, by + bh), min(wx + ww, bx + bw)
+        sub = block[ys - by : ye - by, xs - bx : xe - bx]
+        if planar == 2:
+            out[p, ys - wy : ye - wy, xs - wx : xe - wx] = sub[..., 0]
+        else:
+            out[:, ys - wy : ye - wy, xs - wx : xe - wx] = np.moveaxis(
+                sub, -1, 0
+            )
 
-    nodata = None
-    if _T_GDAL_NODATA in tags:
-        try:
-            nodata = float(tags[_T_GDAL_NODATA][0])
-        except (TypeError, ValueError):
-            nodata = None
-    geo = GeoMeta(
-        pixel_scale=tags.get(_T_MODEL_PIXEL_SCALE),
-        tiepoint=tags.get(_T_MODEL_TIEPOINT),
-        geo_key_directory=tags.get(_T_GEO_KEY_DIRECTORY),
-        geo_double_params=tags.get(_T_GEO_DOUBLE_PARAMS),
-        geo_ascii_params=tags.get(_T_GEO_ASCII_PARAMS, (None,))[0],
-        nodata=nodata,
-    )
-    info = TiffInfo(
+    return _page_geo(tags), TiffInfo(
         width=width,
         height=height,
         bands=spp,
@@ -724,7 +817,23 @@ def _decode_ifd(
         compression=compression,
         big=big,
     )
-    return geo, info
+
+
+def _page_geo(tags: dict[int, tuple]) -> GeoMeta:
+    nodata = None
+    if _T_GDAL_NODATA in tags:
+        try:
+            nodata = float(tags[_T_GDAL_NODATA][0])
+        except (TypeError, ValueError):
+            nodata = None
+    return GeoMeta(
+        pixel_scale=tags.get(_T_MODEL_PIXEL_SCALE),
+        tiepoint=tags.get(_T_MODEL_TIEPOINT),
+        geo_key_directory=tags.get(_T_GEO_KEY_DIRECTORY),
+        geo_double_params=tags.get(_T_GEO_DOUBLE_PARAMS),
+        geo_ascii_params=tags.get(_T_GEO_ASCII_PARAMS, (None,))[0],
+        nodata=nodata,
+    )
 
 
 def _block(f: BinaryIO, offset: int, count: int, compression: int) -> bytes:

@@ -256,23 +256,35 @@ def write_change_maps(
     index: str = "nbr",
     filt: ChangeFilter = ChangeFilter(),
     mmu: int = 1,
+    band_px: int = 1 << 21,
+    align_bands: bool = True,
 ) -> dict[str, str]:
     """Segment rasters (assemble_outputs' out_dir) → change-map rasters.
 
-    Reads the required products from ``seg_dir``, runs the jitted
-    selector per pixel, applies the minimum-mapping-unit sieve on the
-    changed mask (``mmu`` > 1), and writes one single-band GeoTIFF per
-    product in ``dest`` (``change_yod.tif`` …), on the input grid.
-    Returns product → path.
+    STREAMING: the required products are window-read in row bands
+    (``read_geotiff_window``), the jitted selector runs per band, and each
+    change product streams into a :class:`GeoTiffStreamWriter` — host
+    memory is O(row band × products) plus ONE full-raster boolean mask
+    (1 byte/px; 1.6 GB even at a 40k×40k CONUS mosaic), which the
+    minimum-mapping-unit sieve needs whole because patch connectivity is
+    global.  With ``mmu`` > 1, pixels the sieve removes are zeroed by a
+    second windowed pass over the just-written products (window-read →
+    zero → stream-rewrite → atomic replace), so peak memory never grows
+    with raster size.  Writes one single-band GeoTIFF per product in
+    ``dest`` (``change_yod.tif`` …), on the input grid.  Returns
+    product → path.
     """
-    from land_trendr_tpu.io.geotiff import read_geotiff, write_geotiff
+    from land_trendr_tpu.io.geotiff import (
+        GeoTiffStreamWriter,
+        read_geotiff_info,
+        read_geotiff_window,
+    )
 
     index = index.lower()
     if index not in idx.DISTURBANCE_SIGN:
         raise ValueError(f"unknown index {index!r} (one of {idx.INDEX_NAMES})")
 
-    arrs = {}
-    geo = None
+    src = {}
     for name in _REQUIRED:
         path = os.path.join(seg_dir, f"{name}.tif")
         if not os.path.exists(path):
@@ -280,42 +292,103 @@ def write_change_maps(
                 f"{path} missing — run `segment` (assemble_outputs) first; "
                 f"change maps need {_REQUIRED}"
             )
-        a, g, _ = read_geotiff(path)
-        arrs[name] = a
-        geo = geo or g
-    h, w = arrs["model_valid"].shape[-2:]
-    px = h * w
+        src[name] = path
+    geo, info = read_geotiff_info(src["model_valid"])
+    h, w = info.height, info.width
+    # ~2M px per row band: the selector inputs are ~150 B/px, so a band's
+    # working set stays around 300 MB regardless of raster size.  Round to
+    # the source rasters' block height so no source tile row is decoded by
+    # more than one band (an unaligned band grid would re-inflate every
+    # straddled tile once per band it touches).
+    band_rows = max(1, min(h, band_px // max(w, 1)))
+    if align_bands:
+        blk = info.block_rows or 1
+        band_rows = min(h, max(blk, band_rows // blk * blk))
 
-    def flat(a):
-        return np.moveaxis(a.reshape(-1, h, w), 0, -1).reshape(px, -1)
-
-    out = select_change(
-        flat(arrs["vertex_years"]).astype(np.float32),
-        flat(arrs["vertex_fit_vals"]).astype(np.float32),
-        flat(arrs["seg_magnitude"]).astype(np.float32),
-        flat(arrs["seg_duration"]).astype(np.float32),
-        flat(arrs["seg_rate"]).astype(np.float32),
-        flat(arrs["model_valid"]).astype(bool)[:, 0],
-        flat(arrs["p_of_f"]).astype(np.float32)[:, 0],
-        flat(arrs["rmse"]).astype(np.float32)[:, 0],
-        sign=idx.DISTURBANCE_SIGN[index],
-        filt=filt,
-    )
-    out = {k: np.asarray(v).reshape(h, w) for k, v in out.items()}
-
-    mask = mmu_sieve(out["mask"], mmu)
-    out["mask"] = mask
-    for k in CHANGE_PRODUCTS:
-        if k != "mask":
-            out[k] = np.where(mask, out[k], 0)
-
+    out_dtypes = {
+        k: np.dtype(np.uint8) if k == "mask"
+        else np.dtype(np.int32) if k == "yod"
+        else np.dtype(np.float32)
+        for k in CHANGE_PRODUCTS
+    }
     os.makedirs(dest, exist_ok=True)
-    paths = {}
-    for k in CHANGE_PRODUCTS:
-        a = out[k]
-        if a.dtype == np.bool_:
-            a = a.astype(np.uint8)
-        path = os.path.join(dest, f"change_{k}.tif")
-        write_geotiff(path, a[None], geo=geo)
-        paths[k] = path
+    paths = {k: os.path.join(dest, f"change_{k}.tif") for k in CHANGE_PRODUCTS}
+    writers = {
+        k: GeoTiffStreamWriter(paths[k], h, w, 1, out_dtypes[k], geo=geo)
+        for k in CHANGE_PRODUCTS
+    }
+    # the sieve needs global connectivity, so with mmu > 1 ONE full-raster
+    # boolean (1 byte/px) is held; the default mmu=1 path stays O(row band)
+    mask_full = np.zeros((h, w), bool) if mmu > 1 else None
+    try:
+        for y0 in range(0, h, band_rows):
+            hb = min(band_rows, h - y0)
+            arrs = {
+                name: np.asarray(read_geotiff_window(src[name], y0, 0, hb, w))
+                for name in _REQUIRED
+            }
+            px = hb * w
+
+            def flat(a):
+                return np.moveaxis(a.reshape(-1, hb, w), 0, -1).reshape(px, -1)
+
+            out = select_change(
+                flat(arrs["vertex_years"]).astype(np.float32),
+                flat(arrs["vertex_fit_vals"]).astype(np.float32),
+                flat(arrs["seg_magnitude"]).astype(np.float32),
+                flat(arrs["seg_duration"]).astype(np.float32),
+                flat(arrs["seg_rate"]).astype(np.float32),
+                flat(arrs["model_valid"]).astype(bool)[:, 0],
+                flat(arrs["p_of_f"]).astype(np.float32)[:, 0],
+                flat(arrs["rmse"]).astype(np.float32)[:, 0],
+                sign=idx.DISTURBANCE_SIGN[index],
+                filt=filt,
+            )
+            out = {k: np.asarray(v).reshape(hb, w) for k, v in out.items()}
+            if mask_full is not None:
+                mask_full[y0 : y0 + hb] = out["mask"]
+            for k in CHANGE_PRODUCTS:
+                writers[k].write(
+                    y0, 0, out[k].astype(out_dtypes[k], copy=False)
+                )
+        for wr in writers.values():
+            wr.close()
+    except BaseException:
+        for wr in writers.values():
+            try:
+                wr.abort()
+            except Exception:
+                pass
+        raise
+
+    if mmu > 1:
+        removed = mask_full & ~mmu_sieve(mask_full, mmu)
+        if removed.any():
+            for k in CHANGE_PRODUCTS:
+                _zero_removed_rewrite(
+                    paths[k], h, w, out_dtypes[k], removed, geo, band_rows
+                )
     return paths
+
+
+def _zero_removed_rewrite(
+    path: str,
+    h: int,
+    w: int,
+    dtype: np.dtype,
+    removed: np.ndarray,
+    geo,
+    band_rows: int,
+) -> None:
+    """Zero sieve-removed pixels of one just-written product, windowed:
+    read → mask → stream into a sibling tmp → atomic replace."""
+    from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter, read_geotiff_window
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with GeoTiffStreamWriter(tmp, h, w, 1, dtype, geo=geo) as wr:
+        for y0 in range(0, h, band_rows):
+            hb = min(band_rows, h - y0)
+            a = np.asarray(read_geotiff_window(path, y0, 0, hb, w))
+            a = np.where(removed[y0 : y0 + hb], 0, a).astype(dtype, copy=False)
+            wr.write(y0, 0, a)
+    os.replace(tmp, path)

@@ -217,3 +217,29 @@ def test_kitchen_sink_cli_chain(tmp_path, capsys):
 
     # overview page rides on the segment rasters
     assert [p[2] for p in _walk_pages(str(tmp_path / "out" / "rmse.tif"))] == [0, 1]
+
+
+def test_info_command(tmp_path, capsys):
+    """`info` reports header facts without decoding; --window adds bounded
+    value stats that match a direct read of the same region."""
+    import numpy as np
+
+    from land_trendr_tpu.io.geotiff import GeoMeta, write_geotiff
+
+    a = (np.arange(80 * 60, dtype=np.float32) / 100.0).reshape(80, 60)
+    p = str(tmp_path / "r.tif")
+    write_geotiff(
+        p, a,
+        geo=GeoMeta(pixel_scale=(30.0, 30.0, 0.0), tiepoint=(0, 0, 0, 1e5, 2e6, 0)),
+        compress="lzw",
+    )
+    assert main(["info", p, "--window", "10,10,20,20"]) == 0
+    rec = json.loads(capsys.readouterr().out)[p]
+    assert (rec["height"], rec["width"], rec["bands"]) == (80, 60, 1)
+    assert rec["dtype"] == "float32" and rec["compression"] == "lzw"
+    assert rec["geotransform"][0] == 1e5 and rec["geotransform"][5] == -30.0
+    win = a[10:30, 10:30]
+    assert abs(rec["window"]["mean"] - float(win.mean())) < 1e-6
+    assert rec["window"]["finite_frac"] == 1.0
+    # malformed window: clean error, not a traceback
+    assert main(["info", p, "--window", "oops"]) == 2

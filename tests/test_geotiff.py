@@ -3,7 +3,13 @@
 import numpy as np
 import pytest
 
-from land_trendr_tpu.io.geotiff import GeoMeta, read_geotiff, write_geotiff
+from land_trendr_tpu.io.geotiff import (
+    GeoMeta,
+    read_geotiff,
+    read_geotiff_info,
+    read_geotiff_window,
+    write_geotiff,
+)
 
 DTYPES = ["u1", "u2", "i2", "i4", "f4", "f8"]
 
@@ -518,6 +524,12 @@ def test_multipage_mismatched_pages_error(tmp_path, rng):
     )
     with pytest.raises(ValueError, match="mismatched pages"):
         read_geotiff(p)
+    # the header-only and windowed readers share the same guard — a
+    # mismatched chain must not silently cast/truncate into page 0's dtype
+    with pytest.raises(ValueError, match="mismatched pages"):
+        read_geotiff_info(p)
+    with pytest.raises(ValueError, match="mismatched pages"):
+        read_geotiff_window(p, 0, 0, 4, 4)
 
 
 def test_multipage_skips_overview_pages(tmp_path, rng):
@@ -779,3 +791,70 @@ def test_overview_strips_and_single_page_unchanged(tmp_path, rng):
     assert _walk_pages(p0) == [(70, 40, 0)]  # default path: single page
     back0, _, _ = read_geotiff(p0)
     np.testing.assert_array_equal(back0, a[0])
+
+
+def test_read_geotiff_info_header_only(tmp_path, rng):
+    """read_geotiff_info answers shape/layout/geo questions from the IFD
+    alone — same facts read_geotiff reports, without decoding a block."""
+    a = rng.integers(0, 255, size=(2, 90, 130)).astype(np.uint8)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0, 0, 0, 512000.0, 4.2e6, 0),
+        nodata=255.0,
+    )
+    p = str(tmp_path / "i.tif")
+    write_geotiff(p, a, geo=geo, overviews=2, tile=64)
+    g, i = read_geotiff_info(p)
+    _, g_ref, i_ref = read_geotiff(p)
+    assert (i.height, i.width, i.bands) == (90, 130, 2)
+    assert i.dtype == np.uint8 and i.tiled and not i.big
+    assert g.pixel_scale == g_ref.pixel_scale == geo.pixel_scale
+    assert g.tiepoint == g_ref.tiepoint
+    assert g.nodata == 255.0
+    # multi-page band stacking counts every full-res page, skips overviews
+    from PIL import Image
+
+    pages = [Image.fromarray(x, mode="L") for x in a]
+    mp = str(tmp_path / "mp.tif")
+    pages[0].save(mp, save_all=True, append_images=pages[1:])
+    _, i_mp = read_geotiff_info(mp)
+    assert i_mp.bands == 2
+
+
+@pytest.mark.parametrize("tile", [64, None])
+@pytest.mark.parametrize("compress", ["deflate", "lzw", "none"])
+def test_read_geotiff_window(tmp_path, rng, tile, compress):
+    """Window reads decode only intersecting blocks and agree with the
+    full-read slice for interior, edge, and single-pixel windows across
+    every layout × codec combination (both native and NumPy paths are
+    exercised by the native suite's LT_NO_NATIVE runs)."""
+    a = rng.integers(0, 4000, size=(3, 150, 211)).astype(np.uint16)
+    p = str(tmp_path / "w.tif")
+    write_geotiff(p, a, compress=compress, tile=tile)
+    for (y0, x0, h, w) in (
+        (0, 0, 150, 211),      # the whole raster
+        (10, 20, 70, 99),      # interior, block-straddling
+        (149, 210, 1, 1),      # bottom-right corner pixel
+        (0, 200, 150, 11),     # right edge column band
+    ):
+        win = read_geotiff_window(p, y0, x0, h, w)
+        np.testing.assert_array_equal(win, a[:, y0 : y0 + h, x0 : x0 + w])
+    with pytest.raises(ValueError, match="window"):
+        read_geotiff_window(p, 100, 0, 100, 10)  # past the bottom edge
+
+
+def test_read_geotiff_window_multipage_and_single_band(tmp_path, rng):
+    from PIL import Image
+
+    a = rng.integers(0, 255, size=(3, 77, 91)).astype(np.uint8)
+    mp = str(tmp_path / "mp.tif")
+    ims = [Image.fromarray(x, mode="L") for x in a]
+    ims[0].save(mp, save_all=True, append_images=ims[1:])
+    win = read_geotiff_window(mp, 30, 40, 20, 25)
+    np.testing.assert_array_equal(win, a[:, 30:50, 40:65])
+
+    p1 = str(tmp_path / "one.tif")
+    write_geotiff(p1, a[0], tile=64)
+    win = read_geotiff_window(p1, 5, 6, 30, 30)
+    assert win.shape == (30, 30)
+    np.testing.assert_array_equal(win, a[0, 5:35, 6:36])
