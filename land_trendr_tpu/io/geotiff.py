@@ -132,9 +132,10 @@ class TiffInfo:
     tiled: bool
     compression: int
     big: bool = False
-    #: rows per block (TileLength / RowsPerStrip) — the natural window-read
-    #: granularity; set by header-only inspection (read_geotiff_info)
+    #: block geometry (TileLength/TileWidth, or RowsPerStrip/width) — the
+    #: natural window-read granularity; set by read_geotiff_info
     block_rows: int | None = None
+    block_cols: int | None = None
 
 
 def _read_ifd(
@@ -556,10 +557,12 @@ def read_geotiff_info(path: str) -> tuple[GeoMeta, TiffInfo]:
         tiled = _T_TILE_OFFSETS in tags
         if tiled:
             block_rows = _tag1(path, tags, _T_TILE_LENGTH)
+            block_cols = _tag1(path, tags, _T_TILE_WIDTH)
         else:
             block_rows = min(
                 _tag1(path, tags, _T_ROWS_PER_STRIP, height), height
             )
+            block_cols = width
         info = TiffInfo(
             width=width,
             height=height,
@@ -569,6 +572,7 @@ def read_geotiff_info(path: str) -> tuple[GeoMeta, TiffInfo]:
             compression=_tag1(path, tags, _T_COMPRESSION, _COMP_NONE),
             big=big,
             block_rows=block_rows,
+            block_cols=block_cols,
         )
         return _page_geo(tags), info
 

@@ -179,29 +179,44 @@ def _run_lengths_arange(lengths: np.ndarray) -> np.ndarray:
     return ids - np.repeat(csum - lengths, lengths)
 
 
-def label4(mask: np.ndarray) -> tuple[np.ndarray, int]:
-    """4-connected component labeling, pure NumPy (no scipy dependency —
-    ADVICE r3: the lazy ``scipy.ndimage`` import was the repo's only
-    undeclared dependency).
+def _runs4(
+    mask: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """4-connected components as horizontal runs, pure NumPy (no scipy
+    dependency — ADVICE r3: the lazy ``scipy.ndimage`` import was the
+    repo's only undeclared dependency).
 
-    Two-pass run-based algorithm: horizontal True-runs are found
-    vectorized from row-wise sign changes; a union-find merges runs that
-    overlap column-wise in adjacent rows (4-connectivity); pixels are
-    painted from run labels vectorized.  Python-side work is O(runs +
-    overlaps) on run *endpoints* — never per pixel.
+    Horizontal True-runs are found vectorized from row-wise sign changes
+    (row-chunked, so temporaries stay O(chunk) even on a CONUS-scale
+    mask); a union-find merges runs that overlap column-wise in adjacent
+    rows (4-connectivity).  Python-side work is O(runs + overlaps) on run
+    *endpoints* — never per pixel.
 
-    Returns ``(labels, n)`` with background 0 and components 1..n,
-    matching ``scipy.ndimage.label`` with the 4-connected structure.
+    Returns ``(rows, starts, ends, component_of_run, n_components)``;
+    components are numbered 0..n-1 in first-run order.
     """
     h, w = mask.shape
-    d = np.diff(
-        np.pad(mask.astype(np.int8), ((0, 0), (1, 1))), axis=1
-    )  # (h, w+1)
-    starts = np.argwhere(d == 1)
-    if len(starts) == 0:
-        return np.zeros((h, w), np.int32), 0
-    rows, s = starts[:, 0], starts[:, 1]
-    e = np.argwhere(d == -1)[:, 1]  # row-major ⇒ pairs with starts 1:1
+    rows_l: list[np.ndarray] = []
+    s_l: list[np.ndarray] = []
+    e_l: list[np.ndarray] = []
+    chunk_rows = max(1, (1 << 22) // max(w, 1))
+    for r0 in range(0, h, chunk_rows):
+        d = np.diff(
+            np.pad(mask[r0 : r0 + chunk_rows].astype(np.int8), ((0, 0), (1, 1))),
+            axis=1,
+        )
+        st = np.argwhere(d == 1)
+        if len(st) == 0:
+            continue
+        rows_l.append((st[:, 0] + r0).astype(np.int64))
+        s_l.append(st[:, 1].astype(np.int32))
+        e_l.append(np.argwhere(d == -1)[:, 1].astype(np.int32))
+    if not rows_l:
+        z = np.zeros(0, np.int64)
+        return z, z, z, z, 0
+    rows = np.concatenate(rows_l)
+    s = np.concatenate(s_l)
+    e = np.concatenate(e_l)  # row-major ⇒ pairs with starts 1:1
     n = len(s)
 
     parent = np.arange(n, dtype=np.int64)
@@ -230,24 +245,72 @@ def label4(mask: np.ndarray) -> tuple[np.ndarray, int]:
 
     roots = np.fromiter((find(i) for i in range(n)), np.int64, n)
     _, lab = np.unique(roots, return_inverse=True)
-    lengths = e - s
-    flat_idx = np.repeat(rows * w + s, lengths) + _run_lengths_arange(lengths)
+    return rows, s, e, lab, int(lab.max()) + 1
+
+
+def _paint_runs(
+    out_flat: np.ndarray,
+    w: int,
+    rows: np.ndarray,
+    s: np.ndarray,
+    e: np.ndarray,
+    values: np.ndarray,
+    budget_px: int = 1 << 24,
+) -> None:
+    """Scatter per-run ``values`` onto the flat image, in run groups of at
+    most ``budget_px`` painted pixels — the index temporaries stay ~100 MB
+    instead of scaling with the mask's total True count (the round-4
+    memory spike at mosaic scale: 77M True px → several 600 MB int64
+    repeats at once)."""
+    lengths = (e - s).astype(np.int64)
+    idx0 = rows * w + s
+    csum = np.cumsum(lengths)
+    n = len(lengths)
+    start = 0
+    while start < n:
+        base = csum[start - 1] if start else 0
+        stop = min(n, int(np.searchsorted(csum, base + budget_px)) + 1)
+        ln = lengths[start:stop]
+        fi = np.repeat(idx0[start:stop], ln) + _run_lengths_arange(ln)
+        out_flat[fi] = np.repeat(values[start:stop], ln)
+        start = stop
+
+
+def label4(mask: np.ndarray) -> tuple[np.ndarray, int]:
+    """4-connected component labeling via :func:`_runs4`.
+
+    Returns ``(labels, n)`` with background 0 and components 1..n,
+    matching ``scipy.ndimage.label`` with the 4-connected structure.
+    """
+    h, w = mask.shape
+    rows, s, e, lab, n = _runs4(mask)
     out = np.zeros(h * w, np.int32)
-    out[flat_idx] = np.repeat(lab.astype(np.int32) + 1, lengths)
-    return out.reshape(h, w), int(lab.max()) + 1
+    if n:
+        _paint_runs(out, w, rows, s, e, lab.astype(np.int32) + 1)
+    return out.reshape(h, w), n
 
 
 def mmu_sieve(mask: np.ndarray, mmu: int) -> np.ndarray:
-    """Drop 4-connected changed patches smaller than ``mmu`` pixels."""
+    """Drop 4-connected changed patches smaller than ``mmu`` pixels.
+
+    Works entirely on the run representation — per-component pixel counts
+    come from a bincount over runs and the kept runs paint a fresh boolean
+    mask, so no full int32 label image (1 GB at 16k²) ever exists.
+    """
     if mmu <= 1:
         return mask
-    labels, n = label4(np.asarray(mask))
+    mask = np.asarray(mask)
+    h, w = mask.shape
+    rows, s, e, lab, n = _runs4(mask)
     if n == 0:
         return mask
-    counts = np.bincount(labels.ravel())
-    keep = counts >= mmu
-    keep[0] = False
-    return keep[labels]
+    counts = np.bincount(lab, weights=(e - s).astype(np.float64))
+    keep_run = counts[lab] >= mmu
+    out = np.zeros(h * w, bool)
+    if keep_run.any():
+        k = keep_run.nonzero()[0]
+        _paint_runs(out, w, rows[k], s[k], e[k], np.ones(len(k), bool))
+    return out.reshape(h, w)
 
 
 def write_change_maps(
@@ -295,15 +358,26 @@ def write_change_maps(
         src[name] = path
     geo, info = read_geotiff_info(src["model_valid"])
     h, w = info.height, info.width
-    # ~2M px per row band: the selector inputs are ~150 B/px, so a band's
-    # working set stays around 300 MB regardless of raster size.  Round to
-    # the source rasters' block height so no source tile row is decoded by
-    # more than one band (an unaligned band grid would re-inflate every
-    # straddled tile once per band it touches).
+    # Chunk the raster in TWO dimensions, aligned to the source rasters'
+    # block grid (so no source block is decoded by more than one chunk):
+    # row bands of the block height, split column-wise into ~band_px-pixel
+    # chunks.  Memory is then bounded by band_px (inputs ~130 B/px, the
+    # jitted selector's XLA transients ~1 kB/px) INDEPENDENT of raster
+    # width — a single full-width block row of a 40k-wide mosaic alone
+    # would be 10M px.  Strip sources don't column-split (a column chunk
+    # would re-decode the full-width strip it slices).
+    blk_r = (info.block_rows or 1) if align_bands else 1
+    blk_c = (info.block_cols or w) if align_bands else 1
     band_rows = max(1, min(h, band_px // max(w, 1)))
-    if align_bands:
-        blk = info.block_rows or 1
-        band_rows = min(h, max(blk, band_rows // blk * blk))
+    band_rows = min(h, max(blk_r, band_rows // blk_r * blk_r))
+    if info.tiled and band_rows * w > band_px:
+        cw = max(1, band_px // max(band_rows, 1))
+        cw = min(w, max(blk_c, cw // blk_c * blk_c))
+    else:
+        cw = w
+    # one compiled selector shape serves every chunk: ragged edge chunks
+    # pad up with model_valid=False rows (all outputs zero there)
+    chunk_px = band_rows * cw
 
     out_dtypes = {
         k: np.dtype(np.uint8) if k == "mask"
@@ -323,34 +397,45 @@ def write_change_maps(
     try:
         for y0 in range(0, h, band_rows):
             hb = min(band_rows, h - y0)
-            arrs = {
-                name: np.asarray(read_geotiff_window(src[name], y0, 0, hb, w))
-                for name in _REQUIRED
-            }
-            px = hb * w
+            for x0 in range(0, w, cw):
+                wb = min(cw, w - x0)
+                arrs = {
+                    name: np.asarray(
+                        read_geotiff_window(src[name], y0, x0, hb, wb)
+                    )
+                    for name in _REQUIRED
+                }
+                px = hb * wb
 
-            def flat(a):
-                return np.moveaxis(a.reshape(-1, hb, w), 0, -1).reshape(px, -1)
+                def flat(a):
+                    fl = np.moveaxis(a.reshape(-1, hb, wb), 0, -1)
+                    fl = fl.reshape(px, -1)
+                    if px < chunk_px:  # ragged edge → canonical shape
+                        fl = np.pad(fl, ((0, chunk_px - px), (0, 0)))
+                    return fl
 
-            out = select_change(
-                flat(arrs["vertex_years"]).astype(np.float32),
-                flat(arrs["vertex_fit_vals"]).astype(np.float32),
-                flat(arrs["seg_magnitude"]).astype(np.float32),
-                flat(arrs["seg_duration"]).astype(np.float32),
-                flat(arrs["seg_rate"]).astype(np.float32),
-                flat(arrs["model_valid"]).astype(bool)[:, 0],
-                flat(arrs["p_of_f"]).astype(np.float32)[:, 0],
-                flat(arrs["rmse"]).astype(np.float32)[:, 0],
-                sign=idx.DISTURBANCE_SIGN[index],
-                filt=filt,
-            )
-            out = {k: np.asarray(v).reshape(hb, w) for k, v in out.items()}
-            if mask_full is not None:
-                mask_full[y0 : y0 + hb] = out["mask"]
-            for k in CHANGE_PRODUCTS:
-                writers[k].write(
-                    y0, 0, out[k].astype(out_dtypes[k], copy=False)
+                out = select_change(
+                    flat(arrs["vertex_years"]).astype(np.float32),
+                    flat(arrs["vertex_fit_vals"]).astype(np.float32),
+                    flat(arrs["seg_magnitude"]).astype(np.float32),
+                    flat(arrs["seg_duration"]).astype(np.float32),
+                    flat(arrs["seg_rate"]).astype(np.float32),
+                    flat(arrs["model_valid"]).astype(bool)[:, 0],
+                    flat(arrs["p_of_f"]).astype(np.float32)[:, 0],
+                    flat(arrs["rmse"]).astype(np.float32)[:, 0],
+                    sign=idx.DISTURBANCE_SIGN[index],
+                    filt=filt,
                 )
+                out = {
+                    k: np.asarray(v)[:px].reshape(hb, wb)
+                    for k, v in out.items()
+                }
+                if mask_full is not None:
+                    mask_full[y0 : y0 + hb, x0 : x0 + wb] = out["mask"]
+                for k in CHANGE_PRODUCTS:
+                    writers[k].write(
+                        y0, x0, out[k].astype(out_dtypes[k], copy=False)
+                    )
         for wr in writers.values():
             wr.close()
     except BaseException:

@@ -159,6 +159,20 @@ def test_label4_matches_scipy_reference():
     assert n == 1 and (lab == 1).all()
 
 
+def test_mmu_sieve_equals_label_image_reference(rng):
+    """The run-level sieve (no label image materialised) must equal the
+    straightforward keep[labels] computation on random masks."""
+    from land_trendr_tpu.ops.change import label4
+
+    for density in (0.1, 0.35, 0.6, 0.9):
+        m = rng.uniform(size=(121, 86)) < density
+        labels, _ = label4(m)
+        counts = np.bincount(labels.ravel())
+        keep = counts >= 7
+        keep[0] = False
+        np.testing.assert_array_equal(mmu_sieve(m, 7), keep[labels], err_msg=str(density))
+
+
 def test_end_to_end_change_maps(tmp_path):
     spec = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
     synth = make_stack(spec)

@@ -1,0 +1,194 @@
+"""Bounded-memory change maps at mosaic scale — the streamed downstream
+layer's scale proof (companion to STREAMASM_r04.json's assembly proof).
+
+Fabricates the full segment-product set (``ops/change._REQUIRED``: 29
+bands across 8 rasters) for an H×W mosaic directly through
+GeoTiffStreamWriter — realistic structure (patchy disturbances with known
+years, compressible like real products) in O(row band) memory — then runs
+:func:`write_change_maps` with ``mmu`` > 1 (forcing the full-raster sieve
+plus the windowed zero-rewrite pass) and reports wall time and THIS
+process's peak RSS, captured before any verification read.
+
+Writes/merges CHANGESTREAM_r04.json.
+
+Usage: python tools/change_stream_bench.py [--size=16000] [--mmu=9]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import shutil
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT_JSON = os.path.join(REPO, "CHANGESTREAM_r04.json")
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def fabricate(seg_dir: str, h: int, w: int, band_rows: int) -> None:
+    """Segment products with a known disturbance structure: ~30% of pixels
+    carry one big drop (mag -0.4, 3 y) at a patch-dependent year, the rest
+    only sub-threshold wiggle — so the change layer has real work to do
+    and deflate sees realistic redundancy."""
+    from land_trendr_tpu.io.geotiff import GeoMeta, GeoTiffStreamWriter
+
+    geo = GeoMeta(pixel_scale=(30.0, 30.0, 0.0), tiepoint=(0, 0, 0, 5e5, 4e6, 0))
+    NV, NM = 7, 6
+    specs = {
+        "vertex_years": (NV, np.float32),
+        "vertex_fit_vals": (NV, np.float32),
+        "seg_magnitude": (NM, np.float32),
+        "seg_duration": (NM, np.float32),
+        "seg_rate": (NM, np.float32),
+        "model_valid": (1, np.uint8),
+        "p_of_f": (1, np.float32),
+        "rmse": (1, np.float32),
+    }
+    writers = {
+        k: GeoTiffStreamWriter(
+            os.path.join(seg_dir, f"{k}.tif"), h, w, depth, dt, geo=geo
+        )
+        for k, (depth, dt) in specs.items()
+    }
+    rng = np.random.default_rng(9)
+    for y0 in range(0, h, band_rows):
+        hb = min(band_rows, h - y0)
+        # patch pattern: 64×64 blocks share a disturbance year (or none)
+        by = (y0 + np.arange(hb)[:, None]) // 64
+        bx = np.arange(w)[None, :] // 64
+        patch = (by * 131 + bx * 17) % 10  # 0..9; <3 → disturbed patch
+        disturbed = patch < 3
+        d_year = 1990.0 + (patch * 3) % 20
+
+        vy = np.empty((hb, w, NV), np.float32)
+        vf = np.empty((hb, w, NV), np.float32)
+        vy[..., 0] = 1984.0
+        vy[..., 1] = np.where(disturbed, d_year, 1998.0)
+        vy[..., 2] = np.where(disturbed, d_year + 3.0, 2012.0)
+        vy[..., 3] = 2023.0
+        vy[..., 4:] = 0.0
+        vf[..., 0] = 0.6
+        vf[..., 1] = np.where(disturbed, 0.62, 0.58)
+        vf[..., 2] = np.where(disturbed, 0.22, 0.60)
+        vf[..., 3] = np.where(disturbed, 0.45, 0.61)
+        vf[..., 4:] = 0.0
+        mag = np.zeros((hb, w, NM), np.float32)
+        dur = np.zeros((hb, w, NM), np.float32)
+        mag[..., :3] = vf[..., 1:4] - vf[..., :3]
+        dur[..., :3] = vy[..., 1:4] - vy[..., :3]
+        rate = np.where(dur > 0, mag / np.where(dur > 0, dur, 1.0), 0.0)
+        arrays = {
+            "vertex_years": vy,
+            "vertex_fit_vals": vf,
+            "seg_magnitude": mag,
+            "seg_duration": dur,
+            "seg_rate": rate.astype(np.float32),
+            "model_valid": np.ones((hb, w, 1), np.uint8),
+            "p_of_f": np.full((hb, w, 1), 0.01, np.float32),
+            "rmse": rng.uniform(0.02, 0.06, (hb, w, 1)).astype(np.float32),
+        }
+        for k, a in arrays.items():
+            writers[k].write(y0, 0, a)
+    for wr in writers.values():
+        wr.close()
+
+
+def main() -> int:
+    size, mmu = 16000, 9
+    fab_only = False
+    for a in sys.argv[1:]:
+        if a.startswith("--size="):
+            size = int(a.split("=", 1)[1])
+        elif a.startswith("--mmu="):
+            mmu = int(a.split("=", 1)[1])
+        elif a == "--fabricate-only":
+            fab_only = True
+    h = w = size
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from land_trendr_tpu.ops.change import ChangeFilter, write_change_maps
+
+    seg_dir = os.path.join(REPO, ".changestream_seg")
+    dest = os.path.join(REPO, ".changestream_out")
+    if fab_only:
+        shutil.rmtree(seg_dir, ignore_errors=True)
+        os.makedirs(seg_dir)
+        fabricate(seg_dir, h, w, band_rows=512)
+        return 0
+
+    shutil.rmtree(dest, ignore_errors=True)
+    # fabrication in a CHILD process: its band transients (~3 GB at 16k²)
+    # must not pollute ru_maxrss — the measurement is the CHANGE layer's
+    import subprocess
+
+    t0 = time.perf_counter()
+    subprocess.run(
+        [sys.executable, os.path.abspath(__file__), f"--size={size}",
+         "--fabricate-only"],
+        check=True,
+        cwd=REPO,
+    )
+    fab_s = time.perf_counter() - t0
+    rss_fab = _rss_mb()
+
+    t0 = time.perf_counter()
+    paths = write_change_maps(
+        seg_dir, dest, index="nbr", filt=ChangeFilter(min_mag=0.1), mmu=mmu
+    )
+    wall = time.perf_counter() - t0
+    peak = _rss_mb()  # before any verification read
+
+    from land_trendr_tpu.io.geotiff import read_geotiff_window
+
+    yod = np.asarray(read_geotiff_window(paths["yod"], 0, 0, 128, w))
+    mask = np.asarray(read_geotiff_window(paths["mask"], 0, 0, 128, w))
+    assert ((yod > 0) == (mask > 0)).all()
+    assert set(np.unique(yod[yod > 0])).issubset(
+        {1991.0 + (p * 3) % 20 for p in range(3)}
+    ), np.unique(yod[yod > 0])[:10]
+
+    rec = {
+        "height": h,
+        "width": w,
+        "pixels": h * w,
+        "mmu": mmu,
+        "fabricate_s": round(fab_s, 1),
+        "change_wall_s": round(wall, 1),
+        "peak_rss_mb": round(peak, 1),
+        "rss_after_fabricate_mb": round(rss_fab, 1),
+        "changed_frac_first_rows": round(float((mask > 0).mean()), 4),
+        "out_bytes": {k: os.path.getsize(p) for k, p in paths.items()},
+        "note": (
+            "full segment-product set fabricated via stream writers, then "
+            "write_change_maps with the mmu sieve + windowed zero-rewrite; "
+            "peak_rss_mb covers fabrication + change mapping, captured "
+            "before verification reads"
+        ),
+    }
+    shutil.rmtree(seg_dir, ignore_errors=True)
+    shutil.rmtree(dest, ignore_errors=True)
+    doc = {}
+    if os.path.exists(OUT_JSON):
+        doc = json.load(open(OUT_JSON))
+    doc[f"change_{h}x{w}_mmu{mmu}"] = rec
+    with open(OUT_JSON, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
