@@ -108,6 +108,27 @@ def build_parser() -> argparse.ArgumentParser:
                      help="background tile-feed threads over the threaded "
                      "native gather (~4.1M px/s each; ~3 sustain the 10M "
                      "px/s target); prefetch depth is feed_workers+1")
+    seg.add_argument("--change", action="store_true",
+                     help="fuse on-device change-map selection into every "
+                     "tile's program; change_*.tif rasters assemble "
+                     "alongside the segment products (one pass, no "
+                     "post-hoc raster reads — the `change` command remains "
+                     "for mapping already-written segment rasters)")
+    seg.add_argument("--change-kind", default="disturbance",
+                     choices=("disturbance", "recovery"))
+    seg.add_argument("--change-sort", default="greatest",
+                     choices=("greatest", "newest", "oldest"))
+    seg.add_argument("--change-min-mag", type=float, default=0.0)
+    seg.add_argument("--change-min-dur", type=float, default=0.0)
+    seg.add_argument("--change-max-dur", type=float, default=float("inf"))
+    seg.add_argument("--change-min-preval", type=float, default=float("-inf"))
+    seg.add_argument("--change-max-p", type=float, default=1.0)
+    seg.add_argument("--change-year-min", type=float, default=float("-inf"))
+    seg.add_argument("--change-year-max", type=float, default=float("inf"))
+    seg.add_argument("--change-mmu", type=int, default=1,
+                     help="minimum mapping unit (pixels) applied to the "
+                     "assembled change mask — spatial, so it runs after "
+                     "assembly, not on device")
     seg.add_argument("--composite", default=None, choices=("medoid",),
                      help="collapse multi-acquisition years in a C2 "
                      "per-band archive to per-pixel QA-masked medoid "
@@ -474,6 +495,21 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         ftv = tuple(s for s in args.ftv.split(",") if s)
+        change_filt = None
+        if args.change:
+            from land_trendr_tpu.ops.change import ChangeFilter
+
+            change_filt = ChangeFilter(
+                kind=args.change_kind,
+                sort=args.change_sort,
+                min_mag=args.change_min_mag,
+                min_dur=args.change_min_dur,
+                max_dur=args.change_max_dur,
+                min_preval=args.change_min_preval,
+                max_p=args.change_max_p,
+                year_min=args.change_year_min,
+                year_max=args.change_year_max,
+            )
         cfg = RunConfig(
             index=args.index,
             ftv_indices=ftv,
@@ -490,6 +526,7 @@ def main(argv: list[str] | None = None) -> int:
             manifest_compress=args.manifest_compress,
             write_workers=args.write_workers,
             feed_workers=args.feed_workers,
+            change_filt=change_filt,
             out_overviews=args.out_overviews,
         )
         mesh = None
@@ -523,6 +560,10 @@ def main(argv: list[str] | None = None) -> int:
         else:
             summary = run_stack(stack, cfg, mesh=mesh)
         paths = assemble_outputs(stack, cfg)
+        if change_filt is not None and args.change_mmu > 1:
+            from land_trendr_tpu.ops.change import sieve_change_rasters
+
+            sieve_change_rasters(cfg.out_dir, args.change_mmu)
         print(json.dumps({"summary": summary, "outputs": paths}, indent=2))
         return 0
 

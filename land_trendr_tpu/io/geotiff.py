@@ -136,6 +136,14 @@ class TiffInfo:
     #: natural window-read granularity; set by read_geotiff_info
     block_rows: int | None = None
     block_cols: int | None = None
+    #: reduced-resolution (overview/mask) pages in the IFD chain; set by
+    #: read_geotiff_info so rewriting tools can reproduce the pyramid
+    overview_pages: int = 0
+
+    def compression_name(self) -> str:
+        return {1: "none", 5: "lzw", 8: "deflate", 32946: "deflate"}.get(
+            self.compression, "deflate"
+        )
 
 
 def _read_ifd(
@@ -439,6 +447,7 @@ def _walk_full_pages(
 
     page_tags: list[dict[int, tuple]] = []
     seen: set[int] = set()
+    n_reduced = 0
     off = ifd_off
     while off:
         if off in seen:
@@ -447,11 +456,12 @@ def _walk_full_pages(
         tags, off = _read_ifd(f, bo, off, big)
         subtype = _tag1(path, tags, _T_NEW_SUBFILE_TYPE, 0)
         if subtype & 0x5:  # reduced-resolution overview (1) / mask (4)
+            n_reduced += 1
             continue
         page_tags.append(tags)
     if not page_tags:
         raise ValueError(f"{path}: no full-resolution pages in IFD chain")
-    return bo, big, page_tags
+    return bo, big, page_tags, n_reduced
 
 
 def _pages_geometry(
@@ -514,7 +524,7 @@ def read_geotiff(path: str) -> tuple[np.ndarray, GeoMeta, TiffInfo]:
     page 1.
     """
     with open(path, "rb") as f:
-        bo, big, page_tags = _walk_full_pages(f, path)
+        bo, big, page_tags, _ = _walk_full_pages(f, path)
         w0, h0, key0, total_spp = _pages_geometry(path, page_tags)
         # untrusted dimensions: deflate/LZW top out near ~1032:1, so a
         # decoded size beyond file_size × 64Ki (or an absolute 1 TiB) can
@@ -551,7 +561,7 @@ def read_geotiff_info(path: str) -> tuple[GeoMeta, TiffInfo]:
     this is O(tags) even on a multi-GB mosaic — the cheap first step of
     any windowed-read workflow."""
     with open(path, "rb") as f:
-        bo, big, page_tags = _walk_full_pages(f, path)
+        bo, big, page_tags, n_reduced = _walk_full_pages(f, path)
         width, height, key, total_spp = _pages_geometry(path, page_tags)
         tags = page_tags[0]
         tiled = _T_TILE_OFFSETS in tags
@@ -573,6 +583,7 @@ def read_geotiff_info(path: str) -> tuple[GeoMeta, TiffInfo]:
             big=big,
             block_rows=block_rows,
             block_cols=block_cols,
+            overview_pages=n_reduced,
         )
         return _page_geo(tags), info
 
@@ -591,7 +602,7 @@ def read_geotiff_window(
     is the FULL raster's — offset by ``(y0, x0)`` pixels when a window
     transform is needed (``GeoMeta.geotransform``)."""
     with open(path, "rb") as f:
-        bo, big, page_tags = _walk_full_pages(f, path)
+        bo, big, page_tags, _ = _walk_full_pages(f, path)
         width, height, key, total_spp = _pages_geometry(path, page_tags)
         # bounds BEFORE allocation: a typo'd window must fail with this
         # error, not a garbage-driven MemoryError from np.zeros

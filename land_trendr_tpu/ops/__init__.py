@@ -1,6 +1,11 @@
 """ops subpackage: TPU compute kernels."""
 
-from land_trendr_tpu.ops.change import ChangeFilter, select_change, write_change_maps
+from land_trendr_tpu.ops.change import (
+    ChangeFilter,
+    select_change,
+    sieve_change_rasters,
+    write_change_maps,
+)
 from land_trendr_tpu.ops.composite import medoid_composite, medoid_indices
 from land_trendr_tpu.ops.ftv import ftv_pixel, jax_fit_to_vertices
 from land_trendr_tpu.ops.indices import compute_index, qa_valid_mask, scale_sr, sr_valid_mask
@@ -23,6 +28,7 @@ __all__ = [
     "ChangeFilter",
     "select_change",
     "write_change_maps",
+    "sieve_change_rasters",
     "medoid_composite",
     "medoid_indices",
 ]

@@ -39,7 +39,13 @@ import numpy as np
 
 from land_trendr_tpu.ops import indices as idx
 
-__all__ = ["ChangeFilter", "select_change", "write_change_maps", "CHANGE_PRODUCTS"]
+__all__ = [
+    "ChangeFilter",
+    "select_change",
+    "write_change_maps",
+    "sieve_change_rasters",
+    "CHANGE_PRODUCTS",
+]
 
 CHANGE_PRODUCTS = ("mask", "yod", "mag", "dur", "rate", "preval", "dsnr")
 
@@ -456,6 +462,55 @@ def write_change_maps(
     return paths
 
 
+def sieve_change_rasters(
+    out_dir: str, mmu: int, band_px: int = 1 << 21
+) -> None:
+    """Apply the minimum-mapping-unit sieve to ALREADY-ASSEMBLED change
+    rasters (``change_mask.tif`` + friends in ``out_dir``) — the spatial
+    stage of the fused on-device change path (``RunConfig.change_filt``),
+    which computes per-pixel selection on device but cannot see patch
+    connectivity across tiles.  Windowed row-band reads keep memory at one
+    full-raster boolean plus O(band); products rewrite atomically."""
+    if mmu <= 1:
+        return
+    from land_trendr_tpu.io.geotiff import read_geotiff_info, read_geotiff_window
+
+    mask_path = os.path.join(out_dir, "change_mask.tif")
+    if not os.path.exists(mask_path):
+        raise FileNotFoundError(
+            f"{mask_path} missing — sieve_change_rasters needs an assembled "
+            "change_filt run (RunConfig.change_filt + assemble_outputs)"
+        )
+    geo, info = read_geotiff_info(mask_path)
+    h, w = info.height, info.width
+    # block-aligned bands, same reasoning as write_change_maps: an
+    # unaligned band grid decodes every straddled block twice
+    blk = info.block_rows or 1
+    band_rows = max(1, min(h, band_px // max(w, 1)))
+    band_rows = min(h, max(blk, band_rows // blk * blk))
+    mask = np.zeros((h, w), bool)
+    for y0 in range(0, h, band_rows):
+        hb = min(band_rows, h - y0)
+        mask[y0 : y0 + hb] = (
+            np.asarray(read_geotiff_window(mask_path, y0, 0, hb, w)) > 0
+        )
+    removed = mask & ~mmu_sieve(mask, mmu)
+    if not removed.any():
+        return
+    # mask LAST: a crash mid-pass must leave the mask still showing the
+    # unsieved state, so a re-run recomputes the same `removed` and
+    # self-heals — mask-first would make the retry a silent no-op while
+    # the value products keep sieved-out pixels
+    for k in sorted(CHANGE_PRODUCTS, key=lambda k: k == "mask"):
+        path = os.path.join(out_dir, f"change_{k}.tif")
+        p_info = read_geotiff_info(path)[1]
+        _zero_removed_rewrite(
+            path, h, w, p_info.dtype, removed, geo, band_rows,
+            compress=p_info.compression_name(),
+            overviews=p_info.overview_pages,
+        )
+
+
 def _zero_removed_rewrite(
     path: str,
     h: int,
@@ -464,13 +519,19 @@ def _zero_removed_rewrite(
     removed: np.ndarray,
     geo,
     band_rows: int,
+    compress: str = "deflate",
+    overviews: int = 0,
 ) -> None:
     """Zero sieve-removed pixels of one just-written product, windowed:
-    read → mask → stream into a sibling tmp → atomic replace."""
+    read → mask → stream into a sibling tmp → atomic replace.  The
+    rewrite reproduces the source's compression/overview layout so a
+    sieved raster keeps whatever pyramid/codec the run configured."""
     from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter, read_geotiff_window
 
     tmp = f"{path}.{os.getpid()}.tmp"
-    with GeoTiffStreamWriter(tmp, h, w, 1, dtype, geo=geo) as wr:
+    with GeoTiffStreamWriter(
+        tmp, h, w, 1, dtype, geo=geo, compress=compress, overviews=overviews
+    ) as wr:
         for y0 in range(0, h, band_rows):
             hb = min(band_rows, h - y0)
             a = np.asarray(read_geotiff_window(path, y0, 0, hb, w))

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from land_trendr_tpu.config import LTParams
 from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.change import ChangeFilter, select_change
 from land_trendr_tpu.ops.ftv import jax_fit_to_vertices
 from land_trendr_tpu.ops.segment import (
     SegOutputs,
@@ -47,13 +48,18 @@ class TileOutputs(NamedTuple):
     #: index name → (PX, NY) fitted-trajectory values (disturbance-positive
     #: convention, matching the segmentation input sign).
     ftv: dict[str, jnp.ndarray]
+    #: fused change-map products (ops/change.CHANGE_PRODUCTS → (PX,)
+    #: arrays, natural orientation) when the run asked for them; the
+    #: spatial mmu sieve cannot run here (per-tile, no global
+    #: connectivity) and applies post-assembly.
+    change: "dict[str, jnp.ndarray] | None" = None
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "index", "ftv_indices", "params", "scale", "offset", "reject_bits",
-        "chunk",
+        "chunk", "change_filt",
     ),
 )
 def process_tile_dn(
@@ -67,6 +73,7 @@ def process_tile_dn(
     offset: float = -0.2,
     reject_bits: int = idx.DEFAULT_QA_REJECT,
     chunk: int | None = None,
+    change_filt: ChangeFilter | None = None,
 ) -> TileOutputs:
     """Segment one tile straight from Collection-2 style DNs.
 
@@ -106,7 +113,30 @@ def process_tile_dn(
         ftv[name] = jax_fit_to_vertices(
             years, series, mask, seg.vertex_indices, seg.n_vertices, params
         )
-    return TileOutputs(seg=seg, ftv=ftv)
+    change = None
+    if change_filt is not None:
+        # fused on-device change selection (the TPU-first ordering: the
+        # selector is a tiny elementwise+argmax program over arrays
+        # ALREADY in HBM — fusing it here costs nothing vs a second
+        # host pass over assembled rasters).  The kernel fits in the
+        # disturbance-positive orientation; the selector's contract is
+        # natural orientation, so flip by DISTURBANCE_SIGN first.  The
+        # spatial mmu sieve needs global connectivity and runs
+        # post-assembly (runtime.driver.assemble_outputs callers).
+        sign = idx.DISTURBANCE_SIGN[index]
+        change = select_change(
+            seg.vertex_years,
+            sign * seg.vertex_fit_vals,
+            sign * seg.seg_magnitude,
+            seg.seg_duration,
+            sign * seg.seg_rate,
+            seg.model_valid,
+            seg.p_of_f,
+            seg.rmse,
+            sign=sign,
+            filt=change_filt,
+        )
+    return TileOutputs(seg=seg, ftv=ftv, change=change)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))

@@ -44,6 +44,7 @@ from land_trendr_tpu.config import LTParams
 from land_trendr_tpu.io import native
 from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.ops.change import ChangeFilter
 from land_trendr_tpu.ops.tile import process_tile_dn
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
@@ -74,6 +75,13 @@ class RunConfig:
     resume: bool = True
     max_retries: int = 2
     write_fitted: bool = False  # include the (NY,) fitted trajectory raster
+    #: fuse on-device change-map selection into every tile's program
+    #: (ops/change.select_change over arrays already in HBM); the per-tile
+    #: change products ride the manifest and assemble into change_*.tif
+    #: rasters alongside the segment products.  The spatial mmu sieve
+    #: needs global connectivity — apply ops.change.sieve_change_rasters
+    #: to the assembled out_dir (the CLI's --change-mmu does).
+    change_filt: "ChangeFilter | None" = None
     scale: float = 2.75e-5
     offset: float = -0.2
     reject_bits: int = idx.DEFAULT_QA_REJECT
@@ -150,6 +158,10 @@ class RunConfig:
                 # changes the set of arrays each tile artifact carries, so a
                 # toggled resume must not reuse old artifacts
                 "write_fitted": self.write_fitted,
+                "change": (
+                    dataclasses.asdict(self.change_filt)
+                    if self.change_filt is not None else None
+                ),
                 # chunking changes f32 fusion choices (~0.003% knife-edge
                 # decision flips) — a resume must not mix chunkings.  The
                 # mesh device count is checked separately via the manifest
@@ -264,6 +276,14 @@ def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
     }
     if cfg.write_fitted:
         arrays["fitted"] = sign * seg.fitted[:px]
+    if out.change is not None:
+        for name, arr in out.change.items():
+            a = np.asarray(arr)[:px]
+            if name == "yod":
+                a = a.astype(np.int32)
+            elif name != "mask":
+                a = a.astype(np.float32)
+            arrays[f"change_{name}"] = a
     for name, arr in out.ftv.items():
         arrays[f"ftv_{name}"] = idx.DISTURBANCE_SIGN[name.lower()] * np.asarray(arr)[:px]
     return arrays
@@ -413,6 +433,7 @@ def run_stack(
                         offset=cfg.offset,
                         reject_bits=cfg.reject_bits,
                         chunk=chunk,
+                        change_filt=cfg.change_filt,
                     ),
                     None,
                 )

@@ -216,6 +216,52 @@ def test_end_to_end_change_maps(tmp_path):
     assert (mask2 <= mask).all() and mask2.sum() < mask.sum() + 1
 
 
+def test_fused_change_matches_posthoc(tmp_path):
+    """RunConfig.change_filt (on-device selection fused into the tile
+    program, assembled as change_*.tif, sieved post-assembly) must produce
+    the same maps as the post-hoc write_change_maps over the segment
+    rasters — exact for mask/yod, float-tolerance for the f32 products
+    (the fused selector runs in the kernel dtype before the f32 cast)."""
+    from land_trendr_tpu.ops.change import sieve_change_rasters
+
+    spec = SceneSpec(width=40, height=37, year_start=1992, year_end=2012, seed=5)
+    rstack = stack_from_synthetic(make_stack(spec))
+    params = LTParams(max_segments=4, vertex_count_overshoot=2)
+    filt = ChangeFilter(min_mag=0.05)
+
+    cfg_fused = RunConfig(
+        params=params, tile_size=32,
+        workdir=os.path.join(tmp_path, "a", "work"),
+        out_dir=os.path.join(tmp_path, "a", "out"),
+        change_filt=filt,
+    )
+    run_stack(rstack, cfg_fused)
+    paths_fused = assemble_outputs(rstack, cfg_fused)
+    assert "change_mask" in paths_fused  # fused products ride the manifest
+    sieve_change_rasters(cfg_fused.out_dir, 4)
+
+    cfg_plain = RunConfig(
+        params=params, tile_size=32,
+        workdir=os.path.join(tmp_path, "b", "work"),
+        out_dir=os.path.join(tmp_path, "b", "out"),
+    )
+    run_stack(rstack, cfg_plain)
+    assemble_outputs(rstack, cfg_plain)
+    posthoc = write_change_maps(
+        cfg_plain.out_dir, os.path.join(tmp_path, "c"), filt=filt, mmu=4
+    )
+
+    for k in CHANGE_PRODUCTS:
+        a, _, _ = read_geotiff(
+            os.path.join(cfg_fused.out_dir, f"change_{k}.tif")
+        )
+        b, _, _ = read_geotiff(posthoc[k])
+        if k in ("mask", "yod"):
+            np.testing.assert_array_equal(a, b, err_msg=k)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, err_msg=k)
+
+
 def test_change_maps_band_split_equivalence(tmp_path):
     """The streamed row-band path (band_px forcing many bands, plus the
     mmu rewrite pass) must produce byte-identical products to a
