@@ -378,6 +378,28 @@ def _run_pixel(args: argparse.Namespace) -> int:
     return 0
 
 
+def _change_filter_from_args(args, prefix: str = ""):
+    """One ChangeFilter construction for both the `change` subcommand
+    (bare arg names) and `segment --change` (change_-prefixed) — a field
+    added to ChangeFilter shows up in both paths or neither."""
+    from land_trendr_tpu.ops.change import ChangeFilter
+
+    def g(name):
+        return getattr(args, prefix + name)
+
+    return ChangeFilter(
+        kind=g("kind"),
+        sort=g("sort"),
+        min_mag=g("min_mag"),
+        min_dur=g("min_dur"),
+        max_dur=g("max_dur"),
+        min_preval=g("min_preval"),
+        max_p=g("max_p"),
+        year_min=g("year_min"),
+        year_max=g("year_max"),
+    )
+
+
 def _run_info(args) -> int:
     """Header-only raster inspection; one JSON document for all paths."""
     import numpy as np
@@ -468,17 +490,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "change":
         from land_trendr_tpu.ops.change import ChangeFilter, write_change_maps
 
-        filt = ChangeFilter(
-            kind=args.kind,
-            sort=args.sort,
-            min_mag=args.min_mag,
-            min_dur=args.min_dur,
-            max_dur=args.max_dur,
-            min_preval=args.min_preval,
-            max_p=args.max_p,
-            year_min=args.year_min,
-            year_max=args.year_max,
-        )
+        filt = _change_filter_from_args(args)
         paths = write_change_maps(
             args.seg_dir, args.dest, index=args.index, filt=filt, mmu=args.mmu
         )
@@ -497,19 +509,21 @@ def main(argv: list[str] | None = None) -> int:
         ftv = tuple(s for s in args.ftv.split(",") if s)
         change_filt = None
         if args.change:
+            change_filt = _change_filter_from_args(args, prefix="change_")
+        else:
             from land_trendr_tpu.ops.change import ChangeFilter
 
-            change_filt = ChangeFilter(
-                kind=args.change_kind,
-                sort=args.change_sort,
-                min_mag=args.change_min_mag,
-                min_dur=args.change_min_dur,
-                max_dur=args.change_max_dur,
-                min_preval=args.change_min_preval,
-                max_p=args.change_max_p,
-                year_min=args.change_year_min,
-                year_max=args.change_year_max,
-            )
+            if (
+                _change_filter_from_args(args, prefix="change_")
+                != ChangeFilter()
+                or args.change_mmu != 1
+            ):
+                print(
+                    "error: --change-* options require --change (without "
+                    "it no change rasters are produced)",
+                    file=sys.stderr,
+                )
+                return 2
         cfg = RunConfig(
             index=args.index,
             ftv_indices=ftv,

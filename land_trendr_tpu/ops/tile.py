@@ -123,7 +123,7 @@ def process_tile_dn(
         # natural orientation, so flip by DISTURBANCE_SIGN first.  The
         # spatial mmu sieve needs global connectivity and runs
         # post-assembly (runtime.driver.assemble_outputs callers).
-        sign = idx.DISTURBANCE_SIGN[index]
+        sign = idx.DISTURBANCE_SIGN[index.lower()]
         change = select_change(
             seg.vertex_years,
             sign * seg.vertex_fit_vals,

@@ -71,6 +71,12 @@ def make_batch(rng: np.random.Generator, px: int, ny: int):
 def main() -> int:
     trials = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     out_path = sys.argv[2] if len(sys.argv) > 2 else "PARITY_PARAMS_r03.json"
+    seed_base = 1000
+    for a in sys.argv[3:]:
+        if a.startswith("--seed-base="):
+            # fresh trial population (e.g. r4 ran base 2000 on top of r3's
+            # 1000-based 256 trials — cumulative coverage, no replays)
+            seed_base = int(a.split("=", 1)[1])
     px = 64
 
     from land_trendr_tpu.models import oracle
@@ -81,7 +87,7 @@ def main() -> int:
     exact = 0
     mismatches = []
     for trial in range(trials):
-        rng = np.random.default_rng(1000 + trial)
+        rng = np.random.default_rng(seed_base + trial)
         ny = int(rng.choice([16, 24, 40]))
         params = sample_params(rng, ny)
         years, vals, mask = make_batch(rng, px, ny)
@@ -125,6 +131,7 @@ def main() -> int:
             "per pixel (north-star vertex-for-vertex contract)."
         ),
         "trials": trials,
+        "seed_base": seed_base,
         "pixels_per_trial": px,
         "pixels_total": total,
         "exact": exact,
