@@ -406,7 +406,6 @@ def _run_info(args) -> int:
 
     from land_trendr_tpu.io.geotiff import read_geotiff_info, read_geotiff_window
 
-    _COMP_NAMES = {1: "none", 5: "lzw", 8: "deflate", 32946: "deflate-old"}
     win = None
     if args.window:
         try:
@@ -425,7 +424,7 @@ def _run_info(args) -> int:
             "bands": info.bands,
             "dtype": str(info.dtype),
             "layout": "tiled" if info.tiled else "strips",
-            "compression": _COMP_NAMES.get(info.compression, info.compression),
+            "compression": info.compression_name(),
             "bigtiff": info.big,
             "file_bytes": os.path.getsize(path),
             "geotransform": geo.geotransform(),

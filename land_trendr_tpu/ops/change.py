@@ -374,8 +374,7 @@ def write_change_maps(
     # would re-decode the full-width strip it slices).
     blk_r = (info.block_rows or 1) if align_bands else 1
     blk_c = (info.block_cols or w) if align_bands else 1
-    band_rows = max(1, min(h, band_px // max(w, 1)))
-    band_rows = min(h, max(blk_r, band_rows // blk_r * blk_r))
+    band_rows = _aligned_band_rows(h, w, band_px, blk_r)
     if info.tiled and band_rows * w > band_px:
         cw = max(1, band_px // max(band_rows, 1))
         cw = min(w, max(blk_c, cw // blk_c * blk_c))
@@ -462,6 +461,13 @@ def write_change_maps(
     return paths
 
 
+def _aligned_band_rows(h: int, w: int, band_px: int, blk: int) -> int:
+    """Row-band height targeting ~band_px pixels, rounded to the source
+    block height so no block row is decoded by more than one band."""
+    band_rows = max(1, min(h, band_px // max(w, 1)))
+    return min(h, max(blk, band_rows // blk * blk))
+
+
 def sieve_change_rasters(
     out_dir: str, mmu: int, band_px: int = 1 << 21
 ) -> None:
@@ -483,11 +489,7 @@ def sieve_change_rasters(
         )
     geo, info = read_geotiff_info(mask_path)
     h, w = info.height, info.width
-    # block-aligned bands, same reasoning as write_change_maps: an
-    # unaligned band grid decodes every straddled block twice
-    blk = info.block_rows or 1
-    band_rows = max(1, min(h, band_px // max(w, 1)))
-    band_rows = min(h, max(blk, band_rows // blk * blk))
+    band_rows = _aligned_band_rows(h, w, band_px, info.block_rows or 1)
     mask = np.zeros((h, w), bool)
     for y0 in range(0, h, band_rows):
         hb = min(band_rows, h - y0)

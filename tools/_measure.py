@@ -1,0 +1,26 @@
+"""Shared helpers for the artifact-producing benchmark tools."""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+
+
+def rss_mb() -> float:
+    """This process's ru_maxrss high-water in MB (Linux reports KiB)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def merge_json(path: str, key: str, rec: dict) -> None:
+    """Merge ``rec`` under ``key`` into the JSON document at ``path`` and
+    echo the addition (the committed-artifact update pattern)."""
+    doc = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            doc = json.load(f)
+    doc[key] = rec
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps({key: rec}))

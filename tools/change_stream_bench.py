@@ -16,9 +16,7 @@ Usage: python tools/change_stream_bench.py [--size=16000] [--mmu=9]
 
 from __future__ import annotations
 
-import json
 import os
-import resource
 import shutil
 import sys
 import time
@@ -27,12 +25,11 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _measure import merge_json, rss_mb as _rss_mb  # noqa: E402
 
 OUT_JSON = os.path.join(REPO, "CHANGESTREAM_r04.json")
-
-
-def _rss_mb() -> float:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
 def fabricate(seg_dir: str, h: int, w: int, band_rows: int) -> None:
@@ -179,14 +176,7 @@ def main() -> int:
     }
     shutil.rmtree(seg_dir, ignore_errors=True)
     shutil.rmtree(dest, ignore_errors=True)
-    doc = {}
-    if os.path.exists(OUT_JSON):
-        doc = json.load(open(OUT_JSON))
-    doc[f"change_{h}x{w}_mmu{mmu}"] = rec
-    with open(OUT_JSON, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
-    print(json.dumps(rec))
+    merge_json(OUT_JSON, f"change_{h}x{w}_mmu{mmu}", rec)
     return 0
 
 

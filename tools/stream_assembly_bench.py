@@ -29,9 +29,7 @@ Usage: python tools/stream_assembly_bench.py scene|mosaic [--size=N]
 
 from __future__ import annotations
 
-import json
 import os
-import resource
 import shutil
 import sys
 import time
@@ -40,24 +38,15 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _measure import merge_json, rss_mb as _rss_mb  # noqa: E402
 
 OUT_JSON = os.path.join(REPO, "STREAMASM_r04.json")
 
 
-def _rss_mb() -> float:
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-
-
 def _merge(key: str, rec: dict) -> None:
-    doc = {}
-    if os.path.exists(OUT_JSON):
-        with open(OUT_JSON) as f:
-            doc = json.load(f)
-    doc[key] = rec
-    with open(OUT_JSON, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
-    print(json.dumps({key: rec}))
+    merge_json(OUT_JSON, key, rec)
 
 
 def _stub_stack(years: np.ndarray, h: int, w: int, geo):
