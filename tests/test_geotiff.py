@@ -858,3 +858,16 @@ def test_read_geotiff_window_multipage_and_single_band(tmp_path, rng):
     win = read_geotiff_window(p1, 5, 6, 30, 30)
     assert win.shape == (30, 30)
     np.testing.assert_array_equal(win, a[0, 5:35, 6:36])
+
+
+def test_read_geotiff_window_bigtiff(tmp_path, rng):
+    """Window reads work identically on the BigTIFF layout (u64 offsets in
+    the block tables — the CONUS-scale mosaic case)."""
+    a = rng.normal(size=(95, 140)).astype(np.float32)
+    p = str(tmp_path / "big.tif")
+    write_geotiff(p, a, tile=64, bigtiff=True)
+    _, info = read_geotiff_info(p)
+    assert info.big and info.block_rows == 64
+    for (y0, x0, h, w) in ((0, 0, 95, 140), (30, 50, 40, 60), (94, 139, 1, 1)):
+        win = read_geotiff_window(p, y0, x0, h, w)
+        np.testing.assert_array_equal(win, a[y0 : y0 + h, x0 : x0 + w])
