@@ -67,9 +67,10 @@ def test_bench_emits_single_json_line():
 
 
 def test_bench_chain_mode_emits_single_json_line():
-    """The accelerator-default chain mode (lax.scan of data-dependent
-    kernel applications) must run end to end; the driver's round-end TPU
-    bench takes this path."""
+    """The accelerator-default chain mode (lax.fori_loop of data-dependent
+    kernel applications with a TRACED length, so the paired-K long and
+    short windows share one compiled cache entry) must run end to end;
+    the driver's round-end TPU bench takes this path."""
     proc = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True,
