@@ -202,10 +202,11 @@ def _make_runner(px: int, ny: int):
 
 def _run_chained(
     dev, px: int, ny: int, reps: int, k: int
-) -> tuple[float, float | None, int]:
+) -> tuple[float, float, int]:
     """Time K data-dependent kernel applications in ONE dispatch.
 
-    Returns ``(best_k_seconds, median_delta_seconds, k_short)``: the
+    Returns ``(best_k_seconds, median_delta_seconds, k_short)``
+    (all present — n_pairs >= 1 guarantees a delta): the
     best wall seconds for the full K-chain window (dispatch + K kernels
     + one scalar fetch) and the median over window PAIRS of the
     pair-averaged difference between adjacent K- and ``k_short``-chain
@@ -393,8 +394,12 @@ def _child_main() -> int:
     # retried measurement itself), or the wait gets killed mid-recovery
     # and the next attempt re-pays backend init + compile from scratch
     budget = float(os.environ.get("LT_BENCH_TIMEOUT", 900))
-    for _ in range(10):  # back off: kernel memory is linear in px, and the
-        # tunneled chip's device faults correlate with batch size too
+    # separate budgets: crash waits (same px) must not consume the halving
+    # budget, or two early worker crashes leave the 1M→4096 backoff chain
+    # one iteration short of ever trying the floor size
+    halvings = 0
+    while halvings <= 9:  # back off: kernel memory is linear in px, and
+        # the tunneled chip's device faults correlate with batch size too
         try:
             if mode == "chain":
                 best, median_delta, k_short = _run_chained(dev, px, ny, reps, k)
@@ -419,6 +424,7 @@ def _child_main() -> int:
                 time.sleep(60)
                 continue
             if (_is_oom(e) or _is_device_fault(e)) and px > 4096:
+                halvings += 1
                 print(
                     f"bench: px={px} failed ({str(e)[:120]}); halving",
                     file=sys.stderr,
@@ -462,16 +468,21 @@ def _child_main() -> int:
         if median_delta >= 0.10 * best and k > k_short:
             net = px * (k - k_short) / median_delta
             if net < lower_bound:
-                # px*K/t_K is PROVEN (the window strictly contains the K
-                # executions); a net estimate below it is variance, and
-                # the note must describe the number actually reported
+                # px*K/t_best is PROVEN (that window strictly contained
+                # the K executions), so when the median-based central
+                # estimate lands below it the bound is simply the better
+                # (and safe) number.  Normal on low-dispatch-overhead
+                # devices: min-of-longs beats a median-derived rate
+                # whenever rep spread exceeds the dispatch cost being
+                # cancelled — not an anomaly, and the note must describe
+                # the number actually reported.
                 extra["clamped_to_lower_bound"] = True
                 value = lower_bound
                 extra["note"] = (
-                    "paired-K net estimate fell below the proven "
-                    "window lower bound (high rep variance); value "
-                    "IS the lower bound px*K/t_chain — dispatch+"
-                    "fetch round trip included, not cancelled."
+                    "paired-K net estimate below the proven best-window "
+                    "bound px*K/t_chain (dispatch overhead small vs rep "
+                    "spread — expected off-tunnel); value IS that proven "
+                    "bound, dispatch+fetch round trip included."
                 )
             else:
                 value = net

@@ -7,6 +7,7 @@ CPU mesh the conftest provides).
 """
 
 import json
+import math
 import subprocess
 import sys
 
@@ -94,6 +95,21 @@ def test_bench_chain_mode_emits_single_json_line():
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
     assert rec["mode"] == "chain" and rec["chain_k"] == 3
     assert rec["value"] > 0
+    # the paired-K reporting contract round artifacts/tools consume:
+    # both rates, the delta provenance, and a methodology note
+    assert rec["value_lower_bound"] > 0
+    assert rec["k_short"] == 1  # max(1, 3 // 8)
+    # presence + finiteness only: at px=64/K=3 the delta magnitude is
+    # ~2 ms, and one scheduler stall inside a short window can
+    # legitimately drive it <= 0 (bench falls back to the lower bound
+    # by design) — the sign is not a contract
+    assert isinstance(rec["median_delta_s"], float)
+    assert math.isfinite(rec["median_delta_s"])
+    assert "note" in rec
+    # the reported value never contradicts the proven window bound
+    # (clamped or not, value >= value_lower_bound by construction;
+    # both round to 0.1 so the comparison survives rounding)
+    assert rec["value"] >= rec["value_lower_bound"]
 
 
 def test_bench_chain_mode_through_chunked_kernel():
