@@ -1,0 +1,148 @@
+"""Same-host architecture speedup: reference-style per-pixel Python vs
+the batched TPU-native kernel.
+
+The reference executes LandTrendr as scalar per-pixel Python (NumPy
+float64) under Hadoop streaming — one map task per pixel (SURVEY.md §2
+L4/L3, BASELINE.json north star: "emitting one map task per pixel").
+This repo's `models/oracle.py` IS that execution style, minus Hadoop:
+the same per-pixel scalar pipeline the reference's `PixelSegmenter`
+runs, written against the public algorithm spec.  Timing it against
+`jax_segment_pixels` on the SAME host CPU therefore measures the
+architecture speedup of the rebuild — batched fixed-shape XLA vs
+per-pixel scalar Python — with zero hardware advantage.
+
+The oracle rate is an UPPER bound on the reference's end-to-end rate:
+Hadoop adds process spawn, text serialization, and shuffle on top of
+the per-pixel math (SURVEY.md §4: "the entire per-pixel cost ... is
+wrapped in process spawn + text serialization + shuffle overhead"),
+so the true reference would be slower than the number used here.
+
+Writes ONE JSON artifact:
+
+    oracle_px_s          — per-pixel scalar f64 rate (reference style)
+    kernel_cpu_px_s      — batched f32 kernel, same host CPU, loop mode
+    speedup_same_host    — kernel_cpu_px_s / oracle_px_s
+    tpu_px_s, speedup_tpu_vs_reference_style
+                         — cross-referenced from BENCH_r{R}.json when a
+                           real accelerator number exists there
+
+Usage: python tools/arch_speedup.py [oracle_px] [kernel_px] [out.json]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import make_series  # noqa: E402  (same population as the headline bench)
+
+
+def time_oracle(px: int, ny: int) -> tuple[float, float]:
+    """(seconds, fit_rate) for `px` pixels through the scalar oracle."""
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.models.oracle import segment_series
+
+    params = LTParams()
+    years, vals, mask = make_series(px, ny)
+    years64 = years.astype(np.float64)
+    # one un-timed pixel: import/first-call setup out of the window
+    segment_series(years64, vals[0], mask[0], params)
+    n_fit = 0
+    t0 = time.perf_counter()
+    for i in range(px):
+        r = segment_series(years64, vals[i], mask[i], params)
+        n_fit += bool(r.model_valid)
+    dt = time.perf_counter() - t0
+    return dt, n_fit / px
+
+
+def time_kernel_cpu(px: int, ny: int, reps: int = 3) -> tuple[float, float]:
+    """(best seconds, fit_rate) for the batched f32 kernel on host CPU."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    params = LTParams()
+    years, vals, mask = make_series(px, ny)
+    run = jax.jit(lambda y, v, m: jax_segment_pixels(y, v, m, params))
+    out = run(years, vals, mask)
+    jax.block_until_ready(out)
+    fit_rate = float(np.asarray(out.model_valid).mean())
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(years, vals, mask)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best, fit_rate
+
+
+def main() -> int:
+    oracle_px = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    kernel_px = int(sys.argv[2]) if len(sys.argv) > 2 else 65536
+    out_path = sys.argv[3] if len(sys.argv) > 3 else "ARCH_SPEEDUP.json"
+    ny = 40
+
+    oracle_s, oracle_fit = time_oracle(oracle_px, ny)
+    oracle_px_s = oracle_px / oracle_s
+    kernel_s, kernel_fit = time_kernel_cpu(kernel_px, ny)
+    kernel_px_s = kernel_px / kernel_s
+
+    rec = {
+        "metric": "architecture_speedup_same_host",
+        "oracle_px": oracle_px,
+        "oracle_px_s": round(oracle_px_s, 1),
+        "oracle_fit_rate": round(oracle_fit, 4),
+        "kernel_px": kernel_px,
+        "kernel_cpu_px_s": round(kernel_px_s, 1),
+        "kernel_fit_rate": round(kernel_fit, 4),
+        "speedup_same_host": round(kernel_px_s / oracle_px_s, 1),
+        "years": ny,
+        "nproc": os.cpu_count(),
+        "note": (
+            "oracle = reference-style per-pixel scalar f64 Python "
+            "(models/oracle.py — the execution model of the reference's "
+            "PixelSegmenter under Hadoop, minus Hadoop's spawn/serialize/"
+            "shuffle overhead, so an UPPER bound on the reference's "
+            "rate); kernel = batched f32 jax_segment_pixels on the SAME "
+            "host CPU (loop mode, best of 3). Populations identical "
+            "(bench.make_series)."
+        ),
+    }
+
+    # cross-reference the TPU number when a real one exists
+    round_id = os.environ.get("LT_ROUND", "04")
+    bench_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"BENCH_r{round_id}.json",
+    )
+    try:
+        bench = json.load(open(bench_path))
+        if bench.get("device_platform") not in (None, "cpu") and bench.get(
+            "value", 0
+        ) > 0:
+            rec["tpu_px_s"] = bench["value"]
+            rec["tpu_bench_note"] = bench.get("note", "")
+            rec["speedup_tpu_vs_reference_style"] = round(
+                bench["value"] / oracle_px_s, 1
+            )
+    except (OSError, ValueError):
+        pass
+
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    main()
