@@ -1,0 +1,141 @@
+#!/bin/bash
+# Sequential TPU availability-window worker — round 4+.
+#
+# Replaces the bench_watch.sh + tpu_followup.sh PAIR for in-session use:
+# both gate independently on a probe, so inside one window they run
+# CONCURRENTLY and contend on the tunneled chip — round-4 observation
+# (BENCH_r04_attempts.log 01:00-01:10 UTC): a second client's device_put
+# during an active bench crashes the remote worker ("TPU worker process
+# crashed or restarted") for EVERY batch size until it recovers.  One
+# process, one queue, strictly one chip client at a time.
+#
+# Work queue (each step skipped once its artifact exists, so the script
+# resumes across restarts; each success commits immediately — a window
+# can close at any moment):
+#   1. paired-K chain bench at 65536 px   -> BENCH_r${R}.json (paired-K)
+#   2. TPU-platform f32-vs-f64 parity     -> PARITY_f32_tpu.json
+#   3. TPU stage profile                  -> PROFILE_tpu_r${R}.json
+#   4. 1M-px chunked bench upgrade        -> BENCH_r${R}.json (px=1048576)
+#
+# Usage: LT_ROUND=04 nohup bash tools/window_runner.sh & disown
+cd /root/repo
+R="${LT_ROUND:-04}"
+LOG=/root/repo/BENCH_r${R}_attempts.log
+BENCH=/root/repo/BENCH_r${R}.json
+
+log() { echo "[$(date -u +%FT%TZ)] window_runner: $*" >> "$LOG"; }
+
+probe_green() {
+  timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1
+}
+
+# step predicates ---------------------------------------------------------
+have_paired_bench() {
+  python - "$BENCH" <<'EOF' 2>/dev/null
+import json, sys
+r = json.load(open(sys.argv[1]))
+ok = (r.get("device_platform") not in (None, "cpu")
+      and r.get("value", 0) > 0
+      and "median_delta_s" in r)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+have_1m_bench() {
+  python - "$BENCH" <<'EOF' 2>/dev/null
+import json, sys
+r = json.load(open(sys.argv[1]))
+ok = (r.get("device_platform") not in (None, "cpu")
+      and r.get("value", 0) > 0
+      and "median_delta_s" in r
+      and r.get("px", 0) >= 1048576)
+sys.exit(0 if ok else 1)
+EOF
+}
+
+accept_bench() {  # $1 = candidate json line, $2 = min px; 0 if real-TPU
+  printf '%s\n' "$1" | MIN_PX="$2" python -c '
+import json, os, sys
+try:
+    r = json.loads(sys.stdin.readline() or "{}")
+except ValueError:
+    sys.exit(1)
+ok = (r.get("device_platform") not in (None, "cpu")
+      and r.get("value", 0) > 0
+      and r.get("px", 0) >= int(os.environ["MIN_PX"]))
+sys.exit(0 if ok else 1)' 2>/dev/null
+}
+
+commit_artifact() {  # $1 = path, $2 = message
+  git -C /root/repo add "$1" >> "$LOG" 2>&1 && \
+    git -C /root/repo commit -m "$2" -- "$1" >> "$LOG" 2>&1
+}
+
+for i in $(seq 1 500); do
+  if ! probe_green; then
+    log "probe $i: backend not up"
+    sleep 300
+    continue
+  fi
+  log "probe $i green — working the queue"
+
+  if ! have_paired_bench; then
+    out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=1500 LT_BENCH_PX=65536 \
+          LT_BENCH_REPS=4 LT_BENCH_CHAIN_K=32 python bench.py 2>>"$LOG")
+    log "bench-65k: $out"
+    if accept_bench "$out" 1; then
+      echo "$out" > "$BENCH"
+      commit_artifact "$BENCH" "TPU bench artifact: paired-K 65536-px number (window runner)"
+      log "BENCH committed (65536, paired-K)"
+    else
+      sleep 60   # let a crashed worker recover before the next queue pass
+      continue
+    fi
+  fi
+
+  if [ ! -f PARITY_f32_tpu.json ]; then
+    if timeout 2400 python tools/parity_f32.py 65536 PARITY_f32_tpu.json \
+         --f64-on-cpu >> "$LOG" 2>&1 \
+       && python -c "import json; r=json.load(open('PARITY_f32_tpu.json')); exit(0 if r.get('platform') != 'cpu' else 1)" 2>/dev/null; then
+      commit_artifact PARITY_f32_tpu.json "TPU-platform f32 parity artifact (window runner)"
+      log "PARITY_f32_tpu committed"
+    else
+      rm -f PARITY_f32_tpu.json
+      log "parity attempt failed; re-queueing"
+      sleep 60
+      continue
+    fi
+  fi
+
+  if [ ! -f "PROFILE_tpu_r${R}.json" ]; then
+    if timeout 2400 python tools/profile_stages.py 65536 "PROFILE_tpu_r${R}.json" \
+         --platform=axon,cpu >> "$LOG" 2>&1 \
+       && python -c "import json; exit(0 if json.load(open('PROFILE_tpu_r${R}.json')).get('platform') != 'cpu' else 1)" 2>/dev/null; then
+      commit_artifact "PROFILE_tpu_r${R}.json" "TPU stage profile artifact (window runner)"
+      log "PROFILE_tpu committed"
+    else
+      rm -f "PROFILE_tpu_r${R}.json"
+      log "profile attempt failed; re-queueing"
+      sleep 60
+      continue
+    fi
+  fi
+
+  if ! have_1m_bench; then
+    out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=1500 \
+          LT_BENCH_REPS=4 LT_BENCH_CHAIN_K=32 python bench.py 2>>"$LOG")
+    log "bench-1M: $out"
+    if accept_bench "$out" 1048576; then
+      echo "$out" > "$BENCH"
+      commit_artifact "$BENCH" "TPU bench artifact upgraded: paired-K 1M-px chunked number (window runner)"
+      log "BENCH upgraded (1M, paired-K)"
+    else
+      sleep 60
+      continue
+    fi
+  fi
+
+  log "queue complete — all TPU artifacts present"
+  exit 0
+done
+exit 1
