@@ -647,6 +647,17 @@ def segment_pixel(
         # was MEASURED 16% slower end-to-end on CPU (scan-stack write
         # traffic outweighs one extra _fit_model); _fit_model is
         # deterministic, so the recomputation is exact.
+        #
+        # A second rejected variant (round 4): derive the NM vertex masks
+        # first (_remove_weakest never reads the fits) and vmap _fit_model
+        # over the family axis — NM-fold shorter sequential chain, and
+        # still bit-exact vs the oracle.  MEASURED 23% slower end-to-end
+        # on CPU (18.2k vs 23.6k px/s, 65536 px, quiet box, best of 5):
+        # with vmap over pixels already saturating the machine, batching
+        # the family axis only materializes (px, NM, NY) intermediates
+        # that the scan formulation never holds at once.  Worth re-timing
+        # on real TPU hardware if a profile shows this stage
+        # latency-bound rather than bandwidth-bound.
         m = jnp.sum(vm) - 1  # segments in this model
         if exact_mode:
             p = _f_stat_p(ss0, sse, n_valid.astype(dtype), m.astype(dtype))
