@@ -45,8 +45,13 @@ change model family entirely (different vertex count ⇒ rmse deltas up to
 Pipelines that need bit-exact vertex parity should run the f64 path
 (CPU, or TPU with x64 at a large slowdown).  The committed artifact's
 ``platform`` field records where it was measured; fusion-order effects
-are platform-specific, so re-run ``tools/parity_f32.py --platform=tpu``
-on real hardware for the TPU number.
+are platform-specific.  **Measured on real TPU v5 lite hardware**
+(round 4, ``PARITY_f32_tpu.json``, 65536 px): 99.989% exact vertex
+agreement vs the f64 CPU oracle, fitted-trajectory p99 delta 1.7e-6 —
+the same tail class as CPU f32.  (The pre-rewrite kernel measured
+48.9% on identical inputs: the TPU dynamic gather/scatter lowering this
+rewrite eliminated was not merely slow but decision-flipping —
+TPU_KERNEL_DIAG_r04.md §5.)
 
 Shape/naming conventions: ``NY`` = years (static), ``NC`` =
 ``max_segments + 1 + vertex_count_overshoot`` candidate-vertex capacity,
@@ -63,9 +68,10 @@ could only win by (a) pinning the (px_block, NY) series in VMEM across
 all four stages and (b) hand-laying series on the lane axis.  (a) is
 already what XLA does here — the whole pipeline is one fused jit program
 whose intermediates are loop carries, and the driver's chunked/sharded
-paths bound the working set; (b) would fight the gather-heavy stages
-(despike neighbours, vertex gathers), which Mosaic handles no better
-than XLA today.  The stage-level named_scopes keep the door open: if a
+paths bound the working set; (b) is moot since the round-4 one-hot
+rewrite — every former dynamic gather/scatter is now a lane-friendly
+masked contraction (TPU_KERNEL_DIAG_r04.md §§3-4), precisely the form
+XLA already lays out well.  The stage-level named_scopes keep the door open: if a
 TPU profile ever shows one stage dominated by layout/fusion overheads
 rather than math, that stage is the Pallas candidate, and the f64 oracle
 parity suite defines exactly what any such kernel must reproduce.
@@ -105,6 +111,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from land_trendr_tpu.config import LTParams
@@ -124,6 +131,33 @@ __all__ = [
 ]
 
 _EPS_RATE = 1e-12  # must match oracle._segment_violates
+
+
+# ---------------------------------------------------------------------------
+# One-hot access helpers
+#
+# Batched dynamic gather/scatter serializes on TPU: one 40-index row gather
+# at 65536 px was MEASURED at 21.7 ms against 0.17 ms for the equivalent
+# one-hot where-sum contraction, and the gather-heavy round-3 kernel ran at
+# 40k px/s on a chip simultaneously sustaining 15 TFLOP/s on matmuls
+# (TPU_KERNEL_DIAG_r04.md §§1-3).  Every traced-index read/write in this
+# kernel therefore goes through the helpers below.  Bit-exactness: the
+# where-sum adds the selected element plus explicit zeros, so the result is
+# identical to the gather term for term (and NaN-safe against garbage in
+# never-selected slots — ``where`` masks before the multiply-free sum).
+# ---------------------------------------------------------------------------
+
+
+def _gather_oh(vec: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """``vec[idx]`` given a precomputed one-hot ``oh = idx[..., None] == iota``."""
+    if vec.dtype == jnp.bool_:
+        return jnp.any(oh & vec, axis=-1)
+    return jnp.sum(jnp.where(oh, vec, jnp.zeros((), vec.dtype)), axis=-1)
+
+
+def _gather_1d(vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``vec[idx]`` for in-range integer ``idx`` (any shape), one-hot form."""
+    return _gather_oh(vec, idx[..., None] == jnp.arange(vec.shape[0]))
 
 
 class SegOutputs(NamedTuple):
@@ -181,29 +215,34 @@ def _despike(
     ny = y.shape[0]
     if params.spike_threshold >= 1.0:
         return y
+    iota = jnp.arange(ny)
     prev, nxt = _neighbour_indices(mask)
     interior = mask & (prev >= 0) & (nxt < ny)
     prev_c = jnp.clip(prev, 0, ny - 1)
     nxt_c = jnp.clip(nxt, 0, ny - 1)
-    # loop-invariant hoists; the body keeps the oracle's exact
-    # multiply-then-divide order, so hoisting the subtractions (bit-exact
-    # gathers) cannot move a single ulp
-    tp, tq = t[prev_c], t[nxt_c]
+    # loop-invariant hoists (incl. the neighbour one-hots — the while body
+    # captures them as invariant inputs, so the == compare runs once); the
+    # body keeps the oracle's exact multiply-then-divide order, so hoisting
+    # the subtractions (bit-exact one-hot reads) cannot move a single ulp
+    oh_prev = prev_c[:, None] == iota[None, :]
+    oh_nxt = nxt_c[:, None] == iota[None, :]
+    tp, tq = _gather_oh(t, oh_prev), _gather_oh(t, oh_nxt)
     dtp = t - tp
     denom = jnp.where(interior, tq - tp, 1.0)
 
     def body(carry):
         it, y, _ = carry
-        yp, yq = y[prev_c], y[nxt_c]
+        yp, yq = _gather_oh(y, oh_prev), _gather_oh(y, oh_nxt)
         itp = yp + (yq - yp) * dtp / denom
         dev = jnp.abs(y - itp)
         crossing = jnp.abs(yq - yp)
         prop = jnp.where(dev > 0.0, jnp.maximum(0.0, 1.0 - crossing / jnp.where(dev > 0.0, dev, 1.0)), 0.0)
         prop = jnp.where(interior, prop, -1.0)
         i = jnp.argmax(prop)  # first max — matches oracle tie-break
-        do = (prop[i] > params.spike_threshold) & (it < n_valid)
-        delta = jnp.where(do, (itp[i] - y[i]) * prop[i], 0.0)
-        return it + 1, y.at[i].add(delta), do
+        prop_i = jnp.max(prop)  # == prop[i] exactly (same reduction winner)
+        do = (prop_i > params.spike_threshold) & (it < n_valid)
+        delta = jnp.where(do, (_gather_1d(itp, i) - _gather_1d(y, i)) * prop_i, 0.0)
+        return it + 1, y + jnp.where(iota == i, delta, 0.0), do
 
     def cond(carry):
         it, _, cont = carry
@@ -244,9 +283,17 @@ def _masked_ols(t, y, member):
 
 
 def _vertex_positions(vmask: jnp.ndarray, size: int) -> jnp.ndarray:
-    """Sorted vertex positions, padded with NY (an out-of-range sentinel)."""
+    """Sorted vertex positions, padded with NY (an out-of-range sentinel).
+
+    Rank-keyed one-hot instead of ``jnp.nonzero(size=...)`` (whose
+    compaction lowers to scatter on TPU): slot ``k`` takes the year whose
+    running set-bit count is ``k + 1``; empty slots take NY.
+    """
     ny = vmask.shape[0]
-    return jnp.nonzero(vmask, size=size, fill_value=ny)[0]
+    rank = jnp.cumsum(vmask) - 1
+    oh = vmask[None, :] & (rank[None, :] == jnp.arange(size)[:, None])
+    pos = jnp.sum(jnp.where(oh, jnp.arange(ny)[None, :], 0), axis=-1)
+    return jnp.where(jnp.any(oh, axis=-1), pos, ny)
 
 
 def _find_candidates(t, y, mask, vmask0, params: LTParams):
@@ -280,26 +327,33 @@ def _find_candidates(t, y, mask, vmask0, params: LTParams):
     lo0 = jnp.argmax(vmask0)
     hi0 = ny - 1 - jnp.argmax(vmask0[::-1])
     c0i, c1i = fit_two(jnp.stack([lo0, lo0]), jnp.stack([hi0, hi0]))
-    c0v = jnp.zeros(ny, dtype).at[lo0].set(c0i[0])
-    c1v = jnp.zeros(ny, dtype).at[lo0].set(c1i[0])
+    zero = jnp.zeros((), dtype)
+    c0v = jnp.where(iota == lo0, c0i[0], zero)
+    c1v = jnp.where(iota == lo0, c1i[0], zero)
 
     def body(_, carry):
         vmask, c0v, c1v = carry
         # segment of year j = the one starting at the largest vertex <= j
         seg_start = jnp.clip(lax.cummax(jnp.where(vmask, iota, -1)), 0, ny - 1)
-        dev = jnp.abs(y - (c0v[seg_start] + c1v[seg_start] * t))
+        oh_seg = seg_start[:, None] == iota[None, :]  # (NY, NY)
+        dev = jnp.abs(y - (_gather_oh(c0v, oh_seg) + _gather_oh(c1v, oh_seg) * t))
         vpos = _vertex_positions(vmask, nc)
         eligible = mask & ~vmask & (iota > vpos[0]) & (iota < _last_vertex(vpos, ny))
         dev = jnp.where(eligible, dev, -1.0)
         i = jnp.argmax(dev)
-        do = dev[i] >= 0.0
+        do = jnp.max(dev) >= 0.0  # == dev[i] (same reduction winner)
         # split [lo, hi] at i: refit just the two halves
-        lo = seg_start[i]
+        lo = _gather_1d(seg_start, i)
         hi = jnp.clip(jnp.min(jnp.where(vmask & (iota > i), iota, ny)), 0, ny - 1)
         c0n, c1n = fit_two(jnp.stack([lo, i]), jnp.stack([i, hi]))
-        c0v = jnp.where(do, c0v.at[lo].set(c0n[0]).at[i].set(c0n[1]), c0v)
-        c1v = jnp.where(do, c1v.at[lo].set(c1n[0]).at[i].set(c1n[1]), c1v)
-        vmask = vmask | (jnp.zeros_like(vmask).at[i].set(True) & do)
+        # .at[lo].set(·).at[i].set(·) overwrite order: i wins a collision
+        c0v = jnp.where(
+            do & (iota == i), c0n[1], jnp.where(do & (iota == lo), c0n[0], c0v)
+        )
+        c1v = jnp.where(
+            do & (iota == i), c1n[1], jnp.where(do & (iota == lo), c1n[0], c1v)
+        )
+        vmask = vmask | ((iota == i) & do)
         return vmask, c0v, c1v
 
     vmask, _, _ = lax.fori_loop(0, nc - 2, body, (vmask0, c0v, c1v))
@@ -319,8 +373,9 @@ def _vertex_angles(t, y, vpos, n_verts, t_lo, t_hi, y_lo, y_hi):
     vpos_c = jnp.clip(vpos, 0, ny - 1)
     t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, 1.0)
     y_rng = jnp.where(y_hi > y_lo, y_hi - y_lo, 1.0)
-    xs = (t[vpos_c] - t_lo) / t_rng
-    ys = (y[vpos_c] - y_lo) / y_rng
+    oh_v = vpos_c[:, None] == jnp.arange(ny)[None, :]  # (K, NY)
+    xs = (_gather_oh(t, oh_v) - t_lo) / t_rng
+    ys = (_gather_oh(y, oh_v) - y_lo) / y_rng
     j = jnp.arange(k)
     interior = (j >= 1) & (j < n_verts - 1)
     dx1 = jnp.where(interior, xs - jnp.roll(xs, 1), 1.0)
@@ -340,10 +395,8 @@ def _remove_weakest(t, y, vmask, scale, size, keep_above):
     ang = _vertex_angles(t, y, vpos, n_verts, t_lo, t_hi, y_lo, y_hi)
     j = jnp.argmin(ang)  # first min — matches oracle tie-break
     do = n_verts > keep_above
-    pos = jnp.clip(vpos[j], 0, ny - 1)
-    return jnp.where(
-        do, vmask.at[pos].set(False), vmask
-    )
+    pos = jnp.clip(_gather_1d(vpos, j), 0, ny - 1)
+    return jnp.where(do & (jnp.arange(ny) == pos), False, vmask)
 
 
 # ---------------------------------------------------------------------------
@@ -373,24 +426,32 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
     vpos = _vertex_positions(vmask, nv)
     n_verts = jnp.sum(vmask)
     vpos_c = jnp.clip(vpos, 0, ny - 1)
+    # one (NV, NY) one-hot serves every vertex-position read in this fit:
+    # tv[k] == t[vpos_c[k]], yv[k] == y[vpos_c[k]], bit-exactly
+    oh_vc = vpos_c[:, None] == iota[None, :]
+    tv = _gather_oh(t, oh_vc)
+    yv = _gather_oh(y, oh_vc)
 
     # --- segment 0: OLS over closed [v0, v1] ---
     member0 = (iota >= vpos[0]) & (iota <= vpos[1]) & mask
     c0, c1 = _masked_ols(t, y, member0[None, :])
     c0, c1 = c0[0], c1[0]
-    dur0 = t[vpos_c[1]] - t[vpos_c[0]]
+    dur0 = tv[1] - tv[0]
     c1c = _clamp_slope(c1, dur0, y_range, params)
     # intercept is ym - slope*tm for both the clamped and unclamped slope
     m0 = member0.astype(t.dtype)
     n0 = jnp.maximum(jnp.sum(m0), 1.0)
     c0 = jnp.sum(m0 * y) / n0 - c1c * (jnp.sum(m0 * t) / n0)
     fitted = jnp.where(member0, c0 + c1c * t, 0.0)
-    anchor_t = t[vpos_c[1]]
+    anchor_t = tv[1]
     anchor_y = c0 + c1c * anchor_t
 
     # --- segments 1..: slope-only regression through the anchor ---
-    def body(k, carry):
-        fitted, anchor_t, anchor_y = carry
+    # Python-unrolled (NV is static and small): the fori_loop formulation
+    # forced dynamic vpos[k] picks per trip; unrolled, every vertex read is
+    # a static slice of tv/vpos and XLA fuses across segments.  Same ops in
+    # the same order as the former loop body — bit-exact.
+    for k in range(1, nv - 1):
         a, b = vpos[k], vpos[k + 1]
         active = (k + 1) < n_verts
         member = (iota > a) & (iota <= b) & mask & active
@@ -398,15 +459,11 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
         dt = (t - anchor_t) * m
         denom = jnp.sum(dt * dt)
         slope = jnp.where(denom > 0.0, jnp.sum(dt * (y - anchor_y)) / jnp.where(denom > 0.0, denom, 1.0), 0.0)
-        b_c = jnp.clip(b, 0, ny - 1)
-        slope = _clamp_slope(slope, t[b_c] - anchor_t, y_range, params)
+        slope = _clamp_slope(slope, tv[k + 1] - anchor_t, y_range, params)
         fitted = jnp.where(member, anchor_y + slope * (t - anchor_t), fitted)
-        new_anchor_y = anchor_y + slope * (t[b_c] - anchor_t)
-        anchor_t = jnp.where(active, t[b_c], anchor_t)
+        new_anchor_y = anchor_y + slope * (tv[k + 1] - anchor_t)
+        anchor_t = jnp.where(active, tv[k + 1], anchor_t)
         anchor_y = jnp.where(active, new_anchor_y, anchor_y)
-        return fitted, anchor_t, anchor_y
-
-    fitted, _, _ = lax.fori_loop(1, nv - 1, body, (fitted, anchor_t, anchor_y))
 
     # --- point-to-point fallback (vectorized over segments) ---
     # Per-element arithmetic is identical to the former per-segment
@@ -417,10 +474,8 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
     ks = jnp.arange(nv - 1)
     a_s, b_s = vpos[:-1], vpos[1:]                  # (NV-1,) segment bounds
     active_s = (ks + 1) < n_verts
-    a_sc = jnp.clip(a_s, 0, ny - 1)
-    b_sc = jnp.clip(b_s, 0, ny - 1)
-    dur_s = t[b_sc] - t[a_sc]
-    dy_s = y[b_sc] - y[a_sc]
+    dur_s = tv[1:] - tv[:-1]                        # == t[b_sc] - t[a_sc]
+    dy_s = yv[1:] - yv[:-1]
     # oracle._segment_violates
     viol_s = (dy_s < 0.0) & (y_range > 0.0) & (dur_s > 0.0)
     if params.prevent_one_year_recovery:
@@ -442,16 +497,19 @@ def _fit_model(t, y, mask, vmask, y_range, params: LTParams):
     seg_of = jnp.clip(
         jnp.minimum(jnp.cumsum(vmask) - 1, n_verts - 2), 0, nv - 2
     )
+    oh_seg = seg_of[:, None] == ks[None, :]          # (NY, NV-1)
     member_y = (
         (iota >= vpos[0])
         & (iota <= _last_vertex(vpos, ny))
         & mask
-        & active_s[seg_of]
+        & _gather_oh(active_s, oh_seg)
     )
     p2p0 = jnp.where((iota == vpos[0]) & mask, y, 0.0)
+    # y[a_sc[seg_of]] == (y[a_sc])[seg_of] == yv[:-1][seg_of]; same for t
     p2p = jnp.where(
         member_y,
-        y[a_sc[seg_of]] + rate_s[seg_of] * (t - t[a_sc[seg_of]]),
+        _gather_oh(yv[:-1], oh_seg)
+        + _gather_oh(rate_s, oh_seg) * (t - _gather_oh(tv[:-1], oh_seg)),
         p2p0,
     )
 
@@ -486,11 +544,33 @@ def _interp_through_vertices(t, vmask, fitted, pad_t, size):
     k = jnp.sum(vmask)
     live = jnp.arange(size) < k
     vpos_c = jnp.clip(vpos, 0, ny - 1)
-    vfit = fitted[vpos_c]
-    last_fit = vfit[jnp.clip(k - 1, 0, size - 1)]
-    xp = jnp.where(live, t[vpos_c], pad_t)
+    oh_vc = vpos_c[:, None] == jnp.arange(ny)[None, :]
+    vfit = _gather_oh(fitted, oh_vc)
+    last_fit = _gather_1d(vfit, jnp.clip(k - 1, 0, size - 1))
+    xp = jnp.where(live, _gather_oh(t, oh_vc), pad_t)
     fp = jnp.where(live, vfit, last_fit)
-    return jnp.interp(t, xp, fp)
+    # ``jnp.interp(t, xp, fp)`` replica, gather-free: reproduces
+    # jax._src.numpy.lax_numpy._interp's arithmetic term for term (same
+    # epsilon guard, same (delta / dx) * df association, same edge clamps);
+    # searchsorted(xp, x, side='right') over the sorted xp equals the count
+    # of xp entries <= x.
+    i = jnp.clip(jnp.sum(xp[None, :] <= t[:, None], axis=-1), 1, size - 1)
+    sj = jnp.arange(size)
+    oh_i = i[:, None] == sj[None, :]
+    oh_im1 = (i - 1)[:, None] == sj[None, :]
+    fp_i = _gather_oh(fp, oh_i)
+    fp_im1 = _gather_oh(fp, oh_im1)
+    xp_i = _gather_oh(xp, oh_i)
+    xp_im1 = _gather_oh(xp, oh_im1)
+    df = fp_i - fp_im1
+    dx = xp_i - xp_im1
+    delta = t - xp_im1
+    epsilon = np.spacing(np.finfo(t.dtype).eps)
+    dx0 = jnp.abs(dx) <= epsilon
+    f = jnp.where(dx0, fp_im1, fp_im1 + (delta / jnp.where(dx0, 1, dx)) * df)
+    f = jnp.where(t < xp[0], fp[0], f)
+    f = jnp.where(t > xp[-1], fp[-1], f)
+    return f
 
 
 def _f_stat_p(ss0, sse, n, m):
@@ -614,7 +694,7 @@ def segment_pixel(
 
     first_v = jnp.argmax(mask)
     last_v = ny - 1 - jnp.argmax(mask[::-1])
-    t_lo, t_hi = t[first_v], t[last_v]
+    t_lo, t_hi = _gather_1d(t, first_v), _gather_1d(t, last_v)
     scale = (t_lo, t_hi, y_lo, y_hi)
 
     # Stage 2 — candidates + cull
@@ -682,9 +762,10 @@ def segment_pixel(
                 jnp.asarray(params.best_model_proportion, dtype)
             )
         chosen = jnp.argmax(qualify)  # first (= most segments) qualifying model
-        vmask_c = vmasks[chosen]
+        oh_m = jnp.arange(nm) == chosen
+        vmask_c = _gather_oh(vmasks.T, oh_m)  # row select, one-hot over NM
         fitted_c, sse_c = _fit_model(t, y, mask, vmask_c, y_range, params)
-        p_c = ps[chosen]
+        p_c = _gather_oh(ps, oh_m)
 
     model_valid = enough & (y_range > 0.0) & (p_c <= params.p_val_threshold)
 
@@ -707,20 +788,22 @@ def segment_pixel(
     k = jnp.sum(vmask_c)
     live = jnp.arange(nv) < k
     vpos_c = jnp.clip(vpos, 0, ny - 1)
+    oh_vc = vpos_c[:, None] == iota[None, :]  # (NV, NY): all vertex reads
+    tvc = _gather_oh(t, oh_vc)                # t[vpos_c]
     vertex_indices = jnp.where(live & model_valid, vpos_c, -1).astype(jnp.int32)
-    vertex_years = jnp.where(live & model_valid, t[vpos_c], 0.0)
-    vertex_src = jnp.where(live & model_valid, y[vpos_c], 0.0)
-    vfit = fitted_c[vpos_c]
+    vertex_years = jnp.where(live & model_valid, tvc, 0.0)
+    vertex_src = jnp.where(live & model_valid, _gather_oh(y, oh_vc), 0.0)
+    vfit = _gather_oh(fitted_c, oh_vc)
     vertex_fit = jnp.where(live & model_valid, vfit, 0.0)
 
     sidx = jnp.arange(nm)
     seg_live = (sidx < k - 1) & model_valid
     mag = jnp.where(seg_live, vfit[1:] - vfit[:-1], 0.0)
-    dur = jnp.where(seg_live, t[vpos_c[1:]] - t[vpos_c[:-1]], 0.0)
+    dur = jnp.where(seg_live, tvc[1:] - tvc[:-1], 0.0)
     rate = jnp.where(seg_live & (dur > 0.0), mag / jnp.where(dur > 0.0, dur, 1.0), 0.0)
 
     fitted_full = _interp_through_vertices(
-        t, vmask_c, fitted_c, t[jnp.clip(last_v, 0, ny - 1)], nv
+        t, vmask_c, fitted_c, t_hi, nv
     )
     fitted_full = jnp.where(model_valid, fitted_full, mean)
 
