@@ -170,8 +170,15 @@ def _first_device(init_timeout: float):
         done.set()
 
 
+#: resolved by _make_runner on each build: which kernel the bench actually
+#: ran ("pallas"/"xla") — recorded in the result line so a consumer can
+#: tell the implementations apart without trusting env vars
+_RESOLVED_IMPL = "xla"
+
+
 def _make_runner(px: int, ny: int):
     """(device arrays, single-application fn) for the size-appropriate kernel."""
+    global _RESOLVED_IMPL
     import jax
 
     from land_trendr_tpu.config import LTParams
@@ -184,18 +191,38 @@ def _make_runner(px: int, ny: int):
     params = LTParams()
     years_np, vals_np, mask_np = make_series(px, ny)
     chunk = int(os.environ.get("LT_BENCH_CHUNK", 262144))
+    impl = os.environ.get("LT_BENCH_IMPL", "pallas")
+    use_pallas = impl == "pallas" and jax.default_backend() == "tpu"
+    if use_pallas:
+        from land_trendr_tpu.ops.segment_pallas import (
+            jax_segment_pixels_pallas,
+            jax_segment_pixels_pallas_chunked,
+        )
     if px > chunk:
         # indivisible px pads up with fully-masked rows (never a silent
         # fallback to the unchunked kernel — that is the OOM path);
         # throughput still counts only the real pixels
         vals_np, mask_np, _ = pad_to_multiple(vals_np, mask_np, chunk)
 
-        def run(y, v, m):
-            return jax_segment_pixels_chunked(y, v, m, params, chunk)
+        if use_pallas and (chunk <= 1024 or chunk % 1024 == 0):
+            _RESOLVED_IMPL = "pallas"
+            def run(y, v, m):
+                return jax_segment_pixels_pallas_chunked(y, v, m, params, chunk)
+        else:
+            _RESOLVED_IMPL = "xla"
+            def run(y, v, m):
+                return jax_segment_pixels_chunked(y, v, m, params, chunk)
     else:
-
-        def run(y, v, m):
-            return jax_segment_pixels(y, v, m, params)
+        # the Pallas block is min(1024, px): any px < 1024 divides by
+        # itself; larger px must divide by 1024
+        if use_pallas and (px < 1024 or px % 1024 == 0):
+            _RESOLVED_IMPL = "pallas"
+            def run(y, v, m):
+                return jax_segment_pixels_pallas(y, v, m, params)
+        else:
+            _RESOLVED_IMPL = "xla"
+            def run(y, v, m):
+                return jax_segment_pixels(y, v, m, params)
 
     return years_np, vals_np, mask_np, run
 
@@ -449,6 +476,7 @@ def _child_main() -> int:
         "device_platform": dev.platform,
         "chunked": px > chunk,
         "mode": mode,
+        "impl": _RESOLVED_IMPL,
     }
     if mode == "chain":
         extra["chain_k"] = k

@@ -104,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
                      help="background tile-writer threads (scale up on "
                      "device-rate hosts; memory stays bounded at "
                      "write_workers+2 live tiles)")
+    seg.add_argument("--impl", default="auto", choices=("auto", "pallas", "xla"),
+                     help="segmentation kernel: auto picks the Pallas "
+                          "family kernel on TPU backends (round-4 measured "
+                          "default), XLA elsewhere")
     seg.add_argument("--feed-workers", type=int, default=1,
                      help="background tile-feed threads over the threaded "
                      "native gather (~4.1M px/s each; ~3 sustain the 10M "
@@ -539,6 +543,7 @@ def main(argv: list[str] | None = None) -> int:
             manifest_compress=args.manifest_compress,
             write_workers=args.write_workers,
             feed_workers=args.feed_workers,
+            impl=args.impl,
             change_filt=change_filt,
             out_overviews=args.out_overviews,
         )

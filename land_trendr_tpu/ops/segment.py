@@ -58,50 +58,20 @@ Shape/naming conventions: ``NY`` = years (static), ``NC`` =
 ``NV`` = ``max_segments + 1`` final vertex capacity, ``NM`` =
 ``max_segments`` model-family slots.
 
-**Why no hand-written Pallas kernels (a reasoned decision, not an
-omission).**  SURVEY.md §3 classifies a Pallas inner-loop kernel as "a
-performance choice, not a parity obligation", and the measured profile
-(PROFILE_r03.json) says the choice is currently against: the kernel is
-NOT a large-matmul workload (nothing maps to the MXU — the biggest
-contraction is a (NC−1, NY)≈(9, 40) masked OLS), so a Pallas rewrite
-could only win by (a) pinning the (px_block, NY) series in VMEM across
-all four stages and (b) hand-laying series on the lane axis.  (a) is
-already what XLA does here — the whole pipeline is one fused jit program
-whose intermediates are loop carries, and the driver's chunked/sharded
-paths bound the working set; (b) is moot since the round-4 one-hot
-rewrite — every former dynamic gather/scatter is now a lane-friendly
-masked contraction (TPU_KERNEL_DIAG_r04.md §§3-4), precisely the form
-XLA already lays out well.  The stage-level named_scopes keep the door open: if a
-TPU profile ever shows one stage dominated by layout/fusion overheads
-rather than math, that stage is the Pallas candidate, and the f64 oracle
-parity suite defines exactly what any such kernel must reproduce.
-
-**The TPU-profile trigger for that revisit is mechanical, not a
-judgment call** (VERDICT r3 next-round item #7 — the paragraph above is
-reasoned from CPU profiles only).  Recipe, runnable inside any hardware
-window (``tools/tpu_followup.sh`` runs it automatically after a bench
-success)::
-
-    python tools/profile_stages.py 65536 PROFILE_tpu_rNN.json \
-        --platform=axon,cpu
-
-Decision rule, applied to the resulting record: prototype a stage in
-Pallas IF AND ONLY IF either
-
-(a) the stage's TPU ``stage_share`` exceeds 1.5× its CPU share
-    (PROFILE_r03.json is the CPU baseline) AND the excess is carried by
-    layout/copy/transpose fusions rather than math — visible as
-    ``fusion``/``copy``/``transpose`` entries for that stage in the HLO
-    dump the tool prints with ``LT_PROFILE_DUMP_HLO=1``; or
-(b) ``unmapped_kernel_s`` + runtime spans exceed 30% of
-    ``kernel_attributed_s`` — overhead no stage owns, i.e. scheduling/
-    layout glue a fused Pallas pipeline would collapse.
-
-Any Pallas prototype must pass ``tests/test_parity.py`` and the
-parameter-space suite in f64 mode bit-for-bit and keep every
-``tests/test_f32_quality.py`` gate; otherwise the prototype is rejected
-regardless of speed.  If neither trigger fires on a real TPU profile,
-the no-Pallas decision above stands as *measured*, not assumed.
+**The Pallas revisit trigger fired in round 4, and the Pallas kernel
+exists.**  Rounds 1-3 reasoned (from CPU profiles) that a Pallas kernel
+could not win; the first real TPU profile proved the opposite: this XLA
+kernel is instruction-bound at ~3.4M px/s because the ``(px, NY)``
+layout wastes 88/128 of every vector register and stage boundaries force
+HBM round trips.  :mod:`land_trendr_tpu.ops.segment_pallas` implements
+stages 1-4a in a ``(NY, BLK)`` year-major Pallas kernel (zero lane
+padding, whole pipeline VMEM-resident per block) and reuses this
+module's ``_select_and_assemble`` tail; it passes the f64 oracle-parity
+suite bit-for-bit in interpret mode and measured 100% decision-identical
+to this kernel on real-TPU f32 at 65536 px.  THIS module remains the
+portable reference implementation (CPU, any backend, f64) and the
+semantics anchor: any Pallas change must keep ``tests/test_pallas.py``
+bit-green against it.
 """
 
 from __future__ import annotations
@@ -160,6 +130,40 @@ def _gather_1d(vec: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return _gather_oh(vec, idx[..., None] == jnp.arange(vec.shape[0]))
 
 
+def _fill_forward(
+    vals: jnp.ndarray, valid: jnp.ndarray, *, exclusive: bool = False
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(filled, has)``: per slot, the value at the nearest valid slot at
+    (``exclusive=False``) or strictly before (``exclusive=True``) it, and
+    whether one exists; 0.0 where none.
+
+    Log-doubling select chain — pure elementwise + static shifts, so XLA
+    fuses it into O(1) passes where the equivalent (NY, NY) one-hot
+    contraction pays a 40-way reduction.  Bit-exact: the result is a
+    *selected* element, never an arithmetic combination.
+    """
+    n = vals.shape[0]
+    v = jnp.where(valid, vals, jnp.zeros((), vals.dtype))
+    has = valid
+    if exclusive:
+        v = jnp.concatenate([jnp.zeros_like(v[:1]), v[:-1]])
+        has = jnp.concatenate([jnp.zeros_like(has[:1]), has[:-1]])
+    sh = 1
+    while sh < n:
+        v = jnp.where(has, v, jnp.concatenate([jnp.zeros_like(v[:sh]), v[:-sh]]))
+        has = has | jnp.concatenate([jnp.zeros_like(has[:sh]), has[:-sh]])
+        sh *= 2
+    return v, has
+
+
+def _fill_backward(
+    vals: jnp.ndarray, valid: jnp.ndarray, *, exclusive: bool = False
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mirror of :func:`_fill_forward`: nearest valid slot at/after each slot."""
+    v, has = _fill_forward(vals[::-1], valid[::-1], exclusive=exclusive)
+    return v[::-1], has[::-1]
+
+
 class SegOutputs(NamedTuple):
     """Per-pixel outputs; mirrors ``oracle.SegmentationResult`` field for field.
 
@@ -186,17 +190,6 @@ class SegOutputs(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
-def _neighbour_indices(mask: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Nearest valid neighbour index on each side (prev=-1 / next=NY when none)."""
-    ny = mask.shape[0]
-    iota = jnp.arange(ny)
-    prev_incl = lax.cummax(jnp.where(mask, iota, -1))
-    prev = jnp.concatenate([jnp.array([-1]), prev_incl[:-1]])
-    next_incl = -lax.cummax(jnp.where(mask, -iota, -(ny))[::-1])[::-1]
-    nxt = jnp.concatenate([next_incl[1:], jnp.array([ny])])
-    return prev, nxt
-
-
 def _despike(
     t: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, n_valid: jnp.ndarray,
     params: LTParams,
@@ -216,23 +209,22 @@ def _despike(
     if params.spike_threshold >= 1.0:
         return y
     iota = jnp.arange(ny)
-    prev, nxt = _neighbour_indices(mask)
-    interior = mask & (prev >= 0) & (nxt < ny)
-    prev_c = jnp.clip(prev, 0, ny - 1)
-    nxt_c = jnp.clip(nxt, 0, ny - 1)
-    # loop-invariant hoists (incl. the neighbour one-hots — the while body
-    # captures them as invariant inputs, so the == compare runs once); the
-    # body keeps the oracle's exact multiply-then-divide order, so hoisting
-    # the subtractions (bit-exact one-hot reads) cannot move a single ulp
-    oh_prev = prev_c[:, None] == iota[None, :]
-    oh_nxt = nxt_c[:, None] == iota[None, :]
-    tp, tq = _gather_oh(t, oh_prev), _gather_oh(t, oh_nxt)
+    # nearest-valid-neighbour reads are forward/backward fills along the
+    # year axis (log-doubling select chains — see _fill_forward); the
+    # filled VALUES equal y[prev]/y[next] bit-for-bit wherever a neighbour
+    # exists, and `interior` masks every slot where one does not.  The body
+    # keeps the oracle's exact multiply-then-divide order, so hoisting the
+    # subtractions (bit-exact selected reads) cannot move a single ulp.
+    tp, has_prev = _fill_forward(t, mask, exclusive=True)
+    tq, has_nxt = _fill_backward(t, mask, exclusive=True)
+    interior = mask & has_prev & has_nxt
     dtp = t - tp
     denom = jnp.where(interior, tq - tp, 1.0)
 
     def body(carry):
         it, y, _ = carry
-        yp, yq = _gather_oh(y, oh_prev), _gather_oh(y, oh_nxt)
+        yp, _ = _fill_forward(y, mask, exclusive=True)
+        yq, _ = _fill_backward(y, mask, exclusive=True)
         itp = yp + (yq - yp) * dtp / denom
         dev = jnp.abs(y - itp)
         crossing = jnp.abs(yq - yp)
@@ -333,10 +325,13 @@ def _find_candidates(t, y, mask, vmask0, params: LTParams):
 
     def body(_, carry):
         vmask, c0v, c1v = carry
-        # segment of year j = the one starting at the largest vertex <= j
+        # segment of year j = the one starting at the largest vertex <= j:
+        # c0v/c1v[seg_start] are forward fills of the caches over the
+        # vertex mask (same selected values, no (NY, NY) contraction)
         seg_start = jnp.clip(lax.cummax(jnp.where(vmask, iota, -1)), 0, ny - 1)
-        oh_seg = seg_start[:, None] == iota[None, :]  # (NY, NY)
-        dev = jnp.abs(y - (_gather_oh(c0v, oh_seg) + _gather_oh(c1v, oh_seg) * t))
+        c0_at, _ = _fill_forward(c0v, vmask)
+        c1_at, _ = _fill_forward(c1v, vmask)
+        dev = jnp.abs(y - (c0_at + c1_at * t))
         vpos = _vertex_positions(vmask, nc)
         eligible = mask & ~vmask & (iota > vpos[0]) & (iota < _last_vertex(vpos, ny))
         dev = jnp.where(eligible, dev, -1.0)
@@ -595,23 +590,74 @@ def _f_stat_p(ss0, sse, n, m):
 _LOGP_PERFECT = -1e30
 
 
-def _log_betainc_series(a, b, x, terms: int = 40):
-    """``log I_x(a, b)`` via the hypergeometric series — for ``x <= 0.5``.
+def _betainc_p_and_logp_lentz(a, b, x, iters: int = 12):
+    """``(p, log p)`` of the regularised incomplete beta in ONE fixed-trip pass.
 
-    ``I_x(a,b) = x^a (1-x)^b / (a B(a,b)) · Σ_n [(a+b)_n / (a+1)_n] x^n``.
-    The term ratio tends to ``x``, so 40 terms leave ≲ x^35 ≈ 1e-11 relative
-    remainder at the x = 0.5 boundary; everything is O(1) in float32 — no
-    underflow — which is the point: the *log* of a p-value of 1e-40 is a
-    perfectly representable -92.
+    Float32 scoring speed fix (round 4, measured on TPU v5 lite at 262144
+    px: ``jax.scipy.special.betainc``-based scoring 13.0 ms/step — the
+    entire XLA tail cost of the Pallas pipeline — vs ~4 ms for this):
+    modified-Lentz evaluation of the continued fraction with a FIXED trip
+    count instead of XLA's convergence loop, emitting both the linear p
+    and the log-form.  The log form comes from ``log(front) + log(cf)``
+    directly — no underflow at any dof in this pipeline — which also
+    retires the separate 40-term deep-tail series the selection scores
+    previously needed.
+
+    Accuracy (validated against scipy f64 over the full (a, b, x) grid
+    this pipeline can produce — n in [6, 40], m in [1, 6], F in [1e-3,
+    1e4]): max relative p error 1.8e-5, p99 6e-6; log-p abs error p99
+    8e-6 including the deep tail; converged by 12 iterations (12 == 24
+    half-steps; the error floor is f32 rounding, not truncation).  That
+    widens the f32 knife-edge band for model-selection ties from ~1e-7
+    to ~2e-5 relative — covered by the f32 tolerance contract and gated
+    by ``tests/test_f32_quality.py``.  The float64 exact path
+    (:func:`_f_stat_p`) keeps ``jax.scipy.special.betainc`` untouched.
     """
-    term = jnp.ones_like(x)
-    s = jnp.ones_like(x)
-    for k in range(terms):
-        term = term * ((a + b + k) / (a + 1.0 + k)) * x
-        s = s + term
-    log_beta = lax.lgamma(a) + lax.lgamma(b) - lax.lgamma(a + b)
-    xs = jnp.maximum(x, jnp.asarray(1e-38, x.dtype))
-    return a * jnp.log(xs) + b * jnp.log1p(-x) - jnp.log(a) - log_beta + jnp.log(s)
+    dtype = x.dtype
+    one = jnp.ones((), dtype)
+    tiny = jnp.asarray(1e-30, dtype)
+    swap = x >= (a + 1.0) / (a + b + 2.0)
+    aa = jnp.where(swap, b, a)
+    bb = jnp.where(swap, a, b)
+    xx = jnp.where(swap, 1.0 - x, x)
+    qab = aa + bb
+    qap = aa + 1.0
+    qam = aa - 1.0
+
+    def guard(z):
+        return jnp.where(jnp.abs(z) < tiny, tiny, z)
+
+    c = jnp.ones_like(xx)
+    d = one / guard(1.0 - qab * xx / qap)
+    h = d
+    for m in range(1, iters + 1):
+        m2 = 2.0 * m
+        num = m * (bb - m) * xx / ((qam + m2) * (aa + m2))
+        d = one / guard(1.0 + num * d)
+        c = guard(1.0 + num / c)
+        h = h * d * c
+        num = -(aa + m) * (qab + m) * xx / ((aa + m2) * (qap + m2))
+        d = one / guard(1.0 + num * d)
+        c = guard(1.0 + num / c)
+        h = h * d * c
+
+    log_front = (
+        aa * jnp.log(jnp.maximum(xx, tiny))
+        + bb * jnp.log1p(-xx)
+        + lax.lgamma(qab)
+        - lax.lgamma(aa)
+        - lax.lgamma(bb)
+        - jnp.log(aa)
+    )
+    p_small = jnp.exp(log_front) * h
+    lp_small = log_front + jnp.log(jnp.maximum(h, tiny))
+    p = jnp.where(swap, 1.0 - p_small, p_small)
+    lp = jnp.where(
+        swap,
+        jnp.log1p(-jnp.minimum(p_small, jnp.asarray(1.0 - 1e-7, dtype))),
+        lp_small,
+    )
+    return p, lp
 
 
 def _f_stat_p_and_logp(ss0, sse, n, m):
@@ -624,10 +670,12 @@ def _f_stat_p_and_logp(ss0, sse, n, m):
     returns 0.0 for *several* family members at once, and the oracle's
     ratio rule ``p <= p_best / best_model_proportion`` degenerates to
     "first model whose p rounds to zero".  The selection score is therefore
-    log p: ``log(betainc)`` wherever betainc is healthy — the SAME
-    algorithm float64 uses, so well-conditioned comparisons round the same
-    way — switching to the hypergeometric series (which computes log p
-    directly, no underflow) only in the deep tail where betainc has died.
+    log p, computed alongside the linear p by the fixed-trip Lentz
+    evaluation (:func:`_betainc_p_and_logp_lentz`) — the log form is
+    underflow-proof at every dof this pipeline produces, so no separate
+    deep-tail series is needed (round 4; the previous
+    ``log(betainc)``+series split cost 3× as much on TPU and its betainc
+    convergence loop dominated the whole scoring stage).
     """
     dtype = ss0.dtype
     df1 = 2.0 * m - 1.0
@@ -641,14 +689,7 @@ def _f_stat_p_and_logp(ss0, sse, n, m):
     f = jnp.maximum(f, 0.0)
     x = df2s / (df2s + df1s * f)
     a, b = df2s / 2.0, df1s / 2.0
-    p_direct = jax.scipy.special.betainc(a, b, x)
-    # deep tail: betainc at/near its floor (denormals start ~1e-38; stay a
-    # couple of decades above so log(p_direct) is still full-precision)
-    tail = p_direct < 1e-30
-    lp_direct = jnp.log(jnp.maximum(p_direct, jnp.asarray(1e-38, dtype)))
-    # series needs x <= 0.5; in the tail x is tiny, clamp the other lanes
-    lp_series = _log_betainc_series(a, b, jnp.where(tail, x, 0.25))
-    lp = jnp.where(tail, lp_series, lp_direct)
+    p_direct, lp = _betainc_p_and_logp_lentz(a, b, x)
     lp = jnp.where(
         invalid, 0.0, jnp.where(perfect, jnp.asarray(_LOGP_PERFECT, dtype), lp)
     )
@@ -738,19 +779,62 @@ def segment_pixel(
         # that the scan formulation never holds at once.  Worth re-timing
         # on real TPU hardware if a profile shows this stage
         # latency-bound rather than bandwidth-bound.
-        m = jnp.sum(vm) - 1  # segments in this model
-        if exact_mode:
-            p = _f_stat_p(ss0, sse, n_valid.astype(dtype), m.astype(dtype))
-            score = p
-        else:
-            p, score = _f_stat_p_and_logp(
-                ss0, sse, n_valid.astype(dtype), m.astype(dtype)
-            )
         vm_next = _remove_weakest(t, y, vm, scale, nv, 2)
-        return vm_next, (vm, p, score)
+        return vm_next, (vm, sse)
 
     with jax.named_scope(SCOPE_MODEL_FAMILY):
-        _, (vmasks, ps, scores) = lax.scan(model_step, vmask, None, length=nm)
+        _, (vmasks, sses) = lax.scan(model_step, vmask, None, length=nm)
+
+    return _select_and_assemble(t, values.astype(dtype), mask, y, vmasks, sses, params)
+
+
+def _select_and_assemble(
+    t: jnp.ndarray,
+    raw: jnp.ndarray,
+    mask: jnp.ndarray,
+    y: jnp.ndarray,
+    vmasks: jnp.ndarray,
+    sses: jnp.ndarray,
+    params: LTParams,
+) -> SegOutputs:
+    """Scoring, model selection, and output assembly for one pixel.
+
+    Shared tail of the pipeline: consumes the despiked series ``y`` and the
+    model family (``vmasks`` (NM, NY) bool, ``sses`` (NM,)) however they
+    were produced — the XLA scan in :func:`segment_pixel` or the Pallas
+    family kernel (:mod:`land_trendr_tpu.ops.segment_pallas`) — and is the
+    single definition of everything from the F-stat scoring onward.
+    ``raw`` is the uncleaned (cast) input series; ``mask`` is the cleaned
+    validity mask; ``t`` the cast year axis.
+    """
+    dtype = t.dtype
+    ny = t.shape[0]
+    nv, nm = params.max_vertices, params.max_segments
+    iota = jnp.arange(ny)
+    exact_mode = dtype == jnp.float64
+
+    n_valid = jnp.sum(mask)
+    enough = n_valid >= params.min_observations_needed
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    y_lo = jnp.min(jnp.where(mask, y, big))
+    y_hi = jnp.max(jnp.where(mask, y, -big))
+    y_range = jnp.maximum(y_hi - y_lo, 0.0)
+    last_v = ny - 1 - jnp.argmax(mask[::-1])
+    t_hi = _gather_1d(t, last_v)
+    ss0 = jnp.sum(jnp.where(mask, (y - jnp.sum(jnp.where(mask, y, 0.0)) / jnp.maximum(n_valid, 1)) ** 2, 0.0))
+
+    # In float64 the selection scores are the linear p values — bit-exact
+    # against the oracle's ratio rule.  In float32 the scores are log p
+    # (underflow-proof; see _f_stat_p_and_logp) and the ratio rule becomes
+    # the equivalent ``lp <= lp_best - log(best_model_proportion)``.
+    ms = jnp.sum(vmasks, axis=-1) - 1  # (NM,) segments per model
+    if exact_mode:
+        ps = _f_stat_p(ss0, sses, n_valid.astype(dtype), ms.astype(dtype))
+        scores = ps
+    else:
+        ps, scores = _f_stat_p_and_logp(
+            ss0, sses, n_valid.astype(dtype), ms.astype(dtype)
+        )
 
     # Selection: most segments whose p is within best_model_proportion of best
     with jax.named_scope(SCOPE_MODEL_SELECT):
@@ -774,7 +858,6 @@ def segment_pixel(
     # statistics come from the RAW valid values; the p-threshold / constant
     # no-fit paths run after despiking and use the despiked series
     # (oracle._flat_result's despiked_valid argument).
-    raw = values.astype(dtype)
     has_any = n_valid > 0
     n_safe = jnp.maximum(n_valid, 1)
     mean_desp = jnp.where(has_any, jnp.sum(jnp.where(mask, y, 0.0)) / n_safe, 0.0)

@@ -1,0 +1,617 @@
+"""Pallas TPU kernel for the LandTrendr heavy middle (stages 1–4a).
+
+Why this exists (measured, TPU_KERNEL_DIAG_r04.md): after the round-4
+one-hot rewrite the XLA kernel is *instruction-bound* at ~3.4M px/s — and
+its ceiling is set by layout, not math.  In the ``(px, NY)`` layout every
+vector register carries NY=40 useful lanes out of 128 (3.2× instruction
+inflation), and the stage boundaries (while-loop carries, reductions)
+force HBM round trips between fused groups.  This kernel flips the block
+layout to ``(NY, BLK)`` — years on sublanes (40 = 5 exact f32 sublane
+tiles, zero padding), pixels on lanes — and keeps each block VMEM-resident
+across ALL stages, so the whole per-pixel pipeline costs one HBM read and
+one write.  A despike-only prototype measured 24.1M px/s against the XLA
+stage's 3.8M on the same chip with bit-identical output.
+
+Division of labour
+------------------
+The Pallas kernel computes the despiked series, the NM model-family vertex
+masks, and each model's fitted SSE.  Everything from F-stat scoring onward
+(betainc, selection, chosen-model refit, output assembly) stays in XLA via
+:func:`land_trendr_tpu.ops.segment._select_and_assemble` — the single
+shared tail both execution paths use.  ``jax.scipy.special.betainc`` has
+no Mosaic lowering, and the tail is a small fraction of kernel time.
+
+Semantics
+---------
+Decision-for-decision the same pipeline as :mod:`.segment` (which is the
+parity-tested re-expression of the oracle).  Dynamic per-pixel reads use
+the same two gather-free forms as the XLA kernel, re-expressed in the
+year-major layout:
+
+* nearest/previous-valid and vertex-cache reads → log-doubling
+  forward/backward fills along the sublane (year) axis;
+* vertex-slot reads (``t[vpos[k]]``) → rank-keyed masked reductions,
+  where the rank is an exact int32 prefix sum of the vertex mask.
+
+Fill/rank reads are *selected* elements (never arithmetic combinations),
+and every arithmetic expression replicates the slot-space kernel's
+operation order, so float results match the XLA kernel bit-for-bit on the
+same platform up to reduction-order-neutral sums (verified by the parity
+suites; the despike prototype matched exactly).  Mosaic portability notes:
+boolean concatenate hits an ``i1`` vreg-cast bug in the tunnel's Mosaic,
+so fill carries are f32 0/1; 1-D iota is illegal on TPU, so all index
+vectors are ``broadcasted_iota``; argmax/argmin tie-breaks are expressed
+as min-index-over-equal-to-extremum, which reproduces the oracle's
+first-index rule in year order (== rank order, since vertex positions are
+sorted).
+
+Float64: Mosaic has no f64, so the compiled kernel is f32-only.  The
+``interpret=True`` path executes the same trace with stock JAX ops on CPU
+— dtype-generic, used by the f64 oracle-parity tests in
+``tests/test_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import SegOutputs, _select_and_assemble
+
+__all__ = [
+    "jax_segment_pixels_pallas",
+    "jax_segment_pixels_pallas_chunked",
+    "family_stats_pallas",
+]
+
+
+def _shift(a: jnp.ndarray, sh: int, *, reverse: bool) -> jnp.ndarray:
+    """Shift along the year (sublane) axis by a static amount, zero-filling."""
+    if reverse:
+        return jnp.concatenate([a[sh:], jnp.zeros_like(a[:sh])], axis=0)
+    return jnp.concatenate([jnp.zeros_like(a[:sh]), a[:-sh]], axis=0)
+
+
+def _fill(vals, valid_f, *, exclusive: bool, reverse: bool):
+    """``(filled, has_f)`` nearest-valid fill along years; f32 0/1 carries."""
+    ny = vals.shape[0]
+    zero = jnp.zeros((), vals.dtype)
+    v = jnp.where(valid_f > 0, vals, zero)
+    has = valid_f
+    if exclusive:
+        v, has = _shift(v, 1, reverse=reverse), _shift(has, 1, reverse=reverse)
+    sh = 1
+    while sh < ny:
+        hb = has > 0
+        v = jnp.where(hb, v, _shift(v, sh, reverse=reverse))
+        has = jnp.maximum(has, _shift(has, sh, reverse=reverse))
+        sh *= 2
+    return v, has
+
+
+def _fill2(vals_a, vals_b, valid_f, *, exclusive: bool, reverse: bool):
+    """Two fills sharing one has-chain (same valid mask)."""
+    ny = vals_a.shape[0]
+    zero_a = jnp.zeros((), vals_a.dtype)
+    zero_b = jnp.zeros((), vals_b.dtype)
+    va = jnp.where(valid_f > 0, vals_a, zero_a)
+    vb = jnp.where(valid_f > 0, vals_b, zero_b)
+    has = valid_f
+    if exclusive:
+        va = _shift(va, 1, reverse=reverse)
+        vb = _shift(vb, 1, reverse=reverse)
+        has = _shift(has, 1, reverse=reverse)
+    sh = 1
+    while sh < ny:
+        hb = has > 0
+        va = jnp.where(hb, va, _shift(va, sh, reverse=reverse))
+        vb = jnp.where(hb, vb, _shift(vb, sh, reverse=reverse))
+        has = jnp.maximum(has, _shift(has, sh, reverse=reverse))
+        sh *= 2
+    return va, vb, has
+
+
+def _prefix_sum_incl(a_i32: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive int32 prefix sum along years (log-shift adds — exact)."""
+    ny = a_i32.shape[0]
+    s = a_i32
+    sh = 1
+    while sh < ny:
+        s = s + _shift(s, sh, reverse=False)
+        sh *= 2
+    return s
+
+
+def _prefix_max_incl(a_i32: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive int32 prefix max along years (log-shift — exact).
+
+    Zero-fill shifts would corrupt negative carries, so shift a biased
+    non-negative copy instead.
+    """
+    ny = a_i32.shape[0]
+    s = a_i32 + ny  # bias: values in [-1, ny) -> [ny-1, 2ny)
+    sh = 1
+    while sh < ny:
+        s = jnp.maximum(s, _shift(s, sh, reverse=False))
+        sh *= 2
+    return s - ny
+
+
+def _first_true_idx(b, iota, ny):
+    """Smallest year index where ``b`` (bool) holds; NY when none. (1, BLK)."""
+    return jnp.min(jnp.where(b, iota, ny), axis=0, keepdims=True)
+
+
+def _last_true_idx(b, iota):
+    """Largest year index where ``b`` holds; -1 when none. (1, BLK)."""
+    return jnp.max(jnp.where(b, iota, -1), axis=0, keepdims=True)
+
+
+def _pick_at(a, iota, idx):
+    """Value of ``a`` at year index ``idx`` ((1, BLK)); 0 when idx == NY."""
+    zero = jnp.zeros((), a.dtype)
+    return jnp.sum(jnp.where(iota == idx, a, zero), axis=0, keepdims=True)
+
+
+def _masked_ols_ys(t, y, member_f):
+    """(intercept, slope) (1, BLK) — replicates segment._masked_ols exactly."""
+    dtype = t.dtype
+    one = jnp.ones((), dtype)
+    zero = jnp.zeros((), dtype)
+    n = jnp.sum(member_f, axis=0, keepdims=True)
+    n_safe = jnp.maximum(n, one)
+    tm = jnp.sum(member_f * t, axis=0, keepdims=True) / n_safe
+    ym = jnp.sum(member_f * y, axis=0, keepdims=True) / n_safe
+    tc = (t - tm) * member_f
+    stt = jnp.sum(tc * (t - tm), axis=0, keepdims=True)
+    sty = jnp.sum(tc * (y - ym), axis=0, keepdims=True)
+    ok = (n >= 2.0) & (stt > zero)
+    slope = jnp.where(ok, sty / jnp.where(ok, stt, one), zero)
+    intercept = ym - slope * tm
+    return intercept, slope
+
+
+def _clamp_slope_ys(slope, duration, y_range, params: LTParams):
+    """Recovery-rate constraints — replicates segment._clamp_slope."""
+    dtype = slope.dtype
+    zero = jnp.zeros((), dtype)
+    limit = -jnp.asarray(params.recovery_threshold, dtype) * y_range
+    clamped = jnp.maximum(slope, limit)
+    if params.prevent_one_year_recovery:
+        clamped = jnp.where(duration <= 1.0, zero, clamped)
+    active = (slope < zero) & (y_range > zero)
+    return jnp.where(active, clamped, slope)
+
+
+# Mosaic has no atan lowering; the angle cull needs one.  Degree-10-in-z²
+# Chebyshev-fitted odd polynomial on [0,1] + the |x|>1 reciprocal reduction:
+# measured max error 1.0e-7 (~1.7 f32 ulp at pi/4 scale, dominated by f32
+# Horner rounding) against np.arctan over a 2M-point grid.  Used ONLY in
+# compiled mode — interpret mode keeps jnp.arctan so the f64 parity tests
+# bit-match the oracle; compiled-mode f32 angle comparisons may flip at
+# 1-2-ulp knife edges, which the f32 tolerance contract covers (measured:
+# see tests/test_pallas.py and PARITY_f32_tpu.json methodology).
+_ATAN_COEFS = (
+    0.9999999996147207,
+    -0.3333332366695538,
+    0.19999595880653254,
+    -0.14279048657228555,
+    0.11053785942171465,
+    -0.08796121057076967,
+    0.0671012036450899,
+    -0.04427374044156659,
+    0.022203503960703006,
+    -0.007166183020119105,
+    0.0010844955030828492,
+)
+
+
+def _atan_poly(x: jnp.ndarray) -> jnp.ndarray:
+    dtype = x.dtype
+    one = jnp.ones((), dtype)
+    ax = jnp.abs(x)
+    big = ax > one
+    z = jnp.where(big, one / jnp.maximum(ax, jnp.asarray(1e-30, dtype)), ax)
+    u = z * z
+    acc = jnp.asarray(_ATAN_COEFS[-1], dtype) + jnp.zeros_like(u)
+    for c in _ATAN_COEFS[-2::-1]:
+        acc = acc * u + jnp.asarray(c, dtype)
+    r = z * acc
+    half_pi = jnp.asarray(1.5707963267948966, dtype)
+    r = jnp.where(big, half_pi - r, r)
+    return jnp.where(x < 0, -r, r)
+
+
+def _remove_weakest_ys(t, y, vmask_f, iota, scale, keep_above: int, exact_atan: bool):
+    """Drop the min-angle interior vertex while count > keep_above (one step)."""
+    dtype = t.dtype
+    ny = t.shape[0]
+    one = jnp.ones((), dtype)
+    t_lo, t_hi, y_lo, y_hi = scale
+    t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, one)
+    y_rng = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
+    xs = (t - t_lo) / t_rng
+    ys = (y - y_lo) / y_rng
+    xp, yp, hasp = _fill2(xs, ys, vmask_f, exclusive=True, reverse=False)
+    xq, yq, hasq = _fill2(xs, ys, vmask_f, exclusive=True, reverse=True)
+    interior = (vmask_f > 0) & (hasp > 0) & (hasq > 0)
+    dx1 = jnp.where(interior, xs - xp, one)
+    dx2 = jnp.where(interior, xq - xs, one)
+    s1 = (ys - yp) / dx1
+    s2 = (yq - ys) / dx2
+    atan = jnp.arctan if exact_atan else _atan_poly
+    ang = jnp.abs(atan(s2) - atan(s1))
+    big = jnp.asarray(1e30, dtype)  # > pi; replaces slot-space +inf sentinel
+    ang = jnp.where(interior, ang, big)
+    mn = jnp.min(ang, axis=0, keepdims=True)
+    pos = _first_true_idx(ang == mn, iota, ny)
+    n_verts = jnp.sum(vmask_f, axis=0, keepdims=True)
+    do = n_verts > float(keep_above)
+    return jnp.where(do & (iota == pos), jnp.zeros((), dtype), vmask_f)
+
+
+def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
+    """One model's anchored fit + p2p fallback; returns SSE (1, BLK).
+
+    Year-major re-expression of segment._fit_model with identical
+    arithmetic per decision; vertex-slot reads become rank-keyed masked
+    reductions and seg-of-year reads become fills.
+    """
+    dtype = t.dtype
+    ny = t.shape[0]
+    nv = params.max_vertices
+    one = jnp.ones((), dtype)
+    zero = jnp.zeros((), dtype)
+    vb = vmask_f > 0
+    m = m_f > 0
+
+    n_verts = jnp.sum(vmask_f, axis=0, keepdims=True)
+    rank = _prefix_sum_incl(vmask_f.astype(jnp.int32)) - 1  # (NY, BLK)
+
+    # vertex-slot positions / values: a_k == vpos[k] (NY sentinel when dead)
+    a = []
+    tv = []
+    for k in range(nv):
+        sel = vb & (rank == k)
+        a.append(_first_true_idx(sel, iota, ny))
+        tv.append(jnp.sum(jnp.where(sel, t, zero), axis=0, keepdims=True))
+
+    # --- segment 0: OLS over closed [v0, v1] ---
+    member0 = (iota >= a[0]) & (iota <= a[1]) & m
+    m0 = member0.astype(dtype)
+    c0, c1 = _masked_ols_ys(t, y, m0)
+    dur0 = tv[1] - tv[0]
+    c1c = _clamp_slope_ys(c1, dur0, y_range, params)
+    n0 = jnp.maximum(jnp.sum(m0, axis=0, keepdims=True), one)
+    c0 = jnp.sum(m0 * y, axis=0, keepdims=True) / n0 - c1c * (
+        jnp.sum(m0 * t, axis=0, keepdims=True) / n0
+    )
+    fitted = jnp.where(member0, c0 + c1c * t, zero)
+    anchor_t = tv[1]
+    anchor_y = c0 + c1c * anchor_t
+
+    # --- segments 1..: slope-only regression through the anchor ---
+    for k in range(1, nv - 1):
+        active = (k + 1.0) < n_verts
+        member = (iota > a[k]) & (iota <= a[k + 1]) & m & active
+        mf = member.astype(dtype)
+        dt = (t - anchor_t) * mf
+        denom = jnp.sum(dt * dt, axis=0, keepdims=True)
+        slope = jnp.where(
+            denom > zero,
+            jnp.sum(dt * (y - anchor_y), axis=0, keepdims=True)
+            / jnp.where(denom > zero, denom, one),
+            zero,
+        )
+        slope = _clamp_slope_ys(slope, tv[k + 1] - anchor_t, y_range, params)
+        fitted = jnp.where(member, anchor_y + slope * (t - anchor_t), fitted)
+        new_anchor_y = anchor_y + slope * (tv[k + 1] - anchor_t)
+        anchor_t = jnp.where(active, tv[k + 1], anchor_t)
+        anchor_y = jnp.where(active, new_anchor_y, anchor_y)
+
+    # --- point-to-point fallback ---
+    # per-year segment quantities: value at year i = value of the segment
+    # whose START vertex is the largest vertex <= i, where the last vertex
+    # belongs to the segment *ending* at it (slot-space min(rank, n-2))
+    tnx, ynx, hasnx = _fill2(t, y, vmask_f, exclusive=True, reverse=True)
+    dy_f = ynx - y
+    dur_f = tnx - t
+    viol = (dy_f < zero) & (y_range > zero) & (dur_f > zero)
+    if params.prevent_one_year_recovery:
+        fast = dur_f <= 1.0
+    else:
+        fast = jnp.zeros_like(viol)
+    eps_rate = jnp.asarray(1e-12, dtype)  # segment._EPS_RATE
+    viol = viol & (
+        fast
+        | (
+            (-dy_f) / jnp.where(dur_f > zero, dur_f, one)
+            > jnp.asarray(params.recovery_threshold, dtype) * y_range + eps_rate
+        )
+    )
+    startv = vb & (hasnx > 0)  # vertices that start a segment
+    p2p_ok = ~jnp.any(viol & startv, axis=0, keepdims=True)
+    rate_f = jnp.where(dur_f > zero, dy_f / jnp.where(dur_f > zero, dur_f, one), zero)
+
+    a0_pos = _first_true_idx(vb, iota, ny)
+    last_pos = _last_true_idx(vb, iota)
+    vmask_nl = jnp.where(iota == last_pos, zero, vmask_f)  # drop last vertex
+    t_a, y_a, has_a = _fill2(t, y, vmask_nl, exclusive=False, reverse=False)
+    rate_of, _ = _fill(rate_f, vmask_nl, exclusive=False, reverse=False)
+    member_y = (iota >= a0_pos) & (iota <= last_pos) & m & (has_a > 0)
+    p2p0 = jnp.where((iota == a0_pos) & m, y, zero)
+    p2p = jnp.where(member_y, y_a + rate_of * (t - t_a), p2p0)
+
+    span = m & (iota >= a0_pos) & (iota <= last_pos)
+    sse_reg = jnp.sum(jnp.where(span, (y - fitted) ** 2, zero), axis=0, keepdims=True)
+    sse_p2p = jnp.sum(jnp.where(span, (y - p2p) ** 2, zero), axis=0, keepdims=True)
+    use_p2p = p2p_ok & (sse_p2p < sse_reg)
+    return jnp.where(use_p2p, sse_p2p, sse_reg)
+
+
+def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
+    """Build the Pallas kernel body for static (NY, BLK, params)."""
+    nv, nc, nm = params.max_vertices, params.max_candidates, params.max_segments
+
+    def kernel(t_ref, v_ref, m_ref, desp_ref, vm_ref, sse_ref):
+        dtype = v_ref.dtype
+        one = jnp.ones((), dtype)
+        zero = jnp.zeros((), dtype)
+        t = t_ref[:, 0:1] + jnp.zeros((ny, blk), dtype)  # broadcast year axis
+        m_f = m_ref[:]
+        m = m_f > 0
+        y = jnp.where(m, v_ref[:], zero)
+        iota = lax.broadcasted_iota(jnp.int32, (ny, blk), 0)
+        n_valid = jnp.sum(m_f, axis=0, keepdims=True)
+
+        # ---- Stage 1: despike (early-exit per BLOCK, not per batch) ----
+        if params.spike_threshold < 1.0:
+            tp, hasp = _fill(t, m_f, exclusive=True, reverse=False)
+            tq, hasq = _fill(t, m_f, exclusive=True, reverse=True)
+            interior = m & (hasp > 0) & (hasq > 0)
+            dtp = t - tp
+            denom = jnp.where(interior, tq - tp, one)
+
+            def body(carry):
+                it, y, _ = carry
+                yp, _ = _fill(y, m_f, exclusive=True, reverse=False)
+                yq, _ = _fill(y, m_f, exclusive=True, reverse=True)
+                itp = yp + (yq - yp) * dtp / denom
+                dev = jnp.abs(y - itp)
+                crossing = jnp.abs(yq - yp)
+                prop = jnp.where(
+                    dev > zero,
+                    jnp.maximum(zero, one - crossing / jnp.where(dev > zero, dev, one)),
+                    zero,
+                )
+                prop = jnp.where(interior, prop, -one)
+                mx = jnp.max(prop, axis=0, keepdims=True)
+                i_first = _first_true_idx(prop == mx, iota, ny)
+                do = (mx > params.spike_threshold) & (it < n_valid)
+                oh = iota == i_first
+                delta = jnp.where(
+                    do, (_pick_at(itp, iota, i_first) - _pick_at(y, iota, i_first)) * mx, zero
+                )
+                return it + one, y + jnp.where(oh, delta, zero), jnp.any(do)
+
+            def cond(carry):
+                it, _, cont = carry
+                return cont & (it[0, 0] < ny)
+
+            _, y, _ = lax.while_loop(
+                cond, body, (jnp.zeros((1, blk), dtype), y, jnp.asarray(True))
+            )
+        desp_ref[:] = y
+
+        # ---- shared scalars ----
+        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+        y_lo = jnp.min(jnp.where(m, y, big), axis=0, keepdims=True)
+        y_hi = jnp.max(jnp.where(m, y, -big), axis=0, keepdims=True)
+        y_range = jnp.maximum(y_hi - y_lo, zero)
+        first_v = _first_true_idx(m, iota, ny)
+        last_v = _last_true_idx(m, iota)
+        t_lo = _pick_at(t, iota, first_v)
+        t_hi = _pick_at(t, iota, last_v)
+        scale = (t_lo, t_hi, y_lo, y_hi)
+
+        # ---- Stage 2: candidate vertices (max-deviation insertion) ----
+        vmask_f = jnp.where(m & ((iota == first_v) | (iota == last_v)), one, zero)
+        lo0 = _first_true_idx(vmask_f > 0, iota, ny)
+        member_i = (iota >= lo0) & (iota <= _last_true_idx(vmask_f > 0, iota)) & m
+        c0i, c1i = _masked_ols_ys(t, y, member_i.astype(dtype))
+        c0v = jnp.where(iota == lo0, c0i, zero)
+        c1v = jnp.where(iota == lo0, c1i, zero)
+
+        for _ in range(nc - 2):
+            c0_at, _h = _fill(c0v, vmask_f, exclusive=False, reverse=False)
+            c1_at, _h = _fill(c1v, vmask_f, exclusive=False, reverse=False)
+            dev = jnp.abs(y - (c0_at + c1_at * t))
+            fv = _first_true_idx(vmask_f > 0, iota, ny)
+            lv = _last_true_idx(vmask_f > 0, iota)
+            eligible = m & ~(vmask_f > 0) & (iota > fv) & (iota < lv)
+            dev = jnp.where(eligible, dev, -one)
+            mx = jnp.max(dev, axis=0, keepdims=True)
+            i_first = _first_true_idx(dev == mx, iota, ny)
+            do = mx >= zero
+            seg_start = jnp.clip(
+                _prefix_max_incl(jnp.where(vmask_f > 0, iota, -1)), 0, ny - 1
+            )
+            lo = jnp.sum(
+                jnp.where(iota == i_first, seg_start, 0), axis=0, keepdims=True
+            )
+            hi = jnp.clip(
+                jnp.min(
+                    jnp.where((vmask_f > 0) & (iota > i_first), iota, ny),
+                    axis=0,
+                    keepdims=True,
+                ),
+                0,
+                ny - 1,
+            )
+            mem_a = (iota >= lo) & (iota <= i_first) & m
+            mem_b = (iota >= i_first) & (iota <= hi) & m
+            c0a, c1a = _masked_ols_ys(t, y, mem_a.astype(dtype))
+            c0b, c1b = _masked_ols_ys(t, y, mem_b.astype(dtype))
+            # overwrite order: i wins a lo == i collision
+            c0v = jnp.where(
+                do & (iota == i_first), c0b, jnp.where(do & (iota == lo), c0a, c0v)
+            )
+            c1v = jnp.where(
+                do & (iota == i_first), c1b, jnp.where(do & (iota == lo), c1a, c1v)
+            )
+            vmask_f = jnp.where(do & (iota == i_first), one, vmask_f)
+
+        # ---- Stage 2b: angle cull ----
+        for _ in range(params.vertex_count_overshoot):
+            vmask_f = _remove_weakest_ys(t, y, vmask_f, iota, scale, nv, exact_atan)
+
+        # ---- Stage 4a: model family (fit SSE, then prune weakest) ----
+        for k in range(nm):
+            vm_ref[k] = vmask_f
+            sse = _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params)
+            sse_ref[k] = sse[0]
+            if k + 1 < nm:
+                vmask_f = _remove_weakest_ys(t, y, vmask_f, iota, scale, 2, exact_atan)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "block", "interpret")
+)
+def family_stats_pallas(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+    block: int = 1024,
+    interpret: bool = False,
+):
+    """Run the Pallas family kernel over a ``(PX, NY)`` batch.
+
+    Returns ``(despiked (PX, NY), vmasks (PX, NM, NY) bool, sses (PX, NM))``
+    — the inputs :func:`segment._select_and_assemble` needs.  PX must be a
+    multiple of ``block`` (pad with fully-masked rows first).
+    """
+    px, ny = values.shape
+    block = min(block, px)  # small batches: one block per batch
+    if px % block:
+        raise ValueError(f"pixel count {px} not a multiple of block {block}")
+    nm = params.max_segments
+    dtype = jnp.result_type(values.dtype, jnp.float32)
+    if not interpret and jax.config.jax_enable_x64:
+        # Mosaic's 64-bit-emulation convert_element_type lowering recurses
+        # into itself (observed: infinite jaxpr_subcomp <-> convert loop
+        # when tracing this kernel under jax_enable_x64), and re-tracing
+        # under a nested enable_x64(False) context inside an outer x64
+        # trace still leaks 64-bit weak types into the kernel.  Fail loud
+        # with the working recipe instead of hanging the compiler.
+        raise RuntimeError(
+            "compiled Pallas kernel cannot trace under jax_enable_x64; "
+            "wrap the call in `with jax.enable_x64(False):` at top level "
+            "(f32 inputs), or pass interpret=True for the f64 path"
+        )
+
+    t_col = jnp.broadcast_to(years.astype(dtype)[:, None], (ny, 128))
+    mask_b = mask.astype(bool) & jnp.isfinite(values)
+    v_T = values.astype(dtype).T
+    m_T = mask_b.astype(dtype).T
+
+    kernel = _make_family_kernel(ny, block, params, exact_atan=interpret)
+    grid = (px // block,)
+    desp_T, vm_T, sse_T = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ny, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ny, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ny, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((ny, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nm, ny, block), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nm, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ny, px), dtype),
+            jax.ShapeDtypeStruct((nm, ny, px), dtype),
+            jax.ShapeDtypeStruct((nm, px), dtype),
+        ],
+        interpret=interpret,
+    )(t_col, v_T, m_T)
+    despiked = desp_T.T
+    vmasks = jnp.transpose(vm_T, (2, 0, 1)) > 0
+    sses = sse_T.T
+    return despiked, vmasks, sses
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "chunk", "block", "interpret")
+)
+def jax_segment_pixels_pallas_chunked(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+    chunk: int = 262144,
+    block: int = 1024,
+    interpret: bool = False,
+) -> SegOutputs:
+    """:func:`jax_segment_pixels_pallas` with HBM bounded by ``chunk`` pixels.
+
+    Same contract as :func:`segment.jax_segment_pixels_chunked`: the pixel
+    count must be a multiple of ``chunk`` (pad with fully-masked rows), and
+    ``lax.map`` streams the chunks through one compiled program.  Bounding
+    the chunk also bounds the (chunk, NM, NY) family intermediates the
+    Pallas path materialises between its kernel and the XLA tail.
+    """
+    px = values.shape[0]
+    if px % chunk:
+        raise ValueError(
+            f"pixel count {px} not a multiple of chunk {chunk}; pad first"
+        )
+    v = values.reshape(px // chunk, chunk, values.shape[1])
+    m = mask.reshape(px // chunk, chunk, mask.shape[1])
+    out = lax.map(
+        lambda vm: jax_segment_pixels_pallas(
+            years, vm[0], vm[1], params, block, interpret
+        ),
+        (v, m),
+    )
+    return SegOutputs(*(o.reshape(px, *o.shape[2:]) for o in out))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "block", "interpret")
+)
+def jax_segment_pixels_pallas(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+    block: int = 1024,
+    interpret: bool = False,
+) -> SegOutputs:
+    """:func:`segment.jax_segment_pixels` with the heavy middle on Pallas.
+
+    Same signature and output contract; PX must be a multiple of ``block``
+    (use :func:`land_trendr_tpu.parallel.pad_to_multiple`).  On CPU pass
+    ``interpret=True`` (Mosaic is TPU-only); interpret mode is
+    dtype-generic, which is how the f64 oracle-parity tests drive it.
+    """
+    dtype = jnp.result_type(values.dtype, jnp.float32)
+    despiked, vmasks, sses = family_stats_pallas(
+        years, values, mask, params, block, interpret
+    )
+    t = years.astype(dtype)
+    mask_b = mask.astype(bool) & jnp.isfinite(values)
+    raw = values.astype(dtype)
+    return jax.vmap(
+        lambda r, mb, y, vms, ss: _select_and_assemble(t, r, mb, y, vms, ss, params)
+    )(raw, mask_b, despiked, vmasks, sses)

@@ -55,11 +55,41 @@ class TileOutputs(NamedTuple):
     change: "dict[str, jnp.ndarray] | None" = None
 
 
+#: lane-axis block of the Pallas family kernel (segment_pallas); tile
+#: pixel counts are padded up to a multiple of this, and chunk sizes used
+#: with impl="pallas" must divide by it
+PALLAS_BLOCK = 1024
+
+
+def resolve_impl(impl: str) -> str:
+    """Resolve an ``impl`` choice ("auto"/"pallas"/"xla") to a concrete one.
+
+    "auto" picks the Pallas family kernel only where its compiled form can
+    actually run: a TPU backend without ``jax_enable_x64`` (Mosaic is
+    f32-only and its x64-mode lowering is broken — see
+    ``segment_pallas.family_stats_pallas``).  The resolved value — not
+    "auto" — is what belongs in run fingerprints, so a resume cannot mix
+    implementations across backends.
+    """
+    if impl == "auto":
+        import jax as _jax
+
+        return (
+            "pallas"
+            if _jax.default_backend() == "tpu"
+            and not _jax.config.jax_enable_x64
+            else "xla"
+        )
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"impl={impl!r} not one of 'auto', 'pallas', 'xla'")
+    return impl
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "index", "ftv_indices", "params", "scale", "offset", "reject_bits",
-        "chunk", "change_filt",
+        "chunk", "change_filt", "impl",
     ),
 )
 def process_tile_dn(
@@ -74,6 +104,7 @@ def process_tile_dn(
     reject_bits: int = idx.DEFAULT_QA_REJECT,
     chunk: int | None = None,
     change_filt: ChangeFilter | None = None,
+    impl: str = "auto",
 ) -> TileOutputs:
     """Segment one tile straight from Collection-2 style DNs.
 
@@ -95,12 +126,48 @@ def process_tile_dn(
         multiple with fully-masked rows and cropped back, so results are
         identical to the unchunked path (see the chunked kernel's
         contract).
+    impl : segmentation kernel implementation — "auto" (Pallas family
+        kernel on a TPU backend, XLA elsewhere; the round-4 measured
+        default), "pallas", or "xla".  The two are decision-identical
+        (tests/test_pallas.py; PARITY_f32_tpu_pallas.json); Pallas is
+        ~3.3x faster on TPU v5 lite (BENCH_r04.json).
     """
     sr = {name: idx.scale_sr(dn, scale, offset) for name, dn in dn_bands.items()}
     mask = idx.qa_valid_mask(qa, reject_bits) & idx.sr_valid_mask(sr)
     primary = idx.compute_index(index, sr)
     px = primary.shape[0]
-    if chunk is not None and px > chunk:
+    impl = resolve_impl(impl)
+    if impl == "pallas":
+        from land_trendr_tpu.ops.segment_pallas import (
+            jax_segment_pixels_pallas,
+            jax_segment_pixels_pallas_chunked,
+        )
+
+        # the Pallas grid needs PX % block == 0; pad with masked rows
+        # (padded rows come back model_valid=False and are cropped).
+        # Mosaic only compiles on TPU — an explicit impl="pallas" on any
+        # other backend runs interpret mode (slow; for debugging parity).
+        blk = PALLAS_BLOCK
+        interp = jax.default_backend() != "tpu"
+        primary_p, mask_p, _ = pad_to_multiple(primary, mask, blk)
+        if chunk is not None and primary_p.shape[0] > chunk:
+            if chunk > blk and chunk % blk:
+                raise ValueError(
+                    f"chunk={chunk} must be a multiple of the Pallas block "
+                    f"({blk}) when impl='pallas' — adjust chunk_px or use "
+                    "impl='xla'"
+                )
+            primary_p, mask_p, _ = pad_to_multiple(primary_p, mask_p, chunk)
+            seg = jax_segment_pixels_pallas_chunked(
+                years, primary_p, mask_p, params, chunk, blk, interp
+            )
+        else:
+            seg = jax_segment_pixels_pallas(
+                years, primary_p, mask_p, params, blk, interp
+            )
+        if primary_p.shape[0] != px:
+            seg = SegOutputs(*(o[:px] for o in seg))
+    elif chunk is not None and px > chunk:
         primary_p, mask_p, _ = pad_to_multiple(primary, mask, chunk)
         seg = jax_segment_pixels_chunked(years, primary_p, mask_p, params, chunk)
         if primary_p.shape[0] != px:

@@ -45,7 +45,7 @@ from land_trendr_tpu.io import native
 from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
-from land_trendr_tpu.ops.tile import process_tile_dn
+from land_trendr_tpu.ops.tile import process_tile_dn, resolve_impl
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
     TileManifest,
@@ -118,6 +118,10 @@ class RunConfig:
     #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
     #: what a 256² tile needs by 16×).  ``None`` disables chunking.
     chunk_px: int | None = 262_144
+    #: segmentation kernel implementation: "auto" (Pallas family kernel on
+    #: a TPU backend, XLA elsewhere — the round-4 measured default, ~3.3×
+    #: faster on v5 lite with identical decisions), "pallas", or "xla".
+    impl: str = "auto"
 
     def __post_init__(self) -> None:
         # fail fast: an invalid choice must not surface only at
@@ -131,6 +135,23 @@ class RunConfig:
             raise ValueError(
                 f"manifest_compress={self.manifest_compress!r} not one of "
                 f"{ARTIFACT_COMPRESS}"
+            )
+        if self.impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"impl={self.impl!r} not one of 'auto', 'pallas', 'xla'"
+            )
+        if (
+            resolve_impl(self.impl) == "pallas"
+            and self.chunk_px is not None
+            and self.chunk_px > 1024
+            and self.chunk_px % 1024
+        ):
+            # ops.tile.PALLAS_BLOCK (chunks <= the block clamp the block
+            # instead); checked here so a bad combination fails at config
+            # time, not mid-run at kernel trace time
+            raise ValueError(
+                f"chunk_px={self.chunk_px} must be a multiple of 1024 "
+                "(the Pallas block) when the resolved impl is 'pallas'"
             )
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
@@ -167,6 +188,12 @@ class RunConfig:
                 # mesh device count is checked separately via the manifest
                 # header's context (assembly must stay mesh-blind).
                 "chunk_px": self.chunk_px,
+                # same class of effect as chunk_px: the Pallas and XLA
+                # kernels are decision-identical only up to f32 knife
+                # edges, so a resume must not mix implementations.  The
+                # RESOLVED implementation is fingerprinted — "auto" on a
+                # TPU host and "auto" on a CPU host are different kernels
+                "impl": resolve_impl(self.impl),
             }
         )
 
@@ -434,6 +461,7 @@ def run_stack(
                         reject_bits=cfg.reject_bits,
                         chunk=chunk,
                         change_filt=cfg.change_filt,
+                        impl=cfg.impl,
                     ),
                     None,
                 )

@@ -1,0 +1,161 @@
+"""Pallas family-kernel equivalence vs the XLA reference kernel.
+
+The Pallas path (:mod:`land_trendr_tpu.ops.segment_pallas`) must be
+decision- and value-identical to the XLA kernel, which is itself
+parity-tested against the oracle (tests/test_parity.py).  Mosaic only
+compiles on TPU, so these tests drive ``interpret=True`` — the same trace
+executed with stock JAX ops, dtype-generic — which is exactly the mode the
+f64 contract relies on.  Real-hardware evidence for the compiled kernel
+lives in the committed artifacts: ``PARITY_f32_tpu_pallas.json`` (99.99%
+exact vertex agreement vs the f64 oracle at 65536 px, identical to the
+XLA kernel's artifact) and BENCH_r04.json (the Pallas path's north-star
+number).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.ops.segment import jax_segment_pixels
+from land_trendr_tpu.ops.segment_pallas import (
+    family_stats_pallas,
+    jax_segment_pixels_pallas,
+    jax_segment_pixels_pallas_chunked,
+)
+
+from tools._population import make_population
+
+NY = 40
+PARAMS = LTParams()
+
+
+def _population(px, seed=0):
+    rng = np.random.default_rng(seed)
+    years, vals, mask = make_population(rng, px, NY)
+    return years.astype(np.float64), vals.astype(np.float64), mask
+
+
+def _assert_outputs_equal(out_a, out_b, *, exact=True):
+    for f in out_a._fields:
+        a, b = np.asarray(getattr(out_a, f)), np.asarray(getattr(out_b, f))
+        if exact:
+            np.testing.assert_array_equal(a, b, err_msg=f)
+        else:
+            np.testing.assert_allclose(a, b, rtol=0, atol=1e-6, err_msg=f)
+
+
+def test_f64_interpret_bit_exact_vs_xla_kernel():
+    """Every output field bit-identical to the XLA kernel in f64."""
+    years, vals, mask = _population(512, seed=1)
+    out_x = jax_segment_pixels(years, vals, mask, PARAMS)
+    out_p = jax_segment_pixels_pallas(
+        years, vals, mask, PARAMS, block=256, interpret=True
+    )
+    _assert_outputs_equal(out_x, out_p, exact=True)
+
+
+def test_f64_interpret_bit_exact_masked_edge_cases():
+    """All-masked, single-valid, and min-obs-boundary pixels included."""
+    years, vals, mask = _population(256, seed=2)
+    mask = mask.copy()
+    mask[0] = False                      # all-invalid pixel
+    mask[1] = False
+    mask[1, 7] = True                    # single valid year
+    mask[2] = False
+    mask[2, : PARAMS.min_observations_needed] = True  # exactly min-obs
+    vals = vals.copy()
+    vals[3, 5] = np.nan                  # non-finite input -> masked
+    out_x = jax_segment_pixels(years, vals, mask, PARAMS)
+    out_p = jax_segment_pixels_pallas(
+        years, vals, mask, PARAMS, block=256, interpret=True
+    )
+    _assert_outputs_equal(out_x, out_p, exact=True)
+
+
+def test_f64_interpret_param_variants():
+    """Despike-off and no-one-year-recovery parameter branches."""
+    years, vals, mask = _population(256, seed=3)
+    for params in (
+        LTParams(spike_threshold=1.0),
+        LTParams(prevent_one_year_recovery=False),
+        LTParams(max_segments=4),
+    ):
+        out_x = jax_segment_pixels(years, vals, mask, params)
+        out_p = jax_segment_pixels_pallas(
+            years, vals, mask, params, block=256, interpret=True
+        )
+        _assert_outputs_equal(out_x, out_p, exact=True)
+
+
+def test_chunked_matches_unchunked_interpret():
+    years, vals, mask = _population(512, seed=4)
+    out_a = jax_segment_pixels_pallas(
+        years, vals, mask, PARAMS, block=256, interpret=True
+    )
+    out_b = jax_segment_pixels_pallas_chunked(
+        years, vals, mask, PARAMS, chunk=256, block=256, interpret=True
+    )
+    for f in out_a._fields:
+        a, b = np.asarray(getattr(out_a, f)), np.asarray(getattr(out_b, f))
+        # decisions must be identical; floats may re-fuse across lax.map
+        if a.dtype.kind in "bi":
+            np.testing.assert_array_equal(a, b, err_msg=f)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-12, atol=1e-12, err_msg=f)
+
+
+def test_block_clamps_to_small_batch():
+    years, vals, mask = _population(128, seed=5)
+    out_x = jax_segment_pixels(years, vals, mask, PARAMS)
+    out_p = jax_segment_pixels_pallas(
+        years, vals, mask, PARAMS, block=1024, interpret=True
+    )
+    _assert_outputs_equal(out_x, out_p, exact=True)
+
+
+def test_family_stats_shapes_and_despiked():
+    years, vals, mask = _population(256, seed=6)
+    despiked, vmasks, sses = family_stats_pallas(
+        years, vals, mask, PARAMS, block=256, interpret=True
+    )
+    nm = PARAMS.max_segments
+    assert despiked.shape == (256, NY)
+    assert vmasks.shape == (256, nm, NY) and vmasks.dtype == np.bool_
+    assert sses.shape == (256, nm)
+    assert np.isfinite(np.asarray(sses)).all()
+    # family is a pruning chain: vertex counts strictly ordered (until floor)
+    counts = np.asarray(vmasks).sum(axis=2)
+    assert (np.diff(counts, axis=1) <= 0).all()
+
+
+def test_compiled_under_x64_fails_loud():
+    """The Mosaic x64 lowering bug is guarded with a clear error."""
+    years, vals, mask = _population(128, seed=7)
+    with pytest.raises((RuntimeError, Exception), match="x64|enable_x64"):
+        jax_segment_pixels_pallas(
+            years.astype(np.float32),
+            vals.astype(np.float32),
+            mask,
+            PARAMS,
+            interpret=False,
+        )
+
+
+def test_f32_interpret_decision_quality():
+    """f32 Pallas decisions track the f64 XLA kernel (small-batch gate)."""
+    years, vals, mask = _population(1024, seed=8)
+    out64 = jax_segment_pixels(years, vals, mask, PARAMS)
+    with jax.enable_x64(False):
+        out32 = jax_segment_pixels_pallas(
+            years.astype(np.float32),
+            vals.astype(np.float32),
+            mask,
+            PARAMS,
+            block=256,
+            interpret=True,
+        )
+    vi64 = np.asarray(out64.vertex_indices)
+    vi32 = np.asarray(out32.vertex_indices)
+    agree = np.mean(np.all(vi64 == vi32, axis=1))
+    assert agree >= 0.995, f"pixel-exact agreement {agree:.4f}"

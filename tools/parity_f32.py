@@ -70,9 +70,18 @@ def make_population(px: int, ny: int, seed: int) -> tuple[np.ndarray, np.ndarray
 
 
 def main() -> int:
+    sys.setrecursionlimit(100_000)  # pallas kernel traces deeply under x64
     split = "--f64-on-cpu" in sys.argv
     if split:
         sys.argv.remove("--f64-on-cpu")
+    impl = "xla"
+    for arg in list(sys.argv):
+        if arg.startswith("--impl="):
+            impl = arg.split("=", 1)[1]
+            sys.argv.remove(arg)
+    if impl not in ("xla", "pallas"):
+        print(f"unknown --impl={impl}", file=sys.stderr)
+        return 2
     px_total = int(sys.argv[1]) if len(sys.argv) > 1 else 1_048_576
     out_path = sys.argv[2] if len(sys.argv) > 2 else "PARITY_f32.json"
     ny = 40
@@ -80,6 +89,13 @@ def main() -> int:
 
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    if impl == "pallas":
+        # f32 leg only — the f64 reference leg stays on the XLA kernel
+        # (bit-exact vs the oracle); interpret mode when the chip is a CPU
+        from land_trendr_tpu.ops.segment_pallas import (
+            jax_segment_pixels_pallas,
+        )
 
     acc_dev = jax.devices()[0]
     plat = acc_dev.platform
@@ -121,17 +137,37 @@ def main() -> int:
                 jax.device_put(mask, cpu_dev),
                 params,
             )
-            out32 = jax_segment_pixels(
-                jax.device_put(years, acc_dev),
-                jax.device_put(vals.astype(np.float32), acc_dev),
-                jax.device_put(mask, acc_dev),
-                params,
-            )
+            if impl == "pallas":
+                # compiled Mosaic cannot trace under x64 (see
+                # segment_pallas.family_stats_pallas) — drop to 32-bit
+                # semantics around the f32 leg only
+                with jax.enable_x64(False):
+                    out32 = jax_segment_pixels_pallas(
+                        jax.device_put(years.astype(np.float32), acc_dev),
+                        jax.device_put(vals.astype(np.float32), acc_dev),
+                        jax.device_put(mask, acc_dev),
+                        params,
+                        interpret=plat == "cpu",
+                    )
+            else:
+                out32 = jax_segment_pixels(
+                    jax.device_put(years, acc_dev),
+                    jax.device_put(vals.astype(np.float32), acc_dev),
+                    jax.device_put(mask, acc_dev),
+                    params,
+                )
         else:
             out64 = jax_segment_pixels(years, vals, mask, params)
-            out32 = jax_segment_pixels(
-                years, vals.astype(np.float32), mask, params
-            )
+            if impl == "pallas":
+                with jax.enable_x64(False):
+                    out32 = jax_segment_pixels_pallas(
+                        years.astype(np.float32), vals.astype(np.float32),
+                        mask, params, interpret=plat == "cpu",
+                    )
+            else:
+                out32 = jax_segment_pixels(
+                    years, vals.astype(np.float32), mask, params
+                )
 
         vi64 = np.asarray(out64.vertex_indices)
         vi32 = np.asarray(out32.vertex_indices)
@@ -171,6 +207,7 @@ def main() -> int:
         "n_pixels": px_total,
         "n_years": ny,
         "platform": platform_label,
+        "impl": impl,
         "exact_vertex_agreement": counts["exact"] / total,
         "taxonomy": {
             k: {"count": v, "rate": v / total} for k, v in counts.items()
