@@ -198,13 +198,14 @@ def _make_runner(px: int, ny: int):
             jax_segment_pixels_pallas,
             jax_segment_pixels_pallas_chunked,
         )
+    from land_trendr_tpu.ops.tile import PALLAS_BLOCK
     if px > chunk:
         # indivisible px pads up with fully-masked rows (never a silent
         # fallback to the unchunked kernel — that is the OOM path);
         # throughput still counts only the real pixels
         vals_np, mask_np, _ = pad_to_multiple(vals_np, mask_np, chunk)
 
-        if use_pallas and (chunk <= 1024 or chunk % 1024 == 0):
+        if use_pallas and (chunk <= PALLAS_BLOCK or chunk % PALLAS_BLOCK == 0):
             _RESOLVED_IMPL = "pallas"
             def run(y, v, m):
                 return jax_segment_pixels_pallas_chunked(y, v, m, params, chunk)
@@ -213,9 +214,9 @@ def _make_runner(px: int, ny: int):
             def run(y, v, m):
                 return jax_segment_pixels_chunked(y, v, m, params, chunk)
     else:
-        # the Pallas block is min(1024, px): any px < 1024 divides by
-        # itself; larger px must divide by 1024
-        if use_pallas and (px < 1024 or px % 1024 == 0):
+        # the Pallas block is min(PALLAS_BLOCK, px): any smaller px
+        # divides by itself; larger px must divide by the block
+        if use_pallas and (px < PALLAS_BLOCK or px % PALLAS_BLOCK == 0):
             _RESOLVED_IMPL = "pallas"
             def run(y, v, m):
                 return jax_segment_pixels_pallas(y, v, m, params)

@@ -236,7 +236,22 @@ def _vertex_angles(t: np.ndarray, y: np.ndarray, verts: list[int]) -> np.ndarray
 def cull_by_angle(
     t: np.ndarray, y: np.ndarray, verts: list[int], n_keep: int
 ) -> list[int]:
-    """Drop min-angle interior vertices until ``n_keep`` remain (Stage 2)."""
+    """Drop min-angle interior vertices until ``n_keep`` remain (Stage 2).
+
+    Known sensitivity of the spec'd angle metric (SURVEY.md §3.1: "slope
+    change across the vertex, computed on axis-scaled data"): with x
+    scaled by the full time span, one year is dx ≈ 1/NY, so even small
+    per-year noise produces near-vertical scaled slopes whose arctans
+    saturate toward ±π/2 — a noise wiggle's angle can then rival a real
+    disturbance corner's.  Measured (round 4, 100 random noise seeds,
+    0.01σ noise on a 0.45-magnitude step + slow recovery): 3/100 pixels
+    lose the disturbance vertex to noise vertices at this stage and fall
+    back to the 1-segment model.  This is a property of the published
+    algorithm's angle formulation, reproduced faithfully here — not a
+    kernel defect (the JAX/Pallas kernels match this oracle bit-for-bit);
+    lower ``vertex_count_overshoot`` or stronger despike reduce the
+    exposure.
+    """
     verts = sorted(verts)
     n_keep = max(n_keep, 2)
     while len(verts) > n_keep:

@@ -159,9 +159,14 @@ def select_change(
     neg_inf = jnp.asarray(-jnp.inf, dtype)
     chosen = jnp.argmax(jnp.where(ok, key, neg_inf), axis=1)
     changed = jnp.any(ok, axis=1)
+    # one-hot where-sum instead of take_along_axis: batched dynamic picks
+    # serialize on TPU (TPU_KERNEL_DIAG_r04.md §3); adding explicit zeros
+    # is bit-identical and NaN-safe against garbage in unselected segments
+    oh = chosen[:, None] == jnp.arange(seg_magnitude.shape[1])[None, :]
 
     def pick(a):
-        return jnp.where(changed, jnp.take_along_axis(a, chosen[:, None], 1)[:, 0], 0.0)
+        sel = jnp.sum(jnp.where(oh, a, jnp.zeros((), a.dtype)), axis=1)
+        return jnp.where(changed, sel, 0.0)
 
     mag = pick(seg_magnitude)
     dur = pick(seg_duration)

@@ -25,7 +25,12 @@ import jax
 import jax.numpy as jnp
 
 from land_trendr_tpu.config import LTParams
-from land_trendr_tpu.ops.segment import _fit_model, _interp_through_vertices
+from land_trendr_tpu.ops.segment import (
+    _fit_model,
+    _gather_1d,
+    _interp_through_vertices,
+    _vertex_positions,
+)
 
 __all__ = ["ftv_pixel", "jax_fit_to_vertices"]
 
@@ -63,24 +68,33 @@ def ftv_pixel(
     ny = t.shape[0]
     nv = vertex_indices.shape[0]
 
+    iota = jnp.arange(ny)
     n_valid = jnp.sum(mask)
     n_safe = jnp.maximum(n_valid, 1)
-    valid_pos = jnp.nonzero(mask, size=ny, fill_value=ny)[0]
+    # gather-free forms throughout (TPU: dynamic gather/scatter serializes —
+    # TPU_KERNEL_DIAG_r04.md §3; every replacement below is a selected
+    # element / counted comparison, bit-identical to the indexed original):
+    # rank-keyed valid-position table instead of nonzero's compaction
+    valid_pos = _vertex_positions(mask, ny)
 
     # stack-axis vertex index → nearest valid position at/after it (oracle's
-    # searchsorted + clip), then back to a full-axis index
-    pos = jnp.clip(jnp.searchsorted(valid_pos, vertex_indices), 0, n_safe - 1)
-    full = valid_pos[pos]                       # (NV,) full-axis indices
+    # searchsorted + clip), then back to a full-axis index.
+    # searchsorted(sorted a, v, side='left') == count of a[j] < v.
+    pos = jnp.clip(
+        jnp.sum(valid_pos[None, :] < vertex_indices[:, None], axis=-1),
+        0,
+        n_safe - 1,
+    )
+    full = _gather_1d(valid_pos, pos)           # (NV,) full-axis indices
     live = jnp.arange(nv) < n_vertices
-    vmask = jnp.zeros(ny, dtype=bool).at[full].max(live)  # dedup by scatter
+    # dedup: year j is a vertex iff some live slot maps to it (the one-hot
+    # any-reduce replaces the scatter-max)
+    vmask = jnp.any((full[:, None] == iota[None, :]) & live[:, None], axis=0)
 
     # fallback to endpoints when the mapped set collapses below 2 vertices
     first_v = jnp.argmax(mask)
     last_v = ny - 1 - jnp.argmax(mask[::-1])
-    endpoints = (
-        jnp.zeros(ny, dtype=bool).at[first_v].set(True).at[last_v].set(True)
-        & mask
-    )
+    endpoints = ((iota == first_v) | (iota == last_v)) & mask
     vmask = jnp.where(jnp.sum(vmask) >= 2, vmask, endpoints)
 
     big = jnp.asarray(jnp.finfo(dtype).max, dtype)
@@ -90,7 +104,7 @@ def ftv_pixel(
 
     fitted, _ = _fit_model(t, v, mask, vmask, y_range, params)
     out = _interp_through_vertices(
-        t, vmask, fitted, t[jnp.clip(last_v, 0, ny - 1)], nv
+        t, vmask, fitted, _gather_1d(t, last_v), nv
     )
 
     mean = jnp.where(n_valid > 0, jnp.sum(jnp.where(mask, v, 0.0)) / n_safe, 0.0)

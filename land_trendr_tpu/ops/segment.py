@@ -46,7 +46,7 @@ Pipelines that need bit-exact vertex parity should run the f64 path
 (CPU, or TPU with x64 at a large slowdown).  The committed artifact's
 ``platform`` field records where it was measured; fusion-order effects
 are platform-specific.  **Measured on real TPU v5 lite hardware**
-(round 4, ``PARITY_f32_tpu.json``, 65536 px): 99.989% exact vertex
+(round 4, ``PARITY_f32_tpu.json``, 65536 px): 99.9908% exact vertex
 agreement vs the f64 CPU oracle, fitted-trajectory p99 delta 1.7e-6 —
 the same tail class as CPU f32.  (The pre-rewrite kernel measured
 48.9% on identical inputs: the TPU dynamic gather/scatter lowering this
@@ -723,7 +723,6 @@ def segment_pixel(
     iota = jnp.arange(ny)
 
     n_valid = jnp.sum(mask)
-    enough = n_valid >= params.min_observations_needed
 
     # Stage 1 — despike
     with jax.named_scope(SCOPE_DESPIKE):
@@ -750,15 +749,8 @@ def segment_pixel(
             vmask,
         )
 
-    # Stage 4 — model family: record, then prune weakest and refit
-    ss0 = jnp.sum(jnp.where(mask, (y - jnp.sum(jnp.where(mask, y, 0.0)) / jnp.maximum(n_valid, 1)) ** 2, 0.0))
-
-    # In float64 the selection scores are the linear p values — bit-exact
-    # against the oracle's ratio rule.  In float32 the scores are log p
-    # (underflow-proof; see _f_stat_p_and_logp) and the ratio rule becomes
-    # the equivalent ``lp <= lp_best - log(best_model_proportion)``.
-    exact_mode = dtype == jnp.float64
-
+    # Stage 4 — model family: record each member's vertex set + fit SSE;
+    # scoring/selection live in the shared tail (_select_and_assemble)
     def model_step(vm, _):
         fitted, sse = _fit_model(t, y, mask, vm, y_range, params)
         del fitted  # only the chosen model's trajectory is needed — it is

@@ -45,7 +45,7 @@ from land_trendr_tpu.io import native
 from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
-from land_trendr_tpu.ops.tile import process_tile_dn, resolve_impl
+from land_trendr_tpu.ops.tile import PALLAS_BLOCK, process_tile_dn, resolve_impl
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
     TileManifest,
@@ -141,17 +141,18 @@ class RunConfig:
                 f"impl={self.impl!r} not one of 'auto', 'pallas', 'xla'"
             )
         if (
-            resolve_impl(self.impl) == "pallas"
+            self.impl == "pallas"  # "auto" is validated in run_stack once
+            # the backend is known — resolving it here would initialise a
+            # JAX client as a side effect of constructing a config
             and self.chunk_px is not None
-            and self.chunk_px > 1024
-            and self.chunk_px % 1024
+            and self.chunk_px > PALLAS_BLOCK
+            and self.chunk_px % PALLAS_BLOCK
         ):
-            # ops.tile.PALLAS_BLOCK (chunks <= the block clamp the block
-            # instead); checked here so a bad combination fails at config
-            # time, not mid-run at kernel trace time
+            # chunks <= the block clamp the block instead; checked here so
+            # a bad combination fails at config time, not mid-run
             raise ValueError(
-                f"chunk_px={self.chunk_px} must be a multiple of 1024 "
-                "(the Pallas block) when the resolved impl is 'pallas'"
+                f"chunk_px={self.chunk_px} must be a multiple of "
+                f"{PALLAS_BLOCK} (the Pallas block) when impl='pallas'"
             )
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
@@ -188,12 +189,12 @@ class RunConfig:
                 # mesh device count is checked separately via the manifest
                 # header's context (assembly must stay mesh-blind).
                 "chunk_px": self.chunk_px,
-                # same class of effect as chunk_px: the Pallas and XLA
-                # kernels are decision-identical only up to f32 knife
-                # edges, so a resume must not mix implementations.  The
-                # RESOLVED implementation is fingerprinted — "auto" on a
-                # TPU host and "auto" on a CPU host are different kernels
-                "impl": resolve_impl(self.impl),
+                # NOT fingerprinted: the resolved kernel implementation.
+                # It is an execution fact like mesh_devices — recorded in
+                # the manifest CONTEXT so a compute resume cannot mix
+                # pallas- and xla-produced tiles, while assembly (which
+                # never runs the kernel and may happen on a CPU-only
+                # controller of a TPU run) stays implementation-blind.
             }
         )
 
@@ -427,8 +428,22 @@ def run_stack(
         feed_px = tile_px
         chunk = cfg.chunk_px
 
+    impl_resolved = resolve_impl(cfg.impl)
+    if (
+        impl_resolved == "pallas"
+        and chunk is not None
+        and chunk > PALLAS_BLOCK
+        and chunk % PALLAS_BLOCK
+    ):
+        raise ValueError(
+            f"chunk_px={chunk} must be a multiple of {PALLAS_BLOCK} (the "
+            "Pallas block) when the resolved impl is 'pallas' — adjust "
+            "chunk_px or pass impl='xla'"
+        )
     manifest = TileManifest(
-        cfg.workdir, cfg.fingerprint(stack), context={"mesh_devices": n_mesh}
+        cfg.workdir,
+        cfg.fingerprint(stack),
+        context={"mesh_devices": n_mesh, "impl": impl_resolved},
     )
     done = manifest.open(cfg.resume)
     years = stack.years.astype(np.int32)
@@ -461,7 +476,7 @@ def run_stack(
                         reject_bits=cfg.reject_bits,
                         chunk=chunk,
                         change_filt=cfg.change_filt,
-                        impl=cfg.impl,
+                        impl=impl_resolved,
                     ),
                     None,
                 )
