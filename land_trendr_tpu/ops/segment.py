@@ -46,8 +46,8 @@ Pipelines that need bit-exact vertex parity should run the f64 path
 (CPU, or TPU with x64 at a large slowdown).  The committed artifact's
 ``platform`` field records where it was measured; fusion-order effects
 are platform-specific.  **Measured on real TPU v5 lite hardware**
-(round 4, ``PARITY_f32_tpu.json``, 65536 px): 99.9908% exact vertex
-agreement vs the f64 CPU oracle, fitted-trajectory p99 delta 1.7e-6 —
+(round 4, ``PARITY_f32_tpu.json``, 1M px): 99.987% exact vertex
+agreement vs the f64 CPU oracle, fitted-trajectory p99 delta 1.8e-6 —
 the same tail class as CPU f32.  (The pre-rewrite kernel measured
 48.9% on identical inputs: the TPU dynamic gather/scatter lowering this
 rewrite eliminated was not merely slow but decision-flipping —

@@ -429,8 +429,9 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
         c1v = jnp.where(iota == lo0, c1i, zero)
 
         for _ in range(nc - 2):
-            c0_at, _h = _fill(c0v, vmask_f, exclusive=False, reverse=False)
-            c1_at, _h = _fill(c1v, vmask_f, exclusive=False, reverse=False)
+            c0_at, c1_at, _h = _fill2(
+                c0v, c1v, vmask_f, exclusive=False, reverse=False
+            )
             dev = jnp.abs(y - (c0_at + c1_at * t))
             fv = _first_true_idx(vmask_f > 0, iota, ny)
             lv = _last_true_idx(vmask_f > 0, iota)
