@@ -191,8 +191,9 @@ def _clamp_slope_ys(slope, duration, y_range, params: LTParams):
 
 # Mosaic has no atan lowering; the angle cull needs one.  Degree-10-in-z²
 # Chebyshev-fitted odd polynomial on [0,1] + the |x|>1 reciprocal reduction:
-# measured max error 1.0e-7 (~1.7 f32 ulp at pi/4 scale, dominated by f32
-# Horner rounding) against np.arctan over a 2M-point grid.  Used ONLY in
+# measured max error 1.5e-7 (~2 ulp at atan scale; the [0,1] poly is
+# 1.0e-7 and the reciprocal branch adds one rounding step) against
+# np.arctan over a 2M-point grid (gated by tests/test_pallas.py).  Used ONLY in
 # compiled mode — interpret mode keeps jnp.arctan so the f64 parity tests
 # bit-match the oracle; compiled-mode f32 angle comparisons may flip at
 # 1-2-ulp knife edges, which the f32 tolerance contract covers (measured:
