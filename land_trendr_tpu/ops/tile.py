@@ -68,8 +68,10 @@ def resolve_impl(impl: str) -> str:
     actually run: a TPU backend without ``jax_enable_x64`` (Mosaic is
     f32-only and its x64-mode lowering is broken — see
     ``segment_pallas.family_stats_pallas``).  The resolved value — not
-    "auto" — is what belongs in run fingerprints, so a resume cannot mix
-    implementations across backends.
+    "auto" — is what the driver records in the manifest EXECUTION CONTEXT
+    (not the run fingerprint: assembly stays impl-blind —
+    ``RunConfig.fingerprint`` / ``test_impl_resume_context_rejected``), so
+    a compute resume cannot mix implementations across backends.
     """
     if impl == "auto":
         import jax as _jax

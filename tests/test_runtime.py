@@ -405,6 +405,25 @@ def test_mesh_resume_context_rejected(tmp_path, rstack):
     assemble_outputs(rstack, cfg)  # context-free consumer: OK
 
 
+def test_impl_resume_context_rejected(tmp_path, rstack):
+    """A resume must not mix kernel implementations (pallas/xla decisions
+    differ at f32 knife edges); the resolved impl lives in the manifest
+    execution context, so assembly — which never runs the kernel and may
+    happen on a host with a different backend — stays impl-blind."""
+    import dataclasses
+
+    cfg = make_cfg(tmp_path, tile_size=30)
+    run_stack(rstack, cfg)  # auto -> xla on the CPU test backend
+    # a workdir produced by the OTHER implementation must be refused on
+    # compute resume ...
+    cfg_p = dataclasses.replace(cfg, impl="pallas")
+    with pytest.raises(ValueError, match="execution context"):
+        run_stack(rstack, cfg_p)
+    # ... while the fingerprint (and so assembly) is impl-blind
+    assert cfg.fingerprint(rstack) == cfg_p.fingerprint(rstack)
+    assemble_outputs(rstack, cfg_p)
+
+
 def test_output_compression_choice(tmp_path, rstack):
     """assemble_outputs honors RunConfig.out_compress (GDAL-era pipelines
     commonly emit LZW); rasters decode identically either way."""
