@@ -273,18 +273,23 @@ def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
     m = m_f > 0
 
     n_verts = jnp.sum(vmask_f, axis=0, keepdims=True)
-    rank = _prefix_sum_incl(vmask_f.astype(jnp.int32)) - 1  # (NY, BLK)
+    cincl = _prefix_sum_incl(vmask_f.astype(jnp.int32))  # vertices at/before i
+    rank = cincl - 1                                     # rank of a vertex AT i
+    cexcl = cincl - vb.astype(jnp.int32)                 # vertices strictly before i
 
-    # vertex-slot positions / values: a_k == vpos[k] (NY sentinel when dead)
-    a = []
+    # vertex-slot values: tv[k] == t[vpos[k]] via rank-keyed masked sums.
+    # Slot POSITIONS are never materialised: segment membership is a rank
+    # compare — a year belongs to segment k (years in (a_k, a_{k+1}])
+    # exactly when cexcl == k+1, and to the closed [a_0, a_1] span when
+    # cincl >= 1 & cexcl <= 1 — identical sets to the position compares
+    # they replace, without the per-slot first-index reductions.
     tv = []
     for k in range(nv):
         sel = vb & (rank == k)
-        a.append(_first_true_idx(sel, iota, ny))
         tv.append(jnp.sum(jnp.where(sel, t, zero), axis=0, keepdims=True))
 
     # --- segment 0: OLS over closed [v0, v1] ---
-    member0 = (iota >= a[0]) & (iota <= a[1]) & m
+    member0 = (cincl >= 1) & (cexcl <= 1) & m
     m0 = member0.astype(dtype)
     c0, c1 = _masked_ols_ys(t, y, m0)
     dur0 = tv[1] - tv[0]
@@ -300,7 +305,7 @@ def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
     # --- segments 1..: slope-only regression through the anchor ---
     for k in range(1, nv - 1):
         active = (k + 1.0) < n_verts
-        member = (iota > a[k]) & (iota <= a[k + 1]) & m & active
+        member = (cexcl == k + 1) & m & active
         mf = member.astype(dtype)
         dt = (t - anchor_t) * mf
         denom = jnp.sum(dt * dt, axis=0, keepdims=True)
