@@ -229,32 +229,107 @@ def _atan_poly(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(x < 0, -r, r)
 
 
-def _remove_weakest_ys(t, y, vmask_f, iota, scale, keep_above: int, exact_atan: bool):
-    """Drop the min-angle interior vertex while count > keep_above (one step)."""
-    dtype = t.dtype
-    ny = t.shape[0]
+def _vertex_angle(xs_v, ys_v, xp_v, yp_v, xq_v, yq_v, interior, exact_atan: bool):
+    """Angle at a vertex given its own and neighbour-vertex scaled coords.
+
+    ONE definition serves both the full build (_angle_state_init, whole
+    (NY, BLK) block) and the incremental patches (_remove_weakest_ys,
+    (1, BLK) rows) — the bit-identity between them is structural.
+    """
+    dtype = xs_v.dtype
     one = jnp.ones((), dtype)
-    t_lo, t_hi, y_lo, y_hi = scale
-    t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, one)
-    y_rng = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
-    xs = (t - t_lo) / t_rng
-    ys = (y - y_lo) / y_rng
+    big = jnp.asarray(1e30, dtype)  # > pi; replaces slot-space +inf sentinel
+    dx1 = jnp.where(interior, xs_v - xp_v, one)
+    dx2 = jnp.where(interior, xq_v - xs_v, one)
+    s1 = (ys_v - yp_v) / dx1
+    s2 = (yq_v - ys_v) / dx2
+    atan = jnp.arctan if exact_atan else _atan_poly
+    return jnp.where(interior, jnp.abs(atan(s2) - atan(s1)), big)
+
+
+def _angle_state_init(xs, ys, vmask_f, iota, exact_atan: bool):
+    """Neighbour-fill tables + per-vertex angle table for the cull chains.
+
+    ``(xp, yp, hasp, xq, yq, hasq, ang)`` — the scaled coords of each
+    slot's previous/next VERTEX, and the angle at every vertex slot (BIG
+    sentinel elsewhere).  A removal changes this state at O(1) slots per
+    pixel, so the 8-deep remove chain (angle cull + model family) carries
+    it across calls instead of re-filling and re-atan-ing the whole block
+    each time (the removes were ~22% of kernel time — TPU_KERNEL_DIAG §7).
+    """
+    dtype = xs.dtype
+    one = jnp.ones((), dtype)
     xp, yp, hasp = _fill2(xs, ys, vmask_f, exclusive=True, reverse=False)
     xq, yq, hasq = _fill2(xs, ys, vmask_f, exclusive=True, reverse=True)
     interior = (vmask_f > 0) & (hasp > 0) & (hasq > 0)
-    dx1 = jnp.where(interior, xs - xp, one)
-    dx2 = jnp.where(interior, xq - xs, one)
-    s1 = (ys - yp) / dx1
-    s2 = (yq - ys) / dx2
-    atan = jnp.arctan if exact_atan else _atan_poly
-    ang = jnp.abs(atan(s2) - atan(s1))
-    big = jnp.asarray(1e30, dtype)  # > pi; replaces slot-space +inf sentinel
-    ang = jnp.where(interior, ang, big)
+    ang = _vertex_angle(xs, ys, xp, yp, xq, yq, interior, exact_atan)
+    return xp, yp, hasp, xq, yq, hasq, ang
+
+
+def _remove_weakest_ys(
+    vmask_f, state, xs, ys, iota, keep_above: int, exact_atan: bool
+):
+    """Drop the min-angle interior vertex while count > keep_above.
+
+    Returns ``(vmask_new, state_new)``.  Incremental form: removing the
+    interior vertex at ``pos`` changes the forward tables exactly on
+    ``(pos, next_vertex]`` (their previous vertex was ``pos``), the
+    backward tables exactly on ``[prev_vertex, pos)``, and the angle table
+    only at ``prev_vertex``/``next_vertex`` (recomputed from the updated
+    tables with the identical formula — bit-identical to a full rebuild,
+    gated by the interpret bit-exact suite) plus the BIG sentinel at
+    ``pos``.  ``prev/next_vertex`` exist whenever a removal fires: the
+    argmin is masked to interior vertices.
+    """
+    dtype = xs.dtype
+    ny = xs.shape[0]
+    big = jnp.asarray(1e30, dtype)
+    xp, yp, hasp, xq, yq, hasq, ang = state
     mn = jnp.min(ang, axis=0, keepdims=True)
     pos = _first_true_idx(ang == mn, iota, ny)
     n_verts = jnp.sum(vmask_f, axis=0, keepdims=True)
     do = n_verts > float(keep_above)
-    return jnp.where(do & (iota == pos), jnp.zeros((), dtype), vmask_f)
+    vb = vmask_f > 0
+    prv = _last_true_idx(vb & (iota < pos), iota)
+    nxt = _first_true_idx(vb & (iota > pos), iota, ny)
+    vmask_new = jnp.where(do & (iota == pos), jnp.zeros((), dtype), vmask_f)
+
+    # table patches (picks taken from the PRE-update tables; pos itself is
+    # outside both ranges, so order is immaterial)
+    rngf = do & (iota > pos) & (iota <= nxt)
+    rngb = do & (iota >= prv) & (iota < pos)
+    xp_p = _pick_at(xp, iota, pos)
+    yp_p = _pick_at(yp, iota, pos)
+    hp_p = _pick_at(hasp, iota, pos)
+    xq_p = _pick_at(xq, iota, pos)
+    yq_p = _pick_at(yq, iota, pos)
+    hq_p = _pick_at(hasq, iota, pos)
+    xp = jnp.where(rngf, xp_p, xp)
+    yp = jnp.where(rngf, yp_p, yp)
+    hasp = jnp.where(rngf, hp_p, hasp)
+    xq = jnp.where(rngb, xq_p, xq)
+    yq = jnp.where(rngb, yq_p, yq)
+    hasq = jnp.where(rngb, hq_p, hasq)
+
+    def ang_at(j):
+        # angle at vertex slot j from the UPDATED tables — the shared
+        # _vertex_angle formula applied to (1, BLK) rows
+        interior_j = (_pick_at(hasp, iota, j) > 0) & (_pick_at(hasq, iota, j) > 0)
+        return _vertex_angle(
+            _pick_at(xs, iota, j),
+            _pick_at(ys, iota, j),
+            _pick_at(xp, iota, j),
+            _pick_at(yp, iota, j),
+            _pick_at(xq, iota, j),
+            _pick_at(yq, iota, j),
+            interior_j,
+            exact_atan,
+        )
+
+    ang = jnp.where(do & (iota == pos), big, ang)
+    ang = jnp.where(do & (iota == prv), ang_at(prv), ang)
+    ang = jnp.where(do & (iota == nxt), ang_at(nxt), ang)
+    return vmask_new, (xp, yp, hasp, xq, yq, hasq, ang)
 
 
 def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
@@ -445,7 +520,6 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
         last_v = _last_true_idx(m, iota)
         t_lo = _pick_at(t, iota, first_v)
         t_hi = _pick_at(t, iota, last_v)
-        scale = (t_lo, t_hi, y_lo, y_hi)
 
         # ---- Stage 2: candidate vertices (max-deviation insertion) ----
         vmask_f = jnp.where(m & ((iota == first_v) | (iota == last_v)), one, zero)
@@ -495,9 +569,17 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
             )
             vmask_f = jnp.where(do & (iota == i_first), one, vmask_f)
 
-        # ---- Stage 2b: angle cull ----
+        # ---- Stage 2b + 4a: the remove chain carries one angle state ----
+        # (scaled coordinates replicate the slot-space scaling arithmetic)
+        t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, one)
+        y_rng_s = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
+        xsc = (t - t_lo) / t_rng
+        ysc = (y - y_lo) / y_rng_s
+        state = _angle_state_init(xsc, ysc, vmask_f, iota, exact_atan)
         for _ in range(params.vertex_count_overshoot):
-            vmask_f = _remove_weakest_ys(t, y, vmask_f, iota, scale, nv, exact_atan)
+            vmask_f, state = _remove_weakest_ys(
+                vmask_f, state, xsc, ysc, iota, nv, exact_atan
+            )
 
         # ---- Stage 4a: model family (fit SSE, then prune weakest) ----
         for k in range(nm):
@@ -505,7 +587,9 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
             sse = _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params)
             sse_ref[k] = sse[0]
             if k + 1 < nm:
-                vmask_f = _remove_weakest_ys(t, y, vmask_f, iota, scale, 2, exact_atan)
+                vmask_f, state = _remove_weakest_ys(
+                    vmask_f, state, xsc, ysc, iota, 2, exact_atan
+                )
 
     return kernel
 
