@@ -247,7 +247,7 @@ def _vertex_angle(xs_v, ys_v, xp_v, yp_v, xq_v, yq_v, interior, exact_atan: bool
     return jnp.where(interior, jnp.abs(atan(s2) - atan(s1)), big)
 
 
-def _angle_state_init(xs, ys, vmask_f, iota, exact_atan: bool):
+def _angle_state_init(xs, ys, vmask_f, exact_atan: bool):
     """Neighbour-fill tables + per-vertex angle table for the cull chains.
 
     ``(xp, yp, hasp, xq, yq, hasq, ang)`` — the scaled coords of each
@@ -257,8 +257,6 @@ def _angle_state_init(xs, ys, vmask_f, iota, exact_atan: bool):
     it across calls instead of re-filling and re-atan-ing the whole block
     each time (the removes were ~22% of kernel time — TPU_KERNEL_DIAG §7).
     """
-    dtype = xs.dtype
-    one = jnp.ones((), dtype)
     xp, yp, hasp = _fill2(xs, ys, vmask_f, exclusive=True, reverse=False)
     xq, yq, hasq = _fill2(xs, ys, vmask_f, exclusive=True, reverse=True)
     interior = (vmask_f > 0) & (hasp > 0) & (hasq > 0)
@@ -522,51 +520,51 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
         t_hi = _pick_at(t, iota, last_v)
 
         # ---- Stage 2: candidate vertices (max-deviation insertion) ----
+        # The per-year segment-coefficient table and seg_start map are
+        # CARRIED across insertion trips: inserting a vertex at i into
+        # [lo, hi] changes them exactly on [lo, i) (refit left half) and
+        # [i, hi) (right half) — range selects of freshly fit values,
+        # bit-identical to the forward fills over a slot cache they
+        # replace.  first/last vertex are loop-invariant (insertions are
+        # strictly interior), so the per-trip first/last reductions and
+        # the seg_start prefix-max rebuild go away too.
         vmask_f = jnp.where(m & ((iota == first_v) | (iota == last_v)), one, zero)
         lo0 = _first_true_idx(vmask_f > 0, iota, ny)
         member_i = (iota >= lo0) & (iota <= _last_true_idx(vmask_f > 0, iota)) & m
         c0i, c1i = _masked_ols_ys(t, y, member_i.astype(dtype))
-        c0v = jnp.where(iota == lo0, c0i, zero)
-        c1v = jnp.where(iota == lo0, c1i, zero)
+        c0_at = c0i + jnp.zeros((ny, blk), dtype)
+        c1_at = c1i + jnp.zeros((ny, blk), dtype)
+        seg_start = jnp.clip(
+            _prefix_max_incl(jnp.where(vmask_f > 0, iota, -1)), 0, ny - 1
+        )
 
         for _ in range(nc - 2):
-            c0_at, c1_at, _h = _fill2(
-                c0v, c1v, vmask_f, exclusive=False, reverse=False
-            )
             dev = jnp.abs(y - (c0_at + c1_at * t))
-            fv = _first_true_idx(vmask_f > 0, iota, ny)
-            lv = _last_true_idx(vmask_f > 0, iota)
-            eligible = m & ~(vmask_f > 0) & (iota > fv) & (iota < lv)
+            eligible = m & ~(vmask_f > 0) & (iota > first_v) & (iota < last_v)
             dev = jnp.where(eligible, dev, -one)
             mx = jnp.max(dev, axis=0, keepdims=True)
             i_first = _first_true_idx(dev == mx, iota, ny)
             do = mx >= zero
-            seg_start = jnp.clip(
-                _prefix_max_incl(jnp.where(vmask_f > 0, iota, -1)), 0, ny - 1
-            )
             lo = jnp.sum(
                 jnp.where(iota == i_first, seg_start, 0), axis=0, keepdims=True
             )
-            hi = jnp.clip(
-                jnp.min(
-                    jnp.where((vmask_f > 0) & (iota > i_first), iota, ny),
-                    axis=0,
-                    keepdims=True,
-                ),
-                0,
-                ny - 1,
+            hi_raw = jnp.min(
+                jnp.where((vmask_f > 0) & (iota > i_first), iota, ny),
+                axis=0,
+                keepdims=True,
             )
+            hi = jnp.clip(hi_raw, 0, ny - 1)
             mem_a = (iota >= lo) & (iota <= i_first) & m
             mem_b = (iota >= i_first) & (iota <= hi) & m
             c0a, c1a = _masked_ols_ys(t, y, mem_a.astype(dtype))
             c0b, c1b = _masked_ols_ys(t, y, mem_b.astype(dtype))
-            # overwrite order: i wins a lo == i collision
-            c0v = jnp.where(
-                do & (iota == i_first), c0b, jnp.where(do & (iota == lo), c0a, c0v)
-            )
-            c1v = jnp.where(
-                do & (iota == i_first), c1b, jnp.where(do & (iota == lo), c1a, c1v)
-            )
+            # right half wins the j == i slot, mirroring the slot cache's
+            # .at[lo].set(·).at[i].set(·) overwrite order
+            rng_a = do & (iota >= lo) & (iota < i_first)
+            rng_b = do & (iota >= i_first) & (iota < hi_raw)
+            c0_at = jnp.where(rng_b, c0b, jnp.where(rng_a, c0a, c0_at))
+            c1_at = jnp.where(rng_b, c1b, jnp.where(rng_a, c1a, c1_at))
+            seg_start = jnp.where(rng_b, i_first, seg_start)
             vmask_f = jnp.where(do & (iota == i_first), one, vmask_f)
 
         # ---- Stage 2b + 4a: the remove chain carries one angle state ----
@@ -575,7 +573,7 @@ def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
         y_rng_s = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
         xsc = (t - t_lo) / t_rng
         ysc = (y - y_lo) / y_rng_s
-        state = _angle_state_init(xsc, ysc, vmask_f, iota, exact_atan)
+        state = _angle_state_init(xsc, ysc, vmask_f, exact_atan)
         for _ in range(params.vertex_count_overshoot):
             vmask_f, state = _remove_weakest_ys(
                 vmask_f, state, xsc, ysc, iota, nv, exact_atan
