@@ -161,7 +161,8 @@ def select_change(
     changed = jnp.any(ok, axis=1)
     # one-hot where-sum instead of take_along_axis: batched dynamic picks
     # serialize on TPU (TPU_KERNEL_DIAG_r04.md §3); adding explicit zeros
-    # is bit-identical and NaN-safe against garbage in unselected segments
+    # is identical up to the sign of zero (-0.0 picks as +0.0) and NaN-safe
+    # against garbage in unselected segments
     oh = chosen[:, None] == jnp.arange(seg_magnitude.shape[1])[None, :]
 
     def pick(a):

@@ -113,8 +113,11 @@ _EPS_RATE = 1e-12  # must match oracle._segment_violates
 # (TPU_KERNEL_DIAG_r04.md §§1-3).  Every traced-index read/write in this
 # kernel therefore goes through the helpers below.  Bit-exactness: the
 # where-sum adds the selected element plus explicit zeros, so the result is
-# identical to the gather term for term (and NaN-safe against garbage in
-# never-selected slots — ``where`` masks before the multiply-free sum).
+# identical to the gather term for term *up to the sign of zero* (a gathered
+# -0.0 becomes +0.0, since -0.0 + 0.0 == +0.0; behaviourally neutral — every
+# downstream compare treats them equal — and invisible to the == -based
+# parity suites), and NaN-safe against garbage in never-selected slots —
+# ``where`` masks before the multiply-free sum.
 # ---------------------------------------------------------------------------
 
 
@@ -589,6 +592,56 @@ def _f_stat_p(ss0, sse, n, m):
 # inf arithmetic leaks into selects.
 _LOGP_PERFECT = -1e30
 
+_HALF_LOG_2PI = 0.9189385332046727  # 0.5 * log(2*pi)
+
+
+def _lgamma_fixed(x: jnp.ndarray) -> jnp.ndarray:
+    """``log Gamma(x)`` for ``x >= 0.5`` — fixed 8-step shift + Stirling.
+
+    ``lax.lgamma`` has no Mosaic (Pallas TPU) lowering, and the fused
+    Pallas tail must score models with arithmetic *identical* to this XLA
+    path for the on-chip impl-identity contract — so both paths share this
+    plain-arithmetic form: ``lgamma(x) = lgamma(x+8) - log(x(x+1)…(x+7))``
+    with a 3-term Stirling series at ``x+8 >= 8.5`` (truncation ~2e-10;
+    float32 rounding dominates at ~5e-5 abs worst-case over this
+    pipeline's argument range ``x <= (NY+10)/2``).  Swapping it in for
+    ``lax.lgamma`` *tightened* the measured Lentz envelope on the scoring
+    grid (max rel p error 6.7e-5 -> 4.6e-5 under XLA CPU f32; gated by
+    ``tests/test_f32_quality.py``).  Arguments here are the F-test's
+    half-integers ``df/2 >= 0.5``, so no reflection branch is needed.
+    """
+    dtype = x.dtype
+    prod = x
+    for j in range(1, 8):
+        prod = prod * (x + jnp.asarray(float(j), dtype))
+    z = x + jnp.asarray(8.0, dtype)
+    zi = jnp.asarray(1.0, dtype) / z
+    zi2 = zi * zi
+    series = zi * (
+        jnp.asarray(1.0 / 12.0, dtype)
+        + zi2
+        * (jnp.asarray(-1.0 / 360.0, dtype) + zi2 * jnp.asarray(1.0 / 1260.0, dtype))
+    )
+    lg = (z - 0.5) * jnp.log(z) - z + jnp.asarray(_HALF_LOG_2PI, dtype) + series
+    return lg - jnp.log(prod)
+
+
+def _lentz_iters(ny: int) -> int:
+    """Lentz trip count for a pipeline whose year axis has ``ny`` entries.
+
+    The continued fraction's worst case over this pipeline's argument
+    range converges in ~O(sqrt(max(a, b))) half-step pairs with
+    ``max(a, b) <= (ny + 10) / 2``; 12 trips are validated for NY <= 40
+    (the accuracy-envelope gate in ``tests/test_f32_quality.py``), and the
+    sqrt rule keeps the envelope for longer stacks (validated on the
+    extended NY = 100 grid by the same test) instead of silently
+    degrading — a 100-year series gets 18 trips, not 12.  Truncation,
+    not ceil: NY = 40 must map to exactly the validated 12 (2.5·√25 =
+    12.5), keeping production bit-identical to every gate and artifact
+    measured at the default trip count.
+    """
+    return max(12, int(2.5 * np.sqrt((ny + 10) / 2.0)))
+
 
 def _betainc_p_and_logp_lentz(a, b, x, iters: int = 12):
     """``(p, log p)`` of the regularised incomplete beta in ONE fixed-trip pass.
@@ -605,11 +658,14 @@ def _betainc_p_and_logp_lentz(a, b, x, iters: int = 12):
 
     Accuracy (validated against scipy f64 over the full (a, b, x) grid
     this pipeline can produce — n in [6, 40], m in [1, 6], F in [1e-3,
-    1e4]): max relative p error 1.8e-5 (6.7e-5 under XLA CPU, whose FMA
-    fusion shifts the Lentz rounding tail — gated by
-    ``tests/test_f32_quality.py``), p99 6e-6; log-p abs error p99
-    8e-6 including the deep tail; converged by 12 iterations (12 == 24
-    half-steps; the error floor is f32 rounding, not truncation).  That
+    1e4]): max relative p error 4.6e-5 under XLA CPU f32 with the shared
+    :func:`_lgamma_fixed` (round 5; the previous ``lax.lgamma`` form
+    measured 6.7e-5 — gated by ``tests/test_f32_quality.py``), p99 9e-6;
+    log-p abs error p99 1e-5 including the deep tail; converged by 12
+    iterations for NY <= 40 (12 == 24 half-steps; the error floor is f32
+    rounding, not truncation).  For longer year axes pass
+    ``iters=_lentz_iters(ny)`` — the sqrt-of-dof rule the pipeline
+    callers use; the 12-trip default is only validated to NY = 40.  That
     widens the f32 knife-edge band for model-selection ties from ~1e-7
     to ~2e-5 relative — covered by the f32 tolerance contract and gated
     by ``tests/test_f32_quality.py``.  The float64 exact path
@@ -646,9 +702,9 @@ def _betainc_p_and_logp_lentz(a, b, x, iters: int = 12):
     log_front = (
         aa * jnp.log(jnp.maximum(xx, tiny))
         + bb * jnp.log1p(-xx)
-        + lax.lgamma(qab)
-        - lax.lgamma(aa)
-        - lax.lgamma(bb)
+        + _lgamma_fixed(qab)
+        - _lgamma_fixed(aa)
+        - _lgamma_fixed(bb)
         - jnp.log(aa)
     )
     p_small = jnp.exp(log_front) * h
@@ -662,7 +718,7 @@ def _betainc_p_and_logp_lentz(a, b, x, iters: int = 12):
     return p, lp
 
 
-def _f_stat_p_and_logp(ss0, sse, n, m):
+def _f_stat_p_and_logp(ss0, sse, n, m, iters: int = 12):
     """``(p, log-p score)`` of the F test, underflow-proof in float32.
 
     Float32 model-selection hardening (measured on 64K mixed-regime pixels:
@@ -691,7 +747,7 @@ def _f_stat_p_and_logp(ss0, sse, n, m):
     f = jnp.maximum(f, 0.0)
     x = df2s / (df2s + df1s * f)
     a, b = df2s / 2.0, df1s / 2.0
-    p_direct, lp = _betainc_p_and_logp_lentz(a, b, x)
+    p_direct, lp = _betainc_p_and_logp_lentz(a, b, x, iters=iters)
     lp = jnp.where(
         invalid, 0.0, jnp.where(perfect, jnp.asarray(_LOGP_PERFECT, dtype), lp)
     )
@@ -827,7 +883,8 @@ def _select_and_assemble(
         scores = ps
     else:
         ps, scores = _f_stat_p_and_logp(
-            ss0, sses, n_valid.astype(dtype), ms.astype(dtype)
+            ss0, sses, n_valid.astype(dtype), ms.astype(dtype),
+            iters=_lentz_iters(ny),
         )
 
     # Selection: most segments whose p is within best_model_proportion of best

@@ -14,12 +14,21 @@ stage's 3.8M on the same chip with bit-identical output.
 
 Division of labour
 ------------------
-The Pallas kernel computes the despiked series, the NM model-family vertex
-masks, and each model's fitted SSE.  Everything from F-stat scoring onward
-(betainc, selection, chosen-model refit, output assembly) stays in XLA via
-:func:`land_trendr_tpu.ops.segment._select_and_assemble` — the single
-shared tail both execution paths use.  ``jax.scipy.special.betainc`` has
-no Mosaic lowering, and the tail is a small fraction of kernel time.
+Since round 5 the ENTIRE pipeline is fused: despike, vertex search, the
+model family, F-stat scoring (fixed-trip Lentz with the shared
+:func:`segment._lgamma_fixed` — ``lax.lgamma``/``betainc`` have no Mosaic
+lowering), model selection, the chosen-model refit, and full output
+assembly all run inside the one ``(NY, BLK)`` kernel, so the
+``(PX, NM, NY)`` family intermediates never touch HBM and the second XLA
+program the round-4 split needed (``_select_and_assemble`` over a
+round-tripped family batch — ~35% of end-to-end step time on chip)
+disappears.  The f64 interpret path scores with the exact
+``jax.scipy.special.betainc`` (:func:`segment._f_stat_p`), keeping the
+oracle bit-parity contract; the f32 paths (compiled and interpret) score
+with the same :func:`segment._f_stat_p_and_logp` the XLA kernel uses, so
+XLA-vs-Pallas f32 identity is structural.
+:func:`family_stats_pallas` still exposes the unfused stage-1–4a kernel
+for tests and stage probes.
 
 Semantics
 ---------
@@ -57,12 +66,18 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from land_trendr_tpu.config import LTParams
-from land_trendr_tpu.ops.segment import SegOutputs, _select_and_assemble
+from land_trendr_tpu.ops.segment import (
+    SegOutputs,
+    _f_stat_p,
+    _f_stat_p_and_logp,
+    _lentz_iters,
+)
 
 __all__ = [
     "jax_segment_pixels_pallas",
@@ -154,7 +169,11 @@ def _last_true_idx(b, iota):
 
 
 def _pick_at(a, iota, idx):
-    """Value of ``a`` at year index ``idx`` ((1, BLK)); 0 when idx == NY."""
+    """Value of ``a`` at year index ``idx`` ((1, BLK)); 0 when idx == NY.
+
+    Where-sum pick: identical to a gather up to the sign of zero (a picked
+    -0.0 comes back +0.0) — same caveat as ``segment._gather_oh``.
+    """
     zero = jnp.zeros((), a.dtype)
     return jnp.sum(jnp.where(iota == idx, a, zero), axis=0, keepdims=True)
 
@@ -330,12 +349,26 @@ def _remove_weakest_ys(
     return vmask_new, (xp, yp, hasp, xq, yq, hasq, ang)
 
 
+def _pick_rank(a, rank, vb, key):
+    """Value of ``a`` at the vertex whose rank equals ``key`` ((1, BLK) i32).
+
+    Rank-keyed masked reduction — the dynamic-key analogue of the static
+    ``rank == k`` picks in :func:`_fit_model_ys`; 0 when no vertex has that
+    rank.  Bit-exact: a selected element, never an arithmetic combination.
+    """
+    zero = jnp.zeros((), a.dtype)
+    return jnp.sum(jnp.where(vb & (rank == key), a, zero), axis=0, keepdims=True)
+
+
 def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
-    """One model's anchored fit + p2p fallback; returns SSE (1, BLK).
+    """One model's anchored fit + p2p fallback; ``(sse, fitted) `` (1, BLK)/(NY, BLK).
 
     Year-major re-expression of segment._fit_model with identical
     arithmetic per decision; vertex-slot reads become rank-keyed masked
-    reductions and seg-of-year reads become fills.
+    reductions and seg-of-year reads become fills.  ``fitted`` is the
+    post-p2p-choice trajectory (``segment._fit_model``'s first return);
+    the family loop discards it (one dead select per model), the fused
+    tail's chosen-model refit consumes it.
     """
     dtype = t.dtype
     ny = t.shape[0]
@@ -431,189 +464,455 @@ def _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params: LTParams):
     sse_reg = jnp.sum(jnp.where(span, (y - fitted) ** 2, zero), axis=0, keepdims=True)
     sse_p2p = jnp.sum(jnp.where(span, (y - p2p) ** 2, zero), axis=0, keepdims=True)
     use_p2p = p2p_ok & (sse_p2p < sse_reg)
-    return jnp.where(use_p2p, sse_p2p, sse_reg)
+    sse = jnp.where(use_p2p, sse_p2p, sse_reg)
+    return sse, jnp.where(use_p2p, p2p, fitted)
+
+
+def _run_stages(t, raw, m_f, ny: int, blk: int, params: LTParams, exact_atan: bool):
+    """Stages 1–4a on one ``(NY, BLK)`` block of values.
+
+    Pure function of block VALUES (no refs) shared by both kernel builders
+    (:func:`_make_family_kernel` for the unfused stats path,
+    :func:`_make_fused_kernel` for the production fused path).  Returns
+    ``(y, vmask_list, sse_list, aux)`` where ``y`` is the despiked series,
+    the lists hold the NM family members' vertex masks (f32 0/1) and fit
+    SSEs in pruning order, and ``aux`` carries the shared per-block
+    scalars the fused tail reuses (same expressions as the XLA tail, so
+    reuse is bit-exact).
+    """
+    nv, nc, nm = params.max_vertices, params.max_candidates, params.max_segments
+    dtype = raw.dtype
+    one = jnp.ones((), dtype)
+    zero = jnp.zeros((), dtype)
+    m = m_f > 0
+    y = jnp.where(m, raw, zero)
+    iota = lax.broadcasted_iota(jnp.int32, (ny, blk), 0)
+    n_valid = jnp.sum(m_f, axis=0, keepdims=True)
+    # ---- Stage 1: despike (early-exit per BLOCK, not per batch) ----
+    if params.spike_threshold < 1.0:
+        tp, hasp = _fill(t, m_f, exclusive=True, reverse=False)
+        tq, hasq = _fill(t, m_f, exclusive=True, reverse=True)
+        interior = m & (hasp > 0) & (hasq > 0)
+        dtp = t - tp
+        denom = jnp.where(interior, tq - tp, one)
+        # the neighbour VALUE tables are carried incrementally: each
+        # iteration modifies y at exactly one (valid, interior) slot i
+        # per pixel, which changes yp only at the nearest valid slot
+        # after i and yq only at the nearest valid slot before i — a
+        # single selected write each, replacing two full fills per
+        # trip (the fills are ~60% of the despike body's ops).  The
+        # carried tables equal the per-trip fills at every slot the
+        # body can read (interior slots; garbage between valid slots
+        # matches the fills' don't-care regions), so results are
+        # bit-identical — gated by tests/test_pallas.py's interpret
+        # bit-exact suite.
+        yp0, _ = _fill(y, m_f, exclusive=True, reverse=False)
+        yq0, _ = _fill(y, m_f, exclusive=True, reverse=True)
+
+        def body(carry):
+            it, y, yp, yq, _ = carry
+            itp = yp + (yq - yp) * dtp / denom
+            dev = jnp.abs(y - itp)
+            crossing = jnp.abs(yq - yp)
+            prop = jnp.where(
+                dev > zero,
+                jnp.maximum(zero, one - crossing / jnp.where(dev > zero, dev, one)),
+                zero,
+            )
+            prop = jnp.where(interior, prop, -one)
+            mx = jnp.max(prop, axis=0, keepdims=True)
+            i_first = _first_true_idx(prop == mx, iota, ny)
+            do = (mx > params.spike_threshold) & (it < n_valid)
+            oh = iota == i_first
+            delta = jnp.where(
+                do, (_pick_at(itp, iota, i_first) - _pick_at(y, iota, i_first)) * mx, zero
+            )
+            y_new = y + jnp.where(oh, delta, zero)
+            y_i_new = _pick_at(y_new, iota, i_first)
+            # when do holds, i is a valid interior slot, so these ARE
+            # the only slots whose nearest-valid neighbour is i
+            j_next = _first_true_idx(m & (iota > i_first), iota, ny)
+            j_prev = _last_true_idx(m & (iota < i_first), iota)
+            yp = jnp.where(do & (iota == j_next), y_i_new, yp)
+            yq = jnp.where(do & (iota == j_prev), y_i_new, yq)
+            return it + one, y_new, yp, yq, jnp.any(do)
+
+        def cond(carry):
+            it, _, _, _, cont = carry
+            return cont & (it[0, 0] < ny)
+
+        _, y, _, _, _ = lax.while_loop(
+            cond,
+            body,
+            (jnp.zeros((1, blk), dtype), y, yp0, yq0, jnp.asarray(True)),
+        )
+
+    # ---- shared scalars ----
+    big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    y_lo = jnp.min(jnp.where(m, y, big), axis=0, keepdims=True)
+    y_hi = jnp.max(jnp.where(m, y, -big), axis=0, keepdims=True)
+    y_range = jnp.maximum(y_hi - y_lo, zero)
+    first_v = _first_true_idx(m, iota, ny)
+    last_v = _last_true_idx(m, iota)
+    t_lo = _pick_at(t, iota, first_v)
+    t_hi = _pick_at(t, iota, last_v)
+
+    # ---- Stage 2: candidate vertices (max-deviation insertion) ----
+    # The per-year segment-coefficient table and seg_start map are
+    # CARRIED across insertion trips: inserting a vertex at i into
+    # [lo, hi] changes them exactly on [lo, i) (refit left half) and
+    # [i, hi) (right half) — range selects of freshly fit values,
+    # bit-identical to the forward fills over a slot cache they
+    # replace.  first/last vertex are loop-invariant (insertions are
+    # strictly interior), so the per-trip first/last reductions and
+    # the seg_start prefix-max rebuild go away too.
+    vmask_f = jnp.where(m & ((iota == first_v) | (iota == last_v)), one, zero)
+    lo0 = _first_true_idx(vmask_f > 0, iota, ny)
+    member_i = (iota >= lo0) & (iota <= _last_true_idx(vmask_f > 0, iota)) & m
+    c0i, c1i = _masked_ols_ys(t, y, member_i.astype(dtype))
+    c0_at = c0i + jnp.zeros((ny, blk), dtype)
+    c1_at = c1i + jnp.zeros((ny, blk), dtype)
+    seg_start = jnp.clip(
+        _prefix_max_incl(jnp.where(vmask_f > 0, iota, -1)), 0, ny - 1
+    )
+
+    for _ in range(nc - 2):
+        dev = jnp.abs(y - (c0_at + c1_at * t))
+        eligible = m & ~(vmask_f > 0) & (iota > first_v) & (iota < last_v)
+        dev = jnp.where(eligible, dev, -one)
+        mx = jnp.max(dev, axis=0, keepdims=True)
+        i_first = _first_true_idx(dev == mx, iota, ny)
+        do = mx >= zero
+        lo = jnp.sum(
+            jnp.where(iota == i_first, seg_start, 0), axis=0, keepdims=True
+        )
+        hi_raw = jnp.min(
+            jnp.where((vmask_f > 0) & (iota > i_first), iota, ny),
+            axis=0,
+            keepdims=True,
+        )
+        hi = jnp.clip(hi_raw, 0, ny - 1)
+        mem_a = (iota >= lo) & (iota <= i_first) & m
+        mem_b = (iota >= i_first) & (iota <= hi) & m
+        c0a, c1a = _masked_ols_ys(t, y, mem_a.astype(dtype))
+        c0b, c1b = _masked_ols_ys(t, y, mem_b.astype(dtype))
+        # right half wins the j == i slot, mirroring the slot cache's
+        # .at[lo].set(·).at[i].set(·) overwrite order
+        rng_a = do & (iota >= lo) & (iota < i_first)
+        rng_b = do & (iota >= i_first) & (iota < hi_raw)
+        c0_at = jnp.where(rng_b, c0b, jnp.where(rng_a, c0a, c0_at))
+        c1_at = jnp.where(rng_b, c1b, jnp.where(rng_a, c1a, c1_at))
+        seg_start = jnp.where(rng_b, i_first, seg_start)
+        vmask_f = jnp.where(do & (iota == i_first), one, vmask_f)
+
+    # ---- Stage 2b + 4a: the remove chain carries one angle state ----
+    # (scaled coordinates replicate the slot-space scaling arithmetic)
+    t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, one)
+    y_rng_s = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
+    xsc = (t - t_lo) / t_rng
+    ysc = (y - y_lo) / y_rng_s
+    state = _angle_state_init(xsc, ysc, vmask_f, exact_atan)
+    for _ in range(params.vertex_count_overshoot):
+        vmask_f, state = _remove_weakest_ys(
+            vmask_f, state, xsc, ysc, iota, nv, exact_atan
+        )
+
+    # ---- Stage 4a: model family (fit SSE, then prune weakest) ----
+    # fitted trajectories are KEPT per member (≈ NM·NY·BLK·4 B ≈ 1 MB of
+    # VMEM at the default block): the fused tail then *selects* the chosen
+    # model's fit instead of refitting it — measured 2.6 ms/step saved at
+    # 262144 px (the refit was the single largest tail cost), and bit-exact
+    # because _fit_model_ys is deterministic in its inputs.
+    vmask_list, sse_list, fitted_list = [], [], []
+    for k in range(nm):
+        vmask_list.append(vmask_f)
+        sse, fitted_k = _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params)
+        sse_list.append(sse)
+        fitted_list.append(fitted_k)
+        if k + 1 < nm:
+            vmask_f, state = _remove_weakest_ys(
+                vmask_f, state, xsc, ysc, iota, 2, exact_atan
+            )
+
+    aux = dict(
+        m=m, iota=iota, n_valid=n_valid, y_lo=y_lo, y_hi=y_hi,
+        y_range=y_range, first_v=first_v, last_v=last_v, t_lo=t_lo, t_hi=t_hi,
+    )
+    return y, vmask_list, sse_list, fitted_list, aux
 
 
 def _make_family_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
-    """Build the Pallas kernel body for static (NY, BLK, params)."""
-    nv, nc, nm = params.max_vertices, params.max_candidates, params.max_segments
+    """Unfused kernel body (stages 1–4a): despiked + family vmasks/SSEs.
+
+    Kept for :func:`family_stats_pallas` (tests, stage probes); production
+    runs use :func:`_make_fused_kernel`.
+    """
 
     def kernel(t_ref, v_ref, m_ref, desp_ref, vm_ref, sse_ref):
         dtype = v_ref.dtype
-        one = jnp.ones((), dtype)
-        zero = jnp.zeros((), dtype)
         t = t_ref[:, 0:1] + jnp.zeros((ny, blk), dtype)  # broadcast year axis
-        m_f = m_ref[:]
-        m = m_f > 0
-        y = jnp.where(m, v_ref[:], zero)
-        iota = lax.broadcasted_iota(jnp.int32, (ny, blk), 0)
-        n_valid = jnp.sum(m_f, axis=0, keepdims=True)
-
-        # ---- Stage 1: despike (early-exit per BLOCK, not per batch) ----
-        if params.spike_threshold < 1.0:
-            tp, hasp = _fill(t, m_f, exclusive=True, reverse=False)
-            tq, hasq = _fill(t, m_f, exclusive=True, reverse=True)
-            interior = m & (hasp > 0) & (hasq > 0)
-            dtp = t - tp
-            denom = jnp.where(interior, tq - tp, one)
-            # the neighbour VALUE tables are carried incrementally: each
-            # iteration modifies y at exactly one (valid, interior) slot i
-            # per pixel, which changes yp only at the nearest valid slot
-            # after i and yq only at the nearest valid slot before i — a
-            # single selected write each, replacing two full fills per
-            # trip (the fills are ~60% of the despike body's ops).  The
-            # carried tables equal the per-trip fills at every slot the
-            # body can read (interior slots; garbage between valid slots
-            # matches the fills' don't-care regions), so results are
-            # bit-identical — gated by tests/test_pallas.py's interpret
-            # bit-exact suite.
-            yp0, _ = _fill(y, m_f, exclusive=True, reverse=False)
-            yq0, _ = _fill(y, m_f, exclusive=True, reverse=True)
-
-            def body(carry):
-                it, y, yp, yq, _ = carry
-                itp = yp + (yq - yp) * dtp / denom
-                dev = jnp.abs(y - itp)
-                crossing = jnp.abs(yq - yp)
-                prop = jnp.where(
-                    dev > zero,
-                    jnp.maximum(zero, one - crossing / jnp.where(dev > zero, dev, one)),
-                    zero,
-                )
-                prop = jnp.where(interior, prop, -one)
-                mx = jnp.max(prop, axis=0, keepdims=True)
-                i_first = _first_true_idx(prop == mx, iota, ny)
-                do = (mx > params.spike_threshold) & (it < n_valid)
-                oh = iota == i_first
-                delta = jnp.where(
-                    do, (_pick_at(itp, iota, i_first) - _pick_at(y, iota, i_first)) * mx, zero
-                )
-                y_new = y + jnp.where(oh, delta, zero)
-                y_i_new = _pick_at(y_new, iota, i_first)
-                # when do holds, i is a valid interior slot, so these ARE
-                # the only slots whose nearest-valid neighbour is i
-                j_next = _first_true_idx(m & (iota > i_first), iota, ny)
-                j_prev = _last_true_idx(m & (iota < i_first), iota)
-                yp = jnp.where(do & (iota == j_next), y_i_new, yp)
-                yq = jnp.where(do & (iota == j_prev), y_i_new, yq)
-                return it + one, y_new, yp, yq, jnp.any(do)
-
-            def cond(carry):
-                it, _, _, _, cont = carry
-                return cont & (it[0, 0] < ny)
-
-            _, y, _, _, _ = lax.while_loop(
-                cond,
-                body,
-                (jnp.zeros((1, blk), dtype), y, yp0, yq0, jnp.asarray(True)),
-            )
-        desp_ref[:] = y
-
-        # ---- shared scalars ----
-        big = jnp.asarray(jnp.finfo(dtype).max, dtype)
-        y_lo = jnp.min(jnp.where(m, y, big), axis=0, keepdims=True)
-        y_hi = jnp.max(jnp.where(m, y, -big), axis=0, keepdims=True)
-        y_range = jnp.maximum(y_hi - y_lo, zero)
-        first_v = _first_true_idx(m, iota, ny)
-        last_v = _last_true_idx(m, iota)
-        t_lo = _pick_at(t, iota, first_v)
-        t_hi = _pick_at(t, iota, last_v)
-
-        # ---- Stage 2: candidate vertices (max-deviation insertion) ----
-        # The per-year segment-coefficient table and seg_start map are
-        # CARRIED across insertion trips: inserting a vertex at i into
-        # [lo, hi] changes them exactly on [lo, i) (refit left half) and
-        # [i, hi) (right half) — range selects of freshly fit values,
-        # bit-identical to the forward fills over a slot cache they
-        # replace.  first/last vertex are loop-invariant (insertions are
-        # strictly interior), so the per-trip first/last reductions and
-        # the seg_start prefix-max rebuild go away too.
-        vmask_f = jnp.where(m & ((iota == first_v) | (iota == last_v)), one, zero)
-        lo0 = _first_true_idx(vmask_f > 0, iota, ny)
-        member_i = (iota >= lo0) & (iota <= _last_true_idx(vmask_f > 0, iota)) & m
-        c0i, c1i = _masked_ols_ys(t, y, member_i.astype(dtype))
-        c0_at = c0i + jnp.zeros((ny, blk), dtype)
-        c1_at = c1i + jnp.zeros((ny, blk), dtype)
-        seg_start = jnp.clip(
-            _prefix_max_incl(jnp.where(vmask_f > 0, iota, -1)), 0, ny - 1
+        y, vmask_list, sse_list, _, _ = _run_stages(
+            t, v_ref[:], m_ref[:], ny, blk, params, exact_atan
         )
-
-        for _ in range(nc - 2):
-            dev = jnp.abs(y - (c0_at + c1_at * t))
-            eligible = m & ~(vmask_f > 0) & (iota > first_v) & (iota < last_v)
-            dev = jnp.where(eligible, dev, -one)
-            mx = jnp.max(dev, axis=0, keepdims=True)
-            i_first = _first_true_idx(dev == mx, iota, ny)
-            do = mx >= zero
-            lo = jnp.sum(
-                jnp.where(iota == i_first, seg_start, 0), axis=0, keepdims=True
-            )
-            hi_raw = jnp.min(
-                jnp.where((vmask_f > 0) & (iota > i_first), iota, ny),
-                axis=0,
-                keepdims=True,
-            )
-            hi = jnp.clip(hi_raw, 0, ny - 1)
-            mem_a = (iota >= lo) & (iota <= i_first) & m
-            mem_b = (iota >= i_first) & (iota <= hi) & m
-            c0a, c1a = _masked_ols_ys(t, y, mem_a.astype(dtype))
-            c0b, c1b = _masked_ols_ys(t, y, mem_b.astype(dtype))
-            # right half wins the j == i slot, mirroring the slot cache's
-            # .at[lo].set(·).at[i].set(·) overwrite order
-            rng_a = do & (iota >= lo) & (iota < i_first)
-            rng_b = do & (iota >= i_first) & (iota < hi_raw)
-            c0_at = jnp.where(rng_b, c0b, jnp.where(rng_a, c0a, c0_at))
-            c1_at = jnp.where(rng_b, c1b, jnp.where(rng_a, c1a, c1_at))
-            seg_start = jnp.where(rng_b, i_first, seg_start)
-            vmask_f = jnp.where(do & (iota == i_first), one, vmask_f)
-
-        # ---- Stage 2b + 4a: the remove chain carries one angle state ----
-        # (scaled coordinates replicate the slot-space scaling arithmetic)
-        t_rng = jnp.where(t_hi > t_lo, t_hi - t_lo, one)
-        y_rng_s = jnp.where(y_hi > y_lo, y_hi - y_lo, one)
-        xsc = (t - t_lo) / t_rng
-        ysc = (y - y_lo) / y_rng_s
-        state = _angle_state_init(xsc, ysc, vmask_f, exact_atan)
-        for _ in range(params.vertex_count_overshoot):
-            vmask_f, state = _remove_weakest_ys(
-                vmask_f, state, xsc, ysc, iota, nv, exact_atan
-            )
-
-        # ---- Stage 4a: model family (fit SSE, then prune weakest) ----
-        for k in range(nm):
-            vm_ref[k] = vmask_f
-            sse = _fit_model_ys(t, y, m_f, vmask_f, y_range, iota, params)
-            sse_ref[k] = sse[0]
-            if k + 1 < nm:
-                vmask_f, state = _remove_weakest_ys(
-                    vmask_f, state, xsc, ysc, iota, 2, exact_atan
-                )
+        desp_ref[:] = y
+        for k in range(params.max_segments):
+            vm_ref[k] = vmask_list[k]
+            sse_ref[k] = sse_list[k][0]
 
     return kernel
 
 
-@functools.partial(
-    jax.jit, static_argnames=("params", "block", "interpret")
-)
-def family_stats_pallas(
-    years: jnp.ndarray,
-    values: jnp.ndarray,
-    mask: jnp.ndarray,
-    params: LTParams = LTParams(),
-    block: int = 1024,
-    interpret: bool = False,
-):
-    """Run the Pallas family kernel over a ``(PX, NY)`` batch.
+def _fused_tail(t, raw, y, vmask_list, sse_list, fitted_list, aux,
+                ny: int, blk: int, params: LTParams):
+    """Scoring → selection → chosen-model refit → output assembly, year-major.
 
-    Returns ``(despiked (PX, NY), vmasks (PX, NM, NY) bool, sses (PX, NM))``
-    — the inputs :func:`segment._select_and_assemble` needs.  PX must be a
-    multiple of ``block`` (pad with fully-masked rows first).
+    Line-for-line re-expression of ``segment._select_and_assemble`` on
+    ``(NY, BLK)`` blocks: per-pixel scalars become ``(1, BLK)`` rows,
+    vertex-slot reads become rank-keyed masked reductions
+    (:func:`_pick_rank`), and ``np.interp`` through the chosen vertices
+    becomes fills + the slot-index case analysis below.  Float arithmetic
+    replicates the slot-space tail expression for expression, so f64
+    interpret output is bit-identical to the XLA kernel (gated by
+    ``tests/test_pallas.py``) and compiled f32 shares
+    ``segment._f_stat_p_and_logp`` — the scoring path itself — with the
+    XLA kernel.  Scoring: f64 uses the exact ``betainc``
+    (``segment._f_stat_p``, interpret-only — no Mosaic lowering); f32
+    uses the fixed-trip Lentz with the shared ``_lgamma_fixed``.
     """
-    px, ny = values.shape
-    block = min(block, px)  # small batches: one block per batch
-    if px % block:
-        raise ValueError(f"pixel count {px} not a multiple of block {block}")
-    nm = params.max_segments
+    dtype = t.dtype
+    nv, nm = params.max_vertices, params.max_segments
+    exact_mode = dtype == jnp.float64
+    one = jnp.ones((), dtype)
+    zero = jnp.zeros((), dtype)
+    m = aux["m"]
+    iota = aux["iota"]
+    n_valid = aux["n_valid"]
+    y_range = aux["y_range"]
+    last_v = aux["last_v"]
+    t_hi = aux["t_hi"]
+
+    enough = n_valid >= params.min_observations_needed
+    n_safe = jnp.maximum(n_valid, one)
+    mean0 = jnp.sum(jnp.where(m, y, zero), axis=0, keepdims=True) / n_safe
+    ss0 = jnp.sum(jnp.where(m, (y - mean0) ** 2, zero), axis=0, keepdims=True)
+
+    # --- scores per family member (selection: linear p in f64, log p in f32) ---
+    iters = _lentz_iters(ny)
+    ms_list = [jnp.sum(vm, axis=0, keepdims=True) - one for vm in vmask_list]
+    if exact_mode:
+        # XLA CPU's betainc expansion is not bit-stable across layouts (its
+        # last-ulp rounding tracks the minormost-dim extent), so the exact
+        # path evaluates at the SAME (pixels, NM) layout the vmapped XLA
+        # tail uses — bit-identity with the oracle-parity anchor is layout-
+        # borrowed, not assumed.  Interpret-only (f64 never compiles), so
+        # the transposes never reach Mosaic.
+        sse_T = jnp.concatenate(sse_list, axis=0).T          # (BLK, NM)
+        ms_T = jnp.concatenate(ms_list, axis=0).T
+        p_T = _f_stat_p(ss0[0][:, None], sse_T, n_valid[0][:, None], ms_T)
+        ps_list = [p_T[:, k][None, :] for k in range(nm)]
+        score_list = ps_list
+    else:
+        # sublane-pack the family axis: (1, BLK) per-pixel rows use 1/8 of
+        # every f32 vreg, so running the div/log-heavy Lentz+lgamma scorer
+        # once on an (NM, BLK) stack costs ~NM× fewer vector ops than NM
+        # row evaluations — same expression per element, so identical bits
+        sse_mat = jnp.concatenate(sse_list, axis=0)   # (NM, BLK)
+        ms_mat = jnp.concatenate(ms_list, axis=0)
+        p_mat, s_mat = _f_stat_p_and_logp(
+            ss0, sse_mat, n_valid, ms_mat, iters=iters
+        )
+        ps_list = [p_mat[k:k + 1] for k in range(nm)]
+        score_list = [s_mat[k:k + 1] for k in range(nm)]
+    best = score_list[0]
+    for k in range(1, nm):
+        best = jnp.minimum(best, score_list[k])
+    if exact_mode:
+        thresh = best / params.best_model_proportion
+    else:
+        thresh = best - jnp.log(jnp.asarray(params.best_model_proportion, dtype))
+    # first (= most segments) qualifying model; best always qualifies itself
+    chosen = jnp.full((1, blk), nm - 1, jnp.int32)
+    for k in range(nm - 1, -1, -1):
+        chosen = jnp.where(score_list[k] <= thresh, k, chosen)
+    # chosen-model quantities are SELECTS over the family loop's carried
+    # results — _fit_model_ys is deterministic, so selecting its stored
+    # (sse, fitted) is bit-identical to the XLA tail's refit of the chosen
+    # vertex set, without re-running a seventh fit
+    vmask_c = vmask_list[0]
+    p_c = ps_list[0]
+    sse_c = sse_list[0]
+    fitted_c = fitted_list[0]
+    for k in range(1, nm):
+        sel = chosen == k
+        vmask_c = jnp.where(sel, vmask_list[k], vmask_c)
+        p_c = jnp.where(sel, ps_list[k], p_c)
+        sse_c = jnp.where(sel, sse_list[k], sse_c)
+        fitted_c = jnp.where(sel, fitted_list[k], fitted_c)
+
+    model_valid = enough & (y_range > zero) & (p_c <= params.p_val_threshold)
+    mv = model_valid
+
+    # --- flat no-fit model statistics (raw values when data insufficient) ---
+    has_any = n_valid > zero
+    mean_desp = jnp.where(
+        has_any, jnp.sum(jnp.where(m, y, zero), axis=0, keepdims=True) / n_safe, zero
+    )
+    mean_raw = jnp.where(
+        has_any, jnp.sum(jnp.where(m, raw, zero), axis=0, keepdims=True) / n_safe, zero
+    )
+    mean = jnp.where(enough, mean_desp, mean_raw)
+    flat_src = jnp.where(enough, y, raw)
+
+    # --- vertex-slot outputs: rank-keyed picks over the chosen mask ---
+    vb_c = vmask_c > 0
+    cincl_c = _prefix_sum_incl(vmask_c.astype(jnp.int32))
+    rank_c = cincl_c - 1
+    k_live = jnp.sum(vmask_c.astype(jnp.int32), axis=0, keepdims=True)
+    pos_l, tv_l, yv_l, fv_l = [], [], [], []
+    for j in range(nv):
+        sel = vb_c & (rank_c == j)
+        pos_l.append(jnp.sum(jnp.where(sel, iota, 0), axis=0, keepdims=True))
+        tv_l.append(jnp.sum(jnp.where(sel, t, zero), axis=0, keepdims=True))
+        yv_l.append(jnp.sum(jnp.where(sel, y, zero), axis=0, keepdims=True))
+        fv_l.append(jnp.sum(jnp.where(sel, fitted_c, zero), axis=0, keepdims=True))
+    vidx_rows, vyear_rows, vsrc_rows, vfit_rows = [], [], [], []
+    for j in range(nv):
+        live_j = (j < k_live) & mv
+        vidx_rows.append(jnp.where(live_j, pos_l[j], -1))
+        vyear_rows.append(jnp.where(live_j, tv_l[j], zero))
+        vsrc_rows.append(jnp.where(live_j, yv_l[j], zero))
+        vfit_rows.append(jnp.where(live_j, fv_l[j], zero))
+    smag_rows, sdur_rows, srate_rows = [], [], []
+    for j in range(nm):
+        seg_live = (j < k_live - 1) & mv
+        mag = jnp.where(seg_live, fv_l[j + 1] - fv_l[j], zero)
+        dur = jnp.where(seg_live, tv_l[j + 1] - tv_l[j], zero)
+        rate = jnp.where(
+            seg_live & (dur > zero), mag / jnp.where(dur > zero, dur, one), zero
+        )
+        smag_rows.append(mag)
+        sdur_rows.append(dur)
+        srate_rows.append(rate)
+
+    # --- fitted_full: np.interp replica through the chosen vertices ---
+    # segment._interp_through_vertices pads dead slots with (pad_t = t_hi,
+    # last live fit) and reads xp/fp at i = clip(count(xp <= t), 1, NV-1).
+    # Year-major case analysis of that slot index (equalities verified
+    # against the slot form by the f64 bit-exact suite):
+    #   cincl == 0 (before the first vertex): the computed f is discarded
+    #     by the t < xp[0] clamp, so any finite stand-in works — use the
+    #     rank-0 vertex (dx = 0 ⇒ f = fp[0], the clamp value itself);
+    #   0 < cincl, iota < last_v: xp[i-1] = previous vertex at-or-before,
+    #     xp[i] = next vertex strictly after (both exist);
+    #   iota >= last_v: count saturates ⇒ i = NV-1.  With k < NV slots
+    #     live, xp[NV-2] and xp[NV-1] are both pads (or the last vertex)
+    #     at t_hi ⇒ dx = 0 ⇒ f = last fit.  With ALL NV slots live,
+    #     xp[NV-2] is the PENULTIMATE vertex: delta == dx exactly, so
+    #     f = penult_fit + 1.0 * (last_fit - penult_fit) — replicated, not
+    #     shortcut to last_fit (a + (b-a) != b in float).
+    tp_v, fp_v, _ = _fill2(t, fitted_c, vmask_c, exclusive=False, reverse=False)
+    tn_v, fn_v, _ = _fill2(t, fitted_c, vmask_c, exclusive=True, reverse=True)
+    first_t, first_f = tv_l[0], fv_l[0]
+    last_f = _pick_rank(fitted_c, rank_c, vb_c, k_live - 1)
+    penult_t = _pick_rank(t, rank_c, vb_c, k_live - 2)
+    penult_f = _pick_rank(fitted_c, rank_c, vb_c, k_live - 2)
+    full = k_live == nv
+    tzone = iota >= last_v
+    below = cincl_c == 0
+    xp_im1 = jnp.where(below, first_t, tp_v)
+    fp_im1 = jnp.where(below, first_f, fp_v)
+    xp_im1 = jnp.where(tzone, jnp.where(full, penult_t, t_hi), xp_im1)
+    fp_im1 = jnp.where(tzone, jnp.where(full, penult_f, last_f), fp_im1)
+    xp_i = jnp.where(tzone, t_hi, tn_v)
+    fp_i = jnp.where(tzone, last_f, fn_v)
+    df_i = fp_i - fp_im1
+    dx = xp_i - xp_im1
+    delta = t - xp_im1
+    eps_g = jnp.asarray(np.spacing(np.finfo(np.dtype(dtype)).eps), dtype)
+    dx0 = jnp.abs(dx) <= eps_g
+    f = jnp.where(dx0, fp_im1, fp_im1 + (delta / jnp.where(dx0, one, dx)) * df_i)
+    f = jnp.where(t < first_t, first_f, f)
+    f = jnp.where(t > t_hi, last_f, f)
+    fitted_full = jnp.where(mv, f, mean + jnp.zeros((ny, blk), dtype))
+
+    # --- scalars + despiked output ---
+    rmse_fit = jnp.sqrt(sse_c / n_safe)
+    rmse_flat = jnp.sqrt(
+        jnp.sum(jnp.where(m, (flat_src - mean) ** 2, zero), axis=0, keepdims=True)
+        / n_safe
+    )
+    rmse = jnp.where(mv, rmse_fit, jnp.where(has_any, rmse_flat, zero))
+    p_of_f = jnp.where(mv, p_c, one)
+    n_vertices = jnp.where(mv, k_live, 0)
+    despiked_fit = jnp.where(m, y, raw)
+    despiked_flat = jnp.where(m, flat_src, mean)
+    despiked = jnp.where(mv, despiked_fit, despiked_flat)
+
+    return dict(
+        n_vertices=n_vertices,
+        vertex_indices=jnp.concatenate(vidx_rows, axis=0),
+        vertex_years=jnp.concatenate(vyear_rows, axis=0),
+        vertex_src_vals=jnp.concatenate(vsrc_rows, axis=0),
+        vertex_fit_vals=jnp.concatenate(vfit_rows, axis=0),
+        seg_magnitude=jnp.concatenate(smag_rows, axis=0),
+        seg_duration=jnp.concatenate(sdur_rows, axis=0),
+        seg_rate=jnp.concatenate(srate_rows, axis=0),
+        rmse=rmse,
+        p_of_f=p_of_f,
+        model_valid=mv,
+        fitted=fitted_full,
+        despiked=despiked,
+    )
+
+
+def _make_fused_kernel(ny: int, blk: int, params: LTParams, exact_atan: bool):
+    """Fused kernel body: stages 1–4a + scoring/selection/assembly in VMEM.
+
+    The production path — one HBM read and one write per block for the
+    whole pipeline; the family's ``(NM, NY, BLK)`` vertex masks live only
+    as in-kernel values (register/VMEM), never as an HBM tensor.
+    """
+
+    def kernel(
+        t_ref, v_ref, m_ref,
+        desp_ref, fit_ref, nvert_ref, vidx_ref, vyear_ref, vsrc_ref, vfit_ref,
+        smag_ref, sdur_ref, srate_ref, rmse_ref, pof_ref, mv_ref,
+    ):
+        dtype = v_ref.dtype
+        t = t_ref[:, 0:1] + jnp.zeros((ny, blk), dtype)  # broadcast year axis
+        raw = v_ref[:]
+        m_f = m_ref[:]
+        y, vmask_list, sse_list, fitted_list, aux = _run_stages(
+            t, raw, m_f, ny, blk, params, exact_atan
+        )
+        outs = _fused_tail(
+            t, raw, y, vmask_list, sse_list, fitted_list, aux, ny, blk, params
+        )
+        desp_ref[:] = outs["despiked"]
+        fit_ref[:] = outs["fitted"]
+        nvert_ref[:] = outs["n_vertices"]
+        vidx_ref[:] = outs["vertex_indices"]
+        vyear_ref[:] = outs["vertex_years"]
+        vsrc_ref[:] = outs["vertex_src_vals"]
+        vfit_ref[:] = outs["vertex_fit_vals"]
+        smag_ref[:] = outs["seg_magnitude"]
+        sdur_ref[:] = outs["seg_duration"]
+        srate_ref[:] = outs["seg_rate"]
+        rmse_ref[:] = outs["rmse"]
+        pof_ref[:] = outs["p_of_f"]
+        mv_ref[:] = outs["model_valid"].astype(jnp.int32)
+
+    return kernel
+
+
+
+def _prep_kernel_inputs(years, values, mask, ny: int, interpret: bool):
+    """Shared wrapper preamble: x64 guard + ``(NY, ·)`` input layout.
+
+    One definition for both entry points so the Mosaic-x64 workaround and
+    the lane layout can never diverge between the test path
+    (:func:`family_stats_pallas`) and the production fused path.
+    """
     dtype = jnp.result_type(values.dtype, jnp.float32)
     if not interpret and jax.config.jax_enable_x64:
         # Mosaic's 64-bit-emulation convert_element_type lowering recurses
@@ -627,11 +926,34 @@ def family_stats_pallas(
             "wrap the call in `with jax.enable_x64(False):` at top level "
             "(f32 inputs), or pass interpret=True for the f64 path"
         )
-
     t_col = jnp.broadcast_to(years.astype(dtype)[:, None], (ny, 128))
     mask_b = mask.astype(bool) & jnp.isfinite(values)
-    v_T = values.astype(dtype).T
-    m_T = mask_b.astype(dtype).T
+    return dtype, t_col, values.astype(dtype).T, mask_b.astype(dtype).T
+
+
+@functools.partial(
+    jax.jit, static_argnames=("params", "block", "interpret")
+)
+def family_stats_pallas(
+    years: jnp.ndarray,
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    params: LTParams = LTParams(),
+    block: int = 256,
+    interpret: bool = False,
+):
+    """Run the Pallas family kernel over a ``(PX, NY)`` batch.
+
+    Returns ``(despiked (PX, NY), vmasks (PX, NM, NY) bool, sses (PX, NM))``
+    — the inputs :func:`segment._select_and_assemble` needs.  PX must be a
+    multiple of ``block`` (pad with fully-masked rows first).
+    """
+    px, ny = values.shape
+    block = min(block, px)  # small batches: one block per batch
+    if px % block:
+        raise ValueError(f"pixel count {px} not a multiple of block {block}")
+    nm = params.max_segments
+    dtype, t_col, v_T, m_T = _prep_kernel_inputs(years, values, mask, ny, interpret)
 
     kernel = _make_family_kernel(ny, block, params, exact_atan=interpret)
     grid = (px // block,)
@@ -670,16 +992,17 @@ def jax_segment_pixels_pallas_chunked(
     mask: jnp.ndarray,
     params: LTParams = LTParams(),
     chunk: int = 262144,
-    block: int = 1024,
+    block: int = 256,
     interpret: bool = False,
 ) -> SegOutputs:
     """:func:`jax_segment_pixels_pallas` with HBM bounded by ``chunk`` pixels.
 
     Same contract as :func:`segment.jax_segment_pixels_chunked`: the pixel
     count must be a multiple of ``chunk`` (pad with fully-masked rows), and
-    ``lax.map`` streams the chunks through one compiled program.  Bounding
-    the chunk also bounds the (chunk, NM, NY) family intermediates the
-    Pallas path materialises between its kernel and the XLA tail.
+    ``lax.map`` streams the chunks through one compiled program.  Since the
+    round-5 fusion the family intermediates never leave VMEM, so ``chunk``
+    bounds only the ``(chunk, NY)`` input/despiked/fitted and per-pixel
+    output buffers in HBM.
     """
     px = values.shape[0]
     if px % chunk:
@@ -705,23 +1028,76 @@ def jax_segment_pixels_pallas(
     values: jnp.ndarray,
     mask: jnp.ndarray,
     params: LTParams = LTParams(),
-    block: int = 1024,
+    block: int = 256,
     interpret: bool = False,
 ) -> SegOutputs:
-    """:func:`segment.jax_segment_pixels` with the heavy middle on Pallas.
+    """:func:`segment.jax_segment_pixels` fully fused into one Pallas kernel.
 
     Same signature and output contract; PX must be a multiple of ``block``
     (use :func:`land_trendr_tpu.parallel.pad_to_multiple`).  On CPU pass
     ``interpret=True`` (Mosaic is TPU-only); interpret mode is
     dtype-generic, which is how the f64 oracle-parity tests drive it.
+    The whole pipeline — despike through output assembly — runs inside the
+    ``(NY, BLK)`` kernel (round 5; the round-4 split handed the family
+    intermediates to an XLA ``_select_and_assemble`` tail over HBM).
     """
-    dtype = jnp.result_type(values.dtype, jnp.float32)
-    despiked, vmasks, sses = family_stats_pallas(
-        years, values, mask, params, block, interpret
+    px, ny = values.shape
+    block = min(block, px)  # small batches: one block per batch
+    if px % block:
+        raise ValueError(f"pixel count {px} not a multiple of block {block}")
+    nv, nm = params.max_vertices, params.max_segments
+    dtype, t_col, v_T, m_T = _prep_kernel_inputs(years, values, mask, ny, interpret)
+
+    kernel = _make_fused_kernel(ny, block, params, exact_atan=interpret)
+    grid = (px // block,)
+
+    def out(rows, dt):
+        return (
+            pl.BlockSpec((rows, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            jax.ShapeDtypeStruct((rows, px), dt),
+        )
+
+    specs = [
+        out(ny, dtype),          # despiked
+        out(ny, dtype),          # fitted
+        out(1, jnp.int32),       # n_vertices
+        out(nv, jnp.int32),      # vertex_indices
+        out(nv, dtype),          # vertex_years
+        out(nv, dtype),          # vertex_src_vals
+        out(nv, dtype),          # vertex_fit_vals
+        out(nm, dtype),          # seg_magnitude
+        out(nm, dtype),          # seg_duration
+        out(nm, dtype),          # seg_rate
+        out(1, dtype),           # rmse
+        out(1, dtype),           # p_of_f
+        out(1, jnp.int32),       # model_valid
+    ]
+    desp, fit, nvert, vidx, vyear, vsrc, vfit, smag, sdur, srate, rmse, pof, mv = (
+        pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((ny, 128), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((ny, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+                pl.BlockSpec((ny, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[s for s, _ in specs],
+            out_shape=[o for _, o in specs],
+            interpret=interpret,
+        )(t_col, v_T, m_T)
     )
-    t = years.astype(dtype)
-    mask_b = mask.astype(bool) & jnp.isfinite(values)
-    raw = values.astype(dtype)
-    return jax.vmap(
-        lambda r, mb, y, vms, ss: _select_and_assemble(t, r, mb, y, vms, ss, params)
-    )(raw, mask_b, despiked, vmasks, sses)
+    return SegOutputs(
+        n_vertices=nvert[0],
+        vertex_indices=vidx.T,
+        vertex_years=vyear.T,
+        vertex_src_vals=vsrc.T,
+        vertex_fit_vals=vfit.T,
+        seg_magnitude=smag.T,
+        seg_duration=sdur.T,
+        seg_rate=srate.T,
+        rmse=rmse[0],
+        p_of_f=pof[0],
+        model_valid=mv[0] > 0,
+        fitted=fit.T,
+        despiked=desp.T,
+    )

@@ -57,8 +57,11 @@ class TileOutputs(NamedTuple):
 
 #: lane-axis block of the Pallas family kernel (segment_pallas); tile
 #: pixel counts are padded up to a multiple of this, and chunk sizes used
-#: with impl="pallas" must divide by it
-PALLAS_BLOCK = 1024
+#: with impl="pallas" must divide by it.  256 measured fastest on TPU v5
+#: lite for the round-5 fused kernel (23.2M px/s vs 16.7M at 1024 — the
+#: (NY, 256) working set relieves VMEM/register pressure; >=2048 fails to
+#: compile outright), see tools/tpu_probe.py block sweep.
+PALLAS_BLOCK = 256
 
 
 def resolve_impl(impl: str) -> str:
@@ -151,6 +154,19 @@ def process_tile_dn(
         # other backend runs interpret mode (slow; for debugging parity).
         blk = PALLAS_BLOCK
         interp = jax.default_backend() != "tpu"
+        if interp:
+            import warnings
+
+            # advisor finding (round 4): a misconfigured production run
+            # (impl="pallas", non-TPU backend) would otherwise look hung —
+            # interpret mode is orders of magnitude slower than impl="xla"
+            warnings.warn(
+                f"impl='pallas' on backend {jax.default_backend()!r} runs "
+                "Mosaic INTERPRET mode (debug-only, ~1000x slower than "
+                "impl='xla'); use impl='auto' or 'xla' for production",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         primary_p, mask_p, _ = pad_to_multiple(primary, mask, blk)
         if chunk is not None and primary_p.shape[0] > chunk:
             if chunk > blk and chunk % blk:

@@ -188,13 +188,67 @@ def test_lentz_betainc_accuracy_bound():
     lp32 = np.asarray(lp32, np.float64)
     healthy = ref > 1e-30
     rel = np.abs(p32[healthy] - ref[healthy]) / np.maximum(ref[healthy], 1e-38)
-    # measured: 1.8e-5 in a NumPy f32 emulation, 6.7e-5 under XLA CPU
-    # (FMA fusion shifts the Lentz rounding tail); both orders of
-    # magnitude inside the selection knife-edge band the end-to-end
-    # agreement gates above police
+    # measured: 4.6e-5 under XLA CPU with the shared _lgamma_fixed
+    # (round 5; the lax.lgamma form measured 6.7e-5); orders of magnitude
+    # inside the selection knife-edge band the end-to-end agreement gates
+    # above police
     assert rel.max() < 2e-4, rel.max()
     assert np.percentile(rel, 99) < 2e-5, np.percentile(rel, 99)
     lref = np.log(np.maximum(ref, 1e-300))
     lperr = np.abs(lp32 - lref)
     assert np.percentile(lperr, 99) < 5e-5, np.percentile(lperr, 99)
     assert lperr.max() < 1e-2, lperr.max()       # deep-tail absolute sanity
+
+
+def test_lentz_iters_rule_covers_long_stacks():
+    """The sqrt-of-dof trip rule keeps the Lentz envelope beyond NY = 40.
+
+    Advisor finding (round 4): the fixed 12-trip count was only validated
+    for NY <= 40; a 100-year stack raises a = df2/2 to 44 where 12 trips
+    may not converge.  ``_lentz_iters`` now derives the count from the
+    static year-axis length; this gate runs the extended grid (n up to
+    100) at the derived count and holds the same envelope."""
+    import jax
+    import jax.numpy as jnp
+
+    from land_trendr_tpu.ops.segment import _betainc_p_and_logp_lentz, _lentz_iters
+
+    assert _lentz_iters(40) == 12  # default NY: exactly the validated count
+    ny = 100
+    iters = _lentz_iters(ny)
+    assert iters == 18  # the rule actually scales (truncation, not ceil)
+    rng = np.random.default_rng(1)
+    a_l, b_l, x_l = [], [], []
+    for n in range(6, ny + 1, 2):
+        for m in range(1, 7):
+            df1, df2 = 2 * m - 1, n - 2 * m
+            if df2 < 1:
+                continue
+            f = 10 ** rng.uniform(-3, 4, 120)
+            x = df2 / (df2 + df1 * f)
+            a_l.append(np.full_like(x, df2 / 2.0))
+            b_l.append(np.full_like(x, df1 / 2.0))
+            x_l.append(x)
+    a = np.concatenate(a_l)
+    b = np.concatenate(b_l)
+    x = np.concatenate(x_l)
+    ref = np.asarray(
+        jax.scipy.special.betainc(
+            jnp.asarray(a, jnp.float64),
+            jnp.asarray(b, jnp.float64),
+            jnp.asarray(x, jnp.float64),
+        )
+    )
+    p32, lp32 = _betainc_p_and_logp_lentz(
+        jnp.asarray(a, jnp.float32),
+        jnp.asarray(b, jnp.float32),
+        jnp.asarray(x, jnp.float32),
+        iters=iters,
+    )
+    p32 = np.asarray(p32, np.float64)
+    healthy = ref > 1e-30
+    rel = np.abs(p32[healthy] - ref[healthy]) / np.maximum(ref[healthy], 1e-38)
+    assert rel.max() < 3e-4, rel.max()
+    lref = np.log(np.maximum(ref, 1e-300))
+    lperr = np.abs(np.asarray(lp32, np.float64) - lref)
+    assert lperr.max() < 1e-2, lperr.max()

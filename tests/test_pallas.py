@@ -38,9 +38,24 @@ def _population(px, seed=0):
 
 
 def _assert_outputs_equal(out_a, out_b, *, exact=True):
+    """Exact on every field except ``p_of_f``, which gets rtol 1e-12.
+
+    ``p_of_f`` is the one output whose primitive — XLA's betainc expansion
+    — is not bit-stable across fusion contexts (its last-ulp rounding
+    tracks the surrounding program; measured ~3e-14 rel between the fused
+    in-kernel evaluation and the former standalone tail on identical
+    inputs).  The oracle-parity suite itself compares p_of_f at atol 1e-9
+    (``test_parity.py`` — the oracle's scipy betainc never matched XLA's
+    bitwise), so 1e-12 here is strictly tighter than the contract the XLA
+    kernel is held to.  Every DECISION derived from p (model choice,
+    model_valid, vertices) still must match bit-for-bit via the other
+    fields.
+    """
     for f in out_a._fields:
         a, b = np.asarray(getattr(out_a, f)), np.asarray(getattr(out_b, f))
-        if exact:
+        if exact and f == "p_of_f":
+            np.testing.assert_allclose(a, b, rtol=1e-12, atol=0, err_msg=f)
+        elif exact:
             np.testing.assert_array_equal(a, b, err_msg=f)
         else:
             np.testing.assert_allclose(a, b, rtol=0, atol=1e-6, err_msg=f)

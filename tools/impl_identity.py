@@ -1,0 +1,133 @@
+"""On-chip XLA-vs-Pallas implementation identity proof (committed form).
+
+Runs BOTH compiled f32 kernels — the portable XLA kernel and the fused
+Pallas kernel — over the identical parity-suite population on the real
+TPU and measures per-pixel decision overlap directly.  This is the
+auditable form of the "identical parity taxonomy" observation in
+``PARITY_f32_tpu*.json``: if the two implementations are bit-identical
+pixel-for-pixel, every oracle disagreement belongs to both.
+
+Round-5 contract update: with the tail fused into the Pallas kernel, all
+DECISION fields and float trajectories remain bit-identical, but
+``p_of_f`` is evaluated by the same Lentz expression in two different
+fusion contexts (Mosaic in-kernel vs the XLA tail), whose last-ulp
+rounding differs — the artifact therefore records its max relative delta
+(expected within the documented Lentz envelope, ~1e-4) instead of
+asserting bitwise equality on it.  Same principle as the f64 suite
+(``tests/test_pallas.py::_assert_outputs_equal``).
+
+Usage::  python tools/impl_identity.py [--px 1048576] [--out IMPL_IDENTITY_rNN.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--px", type=int, default=1048576)
+    ap.add_argument("--chunk", type=int, default=262144)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels_chunked
+    from land_trendr_tpu.ops.segment_pallas import (
+        jax_segment_pixels_pallas_chunked,
+    )
+    from land_trendr_tpu.utils.compilation_cache import enable_persistent_cache
+    from tools._population import make_population
+
+    enable_persistent_cache()
+    params = LTParams()
+    px, ny = args.px, 40
+    n_seeds = 16
+    per = px // n_seeds
+    args.chunk = min(args.chunk, per)
+    platform = jax.default_backend()
+
+    stats = {
+        "pixel_exact_vertex_indices": 0,
+        "model_valid_equal": 0,
+        "n_vertices_equal": 0,
+        "fitted_abs_delta_max": 0.0,
+        "p_of_f_rel_delta_max": 0.0,
+    }
+    done = 0
+    for seed in range(n_seeds):
+        rng = np.random.default_rng(seed)
+        years, vals, mask = make_population(rng, per, ny)
+        vals = vals.astype(np.float32)
+        out_x = jax.block_until_ready(
+            jax_segment_pixels_chunked(years, vals, mask, params, args.chunk)
+        )
+        out_p = jax.block_until_ready(
+            jax_segment_pixels_pallas_chunked(
+                years, vals, mask, params, chunk=args.chunk
+            )
+        )
+        vi_eq = np.all(
+            np.asarray(out_x.vertex_indices) == np.asarray(out_p.vertex_indices),
+            axis=1,
+        )
+        stats["pixel_exact_vertex_indices"] += int(vi_eq.sum())
+        stats["model_valid_equal"] += int(
+            (np.asarray(out_x.model_valid) == np.asarray(out_p.model_valid)).sum()
+        )
+        stats["n_vertices_equal"] += int(
+            (np.asarray(out_x.n_vertices) == np.asarray(out_p.n_vertices)).sum()
+        )
+        stats["fitted_abs_delta_max"] = max(
+            stats["fitted_abs_delta_max"],
+            float(
+                np.max(
+                    np.abs(
+                        np.asarray(out_x.fitted, np.float64)
+                        - np.asarray(out_p.fitted, np.float64)
+                    )
+                )
+            ),
+        )
+        px_ = np.asarray(out_x.p_of_f, np.float64)
+        pp_ = np.asarray(out_p.p_of_f, np.float64)
+        stats["p_of_f_rel_delta_max"] = max(
+            stats["p_of_f_rel_delta_max"],
+            float(np.max(np.abs(px_ - pp_) / np.maximum(np.abs(px_), 1e-30))),
+        )
+        done += per
+        print(f"seed {seed}: cumulative exact "
+              f"{stats['pixel_exact_vertex_indices']}/{done}", flush=True)
+
+    out = {
+        "n_pixels": done,
+        "platform": f"{platform} (both legs, same chip)",
+        "population": "tools/_population.make_population seeds 0-15 "
+                      "(the parity-suite population)",
+        **{k: (round(v, 12) if isinstance(v, float) else v)
+           for k, v in stats.items()},
+        "pixel_exact_rate": stats["pixel_exact_vertex_indices"] / done,
+        "note": "XLA kernel vs round-5 FUSED Pallas kernel, both compiled "
+                "f32 on the same chip over identical inputs.  Decisions and "
+                "trajectories compared bitwise; p_of_f compared by relative "
+                "delta (two fusion contexts of the same Lentz expression — "
+                "see tools/impl_identity.py docstring).",
+    }
+    line = json.dumps(out, indent=1)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
