@@ -90,6 +90,18 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--out-dir", default="lt_out")
     seg.add_argument("--no-resume", action="store_true",
                      help="discard any existing workdir manifest")
+    seg.add_argument("--products", default=None, metavar="P1,P2,...",
+                     help="segmentation products to checkpoint + assemble "
+                          "(default: all); a subset cuts manifest/output/"
+                          "fetch bytes proportionally — the gigapixel knob")
+    seg.add_argument("--fetch-f16", action="store_true",
+                     help="fetch float products from the device as float16 "
+                          "(halves device->host bytes; opt-in lossy packing "
+                          "within the f32 tolerance contract)")
+    seg.add_argument("--lazy", action="store_true",
+                     help="windowed file-backed ingest (C2 per-band layout "
+                          "only): no input cube in host RAM — for scenes "
+                          "larger than memory")
     seg.add_argument("--write-fitted", action="store_true",
                      help="also write the full fitted-trajectory raster")
     seg.add_argument("--out-compress", default="deflate",
@@ -537,6 +549,11 @@ def main(argv: list[str] | None = None) -> int:
             resume=not args.no_resume,
             max_retries=args.max_retries,
             write_fitted=args.write_fitted,
+            products=(
+                tuple(x.strip() for x in args.products.split(","))
+                if args.products else None
+            ),
+            fetch_f16=args.fetch_f16,
             scale=args.scale,
             offset=args.offset,
             out_compress=args.out_compress,
@@ -561,15 +578,27 @@ def main(argv: list[str] | None = None) -> int:
         # the C2 per-band layout also skips decoding the unused files)
         from land_trendr_tpu.ops.indices import required_bands
 
-        stack = load_stack_dir(
-            args.stack_dir,
-            bands=required_bands(args.index, ftv),
-            composite=args.composite,
-            # composite validity masks must match the run's own masking
-            reject_bits=cfg.reject_bits,
-            scale=cfg.scale,
-            offset=cfg.offset,
-        )
+        if args.lazy:
+            if args.composite is not None:
+                raise SystemExit(
+                    "--lazy cannot composite (one acquisition per year); "
+                    "pre-composite or drop --lazy"
+                )
+            from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+            stack = open_stack_dir_c2_lazy(
+                args.stack_dir, bands=required_bands(args.index, ftv)
+            )
+        else:
+            stack = load_stack_dir(
+                args.stack_dir,
+                bands=required_bands(args.index, ftv),
+                composite=args.composite,
+                # composite validity masks must match the run's own masking
+                reject_bits=cfg.reject_bits,
+                scale=cfg.scale,
+                offset=cfg.offset,
+            )
         if args.trace:
             from land_trendr_tpu.utils.profiling import trace
 

@@ -474,9 +474,10 @@ def _run_stages(t, raw, m_f, ny: int, blk: int, params: LTParams, exact_atan: bo
     Pure function of block VALUES (no refs) shared by both kernel builders
     (:func:`_make_family_kernel` for the unfused stats path,
     :func:`_make_fused_kernel` for the production fused path).  Returns
-    ``(y, vmask_list, sse_list, aux)`` where ``y`` is the despiked series,
-    the lists hold the NM family members' vertex masks (f32 0/1) and fit
-    SSEs in pruning order, and ``aux`` carries the shared per-block
+    ``(y, vmask_list, sse_list, fitted_list, aux)`` where ``y`` is the
+    despiked series, the lists hold the NM family members' vertex masks
+    (f32 0/1), fit SSEs, and fitted trajectories in pruning order, and
+    ``aux`` carries the shared per-block
     scalars the fused tail reuses (same expressions as the XLA tail, so
     reuse is bit-exact).
     """

@@ -38,6 +38,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from land_trendr_tpu.config import LTParams
@@ -75,6 +76,26 @@ class RunConfig:
     resume: bool = True
     max_retries: int = 2
     write_fitted: bool = False  # include the (NY,) fitted trajectory raster
+    #: segmentation products to checkpoint + assemble; ``None`` = the full
+    #: set.  A subset (e.g. ``("n_vertices", "vertex_years",
+    #: "seg_magnitude", "rmse", "model_valid")``) cuts manifest + output
+    #: bytes proportionally — the knob that makes gigapixel runs fit
+    #: bounded disk (BASELINE configs[4]; the reference's driver likewise
+    #: writes only requested outputs).  Change products are governed by
+    #: ``change_filt``, FTV products by ``ftv_indices``; this filters the
+    #: per-pixel segmentation set only.  Fingerprinted: a resume cannot
+    #: mix artifact schemas.
+    products: "tuple[str, ...] | None" = None
+    #: fetch float products from the device as float16 (cast on device,
+    #: restored to the float32 manifest schema on host): halves
+    #: device→host bytes for every float product.  Opt-in lossy packing
+    #: (f16 quantization ~5e-4 relative — far inside the f32 tolerance
+    #: contract's measured decision envelope but far above kernel rounding,
+    #: hence not the default).  The dominant cost on a tunneled chip
+    #: (SCENE_TPU_r04.json: fetch was 96% of wall) and a real PCIe/DCN
+    #: saving in any deployment.  Not fingerprinted content-wise — but it
+    #: changes written values, so it IS part of the run fingerprint.
+    fetch_f16: bool = False
     #: fuse on-device change-map selection into every tile's program
     #: (ops/change.select_change over arrays already in HBM); the per-tile
     #: change products ride the manifest and assemble into change_*.tif
@@ -136,6 +157,14 @@ class RunConfig:
                 f"manifest_compress={self.manifest_compress!r} not one of "
                 f"{ARTIFACT_COMPRESS}"
             )
+        if self.products is not None:
+            bad = [p for p in self.products if p not in _SEG_PRODUCTS]
+            if bad:
+                raise ValueError(
+                    f"unknown products {bad}; choose from {_SEG_PRODUCTS}"
+                )
+            if not self.products:
+                raise ValueError("products subset must not be empty (use None)")
         if self.impl not in ("auto", "pallas", "xla"):
             raise ValueError(
                 f"impl={self.impl!r} not one of 'auto', 'pallas', 'xla'"
@@ -180,6 +209,10 @@ class RunConfig:
                 # changes the set of arrays each tile artifact carries, so a
                 # toggled resume must not reuse old artifacts
                 "write_fitted": self.write_fitted,
+                "products": (
+                    list(self.products) if self.products is not None else None
+                ),
+                "fetch_f16": self.fetch_f16,
                 "change": (
                     dataclasses.asdict(self.change_filt)
                     if self.change_filt is not None else None
@@ -197,6 +230,21 @@ class RunConfig:
                 # controller of a TPU run) stays implementation-blind.
             }
         )
+
+
+@jax.jit
+def _jit_f16(a):
+    """Device-side f16 cast for the packed fetch path (one tiny program)."""
+    return a.astype(jnp.float16)
+
+
+#: the full per-pixel segmentation product set (RunConfig.products domain);
+#: "fitted" is governed by write_fitted, change_*/ftv_* by their own knobs
+_SEG_PRODUCTS = (
+    "n_vertices", "vertex_indices", "vertex_years", "vertex_src_vals",
+    "vertex_fit_vals", "seg_magnitude", "seg_duration", "seg_rate",
+    "rmse", "p_of_f", "model_valid",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -249,8 +297,10 @@ def _feed_tile(
     def cut(a: np.ndarray) -> np.ndarray:
         # the feed path's hot transpose (SURVEY.md §7 hard-part 4): the
         # threaded native gather sustains ~2.3 GB/s/core vs NumPy's ~1;
-        # both produce identical arrays
-        if native.available():
+        # both produce identical arrays.  Lazy file-backed cubes
+        # (stack.LazyBandCube — no in-RAM buffer for ctypes to point at)
+        # take the slicing path, which window-reads just this tile.
+        if native.available() and isinstance(a, np.ndarray):
             try:
                 return native.gather_tile(a, t.y0, t.x0, t.h, t.w)
             except native.NativeCodecError as e:
@@ -287,33 +337,45 @@ def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
     Durations, rmse, p-of-F and vertex bookkeeping are sign-invariant.
     """
     px = t.h * t.w
-    seg = jax.tree_util.tree_map(np.asarray, out.seg)
     sign = idx.DISTURBANCE_SIGN[cfg.index.lower()]
-    arrays = {
-        "n_vertices": seg.n_vertices[:px],
-        "vertex_indices": seg.vertex_indices[:px],
-        "vertex_years": seg.vertex_years[:px],
-        "vertex_src_vals": sign * seg.vertex_src_vals[:px],
-        "vertex_fit_vals": sign * seg.vertex_fit_vals[:px],
-        "seg_magnitude": sign * seg.seg_magnitude[:px],
-        "seg_duration": seg.seg_duration[:px],
-        "seg_rate": sign * seg.seg_rate[:px],
-        "rmse": seg.rmse[:px],
-        "p_of_f": seg.p_of_f[:px],
-        "model_valid": seg.model_valid[:px],
+
+    def fetch(dev_arr, signed: bool = False) -> np.ndarray:
+        # device→host transfer happens HERE, per selected product — an
+        # unselected product is never fetched (round 4's tree_map fetched
+        # every SegOutputs field and filtered afterwards: ~2× the bytes a
+        # subset run needs, and on a tunneled chip the fetch IS the
+        # critical path — SCENE_TPU_r04.json write_s 96%).  fetch_f16
+        # halves float bytes on the wire: the cast runs on device, the
+        # manifest keeps f32 schema (values quantized to f16 — opt-in,
+        # bounded by the f32 tolerance contract's much larger envelope).
+        a = dev_arr
+        if cfg.fetch_f16 and jnp.issubdtype(a.dtype, jnp.floating):
+            a = _jit_f16(a)
+        host = np.asarray(a)
+        if host.dtype == np.float16:
+            host = host.astype(np.float32)
+        return (sign * host[:px]) if signed else host[:px]
+
+    signed_products = {
+        "vertex_src_vals", "vertex_fit_vals", "seg_magnitude", "seg_rate",
+    }
+    want = _SEG_PRODUCTS if cfg.products is None else cfg.products
+    arrays: dict[str, np.ndarray] = {
+        name: fetch(getattr(out.seg, name), name in signed_products)
+        for name in _SEG_PRODUCTS if name in want
     }
     if cfg.write_fitted:
-        arrays["fitted"] = sign * seg.fitted[:px]
+        arrays["fitted"] = fetch(out.seg.fitted, True)
     if out.change is not None:
         for name, arr in out.change.items():
-            a = np.asarray(arr)[:px]
+            a = fetch(arr)
             if name == "yod":
                 a = a.astype(np.int32)
             elif name != "mask":
                 a = a.astype(np.float32)
             arrays[f"change_{name}"] = a
     for name, arr in out.ftv.items():
-        arrays[f"ftv_{name}"] = idx.DISTURBANCE_SIGN[name.lower()] * np.asarray(arr)[:px]
+        arrays[f"ftv_{name}"] = idx.DISTURBANCE_SIGN[name.lower()] * fetch(arr)
     return arrays
 
 
@@ -490,7 +552,14 @@ def run_stack(
         with timer.stage("write"):
             arrays = _tile_arrays(out, t, cfg)
             px = t.h * t.w
-            fit = int(arrays["model_valid"].sum())
+            # fit-rate metadata needs model_valid even when the product
+            # subset excludes it from the ARTIFACT: one extra device
+            # fetch of 1 B/px, not a schema change (review r5 finding:
+            # --products without model_valid crashed every tile write)
+            if "model_valid" in arrays:
+                fit = int(arrays["model_valid"].sum())
+            else:
+                fit = int(np.asarray(out.seg.model_valid[:px]).sum())
             meta = {
                 "y0": t.y0,
                 "x0": t.x0,

@@ -22,9 +22,11 @@ from land_trendr_tpu.io.synthetic import SyntheticStack
 from land_trendr_tpu.ops.indices import BANDS
 
 __all__ = [
+    "LazyBandCube",
     "RasterStack",
     "load_stack_dir",
     "load_stack_dir_c2",
+    "open_stack_dir_c2_lazy",
     "stack_from_synthetic",
 ]
 
@@ -397,3 +399,145 @@ def stack_from_synthetic(stack: SyntheticStack, geo: GeoMeta | None = None) -> R
         qa=stack.qa.astype(np.uint16),
         geo=geo,
     )
+
+
+class LazyBandCube:
+    """``(NY, H, W)``-shaped lazy cube: one single-band raster per year.
+
+    Holds no pixel data — ``__getitem__`` window-reads only the blocks a
+    tile needs (:func:`~land_trendr_tpu.io.geotiff.read_geotiff_window`).
+    This is the CONUS-scale ingest seam (BASELINE configs[4], SURVEY.md
+    §2 L1): a gigapixel mosaic's input cubes cannot live in host RAM, so
+    the reference reads GDAL windows on demand; this duck-types exactly
+    the slicing the driver feed performs (``a[:, y0:y1, x0:x1]``) over
+    per-year files instead.  Use :func:`open_stack_dir_c2_lazy` to build
+    a :class:`RasterStack` of these.
+    """
+
+    def __init__(self, paths: list[str], shape: tuple[int, int], dtype):
+        self.paths = list(paths)
+        self.shape = (len(self.paths), *shape)
+        self.dtype = np.dtype(dtype)
+        self.ndim = 3
+
+    def __getitem__(self, key) -> np.ndarray:
+        from land_trendr_tpu.io.geotiff import read_geotiff_window
+
+        if not (isinstance(key, tuple) and len(key) == 3):
+            raise TypeError(
+                f"LazyBandCube supports [years, y, x] window slicing; got {key!r}"
+            )
+        ys, rows, cols = key
+        ny, h_full, w_full = self.shape
+        yr_idx = range(ny)[ys] if isinstance(ys, slice) else [ys]
+        r0, r1, rstep = rows.indices(h_full) if isinstance(rows, slice) else (rows, rows + 1, 1)
+        c0, c1, cstep = cols.indices(w_full) if isinstance(cols, slice) else (cols, cols + 1, 1)
+        if rstep != 1 or cstep != 1:
+            raise ValueError("LazyBandCube windows must be contiguous (step 1)")
+        h, w = r1 - r0, c1 - c0
+        out = np.empty((len(yr_idx), h, w), self.dtype)
+        for i, k in enumerate(yr_idx):
+            win = read_geotiff_window(self.paths[k], r0, c0, h, w)
+            if win.ndim != 2:
+                raise ValueError(
+                    f"{self.paths[k]}: expected a single-band raster for a "
+                    f"lazy cube; got shape {win.shape}"
+                )
+            out[i] = win
+        return out
+
+
+def open_stack_dir_c2_lazy(
+    path: str, pattern: str | None = None, bands=None
+) -> RasterStack:
+    """Open a Collection-2 per-band directory WITHOUT reading pixel data.
+
+    Same layout rules as :func:`load_stack_dir_c2` (one acquisition per
+    year — compositing requires the eager loader; one WRS-2 path/row),
+    but each band becomes a :class:`LazyBandCube` whose windows are read
+    on demand by the driver's tile feed.  Header-only validation up
+    front: every needed file must exist, agree on raster size, and carry
+    a 16-bit sample format.  Peak host memory for a run over the result
+    is O(tile), not O(scene) — the configs[4] requirement.
+    """
+    from land_trendr_tpu.io.geotiff import read_geotiff_info
+
+    groups: dict[int, dict[str, dict[str, str]]] = {}
+    pathrows: set[str] = set()
+    for n in sorted(os.listdir(path)):
+        if pattern is not None and not re.search(pattern, n, re.IGNORECASE):
+            continue
+        m = _C2_RE.match(n)
+        if not m:
+            continue
+        band = _c2_band_name(m["sensor"], m["prod"])
+        if band is None:
+            continue
+        pathrows.add(m["pathrow"])
+        year = int(m["date"][:4])
+        groups.setdefault(year, {}).setdefault(m["date"], {})[band] = os.path.join(
+            path, n
+        )
+    if not groups:
+        raise FileNotFoundError(f"no Collection-2 per-band rasters in {path}")
+    if len(pathrows) > 1:
+        raise ValueError(
+            f"{path}: multiple WRS-2 path/rows {sorted(pathrows)} in one "
+            "stack — pass pattern=... to select one scene"
+        )
+    multi = {y: sorted(d) for y, d in groups.items() if len(d) > 1}
+    if multi:
+        raise ValueError(
+            f"{path}: multiple acquisitions per year {multi} — the lazy "
+            "opener takes one image per year (compositing needs the eager "
+            "loader: load_stack_dir_c2(..., composite='medoid'))"
+        )
+    years = np.array(sorted(groups), dtype=np.int32)
+    needed = (*_use_bands(bands), "qa")
+    per_band_paths: dict[str, list[str]] = {b: [] for b in needed}
+    for year in years.tolist():
+        (date,) = groups[year]
+        missing = [b for b in needed if b not in groups[year][date]]
+        if missing:
+            raise ValueError(
+                f"{path}: acquisition {date} is missing bands {missing} "
+                f"(have {sorted(groups[year][date])})"
+            )
+        for b in needed:
+            per_band_paths[b].append(groups[year][date][b])
+
+    shape = None
+    geo = None
+    dtypes: dict[str, str] = {}
+    for b in needed:
+        for fp in per_band_paths[b]:
+            gmeta, info = read_geotiff_info(fp)
+            if shape is None:
+                shape, geo = (info.height, info.width), gmeta
+            elif (info.height, info.width) != shape:
+                raise ValueError(
+                    f"{fp}: raster size {(info.height, info.width)} != {shape}"
+                )
+            if b != "qa" and info.dtype not in (
+                np.dtype(np.int16), np.dtype(np.uint16)
+            ):
+                # same whitelist as the eager loader's read_band: f16 has
+                # itemsize 2 but rounds DNs above its 2048 integer-exact
+                # range — reject, don't silently corrupt radiometry
+                raise ValueError(
+                    f"{fp}: SR band dtype {info.dtype} unsupported "
+                    "(expected int16 or uint16 DNs)"
+                )
+            prev = dtypes.setdefault(b, str(info.dtype))
+            if b != "qa" and prev != str(info.dtype):
+                raise ValueError(
+                    f"band {b!r}: mixed DN dtypes across years "
+                    f"{sorted({prev, str(info.dtype)})} — re-export the "
+                    "archive with one dtype"
+                )
+    dn = {
+        b: LazyBandCube(per_band_paths[b], shape, np.dtype(dtypes[b]))
+        for b in needed if b != "qa"
+    }
+    qa = LazyBandCube(per_band_paths["qa"], shape, np.uint16)
+    return RasterStack(years=years, dn_bands=dn, qa=qa, geo=geo)

@@ -491,3 +491,102 @@ def test_corrupt_headers_raise_cleanly(tmp_path):
         assert not isinstance(
             ei.value, (MemoryError, SystemError)
         ), f"{name}: {ei.value!r}"
+
+
+# --------------------------------------------------------------------------
+# Lazy (windowed) ingest: the CONUS-scale feed seam
+# --------------------------------------------------------------------------
+
+
+def test_lazy_stack_matches_eager_and_feeds_driver(golden_root, tmp_path):
+    """open_stack_dir_c2_lazy windows must decode the same bytes as the
+    eager loader, and a full driver run over the lazy stack must produce
+    rasters identical to the eager run's."""
+    from land_trendr_tpu.runtime.driver import (
+        RunConfig, assemble_outputs, run_stack,
+    )
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    src = str(golden_root / "le_tiles")
+    eager = load_stack_dir(src)
+    lazy = open_stack_dir_c2_lazy(src)
+    assert lazy.years.tolist() == eager.years.tolist()
+    assert lazy.shape == eager.shape
+    # window equivalence incl. edge windows
+    for (r0, c0, h, w) in [(0, 0, 5, 7), (17, 25, 4, 8), (0, 0, H, W)]:
+        for b in ("nir", "swir2"):
+            np.testing.assert_array_equal(
+                lazy.dn_bands[b][:, r0:r0 + h, c0:c0 + w],
+                eager.dn_bands[b][:, r0:r0 + h, c0:c0 + w],
+                err_msg=f"{b}@{r0},{c0}",
+            )
+        np.testing.assert_array_equal(
+            lazy.qa[:, r0:r0 + h, c0:c0 + w],
+            eager.qa[:, r0:r0 + h, c0:c0 + w],
+        )
+
+    from land_trendr_tpu.io.geotiff import read_geotiff
+
+    outs = {}
+    for name, stack in [("eager", eager), ("lazy", lazy)]:
+        cfg = RunConfig(
+            out_dir=str(tmp_path / name), workdir=str(tmp_path / (name + "_w")),
+            tile_size=16, index="nbr", impl="xla",
+        )
+        run_stack(stack, cfg)
+        outs[name] = assemble_outputs(stack, cfg)
+    assert set(outs["eager"]) == set(outs["lazy"])
+    for prod in outs["eager"]:
+        a, _, _ = read_geotiff(outs["eager"][prod])
+        b, _, _ = read_geotiff(outs["lazy"][prod])
+        np.testing.assert_array_equal(a, b, err_msg=prod)
+
+
+def test_products_subset_run(golden_root, tmp_path):
+    """RunConfig.products filters manifest + assembled rasters; invalid
+    names fail fast; a subset-run resume is schema-consistent."""
+    from land_trendr_tpu.runtime.driver import (
+        RunConfig, assemble_outputs, run_stack,
+    )
+
+    with pytest.raises(ValueError, match="unknown products"):
+        RunConfig(products=("n_vertices", "bogus"))
+
+    stack = load_stack_dir(str(golden_root / "le_strips"))
+    subset = ("n_vertices", "vertex_years", "seg_magnitude", "rmse",
+              "model_valid")
+    cfg = RunConfig(
+        out_dir=str(tmp_path / "out"), workdir=str(tmp_path / "work"),
+        tile_size=16, index="nbr", impl="xla", products=subset,
+    )
+    run_stack(stack, cfg)
+    paths = assemble_outputs(stack, cfg)
+    assert set(paths) == set(subset), sorted(paths)
+
+
+def test_fetch_f16_packed_run(golden_root, tmp_path):
+    """fetch_f16 halves wire bytes; decisions identical, floats within
+    f16 quantization of the f32 run."""
+    from land_trendr_tpu.io.geotiff import read_geotiff
+    from land_trendr_tpu.runtime.driver import (
+        RunConfig, assemble_outputs, run_stack,
+    )
+
+    stack = load_stack_dir(str(golden_root / "le_strips"))
+    outs = {}
+    for name, f16 in [("f32", False), ("f16", True)]:
+        cfg = RunConfig(
+            out_dir=str(tmp_path / name), workdir=str(tmp_path / (name + "_w")),
+            tile_size=16, index="nbr", impl="xla", fetch_f16=f16,
+        )
+        run_stack(stack, cfg)
+        outs[name] = assemble_outputs(stack, cfg)
+    for prod in outs["f32"]:
+        a, _, _ = read_geotiff(outs["f32"][prod])
+        b, _, _ = read_geotiff(outs["f16"][prod])
+        if a.dtype.kind in "iub":  # decisions must be identical
+            np.testing.assert_array_equal(a, b, err_msg=prod)
+        else:
+            np.testing.assert_allclose(
+                b, a, rtol=1e-3, atol=1e-3, err_msg=prod
+            )

@@ -243,3 +243,27 @@ def test_info_command(tmp_path, capsys):
     assert rec["window"]["finite_frac"] == 1.0
     # malformed window: clean error, not a traceback
     assert main(["info", p, "--window", "oops"]) == 2
+
+
+def test_segment_products_and_f16_flags(tmp_path, capsys):
+    """round-5 fetch-economy flags: --products subsets the outputs,
+    --fetch-f16 round-trips, and bad product names fail loudly."""
+    stack_dir = str(tmp_path / "stack")
+    assert main(["synth", stack_dir, "--size", "32",
+                 "--year-start", "1990", "--year-end", "2005"]) == 0
+    capsys.readouterr()
+    out_dir = str(tmp_path / "out")
+    assert main([
+        "segment", stack_dir, "--index", "nbr", "--tile-size", "32",
+        "--workdir", str(tmp_path / "work"), "--out-dir", out_dir,
+        "--products", "n_vertices,seg_magnitude,model_valid", "--fetch-f16",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert set(rep["outputs"]) == {"n_vertices", "seg_magnitude", "model_valid"}
+
+    with pytest.raises(ValueError, match="unknown products"):
+        main([
+            "segment", stack_dir, "--tile-size", "32",
+            "--workdir", str(tmp_path / "w2"), "--out-dir", out_dir,
+            "--products", "bogus",
+        ])

@@ -1,0 +1,299 @@
+"""GIGA: one composed gigapixel end-to-end run (VERDICT r4 Missing #3).
+
+Round 4 rehearsed config #5 (BASELINE configs[4], CONUS class) piecewise:
+assembly alone at 1.6e9 px, change streaming alone at 2.6e8 px, the full
+driver at 2.5e7 px.  This tool runs the COMPOSED claim as one artifact:
+
+    synthetic ≥1e9-px C2-named scene on disk (deflate, tiled)
+      → lazy windowed ingest (stack.open_stack_dir_c2_lazy — no input
+        cube ever materialises in RAM)
+      → full driver segmentation into the fingerprinted tile manifest,
+        HARD-KILLED part-way and resumed (the crash-resume path, not a
+        polite checkpoint)
+      → streamed raster assembly (BigTIFF auto)
+      → on-device change products + the streamed spatial mmu sieve
+    with every phase's wall time and peak RSS recorded → GIGA_r05.json.
+
+Scale knobs keep the run honest but tractable on this 1-core host: the
+pixel COUNT is real (default 32768² = 1.074e9 > 1e9); the year axis (12)
+and a light parameter set (max_segments=2, no despike/overshoot — a
+legitimate user configuration, fingerprinted like any other) bound the
+per-pixel CPU cost; RunConfig.products bounds manifest+output bytes to
+the products this run writes, exactly as a real gigapixel deployment
+would.  Nothing is stubbed: every pixel flows disk → window read →
+device kernel → manifest → assembled raster.
+
+Usage:
+    python tools/giga_run.py all [--size 32768] [--out-root /root/giga]
+    python tools/giga_run.py gen|segment|assemble|sieve ...  (phases)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+NY = 12
+YEAR0 = 1990
+TILE = 1024
+PRODUCTS = ("n_vertices", "vertex_years", "seg_magnitude", "rmse", "model_valid")
+
+
+def _params():
+    from land_trendr_tpu.config import LTParams
+
+    return LTParams(max_segments=2, vertex_count_overshoot=0, spike_threshold=1.0)
+
+
+def _cfg(root: Path, size: int):
+    from land_trendr_tpu.ops.change import ChangeFilter
+    from land_trendr_tpu.runtime.driver import RunConfig
+
+    return RunConfig(
+        index="nbr",
+        params=_params(),
+        tile_size=TILE,
+        workdir=str(root / "work"),
+        out_dir=str(root / "out"),
+        products=PRODUCTS,
+        change_filt=ChangeFilter(min_mag=0.1),
+        manifest_compress="deflate",
+        out_compress="deflate",
+        impl="xla",
+        chunk_px=262_144,
+    )
+
+
+def _scene_dir(root: Path) -> Path:
+    return root / "scene"
+
+
+def _c2_name(year: int, prod: str) -> str:
+    return f"LT05_L2SP_045030_{year}0715_{year}0912_02_T1_{prod}.TIF"
+
+
+_DN = lambda r: np.uint16(round((r + 0.2) / 2.75e-5))  # noqa: E731
+
+
+def cmd_gen(args) -> dict:
+    """Write the synthetic scene: nir (SR_B4) + swir2 (SR_B7) + QA_PIXEL
+    per year, deflate tiled, block-streamed (bounded RSS)."""
+    from land_trendr_tpu.io.geotiff import GeoMeta, GeoTiffStreamWriter
+
+    size = args.size
+    root = Path(args.out_root)
+    scene = _scene_dir(root)
+    scene.mkdir(parents=True, exist_ok=True)
+    geo = GeoMeta(
+        pixel_scale=(30.0, 30.0, 0.0),
+        tiepoint=(0.0, 0.0, 0.0, 553785.0, 5189625.0, 0.0),
+        nodata=0.0,
+    )
+    t0 = time.time()
+    band_rows = 2048
+    # disturbance patch field: deterministic patch grid (128-px cells),
+    # ~30% of cells disturbed, each with a per-cell year
+    cell = 128
+    ncell = size // cell
+    rng = np.random.default_rng(20260731)
+    cell_dist = rng.random((ncell, ncell)) < 0.3
+    cell_year = rng.integers(2, NY - 2, (ncell, ncell))
+
+    writers = {}
+    for k in range(NY):
+        year = YEAR0 + k
+        for prod in ("SR_B4", "SR_B7", "QA_PIXEL"):
+            writers[(k, prod)] = GeoTiffStreamWriter(
+                str(scene / _c2_name(year, prod)), size, size, 1, np.uint16,
+                geo=geo, compress="deflate", tile=512,
+            )
+    for r0 in range(0, size, band_rows):
+        h = min(band_rows, size - r0)
+        crows = slice(r0 // cell, (r0 + h + cell - 1) // cell)
+        dist = np.kron(cell_dist[crows], np.ones((cell, cell), bool))[
+            r0 % cell or 0 :, :
+        ][:h, :size]
+        dyear = np.kron(cell_year[crows], np.ones((cell, cell), np.int64))[
+            :h, :size
+        ]
+        brng = np.random.default_rng(r0)
+        noise = brng.normal(0.0, 0.004, (h, size))
+        for k in range(NY):
+            disturbed = dist & (dyear <= k)
+            nir = np.where(disturbed, 0.18, 0.45) + noise
+            swir2 = np.where(disturbed, 0.25, 0.08) - noise
+            qa = np.full((h, size), 1 << 6, np.uint16)
+            if k % 5 == 2:  # a cloud band sweeping rows per year
+                band = slice((r0 // 7) % max(1, h - 32), (r0 // 7) % max(1, h - 32) + 32)
+                qa[band] |= 1 << 3
+            writers[(k, "SR_B4")].write(
+                r0, 0, ((nir + 0.2) / 2.75e-5).astype(np.uint16)[..., None]
+            )
+            writers[(k, "SR_B7")].write(
+                r0, 0, ((swir2 + 0.2) / 2.75e-5).astype(np.uint16)[..., None]
+            )
+            writers[(k, "QA_PIXEL")].write(r0, 0, qa[..., None])
+        print(f"gen rows {r0 + h}/{size} at {time.time()-t0:.0f}s", flush=True)
+    for wtr in writers.values():
+        wtr.close()
+    bytes_total = sum(f.stat().st_size for f in scene.iterdir())
+    return {
+        "px": size * size, "ny": NY, "files": len(writers),
+        "scene_bytes": bytes_total, "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def cmd_segment(args) -> dict:
+    from land_trendr_tpu.runtime.driver import run_stack
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    root = Path(args.out_root)
+    stack = open_stack_dir_c2_lazy(str(_scene_dir(root)), bands=("nir", "swir2"))
+    res = run_stack(stack, _cfg(root, args.size))
+    return res
+
+
+def cmd_assemble(args) -> dict:
+    from land_trendr_tpu.runtime.driver import assemble_outputs
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    root = Path(args.out_root)
+    t0 = time.time()
+    stack = open_stack_dir_c2_lazy(str(_scene_dir(root)), bands=("nir", "swir2"))
+    paths = assemble_outputs(stack, _cfg(root, args.size))
+    out_bytes = sum(Path(p).stat().st_size for p in paths.values())
+    return {
+        "products": sorted(paths), "out_bytes": out_bytes,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def cmd_sieve(args) -> dict:
+    from land_trendr_tpu.ops.change import sieve_change_rasters
+
+    root = Path(args.out_root)
+    t0 = time.time()
+    sieve_change_rasters(str(root / "out"), mmu=11)
+    return {"mmu": 11, "wall_s": round(time.time() - t0, 1)}
+
+
+def _run_phase(phase: str, args, timeout=None, kill_after=None) -> dict:
+    """Run one phase as a child process; the child self-reports peak RSS
+    (resource.ru_maxrss) in its JSON line — no /usr/bin/time on this box."""
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        phase, "--size", str(args.size), "--out-root", args.out_root,
+    ]
+    t0 = time.time()
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    if kill_after is not None:
+        try:
+            proc.wait(timeout=kill_after)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGKILL)  # crash, not a polite stop
+        out, err = proc.communicate()
+        return {"killed_after_s": kill_after, "rc": proc.returncode,
+                "wall_s": round(time.time() - t0, 1)}
+    out, err = proc.communicate(timeout=timeout)
+    rec = {}
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"phase {phase} rc={proc.returncode}\n{err[-2000:]}"
+        )
+    rec["wall_s_total"] = round(time.time() - t0, 1)
+    return rec
+
+
+def cmd_all(args) -> dict:
+    root = Path(args.out_root)
+    root.mkdir(parents=True, exist_ok=True)
+    result = {
+        "px": args.size * args.size,
+        "ny": NY,
+        "tile": TILE,
+        "products": list(PRODUCTS),
+        "params": {"max_segments": 2, "vertex_count_overshoot": 0,
+                   "spike_threshold": 1.0},
+        "host": "1-core CPU (the build environment; the tunnel readback "
+                "makes the chip slower than the CPU for manifest-heavy "
+                "runs — SCENE_TPU_r04.json)",
+    }
+    result["gen"] = _run_phase("gen", args)
+    # crash mid-segmentation, then resume: the manifest IS the checkpoint
+    result["segment_killed"] = _run_phase(
+        "segment", args, kill_after=args.kill_after
+    )
+    result["segment_resumed"] = _run_phase("segment", args)
+    assert result["segment_resumed"].get("tiles_skipped_resume", 0) > 0, (
+        "resume must skip tiles completed before the kill"
+    )
+    result["assemble"] = _run_phase("assemble", args)
+    result["sieve"] = _run_phase("sieve", args)
+    result["wall_s_total"] = round(sum(
+        p.get("wall_s_total", p.get("wall_s", 0.0)) for p in (
+            result["gen"], result["segment_killed"],
+            result["segment_resumed"], result["assemble"], result["sieve"],
+        )
+    ), 1)
+    result["peak_rss_mib_max"] = max(
+        p["peak_rss_mib"] for p in (
+            result["gen"], result["segment_resumed"], result["assemble"],
+            result["sieve"],
+        ) if p.get("peak_rss_mib")
+    )
+    out_path = REPO / "GIGA_r05.json"
+    out_path.write_text(json.dumps(result, indent=1) + "\n")
+    print(json.dumps(result, indent=1))
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("phase", choices=["all", "gen", "segment", "assemble", "sieve"])
+    ap.add_argument("--size", type=int, default=32768)
+    ap.add_argument("--out-root", type=str, default="/root/giga")
+    ap.add_argument("--kill-after", type=float, default=900.0)
+    args = ap.parse_args()
+    if args.phase == "all":
+        cmd_all(args)
+        return 0
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    rec = {
+        "gen": cmd_gen, "segment": cmd_segment,
+        "assemble": cmd_assemble, "sieve": cmd_sieve,
+    }[args.phase](args)
+    import resource
+
+    rec["peak_rss_mib"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1
+    )
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,19 +9,23 @@
 # crashed or restarted") for EVERY batch size until it recovers.  One
 # process, one queue, strictly one chip client at a time.
 #
-# Work queue (each step skipped once its artifact exists, so the script
-# resumes across restarts; each success commits immediately — a window
-# can close at any moment):
-#   1. paired-K chain bench at 65536 px   -> BENCH_r${R}.json (paired-K)
-#   2. TPU-platform f32-vs-f64 parity     -> PARITY_f32_tpu.json
-#   3. TPU stage profile                  -> PROFILE_tpu_r${R}.json
-#   4. 1M-px chunked bench upgrade        -> BENCH_r${R}.json (px=1048576)
+# Each step is skipped once its artifact exists, so the script resumes
+# across restarts; each success commits immediately — a window can close
+# at any moment.
 #
-# Usage: LT_ROUND=04 nohup bash tools/window_runner.sh & disown
+# Round-5 queue (artifact-gated, resumes across restarts):
+#   1. paired-K 1M-px bench          -> BENCH_r${R}_build.json
+#   2. packed-fetch 25M-px scene     -> SCENE_TPU_r05.json
+#   3. on-chip impl identity (1M px) -> IMPL_IDENTITY_r05.json
+#   4. fused-kernel TPU parity 1M px -> PARITY_f32_tpu_pallas_r05.json
+# (BENCH_r${R}.json itself is driver-captured at round end; the build
+# artifact is the session's fallback evidence.)
+#
+# Usage: LT_ROUND=05 nohup bash tools/window_runner.sh & disown
 cd /root/repo
-R="${LT_ROUND:-04}"
+R="${LT_ROUND:-05}"
 LOG=/root/repo/BENCH_r${R}_attempts.log
-BENCH=/root/repo/BENCH_r${R}.json
+BENCH=/root/repo/BENCH_r${R}_build.json
 
 log() { echo "[$(date -u +%FT%TZ)] window_runner: $*" >> "$LOG"; }
 
@@ -30,17 +34,6 @@ probe_green() {
 }
 
 # step predicates ---------------------------------------------------------
-have_paired_bench() {
-  python - "$BENCH" <<'EOF' 2>/dev/null
-import json, sys
-r = json.load(open(sys.argv[1]))
-ok = (r.get("device_platform") not in (None, "cpu")
-      and r.get("value", 0) > 0
-      and "median_delta_s" in r)
-sys.exit(0 if ok else 1)
-EOF
-}
-
 have_1m_bench() {
   python - "$BENCH" <<'EOF' 2>/dev/null
 import json, sys
@@ -79,43 +72,42 @@ for i in $(seq 1 500); do
   fi
   log "probe $i green — working the queue"
 
-  if ! have_paired_bench; then
-    out=$(LT_BENCH_ATTEMPTS=1 LT_BENCH_TIMEOUT=1500 LT_BENCH_PX=65536 \
-          LT_BENCH_REPS=4 LT_BENCH_CHAIN_K=32 python bench.py 2>>"$LOG")
-    log "bench-65k: $out"
-    if accept_bench "$out" 1; then
-      echo "$out" > "$BENCH"
-      commit_artifact "$BENCH" "TPU bench artifact: paired-K 65536-px number (window runner)"
-      log "BENCH committed (65536, paired-K)"
+  if [ ! -f SCENE_TPU_r05.json ]; then
+    if timeout 3500 python tools/scene_tpu_packed.py --size 5000 \
+         --out SCENE_TPU_r05.json >> "$LOG" 2>&1 \
+       && python -c "import json; exit(0 if json.load(open('SCENE_TPU_r05.json')).get('platform') == 'tpu' else 1)" 2>/dev/null; then
+      commit_artifact SCENE_TPU_r05.json "Packed-fetch TPU scene artifact (window runner)"
+      log "SCENE_TPU_r05 committed"
     else
-      sleep 60   # let a crashed worker recover before the next queue pass
-      continue
-    fi
-  fi
-
-  if [ ! -f PARITY_f32_tpu.json ]; then
-    if timeout 2400 python tools/parity_f32.py 65536 PARITY_f32_tpu.json \
-         --f64-on-cpu >> "$LOG" 2>&1 \
-       && python -c "import json; r=json.load(open('PARITY_f32_tpu.json')); exit(0 if r.get('platform') != 'cpu' else 1)" 2>/dev/null; then
-      commit_artifact PARITY_f32_tpu.json "TPU-platform f32 parity artifact (window runner)"
-      log "PARITY_f32_tpu committed"
-    else
-      rm -f PARITY_f32_tpu.json
-      log "parity attempt failed; re-queueing"
+      rm -f SCENE_TPU_r05.json
+      log "packed scene attempt failed; re-queueing"
       sleep 60
       continue
     fi
   fi
 
-  if [ ! -f "PROFILE_tpu_r${R}.json" ]; then
-    if timeout 2400 python tools/profile_stages.py 65536 "PROFILE_tpu_r${R}.json" \
-         --platform=axon,cpu >> "$LOG" 2>&1 \
-       && python -c "import json; exit(0 if json.load(open('PROFILE_tpu_r${R}.json')).get('platform') != 'cpu' else 1)" 2>/dev/null; then
-      commit_artifact "PROFILE_tpu_r${R}.json" "TPU stage profile artifact (window runner)"
-      log "PROFILE_tpu committed"
+  if [ ! -f IMPL_IDENTITY_r05.json ]; then
+    if timeout 2400 python tools/impl_identity.py --out IMPL_IDENTITY_r05.json \
+         >> "$LOG" 2>&1; then
+      commit_artifact IMPL_IDENTITY_r05.json "On-chip impl identity artifact (window runner)"
+      log "IMPL_IDENTITY_r05 committed"
     else
-      rm -f "PROFILE_tpu_r${R}.json"
-      log "profile attempt failed; re-queueing"
+      rm -f IMPL_IDENTITY_r05.json
+      log "identity attempt failed; re-queueing"
+      sleep 60
+      continue
+    fi
+  fi
+
+  if [ ! -f PARITY_f32_tpu_pallas_r05.json ]; then
+    if timeout 3500 python tools/parity_f32.py 1048576 PARITY_f32_tpu_pallas_r05.json \
+         --platform=axon,cpu --f64-on-cpu --impl=pallas >> "$LOG" 2>&1 \
+       && python -c "import json; r=json.load(open('PARITY_f32_tpu_pallas_r05.json')); exit(0 if 'tpu' in r.get('platform','') else 1)" 2>/dev/null; then
+      commit_artifact PARITY_f32_tpu_pallas_r05.json "Fused-kernel TPU parity artifact (window runner)"
+      log "PARITY_r05 committed"
+    else
+      rm -f PARITY_f32_tpu_pallas_r05.json
+      log "parity attempt failed; re-queueing"
       sleep 60
       continue
     fi
