@@ -271,3 +271,38 @@ def test_f64_interpret_more_param_variants():
             years, vals, mask, params, block=256, interpret=True
         )
         _assert_outputs_equal(out_x, out_p, exact=True)
+
+
+def test_f64_interpret_ny_variants():
+    """Year-axis generality: NY not a multiple of the sublane tile (8) or
+    the historic 40.  DECISION fields must stay bit-exact; float outputs
+    get a few-ulp budget — at NY with SIMD remainder tiles (observed at
+    12: 2/512 vertex_fit values off by 1 ulp) XLA's reduction codegen
+    differs between the two programs' layouts, the same fusion-context
+    class as the p_of_f/betainc note on ``_assert_outputs_equal``.  The
+    NY=40 suite keeps the full bit-exact gate.  Compiled-on-chip
+    identity was separately verified this round at NY=12/25/61
+    (vertex-identical 1.0, fitted maxdelta 0.0 — the compiled Mosaic
+    paths DO agree; the ulp wiggle is CPU-interpret-vs-XLA codegen)."""
+    for ny, params in [
+        (12, LTParams(max_segments=3, vertex_count_overshoot=2)),
+        (25, PARAMS),
+        (61, PARAMS),
+    ]:
+        rng = np.random.default_rng(ny)
+        years, vals, mask = make_population(rng, 128, ny)
+        years = years.astype(np.float64)
+        vals = vals.astype(np.float64)
+        out_x = jax_segment_pixels(years, vals, mask, params)
+        out_p = jax_segment_pixels_pallas(
+            years, vals, mask, params, block=128, interpret=True
+        )
+        for f in out_x._fields:
+            a = np.asarray(getattr(out_x, f))
+            b = np.asarray(getattr(out_p, f))
+            if a.dtype.kind in "bi":
+                np.testing.assert_array_equal(a, b, err_msg=f"ny={ny} {f}")
+            else:
+                np.testing.assert_allclose(
+                    b, a, rtol=1e-12, atol=0, err_msg=f"ny={ny} {f}"
+                )
