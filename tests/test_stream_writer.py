@@ -135,3 +135,23 @@ def test_bigtiff_auto_bound_and_force(tmp_path, rng):
         small.comp_id = _resolve_compress("deflate")
         small.levels = [_StreamLevel(h, w, 256)]
         assert small._pick_layout("auto") is expect_big, (h, w)
+
+
+def test_compress_level_trades_size_not_content(tmp_path):
+    """compress_level=1 must decode identically; files may differ in size."""
+    rng = np.random.default_rng(0)
+    img = (rng.integers(7000, 44000, (300, 400))).astype(np.uint16)
+    paths = {}
+    for lvl in (1, 6):
+        p = tmp_path / f"l{lvl}.tif"
+        w = GeoTiffStreamWriter(
+            str(p), 300, 400, 1, np.uint16, compress="deflate",
+            tile=128, compress_level=lvl,
+        )
+        w.write(0, 0, img[..., None])
+        w.close()
+        paths[lvl] = p
+    a, _, _ = read_geotiff(str(paths[1]))
+    b, _, _ = read_geotiff(str(paths[6]))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, img)
