@@ -162,6 +162,21 @@ def build_parser() -> argparse.ArgumentParser:
                      help="capture a jax.profiler device+host trace of the "
                      "run under LOGDIR (open with TensorBoard's profile "
                      "plugin, or feed to tools/profile_stages.py)")
+    seg.add_argument("--telemetry", action="store_true",
+                     help="run-wide telemetry: schema-versioned "
+                     "events.jsonl (run/tile lifecycle, retries, backlog "
+                     "depths; one file per process under multihost) and a "
+                     "Prometheus metrics.prom exposition, both refreshed "
+                     "in flight under --workdir; fold with "
+                     "tools/obs_report.py")
+    seg.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                     help="with --telemetry: serve a live /metrics "
+                     "endpoint on PORT (0 = ephemeral; reported in the "
+                     "run summary) so the run is scrapeable in flight")
+    seg.add_argument("--metrics-host", default="", metavar="HOST",
+                     help="bind address for --metrics-port (default: all "
+                     "interfaces; pass 127.0.0.1 to keep the "
+                     "unauthenticated endpoint off the network)")
     seg.add_argument("--max-retries", type=int, default=2)
     seg.add_argument(
         "--mesh",
@@ -539,31 +554,61 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 return 2
-        cfg = RunConfig(
-            index=args.index,
-            ftv_indices=ftv,
-            params=_params_from_args(args),
-            tile_size=args.tile_size,
-            workdir=args.workdir,
-            out_dir=args.out_dir,
-            resume=not args.no_resume,
-            max_retries=args.max_retries,
-            write_fitted=args.write_fitted,
-            products=(
-                tuple(x.strip() for x in args.products.split(","))
-                if args.products else None
-            ),
-            fetch_f16=args.fetch_f16,
-            scale=args.scale,
-            offset=args.offset,
-            out_compress=args.out_compress,
-            manifest_compress=args.manifest_compress,
-            write_workers=args.write_workers,
-            feed_workers=args.feed_workers,
-            impl=args.impl,
-            change_filt=change_filt,
-            out_overviews=args.out_overviews,
-        )
+        try:
+            cfg = RunConfig(
+                index=args.index,
+                ftv_indices=ftv,
+                params=_params_from_args(args),
+                tile_size=args.tile_size,
+                workdir=args.workdir,
+                out_dir=args.out_dir,
+                resume=not args.no_resume,
+                max_retries=args.max_retries,
+                write_fitted=args.write_fitted,
+                products=(
+                    tuple(x.strip() for x in args.products.split(","))
+                    if args.products else None
+                ),
+                fetch_f16=args.fetch_f16,
+                scale=args.scale,
+                offset=args.offset,
+                out_compress=args.out_compress,
+                manifest_compress=args.manifest_compress,
+                write_workers=args.write_workers,
+                feed_workers=args.feed_workers,
+                impl=args.impl,
+                change_filt=change_filt,
+                out_overviews=args.out_overviews,
+                telemetry=args.telemetry,
+                metrics_port=args.metrics_port,
+                metrics_host=args.metrics_host,
+            )
+        except ValueError as e:
+            # argument errors (bad --products name, out-of-range workers…)
+            # exit like every other CLI argument conflict — a clean message
+            # and code 2, not a RunConfig traceback (ADVICE round 5)
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if cfg.metrics_port is not None:
+            # probe the scrape port NOW, before the stack open / resume
+            # scan: the real bind happens deep inside run_stack, where a
+            # busy port would surface as a raw OSError traceback minutes in
+            import socket
+
+            try:
+                with socket.socket() as s:
+                    # match the real server's bind semantics
+                    # (http.server sets allow_reuse_address) — without
+                    # this the probe rejects a port merely in TIME_WAIT
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((cfg.metrics_host, cfg.metrics_port))
+            except OSError as e:
+                print(
+                    f"error: --metrics-port {cfg.metrics_port} "
+                    f"unusable: {e}",
+                    file=sys.stderr,
+                )
+                return 2
         mesh = None
         if args.mesh:
             import jax

@@ -1,0 +1,52 @@
+"""obs subpackage: run-wide telemetry — structured events, metrics, traces.
+
+The observability layer the whole runtime reports through (ROADMAP
+"§5 metrics / logging" growth item): a schema-versioned JSONL event
+stream per process, a Prometheus-exposition metrics registry with file
+and HTTP exporters, and the :class:`Telemetry` bundle the tile driver
+wires them up with.  Consumers live in ``tools/obs_report.py`` (per-stage
+report + ``chrome://tracing`` export) and ``tools/check_events_schema.py``
+(schema lint).  Everything here is stdlib-only — no jax import, no new
+dependencies.
+"""
+
+from land_trendr_tpu.obs.events import (
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    EventLog,
+    discover_event_files,
+    events_path,
+    expand_event_paths,
+    iter_events,
+    validate_event,
+    validate_events_file,
+)
+from land_trendr_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    PromFileExporter,
+)
+from land_trendr_tpu.obs.telemetry import Telemetry, metrics_path
+
+__all__ = [
+    "EVENT_FIELDS",
+    "SCHEMA_VERSION",
+    "EventLog",
+    "discover_event_files",
+    "events_path",
+    "expand_event_paths",
+    "iter_events",
+    "validate_event",
+    "validate_events_file",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsHTTPServer",
+    "MetricsRegistry",
+    "PromFileExporter",
+    "Telemetry",
+    "metrics_path",
+]

@@ -1,0 +1,443 @@
+"""Structured run-event log: a schema-versioned JSONL stream per process.
+
+The reference's observability is Hadoop job counters plus task logs; the
+TPU-native driver previously emitted free-form ``log.info`` lines and one
+end-of-run summary dict — unusable for watching a gigapixel run in flight
+or for regression-tracking a scaling PR.  This module is the event half of
+the :mod:`land_trendr_tpu.obs` subsystem: every run writes an append-only
+``events.jsonl`` (one file *per process* in multihost runs —
+``events.p<i>.jsonl`` — so no cross-process write coordination is ever
+needed; the primary merges post-hoc via
+:func:`land_trendr_tpu.parallel.multihost.merge_host_event_logs`).
+
+Design rules:
+
+* **One JSON object per line**, schema-versioned via the ``schema`` field
+  on every ``run_start`` event.  Consumers (``tools/obs_report.py``,
+  ``tools/check_events_schema.py``) validate against
+  :data:`EVENT_FIELDS` — required fields are a *minimum*; extra fields are
+  always allowed, so instrumentation can grow without a schema bump.
+* **Every event carries both clocks**: ``t_wall`` (``time.time()`` — joins
+  across processes and with external logs) and ``t_mono``
+  (``time.perf_counter()`` — duration-accurate within one process).  The
+  trace exporter anchors each run scope's monotonic clock to its
+  ``run_start`` wall time, so multihost timelines line up.
+* **Atomic thread-safe append**: one ``os.write`` of the whole line to an
+  ``O_APPEND`` descriptor under a lock, so the driver's ``write_workers``
+  pool, the feed pool, and the main loop can all emit without interleaving
+  bytes.  A resumed run appends a fresh ``run_start`` to the same file;
+  each ``run_start`` opens a new *run scope* for consumers.
+* **Never fail the run**: emitting into a full disk raises at the caller —
+  deliberate (silently lost telemetry is worse) — but schema problems are
+  a consumer-side concern; ``emit`` does not validate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import threading
+import time
+from typing import Any, Iterator
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENT_FIELDS",
+    "OPTIONAL_FIELDS",
+    "EventLog",
+    "events_path",
+    "discover_event_files",
+    "expand_event_paths",
+    "iter_events",
+    "summarize_events_file",
+    "validate_event",
+    "validate_events_file",
+]
+
+#: bump when a REQUIRED field is added/renamed/retyped; adding optional
+#: fields is backward-compatible and does not bump the version
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+#: event type → required payload fields (beyond the common ``ev`` /
+#: ``t_wall`` / ``t_mono`` every event carries) and their types.  This is
+#: the normative schema ``tools/check_events_schema.py`` lints against.
+EVENT_FIELDS: dict[str, dict[str, Any]] = {
+    # run lifecycle — first event of every run scope
+    "run_start": {
+        "schema": int,
+        "fingerprint": str,
+        "pid": int,
+        "host": str,
+        "process_index": int,
+        "process_count": int,
+        "tiles_total": int,
+        "tiles_todo": int,
+        "tiles_skipped_resume": int,
+        "mesh_devices": int,
+        "impl": str,
+    },
+    # a tile's device program was dispatched (attempt 1) or re-dispatched
+    "tile_start": {"tile_id": int, "attempt": int},
+    # the tile's result is ready on host (dispatch + device wait)
+    "tile_done": {
+        "tile_id": int,
+        "px": int,
+        "compute_s": _NUM,
+        "px_per_s": _NUM,
+        "feed_backlog": int,
+        "write_backlog": int,
+    },
+    "tile_retry": {"tile_id": int, "attempt": int, "error": str},
+    "tile_failed": {"tile_id": int, "attempts": int, "error": str},
+    # the tile's artifact + manifest line are durable (emitted by
+    # TileManifest.record, i.e. from a writer-pool thread)
+    "write_done": {"tile_id": int, "bytes": int, "record_s": _NUM},
+    "run_done": {
+        "status": str,  # "ok" | "aborted"
+        "tiles_done": int,
+        "pixels": int,
+        "wall_s": _NUM,
+        "px_per_s": _NUM,
+        "fit_rate": _NUM,
+    },
+}
+
+#: well-known OPTIONAL fields: type-checked when present, never required
+OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
+    "tile_done": {"device_bytes_in_use": _NUM},
+    # no px_per_s here: the manifest meta's rate is over PADDED tile
+    # pixels; tile_done's real-pixel px_per_s is the stream's one
+    # throughput number (extra fields still validate — see module doc)
+    "write_done": {"no_fit_rate": _NUM},
+    "run_done": {"stage_s": dict},
+}
+
+
+def events_path(workdir: str, process_index: int = 0, process_count: int = 1) -> str:
+    """Canonical per-process event-log path under a run's workdir.
+
+    Single-process runs write ``events.jsonl``; multihost runs write one
+    file per process (``events.p<i>.jsonl``) into the shared workdir so
+    appends never cross processes — the same per-host-output pattern the
+    tile manifest's artifact writes use.
+    """
+    if process_count <= 1:
+        return os.path.join(workdir, "events.jsonl")
+    return os.path.join(workdir, f"events.p{process_index}.jsonl")
+
+
+def _declared_process_count(p0_path: str) -> int | None:
+    """The pod shape the latest run scope of ``events.p0.jsonl`` declares.
+
+    ``run_start`` lines are rare (one per scope), so a forward filter scan
+    is cheap relative to the full read every post-hoc consumer does
+    anyway; any parse problem returns ``None`` (caller keeps everything).
+    """
+    last = None
+    try:
+        with open(p0_path) as f:
+            for line in f:
+                if '"ev":"run_start"' in line:
+                    last = line
+        if last is None:
+            return None
+        n = json.loads(last).get("process_count")
+        return n if isinstance(n, int) and n > 0 else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def discover_event_files(
+    workdir: str, process_count: int | None = None
+) -> list[str]:
+    """The event files that constitute a workdir's (latest) run.
+
+    The one file-discovery contract every consumer shares (the multihost
+    merge, ``tools/obs_report.py``, ``tools/check_events_schema.py``):
+    when per-process pod files (``events.p<i>.jsonl``) exist they ARE the
+    run, in process order — a bare ``events.jsonl`` alongside them is a
+    stale single-process leftover in a reused workdir, not a host.
+    Raises ``FileNotFoundError`` when the workdir has no event files.
+
+    With ``process_count`` (a caller that KNOWS the run shape, like the
+    pod primary's merge), only that shape's files are returned: leftover
+    ``events.p2.jsonl``/``events.p3.jsonl`` from a previous 4-host run of
+    a workdir now reused by 2 hosts are dead streams, not hosts.
+    Without it, the shape is recovered from the stream itself — process 0
+    always exists, and its latest ``run_start`` declares the current
+    pod's ``process_count``, so the same leftovers are excluded for the
+    post-hoc consumers too (unparseable p0 = keep everything, best
+    effort).  When BOTH namings exist the more recently written set
+    wins — the reuse could have gone in either direction.
+    """
+    if process_count is not None:
+        expected = [
+            events_path(workdir, i, process_count)
+            for i in range(process_count)
+        ]
+        found = [p for p in expected if os.path.exists(p)]
+        if not found:
+            raise FileNotFoundError(
+                f"no events files for a {process_count}-process run "
+                f"under {workdir}"
+            )
+        return found
+    pod = glob.glob(os.path.join(workdir, "events.p*.jsonl"))
+    if pod:
+        def pidx(p: str) -> int:
+            m = re.search(r"events\.p(\d+)\.jsonl$", p)
+            return int(m.group(1)) if m else -1
+        pod = sorted(pod, key=pidx)
+        shape = _declared_process_count(os.path.join(workdir, "events.p0.jsonl"))
+        if shape is not None:
+            pod = [p for p in pod if 0 <= pidx(p) < shape]
+    single = os.path.join(workdir, "events.jsonl")
+    has_single = os.path.exists(single)
+    if pod and has_single:
+        newest_pod = max(os.path.getmtime(p) for p in pod)
+        return pod if newest_pod >= os.path.getmtime(single) else [single]
+    if pod:
+        return pod
+    if has_single:
+        return [single]
+    raise FileNotFoundError(f"no events*.jsonl under {workdir}")
+
+
+def expand_event_paths(paths: list[str]) -> list[str]:
+    """CLI arguments → event files: the expansion both tools share.
+
+    Each path is an event file OR a workdir (expanded via
+    :func:`discover_event_files`, so stale files in a reused/resized
+    workdir are excluded identically everywhere).  Raises
+    ``FileNotFoundError`` for a missing file or an event-less workdir —
+    callers turn that into their clean exit-2 path.  Lives here so
+    ``obs_report`` and ``check_events_schema`` cannot drift on which
+    files constitute a run.
+    """
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(discover_event_files(p))
+        elif os.path.exists(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"{p} does not exist")
+    return out
+
+
+class EventLog:
+    """Append-only JSONL event stream with atomic thread-safe writes.
+
+    Each :meth:`emit` serialises one event to a single ``os.write`` on an
+    ``O_APPEND`` descriptor (atomic for regular files) under a lock, so
+    concurrent emitters — the driver loop, the feed pool, the writer pool —
+    can never interleave partial lines.  Timestamps are stamped here, not
+    by callers, so every event's two clocks are sampled together.
+    """
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fd: int | None = os.open(
+            path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644
+        )
+        self._lock = threading.Lock()
+
+    def emit(self, ev: str, **fields: Any) -> dict:
+        """Append one event line; returns the record as written."""
+        rec = {
+            "ev": ev,
+            "t_wall": time.time(),
+            "t_mono": time.perf_counter(),
+            **fields,
+        }
+        data = (json.dumps(rec, separators=(",", ":"), default=str) + "\n").encode()
+        with self._lock:
+            if self._fd is None:
+                raise ValueError(f"EventLog {self.path} is closed")
+            n = os.write(self._fd, data)
+            if n != len(data):
+                # a short write (ENOSPC reached mid-line) tears the line;
+                # the contract is raise-at-caller, never silent loss
+                raise OSError(
+                    f"short write to {self.path}: {n}/{len(data)} bytes"
+                )
+        return rec
+
+    def run_start(self, **fields: Any) -> dict:
+        """``run_start`` with the ambient process facts filled in."""
+        fields.setdefault("schema", SCHEMA_VERSION)
+        fields.setdefault("pid", os.getpid())
+        fields.setdefault("host", socket.gethostname())
+        return self.emit("run_start", **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def summarize_events_file(path: str) -> dict:
+    """Fold one per-process event file into its LAST run scope's aggregate.
+
+    The per-host rollup the multihost primary folds into the run summary
+    (:func:`land_trendr_tpu.parallel.multihost.merge_host_event_logs`).
+    A resumed run appends a fresh ``run_start`` to the same file, so
+    counters reset at every ``run_start`` — the summary describes the most
+    recent run, which is the one the merging driver is part of.  Malformed
+    lines are counted, not fatal: a crashed peer's torn final line must
+    not take down the primary's summary.  Lives here, next to
+    :data:`EVENT_FIELDS`, so the schema knowledge stays in one module.
+    """
+    agg: dict = {
+        "events_file": path,
+        "process_index": None,
+        "host": None,
+        "pid": None,
+        "tiles_done": 0,
+        "tile_retries": 0,
+        "tiles_failed": 0,
+        "pixels": 0,
+        "wall_s": None,
+        "px_per_s": None,
+        "status": None,
+        "malformed_lines": 0,
+    }
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                agg["malformed_lines"] += 1
+                continue
+            ev = rec.get("ev")
+            if ev == "run_start":
+                agg.update(
+                    process_index=rec.get("process_index"),
+                    host=rec.get("host"),
+                    pid=rec.get("pid"),
+                    tiles_done=0,
+                    tile_retries=0,
+                    tiles_failed=0,
+                    pixels=0,
+                    wall_s=None,
+                    px_per_s=None,
+                    status=None,
+                    # the torn final line of a crashed PREVIOUS scope must
+                    # not flag the healthy resumed scope as corrupt
+                    malformed_lines=0,
+                )
+            elif ev == "tile_done":
+                agg["tiles_done"] += 1
+                agg["pixels"] += int(rec.get("px", 0))
+            elif ev == "tile_retry":
+                agg["tile_retries"] += 1
+            elif ev == "tile_failed":
+                agg["tiles_failed"] += 1
+            elif ev == "run_done":
+                agg["status"] = rec.get("status")
+                agg["wall_s"] = rec.get("wall_s")
+                agg["px_per_s"] = rec.get("px_per_s")
+    return agg
+
+
+def iter_events(path: str) -> Iterator[dict]:
+    """Yield parsed event records; skips blank lines, raises on bad JSON."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)
+
+
+def validate_event(rec: Any, lineno: int | None = None) -> list[str]:
+    """Schema errors for one record (empty list = valid).
+
+    Required fields are a minimum — unknown extra fields pass, so older
+    validators accept newer (compatible) streams.
+    """
+    where = f"line {lineno}: " if lineno is not None else ""
+    if not isinstance(rec, dict):
+        return [f"{where}event is not a JSON object: {type(rec).__name__}"]
+    errs: list[str] = []
+    ev = rec.get("ev")
+    if ev not in EVENT_FIELDS:
+        return [f"{where}unknown event type {ev!r}"]
+    for name in ("t_wall", "t_mono"):
+        v = rec.get(name)
+        if not isinstance(v, _NUM) or isinstance(v, bool):
+            errs.append(f"{where}{ev}: {name} missing or non-numeric ({v!r})")
+    for name, typ in EVENT_FIELDS[ev].items():
+        if name not in rec:
+            errs.append(f"{where}{ev}: missing required field {name!r}")
+        elif not isinstance(rec[name], typ) or (
+            typ is not bool and isinstance(rec[name], bool)
+        ):
+            errs.append(
+                f"{where}{ev}: field {name!r} has type "
+                f"{type(rec[name]).__name__}, expected {typ}"
+            )
+    for name, typ in OPTIONAL_FIELDS.get(ev, {}).items():
+        # same bool guard as required fields: isinstance(True, int) holds,
+        # but a bool in a numeric field is producer drift, not a number
+        if name in rec and (
+            not isinstance(rec[name], typ)
+            or (typ is not bool and isinstance(rec[name], bool))
+        ):
+            errs.append(
+                f"{where}{ev}: optional field {name!r} has type "
+                f"{type(rec[name]).__name__}, expected {typ}"
+            )
+    if ev == "run_start" and rec.get("schema") not in (None, SCHEMA_VERSION):
+        errs.append(
+            f"{where}run_start: schema version {rec.get('schema')!r} != "
+            f"{SCHEMA_VERSION} (this validator)"
+        )
+    return errs
+
+
+def validate_events_file(path: str) -> list[str]:
+    """All schema errors in one JSONL event file (empty list = valid).
+
+    Beyond per-record checks: the first event of the file must be a
+    ``run_start`` (every later run scope re-opens with its own), and
+    malformed JSON is an error, not a crash.
+    """
+    errs: list[str] = []
+    first_seen = False
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"line {i}: malformed JSON ({e})")
+                continue
+            if not first_seen:
+                first_seen = True
+                if isinstance(rec, dict) and rec.get("ev") != "run_start":
+                    errs.append(
+                        f"line {i}: first event is {rec.get('ev')!r}, "
+                        "expected 'run_start'"
+                    )
+            errs.extend(validate_event(rec, lineno=i))
+    if not first_seen:
+        errs.append("file contains no events")
+    return errs

@@ -1,0 +1,286 @@
+"""The run-wide telemetry bundle the runtime driver reports through.
+
+One :class:`Telemetry` per ``run_stack`` call ties the two halves of
+:mod:`land_trendr_tpu.obs` together and owns their lifecycles:
+
+* the per-process :class:`~land_trendr_tpu.obs.events.EventLog`
+  (``<workdir>/events.jsonl``, ``events.p<i>.jsonl`` under multihost);
+* a :class:`~land_trendr_tpu.obs.metrics.MetricsRegistry` pre-populated
+  with the driver instrument set (the ``lt_*`` names documented in
+  README.md §Observability), its :class:`PromFileExporter` refreshing
+  ``<workdir>/metrics.prom``, and — when ``metrics_port`` is set — the
+  in-flight ``/metrics`` HTTP endpoint.
+
+The driver calls the ``tile_*`` / ``run_*`` hooks; the tile manifest calls
+:meth:`write_done` from inside :meth:`TileManifest.record` (writer-pool
+threads — every path here is thread-safe).  Deliberately **jax-free**:
+device facts (mesh size, resolved impl, HBM live bytes) are plain values
+passed in by the driver, so the subsystem tests run without a backend and
+the import cost is stdlib-only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+from land_trendr_tpu.obs.events import EventLog, events_path
+from land_trendr_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    PromFileExporter,
+)
+
+__all__ = ["Telemetry", "metrics_path"]
+
+#: px/s histogram buckets: log-spaced from one-core-CPU (~2e4) past the
+#: 10M px/s north star
+_PXS_BUCKETS = (1e4, 3e4, 1e5, 3e5, 1e6, 3e6, 1e7, 3e7, 1e8)
+
+
+def metrics_path(workdir: str, process_index: int = 0, process_count: int = 1) -> str:
+    """Per-process ``.prom`` path (mirrors :func:`events_path` naming)."""
+    if process_count <= 1:
+        return os.path.join(workdir, "metrics.prom")
+    return os.path.join(workdir, f"metrics.p{process_index}.prom")
+
+
+class Telemetry:
+    """Event log + metrics registry + exporters for one driver run."""
+
+    def __init__(
+        self,
+        workdir: str,
+        *,
+        fingerprint: str = "",
+        process_index: int = 0,
+        process_count: int = 1,
+        metrics_port: int | None = None,
+        metrics_host: str = "",
+        metrics_interval_s: float = 5.0,
+    ) -> None:
+        os.makedirs(workdir, exist_ok=True)
+        self.events = EventLog(events_path(workdir, process_index, process_count))
+        try:
+            self._init_metrics(
+                workdir, fingerprint, process_index, process_count,
+                metrics_port, metrics_host, metrics_interval_s,
+            )
+        except BaseException:
+            # a half-built Telemetry (e.g. --metrics-port already bound)
+            # must not leak the event fd, the exporter thread, or the
+            # server — the caller only gets the exception, never a handle
+            if getattr(self, "_server", None) is not None:
+                self._server.stop()
+            self.events.close()
+            raise
+
+    def _init_metrics(
+        self,
+        workdir: str,
+        fingerprint: str,
+        process_index: int,
+        process_count: int,
+        metrics_port: int | None,
+        metrics_host: str,
+        metrics_interval_s: float,
+    ) -> None:
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self._tiles_done = r.counter(
+            "lt_tiles_done_total", "tiles whose device result completed"
+        )
+        self._tile_retries = r.counter(
+            "lt_tile_retries_total", "tile attempt failures that were retried"
+        )
+        self._tiles_failed = r.counter(
+            "lt_tiles_failed_total", "tiles that exhausted their retry budget"
+        )
+        self._pixels = r.counter(
+            "lt_pixels_total", "real (unpadded) pixels whose tile completed"
+        )
+        self._bytes_written = r.counter(
+            "lt_artifact_bytes_written_total",
+            "bytes of tile checkpoint artifacts persisted",
+        )
+        self._compute_hist = r.histogram(
+            "lt_tile_compute_seconds",
+            "per-tile dispatch + device-wait wall seconds",
+            buckets=DEFAULT_LATENCY_BUCKETS,
+        )
+        self._record_hist = r.histogram(
+            "lt_tile_record_seconds",
+            "per-tile artifact + manifest persist seconds",
+            buckets=DEFAULT_LATENCY_BUCKETS,
+        )
+        self._pxs_hist = r.histogram(
+            "lt_tile_px_per_s", "per-tile pixel throughput", buckets=_PXS_BUCKETS
+        )
+        self._pxs_gauge = r.gauge("lt_px_per_s", "last tile's pixel throughput")
+        self._no_fit = r.gauge("lt_no_fit_rate", "last written tile's no-fit rate")
+        self._feed_backlog = r.gauge(
+            "lt_feed_backlog", "fed tiles waiting for dispatch"
+        )
+        self._write_backlog = r.gauge(
+            "lt_write_backlog", "finished tiles waiting in the writer pool"
+        )
+        self._dev_bytes = r.gauge(
+            "lt_device_bytes_in_use", "device allocator live bytes (all local devices)"
+        )
+        self._dev_peak = r.gauge(
+            "lt_device_bytes_peak", "high watermark of lt_device_bytes_in_use"
+        )
+        if fingerprint:
+            r.gauge(
+                "lt_run_info",
+                "constant 1; labels carry run identity",
+                labels={"fingerprint": fingerprint},
+            ).set(1)
+
+        # bind the port BEFORE starting the exporter thread: a bind
+        # failure is the common construction error, and nothing should be
+        # running yet when it raises
+        self._server = (
+            MetricsHTTPServer(self.registry, metrics_port, host=metrics_host)
+            if metrics_port is not None
+            else None
+        )
+        self._exporter = PromFileExporter(
+            self.registry,
+            metrics_path(workdir, process_index, process_count),
+            interval_s=metrics_interval_s,
+        ).start()
+
+    # -- paths the run summary reports -------------------------------------
+    @property
+    def events_file(self) -> str:
+        return self.events.path
+
+    @property
+    def metrics_file(self) -> str:
+        return self._exporter.path
+
+    @property
+    def metrics_port(self) -> int | None:
+        return self._server.port if self._server is not None else None
+
+    # -- driver hooks ------------------------------------------------------
+    def run_start(self, **fields: Any) -> None:
+        self.events.run_start(**fields)
+
+    def tile_start(self, tile_id: int, attempt: int = 1) -> None:
+        self.events.emit("tile_start", tile_id=tile_id, attempt=attempt)
+
+    def tile_done(
+        self,
+        tile_id: int,
+        px: int,
+        compute_s: float,
+        feed_backlog: int,
+        write_backlog: int,
+        device_bytes_in_use: int | None = None,
+    ) -> None:
+        pxs = px / compute_s if compute_s > 0 else 0.0
+        fields: dict[str, Any] = {}
+        if device_bytes_in_use is not None:
+            self._dev_bytes.set(device_bytes_in_use)
+            self._dev_peak.set_max(device_bytes_in_use)
+            fields["device_bytes_in_use"] = device_bytes_in_use
+        self.events.emit(
+            "tile_done",
+            tile_id=tile_id,
+            px=px,
+            compute_s=round(compute_s, 6),
+            px_per_s=round(pxs, 1),
+            feed_backlog=feed_backlog,
+            write_backlog=write_backlog,
+            **fields,
+        )
+        self._tiles_done.inc()
+        self._pixels.inc(px)
+        self._compute_hist.observe(compute_s)
+        self._pxs_hist.observe(pxs)
+        self._pxs_gauge.set(pxs)
+        self._feed_backlog.set(feed_backlog)
+        self._write_backlog.set(write_backlog)
+
+    def tile_retry(self, tile_id: int, attempt: int, error: BaseException | str) -> None:
+        self.events.emit(
+            "tile_retry", tile_id=tile_id, attempt=attempt, error=str(error)
+        )
+        self._tile_retries.inc()
+
+    def tile_failed(self, tile_id: int, attempts: int, error: BaseException | str) -> None:
+        self.events.emit(
+            "tile_failed", tile_id=tile_id, attempts=attempts, error=str(error)
+        )
+        self._tiles_failed.inc()
+
+    def write_done(
+        self, tile_id: int, nbytes: int, record_s: float, meta: Mapping[str, Any]
+    ) -> None:
+        """Called by ``TileManifest.record`` once a tile is durable."""
+        fields: dict[str, Any] = {}
+        # only no_fit_rate rides along from the manifest meta: its
+        # px_per_s is computed over PADDED tile pixels, which would
+        # contradict tile_done's real-pixel px_per_s for the same tile —
+        # tile_done is the one throughput source of truth in the stream
+        if "no_fit_rate" in meta:
+            fields["no_fit_rate"] = meta["no_fit_rate"]
+        self.events.emit(
+            "write_done",
+            tile_id=tile_id,
+            bytes=nbytes,
+            record_s=round(record_s, 6),
+            **fields,
+        )
+        self._bytes_written.inc(nbytes)
+        self._record_hist.observe(record_s)
+        if "no_fit_rate" in meta:
+            self._no_fit.set(float(meta["no_fit_rate"]))
+
+    def run_done(
+        self,
+        status: str,
+        tiles_done: int,
+        pixels: int,
+        wall_s: float,
+        px_per_s: float,
+        fit_rate: float,
+        stage_s: Mapping[str, float] | None = None,
+    ) -> None:
+        self.events.emit(
+            "run_done",
+            status=status,
+            tiles_done=tiles_done,
+            pixels=pixels,
+            wall_s=wall_s,
+            px_per_s=px_per_s,
+            fit_rate=fit_rate,
+            **({"stage_s": dict(stage_s)} if stage_s else {}),
+        )
+        for name, secs in (stage_s or {}).items():
+            # "feed_s" -> stage="feed"; totals only meaningful at run end
+            self.registry.gauge(
+                "lt_stage_seconds",
+                "accumulated host seconds per driver stage",
+                labels={"stage": name.removesuffix("_s")},
+            ).set(secs)
+
+    def close(self) -> None:
+        """Flush the final exposition, stop the exporters, close the log.
+
+        Idempotent and exception-tolerant in the ways that matter on the
+        driver's abort path: the event log closes even when the final
+        metrics flush raises.
+        """
+        try:
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+        finally:
+            try:
+                self._exporter.stop()
+            finally:
+                self.events.close()

@@ -54,6 +54,7 @@ __all__ = [
     "host_share",
     "feed_global",
     "gather_local_rows",
+    "merge_host_event_logs",
 ]
 
 _T = TypeVar("_T")
@@ -177,6 +178,113 @@ def feed_global(
     vals = jax.make_array_from_process_local_data(sharding, local_values)
     mask = jax.make_array_from_process_local_data(sharding, local_mask)
     return vals, mask
+
+
+def merge_host_event_logs(
+    workdir: str,
+    expect_hosts: int | None = None,
+    timeout_s: float = 60.0,
+    poll_s: float = 0.1,
+    newer_than: float | None = None,
+) -> list[dict]:
+    """Merge every per-process ``events*.jsonl`` in a shared workdir into
+    per-host run aggregates — the primary-host fold the run summary carries.
+
+    The pod driver flow keeps each process's telemetry in its own file
+    (:func:`land_trendr_tpu.obs.events_path`), so merging is a plain
+    shared-filesystem read — the same trust the tile manifest already
+    places in the workdir, with no device collective involved.  With
+    ``expect_hosts`` the merge WAITS (bounded by ``timeout_s``) until that
+    many files carry a terminal ``run_done``: hosts finish their tile
+    shares at different times, and the primary must not fold a peer's
+    half-written stream.  On timeout the partial merge is returned with a
+    warning — a crashed peer must not hang the primary's summary.
+
+    While waiting, terminal state is probed from each file's TAIL only
+    (``run_done`` is the last event a process emits); the full per-file
+    parse happens exactly once, after the wait resolves — a straggler
+    must not cost the primary quadratic re-parsing of gigarun streams.
+
+    ``newer_than`` (a wall-clock timestamp — the caller's own run start,
+    minus clock-skew slack) guards a REUSED workdir against a peer that
+    died before writing this run's ``run_start``: its file still ends in
+    the previous scope's ``run_done``, which the tail probe alone cannot
+    tell from a live one.  Files not modified since ``newer_than`` are
+    never counted terminal (the timeout warning surfaces the missing
+    peer) and their summaries carry ``"stale": True``.
+    """
+    import time
+
+    from land_trendr_tpu.obs.events import (
+        discover_event_files,
+        summarize_events_file,
+    )
+
+    def _files() -> list[str]:
+        # the shared discovery contract: pod per-process files are the
+        # run; a bare events.jsonl next to them — or p-files beyond
+        # expect_hosts from a previous, larger pod run — are stale
+        # leftovers in a reused workdir, not hosts
+        try:
+            return discover_event_files(workdir, process_count=expect_hosts)
+        except FileNotFoundError:
+            return []
+
+    def _stale(path: str) -> bool:
+        # untouched since the current run started = the stream is all
+        # previous-scope history; its run_done must not satisfy the wait
+        if newer_than is None:
+            return False
+        try:
+            return os.path.getmtime(path) < newer_than
+        except OSError:
+            return True
+
+    def _tail_terminal(path: str, tail_bytes: int = 8192) -> bool:
+        # terminal = the LAST run scope has its run_done: a run_done with
+        # a run_start after it in the tail belongs to a finished PREVIOUS
+        # scope of a resumed run, and that peer is still mid-stream
+        if _stale(path):
+            return False
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - tail_bytes))
+                tail = f.read()
+        except OSError:
+            return False
+        done = tail.rfind(b'"ev":"run_done"')
+        return done >= 0 and done > tail.rfind(b'"ev":"run_start"')
+
+    deadline = time.monotonic() + timeout_s
+    # a file probed terminal stays terminal for THIS wait (its last scope
+    # cannot lose its run_done) — only the pending set is re-probed, so a
+    # straggler costs one tail read per poll, not one per host per poll
+    # against the shared filesystem
+    terminal: set[str] = set()
+    while True:
+        files = _files()
+        terminal.update(p for p in files if p not in terminal and _tail_terminal(p))
+        n_terminal = sum(1 for p in files if p in terminal)
+        if expect_hosts is None or n_terminal >= expect_hosts:
+            break
+        if time.monotonic() > deadline:
+            _log.warning(
+                "merge_host_event_logs: only %d/%d hosts reached run_done "
+                "within %.0fs; returning the partial merge",
+                n_terminal, expect_hosts, timeout_s,
+            )
+            break
+        time.sleep(poll_s)
+    merged = []
+    for p in files:
+        s = summarize_events_file(p)
+        if p not in terminal and _stale(p):
+            # the summary describes a PREVIOUS run's scope, not this one —
+            # a consumer must not read its status='ok' as a live host
+            s["stale"] = True
+        merged.append(s)
+    return merged
 
 
 def gather_local_rows(out: jax.Array) -> np.ndarray:

@@ -23,7 +23,13 @@ Design points (SURVEY.md §5 / §7):
   equivalent, minus speculative execution which a single SPMD program does
   not need).
 * **Observability**: structured per-tile logs (px/sec, no-fit rate, mean
-  p-of-F) through :mod:`logging`, plus a run summary dict.
+  p-of-F) through :mod:`logging`, plus a run summary dict; with
+  ``RunConfig.telemetry`` the run additionally reports through
+  :mod:`land_trendr_tpu.obs` — a schema-versioned ``events.jsonl`` stream
+  (run/tile lifecycle, retries, backlog depths), a Prometheus
+  ``metrics.prom`` exposition refreshed in flight, and an optional live
+  ``/metrics`` endpoint (``metrics_port``) — the Hadoop-counters
+  equivalent a production-scale deployment scrapes.
 """
 
 from __future__ import annotations
@@ -143,6 +149,26 @@ class RunConfig:
     #: a TPU backend, XLA elsewhere — the round-4 measured default, ~3.3×
     #: faster on v5 lite with identical decisions), "pallas", or "xla".
     impl: str = "auto"
+    #: run-wide telemetry (:mod:`land_trendr_tpu.obs`): a schema-versioned
+    #: ``events.jsonl`` stream (one file per process in multihost runs) and
+    #: a Prometheus ``metrics.prom`` exposition refreshed from a daemon
+    #: thread, both under ``workdir``.  An execution fact like
+    #: ``write_workers`` — NOT fingerprinted, and per-tile overhead is a
+    #: few JSON lines (measured ≪ 2% of even a CPU-backend run's wall).
+    telemetry: bool = False
+    #: with ``telemetry``: also serve a live ``/metrics`` endpoint on this
+    #: port (0 = ephemeral, reported in the run summary) so an in-flight
+    #: gigapixel run is scrapeable.  ``None`` (default) = no server.
+    #: Multi-process runs bind ``port + process_index`` (per-process, like
+    #: the event/metrics file naming) so same-host pods don't collide.
+    metrics_port: int | None = None
+    #: bind address for the ``/metrics`` server.  Default ``""`` = all
+    #: interfaces (the scrape-from-another-host use case); operators on
+    #: shared nodes can restrict the unauthenticated endpoint with
+    #: ``"127.0.0.1"``
+    metrics_host: str = ""
+    #: ``metrics.prom`` refresh period, seconds
+    metrics_interval_s: float = 5.0
 
     def __post_init__(self) -> None:
         # fail fast: an invalid choice must not surface only at
@@ -193,6 +219,25 @@ class RunConfig:
             raise ValueError(
                 f"out_overviews={self.out_overviews!r} must be >= 0 or 'auto'"
             )
+        if self.metrics_port is not None:
+            if not self.telemetry:
+                raise ValueError(
+                    "metrics_port requires telemetry=True (the registry the "
+                    "endpoint serves only exists on telemetry runs)"
+                )
+            if not (0 <= self.metrics_port <= 65535):
+                raise ValueError(
+                    f"metrics_port={self.metrics_port} outside 0..65535"
+                )
+        elif self.metrics_host:
+            raise ValueError(
+                "metrics_host requires metrics_port (there is no server "
+                "to bind without a port)"
+            )
+        if self.telemetry and self.metrics_interval_s <= 0:
+            raise ValueError(
+                f"metrics_interval_s={self.metrics_interval_s} must be > 0"
+            )
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -236,6 +281,22 @@ class RunConfig:
 def _jit_f16(a):
     """Device-side f16 cast for the packed fetch path (one tiny program)."""
     return a.astype(jnp.float16)
+
+
+def _device_live_bytes() -> "int | None":
+    """Sum of allocator live bytes across local devices, or None where the
+    backend exposes no ``memory_stats`` (CPU) — the HBM watermark feed for
+    the telemetry gauges."""
+    total, seen = 0, False
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            return None
+        if ms and "bytes_in_use" in ms:
+            total += int(ms["bytes_in_use"])
+            seen = True
+    return total if seen else None
 
 
 #: the full per-pixel segmentation product set (RunConfig.products domain);
@@ -446,6 +507,14 @@ def run_stack(
 
     # validate the mesh configuration BEFORE touching the workdir, so a
     # rejected run cannot stamp a fresh manifest with a bad context
+    if cfg.metrics_port and cfg.metrics_port + jax.process_count() - 1 > 65535:
+        # the per-process fan-out binds port + process_index; a
+        # near-ceiling base port must fail fast here, not as a bind
+        # OSError deep in a non-primary process minutes into the run
+        raise ValueError(
+            f"metrics_port={cfg.metrics_port}: port + process_index "
+            f"exceeds 65535 for a {jax.process_count()}-process run"
+        )
     share = list(tiles)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -587,6 +656,7 @@ def run_stack(
     pending_writes: deque = deque()  # bounded at write_workers in flight
     n_px = 0
     n_fit = 0
+    n_done = 0
 
     def _collect_write(fut) -> None:
         """Backpressure + fail-fast: re-raises writer errors at the next tile."""
@@ -602,6 +672,7 @@ def run_stack(
 
     def _finish(pending) -> None:
         """Await one in-flight tile (retrying on failure) and queue its write."""
+        nonlocal n_done
         t, out, err, dn, qa, dt_dispatch = pending
         attempt = 1
         while True:
@@ -619,13 +690,29 @@ def run_stack(
                 t.tile_id, attempt, cfg.max_retries + 1, err,
             )
             if attempt > cfg.max_retries:
+                if telemetry is not None:
+                    telemetry.tile_failed(t.tile_id, attempt, err)
                 raise RuntimeError(
                     f"tile {t.tile_id} failed after {attempt} attempts"
                 ) from err
+            if telemetry is not None:
+                telemetry.tile_retry(t.tile_id, attempt, err)
             attempt += 1
+            if telemetry is not None:
+                telemetry.tile_start(t.tile_id, attempt=attempt)
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
             dt_dispatch = time.perf_counter() - t0
+        n_done += 1
+        if telemetry is not None:
+            telemetry.tile_done(
+                t.tile_id,
+                t.h * t.w,
+                dt,
+                feed_backlog=len(pending_feeds),
+                write_backlog=len(pending_writes),
+                device_bytes_in_use=_device_live_bytes(),
+            )
         _drain_writes(cfg.write_workers - 1)
         pending_writes.append(writer.submit(_write_job, t, out, dt))
 
@@ -648,6 +735,54 @@ def run_stack(
         with timer.stage("feed"):
             return _feed_tile(stack, t, feed_px, bands)
 
+    # constructed LAST, immediately before the try/finally that owns its
+    # shutdown: an exception anywhere between construction and that
+    # finally would leak the exporter thread / metrics port / event fd
+    # and leave a stream with no terminal run_done
+    telemetry = None
+    if cfg.telemetry:
+        from land_trendr_tpu.obs import Telemetry
+
+        # per-process port fan-out (port + process_index, like the
+        # per-process event/metrics FILE naming): a same-host pod would
+        # otherwise have every process after the first die binding the
+        # one configured port.  0 (ephemeral) needs no offset; each
+        # process's bound port lands in its own run summary.
+        metrics_port = cfg.metrics_port
+        if metrics_port:
+            metrics_port += jax.process_index()
+        telemetry = Telemetry(
+            cfg.workdir,
+            fingerprint=manifest.fingerprint,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            metrics_port=metrics_port,
+            metrics_host=cfg.metrics_host,
+            metrics_interval_s=cfg.metrics_interval_s,
+        )
+        try:
+            # the manifest reports write_done events once each tile is
+            # durable
+            manifest.telemetry = telemetry
+            telemetry.run_start(
+                fingerprint=manifest.fingerprint,
+                process_index=jax.process_index(),
+                process_count=jax.process_count(),
+                tiles_total=len(tiles),
+                tiles_todo=len(todo),
+                tiles_skipped_resume=n_resume_skipped,
+                mesh_devices=n_mesh,
+                impl=impl_resolved,
+            )
+        except BaseException:
+            # a failed run_start emit surfaces before the try/finally
+            # below owns shutdown — unwind here or the exporter thread /
+            # metrics port / event fd leak into the caller's process
+            manifest.telemetry = None
+            telemetry.close()
+            raise
+
+    run_ok = False
     try:
         feed_iter = iter(todo)
         for t in itertools.islice(feed_iter, cfg.feed_workers + 1):
@@ -659,6 +794,8 @@ def run_stack(
             nxt = next(feed_iter, None)
             if nxt is not None:
                 pending_feeds.append((nxt, feeder.submit(_feed_job, nxt)))
+            if telemetry is not None:
+                telemetry.tile_start(t.tile_id, attempt=1)
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
             dt_dispatch = time.perf_counter() - t0
@@ -674,6 +811,7 @@ def run_stack(
         if pending is not None:
             _finish(pending)
         _drain_writes(0)
+        run_ok = True
     finally:
         feeder.shutdown(wait=False, cancel_futures=True)
         writer.shutdown(wait=True)
@@ -681,6 +819,38 @@ def run_stack(
             if (exc := fut.exception()):
                 # a compute abort is already propagating; surface, don't mask
                 log.error("tile write also failed during abort: %s", exc)
+            else:
+                # writes the shutdown drain completed are real durable
+                # tiles: fold them in so the aborted run_done's pixels /
+                # fit_rate stay consistent with its own tiles_done
+                # (success path drained everything before run_ok)
+                px, fit = fut.result()
+                n_px += px
+                n_fit += fit
+        if telemetry is not None and not run_ok:
+            # abort visibility: the stream must say the run died, not just
+            # stop — consumers treat a missing run_done as "still running".
+            # Best-effort only: the run-failure exception is propagating
+            # through this finally, and a telemetry emit error (e.g. the
+            # SAME full disk that killed the write) must not replace it
+            abort_wall = time.perf_counter() - t_run
+            try:
+                telemetry.run_done(
+                    "aborted",
+                    tiles_done=n_done,
+                    pixels=n_px,
+                    wall_s=round(abort_wall, 3),
+                    px_per_s=round(n_px / abort_wall, 1) if n_px else 0.0,
+                    fit_rate=(n_fit / n_px) if n_px else 0.0,
+                    stage_s=timer.summary(),
+                )
+            except Exception as exc:
+                log.error("abort-path telemetry run_done failed: %s", exc)
+            finally:
+                try:
+                    telemetry.close()
+                except Exception as exc:
+                    log.error("abort-path telemetry close failed: %s", exc)
 
     wall = time.perf_counter() - t_run
     summary = {
@@ -694,6 +864,55 @@ def run_stack(
         "fingerprint": manifest.fingerprint,
         "mesh_devices": n_mesh,
     }
+    if telemetry is not None:
+        try:
+            telemetry.run_done(
+                "ok",
+                tiles_done=n_done,
+                pixels=n_px,
+                wall_s=summary["wall_s"],
+                px_per_s=summary["px_per_s"],
+                fit_rate=summary["fit_rate"],
+                stage_s=timer.summary(),
+            )
+        finally:
+            # the terminal-event emit may raise (full disk) and that error
+            # should surface on a succeeded run — but close() must still
+            # run, or the metrics port / exporter thread / event fd leak
+            # into the caller's process
+            summary["telemetry"] = {
+                "events": telemetry.events_file,
+                "metrics": telemetry.metrics_file,
+            }
+            if telemetry.metrics_port is not None:
+                summary["telemetry"]["metrics_port"] = telemetry.metrics_port
+            telemetry.close()  # final exposition flush before anyone reads it
+        if jax.process_count() > 1 and jax.process_index() == 0:
+            # primary-host fold: per-process event files live in the SHARED
+            # workdir (the manifest's filesystem is the pod's job state), so
+            # the merge is a bounded wait for every peer's run_done line —
+            # no collective, usable even when a peer aborted
+            from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+
+            # wait bound scaled to THIS run: all hosts started together on
+            # similar tile shares, so a straggler peer gets up to the
+            # primary's own wall again — but capped, because a peer that
+            # died WITHOUT its run_done line (OOM kill) must not make the
+            # primary of a 10-hour run poll for another 10 hours; then
+            # the partial fold (with its log warning) is the right answer
+            merge_timeout_s = max(60.0, min(2.0 * wall, 900.0))
+            summary["telemetry"]["hosts"] = merge_host_event_logs(
+                cfg.workdir,
+                expect_hosts=jax.process_count(),
+                timeout_s=merge_timeout_s,
+                # coarsen the straggler poll with the wait bound: a 900s
+                # wait does not need 10Hz probes of a shared filesystem
+                poll_s=max(0.1, min(2.0, merge_timeout_s / 600.0)),
+                # guard a reused workdir: a peer file untouched since this
+                # run began (60s clock-skew slack) holds only a PREVIOUS
+                # scope — its old run_done must not pass for a live host
+                newer_than=time.time() - wall - 60.0,
+            )
     log.info("run complete: %s", summary)
     return summary
 

@@ -20,6 +20,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import time
 import zipfile
 from typing import Iterator
 
@@ -90,11 +91,19 @@ class TileManifest:
     f32 knife-edge decisions) but that post-hoc consumers like raster
     assembly don't know and don't need: when ``context`` is None the
     header's context is not checked.
+
+    ``telemetry`` (optional — anything with a ``write_done(tile_id,
+    nbytes, record_s, meta)`` hook, in practice
+    :class:`land_trendr_tpu.obs.Telemetry`) is notified once per
+    :meth:`record`, AFTER the artifact and manifest line are durable: the
+    ``write_done`` event stream is therefore a faithful durability log —
+    an event present means the tile survives a crash.
     """
 
     workdir: str
     fingerprint: str
     context: dict | None = None
+    telemetry: "object | None" = None
 
     @property
     def path(self) -> str:
@@ -117,9 +126,7 @@ class TileManifest:
         # STALE ones: in a shared pod workdir a peer process may be inside
         # record() right now, and deleting its live tmp would abort its
         # os.replace.  10 minutes is far beyond any tile write.
-        import time as _time
-
-        now = _time.time()
+        now = time.time()
         for n in os.listdir(self.workdir):
             if n.endswith(".tmp.npz"):
                 p = os.path.join(self.workdir, n)
@@ -202,6 +209,7 @@ class TileManifest:
             raise ValueError(
                 f"compress={compress!r} not one of {ARTIFACT_COMPRESS}"
             )
+        t0 = time.perf_counter()
         # note: np.savez appends ".npz" unless the name already ends with it;
         # the pid keeps concurrent pod processes' tmp files distinct
         tmp = f"{self.tile_path(tile_id)}.{os.getpid()}.tmp.npz"
@@ -209,6 +217,13 @@ class TileManifest:
         os.replace(tmp, self.tile_path(tile_id))
         with open(self.path, "a") as f:
             f.write(json.dumps({"kind": "tile", "tile_id": tile_id, **meta}) + "\n")
+        if self.telemetry is not None:
+            self.telemetry.write_done(
+                tile_id,
+                os.path.getsize(self.tile_path(tile_id)),
+                time.perf_counter() - t0,
+                meta,
+            )
 
     def load_tile(self, tile_id: int) -> dict[str, np.ndarray]:
         with np.load(self.tile_path(tile_id)) as z:

@@ -114,6 +114,21 @@ def _use_bands(bands) -> tuple[str, ...]:
     return use
 
 
+def _check_qa_dtype(fp: str, dtype: np.dtype) -> None:
+    """The ONE QA_PIXEL whitelist both C2 loaders share.
+
+    C2 defines QA_PIXEL as uint16 with flags through bit 15: a wider file
+    would be silently truncated by a blind uint16 cast, and a narrower one
+    cannot carry the full flag set — either way the file is not a valid C2
+    QA band, so reject it loudly (ADVICE round 5).  Kept as one helper so
+    the eager and lazy loaders cannot diverge."""
+    if dtype != np.dtype(np.uint16):
+        raise ValueError(
+            f"{fp}: QA_PIXEL dtype {dtype} unsupported "
+            "(expected uint16 bit flags)"
+        )
+
+
 def load_stack_dir(
     path: str,
     pattern: str = r"\.tif$",
@@ -316,7 +331,8 @@ def load_stack_dir_c2(
         elif img.shape != shape:
             raise ValueError(f"{fp}: raster size {img.shape} != {shape}")
         if b == "qa":
-            return img.astype(np.uint16, copy=False)
+            _check_qa_dtype(fp, img.dtype)
+            return img
         if img.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
             # keep the on-disk dtype: real C2 SR is uint16 with valid DNs
             # up to 43636 — an int16 cast would wrap bright pixels (snow,
@@ -429,9 +445,34 @@ class LazyBandCube:
             )
         ys, rows, cols = key
         ny, h_full, w_full = self.shape
-        yr_idx = range(ny)[ys] if isinstance(ys, slice) else [ys]
-        r0, r1, rstep = rows.indices(h_full) if isinstance(rows, slice) else (rows, rows + 1, 1)
-        c0, c1, cstep = cols.indices(w_full) if isinstance(cols, slice) else (cols, cols + 1, 1)
+
+        def norm_int(k: int, dim: int, axis: str) -> int:
+            # ndarray index semantics: negatives count from the end; out of
+            # range raises.  Without this, a negative int became a negative
+            # window offset handed straight to read_geotiff_window
+            # (ADVICE round 5).
+            j = int(k)
+            if j < 0:
+                j += dim
+            if not 0 <= j < dim:
+                raise IndexError(
+                    f"index {k} out of bounds for LazyBandCube {axis} axis "
+                    f"of size {dim}"
+                )
+            return j
+
+        yr_idx = (
+            range(ny)[ys] if isinstance(ys, slice)
+            else [norm_int(ys, ny, "year")]
+        )
+        r0, r1, rstep = (
+            rows.indices(h_full) if isinstance(rows, slice)
+            else ((r := norm_int(rows, h_full, "row")), r + 1, 1)
+        )
+        c0, c1, cstep = (
+            cols.indices(w_full) if isinstance(cols, slice)
+            else ((c := norm_int(cols, w_full, "col")), c + 1, 1)
+        )
         if rstep != 1 or cstep != 1:
             raise ValueError("LazyBandCube windows must be contiguous (step 1)")
         h, w = r1 - r0, c1 - c0
@@ -518,7 +559,11 @@ def open_stack_dir_c2_lazy(
                 raise ValueError(
                     f"{fp}: raster size {(info.height, info.width)} != {shape}"
                 )
-            if b != "qa" and info.dtype not in (
+            if b == "qa":
+                # the lazy feed casts windows to uint16 blindly, so the
+                # header dtype must pass the shared whitelist up front
+                _check_qa_dtype(fp, info.dtype)
+            elif info.dtype not in (
                 np.dtype(np.int16), np.dtype(np.uint16)
             ):
                 # same whitelist as the eager loader's read_band: f16 has

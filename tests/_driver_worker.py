@@ -1,7 +1,7 @@
 """Worker process for the true multi-process DRIVER test.
 
 Run as: ``python _driver_worker.py <coordinator> <num_procs> <proc_id>
-<workdir> <summary_json> [size] [tile]``.  Each worker owns 4 virtual CPU
+<workdir> <summary_json> [size] [tile] [telemetry]``.  Each worker owns 4 virtual CPU
 devices (``size``/``tile`` default to the test's tiny 48×40/20 scene;
 ``tools/multihost_bench.py`` passes larger ones for its artifact).  The
 worker joins the ``jax.distributed`` cluster, builds the SAME deterministic
@@ -33,6 +33,7 @@ def main() -> int:
     )
     size = int(sys.argv[6]) if len(sys.argv) > 6 else 0
     tile = int(sys.argv[7]) if len(sys.argv) > 7 else 20
+    telemetry = bool(int(sys.argv[8])) if len(sys.argv) > 8 else False
 
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
@@ -55,6 +56,9 @@ def main() -> int:
         tile_size=tile,  # default: 2×3 grid → 6 tiles, 3 per process
         workdir=workdir,
         out_dir=workdir + "_out",
+        # per-process events.p<i>.jsonl in the shared workdir; the primary
+        # folds every host's stream into its summary["telemetry"]["hosts"]
+        telemetry=telemetry,
     )
     summary = run_stack(rs, cfg, mesh=mesh)
     with open(out_path, "w") as f:

@@ -261,9 +261,11 @@ def test_segment_products_and_f16_flags(tmp_path, capsys):
     rep = json.loads(capsys.readouterr().out)
     assert set(rep["outputs"]) == {"n_vertices", "seg_magnitude", "model_valid"}
 
-    with pytest.raises(ValueError, match="unknown products"):
-        main([
-            "segment", stack_dir, "--tile-size", "32",
-            "--workdir", str(tmp_path / "w2"), "--out-dir", out_dir,
-            "--products", "bogus",
-        ])
+    # an invalid products list is an ARGUMENT error: clean exit code 2 with
+    # the message on stderr, not a RunConfig traceback (ADVICE round 5)
+    assert main([
+        "segment", stack_dir, "--tile-size", "32",
+        "--workdir", str(tmp_path / "w2"), "--out-dir", out_dir,
+        "--products", "bogus",
+    ]) == 2
+    assert "unknown products" in capsys.readouterr().err

@@ -200,6 +200,64 @@ def test_lentz_betainc_accuracy_bound():
     assert lperr.max() < 1e-2, lperr.max()       # deep-tail absolute sanity
 
 
+def test_lentz_iters_ny41_44_band_at_default_trips():
+    """The NY=41–44 band runs at the DEFAULT 12-trip count — validate it.
+
+    Advisor finding (round 5): ``_lentz_iters`` truncates, so
+    ``2.5·sqrt((44+10)/2) = 12.99 → 12`` — NY 41–44 share the 12-trip
+    count whose accuracy envelope was only measured on the NY ≤ 40 grid
+    (the extended-grid gate runs NY = 100 at 18 trips, skipping this
+    band).  This closes the gap: the full (a, b, x) grid those year
+    counts can produce, at exactly 12 trips, holds the same envelope the
+    NY ≤ 40 gate enforces."""
+    import jax
+    import jax.numpy as jnp
+
+    from land_trendr_tpu.ops.segment import _betainc_p_and_logp_lentz, _lentz_iters
+
+    # the band boundary: 44 is the last NY at the default trip count
+    assert [_lentz_iters(n) for n in (41, 42, 43, 44, 45)] == [12, 12, 12, 12, 13]
+
+    rng = np.random.default_rng(2)
+    a_l, b_l, x_l = [], [], []
+    for n in range(41, 45):
+        for m in range(1, 7):
+            df1, df2 = 2 * m - 1, n - 2 * m
+            if df2 < 1:
+                continue
+            f = 10 ** rng.uniform(-3, 4, 500)
+            x = df2 / (df2 + df1 * f)
+            a_l.append(np.full_like(x, df2 / 2.0))
+            b_l.append(np.full_like(x, df1 / 2.0))
+            x_l.append(x)
+    a = np.concatenate(a_l)
+    b = np.concatenate(b_l)
+    x = np.concatenate(x_l)
+    ref = np.asarray(
+        jax.scipy.special.betainc(
+            jnp.asarray(a, jnp.float64),
+            jnp.asarray(b, jnp.float64),
+            jnp.asarray(x, jnp.float64),
+        )
+    )
+    p32, lp32 = _betainc_p_and_logp_lentz(
+        jnp.asarray(a, jnp.float32),
+        jnp.asarray(b, jnp.float32),
+        jnp.asarray(x, jnp.float32),
+        iters=12,
+    )
+    p32 = np.asarray(p32, np.float64)
+    healthy = ref > 1e-30
+    rel = np.abs(p32[healthy] - ref[healthy]) / np.maximum(ref[healthy], 1e-38)
+    # same envelope the extended-grid gate holds (NY ≤ 40 gate: 2e-4)
+    assert rel.max() < 3e-4, rel.max()
+    assert np.percentile(rel, 99) < 2e-5, np.percentile(rel, 99)
+    lref = np.log(np.maximum(ref, 1e-300))
+    lperr = np.abs(np.asarray(lp32, np.float64) - lref)
+    assert np.percentile(lperr, 99) < 5e-5, np.percentile(lperr, 99)
+    assert lperr.max() < 1e-2, lperr.max()
+
+
 def test_lentz_iters_rule_covers_long_stacks():
     """The sqrt-of-dof trip rule keeps the Lentz envelope beyond NY = 40.
 

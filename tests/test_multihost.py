@@ -190,7 +190,10 @@ def test_two_process_driver_shares_tiles(tmp_path):
     summaries = [str(tmp_path / f"summary{i}.json") for i in range(2)]
     launch_pod(
         worker,
-        lambda i: ["2", str(i), workdir, summaries[i]],
+        # size=0/tile=20 defaults, telemetry=1: the pod flow doubles as the
+        # multihost telemetry acceptance run (per-process event files in
+        # the shared workdir, primary-host merge into the run summary)
+        lambda i: ["2", str(i), workdir, summaries[i], "0", "20", "1"],
         # a lost-port-race attempt may have part-written the shared workdir
         before_attempt=lambda: shutil.rmtree(workdir, ignore_errors=True),
     )
@@ -200,6 +203,21 @@ def test_two_process_driver_shares_tiles(tmp_path):
     assert [s["mesh_devices"] for s in per_proc] == [4, 4]
     assert sorted(s["pixels"] for s in per_proc) == [960, 960]  # 3 tiles each
     assert sum(s["pixels"] for s in per_proc) == 40 * 48
+
+    # telemetry: one event file per process, each schema-clean, and the
+    # primary's summary carries the merged per-host fold
+    from land_trendr_tpu.obs import events_path, validate_events_file
+
+    for i in range(2):
+        ev = events_path(workdir, i, 2)
+        assert os.path.exists(ev)
+        assert validate_events_file(ev) == []
+    hosts = per_proc[0]["telemetry"]["hosts"]
+    assert [h["process_index"] for h in hosts] == [0, 1]
+    assert all(h["status"] == "ok" for h in hosts)
+    assert sum(h["pixels"] for h in hosts) == 40 * 48
+    assert sum(h["tiles_done"] for h in hosts) == 6
+    assert "hosts" not in per_proc[1].get("telemetry", {})  # primary-only fold
 
     # assembly from the shared workdir sees ALL tiles (mesh-blind consumer)
     from land_trendr_tpu.config import LTParams

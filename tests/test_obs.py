@@ -1,0 +1,742 @@
+"""Telemetry subsystem tests: events, metrics, exporters, consumers.
+
+Covers the :mod:`land_trendr_tpu.obs` contract end to end — the
+schema-versioned JSONL event stream (round-trip + thread-safe append), the
+Prometheus text exposition (format invariants a scraper relies on), the
+file/HTTP exporters, the ``tools/check_events_schema.py`` lint and
+``tools/obs_report.py`` fold/trace consumers, the multihost per-process
+merge, and a real CPU-backend driver run with ``RunConfig.telemetry`` on.
+These run in the tier-1 suite: the event schema is a cross-PR contract
+(producer = driver, consumers = report/dashboards) and must not drift
+silently.
+"""
+
+import json
+import math
+import os
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.obs import (
+    SCHEMA_VERSION,
+    EventLog,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    PromFileExporter,
+    events_path,
+    iter_events,
+    metrics_path,
+    validate_event,
+    validate_events_file,
+)
+from land_trendr_tpu.runtime import RunConfig, run_stack, stack_from_synthetic
+from tools import check_events_schema, obs_report
+
+# ---------------------------------------------------------------------------
+# events: schema round-trip + atomic append
+# ---------------------------------------------------------------------------
+
+
+def _emit_valid_stream(log: EventLog) -> None:
+    """One schema-complete run scope, exercising every event type."""
+    log.run_start(
+        fingerprint="fp", process_index=0, process_count=1, tiles_total=2,
+        tiles_todo=2, tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+    )
+    log.emit("tile_start", tile_id=0, attempt=1)
+    log.emit(
+        "tile_done", tile_id=0, px=1024, compute_s=0.5, px_per_s=2048.0,
+        feed_backlog=1, write_backlog=0,
+    )
+    log.emit("tile_retry", tile_id=1, attempt=1, error="injected")
+    log.emit("tile_start", tile_id=1, attempt=2)
+    log.emit(
+        "tile_done", tile_id=1, px=1024, compute_s=0.25, px_per_s=4096.0,
+        feed_backlog=0, write_backlog=1, device_bytes_in_use=12345,
+    )
+    log.emit("write_done", tile_id=0, bytes=999, record_s=0.01, no_fit_rate=0.1)
+    log.emit("write_done", tile_id=1, bytes=888, record_s=0.02)
+    log.emit(
+        "run_done", status="ok", tiles_done=2, pixels=2048, wall_s=1.0,
+        px_per_s=2048.0, fit_rate=0.9, stage_s={"feed_s": 0.1},
+    )
+
+
+def test_event_schema_round_trip(tmp_path):
+    path = events_path(str(tmp_path))
+    assert path.endswith("events.jsonl")
+    with EventLog(path) as log:
+        _emit_valid_stream(log)
+    recs = list(iter_events(path))
+    assert [r["ev"] for r in recs] == [
+        "run_start", "tile_start", "tile_done", "tile_retry", "tile_start",
+        "tile_done", "write_done", "write_done", "run_done",
+    ]
+    # every event carries both clocks, stamped at emit time, non-decreasing
+    # within the stream (monotonic clock)
+    monos = [r["t_mono"] for r in recs]
+    assert all(isinstance(r["t_wall"], float) for r in recs)
+    assert monos == sorted(monos)
+    assert recs[0]["schema"] == SCHEMA_VERSION
+    assert recs[0]["pid"] == os.getpid()
+    assert validate_events_file(path) == []
+
+
+def test_validate_event_rejects_bad_records():
+    ok = {
+        "ev": "tile_start", "t_wall": 1.0, "t_mono": 2.0,
+        "tile_id": 3, "attempt": 1,
+    }
+    assert validate_event(ok) == []
+    # unknown extra fields are allowed (schema growth without a bump)
+    assert validate_event({**ok, "novel_field": "x"}) == []
+    assert validate_event({**ok, "ev": "bogus_event"})
+    assert validate_event({k: v for k, v in ok.items() if k != "tile_id"})
+    assert validate_event({**ok, "tile_id": "3"})  # wrong type
+    assert validate_event({**ok, "tile_id": True})  # bool is not an int here
+    assert validate_event([1, 2, 3])
+    # OPTIONAL numeric fields get the same bool guard as required ones
+    done = {
+        "ev": "tile_done", "t_wall": 1.0, "t_mono": 2.0, "tile_id": 0,
+        "px": 8, "compute_s": 0.1, "px_per_s": 80.0,
+        "feed_backlog": 0, "write_backlog": 0,
+    }
+    assert validate_event(done) == []
+    assert validate_event({**done, "device_bytes_in_use": 123}) == []
+    assert validate_event({**done, "device_bytes_in_use": True})
+    no_mono = {k: v for k, v in ok.items() if k != "t_mono"}
+    assert any("t_mono" in e for e in validate_event(no_mono))
+
+
+def test_validate_events_file_flags_structure(tmp_path):
+    p = tmp_path / "events.jsonl"
+    p.write_text(
+        json.dumps({"ev": "tile_start", "t_wall": 1.0, "t_mono": 1.0,
+                    "tile_id": 0, "attempt": 1}) + "\n" + "{not json\n"
+    )
+    errs = validate_events_file(str(p))
+    assert any("expected 'run_start'" in e for e in errs)
+    assert any("malformed JSON" in e for e in errs)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert validate_events_file(str(empty)) == ["file contains no events"]
+
+
+def test_event_log_thread_safe_append(tmp_path):
+    """32 threads × 50 emits: every line lands whole (no interleaving)."""
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path)
+    n_threads, n_each = 32, 50
+
+    def worker(i: int) -> None:
+        for j in range(n_each):
+            log.emit("tile_start", tile_id=i * n_each + j, attempt=1)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.close()
+    recs = list(iter_events(path))  # raises on any torn/partial JSON line
+    assert len(recs) == n_threads * n_each
+    assert {r["tile_id"] for r in recs} == set(range(n_threads * n_each))
+    with pytest.raises(ValueError, match="closed"):
+        log.emit("tile_start", tile_id=0, attempt=1)
+
+
+def test_events_path_per_process(tmp_path):
+    d = str(tmp_path)
+    assert events_path(d).endswith("events.jsonl")
+    assert events_path(d, 1, 4).endswith("events.p1.jsonl")
+    assert metrics_path(d).endswith("metrics.prom")
+    assert metrics_path(d, 2, 4).endswith("metrics.p2.prom")
+
+
+# ---------------------------------------------------------------------------
+# metrics: exposition format invariants
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_+.\"=0-9]+)*\})? (NaN|[+-]?(Inf|[0-9.e+-]+))$"
+)
+
+
+def test_prometheus_exposition_format():
+    r = MetricsRegistry()
+    c = r.counter("lt_tiles_done_total", "tiles completed")
+    g = r.gauge("lt_px_per_s", "throughput")
+    h = r.histogram("lt_tile_compute_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    c.inc()
+    c.inc(2)
+    g.set(1.5e6)
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = r.render()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    # node-exporter text format 0.0.4: HELP before TYPE, TYPE before samples,
+    # every non-comment line is a well-formed sample
+    assert lines.index("# HELP lt_tiles_done_total tiles completed") \
+        < lines.index("# TYPE lt_tiles_done_total counter")
+    assert "# TYPE lt_px_per_s gauge" in lines
+    assert "# TYPE lt_tile_compute_seconds histogram" in lines
+    for ln in lines:
+        if not ln.startswith("#"):
+            assert _SAMPLE_RE.match(ln), ln
+    # histogram contract: cumulative buckets, +Inf == count, sum exact
+    assert 'lt_tile_compute_seconds_bucket{le="0.1"} 1' in lines
+    assert 'lt_tile_compute_seconds_bucket{le="1.0"} 2' in lines
+    assert 'lt_tile_compute_seconds_bucket{le="10.0"} 3' in lines
+    assert 'lt_tile_compute_seconds_bucket{le="+Inf"} 4' in lines
+    assert "lt_tile_compute_seconds_count 4" in lines
+    [sum_ln] = [l for l in lines if l.startswith("lt_tile_compute_seconds_sum")]
+    assert math.isclose(float(sum_ln.split()[-1]), 55.55)
+    assert "lt_tiles_done_total 3.0" in lines
+
+
+def test_metrics_registry_identity_rules():
+    r = MetricsRegistry()
+    c = r.counter("lt_x_total", "help")
+    assert r.counter("lt_x_total") is c  # get-or-create on (name, labels)
+    g1 = r.gauge("lt_stage_seconds", "per stage", labels={"stage": "feed"})
+    g2 = r.gauge("lt_stage_seconds", "per stage", labels={"stage": "write"})
+    assert g1 is not g2
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("lt_x_total")
+    with pytest.raises(ValueError, match="invalid metric name"):
+        r.counter("0bad")
+    with pytest.raises(ValueError, match="invalid label name"):
+        r.counter("lt_ok_total", labels={"0bad": "v"})
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    h = r.histogram("lt_h", buckets=(1.0, 2.0))
+    assert r.histogram("lt_h", buckets=(2.0, 1.0)) is h  # order-insensitive
+    with pytest.raises(ValueError, match="different buckets"):
+        r.histogram("lt_h", buckets=(1.0, 3.0))
+    g1.set(2)
+    g1.set_max(1)  # watermark keeps the max
+    assert g1.value == 2
+    g1.set_max(5)
+    assert g1.value == 5
+    # escaping: label values with quotes/backslashes/newlines stay
+    # parseable (a raw line-feed would break the whole scrape)
+    r.gauge("lt_info", labels={"v": 'a"b\\c\nd'}).set(1)
+    assert '{v="a\\"b\\\\c\\nd"}' in r.render()
+
+
+def test_prom_file_exporter_atomic_refresh(tmp_path):
+    r = MetricsRegistry()
+    c = r.counter("lt_n_total", "n")
+    path = str(tmp_path / "metrics.prom")
+    exp = PromFileExporter(r, path, interval_s=0.05)
+    exp.start()
+    assert os.path.exists(path)  # first exposition written synchronously
+    c.inc(7)
+    exp.stop()  # final flush on stop
+    text = open(path).read()
+    assert "lt_n_total 7" in text
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    with pytest.raises(ValueError, match="interval_s"):
+        PromFileExporter(r, path, interval_s=0)
+
+
+def test_metrics_http_endpoint():
+    r = MetricsRegistry()
+    r.counter("lt_scraped_total", "n").inc(3)
+    srv = MetricsHTTPServer(r, port=0)  # ephemeral
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url) as resp:
+            assert resp.status == 200
+            assert "0.0.4" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "lt_scraped_total 3" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/other")
+    finally:
+        srv.stop()
+
+    # --metrics-host plumbing: a loopback-restricted bind still serves
+    srv = MetricsHTTPServer(r, port=0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# consumers: schema lint CLI + report/trace export
+# ---------------------------------------------------------------------------
+
+
+def test_check_events_schema_cli(tmp_path, capsys):
+    good = tmp_path / "events.jsonl"
+    with EventLog(str(good)) as log:
+        _emit_valid_stream(log)
+    assert check_events_schema.main([str(good)]) == 0
+    assert check_events_schema.main([str(tmp_path)]) == 0  # workdir form
+    assert "OK (schema v1)" in capsys.readouterr().out
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ev":"tile_done","t_wall":1.0,"t_mono":1.0}\n')
+    assert check_events_schema.main([str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "missing required field" in err
+    assert check_events_schema.main([str(tmp_path / "nope.jsonl")]) == 2
+    assert check_events_schema.main([str(tmp_path / "emptydir")]) == 2
+
+
+def test_obs_report_fold_and_trace(tmp_path, capsys):
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    with EventLog(events_path(str(wd))) as log:
+        _emit_valid_stream(log)
+    trace = str(tmp_path / "trace.json")
+    assert obs_report.main([str(wd), "--trace", trace]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["event_counts"]["tile_done"] == 2
+    assert report["pixels"] == 2048
+    assert report["retries"] == 1 and report["failures"] == 0
+    assert report["tile_compute_s"]["n"] == 2
+    assert report["max_feed_backlog"] == 1 and report["max_write_backlog"] == 1
+    assert report["stage_s"] == {"feed_s": 0.1}
+    [host] = report["hosts"]
+    assert host["status"] == "ok" and host["impl"] == "xla"
+
+    # chrome://tracing loadability: the JSON object form with traceEvents,
+    # every event a known phase with numeric non-negative timestamps
+    with open(trace) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert evs and report["trace"]["events"] == len(evs)
+    for e in evs:
+        assert e["ph"] in ("X", "i", "C", "M")
+        if e["ph"] != "M":
+            assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    names = {e["name"] for e in evs}
+    assert {"tile 0", "tile 1", "retry tile 1", "backlog"} <= names
+    # device-wait slices anchored at their tile_start, not inferred
+    slices = [e for e in evs if e["ph"] == "X" and e.get("cat") == "device-wait"]
+    assert len(slices) == 2
+
+    # schema gate: a malformed stream refuses to fold unless --no-validate
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ev":"nope","t_wall":1.0,"t_mono":1.0}\n')
+    assert obs_report.main([str(bad)]) == 1
+    capsys.readouterr()
+    assert obs_report.main([str(bad), "--no-validate"]) == 0
+
+    # --no-validate is best-effort on the post-mortem stream of a killed
+    # run: torn JSON and field-incomplete records are counted, not fatal
+    torn = tmp_path / "torn.jsonl"
+    with EventLog(str(torn)) as log:
+        log.run_start(
+            fingerprint="fp", process_index=0, process_count=1,
+            tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        log.emit("tile_done", tile_id=0, px=7, compute_s=0.1,
+                 px_per_s=70.0, feed_backlog=0, write_backlog=0)
+        log.emit("tile_done", tile_id=1)  # field-incomplete
+    with open(torn, "a") as f:
+        f.write('{"t_wall": 1.0}\n')  # parsed-but-eventless foreign line
+        f.write('{"ev":"tile_done","t_wall":1.0,"t_mo')  # torn final line
+    capsys.readouterr()
+    assert obs_report.main([str(torn), "--no-validate"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["malformed"] == 3 and rep["pixels"] == 7
+    assert None not in rep["event_counts"]
+    # a field-incomplete tile_done is malformed ALONE: not double-counted
+    # under event_counts, and no half-folded stats entries
+    assert rep["event_counts"]["tile_done"] == 1
+    assert rep["tile_compute_s"]["n"] == 1 == rep["tile_px_per_s"]["n"]
+
+
+def test_obs_report_resumed_file_last_scope_only(tmp_path, capsys):
+    """A resumed file's report aggregates describe the LAST scope only —
+    the aborted attempt's recomputed work must not double-count (same
+    semantics as ``summarize_events_file``) — while the trace keeps both
+    scopes: an abort + resume timeline is what a post-mortem wants."""
+    f = tmp_path / "events.jsonl"
+    with EventLog(str(f)) as log:
+        log.run_start(
+            fingerprint="fp", process_index=0, process_count=1,
+            tiles_total=2, tiles_todo=2, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        log.emit("tile_done", tile_id=0, px=100, compute_s=0.1,
+                 px_per_s=1000.0, feed_backlog=3, write_backlog=0)
+        log.emit("run_done", status="aborted", tiles_done=1, pixels=100,
+                 wall_s=0.2, px_per_s=500.0, fit_rate=1.0,
+                 stage_s={"feed_s": 0.5})
+        log.run_start(
+            fingerprint="fp", process_index=0, process_count=1,
+            tiles_total=2, tiles_todo=1, tiles_skipped_resume=1,
+            mesh_devices=1, impl="xla",
+        )
+        log.emit("tile_done", tile_id=1, px=60, compute_s=0.2,
+                 px_per_s=300.0, feed_backlog=1, write_backlog=1)
+        log.emit("run_done", status="ok", tiles_done=1, pixels=60,
+                 wall_s=0.3, px_per_s=200.0, fit_rate=1.0,
+                 stage_s={"feed_s": 0.1})
+    trace = str(tmp_path / "tr.json")
+    assert obs_report.main([str(f), "--trace", trace]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["pixels"] == 60  # NOT 160: the aborted scope is history
+    assert rep["event_counts"]["tile_done"] == 1
+    assert rep["stage_s"] == {"feed_s": 0.1}
+    assert rep["tile_compute_s"]["n"] == 1
+    assert rep["max_feed_backlog"] == 1  # last scope's backlog, not the abort's
+    [host] = rep["hosts"]
+    assert host["status"] == "ok"
+    with open(trace) as fh:
+        names = {e["name"] for e in json.load(fh)["traceEvents"]}
+    assert {"tile 0", "tile 1"} <= names  # the trace keeps BOTH scopes
+
+
+def test_discover_event_files_recovers_pod_shape(tmp_path):
+    """Without ``process_count``, p0's latest ``run_start`` declares the
+    shape: stale p-files from a previous LARGER pod run are excluded for
+    the post-hoc consumers, not just the driver's merge."""
+    from land_trendr_tpu.obs import discover_event_files
+
+    wd = str(tmp_path)
+    for pi in range(4):  # previous 4-host run
+        with EventLog(events_path(wd, pi, 4)) as log:
+            log.run_start(
+                fingerprint="old", process_index=pi, process_count=4,
+                tiles_total=4, tiles_todo=1, tiles_skipped_resume=0,
+                mesh_devices=1, impl="xla",
+            )
+    for pi in range(2):  # workdir reused by a 2-host run
+        with EventLog(events_path(wd, pi, 2)) as log:
+            log.run_start(
+                fingerprint="new", process_index=pi, process_count=2,
+                tiles_total=2, tiles_todo=1, tiles_skipped_resume=0,
+                mesh_devices=1, impl="xla",
+            )
+    got = [os.path.basename(p) for p in discover_event_files(wd)]
+    assert got == ["events.p0.jsonl", "events.p1.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# multihost merge
+# ---------------------------------------------------------------------------
+
+
+def test_merge_host_event_logs(tmp_path):
+    from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+
+    wd = str(tmp_path)
+    for pi in range(2):
+        with EventLog(events_path(wd, pi, 2)) as log:
+            log.run_start(
+                fingerprint="fp", process_index=pi, process_count=2,
+                tiles_total=4, tiles_todo=2, tiles_skipped_resume=0,
+                mesh_devices=1, impl="xla",
+            )
+            for t in range(2):
+                tid = pi * 2 + t
+                log.emit(
+                    "tile_done", tile_id=tid, px=100, compute_s=0.1,
+                    px_per_s=1000.0, feed_backlog=0, write_backlog=0,
+                )
+            if pi == 1:
+                log.emit("tile_retry", tile_id=3, attempt=1, error="x")
+            log.emit(
+                "run_done", status="ok", tiles_done=2, pixels=200,
+                wall_s=0.5, px_per_s=400.0, fit_rate=1.0,
+            )
+    hosts = merge_host_event_logs(wd, expect_hosts=2)
+    assert [h["process_index"] for h in hosts] == [0, 1]
+    assert all(h["status"] == "ok" for h in hosts)
+    assert sum(h["pixels"] for h in hosts) == 400
+    assert hosts[1]["tile_retries"] == 1 and hosts[0]["tile_retries"] == 0
+
+    # a stale single-process events.jsonl in the reused shared workdir is
+    # NOT a host: it must neither satisfy expect_hosts nor join the fold
+    with EventLog(events_path(wd)) as stale:
+        stale.run_start(
+            fingerprint="old", process_index=0, process_count=1,
+            tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        stale.emit(
+            "run_done", status="ok", tiles_done=1, pixels=50,
+            wall_s=0.1, px_per_s=500.0, fit_rate=1.0,
+        )
+    hosts = merge_host_event_logs(wd, expect_hosts=2)
+    assert len(hosts) == 2 and sum(h["pixels"] for h in hosts) == 400
+
+    # stale p-files from a previous LARGER pod run (workdir reused after
+    # resizing 4 -> 2 hosts) are dead streams, not hosts
+    with EventLog(events_path(wd, 2, 4)) as ghost:
+        ghost.run_start(
+            fingerprint="old4", process_index=2, process_count=4,
+            tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        ghost.emit(
+            "run_done", status="ok", tiles_done=1, pixels=25,
+            wall_s=0.1, px_per_s=250.0, fit_rate=1.0,
+        )
+    hosts = merge_host_event_logs(wd, expect_hosts=2)
+    assert [h["process_index"] for h in hosts] == [0, 1]
+    assert sum(h["pixels"] for h in hosts) == 400
+
+    # a resumed peer mid-stream: its file still carries the PREVIOUS
+    # scope's run_done, but a run_start after it means "not terminal" —
+    # the primary must keep waiting, then fold the partial scope
+    with EventLog(events_path(wd, 1, 2)) as log:
+        log.run_start(
+            fingerprint="fp2", process_index=1, process_count=2,
+            tiles_total=4, tiles_todo=2, tiles_skipped_resume=2,
+            mesh_devices=1, impl="xla",
+        )
+    stale_scope = merge_host_event_logs(
+        wd, expect_hosts=2, timeout_s=0.3, poll_s=0.05
+    )
+    assert stale_scope[1]["status"] is None  # waited, then partial fold
+
+    # bounded wait: a missing peer yields a partial merge, not a hang
+    os.remove(events_path(wd))
+    os.remove(events_path(wd, 1, 2))
+    partial = merge_host_event_logs(wd, expect_hosts=2, timeout_s=0.3, poll_s=0.05)
+    assert len(partial) == 1
+
+
+def test_merge_host_event_logs_stale_peer_file(tmp_path):
+    """``newer_than``: a reused workdir's peer file untouched since the
+    current run began holds only a PREVIOUS scope — its old ``run_done``
+    must not satisfy the wait, and its summary is flagged ``stale``."""
+    import time
+
+    from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+
+    wd = str(tmp_path)
+    for pi in range(2):
+        with EventLog(events_path(wd, pi, 2)) as log:
+            log.run_start(
+                fingerprint="fp", process_index=pi, process_count=2,
+                tiles_total=2, tiles_todo=1, tiles_skipped_resume=0,
+                mesh_devices=1, impl="xla",
+            )
+            log.emit(
+                "run_done", status="ok", tiles_done=1, pixels=100,
+                wall_s=0.1, px_per_s=1000.0, fit_rate=1.0,
+            )
+    # peer 1 "died before this run's run_start": its stream predates the run
+    past = time.time() - 1000.0
+    os.utime(events_path(wd, 1, 2), (past, past))
+    hosts = merge_host_event_logs(
+        wd, expect_hosts=2, timeout_s=0.3, poll_s=0.05,
+        newer_than=time.time() - 500.0,
+    )
+    assert len(hosts) == 2
+    assert "stale" not in hosts[0]
+    assert hosts[1].get("stale") is True  # previous-scope fold, marked
+    # without the cutoff the tail probe alone cannot tell, and the old
+    # run_done passes for a live host — the behavior the guard exists for
+    hosts = merge_host_event_logs(wd, expect_hosts=2)
+    assert "stale" not in hosts[1]
+
+
+def test_telemetry_init_unwinds_on_bind_failure(tmp_path):
+    """A taken --metrics-port must not leak the exporter thread / event fd."""
+    import socket
+
+    from land_trendr_tpu.obs import Telemetry
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        s.listen(1)
+        port = s.getsockname()[1]
+        with pytest.raises(OSError):
+            Telemetry(str(tmp_path), metrics_port=port)
+    assert not any(
+        t.name == "lt-metrics-exporter" for t in threading.enumerate()
+    )
+
+
+def test_trace_process_labels_follow_file_order(tmp_path):
+    """process_name metadata must share the spans' pid keying (file order),
+    even when files are given in an order that disagrees with their
+    recorded process_index."""
+    for pi in range(2):
+        with EventLog(events_path(str(tmp_path), pi, 2)) as log:
+            log.run_start(
+                fingerprint="fp", process_index=pi, process_count=2,
+                tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+                mesh_devices=1, impl="xla",
+            )
+            log.emit("tile_start", tile_id=pi, attempt=1)
+            log.emit(
+                "tile_done", tile_id=pi, px=10, compute_s=0.1,
+                px_per_s=100.0, feed_backlog=0, write_backlog=0,
+            )
+            log.emit(
+                "run_done", status="ok", tiles_done=1, pixels=10,
+                wall_s=0.2, px_per_s=50.0, fit_rate=1.0,
+            )
+    # deliberately reversed: file 0 = proc 1's stream
+    report, spans = obs_report.fold(
+        [events_path(str(tmp_path), 1, 2), events_path(str(tmp_path), 0, 2)]
+    )
+    out = tmp_path / "trace.json"
+    obs_report.export_trace(spans, report["hosts"], str(out))
+    evs = json.load(open(out))["traceEvents"]
+    labels = {
+        e["pid"]: e["args"]["name"]
+        for e in evs if e.get("name") == "process_name"
+    }
+    slice_pids = {
+        e["pid"]: e["name"]
+        for e in evs if e["ph"] == "X"
+    }
+    # file 0 carries proc 1's events → pid 0's label says proc 1 and pid
+    # 0's slice is tile 1 (proc 1's tile): label and spans agree
+    assert labels[0] == "proc 1 @ " + report["hosts"][0]["host"]
+    assert slice_pids[0] == "tile 1"
+    assert labels[1].startswith("proc 0")
+    assert slice_pids[1] == "tile 0"
+
+
+# ---------------------------------------------------------------------------
+# driver integration: RunConfig.telemetry through run_stack
+# ---------------------------------------------------------------------------
+
+SPEC = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+@pytest.fixture(scope="module")
+def rstack():
+    return stack_from_synthetic(make_stack(SPEC))
+
+
+def make_cfg(tmp, **kw):
+    kw.setdefault("params", PARAMS)
+    kw.setdefault("tile_size", 32)
+    return RunConfig(
+        workdir=os.path.join(tmp, "work"), out_dir=os.path.join(tmp, "out"), **kw
+    )
+
+
+def test_runconfig_telemetry_validation(tmp_path):
+    with pytest.raises(ValueError, match="metrics_port requires telemetry"):
+        make_cfg(str(tmp_path), metrics_port=0)
+    with pytest.raises(ValueError, match="outside 0..65535"):
+        make_cfg(str(tmp_path), telemetry=True, metrics_port=70000)
+    with pytest.raises(ValueError, match="metrics_interval_s"):
+        make_cfg(str(tmp_path), telemetry=True, metrics_interval_s=0)
+    with pytest.raises(ValueError, match="metrics_host requires metrics_port"):
+        make_cfg(str(tmp_path), telemetry=True, metrics_host="127.0.0.1")
+
+
+def test_driver_telemetry_end_to_end(tmp_path, rstack):
+    """A real (CPU-backend) telemetry run: valid events, well-formed
+    exposition, live /metrics endpoint, summary pointers."""
+    cfg = make_cfg(str(tmp_path), telemetry=True, metrics_port=0)
+    summary = run_stack(rstack, cfg)
+    tel = summary["telemetry"]
+    assert tel["events"] == events_path(cfg.workdir)
+    assert tel["metrics"] == metrics_path(cfg.workdir)
+    assert isinstance(tel["metrics_port"], int)  # ephemeral port was bound
+
+    # every event validates; lifecycle is complete and consistent
+    assert validate_events_file(tel["events"]) == []
+    recs = list(iter_events(tel["events"]))
+    by_ev = {}
+    for r in recs:
+        by_ev.setdefault(r["ev"], []).append(r)
+    assert len(by_ev["run_start"]) == 1
+    assert len(by_ev["tile_done"]) == summary["tiles"] == 4
+    assert len(by_ev["write_done"]) == 4
+    assert {r["tile_id"] for r in by_ev["tile_done"]} == set(range(4))
+    assert sum(r["px"] for r in by_ev["tile_done"]) == summary["pixels"]
+    [done] = by_ev["run_done"]
+    assert done["status"] == "ok" and done["pixels"] == summary["pixels"]
+    assert set(done["stage_s"]) >= {"feed_s", "compute_s", "write_s"}
+    # write_done events carry the per-tile quality metadata the manifest has
+    assert all("no_fit_rate" in r for r in by_ev["write_done"])
+
+    # the final exposition flush reflects the whole run
+    text = open(tel["metrics"]).read()
+    assert "lt_tiles_done_total 4" in text
+    assert f"lt_pixels_total {summary['pixels']}" in text
+    assert "lt_tile_compute_seconds_count 4" in text
+    assert 'lt_run_info{fingerprint="' in text
+    assert 'lt_stage_seconds{stage="compute"}' in text
+
+    # events fold into a clean report + trace (the acceptance path)
+    report, spans = obs_report.fold([tel["events"]])
+    assert report["event_counts"]["run_done"] == 1
+    trace = os.path.join(str(tmp_path), "trace.json")
+    assert obs_report.export_trace(spans, report["hosts"], trace) > 0
+    json.load(open(trace))
+
+
+def test_driver_telemetry_retry_and_abort_events(tmp_path, rstack, monkeypatch):
+    from land_trendr_tpu.ops.tile import process_tile_dn as real_op
+
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient fault")
+        return real_op(*a, **k)
+
+    monkeypatch.setattr("land_trendr_tpu.runtime.driver.process_tile_dn", flaky)
+    cfg = make_cfg(str(tmp_path), telemetry=True, max_retries=2)
+    run_stack(rstack, cfg)
+    ev_file = events_path(cfg.workdir)
+    assert validate_events_file(ev_file) == []
+    recs = list(iter_events(ev_file))
+    retries = [r for r in recs if r["ev"] == "tile_retry"]
+    assert len(retries) == 1 and "transient fault" in retries[0]["error"]
+    # the retried tile re-announces with attempt=2
+    assert any(
+        r["ev"] == "tile_start" and r["attempt"] == 2
+        and r["tile_id"] == retries[0]["tile_id"] for r in recs
+    )
+
+    # hard abort: stream terminates with run_done status="aborted"
+    def boom(*a, **k):
+        raise RuntimeError("injected device fault")
+
+    monkeypatch.setattr("land_trendr_tpu.runtime.driver.process_tile_dn", boom)
+    cfg2 = make_cfg(os.path.join(str(tmp_path), "abort"), telemetry=True,
+                    max_retries=0)
+    with pytest.raises(RuntimeError, match="failed after"):
+        run_stack(rstack, cfg2)
+    recs2 = list(iter_events(events_path(cfg2.workdir)))
+    assert validate_events_file(events_path(cfg2.workdir)) == []
+    assert recs2[-1]["ev"] == "run_done" and recs2[-1]["status"] == "aborted"
+    assert any(r["ev"] == "tile_failed" for r in recs2)
+    # exporters shut down on the abort path too: final exposition exists
+    assert os.path.exists(metrics_path(cfg2.workdir))
+
+
+def test_driver_telemetry_resume_appends_new_scope(tmp_path, rstack):
+    cfg = make_cfg(str(tmp_path), telemetry=True)
+    run_stack(rstack, cfg)
+    summary = run_stack(rstack, cfg)  # resume: all tiles done
+    assert summary["tiles_skipped_resume"] == 4
+    ev_file = events_path(cfg.workdir)
+    assert validate_events_file(ev_file) == []
+    starts = [r for r in iter_events(ev_file) if r["ev"] == "run_start"]
+    assert len(starts) == 2  # one scope per run, appended to the same file
+    assert starts[1]["tiles_skipped_resume"] == 4 and starts[1]["tiles_todo"] == 0

@@ -174,6 +174,25 @@ def test_c2_uint16_sr_preserved(tmp_path):
     np.testing.assert_allclose(sr, 43636 * 2.75e-5 - 0.2, rtol=1e-5)  # ~1.0
 
 
+def test_c2_qa_dtype_whitelist_both_loaders(tmp_path, scene):
+    """A wider-than-uint16 QA_PIXEL file must error loudly in BOTH the
+    eager and the lazy loader — a blind uint16 cast silently truncates
+    the CFMask bit flags (ADVICE round 5; loaders must not diverge)."""
+    from land_trendr_tpu.io.geotiff import write_geotiff
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    d = str(tmp_path / "wide_qa")
+    write_stack_c2(d, scene)
+    qa = next(n for n in os.listdir(d) if "QA_PIXEL" in n)
+    write_geotiff(
+        os.path.join(d, qa), np.zeros((12, 16), dtype=np.uint32)
+    )
+    with pytest.raises(ValueError, match="QA_PIXEL dtype"):
+        load_stack_dir_c2(d)
+    with pytest.raises(ValueError, match="QA_PIXEL dtype"):
+        open_stack_dir_c2_lazy(d)
+
+
 def test_c2_rt_tier_accepted(tmp_path, scene):
     """The USGS RT (real-time) collection tier must not silently vanish."""
     d = str(tmp_path / "rt")

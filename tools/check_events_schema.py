@@ -1,0 +1,69 @@
+"""Lint event-log files against the obs event schema (CI seam).
+
+Validates one or more ``events.jsonl`` files (or workdirs containing them)
+against :data:`land_trendr_tpu.obs.events.EVENT_FIELDS` at the current
+:data:`~land_trendr_tpu.obs.events.SCHEMA_VERSION`: every line parses,
+every event is a known type with its required fields at the right types,
+and the stream opens with ``run_start``.  Exit 0 = all clean, 1 = schema
+errors (listed on stderr), 2 = usage/IO error.
+
+This is the guard that keeps producer (driver) and consumers
+(``obs_report``, dashboards) honest about the JSONL contract — wired into
+the tier-1 test run as a fast test (``tests/test_obs.py``), and runnable
+against any run's workdir:
+
+    python tools/check_events_schema.py lt_work/
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from land_trendr_tpu.obs.events import (  # noqa: E402
+    SCHEMA_VERSION,
+    expand_event_paths,
+    validate_events_file,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="events.jsonl files, or workdirs containing them")
+    ap.add_argument("--max-errors", type=int, default=20,
+                    help="cap per-file error listing (all are counted)")
+    args = ap.parse_args(argv)
+
+    try:
+        # the shared expansion contract (land_trendr_tpu.obs): pod
+        # per-process files win over a stale events.jsonl, identically
+        # for this lint and for obs_report
+        files = expand_event_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    n_bad = 0
+    for path in files:
+        errs = validate_events_file(path)
+        if errs:
+            n_bad += 1
+            for e in errs[: args.max_errors]:
+                print(f"{path}: {e}", file=sys.stderr)
+            if len(errs) > args.max_errors:
+                print(
+                    f"{path}: ... and {len(errs) - args.max_errors} more",
+                    file=sys.stderr,
+                )
+        else:
+            print(f"{path}: OK (schema v{SCHEMA_VERSION})")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,12 +121,14 @@ def cmd_gen(args) -> dict:
     for r0 in range(0, size, band_rows):
         h = min(band_rows, size - r0)
         crows = slice(r0 // cell, (r0 + h + cell - 1) // cell)
+        # both fields get the SAME intra-cell row-offset slice — slicing
+        # only dist would misalign the pair whenever band_rows % cell != 0
         dist = np.kron(cell_dist[crows], np.ones((cell, cell), bool))[
-            r0 % cell or 0 :, :
+            r0 % cell :, :
         ][:h, :size]
         dyear = np.kron(cell_year[crows], np.ones((cell, cell), np.int64))[
-            :h, :size
-        ]
+            r0 % cell :, :
+        ][:h, :size]
         brng = np.random.default_rng(r0)
         # noise quantized to 32-DN steps (0.00088 reflectance — well below
         # the disturbance signal, far above f32 rounding): the deflate

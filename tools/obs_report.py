@@ -1,0 +1,344 @@
+"""Fold driver event logs into a per-stage report + chrome://tracing export.
+
+The post-hoc consumer of the :mod:`land_trendr_tpu.obs` event stream: give
+it one or more ``events.jsonl`` files (several = one multihost run's
+per-process files) and it emits
+
+* a JSON **report** on stdout — per-event-type counts, tile compute-latency
+  and px/s distributions, retry/failure totals, backlog-depth maxima, the
+  run_done stage split, and per-host rollups;
+* with ``--trace OUT.json``, a **Chrome trace-event file** (the
+  ``chrome://tracing`` / Perfetto JSON array format): per-tile device-wait
+  and artifact-write slices, retry instants, and backlog counter tracks,
+  one trace "process" per event file — so the driver's host-side phases
+  line up next to the device traces ``utils/profiling.trace`` captures.
+
+Timeline construction: every event carries wall + monotonic clocks; each
+run scope (a ``run_start`` and what follows it) anchors its monotonic
+clock to its ``run_start`` wall time, so durations stay
+monotonic-accurate while multiple processes align on the wall clock.
+
+Usage:
+    python tools/obs_report.py WORKDIR | EVENTS.jsonl ... [--trace out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from land_trendr_tpu.obs.events import (  # noqa: E402
+    expand_event_paths,
+    validate_events_file,
+)
+
+_US = 1e6  # trace-event timestamps are microseconds
+
+
+def _stats(values: list[float]) -> dict | None:
+    if not values:
+        return None
+    v = sorted(values)
+
+    def q(p: float) -> float:
+        return v[min(len(v) - 1, int(p * len(v)))]
+
+    return {
+        "n": len(v),
+        "min": round(v[0], 6),
+        "p50": round(q(0.50), 6),
+        "mean": round(sum(v) / len(v), 6),
+        "p95": round(q(0.95), 6),
+        "max": round(v[-1], 6),
+    }
+
+
+def _wall_anchored(scopes: list[dict], rec: dict) -> float:
+    """Event time on the shared wall axis, with monotonic-clock accuracy.
+
+    Uses the current run scope's (wall, mono) anchor pair; events before
+    any ``run_start`` (malformed streams) fall back to their own wall time.
+    """
+    if scopes:
+        a = scopes[-1]
+        return a["t_wall"] + (rec["t_mono"] - a["t_mono"])
+    return rec.get("t_wall", 0.0)
+
+
+def _iter_tolerant(path: str):
+    """Yield parsed records; a torn/malformed line yields None, not a crash.
+
+    The post-mortem stream of a killed run — exactly what this tool
+    inspects — routinely ends in a torn line; ``--no-validate`` promises a
+    best-effort fold of it.
+    """
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                yield None
+
+
+def _fresh_scope() -> dict:
+    return {
+        "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
+        "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
+        "retries": 0, "failures": 0, "stage_s": {},
+    }
+
+
+def fold(paths: list[str]) -> tuple[dict, list[dict]]:
+    """Parse event files → (report dict, flat trace-source records).
+
+    The report aggregates describe each file's LAST run scope — a resumed
+    file's aborted earlier attempt must not double-count pixels or skew
+    the latency distributions (the same "most recent run" semantics as
+    ``summarize_events_file``, the run-summary consumer of this stream).
+    The TRACE keeps every scope: the timeline of an abort + resume is
+    exactly what a post-mortem wants to see.
+
+    Trace-source records carry absolute wall-anchored times; the exporter
+    rebases them to the earliest event so trace timestamps start near 0.
+    Malformed lines and field-incomplete records are counted
+    (``malformed``), never fatal.
+    """
+    malformed = 0
+    hosts: list[dict] = []
+    spans: list[dict] = []   # trace-source records, ALL scopes
+    folded: list[dict] = []  # each file's LAST scope aggregate
+
+    for fileno, path in enumerate(paths):
+        scopes: list[dict] = []
+        cur = _fresh_scope()
+        host_info: dict = {"events_file": path, "process_index": fileno}
+        starts: dict[int, float] = {}  # tile_id -> wall-anchored start
+        for rec in _iter_tolerant(path):
+            if not isinstance(rec, dict) or not isinstance(rec.get("ev"), str):
+                # torn/foreign JSON that still parsed (e.g. a truncated
+                # prefix that happens to be valid) is malformed, not an
+                # event type of its own
+                malformed += 1
+                continue
+            ev = rec["ev"]
+            # required fields are read into locals FIRST, aggregates
+            # mutated only after they all resolved: a field-incomplete
+            # record must count as malformed alone, never half-fold (a
+            # tile_done missing px_per_s must not leave its compute_s in
+            # the stats and be double-counted under event_counts too)
+            try:
+                tw = _wall_anchored(scopes, rec)
+                if ev == "run_start":
+                    t_wall, t_mono = rec["t_wall"], rec["t_mono"]
+                    scopes.append({"t_wall": t_wall, "t_mono": t_mono})
+                    tw = t_wall
+                    cur = _fresh_scope()  # aggregates describe the LAST scope
+                    starts.clear()
+                    host_info.update(
+                        process_index=rec.get("process_index", fileno),
+                        host=rec.get("host"),
+                        pid=rec.get("pid"),
+                        impl=rec.get("impl"),
+                        mesh_devices=rec.get("mesh_devices"),
+                        # a previous scope's run_done must not leak into
+                        # this scope's rollup (summarize_events_file
+                        # resets these identically)
+                        status=None,
+                        wall_s=None,
+                        px_per_s=None,
+                    )
+                elif ev == "tile_start":
+                    starts[rec["tile_id"]] = tw
+                elif ev == "tile_done":
+                    tile_id, c_s, pps = rec["tile_id"], rec["compute_s"], rec["px_per_s"]
+                    cur["compute_s"].append(c_s)
+                    cur["px_per_s"].append(pps)
+                    cur["pixels"] += rec.get("px", 0)
+                    cur["max_feed_backlog"] = max(
+                        cur["max_feed_backlog"], rec.get("feed_backlog", 0)
+                    )
+                    cur["max_write_backlog"] = max(
+                        cur["max_write_backlog"], rec.get("write_backlog", 0)
+                    )
+                    t0 = starts.pop(tile_id, tw - c_s)
+                    spans.append({
+                        "kind": "slice", "file": fileno, "tid": "device-wait",
+                        "name": f"tile {tile_id}", "t0": t0,
+                        "dur": max(c_s, tw - t0),
+                        "args": {"px": rec.get("px"), "px_per_s": pps},
+                    })
+                    spans.append({
+                        "kind": "counter", "file": fileno, "t0": tw,
+                        "name": "backlog",
+                        "args": {
+                            "feed": rec.get("feed_backlog", 0),
+                            "write": rec.get("write_backlog", 0),
+                        },
+                    })
+                elif ev == "write_done":
+                    tile_id, r_s = rec["tile_id"], rec["record_s"]
+                    cur["record_s"].append(r_s)
+                    spans.append({
+                        "kind": "slice", "file": fileno, "tid": "write",
+                        "name": f"tile {tile_id}",
+                        "t0": tw - r_s, "dur": r_s,
+                        "args": {"bytes": rec.get("bytes")},
+                    })
+                elif ev == "tile_retry":
+                    tile_id = rec["tile_id"]
+                    cur["retries"] += 1
+                    spans.append({
+                        "kind": "instant", "file": fileno, "tid": "device-wait",
+                        "name": f"retry tile {tile_id}", "t0": tw,
+                        "args": {"error": rec.get("error")},
+                    })
+                elif ev == "tile_failed":
+                    tile_id = rec["tile_id"]
+                    cur["failures"] += 1
+                    spans.append({
+                        "kind": "instant", "file": fileno, "tid": "device-wait",
+                        "name": f"FAILED tile {tile_id}", "t0": tw,
+                        "args": {"error": rec.get("error")},
+                    })
+                elif ev == "run_done":
+                    host_info.update(
+                        status=rec.get("status"), wall_s=rec.get("wall_s"),
+                        px_per_s=rec.get("px_per_s"),
+                    )
+                    for k, v in (rec.get("stage_s") or {}).items():
+                        cur["stage_s"][k] = cur["stage_s"].get(k, 0.0) + v
+            except (KeyError, TypeError):
+                # a field-incomplete record (torn write, foreign schema)
+                # must not kill a post-mortem fold
+                malformed += 1
+            else:
+                cur["counts"][ev] = cur["counts"].get(ev, 0) + 1
+        hosts.append(host_info)
+        folded.append(cur)
+
+    # cross-file merge of each file's last scope
+    counts: dict[str, int] = {}
+    stage_s: dict[str, float] = {}
+    for c in folded:
+        for k, v in c["counts"].items():
+            counts[k] = counts.get(k, 0) + v
+        for k, v in c["stage_s"].items():
+            stage_s[k] = stage_s.get(k, 0.0) + v
+    report = {
+        "files": len(paths),
+        "event_counts": counts,
+        "pixels": sum(c["pixels"] for c in folded),
+        "malformed": malformed,
+        "tile_compute_s": _stats([v for c in folded for v in c["compute_s"]]),
+        "tile_px_per_s": _stats([v for c in folded for v in c["px_per_s"]]),
+        "tile_record_s": _stats([v for c in folded for v in c["record_s"]]),
+        "retries": sum(c["retries"] for c in folded),
+        "failures": sum(c["failures"] for c in folded),
+        "max_feed_backlog": max((c["max_feed_backlog"] for c in folded), default=0),
+        "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
+        "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
+        "hosts": hosts,
+    }
+    return report, spans
+
+
+def export_trace(spans: list[dict], hosts: list[dict], out_path: str) -> int:
+    """Write the chrome://tracing JSON; returns the number of trace events."""
+    if spans:
+        t_base = min(s["t0"] for s in spans)
+    else:
+        t_base = 0.0
+    events: list[dict] = []
+    tids: dict[tuple[int, str], int] = {}
+
+    def tid_of(fileno: int, name: str) -> int:
+        key = (fileno, name)
+        if key not in tids:
+            tids[key] = len([k for k in tids if k[0] == fileno]) + 1
+            events.append({
+                "ph": "M", "pid": fileno, "tid": tids[key],
+                "name": "thread_name", "args": {"name": name},
+            })
+        return tids[key]
+
+    # spans are keyed by file ordinal, so the process_name metadata must
+    # be too — hosts[] is built in file order, and a file's recorded
+    # process_index (shown in the label) need not match its ordinal
+    for fileno, h in enumerate(hosts):
+        label = f"proc {h.get('process_index', fileno)}"
+        if h.get("host"):
+            label += f" @ {h['host']}"
+        events.append({
+            "ph": "M", "pid": fileno, "tid": 0,
+            "name": "process_name", "args": {"name": label},
+        })
+    for s in spans:
+        ts = (s["t0"] - t_base) * _US
+        if s["kind"] == "slice":
+            events.append({
+                "ph": "X", "pid": s["file"], "tid": tid_of(s["file"], s["tid"]),
+                "name": s["name"], "cat": s["tid"], "ts": ts,
+                "dur": max(s["dur"], 0.0) * _US, "args": s.get("args", {}),
+            })
+        elif s["kind"] == "instant":
+            events.append({
+                "ph": "i", "pid": s["file"], "tid": tid_of(s["file"], s["tid"]),
+                "name": s["name"], "cat": "retry", "ts": ts, "s": "t",
+                "args": s.get("args", {}),
+            })
+        elif s["kind"] == "counter":
+            events.append({
+                "ph": "C", "pid": s["file"], "tid": 0, "name": s["name"],
+                "ts": ts, "args": s.get("args", {}),
+            })
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="events.jsonl files, or workdirs containing them")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also export a chrome://tracing / Perfetto trace")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip the schema lint pass (malformed streams "
+                    "still fold best-effort)")
+    args = ap.parse_args(argv)
+
+    try:
+        paths = expand_event_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.no_validate:
+        all_errs = {p: validate_events_file(p) for p in paths}
+        bad = {p: e for p, e in all_errs.items() if e}
+        if bad:
+            for p, errs in bad.items():
+                for e in errs[:10]:
+                    print(f"{p}: {e}", file=sys.stderr)
+            print("error: schema validation failed (use --no-validate to "
+                  "fold anyway)", file=sys.stderr)
+            return 1
+    report, spans = fold(paths)
+    if args.trace:
+        report["trace"] = {
+            "path": args.trace,
+            "events": export_trace(spans, report["hosts"], args.trace),
+        }
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
