@@ -124,6 +124,20 @@ def build_parser() -> argparse.ArgumentParser:
                      help="background tile-feed threads over the threaded "
                      "native gather (~4.1M px/s each; ~3 sustain the 10M "
                      "px/s target); prefetch depth is feed_workers+1")
+    seg.add_argument("--feed-cache-mb", type=int, default=256,
+                     help="decoded-block cache budget (MiB) for the "
+                     "windowed feed path: tile windows that revisit a "
+                     "compressed TIFF block (tile edges, --lazy re-reads, "
+                     "resume passes) decode it once; 0 disables the cache "
+                     "and reproduces the uncached codec byte for byte")
+    seg.add_argument("--decode-workers", type=int, default=0,
+                     help="feed-decode threads (native codec AND the NumPy "
+                     "fallback share this knob): 0 = auto, 1 = serial, "
+                     "N = N threads")
+    seg.add_argument("--no-feed-readahead", action="store_true",
+                     help="disable the feed pool's next-tile block-decode "
+                     "hint (only meaningful with --lazy and a non-zero "
+                     "--feed-cache-mb)")
     seg.add_argument("--change", action="store_true",
                      help="fuse on-device change-map selection into every "
                      "tile's program; change_*.tif rasters assemble "
@@ -576,6 +590,9 @@ def main(argv: list[str] | None = None) -> int:
                 manifest_compress=args.manifest_compress,
                 write_workers=args.write_workers,
                 feed_workers=args.feed_workers,
+                feed_cache_mb=args.feed_cache_mb,
+                decode_workers=args.decode_workers,
+                feed_readahead=not args.no_feed_readahead,
                 impl=args.impl,
                 change_filt=change_filt,
                 out_overviews=args.out_overviews,

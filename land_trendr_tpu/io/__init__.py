@@ -1,5 +1,7 @@
-"""io subpackage: host-side raster I/O (GeoTIFF codec, synthetic stacks)."""
+"""io subpackage: host-side raster I/O (GeoTIFF codec, synthetic stacks,
+decoded-block cache + shared decode pool for the feed path)."""
 
+from land_trendr_tpu.io import blockcache
 from land_trendr_tpu.io.geotiff import (
     GeoMeta,
     GeoTiffStreamWriter,
@@ -12,6 +14,7 @@ from land_trendr_tpu.io.geotiff import (
 from land_trendr_tpu.io.synthetic import SceneSpec, SyntheticStack, make_stack, write_stack
 
 __all__ = [
+    "blockcache",
     "GeoMeta",
     "TiffInfo",
     "GeoTiffStreamWriter",

@@ -1,0 +1,321 @@
+"""Decoded-block cache + shared decode pool for the GeoTIFF feed path.
+
+On the r05 gigapixel resume run the host feed stage was the dominant
+non-compute cost (GIGA_r05.json ``stage_s``: feed 18.96s of 56.9s wall):
+every tile window re-decoded the compressed TIFF blocks straddling tile
+boundaries — once per band, serially, under a single feed worker.  The
+massively-parallel break-detection literature (arXiv:1807.01751) names
+exactly this host decode/feed stage as the scaling limiter once the
+fitting kernel is fast.  This module is the process-wide answer, used by
+:mod:`land_trendr_tpu.io.geotiff` window reads:
+
+* a **decoded-block LRU cache** keyed by
+  ``(path, mtime_ns, size, page, block_index)`` with a configurable byte
+  budget — a block revisited by an overlapping window, a
+  ``LazyBandCube`` re-read, or a resume pass decodes once;
+* a **shared decode thread pool**: zlib releases the GIL, so the blocks
+  of one window decode concurrently (the native codec threads in C++
+  instead — the same ``decode_workers`` knob governs both paths);
+* **readahead**: the driver's feed pool hints the next planned tile's
+  windows (:func:`prefetch_window`), so their blocks decode into the
+  cache while the current tile waits on the device;
+* **stats** (:func:`stats_snapshot` / :func:`stats_delta`): hits,
+  misses, evictions, decode seconds, readahead effectiveness — exported
+  through the run telemetry (``feed_cache`` event + ``lt_feed_*``
+  Prometheus metrics) and surfaced by ``tools/obs_report.py``.
+
+Unconfigured (the import-time default: budget 0, workers ``None``) the
+module is inert and the codec behaves exactly as before — no cache, the
+native path auto-threads, the NumPy path decodes serially.  The driver
+configures it from ``RunConfig.feed_cache_mb`` / ``decode_workers``;
+``feed_cache_mb=0`` reproduces the uncached behavior byte for byte
+(cached and uncached reads are byte-identical either way — the cache
+stores fully decoded, un-predicted blocks, so it is pure memoization).
+
+Thread-safety: one module lock guards the cache map and the counters;
+entries are immutable by convention (every consumer only reads slices).
+A decode task spawned by :func:`prefetch_window` runs ON the shared
+pool, so window reads inside a readahead task decode serially
+(:func:`decode_pool` returns ``None`` there) — submitting pool work
+from a pool task and waiting on it would deadlock a saturated pool.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    import numpy as np
+
+__all__ = [
+    "configure",
+    "cache_enabled",
+    "cache_get",
+    "cache_put",
+    "cache_clear",
+    "decode_threads",
+    "decode_pool",
+    "file_key",
+    "note_decode_seconds",
+    "prefetch_window",
+    "stats_snapshot",
+    "stats_delta",
+]
+
+#: cap for ``decode_workers=0`` (auto): feed decode shares the host with
+#: the feed/writer pools and the JAX dispatch thread — more than a few
+#: zlib threads per window hits diminishing returns long before this
+_AUTO_WORKERS_MAX = 8
+
+_lock = threading.Lock()
+_tl = threading.local()  # .readahead: True inside a prefetch pool task
+
+# -- configuration state (module-wide: the cache is process-wide by design,
+#    like the reference's GDAL block cache) --------------------------------
+_budget_bytes: int = 0
+_workers: int | None = None  # None = never configured (legacy behavior)
+_pool: ThreadPoolExecutor | None = None
+_pool_size: int = 0
+
+# -- cache map: key -> [array, nbytes, readahead_pending] ------------------
+_entries: "OrderedDict[tuple, list]" = OrderedDict()
+_cache_bytes: int = 0
+
+# -- counters (guarded by _lock) -------------------------------------------
+_stats = {
+    "hits": 0,
+    "misses": 0,
+    "evictions": 0,
+    "inserted_bytes": 0,
+    "decode_s": 0.0,
+    "readahead_blocks": 0,
+    "readahead_hits": 0,
+    "readahead_dropped": 0,
+}
+_inflight_prefetch = 0
+
+
+def configure(budget_bytes: int = 0, workers: int | None = 0) -> None:
+    """Set the cache byte budget and the decode worker count.
+
+    ``budget_bytes=0`` disables the cache (and clears it).  ``workers``:
+    ``0`` = auto (``min(8, cpu)`` for the NumPy path, the native codec's
+    own auto-threading), ``1`` = serial everywhere, ``N`` = that many
+    threads in both paths, ``None`` = the unconfigured import-time
+    default (serial NumPy, auto native — exactly the pre-cache codec).
+    Counters are NOT reset — callers diff :func:`stats_snapshot`.
+    """
+    global _budget_bytes, _workers
+    if budget_bytes < 0:
+        raise ValueError(f"budget_bytes={budget_bytes} must be >= 0")
+    if workers is not None and workers < 0:
+        raise ValueError(f"workers={workers} must be >= 0 (or None)")
+    with _lock:
+        _budget_bytes = int(budget_bytes)
+        _workers = workers
+        _evict_to_budget_locked()
+        if _budget_bytes == 0:
+            _entries.clear()
+            _reset_bytes_locked()
+
+
+def _reset_bytes_locked() -> None:
+    global _cache_bytes
+    _cache_bytes = 0
+
+
+def _evict_to_budget_locked() -> None:
+    global _cache_bytes
+    while _cache_bytes > _budget_bytes and _entries:
+        _, (arr, nbytes, _ra) = _entries.popitem(last=False)
+        _cache_bytes -= nbytes
+        _stats["evictions"] += 1
+
+
+def cache_enabled() -> bool:
+    return _budget_bytes > 0
+
+
+def cache_get(key: tuple) -> "np.ndarray | None":
+    """Cached decoded block for ``key``, or None (counts a hit/miss).
+
+    Lookups made FROM a readahead task are invisible to the counters:
+    prefetch probing its own (or a sibling hint's) blocks is not demand
+    traffic — counting it would floor-inflate the hit rate and consume
+    the readahead-pending flag on lookups that never served a real read.
+    """
+    demand = not getattr(_tl, "readahead", False)
+    with _lock:
+        ent = _entries.get(key)
+        if ent is None:
+            if demand:
+                _stats["misses"] += 1
+            return None
+        _entries.move_to_end(key)
+        if demand:
+            _stats["hits"] += 1
+            if ent[2]:  # first real hit on a readahead-inserted block
+                ent[2] = False
+                _stats["readahead_hits"] += 1
+        return ent[0]
+
+
+def cache_put(key: tuple, arr: "np.ndarray") -> None:
+    """Insert a decoded block (no-op when disabled or oversized)."""
+    nbytes = int(arr.nbytes)
+    readahead = bool(getattr(_tl, "readahead", False))
+    with _lock:
+        if _budget_bytes <= 0 or nbytes > _budget_bytes:
+            return
+        global _cache_bytes
+        old = _entries.pop(key, None)
+        if old is not None:
+            _cache_bytes -= old[1]
+        _entries[key] = [arr, nbytes, readahead]
+        _cache_bytes += nbytes
+        _stats["inserted_bytes"] += nbytes
+        if readahead:
+            _stats["readahead_blocks"] += 1
+        _evict_to_budget_locked()
+
+
+def cache_clear() -> None:
+    """Drop every entry (budget/config unchanged; counters kept)."""
+    with _lock:
+        _entries.clear()
+        _reset_bytes_locked()
+
+
+def cache_bytes() -> int:
+    return _cache_bytes
+
+
+def budget_bytes() -> int:
+    return _budget_bytes
+
+
+def file_key(f, path: str) -> "tuple | None":
+    """Cache identity of an open raster: ``(path, mtime_ns, size)``.
+
+    mtime + size guard rewritten files — a regenerated scene under the
+    same path must not serve the previous contents' blocks.  ``None``
+    (no caching) for non-statable streams.
+    """
+    try:
+        st = os.fstat(f.fileno())
+    except (OSError, AttributeError, ValueError):
+        return None
+    return (path, st.st_mtime_ns, st.st_size)
+
+
+def decode_threads() -> int:
+    """``n_threads`` for the native codec: 0 = its own auto-threading."""
+    if _workers is None:
+        return 0
+    return _workers
+
+
+def _effective_pool_size() -> int:
+    if _workers is None or _workers == 1:
+        return 1
+    if _workers == 0:
+        return min(_AUTO_WORKERS_MAX, os.cpu_count() or 1)
+    return _workers
+
+
+def decode_pool() -> "ThreadPoolExecutor | None":
+    """The shared pool for NumPy-path block decode, or ``None`` when the
+    decode must run serially (unconfigured, ``workers=1``, or already on
+    a pool thread via :func:`prefetch_window` — see the module note on
+    pool-in-pool deadlock)."""
+    if getattr(_tl, "readahead", False):
+        return None
+    size = _effective_pool_size()
+    if size <= 1:
+        return None
+    return _get_pool(size)
+
+
+def _get_pool(size: int) -> ThreadPoolExecutor:
+    global _pool, _pool_size
+    with _lock:
+        if _pool is None or _pool_size != size:
+            old = _pool
+            _pool = ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix="lt-decode"
+            )
+            _pool_size = size
+            if old is not None:
+                old.shutdown(wait=False)
+        return _pool
+
+
+def note_decode_seconds(dt: float) -> None:
+    """Accumulate block-decode wall seconds (summed across threads, so
+    the total can exceed wall time — like the driver's stage timers)."""
+    with _lock:
+        _stats["decode_s"] += dt
+
+
+def prefetch_window(path: str, y0: int, x0: int, h: int, w: int) -> bool:
+    """Hint a future window: decode its blocks into the cache off-thread.
+
+    Fire-and-forget — returns True when the hint was queued, False when
+    readahead is off (cache disabled / serial config) or the pool is
+    already saturated with hints (bounded backlog; dropped hints are
+    counted, the blocks just decode on demand later).  Errors inside the
+    prefetch task are swallowed: the on-demand read will surface them.
+    """
+    global _inflight_prefetch
+    size = _effective_pool_size()
+    if not cache_enabled() or size <= 1:
+        return False
+    with _lock:
+        if _inflight_prefetch >= 2 * size:
+            _stats["readahead_dropped"] += 1
+            return False
+        _inflight_prefetch += 1
+    _get_pool(size).submit(_prefetch_task, path, y0, x0, h, w)
+    return True
+
+
+def _prefetch_task(path: str, y0: int, x0: int, h: int, w: int) -> None:
+    global _inflight_prefetch
+    from land_trendr_tpu.io.geotiff import read_geotiff_window
+
+    _tl.readahead = True
+    try:
+        read_geotiff_window(path, y0, x0, h, w)
+    except Exception:
+        pass  # the on-demand read reports the real error with context
+    finally:
+        _tl.readahead = False
+        with _lock:
+            _inflight_prefetch -= 1
+
+
+def stats_snapshot() -> dict:
+    """Cumulative process-wide counters (plus current cache occupancy)."""
+    with _lock:
+        out = dict(_stats)
+        out["cache_bytes"] = _cache_bytes
+        out["budget_bytes"] = _budget_bytes
+        return out
+
+
+def stats_delta(base: dict) -> dict:
+    """Counters accumulated since ``base`` (a prior snapshot); occupancy
+    fields (``cache_bytes``/``budget_bytes``) are reported as-is, not
+    differenced — they are gauges, not counters."""
+    now = stats_snapshot()
+    out = {}
+    for k, v in now.items():
+        if k in ("cache_bytes", "budget_bytes"):
+            out[k] = v
+        else:
+            out[k] = round(v - base.get(k, 0), 6) if isinstance(v, float) else v - base.get(k, 0)
+    return out

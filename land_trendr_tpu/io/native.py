@@ -143,7 +143,7 @@ def decode_blocks(
     spp: int,
     dtype: np.dtype,
     block_rows: np.ndarray | None = None,
-    n_threads: int = 0,
+    n_threads: int | None = None,
 ) -> np.ndarray:
     """Decode TIFF blocks → ``(n_blocks, rows, width, spp)`` native-endian.
 
@@ -154,8 +154,17 @@ def decode_blocks(
     corrupt and raises, exactly like the NumPy path's ``frombuffer``.
     Raises :class:`NativeCodecError` on any per-block failure (caller falls
     back to the NumPy path).
+
+    ``n_threads``: ``None`` (default) takes the feed subsystem's shared
+    ``decode_workers`` knob (:func:`land_trendr_tpu.io.blockcache.
+    decode_threads` — 0 = the codec's own auto-threading, so an
+    unconfigured process behaves as before); an explicit int overrides.
     """
     assert _LIB is not None
+    if n_threads is None:
+        from land_trendr_tpu.io import blockcache
+
+        n_threads = blockcache.decode_threads()
     dtype = np.dtype(dtype)
     if predictor == 2 and dtype.kind not in "iu":
         raise NativeCodecError("predictor 2 requires an integer dtype")

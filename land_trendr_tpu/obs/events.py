@@ -41,7 +41,7 @@ import re
 import socket
 import threading
 import time
-from typing import Any, Iterator
+from typing import Any, Callable, Iterator
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -52,6 +52,7 @@ __all__ = [
     "discover_event_files",
     "expand_event_paths",
     "iter_events",
+    "run_scope_reset",
     "summarize_events_file",
     "validate_event",
     "validate_events_file",
@@ -97,6 +98,18 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # the tile's artifact + manifest line are durable (emitted by
     # TileManifest.record, i.e. from a writer-pool thread)
     "write_done": {"tile_id": int, "bytes": int, "record_s": _NUM},
+    # feed-path decode subsystem rollup (io/blockcache): one terminal
+    # event per run scope with the counters accumulated over that run —
+    # cache effectiveness, decode wall seconds (summed across threads),
+    # and readahead effectiveness.  Additive event type: introduced
+    # without a schema bump (older consumers flag it unknown; required
+    # fields of EXISTING types are unchanged).
+    "feed_cache": {
+        "hits": int,
+        "misses": int,
+        "evictions": int,
+        "decode_s": _NUM,
+    },
     "run_done": {
         "status": str,  # "ok" | "aborted"
         "tiles_done": int,
@@ -114,6 +127,14 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     # pixels; tile_done's real-pixel px_per_s is the stream's one
     # throughput number (extra fields still validate — see module doc)
     "write_done": {"no_fit_rate": _NUM},
+    "feed_cache": {
+        "inserted_bytes": int,
+        "readahead_blocks": int,
+        "readahead_hits": int,
+        "readahead_dropped": int,
+        "cache_bytes": int,
+        "budget_bytes": int,
+    },
     "run_done": {"stage_s": dict},
 }
 
@@ -289,6 +310,29 @@ class EventLog:
         self.close()
 
 
+def run_scope_reset(rec: Any, default_process_index: "int | None" = None) -> dict:
+    """The per-file aggregate fields a ``run_start`` record resets.
+
+    Every consumer that folds a per-process event file scope-by-scope —
+    :func:`summarize_events_file` here and ``tools/obs_report.fold`` —
+    must reset the same identity + terminal fields when a new run scope
+    opens, or a resumed file's earlier scope leaks into the rollup
+    (previous ``run_done`` status surviving a fresh ``run_start`` was the
+    exact hand-rolled-copy drift this primitive removes).  Identity
+    fields come from the ``run_start`` record; terminal fields reset to
+    ``None`` until the scope's own ``run_done`` arrives.
+    """
+    get = rec.get if isinstance(rec, dict) else (lambda *_: None)
+    return {
+        "process_index": get("process_index", default_process_index),
+        "host": get("host"),
+        "pid": get("pid"),
+        "status": None,
+        "wall_s": None,
+        "px_per_s": None,
+    }
+
+
 def summarize_events_file(path: str) -> dict:
     """Fold one per-process event file into its LAST run scope's aggregate.
 
@@ -328,16 +372,11 @@ def summarize_events_file(path: str) -> dict:
             ev = rec.get("ev")
             if ev == "run_start":
                 agg.update(
-                    process_index=rec.get("process_index"),
-                    host=rec.get("host"),
-                    pid=rec.get("pid"),
+                    run_scope_reset(rec),
                     tiles_done=0,
                     tile_retries=0,
                     tiles_failed=0,
                     pixels=0,
-                    wall_s=None,
-                    px_per_s=None,
-                    status=None,
                     # the torn final line of a crashed PREVIOUS scope must
                     # not flag the healthy resumed scope as corrupt
                     malformed_lines=0,
@@ -411,12 +450,17 @@ def validate_event(rec: Any, lineno: int | None = None) -> list[str]:
     return errs
 
 
-def validate_events_file(path: str) -> list[str]:
+def validate_events_file(
+    path: str, extra: "Callable[[Any, int], list[str]] | None" = None
+) -> list[str]:
     """All schema errors in one JSONL event file (empty list = valid).
 
     Beyond per-record checks: the first event of the file must be a
     ``run_start`` (every later run scope re-opens with its own), and
-    malformed JSON is an error, not a crash.
+    malformed JSON is an error, not a crash.  ``extra`` is an optional
+    per-record hook ``(record, lineno) -> errors`` run in the SAME pass —
+    how ``tools/check_events_schema.py`` adds its value-level feed_cache
+    lint without a second parse of the file, with errors in line order.
     """
     errs: list[str] = []
     first_seen = False
@@ -438,6 +482,8 @@ def validate_events_file(path: str) -> list[str]:
                         "expected 'run_start'"
                     )
             errs.extend(validate_event(rec, lineno=i))
+            if extra is not None:
+                errs.extend(extra(rec, i))
     if not first_seen:
         errs.append("file contains no events")
     return errs

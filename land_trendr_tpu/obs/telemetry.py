@@ -131,6 +131,33 @@ class Telemetry:
         self._dev_peak = r.gauge(
             "lt_device_bytes_peak", "high watermark of lt_device_bytes_in_use"
         )
+        # feed-path decode subsystem (io/blockcache): run-scoped counters
+        # folded in once per run by Telemetry.feed_cache
+        self._fc_hits = r.counter(
+            "lt_feed_cache_hits_total", "decoded-block cache hits (feed path)"
+        )
+        self._fc_misses = r.counter(
+            "lt_feed_cache_misses_total", "decoded-block cache misses (feed path)"
+        )
+        self._fc_evictions = r.counter(
+            "lt_feed_cache_evictions_total",
+            "decoded blocks evicted by the cache byte budget",
+        )
+        self._fc_decode_s = r.counter(
+            "lt_feed_decode_seconds_total",
+            "block-decode wall seconds, summed across decode threads",
+        )
+        self._fc_ra_blocks = r.counter(
+            "lt_feed_readahead_blocks_total",
+            "blocks decoded into the cache by readahead hints",
+        )
+        self._fc_ra_hits = r.counter(
+            "lt_feed_readahead_hits_total",
+            "readahead-decoded blocks later served to a real read",
+        )
+        self._fc_bytes = r.gauge(
+            "lt_feed_cache_bytes", "decoded-block cache occupancy (bytes)"
+        )
         if fingerprint:
             r.gauge(
                 "lt_run_info",
@@ -239,6 +266,36 @@ class Telemetry:
         self._record_hist.observe(record_s)
         if "no_fit_rate" in meta:
             self._no_fit.set(float(meta["no_fit_rate"]))
+
+    def feed_cache(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's feed-decode subsystem counters into the stream.
+
+        ``stats`` is a :func:`land_trendr_tpu.io.blockcache.stats_delta`
+        dict (run-scoped counter deltas + cache occupancy gauges); the
+        driver calls this once, right before ``run_done``.  Emits the
+        ``feed_cache`` event and advances the ``lt_feed_*`` instruments.
+        """
+        fields = {
+            k: stats[k]
+            for k in (
+                "hits", "misses", "evictions", "decode_s", "inserted_bytes",
+                "readahead_blocks", "readahead_hits", "readahead_dropped",
+                "cache_bytes", "budget_bytes",
+            )
+            if k in stats
+        }
+        fields["decode_s"] = round(float(fields.get("decode_s", 0.0)), 6)
+        for req in ("hits", "misses", "evictions"):
+            fields.setdefault(req, 0)
+        self.events.emit("feed_cache", **fields)
+        self._fc_hits.inc(fields["hits"])
+        self._fc_misses.inc(fields["misses"])
+        self._fc_evictions.inc(fields["evictions"])
+        self._fc_decode_s.inc(fields["decode_s"])
+        self._fc_ra_blocks.inc(fields.get("readahead_blocks", 0))
+        self._fc_ra_hits.inc(fields.get("readahead_hits", 0))
+        if "cache_bytes" in fields:
+            self._fc_bytes.set(fields["cache_bytes"])
 
     def run_done(
         self,

@@ -35,7 +35,6 @@ Design points (SURVEY.md §5 / §7):
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import logging
 import os
 import time
@@ -48,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from land_trendr_tpu.config import LTParams
-from land_trendr_tpu.io import native
+from land_trendr_tpu.io import blockcache, native
 from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
@@ -133,6 +132,26 @@ class RunConfig:
     #: needs ~3; the default 1 still overlaps the NEXT tile's gather with
     #: the current tile's device wait (prefetch depth feed_workers + 1).
     feed_workers: int = 1
+    #: decoded-block cache budget (MiB) for the windowed feed path
+    #: (:mod:`land_trendr_tpu.io.blockcache`): tile windows that revisit a
+    #: compressed TIFF block — tile-boundary overlap, ``LazyBandCube``
+    #: re-reads, resume passes — decode it once (GIGA_r05.json: the feed
+    #: stage was the dominant non-compute cost).  ``0`` disables the
+    #: cache and reproduces the uncached codec byte for byte.  The cache
+    #: is process-wide (like GDAL's block cache) and an execution fact —
+    #: NOT fingerprinted; run_stack (re)configures it per run.
+    feed_cache_mb: int = 256
+    #: feed-decode threads (the ``io.blockcache`` knob, governing both
+    #: the native codec's C++ threading and the NumPy path's shared
+    #: pool): 0 = auto (native auto-threads; NumPy min(8, cores)),
+    #: 1 = fully serial decode, N = N threads.
+    decode_workers: int = 0
+    #: readahead: the feed pool hints the NEXT planned tile's block set
+    #: (``LazyBandCube.prefetch_window``) so its decode overlaps the
+    #: current tile's device wait.  Only effective with a file-backed
+    #: lazy stack and ``feed_cache_mb > 0``; eager in-RAM stacks have no
+    #: blocks to prefetch.
+    feed_readahead: bool = True
     #: overview pyramid levels on output rasters (0 = none, N = that many
     #: 2× reductions, "auto" = until the smaller dimension < 256) — the
     #: gdaladdo-style reduced pages GIS viewers expect on scene-scale
@@ -213,6 +232,14 @@ class RunConfig:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
         if self.feed_workers < 1:
             raise ValueError(f"feed_workers={self.feed_workers} must be >= 1")
+        if self.feed_cache_mb < 0:
+            raise ValueError(
+                f"feed_cache_mb={self.feed_cache_mb} must be >= 0 (0 = off)"
+            )
+        if self.decode_workers < 0:
+            raise ValueError(
+                f"decode_workers={self.decode_workers} must be >= 0 (0 = auto)"
+            )
         if self.out_overviews != "auto" and (
             not isinstance(self.out_overviews, int) or self.out_overviews < 0
         ):
@@ -387,6 +414,19 @@ def _feed_tile(
     return dn, qa
 
 
+def _prefetch_tile(
+    stack: RasterStack, t: TileSpec, bands: tuple[str, ...]
+) -> None:
+    """Readahead hint for one planned tile: every lazy file-backed cube
+    this run feeds (selected bands + QA) queues its window's block decode
+    into the shared cache.  No-op for eager ndarray cubes."""
+    for name in (*bands, "qa"):
+        cube = stack.qa if name == "qa" else stack.dn_bands.get(name)
+        pf = getattr(cube, "prefetch_window", None)
+        if pf is not None:
+            pf(t.y0, t.x0, t.h, t.w)
+
+
 def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
     """Device outputs → host npz payload, cropped back to the real window.
 
@@ -504,6 +544,14 @@ def run_stack(
         tiles = plan_tiles(*stack.shape, cfg.tile_size)
     tile_px = cfg.tile_size * cfg.tile_size
     n_mesh = int(mesh.devices.size) if mesh is not None else 1
+
+    # the feed-path decode subsystem (process-wide, like GDAL's block
+    # cache): decoded-block LRU + shared decode pool + readahead — pure
+    # acceleration of the windowed lazy feed, byte-identical either way
+    blockcache.configure(
+        budget_bytes=cfg.feed_cache_mb << 20, workers=cfg.decode_workers
+    )
+    feed_cache_base = blockcache.stats_snapshot()
 
     # validate the mesh configuration BEFORE touching the workdir, so a
     # rejected run cannot stamp a fresh manifest with a bad context
@@ -731,9 +779,16 @@ def run_stack(
     )
     pending_feeds: deque = deque()  # (tile, future), consumed in order
 
-    def _feed_job(t: TileSpec):
+    def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
         with timer.stage("feed"):
-            return _feed_tile(stack, t, feed_px, bands)
+            fed = _feed_tile(stack, t, feed_px, bands)
+        if readahead is not None:
+            # fire-and-forget: hint the next PLANNED tile (one past the
+            # feed queue) so its block decode overlaps the current tiles'
+            # device wait — lazy file-backed cubes only; eager ndarray
+            # stacks have no compressed blocks to prefetch
+            _prefetch_tile(stack, readahead, bands)
+        return fed
 
     # constructed LAST, immediately before the try/finally that owns its
     # shutdown: an exception anywhere between construction and that
@@ -782,18 +837,29 @@ def run_stack(
             telemetry.close()
             raise
 
+    # readahead targets ride the feed submissions: the tile fed at index
+    # i hints the tile at i + feed_workers + 1 — the first one past the
+    # bounded feed queue, so its decode lands in the cache exactly when
+    # the feed pool would otherwise start it cold
+    ra_depth = cfg.feed_workers + 1
+    readahead_on = cfg.feed_readahead and cfg.feed_cache_mb > 0
+
+    def _submit_feed(i: int) -> None:
+        ra = todo[i + ra_depth] if readahead_on and i + ra_depth < len(todo) else None
+        pending_feeds.append((todo[i], feeder.submit(_feed_job, todo[i], ra)))
+
     run_ok = False
     try:
-        feed_iter = iter(todo)
-        for t in itertools.islice(feed_iter, cfg.feed_workers + 1):
-            pending_feeds.append((t, feeder.submit(_feed_job, t)))
+        next_i = min(ra_depth, len(todo))
+        for i in range(next_i):
+            _submit_feed(i)
         pending = None
         while pending_feeds:
             t, fut = pending_feeds.popleft()
             dn, qa = fut.result()  # a feed error aborts the run here
-            nxt = next(feed_iter, None)
-            if nxt is not None:
-                pending_feeds.append((nxt, feeder.submit(_feed_job, nxt)))
+            if next_i < len(todo):
+                _submit_feed(next_i)
+                next_i += 1
             if telemetry is not None:
                 telemetry.tile_start(t.tile_id, attempt=1)
             t0 = time.perf_counter()
@@ -835,6 +901,14 @@ def run_stack(
             # SAME full disk that killed the write) must not replace it
             abort_wall = time.perf_counter() - t_run
             try:
+                if cfg.feed_cache_mb:
+                    # the post-mortem of a died gigapixel run is exactly
+                    # where the cache/decode counters matter — emit the
+                    # rollup for the aborted scope too (still just before
+                    # its run_done, like the success path)
+                    telemetry.feed_cache(
+                        blockcache.stats_delta(feed_cache_base)
+                    )
                 telemetry.run_done(
                     "aborted",
                     tiles_done=n_done,
@@ -864,7 +938,15 @@ def run_stack(
         "fingerprint": manifest.fingerprint,
         "mesh_devices": n_mesh,
     }
+    feed_cache_stats = blockcache.stats_delta(feed_cache_base)
+    if cfg.feed_cache_mb:
+        summary["feed_cache"] = feed_cache_stats
     if telemetry is not None:
+        if cfg.feed_cache_mb:
+            # one terminal rollup per run scope (matching the run-scoped
+            # stage_s), not a per-tile stream: the counters are cheap but
+            # the EVENT volume wouldn't be
+            telemetry.feed_cache(feed_cache_stats)
         try:
             telemetry.run_done(
                 "ok",

@@ -436,6 +436,23 @@ class LazyBandCube:
         self.dtype = np.dtype(dtype)
         self.ndim = 3
 
+    def prefetch_window(self, y0: int, x0: int, h: int, w: int) -> int:
+        """Readahead hint: decode the blocks of this window (every year)
+        into the process-wide decoded-block cache off-thread, so a later
+        ``self[:, y0:y0+h, x0:x0+w]`` is served from cache — the driver
+        feed pool hints the NEXT planned tile while the current one waits
+        on the device.  Fire-and-forget; returns the number of per-file
+        hints actually queued (0 when the cache/readahead is off or the
+        decode pool is saturated — the read then just decodes on demand).
+        """
+        from land_trendr_tpu.io import blockcache
+
+        queued = 0
+        for p in self.paths:
+            if blockcache.prefetch_window(p, y0, x0, h, w):
+                queued += 1
+        return queued
+
     def __getitem__(self, key) -> np.ndarray:
         from land_trendr_tpu.io.geotiff import read_geotiff_window
 

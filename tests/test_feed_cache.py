@@ -1,0 +1,330 @@
+"""Feed-path decode subsystem (io/blockcache): cache parity + readahead.
+
+The contract under test is the tentpole's acceptance bar: cached and
+uncached window reads are BYTE-IDENTICAL across the full layout matrix —
+compression (none / deflate / raw-deflate / LZW) × predictor ×
+stripped/tiled — with the cache enabled, disabled, and squeezed to a
+1-block budget (eviction churn); plus the readahead/prefetch seam, the
+driver wiring (``feed_cache`` telemetry event through a real lazy run),
+and the ``tools/feed_bench.py`` smoke mode.
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io import blockcache, native
+from land_trendr_tpu.io import geotiff as gt
+from land_trendr_tpu.io.geotiff import (
+    read_geotiff,
+    read_geotiff_window,
+    write_geotiff,
+)
+
+
+@pytest.fixture(autouse=True)
+def _unconfigured_blockcache():
+    """Every test starts AND leaves the process-wide subsystem in the
+    unconfigured (legacy) state, so ordering cannot leak cache entries or
+    worker settings between tests."""
+    blockcache.configure(0, None)
+    blockcache.cache_clear()
+    yield
+    blockcache.configure(0, None)
+    blockcache.cache_clear()
+
+
+def _raw_deflate_writer(monkeypatch):
+    """Make write_geotiff emit RAW deflate block payloads (no zlib
+    wrapper) — the nonstandard-but-seen-in-the-wild stream the reader's
+    ``zlib.decompress(buf, -15)`` fallback exists for.  The native encode
+    path is disabled so the Python ``zlib.compress`` seam is the one that
+    runs."""
+    monkeypatch.setattr(native, "available", lambda: False)
+
+    def raw_compress(data, level=6):
+        c = zlib.compressobj(level, zlib.DEFLATED, -15)
+        return c.compress(data) + c.flush()
+
+    monkeypatch.setattr(gt.zlib, "compress", raw_compress)
+
+
+#: windows chosen to straddle the 37-px tile / 64-row strip grid, repeat
+#: (hit path), touch edges, and cover single rows/cols
+_WINDOWS = (
+    (0, 0, 96, 90),
+    (10, 17, 50, 41),
+    (10, 17, 50, 41),  # revisit: served from cache when enabled
+    (63, 30, 33, 60),
+    (95, 0, 1, 90),
+    (0, 89, 96, 1),
+)
+
+
+@pytest.mark.parametrize("layout", ["tiled", "strips"])
+@pytest.mark.parametrize("predictor", [True, False])
+@pytest.mark.parametrize(
+    "compress", ["none", "deflate", "raw-deflate", "lzw"]
+)
+def test_window_parity_matrix(tmp_path, rng, monkeypatch, compress, predictor, layout):
+    """Byte-identity vs the full read, for every (compression × predictor
+    × layout) × (cache off / cache on / 1-block budget) combination."""
+    if compress == "raw-deflate":
+        _raw_deflate_writer(monkeypatch)
+        write_compress = "deflate"
+    else:
+        write_compress = compress
+    p = str(tmp_path / "m.tif")
+    arr = rng.integers(0, 43000, size=(96, 90), dtype=np.uint16)
+    write_geotiff(
+        p,
+        arr,
+        compress=write_compress,
+        tile=37 if layout == "tiled" else None,
+        predictor=predictor,
+    )
+    full, _, _ = read_geotiff(p)
+    assert np.array_equal(full, arr)
+
+    one_block = 37 * 37 * 2 if layout == "tiled" else 64 * 90 * 2
+    for budget, workers in ((0, None), (64 << 20, 0), (one_block, 2)):
+        blockcache.configure(budget, workers)
+        blockcache.cache_clear()
+        for y0, x0, h, w in _WINDOWS:
+            got = read_geotiff_window(p, y0, x0, h, w)
+            assert got.dtype == arr.dtype
+            assert np.array_equal(got, full[y0 : y0 + h, x0 : x0 + w]), (
+                compress, predictor, layout, budget, (y0, x0, h, w),
+            )
+
+
+def test_cache_hits_evictions_and_stats(tmp_path, rng):
+    p = str(tmp_path / "c.tif")
+    arr = rng.integers(0, 1000, size=(128, 128), dtype=np.uint16)
+    write_geotiff(p, arr, compress="deflate", tile=64)
+    blockcache.configure(64 << 20, 0)
+    base = blockcache.stats_snapshot()
+    read_geotiff_window(p, 0, 0, 128, 128)   # 4 blocks, all cold
+    read_geotiff_window(p, 0, 0, 128, 128)   # all 4 from cache
+    d = blockcache.stats_delta(base)
+    assert d["misses"] == 4 and d["hits"] == 4
+    assert d["evictions"] == 0
+    assert d["decode_s"] >= 0.0
+    assert blockcache.cache_bytes() == 4 * 64 * 64 * 2
+
+    # 1-block budget: every insert evicts the previous block (churn), and
+    # reads stay correct (covered by the matrix) while never exceeding it
+    blockcache.configure(64 * 64 * 2, 0)
+    assert blockcache.cache_bytes() <= 64 * 64 * 2  # shrink evicted down
+    base = blockcache.stats_snapshot()
+    read_geotiff_window(p, 0, 0, 128, 128)
+    d = blockcache.stats_delta(base)
+    assert d["evictions"] >= 3
+    assert blockcache.cache_bytes() <= 64 * 64 * 2
+
+
+def test_cache_keys_on_mtime_and_size(tmp_path, rng):
+    """A rewritten file must not serve the previous contents' blocks."""
+    p = str(tmp_path / "r.tif")
+    a1 = rng.integers(0, 100, size=(64, 64), dtype=np.uint16)
+    a2 = (a1 + 7).astype(np.uint16)
+    blockcache.configure(64 << 20, 0)
+    write_geotiff(p, a1, compress="deflate", tile=64)
+    os.utime(p, ns=(1_000_000_000, 1_000_000_000))
+    assert np.array_equal(read_geotiff_window(p, 0, 0, 64, 64), a1)
+    write_geotiff(p, a2, compress="deflate", tile=64)
+    os.utime(p, ns=(2_000_000_000, 2_000_000_000))
+    assert np.array_equal(read_geotiff_window(p, 0, 0, 64, 64), a2)
+
+
+def test_disabled_cache_stores_nothing(tmp_path, rng):
+    p = str(tmp_path / "d.tif")
+    write_geotiff(
+        p, rng.integers(0, 9, size=(64, 64), dtype=np.uint16), tile=64
+    )
+    read_geotiff_window(p, 0, 0, 64, 64)  # unconfigured (autouse fixture)
+    assert blockcache.cache_bytes() == 0
+    assert not blockcache.cache_enabled()
+
+
+def test_prefetch_window_populates_cache_and_counts_readahead(tmp_path, rng):
+    from land_trendr_tpu.runtime.stack import LazyBandCube
+
+    paths = []
+    arrs = []
+    for k in range(3):
+        p = str(tmp_path / f"y{k}.tif")
+        a = rng.integers(0, 2000, size=(128, 120), dtype=np.uint16)
+        write_geotiff(p, a, compress="deflate", tile=64)
+        paths.append(p)
+        arrs.append(a)
+    cube = LazyBandCube(paths, (128, 120), np.uint16)
+
+    # serial config: prefetch is OFF (nothing to overlap), hint refused
+    blockcache.configure(64 << 20, 1)
+    assert cube.prefetch_window(0, 0, 70, 70) == 0
+
+    blockcache.configure(64 << 20, 2)
+    base = blockcache.stats_snapshot()
+    queued = cube.prefetch_window(0, 0, 70, 70)
+    assert queued == 3
+    # drain the decode pool: prefetch is fire-and-forget, so join by
+    # waiting until the hinted blocks landed
+    import time
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if blockcache.stats_delta(base)["readahead_blocks"] >= 3 * 4:
+            break
+        time.sleep(0.01)
+    d = blockcache.stats_delta(base)
+    assert d["readahead_blocks"] == 3 * 4  # 2x2 blocks x 3 years
+
+    win = cube[:, 0:70, 0:70]  # served from the prefetched blocks
+    assert np.array_equal(win, np.stack([a[0:70, 0:70] for a in arrs]))
+    d = blockcache.stats_delta(base)
+    assert d["readahead_hits"] == 3 * 4
+    assert d["hits"] >= 3 * 4
+    # a second real read hits the same entries but must not recount them
+    cube[:, 0:70, 0:70]
+    assert blockcache.stats_delta(base)["readahead_hits"] == 3 * 4
+
+
+def test_feed_bench_smoke_mode(tmp_path):
+    """The tier-1 smoke mode: tiny scene, seconds, artifact written, the
+    cached configuration byte-checked against full reads."""
+    from tools import feed_bench
+
+    out = tmp_path / "FEED_smoke.json"
+    ev_dir = tmp_path / "ev"
+    rc = feed_bench.main([
+        "--smoke", "--size", "256", "--years", "2", "--window", "96",
+        "--out", str(out), "--events-dir", str(ev_dir),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert rec["parity_ok"] is True
+    assert rec["scene"]["windows"] > 0
+    for section in (
+        "baseline_serial_uncached", "parallel_uncached", "cached_parallel",
+        "cached_parallel_readahead",
+    ):
+        assert rec[section]["wall_s"] > 0
+    assert rec["cache_stats"]["hits"] > 0  # straddled windows revisit blocks
+    assert rec["speedup_cached"] > 0
+
+    # the emitted events are schema-valid and fold with the cache counters
+    from tools import check_events_schema, obs_report
+
+    assert check_events_schema.main([str(ev_dir)]) == 0
+    report, _ = obs_report.fold(
+        [str(ev_dir / "events.jsonl")], schema_errors={}
+    )
+    assert report["feed_cache"]["hits"] == rec["cache_stats"]["hits"]
+    assert report["feed_cache"]["decode_s"] >= 0
+    assert report["feed_cache"]["hit_rate"] is not None
+
+
+def _write_c2_year(dirpath, year, arrs, rng):
+    """One C2-named acquisition: SR_B5 (nir), SR_B7 (swir2), QA_PIXEL."""
+    names = {
+        "nir": f"LC08_L2SP_045030_{year}0715_{year}0912_02_T1_SR_B5.TIF",
+        "swir2": f"LC08_L2SP_045030_{year}0715_{year}0912_02_T1_SR_B7.TIF",
+        "qa": f"LC08_L2SP_045030_{year}0715_{year}0912_02_T1_QA_PIXEL.TIF",
+    }
+    for band, fname in names.items():
+        # STRIPS of 64 rows with a 48-px driver tile: adjacent tile rows
+        # share strips, so the run produces real cache hits
+        write_geotiff(
+            os.path.join(dirpath, fname),
+            arrs[band],
+            compress="deflate",
+            tile=None,
+        )
+
+
+def test_driver_lazy_run_emits_feed_cache_event(tmp_path, rng):
+    """End-to-end: a lazy C2 run with telemetry emits a feed_cache event
+    whose counters show real cache traffic, and the stream lints clean."""
+    from land_trendr_tpu.obs.events import iter_events, validate_events_file
+    from land_trendr_tpu.runtime import RunConfig, run_stack
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    stack_dir = tmp_path / "c2"
+    stack_dir.mkdir()
+    h, w = 96, 96
+    for year in (2000, 2001, 2002):
+        qa = np.zeros((h, w), dtype=np.uint16)
+        qa[:2] = 1 << 3  # a little cloud
+        _write_c2_year(
+            str(stack_dir),
+            year,
+            {
+                "nir": rng.integers(7273, 43636, (h, w), dtype=np.uint16),
+                "swir2": rng.integers(7273, 43636, (h, w), dtype=np.uint16),
+                "qa": qa,
+            },
+            rng,
+        )
+    stack = open_stack_dir_c2_lazy(str(stack_dir), bands=("nir", "swir2"))
+    cfg = RunConfig(
+        index="nbr",
+        tile_size=48,
+        workdir=str(tmp_path / "work"),
+        out_dir=str(tmp_path / "out"),
+        telemetry=True,
+        feed_cache_mb=64,
+        decode_workers=2,
+    )
+    summary = run_stack(stack, cfg)
+    assert "feed_cache" in summary
+    assert summary["feed_cache"]["hits"] > 0  # strips straddle tile rows
+
+    ev_file = summary["telemetry"]["events"]
+    assert validate_events_file(ev_file) == []
+    fc = [r for r in iter_events(ev_file) if r["ev"] == "feed_cache"]
+    assert len(fc) == 1
+    assert fc[0]["hits"] == summary["feed_cache"]["hits"]
+    assert fc[0]["misses"] == summary["feed_cache"]["misses"]
+
+    from tools import check_events_schema, obs_report
+
+    assert check_events_schema.main([cfg.workdir]) == 0
+    report, _ = obs_report.fold([ev_file], schema_errors={})
+    assert report["feed_cache"]["hits"] == fc[0]["hits"]
+
+    # metrics exposition carries the lt_feed_* family
+    prom = (tmp_path / "work" / "metrics.prom").read_text()
+    assert "lt_feed_cache_hits_total" in prom
+    assert "lt_feed_decode_seconds_total" in prom
+
+
+def test_check_events_schema_flags_bad_feed_cache(tmp_path):
+    """The CI lint catches value-level feed_cache drift the type schema
+    cannot (negative counters, hits exceeding readahead inserts)."""
+    from tools import check_events_schema
+
+    good = {
+        "ev": "run_start", "t_wall": 1.0, "t_mono": 1.0, "schema": 1,
+        "fingerprint": "f", "pid": 1, "host": "h", "process_index": 0,
+        "process_count": 1, "tiles_total": 1, "tiles_todo": 1,
+        "tiles_skipped_resume": 0, "mesh_devices": 1, "impl": "xla",
+    }
+    bad_fc = {
+        "ev": "feed_cache", "t_wall": 1.0, "t_mono": 1.0,
+        "hits": -3, "misses": 0, "evictions": 0, "decode_s": 0.1,
+        "readahead_blocks": 1, "readahead_hits": 5,
+    }
+    p = tmp_path / "events.jsonl"
+    p.write_text(json.dumps(good) + "\n" + json.dumps(bad_fc) + "\n")
+    assert check_events_schema.main([str(p)]) == 1
+    errs = check_events_schema.feed_cache_value_errors(bad_fc, 2)
+    assert any("negative" in e for e in errs)
+    assert any("exceeds" in e for e in errs)
+
+    ok_fc = dict(bad_fc, hits=3, readahead_hits=1)
+    p.write_text(json.dumps(good) + "\n" + json.dumps(ok_fc) + "\n")
+    assert check_events_schema.main([str(p)]) == 0

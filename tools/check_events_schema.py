@@ -4,8 +4,12 @@ Validates one or more ``events.jsonl`` files (or workdirs containing them)
 against :data:`land_trendr_tpu.obs.events.EVENT_FIELDS` at the current
 :data:`~land_trendr_tpu.obs.events.SCHEMA_VERSION`: every line parses,
 every event is a known type with its required fields at the right types,
-and the stream opens with ``run_start``.  Exit 0 = all clean, 1 = schema
-errors (listed on stderr), 2 = usage/IO error.
+and the stream opens with ``run_start``.  On top of the type schema, the
+``feed_cache`` rollup (the feed-path decode subsystem, ``io/blockcache``)
+gets a VALUE lint: its counters must be non-negative and readahead hits
+cannot exceed the blocks readahead inserted — producer drift a type check
+alone cannot catch.  Exit 0 = all clean, 1 = schema errors (listed on
+stderr), 2 = usage/IO error.
 
 This is the guard that keeps producer (driver) and consumers
 (``obs_report``, dashboards) honest about the JSONL contract — wired into
@@ -30,6 +34,38 @@ from land_trendr_tpu.obs.events import (  # noqa: E402
     validate_events_file,
 )
 
+#: numeric feed_cache fields that can never go negative (counters and
+#: byte gauges alike — a negative value means a broken stats delta)
+_FEED_CACHE_NONNEG = (
+    "hits", "misses", "evictions", "decode_s", "inserted_bytes",
+    "readahead_blocks", "readahead_hits", "readahead_dropped",
+    "cache_bytes", "budget_bytes",
+)
+
+
+def feed_cache_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for one ``feed_cache`` record (type checks are the
+    schema's job — :func:`validate_event` already covers those)."""
+    if not isinstance(rec, dict) or rec.get("ev") != "feed_cache":
+        return []
+    errs = []
+    for name in _FEED_CACHE_NONNEG:
+        v = rec.get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+            errs.append(f"line {lineno}: feed_cache: {name} is negative ({v})")
+    ra_b, ra_h = rec.get("readahead_blocks"), rec.get("readahead_hits")
+    if (
+        isinstance(ra_b, int) and isinstance(ra_h, int)
+        and not isinstance(ra_b, bool) and not isinstance(ra_h, bool)
+        and ra_h > ra_b
+    ):
+        errs.append(
+            f"line {lineno}: feed_cache: readahead_hits {ra_h} exceeds "
+            f"readahead_blocks {ra_b} (each readahead block is counted "
+            "as a hit at most once)"
+        )
+    return errs
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -50,7 +86,9 @@ def main(argv: list[str] | None = None) -> int:
 
     n_bad = 0
     for path in files:
-        errs = validate_events_file(path)
+        # one parse per file: the value-level feed_cache lint rides the
+        # schema pass as a per-record hook, errors in line order
+        errs = validate_events_file(path, extra=feed_cache_value_errors)
         if errs:
             n_bad += 1
             for e in errs[: args.max_errors]:

@@ -1,0 +1,277 @@
+"""Feed-path decode benchmark: decoded-block cache + parallel block decode.
+
+Builds a synthetic **tiled-deflate** scene (one single-band uint16 GeoTIFF
+per year — the shape the C2 lazy ingest feeds from) and measures the
+window-read feed stage three ways over the same row-major tile sweep:
+
+* ``baseline`` — ``feed_cache_mb=0``, ``decode_workers=1``: the serial,
+  uncached pre-cache feed path;
+* ``parallel`` — cache still off, ``decode_workers`` threads: thread
+  scaling alone;
+* ``cached``   — cache + parallel decode (``RunConfig.feed_cache_mb`` /
+  ``decode_workers``): the acceptance comparison;
+* ``cached_readahead`` — cache + parallel + next-window hints.  Recorded
+  for completeness: in this HOST-ONLY loop there is no device wait to
+  overlap, so on small hosts the hint work competes with the main loop —
+  the driver issues hints from its feed pool while the device computes,
+  which is where readahead actually pays.
+
+The tile windows deliberately misalign with the 256-px TIFF block grid,
+so adjacent windows straddle compressed blocks — the revisit pattern the
+r05 gigapixel run's feed stage paid for serially (GIGA_r05.json
+``stage_s``: feed 18.96s of 56.9s wall).  Byte-identity of cached vs
+uncached reads is asserted on sampled windows every run.
+
+Writes one JSON artifact (``--out``, e.g. ``FEED_r07.json``) and, with
+``--events-dir``, a schema-valid ``events.jsonl`` through the obs
+Telemetry (``run_start`` / ``feed_cache`` / ``run_done``) so
+``tools/obs_report.py`` surfaces the cache and decode-seconds counters.
+
+``--smoke`` shrinks the scene to seconds-not-minutes scale — the tier-1
+``-m 'not slow'`` mode ``tests/test_feed_cache.py`` runs in CI.
+
+Usage:
+    python tools/feed_bench.py --out FEED_r07.json
+    python tools/feed_bench.py --smoke --out /tmp/feed_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+from land_trendr_tpu.io import blockcache, native  # noqa: E402
+from land_trendr_tpu.io.geotiff import (  # noqa: E402
+    read_geotiff,
+    read_geotiff_window,
+    write_geotiff,
+)
+
+
+def build_scene(scene_dir: str, size: int, years: int, seed: int) -> list[str]:
+    """One tiled-deflate uint16 single-band file per year (256-px blocks,
+    predictor on — the layout the stream writer and C2 products use).
+    Smooth ramps + noise so deflate genuinely compresses (and inflate
+    genuinely costs — all-random data would be stored, not deflated)."""
+    os.makedirs(scene_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size]
+    base = (yy * 3 + xx * 2) % 4096
+    paths = []
+    for k in range(years):
+        arr = (
+            base + k * 37 + rng.integers(0, 64, size=(size, size))
+        ).astype(np.uint16)
+        p = os.path.join(scene_dir, f"band_{1984 + k}.tif")
+        write_geotiff(p, arr, compress="deflate", tile=256, predictor=True)
+        paths.append(p)
+    return paths
+
+
+def plan_windows(size: int, window: int) -> list[tuple[int, int, int, int]]:
+    wins = []
+    for y0 in range(0, size, window):
+        for x0 in range(0, size, window):
+            wins.append((y0, x0, min(window, size - y0), min(window, size - x0)))
+    return wins
+
+
+def sweep(
+    paths: list[str],
+    wins: list[tuple[int, int, int, int]],
+    readahead: bool,
+) -> float:
+    """One feed pass: every window of every year, row-major — the access
+    pattern of the driver's lazy tile feed.  With ``readahead``, the next
+    window's blocks are hinted before the current one decodes (the driver
+    does this from the feed pool while the device computes)."""
+    t0 = time.perf_counter()
+    for wi, win in enumerate(wins):
+        if readahead and wi + 1 < len(wins):
+            nxt = wins[wi + 1]
+            for p in paths:
+                blockcache.prefetch_window(p, *nxt)
+        for p in paths:
+            read_geotiff_window(p, *win)
+    return time.perf_counter() - t0
+
+
+def check_parity(paths: list[str], wins, n_sample: int = 4) -> int:
+    """Assert cached window reads byte-match the full-read reference on a
+    sample of windows (the current blockcache configuration applies)."""
+    full = {p: read_geotiff(p)[0] for p in paths[:2]}
+    step = max(1, len(wins) // n_sample)
+    checked = 0
+    for win in wins[::step]:
+        y0, x0, h, w = win
+        for p, ref in full.items():
+            got = read_geotiff_window(p, *win)
+            if not np.array_equal(got, ref[y0 : y0 + h, x0 : x0 + w]):
+                raise AssertionError(f"window {win} of {p} mismatches full read")
+            checked += 1
+    return checked
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--size", type=int, default=2048, help="scene edge (px)")
+    ap.add_argument("--years", type=int, default=6, help="files in the stack")
+    ap.add_argument("--window", type=int, default=192,
+                    help="feed window edge; deliberately NOT a multiple of "
+                    "the 256-px TIFF block, so windows straddle blocks")
+    ap.add_argument("--cache-mb", type=int, default=256)
+    ap.add_argument("--workers", type=int, default=0, help="0 = auto")
+    ap.add_argument("--seed", type=int, default=20260802)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per mode; the MEDIAN wall is "
+                    "reported (this 2-core container's scheduler noise is "
+                    "large relative to a single pass)")
+    ap.add_argument("--out", default="FEED_r07.json")
+    ap.add_argument("--scene-dir", default=None,
+                    help="keep/reuse the scene here (default: a temp dir)")
+    ap.add_argument("--events-dir", default=None,
+                    help="also emit a schema-valid events.jsonl with the "
+                    "feed_cache rollup (fold with tools/obs_report.py)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny scene, seconds not minutes (tier-1 CI mode)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.size = min(args.size, 512)
+        args.years = min(args.years, 3)
+        args.window = min(args.window, 160)
+        args.reps = 1
+
+    tmp = None
+    scene_dir = args.scene_dir
+    if scene_dir is None:
+        tmp = tempfile.mkdtemp(prefix="lt_feed_bench_")
+        scene_dir = tmp
+    try:
+        paths = build_scene(scene_dir, args.size, args.years, args.seed)
+        wins = plan_windows(args.size, args.window)
+        px = args.size * args.size * args.years
+
+        def run(cache_mb: int, workers: int, readahead: bool) -> dict:
+            blockcache.configure(
+                budget_bytes=cache_mb << 20, workers=workers
+            )
+            walls = []
+            stats = None
+            for _ in range(max(1, args.reps)):
+                blockcache.cache_clear()  # every rep decodes from cold
+                base = blockcache.stats_snapshot()
+                walls.append(sweep(paths, wins, readahead=readahead))
+                stats = blockcache.stats_delta(base)
+            wall = sorted(walls)[len(walls) // 2]  # median
+            return {
+                "wall_s": round(wall, 4),
+                "px_per_s": round(px / wall, 1),
+                "walls_s": [round(w, 4) for w in walls],
+                "stats": stats,
+            }
+
+        # untimed warmup: fault the scene into the page cache so the first
+        # timed mode does not pay cold-file I/O the others never see
+        blockcache.configure(0, 1)
+        sweep(paths, wins, readahead=False)
+
+        baseline = run(0, 1, readahead=False)
+        parallel = run(0, args.workers, readahead=False)
+        cached = run(args.cache_mb, args.workers, readahead=False)
+        cached_ra = run(args.cache_mb, args.workers, readahead=True)
+        # parity under the CACHED configuration (hits served from cache)
+        parity_checked = check_parity(paths, wins)
+
+        result = {
+            "scene": {
+                "size": args.size,
+                "years": args.years,
+                "window": args.window,
+                "layout": "tiled-256 deflate+predictor uint16",
+                "windows": len(wins),
+                "pixels": px,
+            },
+            "config": {
+                "cache_mb": args.cache_mb,
+                "decode_workers": args.workers,
+                "cpu_count": os.cpu_count(),
+                "native": native.available(),
+            },
+            "baseline_serial_uncached": {
+                k: baseline[k] for k in ("wall_s", "px_per_s")
+            },
+            "parallel_uncached": {
+                k: parallel[k] for k in ("wall_s", "px_per_s")
+            },
+            "cached_parallel": {
+                k: cached[k] for k in ("wall_s", "px_per_s")
+            },
+            "cached_parallel_readahead": {
+                k: cached_ra[k] for k in ("wall_s", "px_per_s")
+            },
+            "speedup_parallel": round(
+                baseline["wall_s"] / parallel["wall_s"], 3
+            ),
+            "speedup_cached": round(baseline["wall_s"] / cached["wall_s"], 3),
+            "cache_stats": cached["stats"],
+            "readahead_stats": {
+                k: cached_ra["stats"][k]
+                for k in ("readahead_blocks", "readahead_hits",
+                          "readahead_dropped", "hits", "misses")
+            },
+            "parity_windows_checked": parity_checked,
+            "parity_ok": True,
+        }
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+
+        if args.events_dir:
+            from land_trendr_tpu.obs import Telemetry
+
+            tel = Telemetry(args.events_dir, fingerprint="feed_bench")
+            try:
+                tel.run_start(
+                    fingerprint="feed_bench",
+                    process_index=0,
+                    process_count=1,
+                    tiles_total=len(wins),
+                    tiles_todo=len(wins),
+                    tiles_skipped_resume=0,
+                    mesh_devices=1,
+                    impl="host-feed",
+                )
+                tel.feed_cache(cached["stats"])
+                tel.run_done(
+                    "ok",
+                    tiles_done=len(wins),
+                    pixels=px,
+                    wall_s=cached["wall_s"],
+                    px_per_s=cached["px_per_s"],
+                    fit_rate=0.0,
+                )
+            finally:
+                tel.close()
+
+        print(json.dumps(result, indent=2))
+        return 0
+    finally:
+        blockcache.configure(0, None)  # leave the process unconfigured
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,9 @@ per-process files) and it emits
 
 * a JSON **report** on stdout — per-event-type counts, tile compute-latency
   and px/s distributions, retry/failure totals, backlog-depth maxima, the
-  run_done stage split, and per-host rollups;
+  run_done stage split, the feed-cache rollup (hits/misses/decode seconds
+  with a derived hit rate), and per-host rollups — schema lint and fold
+  run in a SINGLE pass per file (``fold(paths, schema_errors=...)``);
 * with ``--trace OUT.json``, a **Chrome trace-event file** (the
   ``chrome://tracing`` / Perfetto JSON array format): per-tile device-wait
   and artifact-write slices, retry instants, and backlog counter tracks,
@@ -34,7 +36,8 @@ sys.path.insert(0, str(REPO))
 
 from land_trendr_tpu.obs.events import (  # noqa: E402
     expand_event_paths,
-    validate_events_file,
+    run_scope_reset,
+    validate_event,
 )
 
 _US = 1e6  # trace-event timestamps are microseconds
@@ -70,33 +73,48 @@ def _wall_anchored(scopes: list[dict], rec: dict) -> float:
     return rec.get("t_wall", 0.0)
 
 
-def _iter_tolerant(path: str):
-    """Yield parsed records; a torn/malformed line yields None, not a crash.
-
-    The post-mortem stream of a killed run — exactly what this tool
-    inspects — routinely ends in a torn line; ``--no-validate`` promises a
-    best-effort fold of it.
-    """
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                yield None
-
-
 def _fresh_scope() -> dict:
     return {
         "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
-        "retries": 0, "failures": 0, "stage_s": {},
+        "retries": 0, "failures": 0, "stage_s": {}, "feed_cache": None,
     }
 
 
-def fold(paths: list[str]) -> tuple[dict, list[dict]]:
+#: feed_cache event counters summed across files in the report; the
+#: occupancy gauges (cache_bytes/budget_bytes) are point-in-time, so the
+#: merge takes their maximum instead
+_FEED_CACHE_COUNTERS = (
+    "hits", "misses", "evictions", "decode_s", "inserted_bytes",
+    "readahead_blocks", "readahead_hits", "readahead_dropped",
+)
+_FEED_CACHE_GAUGES = ("cache_bytes", "budget_bytes")
+
+
+def _merge_feed_cache(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the per-scope feed_cache rollups (None when no
+    file's last scope carried one); adds the derived ``hit_rate``."""
+    seen = [c["feed_cache"] for c in folded if c["feed_cache"] is not None]
+    if not seen:
+        return None
+    out: dict = {}
+    for k in _FEED_CACHE_COUNTERS:
+        vals = [fc[k] for fc in seen if k in fc]
+        if vals:
+            v = sum(vals)
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    for k in _FEED_CACHE_GAUGES:
+        vals = [fc[k] for fc in seen if k in fc]
+        if vals:
+            out[k] = max(vals)
+    lookups = out.get("hits", 0) + out.get("misses", 0)
+    out["hit_rate"] = round(out.get("hits", 0) / lookups, 4) if lookups else None
+    return out
+
+
+def fold(
+    paths: list[str], schema_errors: "dict[str, list[str]] | None" = None
+) -> tuple[dict, list[dict]]:
     """Parse event files → (report dict, flat trace-source records).
 
     The report aggregates describe each file's LAST run scope — a resumed
@@ -106,10 +124,18 @@ def fold(paths: list[str]) -> tuple[dict, list[dict]]:
     The TRACE keeps every scope: the timeline of an abort + resume is
     exactly what a post-mortem wants to see.
 
+    ``schema_errors`` (a caller-owned dict) turns on the schema lint IN
+    this pass: each file's ``validate_events_file``-equivalent error list
+    lands under its path, so the validating CLI parses every line exactly
+    once instead of running a lint pass and then a fold pass (the PR-1
+    double-parse this replaces).  ``None`` skips linting (the library
+    default and ``--no-validate``).
+
     Trace-source records carry absolute wall-anchored times; the exporter
     rebases them to the earliest event so trace timestamps start near 0.
     Malformed lines and field-incomplete records are counted
-    (``malformed``), never fatal.
+    (``malformed``), never fatal — a torn final line of a killed run must
+    still fold best-effort.
     """
     malformed = 0
     hosts: list[dict] = []
@@ -117,110 +143,145 @@ def fold(paths: list[str]) -> tuple[dict, list[dict]]:
     folded: list[dict] = []  # each file's LAST scope aggregate
 
     for fileno, path in enumerate(paths):
+        errs = (
+            None if schema_errors is None else schema_errors.setdefault(path, [])
+        )
         scopes: list[dict] = []
         cur = _fresh_scope()
         host_info: dict = {"events_file": path, "process_index": fileno}
         starts: dict[int, float] = {}  # tile_id -> wall-anchored start
-        for rec in _iter_tolerant(path):
-            if not isinstance(rec, dict) or not isinstance(rec.get("ev"), str):
-                # torn/foreign JSON that still parsed (e.g. a truncated
-                # prefix that happens to be valid) is malformed, not an
-                # event type of its own
-                malformed += 1
-                continue
-            ev = rec["ev"]
-            # required fields are read into locals FIRST, aggregates
-            # mutated only after they all resolved: a field-incomplete
-            # record must count as malformed alone, never half-fold (a
-            # tile_done missing px_per_s must not leave its compute_s in
-            # the stats and be double-counted under event_counts too)
-            try:
-                tw = _wall_anchored(scopes, rec)
-                if ev == "run_start":
-                    t_wall, t_mono = rec["t_wall"], rec["t_mono"]
-                    scopes.append({"t_wall": t_wall, "t_mono": t_mono})
-                    tw = t_wall
-                    cur = _fresh_scope()  # aggregates describe the LAST scope
-                    starts.clear()
-                    host_info.update(
-                        process_index=rec.get("process_index", fileno),
-                        host=rec.get("host"),
-                        pid=rec.get("pid"),
-                        impl=rec.get("impl"),
-                        mesh_devices=rec.get("mesh_devices"),
-                        # a previous scope's run_done must not leak into
-                        # this scope's rollup (summarize_events_file
-                        # resets these identically)
-                        status=None,
-                        wall_s=None,
-                        px_per_s=None,
-                    )
-                elif ev == "tile_start":
-                    starts[rec["tile_id"]] = tw
-                elif ev == "tile_done":
-                    tile_id, c_s, pps = rec["tile_id"], rec["compute_s"], rec["px_per_s"]
-                    cur["compute_s"].append(c_s)
-                    cur["px_per_s"].append(pps)
-                    cur["pixels"] += rec.get("px", 0)
-                    cur["max_feed_backlog"] = max(
-                        cur["max_feed_backlog"], rec.get("feed_backlog", 0)
-                    )
-                    cur["max_write_backlog"] = max(
-                        cur["max_write_backlog"], rec.get("write_backlog", 0)
-                    )
-                    t0 = starts.pop(tile_id, tw - c_s)
-                    spans.append({
-                        "kind": "slice", "file": fileno, "tid": "device-wait",
-                        "name": f"tile {tile_id}", "t0": t0,
-                        "dur": max(c_s, tw - t0),
-                        "args": {"px": rec.get("px"), "px_per_s": pps},
-                    })
-                    spans.append({
-                        "kind": "counter", "file": fileno, "t0": tw,
-                        "name": "backlog",
-                        "args": {
-                            "feed": rec.get("feed_backlog", 0),
-                            "write": rec.get("write_backlog", 0),
-                        },
-                    })
-                elif ev == "write_done":
-                    tile_id, r_s = rec["tile_id"], rec["record_s"]
-                    cur["record_s"].append(r_s)
-                    spans.append({
-                        "kind": "slice", "file": fileno, "tid": "write",
-                        "name": f"tile {tile_id}",
-                        "t0": tw - r_s, "dur": r_s,
-                        "args": {"bytes": rec.get("bytes")},
-                    })
-                elif ev == "tile_retry":
-                    tile_id = rec["tile_id"]
-                    cur["retries"] += 1
-                    spans.append({
-                        "kind": "instant", "file": fileno, "tid": "device-wait",
-                        "name": f"retry tile {tile_id}", "t0": tw,
-                        "args": {"error": rec.get("error")},
-                    })
-                elif ev == "tile_failed":
-                    tile_id = rec["tile_id"]
-                    cur["failures"] += 1
-                    spans.append({
-                        "kind": "instant", "file": fileno, "tid": "device-wait",
-                        "name": f"FAILED tile {tile_id}", "t0": tw,
-                        "args": {"error": rec.get("error")},
-                    })
-                elif ev == "run_done":
-                    host_info.update(
-                        status=rec.get("status"), wall_s=rec.get("wall_s"),
-                        px_per_s=rec.get("px_per_s"),
-                    )
-                    for k, v in (rec.get("stage_s") or {}).items():
-                        cur["stage_s"][k] = cur["stage_s"].get(k, 0.0) + v
-            except (KeyError, TypeError):
-                # a field-incomplete record (torn write, foreign schema)
-                # must not kill a post-mortem fold
-                malformed += 1
-            else:
-                cur["counts"][ev] = cur["counts"].get(ev, 0) + 1
+        any_line = False
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    malformed += 1
+                    if errs is not None:
+                        errs.append(f"line {i}: malformed JSON ({e})")
+                    continue
+                if errs is not None:
+                    if not any_line and isinstance(rec, dict) and rec.get("ev") != "run_start":
+                        errs.append(
+                            f"line {i}: first event is {rec.get('ev')!r}, "
+                            "expected 'run_start'"
+                        )
+                    errs.extend(validate_event(rec, lineno=i))
+                any_line = True
+                if not isinstance(rec, dict) or not isinstance(rec.get("ev"), str):
+                    # torn/foreign JSON that still parsed (e.g. a truncated
+                    # prefix that happens to be valid) is malformed, not an
+                    # event type of its own
+                    malformed += 1
+                    continue
+                ev = rec["ev"]
+                # required fields are read into locals FIRST, aggregates
+                # mutated only after they all resolved: a field-incomplete
+                # record must count as malformed alone, never half-fold (a
+                # tile_done missing px_per_s must not leave its compute_s in
+                # the stats and be double-counted under event_counts too)
+                try:
+                    tw = _wall_anchored(scopes, rec)
+                    if ev == "run_start":
+                        t_wall, t_mono = rec["t_wall"], rec["t_mono"]
+                        scopes.append({"t_wall": t_wall, "t_mono": t_mono})
+                        tw = t_wall
+                        cur = _fresh_scope()  # aggregates describe the LAST scope
+                        starts.clear()
+                        # a previous scope's run_done must not leak into this
+                        # scope's rollup — run_scope_reset is the SHARED
+                        # reset contract with summarize_events_file
+                        host_info.update(
+                            run_scope_reset(rec, default_process_index=fileno),
+                            impl=rec.get("impl"),
+                            mesh_devices=rec.get("mesh_devices"),
+                        )
+                    elif ev == "tile_start":
+                        starts[rec["tile_id"]] = tw
+                    elif ev == "tile_done":
+                        tile_id, c_s, pps = rec["tile_id"], rec["compute_s"], rec["px_per_s"]
+                        cur["compute_s"].append(c_s)
+                        cur["px_per_s"].append(pps)
+                        cur["pixels"] += rec.get("px", 0)
+                        cur["max_feed_backlog"] = max(
+                            cur["max_feed_backlog"], rec.get("feed_backlog", 0)
+                        )
+                        cur["max_write_backlog"] = max(
+                            cur["max_write_backlog"], rec.get("write_backlog", 0)
+                        )
+                        t0 = starts.pop(tile_id, tw - c_s)
+                        spans.append({
+                            "kind": "slice", "file": fileno, "tid": "device-wait",
+                            "name": f"tile {tile_id}", "t0": t0,
+                            "dur": max(c_s, tw - t0),
+                            "args": {"px": rec.get("px"), "px_per_s": pps},
+                        })
+                        spans.append({
+                            "kind": "counter", "file": fileno, "t0": tw,
+                            "name": "backlog",
+                            "args": {
+                                "feed": rec.get("feed_backlog", 0),
+                                "write": rec.get("write_backlog", 0),
+                            },
+                        })
+                    elif ev == "write_done":
+                        tile_id, r_s = rec["tile_id"], rec["record_s"]
+                        cur["record_s"].append(r_s)
+                        spans.append({
+                            "kind": "slice", "file": fileno, "tid": "write",
+                            "name": f"tile {tile_id}",
+                            "t0": tw - r_s, "dur": r_s,
+                            "args": {"bytes": rec.get("bytes")},
+                        })
+                    elif ev == "tile_retry":
+                        tile_id = rec["tile_id"]
+                        cur["retries"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "device-wait",
+                            "name": f"retry tile {tile_id}", "t0": tw,
+                            "args": {"error": rec.get("error")},
+                        })
+                    elif ev == "tile_failed":
+                        tile_id = rec["tile_id"]
+                        cur["failures"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "device-wait",
+                            "name": f"FAILED tile {tile_id}", "t0": tw,
+                            "args": {"error": rec.get("error")},
+                        })
+                    elif ev == "feed_cache":
+                        # the per-run rollup from the feed-decode subsystem
+                        # (io/blockcache): required counters must resolve
+                        # before the scope keeps it; one per scope, last wins
+                        cur["feed_cache"] = {
+                            "hits": rec["hits"],
+                            "misses": rec["misses"],
+                            "evictions": rec["evictions"],
+                            "decode_s": rec["decode_s"],
+                            **{
+                                k: rec[k]
+                                for k in (*_FEED_CACHE_COUNTERS, *_FEED_CACHE_GAUGES)
+                                if k in rec
+                            },
+                        }
+                    elif ev == "run_done":
+                        host_info.update(
+                            status=rec.get("status"), wall_s=rec.get("wall_s"),
+                            px_per_s=rec.get("px_per_s"),
+                        )
+                        for k, v in (rec.get("stage_s") or {}).items():
+                            cur["stage_s"][k] = cur["stage_s"].get(k, 0.0) + v
+                except (KeyError, TypeError):
+                    # a field-incomplete record (torn write, foreign schema)
+                    # must not kill a post-mortem fold
+                    malformed += 1
+                else:
+                    cur["counts"][ev] = cur["counts"].get(ev, 0) + 1
+        if errs is not None and not any_line:
+            errs.append("file contains no events")
         hosts.append(host_info)
         folded.append(cur)
 
@@ -245,6 +306,7 @@ def fold(paths: list[str]) -> tuple[dict, list[dict]]:
         "max_feed_backlog": max((c["max_feed_backlog"] for c in folded), default=0),
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
+        "feed_cache": _merge_feed_cache(folded),
         "hosts": hosts,
     }
     return report, spans
@@ -320,9 +382,14 @@ def main(argv: list[str] | None = None) -> int:
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if not args.no_validate:
-        all_errs = {p: validate_events_file(p) for p in paths}
-        bad = {p: e for p, e in all_errs.items() if e}
+    # lint and fold in ONE pass per file (fold collects the schema errors
+    # while aggregating); a failed lint still refuses to report
+    schema_errors: "dict[str, list[str]] | None" = (
+        None if args.no_validate else {}
+    )
+    report, spans = fold(paths, schema_errors=schema_errors)
+    if schema_errors is not None:
+        bad = {p: e for p, e in schema_errors.items() if e}
         if bad:
             for p, errs in bad.items():
                 for e in errs[:10]:
@@ -330,7 +397,6 @@ def main(argv: list[str] | None = None) -> int:
             print("error: schema validation failed (use --no-validate to "
                   "fold anyway)", file=sys.stderr)
             return 1
-    report, spans = fold(paths)
     if args.trace:
         report["trace"] = {
             "path": args.trace,
