@@ -98,6 +98,22 @@ def build_parser() -> argparse.ArgumentParser:
                      help="fetch float products from the device as float16 "
                           "(halves device->host bytes; opt-in lossy packing "
                           "within the f32 tolerance contract)")
+    seg.add_argument("--no-packed-fetch", action="store_true",
+                     help="force the per-product synchronous device->host "
+                          "fetch (default 'auto' packs every tile's "
+                          "products into ONE async transfer on "
+                          "accelerator backends; artifacts are "
+                          "byte-identical either way)")
+    seg.add_argument("--packed-fetch", action="store_true",
+                     help="force the packed fetch path even on CPU "
+                          "backends (where np.asarray is zero-copy and "
+                          "auto keeps the per-product path)")
+    seg.add_argument("--fetch-depth", type=int, default=2,
+                     help="bound on in-flight async packed fetches: tile "
+                          "i's readback lands while tiles up to "
+                          "i+fetch_depth compute (raise on high-latency "
+                          "links; memory grows one packed tile + one fed "
+                          "input per step)")
     seg.add_argument("--lazy", action="store_true",
                      help="windowed file-backed ingest (C2 per-band layout "
                           "only): no input cube in host RAM — for scenes "
@@ -568,6 +584,12 @@ def main(argv: list[str] | None = None) -> int:
                     file=sys.stderr,
                 )
                 return 2
+        if args.no_packed_fetch and args.packed_fetch:
+            print(
+                "error: --packed-fetch conflicts with --no-packed-fetch",
+                file=sys.stderr,
+            )
+            return 2
         try:
             cfg = RunConfig(
                 index=args.index,
@@ -584,6 +606,11 @@ def main(argv: list[str] | None = None) -> int:
                     if args.products else None
                 ),
                 fetch_f16=args.fetch_f16,
+                fetch_packed=(
+                    False if args.no_packed_fetch
+                    else True if args.packed_fetch else "auto"
+                ),
+                fetch_depth=args.fetch_depth,
                 scale=args.scale,
                 offset=args.offset,
                 out_compress=args.out_compress,

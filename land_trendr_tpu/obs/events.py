@@ -110,6 +110,18 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "evictions": int,
         "decode_s": _NUM,
     },
+    # device→host fetch subsystem rollup (runtime/fetch): one terminal
+    # event per run scope — transfer counts (packed = 1 per tile), wire
+    # bytes, and the pack/wait/unpack second split.  Additive event type,
+    # introduced without a schema bump (like feed_cache).
+    "fetch": {
+        "tiles": int,
+        "transfers": int,
+        "bytes": int,
+        "pack_s": _NUM,
+        "wait_s": _NUM,
+        "unpack_s": _NUM,
+    },
     "run_done": {
         "status": str,  # "ok" | "aborted"
         "tiles_done": int,
@@ -122,7 +134,7 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
 
 #: well-known OPTIONAL fields: type-checked when present, never required
 OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
-    "tile_done": {"device_bytes_in_use": _NUM},
+    "tile_done": {"device_bytes_in_use": _NUM, "fetch_backlog": int},
     # no px_per_s here: the manifest meta's rate is over PADDED tile
     # pixels; tile_done's real-pixel px_per_s is the stream's one
     # throughput number (extra fields still validate — see module doc)
@@ -135,6 +147,7 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "cache_bytes": int,
         "budget_bytes": int,
     },
+    "fetch": {"packed": bool, "backlog_max": int},
     "run_done": {"stage_s": dict},
 }
 

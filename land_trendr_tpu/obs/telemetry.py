@@ -125,6 +125,9 @@ class Telemetry:
         self._write_backlog = r.gauge(
             "lt_write_backlog", "finished tiles waiting in the writer pool"
         )
+        self._fetch_backlog = r.gauge(
+            "lt_fetch_backlog", "in-flight async device->host fetches"
+        )
         self._dev_bytes = r.gauge(
             "lt_device_bytes_in_use", "device allocator live bytes (all local devices)"
         )
@@ -157,6 +160,33 @@ class Telemetry:
         )
         self._fc_bytes = r.gauge(
             "lt_feed_cache_bytes", "decoded-block cache occupancy (bytes)"
+        )
+        # device→host fetch subsystem (runtime/fetch): run-scoped counters
+        # folded in once per run by Telemetry.fetch
+        self._fx_tiles = r.counter(
+            "lt_fetch_tiles_total", "tiles whose outputs were fetched to host"
+        )
+        self._fx_transfers = r.counter(
+            "lt_fetch_transfers_total",
+            "device->host transfers issued (packed fetch = 1 per tile)",
+        )
+        self._fx_bytes = r.counter(
+            "lt_fetch_bytes_total", "device->host wire bytes fetched"
+        )
+        self._fx_pack_s = r.counter(
+            "lt_fetch_pack_seconds_total",
+            "host seconds dispatching the device-side pack program",
+        )
+        self._fx_wait_s = r.counter(
+            "lt_fetch_wait_seconds_total",
+            "host seconds blocked waiting for fetched bytes to land",
+        )
+        self._fx_unpack_s = r.counter(
+            "lt_fetch_unpack_seconds_total",
+            "host seconds unpacking landed bytes into artifact arrays",
+        )
+        self._fx_backlog = r.gauge(
+            "lt_fetch_backlog_max", "high watermark of in-flight async fetches"
         )
         if fingerprint:
             r.gauge(
@@ -207,6 +237,7 @@ class Telemetry:
         feed_backlog: int,
         write_backlog: int,
         device_bytes_in_use: int | None = None,
+        fetch_backlog: int | None = None,
     ) -> None:
         pxs = px / compute_s if compute_s > 0 else 0.0
         fields: dict[str, Any] = {}
@@ -214,6 +245,9 @@ class Telemetry:
             self._dev_bytes.set(device_bytes_in_use)
             self._dev_peak.set_max(device_bytes_in_use)
             fields["device_bytes_in_use"] = device_bytes_in_use
+        if fetch_backlog is not None:
+            self._fetch_backlog.set(fetch_backlog)
+            fields["fetch_backlog"] = fetch_backlog
         self.events.emit(
             "tile_done",
             tile_id=tile_id,
@@ -296,6 +330,36 @@ class Telemetry:
         self._fc_ra_hits.inc(fields.get("readahead_hits", 0))
         if "cache_bytes" in fields:
             self._fc_bytes.set(fields["cache_bytes"])
+
+    def fetch(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's device→host fetch counters into the stream.
+
+        ``stats`` is a :meth:`land_trendr_tpu.runtime.fetch.TileFetcher.
+        summary` dict; the driver calls this once, right before
+        ``run_done`` (success and abort paths alike).  Emits the
+        ``fetch`` event and advances the ``lt_fetch_*`` instruments.
+        """
+        fields: dict[str, Any] = {
+            "tiles": int(stats.get("tiles", 0)),
+            "transfers": int(stats.get("transfers", 0)),
+            "bytes": int(stats.get("bytes", 0)),
+            "pack_s": round(float(stats.get("pack_s", 0.0)), 6),
+            "wait_s": round(float(stats.get("wait_s", 0.0)), 6),
+            "unpack_s": round(float(stats.get("unpack_s", 0.0)), 6),
+        }
+        if "backlog_max" in stats:
+            fields["backlog_max"] = int(stats["backlog_max"])
+        if "packed" in stats:
+            fields["packed"] = bool(stats["packed"])
+        self.events.emit("fetch", **fields)
+        self._fx_tiles.inc(fields["tiles"])
+        self._fx_transfers.inc(fields["transfers"])
+        self._fx_bytes.inc(fields["bytes"])
+        self._fx_pack_s.inc(fields["pack_s"])
+        self._fx_wait_s.inc(fields["wait_s"])
+        self._fx_unpack_s.inc(fields["unpack_s"])
+        if "backlog_max" in fields:
+            self._fx_backlog.set_max(fields["backlog_max"])
 
     def run_done(
         self,

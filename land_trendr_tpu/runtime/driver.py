@@ -43,7 +43,6 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from land_trendr_tpu.config import LTParams
@@ -52,6 +51,7 @@ from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
 from land_trendr_tpu.ops.tile import PALLAS_BLOCK, process_tile_dn, resolve_impl
+from land_trendr_tpu.runtime import fetch as fetchmod
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
     TileManifest,
@@ -101,6 +101,25 @@ class RunConfig:
     #: saving in any deployment.  Not fingerprinted content-wise — but it
     #: changes written values, so it IS part of the run fingerprint.
     fetch_f16: bool = False
+    #: device→host fetch strategy (:mod:`land_trendr_tpu.runtime.fetch`):
+    #: ``"auto"`` (default) packs every tile's selected products into ONE
+    #: contiguous device buffer — one D2H transfer per tile instead of
+    #: ~10 latency-bound per-product ones, with ``fetch_f16`` casts fused
+    #: into the pack program and the transfer overlapping the next tile's
+    #: compute — on accelerator backends, and keeps the per-product path
+    #: on CPU (where ``np.asarray`` is zero-copy and packing is pure
+    #: overhead).  ``True``/``False`` force.  A pure execution strategy:
+    #: packed and unpacked artifacts are byte-identical (pinned by
+    #: ``tests/test_fetch.py``), so it is NOT fingerprinted and a resume
+    #: may mix the two.
+    fetch_packed: "bool | str" = "auto"
+    #: bound on in-flight packed fetches: tile ``i``'s readback lands
+    #: while tiles up to ``i + fetch_depth`` compute.  Host memory grows
+    #: by one packed tile buffer plus one fed input (kept for the retry
+    #: ladder — an async-fetch device error re-dispatches from it) per
+    #: depth step; 2 gives full compute/readback overlap for a
+    #: steady-state pipeline.
+    fetch_depth: int = 2
     #: fuse on-device change-map selection into every tile's program
     #: (ops/change.select_change over arrays already in HBM); the per-tile
     #: change products ride the manifest and assemble into change_*.tif
@@ -228,6 +247,13 @@ class RunConfig:
                 f"chunk_px={self.chunk_px} must be a multiple of "
                 f"{PALLAS_BLOCK} (the Pallas block) when impl='pallas'"
             )
+        if self.fetch_packed not in (True, False, "auto"):
+            raise ValueError(
+                f"fetch_packed={self.fetch_packed!r} not one of True, "
+                "False, 'auto'"
+            )
+        if self.fetch_depth < 1:
+            raise ValueError(f"fetch_depth={self.fetch_depth} must be >= 1")
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
         if self.feed_workers < 1:
@@ -304,12 +330,6 @@ class RunConfig:
         )
 
 
-@jax.jit
-def _jit_f16(a):
-    """Device-side f16 cast for the packed fetch path (one tiny program)."""
-    return a.astype(jnp.float16)
-
-
 def _device_live_bytes() -> "int | None":
     """Sum of allocator live bytes across local devices, or None where the
     backend exposes no ``memory_stats`` (CPU) — the HBM watermark feed for
@@ -327,12 +347,11 @@ def _device_live_bytes() -> "int | None":
 
 
 #: the full per-pixel segmentation product set (RunConfig.products domain);
-#: "fitted" is governed by write_fitted, change_*/ftv_* by their own knobs
-_SEG_PRODUCTS = (
-    "n_vertices", "vertex_indices", "vertex_years", "vertex_src_vals",
-    "vertex_fit_vals", "seg_magnitude", "seg_duration", "seg_rate",
-    "rmse", "p_of_f", "model_valid",
-)
+#: "fitted" is governed by write_fitted, change_*/ftv_* by their own knobs.
+#: Canonical home is the fetch plan (runtime/fetch.py), which must know
+#: every product's wire representation; re-exported here for config
+#: validation and existing importers.
+_SEG_PRODUCTS = fetchmod.SEG_PRODUCTS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -436,47 +455,14 @@ def _tile_arrays(out, t: TileSpec, cfg: RunConfig) -> dict[str, np.ndarray]:
     healthy-forest NBR reads +0.7, and a disturbance is a ``seg_magnitude``
     drop — matching the reference's output convention (indices.py contract).
     Durations, rmse, p-of-F and vertex bookkeeping are sign-invariant.
+
+    Thin synchronous wrapper over the fetch subsystem's per-product path
+    (:mod:`land_trendr_tpu.runtime.fetch`) for tools that fetch single
+    tiles outside a run (``tools/host_path_bench.py``); ``run_stack``
+    itself drives :class:`~land_trendr_tpu.runtime.fetch.TileFetcher`
+    directly so packed transfers overlap compute.
     """
-    px = t.h * t.w
-    sign = idx.DISTURBANCE_SIGN[cfg.index.lower()]
-
-    def fetch(dev_arr, signed: bool = False) -> np.ndarray:
-        # device→host transfer happens HERE, per selected product — an
-        # unselected product is never fetched (round 4's tree_map fetched
-        # every SegOutputs field and filtered afterwards: ~2× the bytes a
-        # subset run needs, and on a tunneled chip the fetch IS the
-        # critical path — SCENE_TPU_r04.json write_s 96%).  fetch_f16
-        # halves float bytes on the wire: the cast runs on device, the
-        # manifest keeps f32 schema (values quantized to f16 — opt-in,
-        # bounded by the f32 tolerance contract's much larger envelope).
-        a = dev_arr
-        if cfg.fetch_f16 and jnp.issubdtype(a.dtype, jnp.floating):
-            a = _jit_f16(a)
-        host = np.asarray(a)
-        if host.dtype == np.float16:
-            host = host.astype(np.float32)
-        return (sign * host[:px]) if signed else host[:px]
-
-    signed_products = {
-        "vertex_src_vals", "vertex_fit_vals", "seg_magnitude", "seg_rate",
-    }
-    want = _SEG_PRODUCTS if cfg.products is None else cfg.products
-    arrays: dict[str, np.ndarray] = {
-        name: fetch(getattr(out.seg, name), name in signed_products)
-        for name in _SEG_PRODUCTS if name in want
-    }
-    if cfg.write_fitted:
-        arrays["fitted"] = fetch(out.seg.fitted, True)
-    if out.change is not None:
-        for name, arr in out.change.items():
-            a = fetch(arr)
-            if name == "yod":
-                a = a.astype(np.int32)
-            elif name != "mask":
-                a = a.astype(np.float32)
-            arrays[f"change_{name}"] = a
-    for name, arr in out.ftv.items():
-        arrays[f"ftv_{name}"] = idx.DISTURBANCE_SIGN[name.lower()] * fetch(arr)
+    arrays, _fit = fetchmod.TileFetcher(cfg, packed=False).start(out).tile_arrays(t)
     return arrays
 
 
@@ -510,10 +496,15 @@ def run_stack(
     (feed) and a pool of ``cfg.write_workers`` background writer threads
     persists earlier tiles' artifacts.  ``block_until_ready`` on tile
     ``i`` happens only after tile ``i+1`` has been fed and dispatched.
-    The write queue is bounded at ``write_workers`` in-flight jobs (the
-    oldest is collected before a new one is submitted — backpressure and
-    fail-fast for writer errors), so at most ``write_workers + 2`` tiles
-    are live at once and host memory stays bounded.
+    Device→host readback is its own pipeline stage
+    (:mod:`land_trendr_tpu.runtime.fetch`): with the packed fetch path a
+    completed tile's products leave the device as ONE asynchronous
+    transfer that lands while the next tiles compute, bounded at
+    ``cfg.fetch_depth`` in flight.  The write queue is bounded at
+    ``write_workers`` in-flight jobs (the oldest is collected before a
+    new one is submitted — backpressure and fail-fast for writer
+    errors), so at most ``write_workers + fetch_depth + 2`` tiles are
+    live at once and host memory stays bounded.
 
     A tile that fails — at dispatch or when its result is awaited — is
     retried synchronously up to ``max_retries`` times before the run
@@ -608,6 +599,7 @@ def run_stack(
         chunk = cfg.chunk_px
 
     impl_resolved = resolve_impl(cfg.impl)
+    fetch_packed = fetchmod.resolve_packed(cfg.fetch_packed)
     if (
         impl_resolved == "pallas"
         and chunk is not None
@@ -662,21 +654,27 @@ def run_stack(
         except Exception as e:  # exercised via fault-injection tests
             return None, e
 
-    def _write_job(t: TileSpec, out, dt: float) -> tuple[int, int]:
+    # the fetch subsystem (runtime/fetch.py): packed mode moves every
+    # tile's products in ONE device→host transfer issued asynchronously
+    # right after the tile's compute completes, so readback of tile i
+    # overlaps compute of tile i+1; unpacked mode is the per-product
+    # synchronous path, byte-identical artifacts either way
+    fetcher = fetchmod.TileFetcher(cfg, packed=fetch_packed)
+
+    def _write_job(t: TileSpec, handle, dt: float) -> tuple[int, int]:
         # StageTimer accumulation is locked, so concurrent writer threads
         # may share the "write" key; with write_workers > 1 the summed
         # write_s can legitimately exceed wall time.
         with timer.stage("write"):
-            arrays = _tile_arrays(out, t, cfg)
+            # packed: pure host unpack of already-landed bytes; unpacked:
+            # the per-product synchronous fetch (the pre-packing path).
+            # Either way model_valid rides the same payload, so the
+            # fit-rate metadata never costs a separate blocking device
+            # fetch (review r5 finding: --products without model_valid
+            # crashed every tile write; its fix cost one extra transfer
+            # per tile, now folded away)
+            arrays, fit = handle.tile_arrays(t)
             px = t.h * t.w
-            # fit-rate metadata needs model_valid even when the product
-            # subset excludes it from the ARTIFACT: one extra device
-            # fetch of 1 B/px, not a schema change (review r5 finding:
-            # --products without model_valid crashed every tile write)
-            if "model_valid" in arrays:
-                fit = int(arrays["model_valid"].sum())
-            else:
-                fit = int(np.asarray(out.seg.model_valid[:px]).sum())
             meta = {
                 "y0": t.y0,
                 "x0": t.x0,
@@ -702,6 +700,7 @@ def run_stack(
         max_workers=cfg.write_workers, thread_name_prefix="lt-writer"
     )
     pending_writes: deque = deque()  # bounded at write_workers in flight
+    pending_fetches: deque = deque()  # bounded at fetch_depth in flight
     n_px = 0
     n_fit = 0
     n_done = 0
@@ -718,21 +717,21 @@ def run_stack(
         while len(pending_writes) > limit:
             _collect_write(pending_writes.popleft())
 
-    def _finish(pending) -> None:
-        """Await one in-flight tile (retrying on failure) and queue its write."""
-        nonlocal n_done
-        t, out, err, dn, qa, dt_dispatch = pending
-        attempt = 1
+    def _submit_write(t: TileSpec, handle, dt: float) -> None:
+        _drain_writes(cfg.write_workers - 1)
+        pending_writes.append(writer.submit(_write_job, t, handle, dt))
+
+    def _retry_ladder(t: TileSpec, dn, qa, attempt: int, err):
+        """Synchronous tile retry from the retained inputs.
+
+        Shared by ``_finish`` (dispatch / device-wait / pack failures) and
+        ``_drain_fetches`` (a device error surfacing through an in-flight
+        async fetch): re-dispatches until the tile completes THROUGH a
+        landed fetch — the fault already broke the pipeline, so the
+        re-fetch is resolved synchronously before pipelining resumes.
+        Returns ``(handle, dt, attempt)`` or raises after ``max_retries``.
+        """
         while True:
-            if err is None:
-                try:
-                    t0 = time.perf_counter()
-                    with timer.stage("compute"):
-                        jax.block_until_ready(out)
-                    dt = dt_dispatch + (time.perf_counter() - t0)
-                    break
-                except Exception as e:  # device-side failure surfaces here
-                    err = e
             log.warning(
                 "tile %d attempt %d/%d failed: %s",
                 t.tile_id, attempt, cfg.max_retries + 1, err,
@@ -750,7 +749,30 @@ def run_stack(
                 telemetry.tile_start(t.tile_id, attempt=attempt)
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
-            dt_dispatch = time.perf_counter() - t0
+            if err is not None:
+                continue
+            try:
+                with timer.stage("compute"):
+                    jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                with timer.stage("fetch"):
+                    handle = fetcher.start(out)
+                    handle.wait()
+                return handle, dt, attempt
+            except Exception as e:  # device-side failure surfaces here
+                err = e
+
+    def _tile_completed(t: TileSpec, dt: float) -> None:
+        """Emit tile_done and count the tile.
+
+        On the packed path this fires only once the async fetch has
+        LANDED — a tile whose fetch later exhausts its retries appears in
+        the stream as a failure only, never as done-then-failed.  The
+        per-product fallback keeps its historical semantics: tile_done at
+        compute completion, with the synchronous fetches in the write job
+        behind it (an error there aborts the run via the writer's
+        fail-fast, exactly as before this subsystem existed)."""
+        nonlocal n_done
         n_done += 1
         if telemetry is not None:
             telemetry.tile_done(
@@ -760,9 +782,62 @@ def run_stack(
                 feed_backlog=len(pending_feeds),
                 write_backlog=len(pending_writes),
                 device_bytes_in_use=_device_live_bytes(),
+                fetch_backlog=len(pending_fetches),
             )
-        _drain_writes(cfg.write_workers - 1)
-        pending_writes.append(writer.submit(_write_job, t, out, dt))
+
+    def _drain_fetches(limit: int) -> None:
+        """Collect oldest in-flight fetches until at most ``limit`` remain.
+
+        The wait here is where the packed transfer's landing is awaited —
+        overlapped with the newer tiles' compute already dispatched behind
+        it.  A device error surfacing through the async fetch re-enters
+        the retry ladder; the fed inputs ride the backlog entry for
+        exactly that.  Landed tiles hand off to the writer pool.
+        """
+        while len(pending_fetches) > limit:
+            t, handle, dn, qa, dt, attempt = pending_fetches.popleft()
+            try:
+                with timer.stage("fetch"):
+                    handle.wait()
+            except Exception as err:
+                handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+            _tile_completed(t, dt)
+            _submit_write(t, handle, dt)
+
+    def _finish(pending) -> None:
+        """Await one in-flight tile (retrying on failure), issue its async
+        fetch, and queue writes as the bounded fetch backlog drains."""
+        t, out, err, dn, qa, dt_dispatch = pending
+        attempt = 1
+        handle = None
+        if err is None:
+            try:
+                t0 = time.perf_counter()
+                with timer.stage("compute"):
+                    jax.block_until_ready(out)
+                dt = dt_dispatch + (time.perf_counter() - t0)
+                with timer.stage("fetch"):
+                    # async: the packed buffer lands while the next tiles
+                    # compute; the per-product fallback defers its
+                    # (synchronous) transfers to the writer pool instead
+                    handle = fetcher.start(out)
+            except Exception as e:  # device-side failure surfaces here
+                err = e
+        if err is not None:
+            handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+        if not fetcher.packed:
+            # per-product fallback: the pre-packing flow exactly — the
+            # write job runs the synchronous fetches itself, nothing to
+            # overlap, no retained inputs beyond this call
+            _tile_completed(t, dt)
+            _submit_write(t, handle, dt)
+            return
+        # the retained (dn, qa) ride the backlog for the retry ladder: a
+        # device error surfacing through the in-flight fetch re-dispatches
+        # from them.  Bounded at fetch_depth entries.
+        pending_fetches.append((t, handle, dn, qa, dt, attempt))
+        fetcher.note_backlog(len(pending_fetches))
+        _drain_fetches(cfg.fetch_depth - 1)
 
     # feed pool, mirroring the writer pool on the input side (VERDICT r3
     # next-round item #3): ``cfg.feed_workers`` threads run the native
@@ -876,6 +951,7 @@ def run_stack(
                 pending = (t, out, err, dn, qa, dt_dispatch)
         if pending is not None:
             _finish(pending)
+        _drain_fetches(0)
         _drain_writes(0)
         run_ok = True
     finally:
@@ -909,6 +985,10 @@ def run_stack(
                     telemetry.feed_cache(
                         blockcache.stats_delta(feed_cache_base)
                     )
+                # fetch rollup likewise: a run that died mid-readback is
+                # the one whose transfer/wait counters the post-mortem
+                # needs
+                telemetry.fetch(fetcher.summary())
                 telemetry.run_done(
                     "aborted",
                     tiles_done=n_done,
@@ -941,12 +1021,15 @@ def run_stack(
     feed_cache_stats = blockcache.stats_delta(feed_cache_base)
     if cfg.feed_cache_mb:
         summary["feed_cache"] = feed_cache_stats
+    summary["fetch"] = fetcher.summary()
     if telemetry is not None:
         if cfg.feed_cache_mb:
             # one terminal rollup per run scope (matching the run-scoped
             # stage_s), not a per-tile stream: the counters are cheap but
             # the EVENT volume wouldn't be
             telemetry.feed_cache(feed_cache_stats)
+        # same one-rollup-per-scope shape for the fetch subsystem
+        telemetry.fetch(summary["fetch"])
         try:
             telemetry.run_done(
                 "ok",

@@ -1,0 +1,472 @@
+"""Packed async device→host fetch: one transfer per tile, overlapped.
+
+`SCENE_TPU_r04.json` measured the fetch half of the host path at 96% of
+scene wall on a tunneled chip: each tile's outputs left the device as ~10
+independent per-product `np.asarray` calls, every one paying the link's
+per-transfer latency, all of them serialized inside the write stage.  PR 2
+fixed the *feed* half of the host path (`io/blockcache.py`); this module
+is the *fetch* half — the host-I/O-bound regime the massively-parallel
+break-detection literature names as the practical ceiling for per-pixel
+time-series analysis (Gieseke et al., arXiv:1807.01751).
+
+Three pieces:
+
+* **Device-side pack** (:func:`pack_tile`): one tiny jitted program
+  bitcasts every selected product — seg products, fitted, change, FTV,
+  and the always-needed ``model_valid`` byte — into a single contiguous
+  ``uint32`` word buffer (words, not bytes: XLA's byte-element concat
+  measured ~4× slower for identical output).  ``fetch_f16`` casts are
+  fused into the same program, so a tile costs ONE device→host transfer
+  instead of ~10 latency-bound small ones.
+* **Async overlap**: the driver issues :meth:`TileFetcher.start` right
+  after ``block_until_ready`` — the packed buffer starts its
+  ``copy_to_host_async`` immediately and lands while the NEXT tile
+  computes; a bounded backlog (``RunConfig.fetch_depth``) keeps host
+  memory and retry state bounded.  :meth:`FetchHandle.wait` blocks only
+  on transfers that have not landed yet.
+* **Host-side unpack** (:func:`unpack_tile`): crop to the tile's real
+  pixels FIRST, then f16→f32 upcast / sign flip / dtype conversion —
+  byte-for-byte the per-product path's output, without the per-product
+  path's full-padded-shape upcast allocation.
+
+The contract: ``packed`` and ``unpacked`` runs produce **byte-identical
+artifacts** (``tests/test_fetch.py`` pins the matrix), because both paths
+are driven by the same :class:`FetchPlan` — the single description of
+what leaves the device, in what order, at what wire dtype, and how it is
+restored on host.  ``fetch_packed="auto"`` resolves to packed only where
+it pays: on a CPU backend ``np.asarray`` is zero-copy and the pack
+program would be pure overhead, so auto keeps the per-product path there.
+
+Everything here is a pure execution strategy — nothing is fingerprinted,
+and a resume may freely mix packed and unpacked tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import sys
+import threading
+import time
+from typing import TYPE_CHECKING, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from land_trendr_tpu.ops import indices as idx
+
+if TYPE_CHECKING:  # pragma: no cover - type-only imports (cycle with driver)
+    from land_trendr_tpu.ops.tile import TileOutputs
+    from land_trendr_tpu.runtime.driver import RunConfig, TileSpec
+
+__all__ = [
+    "SEG_PRODUCTS",
+    "SIGNED_PRODUCTS",
+    "FetchPlan",
+    "PlanEntry",
+    "TileFetcher",
+    "build_plan",
+    "pack_tile",
+    "plan_wire_bytes",
+    "resolve_packed",
+    "unpack_tile",
+]
+
+#: the full per-pixel segmentation product set (``RunConfig.products``
+#: domain); "fitted" is governed by ``write_fitted``, change_*/ftv_* by
+#: their own knobs.  Lives here (not driver.py) because the fetch plan is
+#: the one place that must know every product's wire representation.
+SEG_PRODUCTS = (
+    "n_vertices", "vertex_indices", "vertex_years", "vertex_src_vals",
+    "vertex_fit_vals", "seg_magnitude", "seg_duration", "seg_rate",
+    "rmse", "p_of_f", "model_valid",
+)
+
+#: value-carrying products that flip with the index's disturbance sign
+#: (must match cli._SIGNED_FIELDS and the raster orientation contract)
+SIGNED_PRODUCTS = frozenset(
+    {"vertex_src_vals", "vertex_fit_vals", "seg_magnitude", "seg_rate"}
+)
+
+
+class PlanEntry(NamedTuple):
+    """One product's place in the packed wire format.
+
+    ``key`` is the artifact name (``""`` for the ``model_valid`` rider
+    that travels only for the fit-rate metadata); ``src``/``field``
+    resolve the device array inside a :class:`TileOutputs`; ``suffix`` is
+    the per-pixel shape; ``dtype`` the device dtype, ``wire`` the dtype
+    that crosses the link (f16 under ``fetch_f16``, uint8 for bool);
+    ``signed``/``sign`` apply the disturbance-orientation flip on host;
+    ``conv`` is the per-product host conversion the unpacked path has
+    always applied (change yod→int32, change floats→float32, bool view).
+    """
+
+    key: str
+    src: str            # "seg" | "change" | "ftv"
+    field: str
+    suffix: tuple[int, ...]
+    dtype: str
+    wire: str
+    signed: bool
+    sign: float
+    conv: str           # "" | "int32" | "float32" | "bool"
+
+
+class FetchPlan(NamedTuple):
+    """Hashable (jit-static) description of one run's tile fetch."""
+
+    entries: tuple[PlanEntry, ...]
+    px: int  # PADDED device pixel count every tile shares
+
+
+def _resolve(out: "TileOutputs", e: PlanEntry):
+    if e.src == "seg":
+        return getattr(out.seg, e.field)
+    if e.src == "change":
+        return out.change[e.field]
+    return out.ftv[e.field]
+
+
+def build_plan(out: "TileOutputs", cfg: "RunConfig") -> FetchPlan:
+    """The run's fetch plan, from the first tile's (shared) output shapes.
+
+    Entry order is the per-product path's historical fetch order, so the
+    two paths stay structurally identical: seg products in
+    :data:`SEG_PRODUCTS` order filtered by ``cfg.products``, fitted,
+    change products, FTV products, then the ``model_valid`` rider when
+    the product subset excludes it (1 B/px in the same transfer — the
+    fit-rate metadata must never cost a separate blocking fetch).
+    """
+    sign = idx.DISTURBANCE_SIGN[cfg.index.lower()]
+    want = SEG_PRODUCTS if cfg.products is None else cfg.products
+    entries: list[PlanEntry] = []
+
+    def add(key, src, field, arr, signed=False, sgn=1.0, conv=""):
+        dt = np.dtype(arr.dtype)
+        if dt == np.bool_:
+            wire = "uint8"
+            conv = conv or "bool"
+        elif cfg.fetch_f16 and np.issubdtype(dt, np.floating):
+            wire = "float16"
+        else:
+            wire = dt.name
+        entries.append(
+            PlanEntry(
+                key, src, field, tuple(int(s) for s in arr.shape[1:]),
+                dt.name, wire, bool(signed), float(sgn), conv,
+            )
+        )
+
+    for name in SEG_PRODUCTS:
+        if name in want:
+            add(
+                name, "seg", name, getattr(out.seg, name),
+                signed=name in SIGNED_PRODUCTS, sgn=sign,
+            )
+    if cfg.write_fitted:
+        add("fitted", "seg", "fitted", out.seg.fitted, signed=True, sgn=sign)
+    if out.change is not None:
+        for name, arr in out.change.items():
+            conv = "int32" if name == "yod" else (
+                "" if name == "mask" else "float32"
+            )
+            add(f"change_{name}", "change", name, arr, conv=conv)
+    for name, arr in out.ftv.items():
+        add(
+            f"ftv_{name}", "ftv", name, arr,
+            signed=True, sgn=idx.DISTURBANCE_SIGN[name.lower()],
+        )
+    if "model_valid" not in want:
+        add("", "seg", "model_valid", out.seg.model_valid)
+    return FetchPlan(
+        entries=tuple(entries), px=int(out.seg.model_valid.shape[0])
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _layout(plan: FetchPlan) -> tuple[tuple[tuple[int, int], ...], int]:
+    """Per-entry ``(byte_offset, real_bytes)`` and the total wire bytes.
+
+    Every entry starts on a word boundary (sub-word entries — bool, f16 —
+    are zero-padded to the next word on device), so host unpack is a pure
+    reinterpreting view at a known offset.
+    """
+    offs: list[tuple[int, int]] = []
+    off = 0
+    for e in plan.entries:
+        n = plan.px * math.prod(e.suffix) * np.dtype(e.wire).itemsize
+        offs.append((off, n))
+        off += 4 * ((n + 3) // 4)
+    return tuple(offs), off
+
+
+def plan_wire_bytes(plan: FetchPlan) -> int:
+    """Bytes one packed tile transfer moves (word padding included)."""
+    return _layout(plan)[1]
+
+
+def _to_words(a: jnp.ndarray) -> jnp.ndarray:
+    """Reinterpret any array as a flat little-endian ``uint32`` stream."""
+    it = a.dtype.itemsize
+    if it >= 4:
+        # 4-byte dtypes bitcast 1:1; 8-byte gain a trailing word pair
+        return jax.lax.bitcast_convert_type(a, jnp.uint32).reshape(-1)
+    if it == 2:
+        b = jax.lax.bitcast_convert_type(a, jnp.uint16).reshape(-1)
+        if b.size % 2:
+            b = jnp.concatenate([b, jnp.zeros((1,), jnp.uint16)])
+        return jax.lax.bitcast_convert_type(b.reshape(-1, 2), jnp.uint32)
+    b = a.reshape(-1)
+    if b.size % 4:
+        b = jnp.concatenate([b, jnp.zeros(((-b.size) % 4,), b.dtype)])
+    return jax.lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("plan",))
+def pack_tile(out: "TileOutputs", plan: FetchPlan) -> jnp.ndarray:
+    """One device program: every planned product → one ``uint32`` buffer.
+
+    ``fetch_f16`` casts (``wire`` ≠ ``dtype``) are fused here, so the
+    narrowed representation is what crosses the link.  Unselected fields
+    of ``out`` are dead arguments XLA removes.  Compiles once per run —
+    every tile, edge tiles included, shares the padded pixel count.
+    """
+    parts = []
+    for e in plan.entries:
+        a = _resolve(out, e)
+        if e.wire != e.dtype:
+            a = a.astype(e.wire)
+        parts.append(_to_words(a))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def _post(e: PlanEntry, a: np.ndarray) -> np.ndarray:
+    """Shared host-side restore: f16 upcast → sign flip → conversion.
+
+    Runs AFTER the ``[:px]`` crop (both paths), so the f32 upcast never
+    allocates for padded rows — the pre-PR path upcast the full padded
+    device shape first, wasting up to a tile of host f32 per product.
+    """
+    if a.dtype == np.float16:
+        a = a.astype(np.float32)
+    if e.signed:
+        a = e.sign * a
+    if e.conv == "int32":
+        a = a.astype(np.int32)
+    elif e.conv == "float32":
+        a = a.astype(np.float32)
+    return a
+
+
+def unpack_tile(
+    plan: FetchPlan, words: np.ndarray, px: int
+) -> tuple[dict[str, np.ndarray], np.ndarray]:
+    """Landed host words → (artifact arrays, cropped ``model_valid``).
+
+    Pure host work (reinterpreting views + the :func:`_post` restores) —
+    it runs inside the writer pool's write stage, off the driver loop's
+    critical path.
+    """
+    buf = words.view(np.uint8)
+    offs, _total = _layout(plan)
+    arrays: dict[str, np.ndarray] = {}
+    model_valid: np.ndarray | None = None
+    for e, (off, nbytes) in zip(plan.entries, offs):
+        a = buf[off : off + nbytes].view(e.wire).reshape(plan.px, *e.suffix)
+        a = a[:px]
+        if e.conv == "bool":
+            a = a.view(np.bool_)
+        a = _post(e, a)
+        if e.key:
+            arrays[e.key] = a
+        if e.src == "seg" and e.field == "model_valid":
+            model_valid = a
+    assert model_valid is not None  # build_plan always includes the rider
+    return arrays, model_valid
+
+
+@jax.jit
+def _jit_f16(a):
+    """Device-side f16 cast for the per-product fallback path (one tiny
+    program per dtype — the packed path fuses the casts into pack_tile)."""
+    return a.astype(jnp.float16)
+
+
+def _to_host(arr) -> np.ndarray:
+    """The one device→host materialization point (monkeypatch seam for
+    fault-injection tests: a device error in an in-flight async fetch
+    surfaces here, in the driver's drain, where the retry ladder runs)."""
+    return np.asarray(arr)
+
+
+def resolve_packed(fetch_packed: "bool | str") -> bool:
+    """Resolve ``RunConfig.fetch_packed`` ("auto"/True/False) to a bool.
+
+    "auto" packs only where a transfer is a real wire: on the CPU backend
+    ``np.asarray`` of a device array is zero-copy, so the pack program
+    would be pure overhead.  The wire format is little-endian (the device
+    side of every supported backend); a big-endian HOST cannot
+    reinterpret it, so auto falls back and an explicit ``True`` raises.
+    """
+    if fetch_packed == "auto":
+        return jax.default_backend() != "cpu" and sys.byteorder == "little"
+    if fetch_packed and sys.byteorder != "little":
+        raise ValueError(
+            "fetch_packed=True needs a little-endian host (the packed wire "
+            "format is the device's LE byte order); use fetch_packed=False"
+        )
+    return bool(fetch_packed)
+
+
+class _Stats:
+    """Thread-safe fetch counters (unpack runs in writer-pool threads)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.tiles = 0
+        self.transfers = 0
+        self.bytes = 0
+        self.pack_s = 0.0
+        self.wait_s = 0.0
+        self.unpack_s = 0.0
+        self.backlog_max = 0
+
+    def add(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_backlog(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.backlog_max:
+                self.backlog_max = depth
+
+
+class PackedHandle:
+    """One tile's in-flight packed transfer.
+
+    ``wait`` is idempotent and thread-safe: the driver's bounded drain
+    calls it on the loop thread (where a surfacing device error enters
+    the retry ladder); ``tile_arrays`` — writer-pool threads — reuses the
+    landed buffer.
+    """
+
+    def __init__(self, fetcher: "TileFetcher", words) -> None:
+        self._fetcher = fetcher
+        self._words = words
+        self._lock = threading.Lock()
+        self._host: np.ndarray | None = None
+
+    def wait(self) -> None:
+        """Block until the packed buffer has landed on host."""
+        with self._lock:
+            if self._host is None:
+                t0 = time.perf_counter()
+                self._host = _to_host(self._words)
+                self._fetcher.stats.add(wait_s=time.perf_counter() - t0)
+                self._words = None  # release the device buffer reference
+
+    def tile_arrays(self, t: "TileSpec") -> tuple[dict[str, np.ndarray], int]:
+        self.wait()
+        t0 = time.perf_counter()
+        arrays, model_valid = unpack_tile(
+            self._fetcher.plan, self._host, t.h * t.w
+        )
+        # tiles counts COMPLETED tile fetches (one tile_arrays call per
+        # tile); transfers/bytes count wire traffic, which a retried tile
+        # legitimately pays more than once — so transfers >= tiles always
+        self._fetcher.stats.add(unpack_s=time.perf_counter() - t0, tiles=1)
+        return arrays, int(model_valid.sum())
+
+
+class UnpackedHandle:
+    """The per-product fallback: today's path, byte for byte.
+
+    No device work happens at construction; every product is fetched
+    synchronously inside ``tile_arrays`` — i.e. in the writer pool,
+    inside the write stage, exactly where the pre-PR driver fetched.  The
+    one (deliberate) improvement: ``model_valid`` is fetched alongside
+    the products instead of as a separate blocking fetch inside the write
+    timer's metadata branch when ``--products`` excludes it.
+    """
+
+    def __init__(self, fetcher: "TileFetcher", out: "TileOutputs") -> None:
+        self._fetcher = fetcher
+        self._out = out
+
+    def wait(self) -> None:  # transfers happen in tile_arrays, as before
+        return None
+
+    def tile_arrays(self, t: "TileSpec") -> tuple[dict[str, np.ndarray], int]:
+        stats = self._fetcher.stats
+        px = t.h * t.w
+        arrays: dict[str, np.ndarray] = {}
+        model_valid: np.ndarray | None = None
+        for e in self._fetcher.plan.entries:
+            dev = _resolve(self._out, e)
+            if e.wire == "float16" and e.dtype != "float16":
+                dev = _jit_f16(dev)
+            t0 = time.perf_counter()
+            host = _to_host(dev)
+            stats.add(
+                wait_s=time.perf_counter() - t0,
+                transfers=1,
+                bytes=host.nbytes,
+            )
+            a = _post(e, host[:px])
+            if e.key:
+                arrays[e.key] = a
+            if e.src == "seg" and e.field == "model_valid":
+                model_valid = a
+        assert model_valid is not None
+        # counted AFTER the product loop (like the packed handle counts
+        # after its fetch lands): a fetch that dies mid-tile must never
+        # leave tiles ahead of transfers in the abort-path rollup
+        stats.add(tiles=1)
+        return arrays, int(model_valid.sum())
+
+
+class TileFetcher:
+    """Per-run fetch strategy: plan once, then one handle per tile."""
+
+    def __init__(self, cfg: "RunConfig", packed: bool) -> None:
+        self.cfg = cfg
+        self.packed = packed
+        self.plan: FetchPlan | None = None
+        self.stats = _Stats()
+
+    def start(self, out: "TileOutputs") -> "PackedHandle | UnpackedHandle":
+        """Issue one tile's fetch; packed handles begin landing NOW."""
+        if self.plan is None:
+            self.plan = build_plan(out, self.cfg)
+        if not self.packed:
+            return UnpackedHandle(self, out)
+        t0 = time.perf_counter()
+        words = pack_tile(out, plan=self.plan)
+        words.copy_to_host_async()
+        self.stats.add(
+            pack_s=time.perf_counter() - t0,
+            transfers=1,
+            bytes=plan_wire_bytes(self.plan),
+        )
+        return PackedHandle(self, words)
+
+    def note_backlog(self, depth: int) -> None:
+        self.stats.note_backlog(depth)
+
+    def summary(self) -> dict:
+        """Run-scoped counters for the run summary / ``fetch`` event."""
+        s = self.stats
+        with s._lock:
+            return {
+                "packed": self.packed,
+                "tiles": s.tiles,
+                "transfers": s.transfers,
+                "bytes": s.bytes,
+                "pack_s": round(s.pack_s, 6),
+                "wait_s": round(s.wait_s, 6),
+                "unpack_s": round(s.unpack_s, 6),
+                "backlog_max": s.backlog_max,
+            }

@@ -1,0 +1,294 @@
+"""Fetch-subsystem tests: packed ≡ per-product byte parity, async fault
+retry, the model_valid rider, CLI knobs, telemetry/lint/rollup wiring,
+and the fetch_bench smoke (tier-1).
+
+The contract under test (runtime/fetch.py): ``fetch_packed`` is a pure
+execution strategy — packed and per-product runs must produce
+byte-identical tile artifacts across every product selection, with the
+packed path costing ONE device→host transfer per tile.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import main as cli_main
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.ops.change import ChangeFilter
+from land_trendr_tpu.runtime import (
+    RunConfig,
+    run_stack,
+    stack_from_synthetic,
+)
+from land_trendr_tpu.runtime import fetch as fetchmod
+
+SPEC = SceneSpec(width=48, height=40, year_start=1990, year_end=2005, seed=11)
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+@pytest.fixture(scope="module")
+def rstack():
+    return stack_from_synthetic(make_stack(SPEC))
+
+
+def make_cfg(tmp, **kw):
+    kw.setdefault("params", PARAMS)
+    kw.setdefault("tile_size", 32)  # 48x40 scene -> edge tiles in both axes
+    return RunConfig(
+        workdir=os.path.join(tmp, "work"), out_dir=os.path.join(tmp, "out"),
+        **kw,
+    )
+
+
+def load_artifacts(cfg, n_tiles):
+    out = []
+    for tid in range(n_tiles):
+        with np.load(os.path.join(cfg.workdir, f"tile_{tid:05d}.npz")) as z:
+            out.append({k: z[k] for k in z.files})
+    return out
+
+
+PARITY_CASES = {
+    "full": dict(),
+    # subset WITHOUT model_valid: the fit-rate metadata must ride the
+    # payload (packed: 1 B/px in the same transfer; unpacked: fetched
+    # alongside the products, not in a write-timer metadata branch)
+    "subset": dict(
+        products=("n_vertices", "vertex_years", "seg_magnitude", "rmse")
+    ),
+    # the everything-on case: f16 wire + FTV + fitted + fused change
+    "f16_ftv_change": dict(
+        fetch_f16=True, ftv_indices=("ndvi",), write_fitted=True,
+        change_filt=ChangeFilter(),
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(PARITY_CASES))
+def test_packed_unpacked_byte_parity(tmp_path, rstack, case):
+    kw = PARITY_CASES[case]
+    cfg_p = make_cfg(str(tmp_path / "p"), fetch_packed=True, **kw)
+    cfg_u = make_cfg(str(tmp_path / "u"), fetch_packed=False, **kw)
+    sp = run_stack(rstack, cfg_p)
+    su = run_stack(rstack, cfg_u)
+
+    assert sp["fetch"]["packed"] is True
+    assert su["fetch"]["packed"] is False
+    # the tentpole claim: one transfer per tile, vs ~1 per product
+    assert sp["fetch"]["transfers"] == sp["tiles"]
+    assert su["fetch"]["transfers"] >= su["tiles"] * 4
+    # identical run aggregates (the rider keeps fit_rate exact either way)
+    assert sp["fit_rate"] == su["fit_rate"]
+
+    packed, unpacked = (load_artifacts(c, sp["tiles"]) for c in (cfg_p, cfg_u))
+    for tid, (a, b) in enumerate(zip(packed, unpacked)):
+        assert sorted(a) == sorted(b)
+        if "products" in kw:
+            assert "model_valid" not in a  # rider must NOT leak into artifacts
+        for k in a:
+            assert a[k].dtype == b[k].dtype, (tid, k)
+            assert a[k].shape == b[k].shape, (tid, k)
+            assert a[k].tobytes() == b[k].tobytes(), (
+                f"tile {tid} product {k} differs between packed and unpacked"
+            )
+
+
+def test_packed_parity_under_mesh(tmp_path, rstack):
+    """The pack program composes with a sharded pixel axis (virtual
+    8-device mesh): packed ≡ unpacked artifacts there too."""
+    import jax
+
+    from land_trendr_tpu.parallel import make_mesh
+
+    mesh = make_mesh(jax.local_devices())
+    cfg_p = make_cfg(str(tmp_path / "p"), fetch_packed=True)
+    cfg_u = make_cfg(str(tmp_path / "u"), fetch_packed=False)
+    sp = run_stack(rstack, cfg_p, mesh=mesh)
+    run_stack(rstack, cfg_u, mesh=mesh)
+    assert sp["fetch"]["transfers"] == sp["tiles"]
+    for a, b in zip(
+        load_artifacts(cfg_p, sp["tiles"]), load_artifacts(cfg_u, sp["tiles"])
+    ):
+        for k in a:
+            assert a[k].tobytes() == b[k].tobytes()
+
+
+def test_fetch_auto_keeps_per_product_on_cpu(tmp_path, rstack):
+    """"auto" resolves to the per-product path on the CPU backend, where
+    np.asarray is zero-copy and packing would be pure overhead."""
+    assert fetchmod.resolve_packed("auto") is False
+    summary = run_stack(rstack, make_cfg(str(tmp_path)))
+    assert summary["fetch"]["packed"] is False
+
+
+def test_async_fetch_fault_triggers_retry(tmp_path, rstack, monkeypatch):
+    """A device error surfacing through an in-flight async fetch (i.e. at
+    the drain's wait, tiles later than the dispatch) re-enters the retry
+    ladder and the run completes."""
+    real = fetchmod._to_host
+    calls = {"n": 0}
+
+    def flaky(arr):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected transfer fault")
+        return real(arr)
+
+    monkeypatch.setattr(fetchmod, "_to_host", flaky)
+    cfg = make_cfg(str(tmp_path), fetch_packed=True, max_retries=2,
+                   telemetry=True)
+    summary = run_stack(rstack, cfg)
+    assert summary["pixels"] == SPEC.height * SPEC.width
+    evs = [json.loads(l) for l in open(summary["telemetry"]["events"])]
+    retries = [e for e in evs if e["ev"] == "tile_retry"]
+    assert len(retries) == 1
+    assert "injected transfer fault" in retries[0]["error"]
+    # the retried tile re-announced its later attempt
+    assert any(
+        e["ev"] == "tile_start" and e["attempt"] == 2 for e in evs
+    )
+
+
+def test_async_fetch_fault_exhausts_retries(tmp_path, rstack, monkeypatch):
+    monkeypatch.setattr(
+        fetchmod, "_to_host",
+        lambda arr: (_ for _ in ()).throw(RuntimeError("persistent fault")),
+    )
+    cfg = make_cfg(str(tmp_path), fetch_packed=True, max_retries=1,
+                   telemetry=True)
+    with pytest.raises(RuntimeError, match="failed after 2 attempts"):
+        run_stack(rstack, cfg)
+    # the failed tile appears as a failure ONLY: tile_done waits for the
+    # fetch to land, so a tile can never be done-then-failed, and the
+    # aborted run_done must not count it
+    evs = [
+        json.loads(l)
+        for l in open(os.path.join(cfg.workdir, "events.jsonl"))
+    ]
+    failed = {e["tile_id"] for e in evs if e["ev"] == "tile_failed"}
+    done = {e["tile_id"] for e in evs if e["ev"] == "tile_done"}
+    assert failed and not (failed & done) and not done
+    run_done = [e for e in evs if e["ev"] == "run_done"][-1]
+    assert run_done["status"] == "aborted" and run_done["tiles_done"] == 0
+
+
+def test_runconfig_validates_fetch_knobs(tmp_path):
+    with pytest.raises(ValueError, match="fetch_depth"):
+        make_cfg(str(tmp_path), fetch_depth=0)
+    with pytest.raises(ValueError, match="fetch_packed"):
+        make_cfg(str(tmp_path), fetch_packed="yes")
+
+
+def test_no_packed_fetch_cli(tmp_path, capsys):
+    stack_dir = str(tmp_path / "stack")
+    assert cli_main(["synth", stack_dir, "--size", "32",
+                     "--year-start", "1990", "--year-end", "2001"]) == 0
+    capsys.readouterr()
+    assert cli_main([
+        "segment", stack_dir, "--tile-size", "32",
+        "--workdir", str(tmp_path / "work"), "--out-dir",
+        str(tmp_path / "out"), "--max-segments", "4",
+        "--vertex-count-overshoot", "2", "--no-packed-fetch",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["summary"]["fetch"]["packed"] is False
+    assert rep["summary"]["fetch"]["tiles"] == 1
+
+    # forcing both directions at once is an argument conflict
+    assert cli_main([
+        "segment", stack_dir, "--tile-size", "32",
+        "--workdir", str(tmp_path / "w2"), "--out-dir",
+        str(tmp_path / "o2"), "--packed-fetch", "--no-packed-fetch",
+    ]) == 2
+    assert "--no-packed-fetch" in capsys.readouterr().err
+
+
+def test_fetch_telemetry_schema_metrics_and_rollup(tmp_path, rstack):
+    """The fetch event passes the schema + value lint, advances the
+    lt_fetch_* instruments, and folds into obs_report with the derived
+    effective-bandwidth figure."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import check_events_schema
+    import obs_report
+
+    cfg = make_cfg(str(tmp_path), fetch_packed=True, telemetry=True)
+    summary = run_stack(rstack, cfg)
+    assert check_events_schema.main([cfg.workdir]) == 0
+
+    report, _spans = obs_report.fold([summary["telemetry"]["events"]])
+    fx = report["fetch"]
+    assert fx["tiles"] == summary["tiles"]
+    assert fx["transfers_per_tile"] == 1.0
+    assert fx["packed"] is True
+    assert fx["effective_gb_per_s"] is not None
+    assert fx["bytes"] == summary["fetch"]["bytes"] > 0
+
+    prom = open(summary["telemetry"]["metrics"]).read()
+    for name in ("lt_fetch_bytes_total", "lt_fetch_transfers_total",
+                 "lt_fetch_wait_seconds_total", "lt_fetch_backlog_max"):
+        assert name in prom
+
+
+def test_fetch_value_lint_catches_drift(tmp_path):
+    """The value-level fetch lint: negative counters, transfers below
+    tiles, and an unpack_s that exceeds the scope's write stage are all
+    producer drift a type check alone cannot catch."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from check_events_schema import main as lint_main
+
+    from land_trendr_tpu.obs.events import EventLog
+
+    def write_events(path, fetch_fields, stage_s):
+        log = EventLog(path)
+        log.run_start(
+            fingerprint="x", process_index=0, process_count=1,
+            tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        log.emit("fetch", **fetch_fields)
+        log.emit(
+            "run_done", status="ok", tiles_done=1, pixels=1, wall_s=1.0,
+            px_per_s=1.0, fit_rate=1.0, stage_s=stage_s,
+        )
+        log.close()
+
+    ok = dict(tiles=2, transfers=2, bytes=10, pack_s=0.1, wait_s=0.1,
+              unpack_s=0.1)
+    good = str(tmp_path / "good")
+    write_events(os.path.join(good, "events.jsonl"), ok, {"write_s": 0.5})
+    assert lint_main([good]) == 0
+
+    for name, bad, stage in (
+        ("neg", {**ok, "bytes": -1}, {"write_s": 0.5}),
+        ("short", {**ok, "transfers": 1}, {"write_s": 0.5}),
+        ("unpack", ok, {"write_s": 0.01}),
+    ):
+        d = str(tmp_path / name)
+        write_events(os.path.join(d, "events.jsonl"), bad, stage)
+        assert lint_main([d]) == 1, name
+
+
+def test_fetch_bench_smoke(tmp_path):
+    """Tier-1 fetch_bench smoke (the satellite next to feed_bench's): the
+    bench runs end to end, parity holds, and the packed path moves one
+    transfer per tile."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import fetch_bench
+
+    out = str(tmp_path / "fetch_smoke.json")
+    assert fetch_bench.main(["--smoke", "--out", out]) == 0
+    rep = json.load(open(out))
+    assert rep["parity"]["ok"] is True
+    assert rep["workload"]["transfers_per_tile_packed"] == 1
+    assert rep["workload"]["artifact_products"] >= 8
+    assert rep["speedup_packed_sync"] > 0
+    assert rep["speedup_packed_async"] > 0

@@ -5,11 +5,15 @@ against :data:`land_trendr_tpu.obs.events.EVENT_FIELDS` at the current
 :data:`~land_trendr_tpu.obs.events.SCHEMA_VERSION`: every line parses,
 every event is a known type with its required fields at the right types,
 and the stream opens with ``run_start``.  On top of the type schema, the
-``feed_cache`` rollup (the feed-path decode subsystem, ``io/blockcache``)
-gets a VALUE lint: its counters must be non-negative and readahead hits
-cannot exceed the blocks readahead inserted — producer drift a type check
-alone cannot catch.  Exit 0 = all clean, 1 = schema errors (listed on
-stderr), 2 = usage/IO error.
+subsystem rollups get VALUE lints a type check alone cannot catch:
+``feed_cache`` (the feed-path decode subsystem, ``io/blockcache``) must
+have non-negative counters and readahead hits cannot exceed the blocks
+readahead inserted; ``fetch`` (the device→host fetch subsystem,
+``runtime/fetch``) must have non-negative counters, at least one transfer
+per fetched tile, and an ``unpack_s`` that fits inside its scope's
+``run_done`` write-stage seconds (unpack always runs inside the write
+stage — a larger value means a broken stats split).  Exit 0 = all clean,
+1 = schema errors (listed on stderr), 2 = usage/IO error.
 
 This is the guard that keeps producer (driver) and consumers
 (``obs_report``, dashboards) honest about the JSONL contract — wired into
@@ -67,6 +71,84 @@ def feed_cache_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+#: numeric fetch fields that can never go negative
+_FETCH_NONNEG = (
+    "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
+    "backlog_max",
+)
+
+#: slack for the unpack_s ≤ write_s cross-check: both sides are rounded
+#: independently (event fields to 6 dp, stage_s to 4 dp)
+_UNPACK_SLACK_S = 1e-3
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+class FetchValueLint:
+    """Value lint for ``fetch`` records, one instance per file.
+
+    Stateful across records because one invariant is cross-event: the
+    fetch rollup's ``unpack_s`` accumulates inside the driver's write
+    stage, so it must fit within the same run scope's ``run_done``
+    ``stage_s.write_s`` (summed across writer threads, like unpack_s).
+    ``run_start`` opens a new scope and resets the pending check.
+    """
+
+    def __init__(self) -> None:
+        self._pending: "tuple[int, float] | None" = None  # (lineno, unpack_s)
+
+    def __call__(self, rec, lineno: int) -> list[str]:
+        if not isinstance(rec, dict):
+            return []
+        ev = rec.get("ev")
+        if ev == "run_start":
+            self._pending = None
+            return []
+        if ev == "run_done":
+            errs = []
+            stage_s = rec.get("stage_s")
+            if self._pending is not None and isinstance(stage_s, dict):
+                fx_line, unpack_s = self._pending
+                write_s = stage_s.get("write_s")
+                if _num(write_s) and unpack_s > write_s + _UNPACK_SLACK_S:
+                    errs.append(
+                        f"line {fx_line}: fetch: unpack_s {unpack_s} exceeds "
+                        f"the scope's write-stage seconds {write_s} "
+                        f"(run_done line {lineno}; unpack runs inside the "
+                        "write stage)"
+                    )
+            self._pending = None
+            return errs
+        if ev != "fetch":
+            return []
+        errs = []
+        for name in _FETCH_NONNEG:
+            v = rec.get(name)
+            if _num(v) and v < 0:
+                errs.append(f"line {lineno}: fetch: {name} is negative ({v})")
+        tiles, transfers = rec.get("tiles"), rec.get("transfers")
+        if _num(tiles) and _num(transfers) and transfers < tiles:
+            errs.append(
+                f"line {lineno}: fetch: transfers {transfers} below tiles "
+                f"{tiles} (every fetched tile costs at least one transfer)"
+            )
+        if _num(rec.get("unpack_s")):
+            self._pending = (lineno, rec["unpack_s"])
+        return errs
+
+
+def value_lints():
+    """Fresh per-file ``extra`` hook chaining every value-level lint."""
+    fetch_lint = FetchValueLint()
+
+    def extra(rec, lineno: int) -> list[str]:
+        return feed_cache_value_errors(rec, lineno) + fetch_lint(rec, lineno)
+
+    return extra
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",
@@ -86,9 +168,9 @@ def main(argv: list[str] | None = None) -> int:
 
     n_bad = 0
     for path in files:
-        # one parse per file: the value-level feed_cache lint rides the
-        # schema pass as a per-record hook, errors in line order
-        errs = validate_events_file(path, extra=feed_cache_value_errors)
+        # one parse per file: the value-level feed_cache + fetch lints
+        # ride the schema pass as a per-record hook, errors in line order
+        errs = validate_events_file(path, extra=value_lints())
         if errs:
             n_bad += 1
             for e in errs[: args.max_errors]:

@@ -1,0 +1,334 @@
+"""Fetch-path benchmark: packed async device→host fetch vs per-product.
+
+Builds a synthetic tile-output workload (the full segmentation product
+set plus an FTV raster — ≥8 per-pixel products, the shape a real
+multi-product run fetches every tile) and measures the fetch stage three
+ways over the same tile sweep:
+
+* ``per_product_sync`` — the pre-packing baseline: one synchronous
+  ``np.asarray`` per product per tile (the driver's ``--no-packed-fetch``
+  fallback, driven through the real :class:`runtime.fetch.TileFetcher`);
+* ``packed_sync``   — ONE device-side pack + ONE transfer per tile,
+  awaited immediately (isolates the transfer-count win);
+* ``packed_async``  — the driver's production pipeline: the packed
+  transfer of tile *i* lands while tile *i+1* packs, bounded at
+  ``--depth`` in flight (adds the overlap win).
+
+**Link model.** On this container's CPU backend a device→host "transfer"
+is a zero-copy pointer hand-off, so the per-transfer cost that dominates
+real accelerator links (SCENE_TPU_r04.json: fetch was 96% of scene wall
+through the tunneled chip's ~per-request-latency-bound link) does not
+exist locally.  The bench therefore models the link at the transfer
+points — each transfer lands ``latency + bytes/bandwidth`` after it is
+issued (``--link-ms`` / ``--link-gbps``, default a PCIe-class 1 ms /
+8 GB/s; ``--link-ms 0 --link-gbps 0`` disables the model for raw
+measurement on real hardware).  All host work — the pack program, the
+materialization, the unpack/crop/sign restores — is genuinely executed,
+and ``raw_local`` records the unmodeled walls alongside.  Parity (packed
+≡ per-product, byte for byte, every product) is asserted on real arrays
+every run.
+
+Writes one JSON artifact (``--out``, e.g. ``FETCH_r08.json``).
+``--smoke`` shrinks the workload to seconds scale — the tier-1 mode
+``tests/test_fetch.py`` runs in CI.
+
+Usage:
+    python tools/fetch_bench.py --out FETCH_r08.json
+    python tools/fetch_bench.py --smoke --out /tmp/fetch_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, str(REPO / "tools"))
+from _platform_arg import pop_platform_arg  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", pop_platform_arg())
+
+import jax.numpy as jnp  # noqa: E402
+
+from land_trendr_tpu.config import LTParams  # noqa: E402
+from land_trendr_tpu.ops.segment import SegOutputs  # noqa: E402
+from land_trendr_tpu.ops.tile import TileOutputs  # noqa: E402
+from land_trendr_tpu.runtime import RunConfig  # noqa: E402
+from land_trendr_tpu.runtime import fetch as fetchmod  # noqa: E402
+from land_trendr_tpu.runtime.driver import TileSpec  # noqa: E402
+
+
+def synth_outputs(px: int, ny: int, nv: int, nm: int, seed: int) -> TileOutputs:
+    """A device-resident TileOutputs with realistic shapes/dtypes: random
+    data is fine — the fetch stage moves bytes, it never looks at them."""
+    rng = np.random.default_rng(seed)
+    f32 = lambda *s: jnp.asarray(rng.random(s, np.float32))  # noqa: E731
+    seg = SegOutputs(
+        n_vertices=jnp.asarray(rng.integers(0, nv, px).astype(np.int32)),
+        vertex_indices=jnp.asarray(
+            rng.integers(-1, ny, (px, nv)).astype(np.int32)
+        ),
+        vertex_years=f32(px, nv),
+        vertex_src_vals=f32(px, nv),
+        vertex_fit_vals=f32(px, nv),
+        seg_magnitude=f32(px, nm),
+        seg_duration=f32(px, nm),
+        seg_rate=f32(px, nm),
+        rmse=f32(px),
+        p_of_f=f32(px),
+        model_valid=jnp.asarray(rng.integers(0, 2, px).astype(bool)),
+        fitted=f32(px, ny),
+        despiked=f32(px, ny),
+    )
+    out = TileOutputs(seg=seg, ftv={"ndvi": f32(px, ny)}, change=None)
+    jax.block_until_ready(out)
+    return out
+
+
+class LinkModel:
+    """Per-transfer cost model: a transfer issued now lands at
+    ``now + latency_s + bytes/bw``; waiting sleeps out the remainder."""
+
+    def __init__(self, latency_ms: float, gbps: float) -> None:
+        self.latency_s = latency_ms / 1e3
+        self.bps = gbps * 1e9
+
+    @property
+    def enabled(self) -> bool:
+        return self.latency_s > 0 or self.bps > 0
+
+    def land_at(self, nbytes: int) -> float:
+        dt = self.latency_s + (nbytes / self.bps if self.bps else 0.0)
+        return time.perf_counter() + dt
+
+    def wait(self, land_at: float) -> None:
+        while True:
+            dt = land_at - time.perf_counter()
+            if dt <= 0:
+                return
+            time.sleep(dt)
+
+
+def run_per_product(cfg, outs, tiles, link: LinkModel) -> dict:
+    """The production fallback path (TileFetcher packed=False) with the
+    link model spliced into its one materialization seam."""
+    fetcher = fetchmod.TileFetcher(cfg, packed=False)
+    real_to_host = fetchmod._to_host
+
+    def linked_to_host(arr):
+        host = real_to_host(arr)
+        link.wait(link.land_at(host.nbytes))  # synchronous: latency + wire
+        return host
+
+    fetchmod._to_host = linked_to_host if link.enabled else real_to_host
+    try:
+        t0 = time.perf_counter()
+        for i, t in enumerate(tiles):
+            fetcher.start(outs[i % len(outs)]).tile_arrays(t)
+        wall = time.perf_counter() - t0
+    finally:
+        fetchmod._to_host = real_to_host
+    s = fetcher.summary()
+    return {"wall_s": wall, "stats": s}
+
+
+def run_packed(cfg, outs, tiles, link: LinkModel, depth: int) -> dict:
+    """The driver's packed pipeline shape: pack + async transfer, bounded
+    in-flight queue, unpack on landed bytes.  ``depth=1`` = fully sync."""
+    plan = fetchmod.build_plan(outs[0], cfg)
+    wire = fetchmod.plan_wire_bytes(plan)
+    queue: list[tuple[TileSpec, object, float]] = []
+
+    def drain(limit: int) -> None:
+        while len(queue) > limit:
+            t, words, land_at = queue.pop(0)
+            link.wait(land_at)
+            host = np.asarray(words)
+            fetchmod.unpack_tile(plan, host, t.h * t.w)
+
+    t0 = time.perf_counter()
+    for i, t in enumerate(tiles):
+        words = fetchmod.pack_tile(outs[i % len(outs)], plan=plan)
+        words.copy_to_host_async()
+        queue.append((t, words, link.land_at(wire)))
+        drain(depth - 1)
+    drain(0)
+    wall = time.perf_counter() - t0
+    return {"wall_s": wall, "wire_bytes": wire}
+
+
+def check_parity(cfg, outs, tiles) -> int:
+    """Packed and per-product tile arrays must be byte-identical (real
+    arrays, link model off)."""
+    plan = fetchmod.build_plan(outs[0], cfg)
+    checked = 0
+    for i, t in enumerate(tiles[: min(3, len(tiles))]):
+        out = outs[i % len(outs)]
+        packed, mv_p = fetchmod.unpack_tile(
+            plan, np.asarray(fetchmod.pack_tile(out, plan=plan)), t.h * t.w
+        )
+        ref, mv_u = (
+            fetchmod.TileFetcher(cfg, packed=False).start(out).tile_arrays(t)
+        )
+        assert mv_p.sum() == mv_u, "model_valid rider mismatch"
+        assert sorted(packed) == sorted(ref), (sorted(packed), sorted(ref))
+        for k in ref:
+            a, b = packed[k], ref[k]
+            if a.dtype != b.dtype or a.shape != b.shape or a.tobytes() != b.tobytes():
+                raise AssertionError(f"parity mismatch on {k} (tile {i})")
+            checked += 1
+    return checked
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tile", type=int, default=128,
+                    help="tile edge in px (tile_px = tile^2)")
+    ap.add_argument("--years", type=int, default=24)
+    ap.add_argument("--tiles", type=int, default=16,
+                    help="tiles per timed sweep (last one is an edge tile)")
+    ap.add_argument("--depth", type=int, default=2,
+                    help="async in-flight bound (RunConfig.fetch_depth)")
+    ap.add_argument("--f16", action="store_true",
+                    help="also fuse fetch_f16 casts into the pack")
+    ap.add_argument("--link-ms", type=float, default=1.0,
+                    help="modeled per-transfer latency (0 = no model)")
+    ap.add_argument("--link-gbps", type=float, default=8.0,
+                    help="modeled link bandwidth (0 = latency-only model)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per mode; MEDIAN wall reported")
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--out", default="FETCH_r08.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny workload, seconds not minutes (tier-1 CI)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.tile = min(args.tile, 64)
+        args.years = min(args.years, 12)
+        args.tiles = min(args.tiles, 4)
+        args.reps = 1
+
+    params = LTParams()
+    nv, nm = params.max_vertices, params.max_segments
+    px = args.tile * args.tile
+    cfg = RunConfig(
+        index="nbr", ftv_indices=("ndvi",), params=params,
+        tile_size=args.tile, fetch_f16=args.f16, fetch_depth=args.depth,
+    )
+    # two distinct payloads alternated across the sweep (content never
+    # matters to the fetch stage; two keep any caching honest), plus an
+    # edge tile so the crop path is exercised
+    outs = [
+        synth_outputs(px, args.years, nv, nm, args.seed + k) for k in (0, 1)
+    ]
+    tiles = [
+        TileSpec(i, 0, 0, args.tile, args.tile)
+        for i in range(args.tiles - 1)
+    ] + [TileSpec(args.tiles - 1, 0, 0, args.tile - 5, args.tile - 3)]
+    link = LinkModel(args.link_ms, args.link_gbps)
+    no_link = LinkModel(0.0, 0.0)
+
+    # parity first (and the compile warmup for the pack program)
+    parity_products = check_parity(cfg, outs, tiles)
+
+    def median(mode_fn) -> dict:
+        runs = [mode_fn() for _ in range(max(1, args.reps))]
+        runs.sort(key=lambda r: r["wall_s"])
+        return runs[len(runs) // 2]
+
+    per_product = median(lambda: run_per_product(cfg, outs, tiles, link))
+    packed_sync = median(lambda: run_packed(cfg, outs, tiles, link, 1))
+    packed_async = median(
+        lambda: run_packed(cfg, outs, tiles, link, args.depth)
+    )
+    # unmodeled walls: what this host really pays (on the CPU backend the
+    # per-product path is zero-copy — exactly why fetch_packed="auto"
+    # keeps it there)
+    raw_pp = median(lambda: run_per_product(cfg, outs, tiles, no_link))
+    raw_pk = median(lambda: run_packed(cfg, outs, tiles, no_link, args.depth))
+
+    n = len(tiles)
+    stats = per_product["stats"]
+    result = {
+        "workload": {
+            "tile_px": px,
+            "years": args.years,
+            "nv": nv,
+            "nm": nm,
+            "tiles": n,
+            "artifact_products": parity_products // min(3, n),
+            "fetch_f16": args.f16,
+            "bytes_per_tile_packed": packed_sync["wire_bytes"],
+            "transfers_per_tile_per_product": stats["transfers"]
+            // (stats["tiles"] or 1),
+            "transfers_per_tile_packed": 1,
+        },
+        "platform": jax.default_backend(),
+        "link_model": {
+            "latency_ms": args.link_ms,
+            "gbps": args.link_gbps,
+            "note": (
+                "transfers land latency + bytes/bandwidth after issue; "
+                "models the per-transfer cost of a real accelerator link "
+                "(absent on this CPU backend's zero-copy asarray) — all "
+                "host work (pack/materialize/unpack) is real; raw_local "
+                "records the unmodeled walls"
+            ) if link.enabled else "disabled: raw hardware measurement",
+        },
+        "per_product_sync": {
+            "wall_s": round(per_product["wall_s"], 4),
+            "ms_per_tile": round(per_product["wall_s"] / n * 1e3, 3),
+        },
+        "packed_sync": {
+            "wall_s": round(packed_sync["wall_s"], 4),
+            "ms_per_tile": round(packed_sync["wall_s"] / n * 1e3, 3),
+        },
+        "packed_async": {
+            "wall_s": round(packed_async["wall_s"], 4),
+            "ms_per_tile": round(packed_async["wall_s"] / n * 1e3, 3),
+            "depth": args.depth,
+            "note": (
+                "in this HOST-ONLY loop there is no device compute to "
+                "overlap, so depth>1 cannot beat packed_sync locally (the "
+                "queued packs contend for the same host cores); the "
+                "driver issues fetches between block_until_ready calls, "
+                "where the landing transfer overlaps the NEXT tile's "
+                "device compute — that is where async pays"
+            ),
+        },
+        "speedup_packed_sync": round(
+            per_product["wall_s"] / packed_sync["wall_s"], 3
+        ),
+        "speedup_packed_async": round(
+            per_product["wall_s"] / packed_async["wall_s"], 3
+        ),
+        "raw_local": {
+            "per_product_ms_per_tile": round(raw_pp["wall_s"] / n * 1e3, 3),
+            "packed_ms_per_tile": round(raw_pk["wall_s"] / n * 1e3, 3),
+            "note": "no link model; CPU-backend asarray is zero-copy",
+        },
+        "parity": {
+            "tiles_checked": min(3, n),
+            "products_checked": parity_products,
+            "ok": True,
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,8 +7,11 @@ per-process files) and it emits
 * a JSON **report** on stdout — per-event-type counts, tile compute-latency
   and px/s distributions, retry/failure totals, backlog-depth maxima, the
   run_done stage split, the feed-cache rollup (hits/misses/decode seconds
-  with a derived hit rate), and per-host rollups — schema lint and fold
-  run in a SINGLE pass per file (``fold(paths, schema_errors=...)``);
+  with a derived hit rate), the fetch rollup (transfers/bytes and the
+  pack/wait/unpack split, with derived ``transfers_per_tile`` and
+  ``effective_gb_per_s`` — wire bytes over blocking wait seconds), and
+  per-host rollups — schema lint and fold run in a SINGLE pass per file
+  (``fold(paths, schema_errors=...)``);
 * with ``--trace OUT.json``, a **Chrome trace-event file** (the
   ``chrome://tracing`` / Perfetto JSON array format): per-tile device-wait
   and artifact-write slices, retry instants, and backlog counter tracks,
@@ -78,6 +81,7 @@ def _fresh_scope() -> dict:
         "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
         "retries": 0, "failures": 0, "stage_s": {}, "feed_cache": None,
+        "fetch": None,
     }
 
 
@@ -109,6 +113,45 @@ def _merge_feed_cache(folded: list[dict]) -> "dict | None":
             out[k] = max(vals)
     lookups = out.get("hits", 0) + out.get("misses", 0)
     out["hit_rate"] = round(out.get("hits", 0) / lookups, 4) if lookups else None
+    return out
+
+
+#: fetch event counters summed across files; backlog_max is a per-process
+#: high watermark, so the merge takes its maximum
+_FETCH_COUNTERS = (
+    "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
+)
+
+
+def _merge_fetch(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the per-scope fetch rollups (None when no
+    file's last scope carried one); derives the effective readback
+    bandwidth — wire bytes over *blocking* wait seconds, i.e. the rate
+    the driver loop actually experienced after async overlap — and the
+    per-tile transfer count (packed fetch = 1.0)."""
+    seen = [c["fetch"] for c in folded if c["fetch"] is not None]
+    if not seen:
+        return None
+    out: dict = {}
+    for k in _FETCH_COUNTERS:
+        vals = [fx[k] for fx in seen if k in fx]
+        if vals:
+            v = sum(vals)
+            out[k] = round(v, 4) if isinstance(v, float) else v
+    blv = [fx["backlog_max"] for fx in seen if "backlog_max" in fx]
+    if blv:
+        out["backlog_max"] = max(blv)
+    pk = {fx.get("packed") for fx in seen if "packed" in fx}
+    if len(pk) == 1:
+        out["packed"] = pk.pop()
+    tiles = out.get("tiles", 0)
+    out["transfers_per_tile"] = (
+        round(out.get("transfers", 0) / tiles, 2) if tiles else None
+    )
+    wait = out.get("wait_s", 0)
+    out["effective_gb_per_s"] = (
+        round(out.get("bytes", 0) / wait / 1e9, 3) if wait else None
+    )
     return out
 
 
@@ -267,6 +310,22 @@ def fold(
                                 if k in rec
                             },
                         }
+                    elif ev == "fetch":
+                        # device→host fetch rollup (runtime/fetch): one per
+                        # scope, last wins; required counters must resolve
+                        cur["fetch"] = {
+                            "tiles": rec["tiles"],
+                            "transfers": rec["transfers"],
+                            "bytes": rec["bytes"],
+                            "pack_s": rec["pack_s"],
+                            "wait_s": rec["wait_s"],
+                            "unpack_s": rec["unpack_s"],
+                            **{
+                                k: rec[k]
+                                for k in ("backlog_max", "packed")
+                                if k in rec
+                            },
+                        }
                     elif ev == "run_done":
                         host_info.update(
                             status=rec.get("status"), wall_s=rec.get("wall_s"),
@@ -307,6 +366,7 @@ def fold(
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
         "feed_cache": _merge_feed_cache(folded),
+        "fetch": _merge_fetch(folded),
         "hosts": hosts,
     }
     return report, spans
