@@ -32,7 +32,7 @@ import os
 import sys
 
 from land_trendr_tpu.config import LTParams
-from land_trendr_tpu.ops.indices import INDEX_NAMES
+from land_trendr_tpu.ops.indices import DEFAULT_QA_REJECT, INDEX_NAMES
 from land_trendr_tpu.runtime.manifest import ARTIFACT_COMPRESS
 
 __all__ = ["main", "build_parser"]
@@ -208,6 +208,21 @@ def build_parser() -> argparse.ArgumentParser:
                      "interfaces; pass 127.0.0.1 to keep the "
                      "unauthenticated endpoint off the network)")
     seg.add_argument("--max-retries", type=int, default=2)
+    seg.add_argument("--reject-bits", type=lambda s: int(s, 0),
+                     default=DEFAULT_QA_REJECT, metavar="MASK",
+                     help="QA_PIXEL bitmask of rejected observation classes "
+                     "(decimal or 0x hex; default: the C2 fill/cloud/shadow "
+                     f"set, 0x{DEFAULT_QA_REJECT:x})")
+    seg.add_argument("--chunk-px", default=262_144, metavar="N",
+                     type=lambda s: None if s.lower() == "none" else int(s),
+                     help="transient-HBM bound: tiles with more pixels run "
+                     "the segmentation through the chunked kernel; 'none' "
+                     "disables chunking (the kernel working set then grows "
+                     "with the full tile)")
+    seg.add_argument("--metrics-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="with --telemetry: metrics.prom refresh period "
+                     "in seconds")
     seg.add_argument(
         "--mesh",
         action="store_true",
@@ -620,6 +635,9 @@ def main(argv: list[str] | None = None) -> int:
                 feed_cache_mb=args.feed_cache_mb,
                 decode_workers=args.decode_workers,
                 feed_readahead=not args.no_feed_readahead,
+                reject_bits=args.reject_bits,
+                chunk_px=args.chunk_px,
+                metrics_interval_s=args.metrics_interval_s,
                 impl=args.impl,
                 change_filt=change_filt,
                 out_overviews=args.out_overviews,

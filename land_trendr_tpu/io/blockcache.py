@@ -137,7 +137,8 @@ def _evict_to_budget_locked() -> None:
 
 
 def cache_enabled() -> bool:
-    return _budget_bytes > 0
+    with _lock:
+        return _budget_bytes > 0
 
 
 def cache_get(key: tuple) -> "np.ndarray | None":
@@ -191,11 +192,13 @@ def cache_clear() -> None:
 
 
 def cache_bytes() -> int:
-    return _cache_bytes
+    with _lock:
+        return _cache_bytes
 
 
 def budget_bytes() -> int:
-    return _budget_bytes
+    with _lock:
+        return _budget_bytes
 
 
 def file_key(f, path: str) -> "tuple | None":
@@ -214,17 +217,21 @@ def file_key(f, path: str) -> "tuple | None":
 
 def decode_threads() -> int:
     """``n_threads`` for the native codec: 0 = its own auto-threading."""
-    if _workers is None:
+    with _lock:
+        workers = _workers
+    if workers is None:
         return 0
-    return _workers
+    return workers
 
 
 def _effective_pool_size() -> int:
-    if _workers is None or _workers == 1:
+    with _lock:
+        workers = _workers
+    if workers is None or workers == 1:
         return 1
-    if _workers == 0:
+    if workers == 0:
         return min(_AUTO_WORKERS_MAX, os.cpu_count() or 1)
-    return _workers
+    return workers
 
 
 def decode_pool() -> "ThreadPoolExecutor | None":

@@ -1,0 +1,64 @@
+"""lt-lint: AST-based invariant checks for the concurrent subsystems.
+
+Five repo-specific rules over a small parent-linked-AST framework
+(:mod:`.core`); the CLI is ``tools/lt_lint.py`` (``--json``,
+``--changed``, exit 1 on any finding not suppressed by an inline
+``# lt: noqa[rule]`` or a reasoned ``LINT_BASELINE.json`` entry):
+
+========  ==========================================================
+LT001     shared state mutated / snapshot-read outside its lock
+LT002     blocking host sync outside ``runtime/fetch.py``
+LT003     side effects inside (or reachable from) jitted functions
+LT004     RunConfig ↔ CLI flag ↔ README-table coupling
+LT005     Telemetry emit-site fields vs the event schema
+========  ==========================================================
+
+See README.md §Static analysis for the rule table with rationale and
+example findings.
+"""
+
+from land_trendr_tpu.lintkit.configdoc import ConfigDocChecker
+from land_trendr_tpu.lintkit.core import (
+    Baseline,
+    BaselineError,
+    Checker,
+    FileCtx,
+    Finding,
+    RepoCtx,
+    run_rules,
+)
+from land_trendr_tpu.lintkit.eventschema import EventSchemaChecker
+from land_trendr_tpu.lintkit.hostsync import HostSyncChecker
+from land_trendr_tpu.lintkit.jitpurity import JitPurityChecker
+from land_trendr_tpu.lintkit.locks import LockDisciplineChecker
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Baseline",
+    "BaselineError",
+    "Checker",
+    "ConfigDocChecker",
+    "EventSchemaChecker",
+    "FileCtx",
+    "Finding",
+    "HostSyncChecker",
+    "JitPurityChecker",
+    "LockDisciplineChecker",
+    "RepoCtx",
+    "default_checkers",
+    "run_rules",
+]
+
+#: rule classes in rule-id order — the CLI's default set
+ALL_CHECKERS = (
+    LockDisciplineChecker,
+    HostSyncChecker,
+    JitPurityChecker,
+    ConfigDocChecker,
+    EventSchemaChecker,
+)
+
+
+def default_checkers() -> list:
+    """Fresh instances of every rule (some cache schema state)."""
+    return [cls() for cls in ALL_CHECKERS]

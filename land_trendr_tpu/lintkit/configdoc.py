@@ -1,0 +1,221 @@
+"""LT004 — RunConfig / CLI / README coupling.
+
+``RunConfig`` (runtime/driver.py) is the run's one configuration
+surface; ``cli.py``'s ``segment`` flags and README's §Run configuration
+table are its two public projections.  They drift independently: a
+field added for a new subsystem (PR 2's ``feed_cache_mb``, PR 3's
+``fetch_depth``) is easy to wire into one projection and forget in the
+other, leaving a knob that exists but cannot be set from the command
+line, or documentation describing a field that no longer exists.
+
+The rule parses all three sources and checks the triangle:
+
+* every ``RunConfig`` dataclass field has a ``segment`` CLI flag —
+  ``foo_bar`` ↔ ``--foo-bar`` by convention, with an explicit alias
+  table for the negated/composite flags (``resume`` ↔ ``--no-resume``,
+  ``change_filt`` ↔ ``--change``/``--change-*``, ``params`` ↔
+  ``--params-json`` + the per-parameter flags, ...);
+* every field has a row in README.md's ``## Run configuration`` table
+  (a row is ``| `field` | ... |``);
+* every README table row names a real field (the reverse direction —
+  catches renames/removals whose doc row survived).
+
+CLI flags with no field are deliberately NOT checked: many segment
+flags are not run configuration (``--lazy``, ``--mesh``, ``--trace``,
+``--composite`` act before/around ``RunConfig``), and enumerating them
+here would just create a second alias table to drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+
+__all__ = ["ConfigDocChecker"]
+
+DRIVER = "land_trendr_tpu/runtime/driver.py"
+CLI = "land_trendr_tpu/cli.py"
+README = "README.md"
+
+#: fields whose CLI projection is not the mechanical --dashed-name
+FLAG_ALIASES: dict[str, tuple[str, ...]] = {
+    "resume": ("no-resume",),
+    "feed_readahead": ("no-feed-readahead",),
+    "fetch_packed": ("packed-fetch", "no-packed-fetch"),
+    "ftv_indices": ("ftv",),
+    "change_filt": ("change",),
+    "params": ("params-json",),
+}
+
+_ROW_RE = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)`")
+
+
+def _runconfig_fields(repo: RepoCtx) -> "list[tuple[str, int]]":
+    """(field, line) for every RunConfig dataclass field."""
+    tree = repo.file(DRIVER).tree
+    if tree is None:
+        return []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "RunConfig":
+            return [
+                (stmt.target.id, stmt.lineno)
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+    return []
+
+
+def _flag_strings(node: ast.Call) -> Iterator[str]:
+    for a in node.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            if a.value.startswith("--"):
+                yield a.value[2:]
+
+
+def _cli_flags(repo: RepoCtx) -> set:
+    """``--flag`` strings reachable from the SEGMENT subparser.
+
+    Scoped, not global: several subcommands define same-named flags
+    (``--scale``/``--index`` exist on ``pixel`` too), so a flag dropped
+    from ``segment`` must not stay green via another subcommand.  The
+    scope is the variable assigned from ``add_parser("segment")``, plus
+    its ``add_argument_group``/mutually-exclusive-group variables, plus
+    every ``add_argument`` inside a module function the segment parser
+    is passed to (the ``_add_param_flags(seg)`` pattern).  If no segment
+    subparser exists (a restructured cli.py), every flag counts — a
+    conservative fallback rather than a wall of false positives.
+    """
+    tree = repo.file(CLI).tree
+    flags: set = set()
+    if tree is None:
+        return flags
+
+    seg_vars: set = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr == "add_parser"
+            and node.value.args
+            and isinstance(node.value.args[0], ast.Constant)
+            and node.value.args[0].value == "segment"
+        ):
+            seg_vars.update(
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            )
+    if not seg_vars:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+            ):
+                flags.update(_flag_strings(node))
+        return flags
+
+    # argument-group variables of the segment parser count as the parser
+    group_vars = set(seg_vars)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Attribute)
+            and node.value.func.attr
+            in ("add_argument_group", "add_mutually_exclusive_group")
+            and isinstance(node.value.func.value, ast.Name)
+            and node.value.func.value.id in group_vars
+        ):
+            group_vars.update(
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            )
+
+    helper_names: set = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and any(
+                isinstance(a, ast.Name) and a.id in seg_vars
+                for a in node.args
+            )
+        ):
+            helper_names.add(node.func.id)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in helper_names:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "add_argument"
+                ):
+                    flags.update(_flag_strings(sub))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in group_vars
+        ):
+            flags.update(_flag_strings(node))
+    return flags
+
+
+def _readme_config_rows(repo: RepoCtx) -> "list[tuple[str, int]]":
+    """(field, line) for each §Run configuration table row in README."""
+    rows: list[tuple[str, int]] = []
+    in_section = False
+    for i, line in enumerate(repo.read_text(README).splitlines(), 1):
+        if line.startswith("## "):
+            in_section = line.strip().lower() == "## run configuration"
+            continue
+        if in_section:
+            m = _ROW_RE.match(line)
+            if m and m.group(1) not in ("field",):  # skip the header row
+                rows.append((m.group(1), i))
+    return rows
+
+
+class ConfigDocChecker(Checker):
+    rule_id = "LT004"
+    title = "RunConfig field without CLI flag / README row (or vice versa)"
+
+    def inputs(self, repo: RepoCtx) -> set:
+        return {DRIVER, CLI, README}
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        if not (repo.exists(DRIVER) and repo.exists(CLI)):
+            return
+        fields = _runconfig_fields(repo)
+        field_names = {f for f, _ in fields}
+        flags = _cli_flags(repo)
+        rows = _readme_config_rows(repo) if repo.exists(README) else []
+        row_names = {r for r, _ in rows}
+
+        for field, line in fields:
+            expected = FLAG_ALIASES.get(field, (field.replace("_", "-"),))
+            if not any(f in flags for f in expected):
+                yield Finding(
+                    DRIVER, line, self.rule_id,
+                    f"RunConfig.{field} has no CLI flag in cli.py (expected "
+                    f"one of {', '.join('--' + f for f in expected)}) — the "
+                    "knob cannot be set from the command line",
+                )
+            if field not in row_names:
+                yield Finding(
+                    DRIVER, line, self.rule_id,
+                    f"RunConfig.{field} has no row in README.md's "
+                    "'## Run configuration' table",
+                )
+        for row, line in rows:
+            if row not in field_names:
+                yield Finding(
+                    README, line, self.rule_id,
+                    f"README Run-configuration row '{row}' names no "
+                    "RunConfig field (renamed or removed?)",
+                )

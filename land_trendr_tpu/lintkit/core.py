@@ -1,0 +1,341 @@
+"""AST lint framework for the repo's concurrency/coupling invariants.
+
+PRs 1-3 turned the single-process pipeline into a concurrent system — a
+process-wide decoded-block cache with a shared decode pool
+(``io/blockcache.py``), an async fetch backlog (``runtime/fetch.py``),
+and serialized telemetry writers (``obs/``) — whose correctness rests on
+invariants no runtime test can pin, because races and stray host syncs
+are timing-dependent.  PR 3 found one such bug (a blocking
+``model_valid`` fetch hiding in a write-timer metadata branch) by eye;
+this package is the machine that finds the class, on every PR.
+
+Pieces:
+
+* :class:`Finding` — one violation: ``(file, line, rule_id, message)``.
+* :class:`FileCtx` / :class:`RepoCtx` — parsed-AST caches.  Every tree
+  is **parent-linked** (:func:`link_parents` stamps ``node.parent``), so
+  rules ask "is this statement inside a ``with self._lock``" by walking
+  ancestors instead of threading state through a visitor.
+* :class:`Checker` — one rule: ``rule_id``, ``title``, and a
+  ``check(repo)`` generator.  Per-file rules override ``check_file``;
+  repo-level rules (config/README coupling, emit-site schema) override
+  ``check`` directly and declare ``inputs(repo)`` so ``--changed`` runs
+  know when they apply.
+* suppressions — two layers, both requiring intent to be written down:
+  inline ``# lt: noqa[LT001]`` on the finding's line or in the
+  comment-only block immediately above it (``# lt: noqa`` suppresses
+  every rule), and :class:`Baseline` — a
+  committed ``LINT_BASELINE.json`` of deliberate exceptions, each entry
+  carrying a non-empty ``reason`` string (entries without one are a
+  lint-configuration error, not a suppression).
+
+The CLI is ``tools/lt_lint.py``; the rules live in the sibling modules
+(:mod:`.locks`, :mod:`.hostsync`, :mod:`.jitpurity`, :mod:`.configdoc`,
+:mod:`.eventschema`).  Everything here is stdlib-only and jax-free, so
+the linter runs in any environment the tests do.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Baseline",
+    "BaselineError",
+    "Checker",
+    "FileCtx",
+    "Finding",
+    "RepoCtx",
+    "link_parents",
+    "ancestors",
+    "enclosing_function",
+    "in_with_lock",
+    "run_rules",
+]
+
+#: dirs never linted: VCS state, caches, generated protobuf, C++ sources
+_SKIP_DIRS = {".git", "__pycache__", ".claude", "native", "_proto"}
+
+_NOQA_RE = re.compile(r"#\s*lt:\s*noqa(?:\[([A-Za-z0-9_,\s]+)\])?")
+
+#: sentinel for a bare ``# lt: noqa`` (suppresses every rule on the line)
+ALL_RULES = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.
+
+    ``file`` is repo-relative (what the baseline keys on and what CI
+    prints); ``line`` is 1-based.
+    """
+
+    file: str
+    line: int
+    rule_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule_id} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def link_parents(tree: ast.AST) -> ast.AST:
+    """Stamp ``node.parent`` on every node (root's parent is ``None``)."""
+    tree.parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    """Yield ``node``'s ancestors, innermost first (parent-link walk)."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "parent", None)
+
+
+def enclosing_function(node: ast.AST) -> "ast.FunctionDef | ast.AsyncFunctionDef | None":
+    """Nearest enclosing function definition, or None at module level."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def in_with_lock(node: ast.AST, is_lock_expr) -> bool:
+    """True when ``node`` sits inside a ``with`` whose context expression
+    satisfies ``is_lock_expr`` (the rule's definition of "the lock")."""
+    for a in ancestors(node):
+        if isinstance(a, ast.With):
+            for item in a.items:
+                if is_lock_expr(item.context_expr):
+                    return True
+    return False
+
+
+class FileCtx:
+    """One source file: text, parent-linked AST, and noqa line map."""
+
+    def __init__(self, root: str, relpath: str, source: "str | None" = None) -> None:
+        self.root = root
+        self.path = relpath
+        if source is None:
+            with open(os.path.join(root, relpath)) as f:
+                source = f.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self._tree: "ast.AST | None" = None
+        self._noqa: "dict[int, set[str]] | None" = None
+
+    @property
+    def tree(self) -> "ast.AST | None":
+        """Parent-linked AST, or None when the file does not parse (a
+        syntax error is pytest/import-time territory, not lint's)."""
+        if self._tree is None:
+            try:
+                self._tree = link_parents(ast.parse(self.source))
+            except SyntaxError:
+                self._tree = None
+        return self._tree
+
+    def noqa_rules(self, line: int) -> set:
+        """Rule ids suppressed on ``line`` (``{'*'}`` = all rules)."""
+        if self._noqa is None:
+            self._noqa = {}
+            for i, text in enumerate(self.lines, 1):
+                m = _NOQA_RE.search(text)
+                if m:
+                    if m.group(1):
+                        self._noqa[i] = {
+                            r.strip() for r in m.group(1).split(",") if r.strip()
+                        }
+                    else:
+                        self._noqa[i] = {ALL_RULES}
+        return self._noqa.get(line, set())
+
+    def suppressed(self, finding: Finding) -> bool:
+        """Inline suppression: a ``# lt: noqa[...]`` on the finding's own
+        line, or anywhere in the comment-only block immediately above it
+        (so a suppression can carry a multi-line justification without
+        stretching the code line)."""
+        rules = set(self.noqa_rules(finding.line))
+        i = finding.line - 1
+        while i >= 1 and self.lines[i - 1].lstrip().startswith("#"):
+            rules |= self.noqa_rules(i)
+            i -= 1
+        return ALL_RULES in rules or finding.rule_id in rules
+
+
+class RepoCtx:
+    """The lint run's view of the repository: root + cached FileCtx's."""
+
+    def __init__(self, root: str, files: "Iterable[str] | None" = None) -> None:
+        self.root = os.path.abspath(root)
+        self._files = sorted(files) if files is not None else None
+        self._ctx: dict[str, FileCtx] = {}
+
+    @property
+    def py_files(self) -> list[str]:
+        if self._files is None:
+            self._files = sorted(self._discover())
+        return self._files
+
+    def _discover(self) -> Iterator[str]:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for name in filenames:
+                if name.endswith(".py"):
+                    yield os.path.relpath(
+                        os.path.join(dirpath, name), self.root
+                    )
+
+    def file(self, relpath: str) -> FileCtx:
+        if relpath not in self._ctx:
+            self._ctx[relpath] = FileCtx(self.root, relpath)
+        return self._ctx[relpath]
+
+    def exists(self, relpath: str) -> bool:
+        return os.path.exists(os.path.join(self.root, relpath))
+
+    def read_text(self, relpath: str) -> str:
+        with open(os.path.join(self.root, relpath)) as f:
+            return f.read()
+
+
+class Checker:
+    """One lint rule.  Subclasses set ``rule_id``/``title`` and override
+    ``check_file`` (per-file rules) or ``check`` (repo-level rules)."""
+
+    rule_id: str = ""
+    title: str = ""
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        """Files this rule reads beyond the per-file walk (repo-level
+        rules return them so ``--changed`` knows when the rule applies);
+        ``None`` = purely per-file."""
+        return None
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        for relpath in repo.py_files:
+            ctx = repo.file(relpath)
+            if ctx.tree is None:
+                continue
+            yield from self.check_file(ctx)
+
+    def check_file(self, ctx: FileCtx) -> Iterator[Finding]:
+        return iter(())
+
+
+class BaselineError(ValueError):
+    """A malformed LINT_BASELINE.json (missing reason, unknown shape)."""
+
+
+class Baseline:
+    """Committed deliberate exceptions, each with a written reason.
+
+    Entry shape::
+
+        {"rule": "LT002", "file": "land_trendr_tpu/parallel/multihost.py",
+         "contains": "np.asarray", "reason": "gather path: ..."}
+
+    ``contains`` (optional) must be a substring of the finding message —
+    entries key on content, not line numbers, so unrelated edits to the
+    file do not invalidate them.  Every entry MUST carry a non-empty
+    ``reason``; an exception nobody can explain is not an exception.
+    """
+
+    def __init__(self, entries: "list[dict] | None" = None) -> None:
+        self.entries = entries or []
+        for i, e in enumerate(self.entries):
+            if not isinstance(e, dict) or not e.get("rule") or not e.get("file"):
+                raise BaselineError(f"baseline entry {i} needs 'rule' and 'file'")
+            if not str(e.get("reason", "")).strip():
+                raise BaselineError(
+                    f"baseline entry {i} ({e.get('rule')} {e.get('file')}) "
+                    "has no reason — every deliberate exception must say why"
+                )
+        self._hits = [0] * len(self.entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or not isinstance(data.get("entries"), list):
+            raise BaselineError(f"{path}: expected {{'entries': [...]}}")
+        return cls(data["entries"])
+
+    def match(self, finding: Finding) -> "dict | None":
+        for i, e in enumerate(self.entries):
+            if e["rule"] != finding.rule_id or e["file"] != finding.file:
+                continue
+            if e.get("contains") and e["contains"] not in finding.message:
+                continue
+            self._hits[i] += 1
+            return e
+        return None
+
+    def unused(self) -> list[dict]:
+        """Entries that matched nothing — stale exceptions to clean up."""
+        return [e for e, n in zip(self.entries, self._hits) if n == 0]
+
+
+def run_rules(
+    repo: RepoCtx,
+    rules: Iterable[Checker],
+    baseline: "Baseline | None" = None,
+    only_files: "set[str] | None" = None,
+) -> dict:
+    """Run every rule; split findings into active / baselined / noqa'd.
+
+    ``only_files`` (the ``--changed`` set) scopes per-file rules to just
+    those files — they parse and walk nothing else, so a one-file
+    pre-commit run costs one file, not the tree; a repo-level rule runs
+    iff any of its declared ``inputs`` is in the set, and then keeps all
+    its findings (coupling rules span files by nature).
+    """
+    active: list[Finding] = []
+    baselined: list[tuple[Finding, dict]] = []
+    noqa_count = 0
+    scoped_repo = repo
+    if only_files is not None:
+        scoped_repo = RepoCtx(
+            repo.root, files=[f for f in repo.py_files if f in only_files]
+        )
+    for rule in rules:
+        inputs = rule.inputs(repo)
+        if only_files is not None and inputs is not None:
+            if not (inputs & only_files):
+                continue
+        for finding in rule.check(repo if inputs is not None else scoped_repo):
+            if (
+                only_files is not None
+                and inputs is None
+                and finding.file not in only_files
+            ):
+                continue
+            if finding.file.endswith(".py") and repo.exists(finding.file):
+                if repo.file(finding.file).suppressed(finding):
+                    noqa_count += 1
+                    continue
+            entry = baseline.match(finding) if baseline is not None else None
+            if entry is not None:
+                baselined.append((finding, entry))
+            else:
+                active.append(finding)
+    active.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return {
+        "findings": active,
+        "baselined": baselined,
+        "noqa_suppressed": noqa_count,
+        "unused_baseline": baseline.unused() if baseline is not None else [],
+    }

@@ -1,0 +1,259 @@
+"""LT005 — telemetry emit sites must match the event schema.
+
+The events.jsonl contract has one normative source —
+``land_trendr_tpu.obs.events.EVENT_FIELDS`` / ``OPTIONAL_FIELDS`` — and
+two sets of consumers that validate against it at runtime
+(``tools/check_events_schema.py``, ``tools/obs_report.py``).  But the
+PRODUCER side (the dict-literal keys at ``Telemetry``'s
+``self.events.emit(...)`` call sites) was only checked by actually
+running a telemetry run through the schema lint: a typo'd field name or
+a forgotten required field ships silently until some integration test
+happens to exercise that event.  This rule closes the loop statically.
+
+For every ``*.emit("<event>", ...)`` call in the producer modules:
+
+* the literal event name must exist in ``EVENT_FIELDS``;
+* every explicit keyword must be a required or optional field of that
+  event (``t_wall``/``t_mono`` are stamped by ``EventLog.emit`` itself);
+* ``**splat`` arguments are resolved within the enclosing function —
+  dict literals, ``{k: ... for k in ("a", "b", ...)}`` comprehensions
+  over constant tuples, ``fields["k"] = ...`` stores and
+  ``fields.setdefault("k", ...)`` calls all contribute keys; resolved
+  keys are checked like keywords.  A splat the resolver cannot see
+  through (a parameter, a call result) disables only the
+  missing-required check — unknown-key checks still apply to what IS
+  visible;
+* when every splat resolved, each required field must appear.
+
+It also cross-checks the runtime value-lint tables exported by
+``tools/check_events_schema.py`` (``NONNEG_FIELDS`` — the satellite
+refactor that made them importable data): every event and field they
+name must exist in the schema, so the static rule and the runtime
+linter can never drift onto two parallel copies.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import Checker, FileCtx, Finding, RepoCtx
+
+__all__ = ["EventSchemaChecker"]
+
+#: producer modules whose emit sites are checked (the Telemetry bundle
+#: is THE emit surface; EventLog.emit itself is the transport, not a site)
+PRODUCER_FILES = ("land_trendr_tpu/obs/telemetry.py",)
+
+SCHEMA_TOOL = "tools/check_events_schema.py"
+
+#: stamped by EventLog.emit on every record — never passed by callers
+_COMMON = {"t_wall", "t_mono"}
+
+
+def _load_nonneg_tables(repo: RepoCtx) -> "dict | None":
+    """``NONNEG_FIELDS`` from tools/check_events_schema.py, or None when
+    the tool is absent/unloadable (the cross-check then just skips)."""
+    path = os.path.join(repo.root, SCHEMA_TOOL)
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("_lt_schema_tool", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return getattr(mod, "NONNEG_FIELDS", None)
+    except Exception:
+        return None
+
+
+class _SplatKeys:
+    """Key-gathering for one ``**name`` splat inside one function."""
+
+    def __init__(self) -> None:
+        self.keys: set = set()
+        self.resolved = True
+        #: did ANY source contribute?  A splatted name with no visible
+        #: assignment (a parameter, a closure) is unresolvable, not empty
+        self.found = False
+
+    def add_dict_expr(self, expr: ast.AST) -> None:
+        """Gather keys from a dict-producing expression (best effort)."""
+        self.found = True
+        if isinstance(expr, ast.Dict):
+            for k in expr.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    self.keys.add(k.value)
+                elif k is not None:  # non-constant key or ** merge
+                    self.resolved = False
+        elif isinstance(expr, ast.DictComp):
+            # {k: ... for k in ("a", "b") if ...} — constant-tuple domains
+            gen = expr.generators[0] if expr.generators else None
+            if (
+                gen is not None
+                and isinstance(expr.key, ast.Name)
+                and isinstance(gen.target, ast.Name)
+                and expr.key.id == gen.target.id
+                and isinstance(gen.iter, (ast.Tuple, ast.List))
+                and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in gen.iter.elts
+                )
+            ):
+                self.keys.update(e.value for e in gen.iter.elts)
+            else:
+                self.resolved = False
+        elif isinstance(expr, ast.IfExp):
+            # **({"stage_s": ...} if stage_s else {}) — both branches
+            self.add_dict_expr(expr.body)
+            self.add_dict_expr(expr.orelse)
+        else:
+            self.resolved = False
+
+
+def _gather_splat(fn: ast.AST, name: str) -> _SplatKeys:
+    """All keys a local dict ``name`` can carry within ``fn``."""
+    out = _SplatKeys()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    if node.value is not None:
+                        out.add_dict_expr(node.value)
+                elif (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == name
+                ):
+                    if isinstance(t.slice, ast.Constant) and isinstance(
+                        t.slice.value, str
+                    ):
+                        out.found = True
+                        out.keys.add(t.slice.value)
+                    # non-constant subscript keys: conservative — they can
+                    # only ADD keys we cannot name, so requiredness stays
+                    # checkable but unknown-key checks skip them
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+            and node.args
+        ):
+            k = node.args[0]
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.found = True
+                out.keys.add(k.value)
+    if not out.found:
+        out.resolved = False
+    return out
+
+
+class EventSchemaChecker(Checker):
+    rule_id = "LT005"
+    title = "emit-site fields drift from the event schema"
+
+    def __init__(self) -> None:
+        from land_trendr_tpu.obs.events import EVENT_FIELDS, OPTIONAL_FIELDS
+
+        self.required = {ev: set(f) for ev, f in EVENT_FIELDS.items()}
+        self.optional = {ev: set(f) for ev, f in OPTIONAL_FIELDS.items()}
+
+    def inputs(self, repo: RepoCtx) -> set:
+        return set(PRODUCER_FILES) | {SCHEMA_TOOL, "land_trendr_tpu/obs/events.py"}
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        for relpath in PRODUCER_FILES:
+            if repo.exists(relpath):
+                ctx = repo.file(relpath)
+                if ctx.tree is not None:
+                    yield from self._check_producer(ctx)
+        yield from self._check_value_tables(repo)
+
+    # -- producer emit sites ----------------------------------------------
+    def _check_producer(self, ctx: FileCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            ev = node.args[0].value
+            if ev not in self.required:
+                yield Finding(
+                    ctx.path, node.lineno, self.rule_id,
+                    f"emit of unknown event type '{ev}' (not in "
+                    "obs.events.EVENT_FIELDS)",
+                )
+                continue
+            allowed = self.required[ev] | self.optional.get(ev, set()) | _COMMON
+            present: set = set()
+            all_resolved = True
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    present.add(kw.arg)
+                    if kw.arg not in allowed:
+                        yield Finding(
+                            ctx.path, node.lineno, self.rule_id,
+                            f"emit('{ev}') passes field '{kw.arg}' that is "
+                            "neither required nor a known optional field — "
+                            "add it to OPTIONAL_FIELDS or fix the name",
+                        )
+                    continue
+                # **splat: resolve within the enclosing function
+                splat = _SplatKeys()
+                if isinstance(kw.value, ast.Name):
+                    fn = node
+                    from land_trendr_tpu.lintkit.core import enclosing_function
+
+                    owner = enclosing_function(fn)
+                    if owner is not None:
+                        splat = _gather_splat(owner, kw.value.id)
+                    else:
+                        splat.resolved = False
+                else:
+                    splat.add_dict_expr(kw.value)
+                present.update(splat.keys)
+                all_resolved = all_resolved and splat.resolved
+                for key in sorted(splat.keys - allowed):
+                    yield Finding(
+                        ctx.path, node.lineno, self.rule_id,
+                        f"emit('{ev}') splat carries field '{key}' that is "
+                        "neither required nor a known optional field",
+                    )
+            if all_resolved:
+                for missing in sorted(self.required[ev] - present):
+                    yield Finding(
+                        ctx.path, node.lineno, self.rule_id,
+                        f"emit('{ev}') never sets required field "
+                        f"'{missing}' (schema EVENT_FIELDS['{ev}'])",
+                    )
+
+    # -- runtime value-lint tables vs the schema ---------------------------
+    def _check_value_tables(self, repo: RepoCtx) -> Iterator[Finding]:
+        tables = _load_nonneg_tables(repo)
+        if tables is None:
+            return
+        for ev, names in tables.items():
+            if ev not in self.required:
+                yield Finding(
+                    SCHEMA_TOOL, 1, self.rule_id,
+                    f"NONNEG_FIELDS names unknown event '{ev}' — the value "
+                    "lint and the schema have drifted",
+                )
+                continue
+            known = self.required[ev] | self.optional.get(ev, set())
+            for name in names:
+                if name not in known:
+                    yield Finding(
+                        SCHEMA_TOOL, 1, self.rule_id,
+                        f"NONNEG_FIELDS['{ev}'] names field '{name}' that "
+                        "the schema does not define",
+                    )

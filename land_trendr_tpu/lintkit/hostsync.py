@@ -1,0 +1,86 @@
+"""LT002 — no blocking host sync outside the fetch path.
+
+The driver's throughput design (README §Fetch path, arXiv:1807.01751's
+host-I/O-bound regime) funnels every device→host materialization through
+``runtime/fetch.py``: packed transfers overlap the next tile's compute,
+and the per-product fallback runs inside the writer pool.  A stray
+``np.asarray`` / ``.block_until_ready()`` / ``.item()`` anywhere else in
+the runtime stalls the pipeline for a full link round trip per call —
+PR 3 removed exactly such a stray (a blocking ``model_valid`` fetch in a
+write-timer metadata branch) that had been invisible in tests because
+the artifacts stayed byte-identical.
+
+Static typing cannot prove a value is device-resident, so the rule is
+scoped instead of typed: inside the modules that handle device values
+(``land_trendr_tpu/runtime/``, ``land_trendr_tpu/obs/``,
+``land_trendr_tpu/parallel/``), every syncing call form is a finding —
+``np.asarray(...)``, ``jax.device_get(...)``, ``jax.block_until_ready``
+/ ``.block_until_ready()``, and ``.item()``.  ``runtime/fetch.py`` is
+the blessed module (it IS the fetch path); the driver's two sanctioned
+compute-wait sites carry inline ``# lt: noqa[LT002]``, and host-side
+assembly seams live in ``LINT_BASELINE.json`` with their reasons.
+(`float()` on a device scalar is the same hazard but indistinguishable
+from a host cast without types — ``.item()`` covers the idiom the
+codebase actually uses.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import Checker, FileCtx, Finding
+
+__all__ = ["HostSyncChecker"]
+
+#: path prefixes where device values flow and a sync stalls the pipeline
+SCOPED_PREFIXES = (
+    "land_trendr_tpu/runtime/",
+    "land_trendr_tpu/obs/",
+    "land_trendr_tpu/parallel/",
+)
+
+#: the one module allowed to sync: it is the fetch path
+BLESSED_FILES = ("land_trendr_tpu/runtime/fetch.py",)
+
+
+def _call_sync_kind(node: ast.Call) -> "str | None":
+    """The sync idiom a call expresses, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value.id if isinstance(fn.value, ast.Name) else None
+        if fn.attr == "asarray" and base in ("np", "numpy"):
+            return "np.asarray (device->host materialization)"
+        if fn.attr == "device_get" and base == "jax":
+            return "jax.device_get (blocking device->host fetch)"
+        if fn.attr == "block_until_ready":
+            return (
+                "jax.block_until_ready (host blocks on device)"
+                if base == "jax"
+                else ".block_until_ready() (host blocks on device)"
+            )
+        if fn.attr == "item" and not node.args and not node.keywords:
+            return ".item() (device scalar sync)"
+    return None
+
+
+class HostSyncChecker(Checker):
+    rule_id = "LT002"
+    title = "blocking host sync outside runtime/fetch.py"
+
+    def check_file(self, ctx: FileCtx) -> Iterator[Finding]:
+        path = ctx.path.replace("\\", "/")
+        if path in BLESSED_FILES or not path.startswith(SCOPED_PREFIXES):
+            return
+        assert ctx.tree is not None
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _call_sync_kind(node)
+            if kind is not None:
+                yield Finding(
+                    ctx.path, node.lineno, self.rule_id,
+                    f"{kind} outside the fetch path — route device->host "
+                    "materialization through runtime/fetch.py or bless the "
+                    "site explicitly",
+                )

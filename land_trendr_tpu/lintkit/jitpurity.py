@@ -1,0 +1,156 @@
+"""LT003 — no side effects inside (or reachable from) jitted code.
+
+A ``jax.jit``/``pjit`` function's Python body runs once per compilation,
+then never again: a ``print``, file write, telemetry emit, lock
+acquisition, or global mutation inside it fires at trace time only (or
+worse, at every retrace, on no schedule the author controls).  The
+massively-parallel hot loop stays fast precisely because the jitted
+tile program is pure (ROADMAP north star; the pack program in
+``runtime/fetch.py`` is the canonical example — one traced bitcast
+pipeline, zero host effects).
+
+Detection: a function is **jitted** when decorated with ``jax.jit`` /
+``pjit`` / bare ``jit``, directly or through
+``functools.partial(jax.jit, ...)`` / ``jax.jit(...)`` calls.  The rule
+then walks the jitted function AND every same-module function reachable
+from it by direct name calls (one static call graph per module — the
+cross-module closure would mostly re-traverse jax itself).  Flagged
+effects, per the invariant's list:
+
+* ``print(...)`` calls;
+* file I/O — ``open(...)`` and any ``os.*`` call;
+* telemetry — any ``*.emit(...)`` call;
+* lock acquisition — ``with <lock>`` (a ``threading`` primitive named
+  ``*lock*``) or ``.acquire()``/``.release()`` calls;
+* global mutation — assignment to a ``global``-declared name.
+
+``jax.debug.print``/``jax.debug.callback`` are the sanctioned traced
+side-channels and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import Checker, FileCtx, Finding
+
+__all__ = ["JitPurityChecker"]
+
+_JIT_NAMES = ("jit", "pjit")
+
+
+def _names_jit(expr: ast.AST) -> bool:
+    """Does this decorator (sub)expression name a jit transform?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    if isinstance(expr, ast.Call):
+        # functools.partial(jax.jit, ...) or jax.jit(static_argnames=...)
+        if _names_jit(expr.func):
+            return True
+        return any(_names_jit(a) for a in expr.args)
+    return False
+
+
+def _is_jitted(fn: ast.FunctionDef) -> bool:
+    return any(_names_jit(d) for d in fn.decorator_list)
+
+
+def _is_debug_attr(fn: ast.AST) -> bool:
+    """``jax.debug.print`` / ``jax.debug.callback`` — sanctioned."""
+    return (
+        isinstance(fn, ast.Attribute)
+        and isinstance(fn.value, ast.Attribute)
+        and fn.value.attr == "debug"
+    )
+
+
+def _impurities(fn: ast.FunctionDef) -> Iterator[tuple[int, str]]:
+    """Yield ``(line, description)`` for each side effect in ``fn``."""
+    global_names = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            global_names.update(node.names)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id == "print":
+                    yield node.lineno, "print() call"
+                elif f.id == "open":
+                    yield node.lineno, "open() file I/O"
+            elif isinstance(f, ast.Attribute):
+                base = f.value.id if isinstance(f.value, ast.Name) else None
+                if base == "os":
+                    yield node.lineno, f"os.{f.attr}() file/process effect"
+                elif f.attr == "emit" and not _is_debug_attr(f):
+                    yield node.lineno, ".emit() telemetry call"
+                elif f.attr in ("acquire", "release"):
+                    yield node.lineno, f".{f.attr}() lock operation"
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                ce = item.context_expr
+                name = (
+                    ce.attr if isinstance(ce, ast.Attribute)
+                    else ce.id if isinstance(ce, ast.Name) else ""
+                )
+                if "lock" in name.lower():
+                    yield node.lineno, f"'with {name}' lock acquisition"
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in global_names:
+                    yield node.lineno, f"mutation of global '{t.id}'"
+
+
+class JitPurityChecker(Checker):
+    rule_id = "LT003"
+    title = "side effect inside (or reachable from) a jitted function"
+
+    def check_file(self, ctx: FileCtx) -> Iterator[Finding]:
+        assert ctx.tree is not None
+        # module-level function table for the reachability closure
+        functions: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                functions.setdefault(node.name, node)
+
+        def callees(fn: ast.FunctionDef) -> set:
+            return {
+                n.func.id
+                for n in ast.walk(fn)
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+            }
+
+        reported: set = set()  # (line, what): one finding per site, not per root
+        for fn in functions.values():
+            if not _is_jitted(fn):
+                continue
+            # reachable same-module functions, jitted root first
+            seen = {fn.name}
+            frontier = [fn]
+            chain: list[ast.FunctionDef] = []
+            while frontier:
+                cur = frontier.pop()
+                chain.append(cur)
+                for name in callees(cur):
+                    if name in functions and name not in seen:
+                        seen.add(name)
+                        frontier.append(functions[name])
+            for reached in chain:
+                via = (
+                    "" if reached is fn
+                    else f" (in '{reached.name}', reachable from it)"
+                )
+                for line, what in _impurities(reached):
+                    if (line, what) in reported:
+                        continue
+                    reported.add((line, what))
+                    yield Finding(
+                        ctx.path, line, self.rule_id,
+                        f"{what} inside jitted function '{fn.name}'{via} — "
+                        "jitted bodies run at trace time only; side effects "
+                        "fire never or on every retrace",
+                    )

@@ -1,0 +1,340 @@
+"""LT001 — lock discipline for shared mutable state.
+
+The concurrent subsystems (``io/blockcache.py``'s process-wide cache,
+``runtime/fetch.py``'s handle/stat objects, the ``obs/`` writers) keep
+their invariants by construction: state shared across threads is only
+touched under the owning ``threading.Lock``/``RLock``.  A violation is a
+data race that no tier-1 run reproduces deterministically — exactly the
+class of bug static analysis must own.
+
+The rule is evidence-based, not name-based: a name is **guarded** when
+the module/class demonstrably uses its lock for it — i.e. at least one
+mutation of that name happens inside ``with <lock>``.  Then:
+
+* any *mutation* of a guarded name outside the lock is a finding
+  (assignment, augmented assignment, subscript/attribute store, or a
+  mutating method call — ``pop``/``clear``/``append``/``update``/...);
+* any *read* of a guarded name inside a ``return`` expression outside
+  the lock is a finding — the "stats path" pattern, where an accessor
+  hands out a torn or mid-update view (``dict(self._acc)`` while a
+  writer thread mutates it raises ``RuntimeError: dictionary changed
+  size``; multi-field snapshots interleave).
+  Reads in other positions are deliberately NOT flagged: flow-sensitive
+  read analysis drowns the signal in false positives.
+
+Two scopes share the machinery:
+
+* **module scope** — a module-level ``_lock = threading.Lock()`` guards
+  module globals (``io/blockcache.py``'s design).  Mutations count when
+  the name is ``global``-declared, or a subscript/attribute/mutating
+  call on a module-level name.
+* **class scope** — a ``self.<x> = threading.Lock()`` in ``__init__``
+  guards ``self`` attributes.  ``__init__`` itself is exempt
+  (construction happens-before sharing).
+
+Convention: a function whose name ends in ``_locked`` is exempt — it
+documents "caller holds the lock" (``_evict_to_budget_locked``), and
+flagging it would force noqa noise on a pattern the repo already names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import (
+    Checker,
+    FileCtx,
+    Finding,
+    ancestors,
+    enclosing_function,
+    in_with_lock,
+)
+
+__all__ = ["LockDisciplineChecker"]
+
+#: method calls that mutate their receiver (list/dict/set/OrderedDict)
+_MUTATORS = frozenset(
+    {
+        "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+        "update", "setdefault", "add", "discard", "move_to_end", "sort",
+        "reverse", "appendleft", "popleft",
+    }
+)
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``threading.RLock()`` (or bare ``Lock()``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    return name in ("Lock", "RLock")
+
+
+def _locked_exempt(node: ast.AST) -> bool:
+    """Inside a ``*_locked``-suffixed function (caller-holds-lock)."""
+    fn = enclosing_function(node)
+    while fn is not None:
+        if fn.name.endswith("_locked"):
+            return True
+        fn = enclosing_function(fn)
+    return False
+
+
+def _global_names(fn: ast.AST) -> set:
+    return {
+        n
+        for stmt in ast.walk(fn)
+        if isinstance(stmt, ast.Global)
+        for n in stmt.names
+    }
+
+
+class _Scope:
+    """One lock domain (a module or a class) under analysis."""
+
+    def __init__(self, owner, lock_names: set, is_module: bool) -> None:
+        self.owner = owner
+        self.lock_names = lock_names
+        self.is_module = is_module
+
+    def is_lock_expr(self, expr: ast.AST) -> bool:
+        if self.is_module:
+            return isinstance(expr, ast.Name) and expr.id in self.lock_names
+        return (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and expr.attr in self.lock_names
+        )
+
+    def state_name(self, expr: ast.AST) -> "str | None":
+        """The guarded-candidate name an expression refers to, if any."""
+        if self.is_module:
+            return expr.id if isinstance(expr, ast.Name) else None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr
+        return None
+
+
+def _iter_mutations(scope: _Scope, body: ast.AST) -> Iterator[tuple]:
+    """Yield ``(node, name, kind)`` for every mutation of scope state.
+
+    ``kind`` is a short human label for the message.  Module scope
+    requires plain-name assigns to be ``global``-declared (otherwise the
+    target is a function local, not shared state).
+    """
+    for node in ast.walk(body):
+        targets: list[ast.AST] = []
+        if isinstance(node, (ast.Assign,)):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target] if node.target is not None else []
+        for t in targets:
+            name = scope.state_name(t)
+            if name is not None:
+                if scope.is_module and isinstance(t, ast.Name):
+                    fn = enclosing_function(node)
+                    if fn is None or name not in _global_names(fn):
+                        continue
+                yield node, name, "assignment"
+            # container stores: _entries[key] = ..., self._counts[i] += ...
+            if isinstance(t, ast.Subscript):
+                name = scope.state_name(t.value)
+                if name is not None:
+                    yield node, name, "item assignment"
+            # attribute stores on a guarded object: _tl.readahead = ...
+            # (module scope) and self._stats.hits = ... (class scope both
+            # resolve through the store target's value expression)
+            if isinstance(t, ast.Attribute):
+                name = scope.state_name(t.value)
+                if name is not None:
+                    yield node, name, "attribute assignment"
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                name = scope.state_name(node.func.value)
+                if name is not None:
+                    yield node, name, f".{node.func.attr}() call"
+
+
+def _iter_return_reads(scope: _Scope, body: ast.AST, guarded: set) -> Iterator[tuple]:
+    """Yield ``(node, name)`` for guarded-state reads inside ``return``."""
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        for sub in ast.walk(node.value):
+            name = scope.state_name(sub)
+            if name in guarded:
+                # reading self._x where _x is guarded; for module scope a
+                # bare Name load suffices (Store contexts were already
+                # yielded as mutations above — returns only Load)
+                if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(sub, "ctx", ast.Load()), ast.Load
+                ):
+                    yield node, name
+
+
+class LockDisciplineChecker(Checker):
+    rule_id = "LT001"
+    title = "shared state mutated or snapshot-read outside its lock"
+
+    def check_file(self, ctx: FileCtx) -> Iterator[Finding]:
+        tree = ctx.tree
+        assert tree is not None
+        yield from self._check_module_scope(ctx, tree)
+        classes = {
+            node.name: node
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef)
+        }
+        for node in classes.values():
+            yield from self._check_class_scope(ctx, node, classes)
+
+    # -- module-level locks (io/blockcache.py design) ----------------------
+    def _check_module_scope(self, ctx: FileCtx, tree) -> Iterator[Finding]:
+        lock_names = set()
+        module_names = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_names.add(t.id)
+                        if _is_lock_ctor(stmt.value):
+                            lock_names.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                module_names.add(stmt.target.id)
+        if not lock_names:
+            return
+        scope = _Scope(tree, lock_names, is_module=True)
+
+        # pass 1: evidence — names mutated under the lock are "guarded"
+        guarded = set()
+        mutations = []
+        for node, name, kind in _iter_mutations(scope, tree):
+            if name not in module_names or enclosing_function(node) is None:
+                continue  # module top-level init is construction, not sharing
+            mutations.append((node, name, kind))
+            if in_with_lock(node, scope.is_lock_expr):
+                guarded.add(name)
+        # pass 2: violations
+        for node, name, kind in mutations:
+            if name not in guarded:
+                continue
+            if in_with_lock(node, scope.is_lock_expr) or _locked_exempt(node):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, self.rule_id,
+                f"{kind} to lock-guarded module state '{name}' outside "
+                f"'with {sorted(lock_names)[0]}'",
+            )
+        for node, name in _iter_return_reads(scope, tree, guarded):
+            if enclosing_function(node) is None:
+                continue
+            if in_with_lock(node, scope.is_lock_expr) or _locked_exempt(node):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, self.rule_id,
+                f"return reads lock-guarded module state '{name}' outside "
+                f"'with {sorted(lock_names)[0]}' (torn snapshot)",
+            )
+
+    # -- class-held locks (obs/, runtime/fetch.py design) ------------------
+    def _own_lock_attrs(self, cls: ast.ClassDef) -> set:
+        """Lock attributes ``cls``'s own ``__init__`` assigns."""
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        locks: set = set()
+        if init is None:
+            return locks
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign) and (
+                _is_lock_ctor(node.value)
+                # a lock handed in by the owner (obs/metrics.py shares the
+                # registry lock with its instruments): self._lock = lock
+                or (
+                    isinstance(node.value, ast.Name)
+                    and "lock" in node.value.id.lower()
+                )
+            ):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        locks.add(t.attr)
+        return locks
+
+    def _lock_attrs(self, cls: ast.ClassDef, classes: dict, depth: int = 0) -> set:
+        """Own lock attributes plus same-module base classes' (so
+        subclasses of a lock-holding base — the obs/metrics instrument
+        hierarchy — are analysed under the inherited lock)."""
+        locks = self._own_lock_attrs(cls)
+        if depth < 4:
+            for base in cls.bases:
+                if isinstance(base, ast.Name) and base.id in classes:
+                    locks |= self._lock_attrs(classes[base.id], classes, depth + 1)
+        return locks
+
+    def _check_class_scope(
+        self, ctx: FileCtx, cls: ast.ClassDef, classes: dict
+    ) -> Iterator[Finding]:
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        lock_names = self._lock_attrs(cls, classes)
+        if not lock_names:
+            return
+        scope = _Scope(cls, lock_names, is_module=False)
+
+        def exempt(node: ast.AST) -> bool:
+            fn = enclosing_function(node)
+            return fn is init or _locked_exempt(node)
+
+        guarded = set()
+        mutations = []
+        for node, name, kind in _iter_mutations(scope, cls):
+            if name in lock_names:
+                continue
+            mutations.append((node, name, kind))
+            if in_with_lock(node, scope.is_lock_expr) and not (
+                enclosing_function(node) is init
+            ):
+                guarded.add(name)
+        for node, name, kind in mutations:
+            if name not in guarded or exempt(node):
+                continue
+            if in_with_lock(node, scope.is_lock_expr):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, self.rule_id,
+                f"{cls.name}: {kind} to lock-guarded attribute "
+                f"'self.{name}' outside 'with self.{sorted(lock_names)[0]}'",
+            )
+        for node, name in _iter_return_reads(scope, cls, guarded):
+            if exempt(node) or in_with_lock(node, scope.is_lock_expr):
+                continue
+            yield Finding(
+                ctx.path, node.lineno, self.rule_id,
+                f"{cls.name}: return reads lock-guarded attribute "
+                f"'self.{name}' outside 'with self.{sorted(lock_names)[0]}' "
+                "(torn snapshot)",
+            )

@@ -113,9 +113,12 @@ class Counter(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def _render(self) -> list[str]:
+        # lt: noqa[LT001] — only called from MetricsRegistry.render, which
+        # already holds this same shared (non-reentrant) lock
         return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self._value)}"]
 
 
@@ -146,9 +149,12 @@ class Gauge(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def _render(self) -> list[str]:
+        # lt: noqa[LT001] — only called from MetricsRegistry.render, which
+        # already holds this same shared (non-reentrant) lock
         return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self._value)}"]
 
 
@@ -187,11 +193,13 @@ class Histogram(_Metric):
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def _render(self) -> list[str]:
         lines = []

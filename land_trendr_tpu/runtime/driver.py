@@ -247,6 +247,13 @@ class RunConfig:
                 f"chunk_px={self.chunk_px} must be a multiple of "
                 f"{PALLAS_BLOCK} (the Pallas block) when impl='pallas'"
             )
+        if self.chunk_px is not None and self.chunk_px < 1:
+            # 0 is NOT the disable spelling (None is): a zero chunk would
+            # divide-by-zero deep in the chunked kernel, minutes into a run
+            raise ValueError(
+                f"chunk_px={self.chunk_px} must be >= 1 (or None to "
+                "disable chunking)"
+            )
         if self.fetch_packed not in (True, False, "auto"):
             raise ValueError(
                 f"fetch_packed={self.fetch_packed!r} not one of True, "
@@ -753,7 +760,9 @@ def run_stack(
                 continue
             try:
                 with timer.stage("compute"):
-                    jax.block_until_ready(out)
+                    # the retry ladder's sanctioned compute-wait: the fault
+                    # already broke the pipeline, nothing left to overlap
+                    jax.block_until_ready(out)  # lt: noqa[LT002]
                 dt = time.perf_counter() - t0
                 with timer.stage("fetch"):
                     handle = fetcher.start(out)
@@ -814,7 +823,9 @@ def run_stack(
             try:
                 t0 = time.perf_counter()
                 with timer.stage("compute"):
-                    jax.block_until_ready(out)
+                    # THE sanctioned compute-wait of the pipeline (tile
+                    # i+1 is already dispatched behind it)
+                    jax.block_until_ready(out)  # lt: noqa[LT002]
                 dt = dt_dispatch + (time.perf_counter() - t0)
                 with timer.stage("fetch"):
                     # async: the packed buffer lands while the next tiles

@@ -141,12 +141,20 @@ class StageTimer:
                 self._n[name] = self._n.get(name, 0) + 1
 
     def totals(self) -> dict[str, float]:
-        """Stage → accumulated seconds."""
-        return dict(self._acc)
+        """Stage → accumulated seconds.
+
+        Locked like the accumulators: a ``dict()`` copy racing a stage
+        exit in a writer/feeder thread is a ``dictionary changed size
+        during iteration`` crash, not just a stale read (LT001).
+        """
+        with self._lock:
+            return dict(self._acc)
 
     def counts(self) -> dict[str, int]:
-        return dict(self._n)
+        with self._lock:
+            return dict(self._n)
 
     def summary(self) -> dict[str, float]:
         """Flat ``{stage}_s`` dict, rounded — ready to merge into run logs."""
-        return {f"{k}_s": round(v, 4) for k, v in self._acc.items()}
+        with self._lock:
+            return {f"{k}_s": round(v, 4) for k, v in self._acc.items()}

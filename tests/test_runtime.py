@@ -455,6 +455,17 @@ def test_parallel_writers_match_single(tmp_path, rstack):
         RunConfig(write_workers=0)
 
 
+def test_chunk_px_zero_rejected_at_config_time():
+    """chunk_px=0 is not the disable spelling (None is): a zero chunk
+    would divide-by-zero deep in the chunked kernel mid-run, so the
+    config constructor rejects it (and negatives) up front."""
+    with pytest.raises(ValueError, match="chunk_px"):
+        RunConfig(chunk_px=0)
+    with pytest.raises(ValueError, match="chunk_px"):
+        RunConfig(chunk_px=-4096)
+    assert RunConfig(chunk_px=None).chunk_px is None
+
+
 def test_parallel_feeders_match_single(tmp_path, rstack):
     """feed_workers=3 (prefetch depth 4) produces the same manifest +
     rasters as the default: feeds are per-tile independent reads, only

@@ -38,13 +38,24 @@ from land_trendr_tpu.obs.events import (  # noqa: E402
     validate_events_file,
 )
 
-#: numeric feed_cache fields that can never go negative (counters and
-#: byte gauges alike — a negative value means a broken stats delta)
-_FEED_CACHE_NONNEG = (
-    "hits", "misses", "evictions", "decode_s", "inserted_bytes",
-    "readahead_blocks", "readahead_hits", "readahead_dropped",
-    "cache_bytes", "budget_bytes",
-)
+#: numeric fields that can never go negative, per event type (counters
+#: and byte gauges alike — a negative value means a broken stats delta).
+#: EXPORTED data, not a private tuple: the static emit-site rule
+#: (``land_trendr_tpu/lintkit/eventschema.py`` LT005) imports this table
+#: and cross-checks every name against the schema's
+#: ``EVENT_FIELDS``/``OPTIONAL_FIELDS``, so the runtime value lint and
+#: the static lint can never drift onto two parallel field lists.
+NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
+    "feed_cache": (
+        "hits", "misses", "evictions", "decode_s", "inserted_bytes",
+        "readahead_blocks", "readahead_hits", "readahead_dropped",
+        "cache_bytes", "budget_bytes",
+    ),
+    "fetch": (
+        "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
+        "backlog_max",
+    ),
+}
 
 
 def feed_cache_value_errors(rec, lineno: int) -> list[str]:
@@ -53,7 +64,7 @@ def feed_cache_value_errors(rec, lineno: int) -> list[str]:
     if not isinstance(rec, dict) or rec.get("ev") != "feed_cache":
         return []
     errs = []
-    for name in _FEED_CACHE_NONNEG:
+    for name in NONNEG_FIELDS["feed_cache"]:
         v = rec.get(name)
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
             errs.append(f"line {lineno}: feed_cache: {name} is negative ({v})")
@@ -70,12 +81,6 @@ def feed_cache_value_errors(rec, lineno: int) -> list[str]:
         )
     return errs
 
-
-#: numeric fetch fields that can never go negative
-_FETCH_NONNEG = (
-    "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
-    "backlog_max",
-)
 
 #: slack for the unpack_s ≤ write_s cross-check: both sides are rounded
 #: independently (event fields to 6 dp, stage_s to 4 dp)
@@ -124,7 +129,7 @@ class FetchValueLint:
         if ev != "fetch":
             return []
         errs = []
-        for name in _FETCH_NONNEG:
+        for name in NONNEG_FIELDS["fetch"]:
             v = rec.get(name)
             if _num(v) and v < 0:
                 errs.append(f"line {lineno}: fetch: {name} is negative ({v})")

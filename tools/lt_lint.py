@@ -1,0 +1,184 @@
+"""lt-lint CLI: run the repo's AST invariant checks (CI seam).
+
+Runs the five LT rules (``land_trendr_tpu/lintkit``) over the tree and
+exits 1 on any finding that is neither ``# lt: noqa[rule]``-suppressed
+inline nor recorded (with a reason) in ``LINT_BASELINE.json``.  Exit 0 =
+clean, 2 = usage/configuration error (including a baseline entry with no
+reason — an exception nobody wrote down is not an exception).
+
+    python tools/lt_lint.py                 # whole tree
+    python tools/lt_lint.py --changed       # files touched vs git HEAD
+    python tools/lt_lint.py --json          # machine-readable report
+    python tools/lt_lint.py land_trendr_tpu/io/blockcache.py
+
+``--changed`` is the pre-commit invocation (README §Static analysis):
+per-file rules run only on modified/untracked Python files; the
+repo-level coupling rules (LT004/LT005) run whenever one of their
+source files (driver/cli/README, telemetry/schema) changed.
+
+Wired into tier-1 as ``tests/test_lint.py::test_repo_tree_is_clean``,
+so producer drift fails the suite the same way schema drift in an
+events stream does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from land_trendr_tpu.lintkit import (  # noqa: E402
+    ALL_CHECKERS,
+    Baseline,
+    BaselineError,
+    RepoCtx,
+    default_checkers,
+    run_rules,
+)
+
+BASELINE_FILE = "LINT_BASELINE.json"
+
+
+def changed_files(root: Path) -> "set[str] | None":
+    """Repo-relative Python files modified/added/untracked vs git HEAD,
+    or None when git is unavailable (caller falls back to a full run)."""
+    try:
+        # -uall: list files INSIDE untracked directories individually — the
+        # default collapses a new package to one 'dir/' entry that would
+        # never match per-file scoping (a new-subsystem PR's exact shape)
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "-uall"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    files: set[str] = set()
+    for line in out.stdout.splitlines():
+        # porcelain v1: XY <path> (renames: "XY old -> new")
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path:
+            files.add(path)
+    return files
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the whole repo)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files modified vs git HEAD (pre-commit "
+                         "mode); repo-level rules run when their sources "
+                         "changed")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file (default: <repo>/{BASELINE_FILE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (every finding counts)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_CHECKERS:
+            print(f"{cls.rule_id}  {cls.title}")
+        return 0
+
+    files = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            path = (REPO / p) if not Path(p).is_absolute() else Path(p)
+            try:
+                if path.is_dir():
+                    files.extend(
+                        str(f.relative_to(REPO))
+                        for f in sorted(path.rglob("*.py"))
+                        if "__pycache__" not in f.parts
+                    )
+                elif path.exists():
+                    files.append(str(path.relative_to(REPO)))
+                else:
+                    print(f"error: {p} does not exist", file=sys.stderr)
+                    return 2
+            except ValueError:
+                print(
+                    f"error: {p} is outside the repo ({REPO}) — lt-lint "
+                    "paths are repo-relative", file=sys.stderr,
+                )
+                return 2
+
+    repo = RepoCtx(str(REPO), files=files)
+
+    only: "set[str] | None" = None
+    if args.changed:
+        only = changed_files(REPO)
+        if only is None:
+            print(
+                "warning: git unavailable; --changed falling back to a "
+                "full run", file=sys.stderr,
+            )
+
+    baseline = None
+    if not args.no_baseline:
+        bpath = Path(args.baseline) if args.baseline else REPO / BASELINE_FILE
+        if bpath.exists():
+            try:
+                baseline = Baseline.load(str(bpath))
+            except (BaselineError, json.JSONDecodeError, OSError) as e:
+                print(f"error: {bpath}: {e}", file=sys.stderr)
+                return 2
+
+    try:
+        report = run_rules(repo, default_checkers(), baseline, only_files=only)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.paths or only is not None:
+        # partial runs trivially leave other files' baseline entries
+        # unmatched — staleness is only meaningful over the full tree
+        report["unused_baseline"] = []
+
+    findings = report["findings"]
+    if args.as_json:
+        print(json.dumps(
+            {
+                "clean": not findings,
+                "findings": [f.to_dict() for f in findings],
+                "baselined": [
+                    {**f.to_dict(), "reason": e["reason"]}
+                    for f, e in report["baselined"]
+                ],
+                "noqa_suppressed": report["noqa_suppressed"],
+                "unused_baseline": report["unused_baseline"],
+                "files_checked": len(repo.py_files),
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.render(), file=sys.stderr)
+        for e in report["unused_baseline"]:
+            print(
+                f"warning: stale baseline entry ({e['rule']} {e['file']}): "
+                f"{e['reason']}", file=sys.stderr,
+            )
+        n_base = len(report["baselined"])
+        print(
+            f"lt-lint: {len(findings)} finding(s), {n_base} baselined, "
+            f"{report['noqa_suppressed']} noqa-suppressed over "
+            f"{len(repo.py_files)} files"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
