@@ -208,6 +208,33 @@ def build_parser() -> argparse.ArgumentParser:
                      "interfaces; pass 127.0.0.1 to keep the "
                      "unauthenticated endpoint off the network)")
     seg.add_argument("--max-retries", type=int, default=2)
+    seg.add_argument("--retry-backoff-s", type=float, default=0.5,
+                     metavar="SEC",
+                     help="base of the exponential per-tile retry backoff "
+                     "(attempt n sleeps ~SEC*2^(n-1), ±50%% jitter, capped "
+                     "at 30s); 0 retries immediately")
+    seg.add_argument("--quarantine-tiles", action="store_true",
+                     help="a tile that exhausts --max-retries is recorded "
+                     "as failed in the manifest and the run CONTINUES "
+                     "(tiles are independent); the run summary lists "
+                     "tiles_quarantined, the exit code is 3, assembly is "
+                     "skipped, and a resume re-attempts the tiles")
+    seg.add_argument("--stall-timeout-s", type=float, default=None,
+                     metavar="SEC",
+                     help="abort (exit 4) after SEC without tile progress "
+                     "— a hung device wait is otherwise an infinite hang; "
+                     "set well above the first tile's compile time "
+                     "(default: no watchdog)")
+    seg.add_argument("--merge-timeout-s", type=float, default=None,
+                     metavar="SEC",
+                     help="multihost only: bound on the primary's wait for "
+                     "straggler peers' run_done during the event-log merge "
+                     "(default: derived from this run's wall time)")
+    seg.add_argument("--fault-schedule", default=None, metavar="SPEC",
+                     help="deterministic fault injection for test/soak "
+                     "runs (land_trendr_tpu.runtime.faults), e.g. "
+                     "'seed=7,dispatch@1,fetch.wait@0*2=io'; production "
+                     "runs leave this unset")
     seg.add_argument("--reject-bits", type=lambda s: int(s, 0),
                      default=DEFAULT_QA_REJECT, metavar="MASK",
                      help="QA_PIXEL bitmask of rejected observation classes "
@@ -576,6 +603,8 @@ def main(argv: list[str] | None = None) -> int:
         # deferred: importing jax before arg validation makes --help slow
         from land_trendr_tpu.runtime import (
             RunConfig,
+            StallError,
+            TileRetriesExhausted,
             assemble_outputs,
             load_stack_dir,
             run_stack,
@@ -637,6 +666,11 @@ def main(argv: list[str] | None = None) -> int:
                 feed_readahead=not args.no_feed_readahead,
                 reject_bits=args.reject_bits,
                 chunk_px=args.chunk_px,
+                retry_backoff_s=args.retry_backoff_s,
+                quarantine_tiles=args.quarantine_tiles,
+                stall_timeout_s=args.stall_timeout_s,
+                merge_timeout_s=args.merge_timeout_s,
+                fault_schedule=args.fault_schedule,
                 metrics_interval_s=args.metrics_interval_s,
                 impl=args.impl,
                 change_filt=change_filt,
@@ -706,13 +740,36 @@ def main(argv: list[str] | None = None) -> int:
                 scale=cfg.scale,
                 offset=cfg.offset,
             )
-        if args.trace:
-            from land_trendr_tpu.utils.profiling import trace
+        # exit-code contract (README §Failure semantics — orchestrators
+        # branch on these): 2 config/usage error, 3 tile(s) exhausted
+        # retries / quarantined (retryable: resume re-attempts exactly the
+        # failed tiles), 4 stall-watchdog abort (investigate the device)
+        try:
+            if args.trace:
+                from land_trendr_tpu.utils.profiling import trace
 
-            with trace(args.trace):
+                with trace(args.trace):
+                    summary = run_stack(stack, cfg, mesh=mesh)
+            else:
                 summary = run_stack(stack, cfg, mesh=mesh)
-        else:
-            summary = run_stack(stack, cfg, mesh=mesh)
+        except StallError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 4
+        except TileRetriesExhausted as e:
+            print(f"error: {e} (re-run to resume from the manifest)",
+                  file=sys.stderr)
+            return 3
+        if summary.get("tiles_quarantined"):
+            # incomplete manifest: assembly would fail on the missing
+            # tiles — report what finished, exit retryable
+            print(json.dumps({"summary": summary, "outputs": None}, indent=2))
+            print(
+                f"error: {len(summary['tiles_quarantined'])} tile(s) "
+                "quarantined after exhausting retries; outputs NOT "
+                "assembled (re-run to resume the quarantined tiles)",
+                file=sys.stderr,
+            )
+            return 3
         paths = assemble_outputs(stack, cfg)
         if change_filt is not None and args.change_mmu > 1:
             from land_trendr_tpu.ops.change import sieve_change_rasters

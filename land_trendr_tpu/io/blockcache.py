@@ -59,9 +59,13 @@ __all__ = [
     "cache_clear",
     "decode_threads",
     "decode_pool",
+    "drop_corrupt",
+    "fault_check",
+    "fault_corrupt",
     "file_key",
     "note_decode_seconds",
     "prefetch_window",
+    "set_fault_plan",
     "stats_snapshot",
     "stats_delta",
 ]
@@ -95,8 +99,61 @@ _stats = {
     "readahead_blocks": 0,
     "readahead_hits": 0,
     "readahead_dropped": 0,
+    "corrupt_dropped": 0,
 }
 _inflight_prefetch = 0
+
+# -- fault-injection hook (land_trendr_tpu.runtime.faults) -----------------
+# The io layer must not import runtime/ (driver imports geotiff — a
+# module-level back-import would cycle), so the active FaultPlan is
+# REGISTERED here by faults.activate()/deactivate().  None = inert.
+_fault_plan = None
+
+
+def set_fault_plan(plan) -> None:
+    """Install/clear the active fault plan for the io-layer seams
+    (``feed.decode``, ``cache.corrupt``); called by ``runtime.faults``."""
+    global _fault_plan
+    _fault_plan = plan
+
+
+def fault_check(seam: str) -> None:
+    """Raising io-layer seam; no-op (one attribute read) when inert.
+
+    Readahead tasks are invisible to the seams (like they are to the
+    hit/miss counters): their errors are swallowed by design, so letting
+    them consume per-seam invocation indices would both waste scheduled
+    faults on a path that cannot surface them AND make the demand path's
+    indices race the prefetch pool — breaking the injector's determinism
+    contract."""
+    plan = _fault_plan
+    if plan is not None and not getattr(_tl, "readahead", False):
+        plan.check(seam)
+
+
+def fault_corrupt(seam: str, arr: "np.ndarray") -> "np.ndarray":
+    """Corruption io-layer seam: damaged stand-in on a firing invocation
+    (demand reads only — see :func:`fault_check` on readahead)."""
+    plan = _fault_plan
+    if plan is None or getattr(_tl, "readahead", False):
+        return arr
+    return plan.corrupt(seam, arr)
+
+
+def drop_corrupt(key: tuple) -> None:
+    """Invalidate one cache entry whose consumer found it corrupt (wrong
+    shape/dtype for its slot): the entry is removed and counted, and the
+    caller re-decodes from the file — a poisoned block degrades to one
+    extra decode instead of failing the tile."""
+    with _lock:
+        global _cache_bytes
+        ent = _entries.pop(key, None)
+        if ent is not None:
+            # count actual removals only: a concurrent reader that found
+            # the same poisoned block (or an eviction racing this call)
+            # must not double-count one corruption
+            _cache_bytes -= ent[1]
+            _stats["corrupt_dropped"] += 1
 
 
 def configure(budget_bytes: int = 0, workers: int | None = 0) -> None:

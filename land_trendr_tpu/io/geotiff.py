@@ -602,6 +602,9 @@ def read_geotiff_window(
     (multi-page band stacking as in :func:`read_geotiff`).  Georeferencing
     is the FULL raster's — offset by ``(y0, x0)`` pixels when a window
     transform is needed (``GeoMeta.geotransform``)."""
+    # fault seam "feed.decode" (runtime.faults): the windowed feed path's
+    # decode errors — a transient NFS read, a torn block — surface here
+    blockcache.fault_check("feed.decode")
     with open(path, "rb") as f:
         bo, big, page_tags, _ = _walk_full_pages(f, path)
         width, height, key, total_spp = _pages_geometry(path, page_tags)
@@ -790,8 +793,23 @@ def _decode_ifd(
 
     blocks: list[np.ndarray | None] = [None] * len(sel)
     if use_cache:
+        native_dt = dtype.newbyteorder("=")
         for pos, bidx in enumerate(sel):
-            blocks[pos] = blockcache.cache_get((*fkey, page, bidx))
+            b = blockcache.cache_get((*fkey, page, bidx))
+            if b is not None:
+                # fault seam "cache.corrupt" + the validation that makes a
+                # poisoned entry survivable: a cached block that no longer
+                # matches its slot's shape/dtype (bit rot, a corrupting
+                # bug, an injected fault) is invalidated and re-decoded
+                # from the file instead of failing the tile
+                b = blockcache.fault_corrupt("cache.corrupt", b)
+                if (
+                    b.shape != (rows_of[pos], blk_w, chunk_spp)
+                    or b.dtype != native_dt
+                ):
+                    blockcache.drop_corrupt((*fkey, page, bidx))
+                    b = None
+            blocks[pos] = b
     miss = [pos for pos, b in enumerate(blocks) if b is None]
 
     t_dec = time.perf_counter()

@@ -95,6 +95,22 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     },
     "tile_retry": {"tile_id": int, "attempt": int, "error": str},
     "tile_failed": {"tile_id": int, "attempts": int, "error": str},
+    # a tile exhausted its retry budget under --quarantine-tiles: the run
+    # continues without it (the manifest records it kind="tile_failed";
+    # resume re-attempts it).  Always follows a tile_failed for the tile.
+    "tile_quarantined": {"tile_id": int, "attempts": int, "error": str},
+    # the deterministic fault injector (runtime/faults) fired a scheduled
+    # fault: seam name, per-seam invocation index, error kind.  Emitted
+    # only on injection runs — production streams never carry it.
+    "fault_injected": {"seam": str, "index": int, "error": str},
+    # the stall watchdog saw no tile progress for stall_timeout_s and is
+    # aborting the run (exit code 4 via the CLI); idle_s is the observed
+    # progress gap at the moment the watchdog fired
+    "stall": {"idle_s": _NUM, "timeout_s": _NUM},
+    # graceful degradation: repeated packed-fetch failures demoted the
+    # device→host path to per-product synchronous transfers for the rest
+    # of the run (artifacts are byte-identical either way)
+    "fetch_demoted": {"failures": int},
     # the tile's artifact + manifest line are durable (emitted by
     # TileManifest.record, i.e. from a writer-pool thread)
     "write_done": {"tile_id": int, "bytes": int, "record_s": _NUM},
@@ -146,9 +162,10 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "readahead_dropped": int,
         "cache_bytes": int,
         "budget_bytes": int,
+        "corrupt_dropped": int,
     },
-    "fetch": {"packed": bool, "backlog_max": int},
-    "run_done": {"stage_s": dict},
+    "fetch": {"packed": bool, "backlog_max": int, "demoted": bool},
+    "run_done": {"stage_s": dict, "tiles_quarantined": int},
 }
 
 
@@ -366,6 +383,7 @@ def summarize_events_file(path: str) -> dict:
         "tiles_done": 0,
         "tile_retries": 0,
         "tiles_failed": 0,
+        "tiles_quarantined": 0,
         "pixels": 0,
         "wall_s": None,
         "px_per_s": None,
@@ -389,6 +407,7 @@ def summarize_events_file(path: str) -> dict:
                     tiles_done=0,
                     tile_retries=0,
                     tiles_failed=0,
+                    tiles_quarantined=0,
                     pixels=0,
                     # the torn final line of a crashed PREVIOUS scope must
                     # not flag the healthy resumed scope as corrupt
@@ -401,6 +420,8 @@ def summarize_events_file(path: str) -> dict:
                 agg["tile_retries"] += 1
             elif ev == "tile_failed":
                 agg["tiles_failed"] += 1
+            elif ev == "tile_quarantined":
+                agg["tiles_quarantined"] += 1
             elif ev == "run_done":
                 agg["status"] = rec.get("status")
                 agg["wall_s"] = rec.get("wall_s")

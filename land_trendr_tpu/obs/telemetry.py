@@ -161,6 +161,27 @@ class Telemetry:
         self._fc_bytes = r.gauge(
             "lt_feed_cache_bytes", "decoded-block cache occupancy (bytes)"
         )
+        self._fc_corrupt = r.counter(
+            "lt_feed_corrupt_dropped_total",
+            "corrupt cached blocks invalidated and re-decoded from file",
+        )
+        # robustness subsystem (runtime/faults + the driver hardening)
+        self._faults = r.counter(
+            "lt_faults_injected_total",
+            "scheduled faults fired by the deterministic injector",
+        )
+        self._quarantined = r.counter(
+            "lt_tiles_quarantined_total",
+            "tiles that exhausted retries and were quarantined",
+        )
+        self._stalls = r.counter(
+            "lt_stalls_total", "stall-watchdog aborts (no tile progress)"
+        )
+        self._demoted = r.gauge(
+            "lt_fetch_demoted",
+            "1 once repeated packed-fetch failures demoted the run to the "
+            "per-product sync path",
+        )
         # device→host fetch subsystem (runtime/fetch): run-scoped counters
         # folded in once per run by Telemetry.fetch
         self._fx_tiles = r.counter(
@@ -278,6 +299,40 @@ class Telemetry:
         )
         self._tiles_failed.inc()
 
+    def tile_quarantined(
+        self, tile_id: int, attempts: int, error: BaseException | str
+    ) -> None:
+        """The tile exhausted its retries under quarantine mode: the run
+        goes on without it (resume re-attempts it)."""
+        self.events.emit(
+            "tile_quarantined",
+            tile_id=tile_id,
+            attempts=attempts,
+            error=str(error),
+        )
+        self._quarantined.inc()
+
+    def fault_injected(self, seam: str, index: int, error: str) -> None:
+        """One scheduled fault fired (the runtime.faults observer hook)."""
+        self.events.emit("fault_injected", seam=seam, index=index, error=error)
+        self._faults.inc()
+
+    def stall(self, idle_s: float, timeout_s: float) -> None:
+        """The stall watchdog is aborting: no tile progress for idle_s.
+        Emitted from the watchdog thread, BEFORE the abort unwinds —
+        a hung run's stream must say why it died even if the unwind
+        itself never completes."""
+        self.events.emit(
+            "stall", idle_s=round(idle_s, 3), timeout_s=timeout_s
+        )
+        self._stalls.inc()
+
+    def fetch_demoted(self, failures: int) -> None:
+        """Packed fetch demoted to the per-product sync path for the rest
+        of the run after repeated fetch failures."""
+        self.events.emit("fetch_demoted", failures=failures)
+        self._demoted.set(1)
+
     def write_done(
         self, tile_id: int, nbytes: int, record_s: float, meta: Mapping[str, Any]
     ) -> None:
@@ -314,7 +369,7 @@ class Telemetry:
             for k in (
                 "hits", "misses", "evictions", "decode_s", "inserted_bytes",
                 "readahead_blocks", "readahead_hits", "readahead_dropped",
-                "cache_bytes", "budget_bytes",
+                "cache_bytes", "budget_bytes", "corrupt_dropped",
             )
             if k in stats
         }
@@ -328,6 +383,7 @@ class Telemetry:
         self._fc_decode_s.inc(fields["decode_s"])
         self._fc_ra_blocks.inc(fields.get("readahead_blocks", 0))
         self._fc_ra_hits.inc(fields.get("readahead_hits", 0))
+        self._fc_corrupt.inc(fields.get("corrupt_dropped", 0))
         if "cache_bytes" in fields:
             self._fc_bytes.set(fields["cache_bytes"])
 
@@ -351,6 +407,8 @@ class Telemetry:
             fields["backlog_max"] = int(stats["backlog_max"])
         if "packed" in stats:
             fields["packed"] = bool(stats["packed"])
+        if "demoted" in stats:
+            fields["demoted"] = bool(stats["demoted"])
         self.events.emit("fetch", **fields)
         self._fx_tiles.inc(fields["tiles"])
         self._fx_transfers.inc(fields["transfers"])
@@ -370,6 +428,7 @@ class Telemetry:
         px_per_s: float,
         fit_rate: float,
         stage_s: Mapping[str, float] | None = None,
+        tiles_quarantined: int | None = None,
     ) -> None:
         self.events.emit(
             "run_done",
@@ -380,6 +439,11 @@ class Telemetry:
             px_per_s=px_per_s,
             fit_rate=fit_rate,
             **({"stage_s": dict(stage_s)} if stage_s else {}),
+            **(
+                {"tiles_quarantined": tiles_quarantined}
+                if tiles_quarantined
+                else {}
+            ),
         )
         for name, secs in (stage_s or {}).items():
             # "feed_s" -> stage="feed"; totals only meaningful at run end

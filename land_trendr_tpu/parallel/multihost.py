@@ -219,6 +219,7 @@ def merge_host_event_logs(
         discover_event_files,
         summarize_events_file,
     )
+    from land_trendr_tpu.runtime import faults
 
     def _files() -> list[str]:
         # the shared discovery contract: pod per-process files are the
@@ -244,6 +245,11 @@ def merge_host_event_logs(
         # terminal = the LAST run scope has its run_done: a run_done with
         # a run_start after it in the tail belongs to a finished PREVIOUS
         # scope of a resumed run, and that peer is still mid-stream
+        if faults.fired("merge.peer"):
+            # behavioral fault seam: this probe sees a slow/dead peer —
+            # the file reads as not-terminal, exercising the bounded-wait
+            # timeout and partial-merge path deterministically
+            return False
         if _stale(path):
             return False
         try:

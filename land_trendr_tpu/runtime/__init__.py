@@ -2,6 +2,8 @@
 
 from land_trendr_tpu.runtime.driver import (
     RunConfig,
+    StallError,
+    TileRetriesExhausted,
     TileSpec,
     assemble_outputs,
     plan_tiles,
@@ -17,6 +19,8 @@ from land_trendr_tpu.runtime.stack import (
 
 __all__ = [
     "RunConfig",
+    "StallError",
+    "TileRetriesExhausted",
     "TileSpec",
     "assemble_outputs",
     "plan_tiles",

@@ -34,13 +34,16 @@ Design points (SURVEY.md §5 / §7):
 
 from __future__ import annotations
 
+import _thread
 import dataclasses
 import logging
 import os
+import random
+import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Sequence
+from typing import Callable, Sequence
 
 import jax
 import numpy as np
@@ -52,6 +55,7 @@ from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
 from land_trendr_tpu.ops.tile import PALLAS_BLOCK, process_tile_dn, resolve_impl
 from land_trendr_tpu.runtime import fetch as fetchmod
+from land_trendr_tpu.runtime import faults
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
     TileManifest,
@@ -60,12 +64,124 @@ from land_trendr_tpu.runtime.manifest import (
 from land_trendr_tpu.runtime.stack import RasterStack
 from land_trendr_tpu.utils.profiling import StageTimer
 
-__all__ = ["RunConfig", "TileSpec", "plan_tiles", "run_stack", "assemble_outputs"]
+__all__ = [
+    "RunConfig",
+    "StallError",
+    "TileRetriesExhausted",
+    "TileSpec",
+    "plan_tiles",
+    "run_stack",
+    "assemble_outputs",
+]
 
 log = logging.getLogger("land_trendr_tpu.runtime")
 
 #: one-time warning latch for the native feed-gather fallback
 _warned_gather_fallback = False
+
+#: demote the packed fetch path to per-product sync transfers after this
+#: many fetch-wait failures in one run — a sick link must not keep
+#: spending every subsequent tile's retry budget on transfer faults
+_FETCH_DEMOTE_AFTER = 3
+
+#: retry backoff ceiling: the exponential ladder never sleeps longer
+#: than this between attempts, whatever max_retries is set to
+_BACKOFF_CAP_S = 30.0
+
+
+class TileRetriesExhausted(RuntimeError):
+    """One tile failed ``attempts`` times (dispatch, device wait, fetch,
+    or feed).  Without ``RunConfig.quarantine_tiles`` it aborts the run
+    (CLI exit code 3); with it, the tile is recorded as failed in the
+    manifest and the run continues."""
+
+    def __init__(self, tile_id: int, attempts: int, cause: BaseException) -> None:
+        super().__init__(f"tile {tile_id} failed after {attempts} attempts")
+        self.tile_id = tile_id
+        self.attempts = attempts
+        self.cause = cause
+
+
+class StallError(RuntimeError):
+    """The stall watchdog aborted the run: no tile progress for
+    ``RunConfig.stall_timeout_s`` (CLI exit code 4)."""
+
+
+class _StallWatchdog:
+    """Abort a run whose device wait hangs instead of hanging with it.
+
+    A daemon thread watches a progress timestamp the driver ticks at
+    every pipeline step (feed result, dispatch, compute wait, fetch
+    landing, write collection, retry attempts).  When the gap exceeds
+    ``timeout_s`` it calls ``on_stall`` (telemetry ``stall`` event — the
+    stream must say WHY the run died even if the unwind never finishes),
+    then interrupts the main thread; the driver converts that into
+    :class:`StallError`, so the normal abort path (telemetry ``run_done
+    aborted``, pool shutdown) still runs.  If the main thread is stuck in
+    an uninterruptible native call and the run has not unwound within the
+    grace period, the watchdog hard-exits the process with the documented
+    stall code (4) — the one case where a clean unwind is impossible by
+    definition.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_stall: "Callable[[float], None]",
+        grace_s: "float | None" = None,
+    ) -> None:
+        self._timeout = float(timeout_s)
+        self._grace = float(grace_s) if grace_s is not None else max(
+            30.0, self._timeout
+        )
+        self._on_stall = on_stall
+        self._lock = threading.Lock()
+        self._last = time.monotonic()
+        self._done = threading.Event()
+        self.stalled = False
+        self._thread = threading.Thread(
+            target=self._run, name="lt-stall-watchdog", daemon=True
+        )
+
+    def start(self) -> "_StallWatchdog":
+        self._thread.start()
+        return self
+
+    def tick(self) -> None:
+        """Note pipeline progress (any step counts — first-tile compiles
+        and retry ladders are slow but alive)."""
+        with self._lock:
+            self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._done.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        poll = min(1.0, self._timeout / 4.0)
+        while not self._done.wait(poll):
+            with self._lock:
+                idle = time.monotonic() - self._last
+            if idle < self._timeout:
+                continue
+            with self._lock:
+                self.stalled = True
+            log.critical(
+                "stall watchdog: no tile progress for %.1fs "
+                "(stall_timeout_s=%.1f); aborting the run", idle, self._timeout,
+            )
+            try:
+                self._on_stall(idle)
+            except Exception:
+                log.exception("stall watchdog: stall-event emit failed")
+            _thread.interrupt_main()
+            if not self._done.wait(self._grace):
+                log.critical(
+                    "stall watchdog: run did not unwind within %.0fs grace; "
+                    "hard abort (exit 4)", self._grace,
+                )
+                os._exit(4)
+            return
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +196,41 @@ class RunConfig:
     out_dir: str = "lt_out"
     resume: bool = True
     max_retries: int = 2
+    #: base of the exponential retry backoff: attempt ``n`` sleeps about
+    #: ``retry_backoff_s * 2**(n-1)`` (±50% jitter, capped at 30s) before
+    #: re-dispatching — a sick device gets breathing room instead of an
+    #: immediate hammer.  ``0`` restores the immediate-retry behavior.
+    retry_backoff_s: float = 0.5
+    #: after ``max_retries`` a tile is recorded as FAILED in the manifest
+    #: and the run continues (Kennedy et al. 2010 semantics: tiles are
+    #: independent — one bad tile must not cost the other 10k).  The run
+    #: summary carries ``tiles_quarantined``; the CLI exits 3 and skips
+    #: assembly; a resume re-attempts quarantined tiles.  Off by default:
+    #: a single-tile run aborting loudly is the right default semantics.
+    quarantine_tiles: bool = False
+    #: abort the run after this many seconds without tile progress (feed,
+    #: dispatch, device wait, fetch, write, retries all count) — a hung
+    #: device wait is otherwise an infinite hang.  Emits the ``stall``
+    #: telemetry event and raises :class:`StallError` (CLI exit 4; a main
+    #: thread stuck in an uninterruptible native call is hard-exited with
+    #: the same code after a grace period).  ``None`` disables.  Set it
+    #: well above the first tile's compile time and the retry ladder's
+    #: worst-case backoff (≤30s per attempt).
+    stall_timeout_s: float | None = None
+    #: bound on the multihost primary's wait for straggler peers'
+    #: ``run_done`` during the event-log merge.  ``None`` (default)
+    #: derives it from this run's wall time (``max(60, min(2*wall,
+    #: 900))``); operators who know their pod's straggler profile set it
+    #: explicitly.
+    merge_timeout_s: float | None = None
+    #: deterministic fault-injection schedule
+    #: (:func:`land_trendr_tpu.runtime.faults.parse_schedule`, e.g.
+    #: ``"seed=7,dispatch@1,fetch.wait@0*2=io"``) — fires scheduled
+    #: errors at the named pipeline seams so recovery paths run
+    #: deterministically (tests, ``tools/fault_soak.py``).  ``None``
+    #: (production) keeps every seam inert.  An execution fact — never
+    #: fingerprinted.
+    fault_schedule: str | None = None
     write_fitted: bool = False  # include the (NY,) fitted trajectory raster
     #: segmentation products to checkpoint + assemble; ``None`` = the full
     #: set.  A subset (e.g. ``("n_vertices", "vertex_years",
@@ -298,6 +449,24 @@ class RunConfig:
             raise ValueError(
                 f"metrics_interval_s={self.metrics_interval_s} must be > 0"
             )
+        if self.retry_backoff_s < 0:
+            raise ValueError(
+                f"retry_backoff_s={self.retry_backoff_s} must be >= 0"
+            )
+        if self.stall_timeout_s is not None and self.stall_timeout_s <= 0:
+            raise ValueError(
+                f"stall_timeout_s={self.stall_timeout_s} must be > 0 "
+                "(or None to disable the watchdog)"
+            )
+        if self.merge_timeout_s is not None and self.merge_timeout_s <= 0:
+            raise ValueError(
+                f"merge_timeout_s={self.merge_timeout_s} must be > 0 "
+                "(or None for the wall-time-derived bound)"
+            )
+        if self.fault_schedule is not None:
+            # parse NOW: a typo'd seam/spec is a config error at exit-2
+            # time, not a dead injection discovered after the soak run
+            faults.parse_schedule(self.fault_schedule)
 
     def fingerprint(self, stack: RasterStack) -> str:
         return run_fingerprint(
@@ -632,10 +801,93 @@ def run_stack(
     t_run = time.perf_counter()
     timer = StageTimer()
 
+    # robustness state: the quarantine ledger, the packed-fetch failure
+    # counter behind graceful demotion, and the stall watchdog (created
+    # after telemetry so its stall event has somewhere to go)
+    quarantined: list[int] = []
+    fetch_failures = 0
+    watchdog: "_StallWatchdog | None" = None
+
+    def _backoff(attempt: int) -> None:
+        """Exponential backoff + jitter before re-dispatching a failed
+        tile: immediate retry hammers a sick device with the exact work
+        that just killed it.  Jitter (±50%) keeps a pod's hosts from
+        retrying in lockstep against a shared sick filesystem."""
+        if cfg.retry_backoff_s <= 0:
+            return
+        delay = cfg.retry_backoff_s * 2 ** (attempt - 1) * (0.5 + random.random())
+        # cap AFTER jitter: the 30s ceiling is documented as a hard bound
+        # (operators size stall_timeout_s against it)
+        time.sleep(min(delay, _BACKOFF_CAP_S))
+
+    def _note_fetch_failure() -> None:
+        """Count one fetch-wait failure; demote the packed path once the
+        run has seen ``_FETCH_DEMOTE_AFTER`` CONSECUTIVE ones (the
+        per-product sync path produces byte-identical artifacts, so
+        demotion costs throughput, never correctness).  Consecutive, not
+        cumulative: a compute fault XLA defers to the async wait, or a
+        transient blip recovered hours ago, must not push a 10k-tile run
+        over the threshold — a sick link fails back to back."""
+        nonlocal fetch_failures
+        fetch_failures += 1
+        if fetch_failures >= _FETCH_DEMOTE_AFTER and fetcher.packed:
+            fetcher.demote()
+            log.warning(
+                "packed fetch demoted to per-product sync transfers after "
+                "%d consecutive fetch failures (artifacts unaffected)",
+                fetch_failures,
+            )
+            if telemetry is not None:
+                telemetry.fetch_demoted(fetch_failures)
+
+    def _note_fetch_ok() -> None:
+        """A landed fetch resets the consecutive-failure streak."""
+        nonlocal fetch_failures
+        fetch_failures = 0
+
+    def _retry_step(t: TileSpec, attempt: int, err, what: str = "") -> int:
+        """One failed attempt's shared bookkeeping — the single copy of
+        the retry contract for the ladder, the feed retry, and the
+        writer-path fetch retry: log, exhaustion check (``tile_failed``
+        emit + :class:`TileRetriesExhausted`), ``tile_retry`` emit,
+        watchdog tick, exponential backoff.  Returns the next attempt
+        number."""
+        log.warning(
+            "tile %d %sattempt %d/%d failed: %s",
+            t.tile_id, what, attempt, cfg.max_retries + 1, err,
+        )
+        if attempt > cfg.max_retries:
+            if telemetry is not None:
+                telemetry.tile_failed(t.tile_id, attempt, err)
+            exc = TileRetriesExhausted(t.tile_id, attempt, err)
+            exc.__cause__ = err
+            raise exc
+        if telemetry is not None:
+            telemetry.tile_retry(t.tile_id, attempt, err)
+        if watchdog is not None:
+            watchdog.tick()  # retrying is progress, not a stall
+        _backoff(attempt)
+        return attempt + 1
+
+    def _quarantine(t: TileSpec, exc: TileRetriesExhausted) -> None:
+        """Record an exhausted tile and keep going — or re-raise when
+        quarantine mode is off (the pre-PR abort semantics)."""
+        if not cfg.quarantine_tiles:
+            raise exc
+        quarantined.append(t.tile_id)
+        manifest.record_failed(t.tile_id, exc.attempts, str(exc.cause))
+        if telemetry is not None:
+            telemetry.tile_quarantined(t.tile_id, exc.attempts, str(exc.cause))
+        log.error(
+            "tile %d quarantined after %d attempts (%s); run continues — "
+            "resume will re-attempt it", t.tile_id, exc.attempts, exc.cause,
+        )
+
     def _dispatch(dn, qa):
         """Async-dispatch one tile; returns ``(out, None)`` or ``(None, exc)``."""
         try:
             with timer.stage("dispatch"):
+                faults.check("dispatch")
                 if px_sharding is not None:
                     dn = {
                         k: jax.device_put(v, px_sharding) for k, v in dn.items()
@@ -679,8 +931,31 @@ def run_stack(
             # fit-rate metadata never costs a separate blocking device
             # fetch (review r5 finding: --products without model_valid
             # crashed every tile write; its fix cost one extra transfer
-            # per tile, now folded away)
-            arrays, fit = handle.tile_arrays(t)
+            # per tile, now folded away).
+            # The per-product handle re-fetches from its retained device
+            # outputs, so a transient fetch fault HERE (the demoted /
+            # fallback path, where transfers run in writer threads) gets
+            # the same retry budget as the ladder instead of aborting the
+            # run; persistent failure still fails fast via the writer's
+            # backpressure collection.
+            attempt = 1
+            while True:
+                try:
+                    arrays, fit = handle.tile_arrays(t)
+                    break
+                except Exception as e:
+                    try:
+                        attempt = _retry_step(
+                            t, attempt, e, what="writer-fetch "
+                        )
+                    except TileRetriesExhausted as exc:
+                        # same quarantine contract as the ladder (one bad
+                        # tile never costs the other 10k — also on the
+                        # per-product / post-demotion path): record +
+                        # skip, or re-raise through the writer future →
+                        # _collect_write → run abort → CLI exit 3
+                        _quarantine(t, exc)
+                        return 0, 0
             px = t.h * t.w
             meta = {
                 "y0": t.y0,
@@ -716,6 +991,8 @@ def run_stack(
         """Backpressure + fail-fast: re-raises writer errors at the next tile."""
         nonlocal n_px, n_fit
         px, fit = fut.result()
+        if watchdog is not None:
+            watchdog.tick()
         n_px += px
         n_fit += fit
 
@@ -736,22 +1013,13 @@ def run_stack(
         async fetch): re-dispatches until the tile completes THROUGH a
         landed fetch — the fault already broke the pipeline, so the
         re-fetch is resolved synchronously before pipelining resumes.
-        Returns ``(handle, dt, attempt)`` or raises after ``max_retries``.
+        Attempts are spaced by :func:`_backoff` (exponential + jitter) so
+        a sick device is not re-hammered immediately.  Returns
+        ``(handle, dt, attempt)`` or raises :class:`TileRetriesExhausted`
+        after ``max_retries``.
         """
         while True:
-            log.warning(
-                "tile %d attempt %d/%d failed: %s",
-                t.tile_id, attempt, cfg.max_retries + 1, err,
-            )
-            if attempt > cfg.max_retries:
-                if telemetry is not None:
-                    telemetry.tile_failed(t.tile_id, attempt, err)
-                raise RuntimeError(
-                    f"tile {t.tile_id} failed after {attempt} attempts"
-                ) from err
-            if telemetry is not None:
-                telemetry.tile_retry(t.tile_id, attempt, err)
-            attempt += 1
+            attempt = _retry_step(t, attempt, err)  # raises at exhaustion
             if telemetry is not None:
                 telemetry.tile_start(t.tile_id, attempt=attempt)
             t0 = time.perf_counter()
@@ -760,15 +1028,22 @@ def run_stack(
                 continue
             try:
                 with timer.stage("compute"):
+                    faults.check("compute.wait")
                     # the retry ladder's sanctioned compute-wait: the fault
                     # already broke the pipeline, nothing left to overlap
                     jax.block_until_ready(out)  # lt: noqa[LT002]
                 dt = time.perf_counter() - t0
+            except Exception as e:  # device-side failure surfaces here
+                err = e
+                continue
+            try:
                 with timer.stage("fetch"):
                     handle = fetcher.start(out)
                     handle.wait()
+                _note_fetch_ok()
                 return handle, dt, attempt
-            except Exception as e:  # device-side failure surfaces here
+            except Exception as e:  # transfer failure: counts toward
+                _note_fetch_failure()  # packed-path demotion
                 err = e
 
     def _tile_completed(t: TileSpec, dt: float) -> None:
@@ -779,10 +1054,15 @@ def run_stack(
         the stream as a failure only, never as done-then-failed.  The
         per-product fallback keeps its historical semantics: tile_done at
         compute completion, with the synchronous fetches in the write job
-        behind it (an error there aborts the run via the writer's
-        fail-fast, exactly as before this subsystem existed)."""
+        behind it — so on THAT path a quarantined writer-fetch tile shows
+        tile_done followed by tile_quarantined (done = device result
+        completed; ``write_done`` remains the stream's only durability
+        signal), and a non-quarantine error aborts the run via the
+        writer's fail-fast, exactly as before this subsystem existed."""
         nonlocal n_done
         n_done += 1
+        if watchdog is not None:
+            watchdog.tick()
         if telemetry is not None:
             telemetry.tile_done(
                 t.tile_id,
@@ -808,25 +1088,37 @@ def run_stack(
             try:
                 with timer.stage("fetch"):
                     handle.wait()
+                _note_fetch_ok()
             except Exception as err:
-                handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+                _note_fetch_failure()
+                try:
+                    handle, dt, attempt = _retry_ladder(
+                        t, dn, qa, attempt, err
+                    )
+                except TileRetriesExhausted as e:
+                    _quarantine(t, e)
+                    continue
             _tile_completed(t, dt)
             _submit_write(t, handle, dt)
 
     def _finish(pending) -> None:
         """Await one in-flight tile (retrying on failure), issue its async
-        fetch, and queue writes as the bounded fetch backlog drains."""
-        t, out, err, dn, qa, dt_dispatch = pending
-        attempt = 1
+        fetch, and queue writes as the bounded fetch backlog drains.  The
+        pending tuple's attempt is > 1 when the tile's FEED already spent
+        retries — one budget per tile across phases."""
+        t, out, err, dn, qa, dt_dispatch, attempt = pending
         handle = None
         if err is None:
             try:
                 t0 = time.perf_counter()
                 with timer.stage("compute"):
+                    faults.check("compute.wait")
                     # THE sanctioned compute-wait of the pipeline (tile
                     # i+1 is already dispatched behind it)
                     jax.block_until_ready(out)  # lt: noqa[LT002]
                 dt = dt_dispatch + (time.perf_counter() - t0)
+                if watchdog is not None:
+                    watchdog.tick()
                 with timer.stage("fetch"):
                     # async: the packed buffer lands while the next tiles
                     # compute; the per-product fallback defers its
@@ -835,7 +1127,11 @@ def run_stack(
             except Exception as e:  # device-side failure surfaces here
                 err = e
         if err is not None:
-            handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+            try:
+                handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+            except TileRetriesExhausted as e:
+                _quarantine(t, e)
+                return
         if not fetcher.packed:
             # per-product fallback: the pre-packing flow exactly — the
             # write job runs the synchronous fetches itself, nothing to
@@ -867,6 +1163,7 @@ def run_stack(
 
     def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
         with timer.stage("feed"):
+            faults.check("feed")  # injection seam: transient feed I/O
             fed = _feed_tile(stack, t, feed_px, bands)
         if readahead is not None:
             # fire-and-forget: hint the next PLANNED tile (one past the
@@ -875,6 +1172,29 @@ def run_stack(
             # stacks have no compressed blocks to prefetch
             _prefetch_tile(stack, readahead, bands)
         return fed
+
+    def _refeed(t: TileSpec, err: BaseException):
+        """Synchronous feed retry: a transient stack-read error (NFS blip,
+        decode hiccup) re-enters the same per-tile retry budget as device
+        faults instead of aborting the whole run.  Returns ``(dn, qa,
+        attempt)`` — the attempt number the tile continues from, so its
+        ``tile_start`` and any later dispatch retries share ONE per-tile
+        budget — or ``None`` when the tile was quarantined; an exhausted
+        budget raises :class:`TileRetriesExhausted` (chaining the original
+        feed error) exactly like the device-fault ladder, so the CLI's
+        exit-3 contract covers every per-tile failure class.
+        """
+        attempt = 1
+        while True:
+            try:
+                attempt = _retry_step(t, attempt, err, what="feed ")
+            except TileRetriesExhausted as exc:
+                _quarantine(t, exc)
+                return None
+            try:
+                return (*_feed_job(t), attempt)
+            except Exception as e:
+                err = e
 
     # constructed LAST, immediately before the try/finally that owns its
     # shutdown: an exception anywhere between construction and that
@@ -923,6 +1243,47 @@ def run_stack(
             telemetry.close()
             raise
 
+    # fault injection + stall watchdog are armed AFTER telemetry exists
+    # (their events need somewhere to go) and disarmed in the finally; a
+    # failure arming them must unwind telemetry like run_start's guard
+    fault_plan = None
+    try:
+        if cfg.fault_schedule:
+            fault_plan = faults.activate(
+                faults.parse_schedule(cfg.fault_schedule)
+            )
+            if telemetry is not None:
+                faults.set_observer(telemetry.fault_injected)
+            log.warning(
+                "fault injection ACTIVE (%s) — this is a test/soak run",
+                cfg.fault_schedule,
+            )
+        if cfg.stall_timeout_s is not None:
+            if threading.current_thread() is not threading.main_thread():
+                # the watchdog aborts via interrupt_main: armed from a
+                # worker thread it would interrupt an UNRELATED main
+                # thread and hard-exit the whole host process on stall
+                raise ValueError(
+                    "stall_timeout_s requires run_stack on the process "
+                    "main thread (the watchdog aborts via "
+                    "interrupt_main); run without the watchdog or move "
+                    "the run to the main thread"
+                )
+
+            def _on_stall(idle_s: float) -> None:
+                if telemetry is not None:
+                    telemetry.stall(idle_s, cfg.stall_timeout_s)
+
+            watchdog = _StallWatchdog(cfg.stall_timeout_s, _on_stall).start()
+    except BaseException:
+        if fault_plan is not None:
+            faults.set_observer(None)
+            faults.deactivate()
+        if telemetry is not None:
+            manifest.telemetry = None
+            telemetry.close()
+        raise
+
     # readahead targets ride the feed submissions: the tile fed at index
     # i hints the tile at i + feed_workers + 1 — the first one past the
     # bounded feed queue, so its decode lands in the cache exactly when
@@ -942,12 +1303,29 @@ def run_stack(
         pending = None
         while pending_feeds:
             t, fut = pending_feeds.popleft()
-            dn, qa = fut.result()  # a feed error aborts the run here
+            # top up the queue BEFORE resolving this feed: if it failed,
+            # the synchronous retry below backs off for seconds — the
+            # feed pool should keep decoding tiles i+1.. meanwhile
             if next_i < len(todo):
                 _submit_feed(next_i)
                 next_i += 1
+            attempt0 = 1
+            try:
+                dn, qa = fut.result()
+            except Exception as e:
+                # transient feed I/O enters the retry budget (sync,
+                # with backoff) instead of aborting the whole run
+                fed = _refeed(t, e)
+                if fed is None:
+                    continue  # tile quarantined; the rest of the run goes on
+                dn, qa, attempt0 = fed
+            if watchdog is not None:
+                watchdog.tick()
             if telemetry is not None:
-                telemetry.tile_start(t.tile_id, attempt=1)
+                # attempt0 > 1 after feed retries: the stream's
+                # tile_retry(1..n) → tile_start(n+1) stays coherent, and
+                # dispatch retries continue the SAME per-tile budget
+                telemetry.tile_start(t.tile_id, attempt=attempt0)
             t0 = time.perf_counter()
             out, err = _dispatch(dn, qa)
             dt_dispatch = time.perf_counter() - t0
@@ -957,65 +1335,114 @@ def run_stack(
             if err is not None:
                 # synchronous dispatch failure: resolve (retry or abort) now
                 # rather than dispatching further tiles behind a known fault
-                _finish((t, out, err, dn, qa, dt_dispatch))
+                _finish((t, out, err, dn, qa, dt_dispatch, attempt0))
             else:
-                pending = (t, out, err, dn, qa, dt_dispatch)
+                pending = (t, out, err, dn, qa, dt_dispatch, attempt0)
         if pending is not None:
             _finish(pending)
         _drain_fetches(0)
         _drain_writes(0)
         run_ok = True
+    except KeyboardInterrupt:
+        if watchdog is not None and watchdog.stalled:
+            # the watchdog's interrupt_main landed: convert it to the
+            # documented abort (CLI exit 4) — a real Ctrl-C propagates
+            raise StallError(
+                f"run stalled: no tile progress for over "
+                f"{cfg.stall_timeout_s}s (stall watchdog abort)"
+            ) from None
+        raise
     finally:
-        feeder.shutdown(wait=False, cancel_futures=True)
-        writer.shutdown(wait=True)
-        for fut in pending_writes:
-            if (exc := fut.exception()):
-                # a compute abort is already propagating; surface, don't mask
-                log.error("tile write also failed during abort: %s", exc)
-            else:
-                # writes the shutdown drain completed are real durable
-                # tiles: fold them in so the aborted run_done's pixels /
-                # fit_rate stay consistent with its own tiles_done
-                # (success path drained everything before run_ok)
-                px, fit = fut.result()
-                n_px += px
-                n_fit += fit
-        if telemetry is not None and not run_ok:
-            # abort visibility: the stream must say the run died, not just
-            # stop — consumers treat a missing run_done as "still running".
-            # Best-effort only: the run-failure exception is propagating
-            # through this finally, and a telemetry emit error (e.g. the
-            # SAME full disk that killed the write) must not replace it
-            abort_wall = time.perf_counter() - t_run
-            try:
-                if cfg.feed_cache_mb:
-                    # the post-mortem of a died gigapixel run is exactly
-                    # where the cache/decode counters matter — emit the
-                    # rollup for the aborted scope too (still just before
-                    # its run_done, like the success path)
-                    telemetry.feed_cache(
-                        blockcache.stats_delta(feed_cache_base)
-                    )
-                # fetch rollup likewise: a run that died mid-readback is
-                # the one whose transfer/wait counters the post-mortem
-                # needs
-                telemetry.fetch(fetcher.summary())
-                telemetry.run_done(
-                    "aborted",
-                    tiles_done=n_done,
-                    pixels=n_px,
-                    wall_s=round(abort_wall, 3),
-                    px_per_s=round(n_px / abort_wall, 1) if n_px else 0.0,
-                    fit_rate=(n_fit / n_px) if n_px else 0.0,
-                    stage_s=timer.summary(),
-                )
-            except Exception as exc:
-                log.error("abort-path telemetry run_done failed: %s", exc)
-            finally:
+        try:
+            # NOTE: the watchdog stays armed through this whole unwind — a
+            # writer thread hung in a native transfer would otherwise block
+            # writer.shutdown(wait=True) forever with the hard-exit grace
+            # clock already cancelled, reinstating exactly the infinite hang
+            # the watchdog exists to prevent.  A stall firing mid-unwind
+            # ends, at worst, in the documented os._exit(4).
+            feeder.shutdown(wait=False, cancel_futures=True)
+            writer.shutdown(wait=True)
+            for fut in pending_writes:
+                if (exc := fut.exception()):
+                    # a compute abort is already propagating; surface, don't mask
+                    log.error("tile write also failed during abort: %s", exc)
+                else:
+                    # writes the shutdown drain completed are real durable
+                    # tiles: fold them in so the aborted run_done's pixels /
+                    # fit_rate stay consistent with its own tiles_done
+                    # (success path drained everything before run_ok)
+                    px, fit = fut.result()
+                    n_px += px
+                    n_fit += fit
+            if fault_plan is not None and not run_ok:
+                # abort path: disarm here (after the writer drain, so seam
+                # indices stay deterministic through the last record()).  On
+                # success the plan stays active through the multihost merge —
+                # the merge.peer seam fires there — and is disarmed at the
+                # end of run_stack.
+                faults.set_observer(None)
+                faults.deactivate()
+            if telemetry is not None and not run_ok:
+                # abort visibility: the stream must say the run died, not just
+                # stop — consumers treat a missing run_done as "still running".
+                # Best-effort only: the run-failure exception is propagating
+                # through this finally, and a telemetry emit error (e.g. the
+                # SAME full disk that killed the write) must not replace it
+                abort_wall = time.perf_counter() - t_run
                 try:
-                    telemetry.close()
+                    if cfg.feed_cache_mb:
+                        # the post-mortem of a died gigapixel run is exactly
+                        # where the cache/decode counters matter — emit the
+                        # rollup for the aborted scope too (still just before
+                        # its run_done, like the success path)
+                        telemetry.feed_cache(
+                            blockcache.stats_delta(feed_cache_base)
+                        )
+                    # fetch rollup likewise: a run that died mid-readback is
+                    # the one whose transfer/wait counters the post-mortem
+                    # needs
+                    telemetry.fetch(fetcher.summary())
+                    telemetry.run_done(
+                        "aborted",
+                        tiles_done=n_done,
+                        pixels=n_px,
+                        wall_s=round(abort_wall, 3),
+                        px_per_s=round(n_px / abort_wall, 1) if n_px else 0.0,
+                        fit_rate=(n_fit / n_px) if n_px else 0.0,
+                        stage_s=timer.summary(),
+                        tiles_quarantined=len(quarantined),
+                    )
                 except Exception as exc:
-                    log.error("abort-path telemetry close failed: %s", exc)
+                    log.error("abort-path telemetry run_done failed: %s", exc)
+                finally:
+                    try:
+                        telemetry.close()
+                    except Exception as exc:
+                        log.error("abort-path telemetry close failed: %s", exc)
+            if watchdog is not None:
+                # LAST: disarmed only once the unwind is through — the
+                # success tail below (merge wait included) has its own
+                # bounded timeouts and must not be subject to stall aborts
+                watchdog.stop()
+        except KeyboardInterrupt:
+            if watchdog is not None and watchdog.stalled:
+                # the watchdog fired DURING the unwind (e.g. a writer
+                # thread hung in a native transfer blocking the
+                # shutdown drain above): the remaining cleanup cannot
+                # run, the stall event is already durable — exit with
+                # the documented stall code rather than dying as an
+                # unexplained KeyboardInterrupt (~130) with the fault
+                # plan still armed
+                log.critical(
+                    "stall during abort unwind; hard abort (exit 4)"
+                )
+                if telemetry is not None:
+                    try:
+                        telemetry.close()
+                    except Exception:
+                        pass
+                os._exit(4)
+            raise
 
     wall = time.perf_counter() - t_run
     summary = {
@@ -1028,67 +1455,96 @@ def run_stack(
         "stage_s": timer.summary(),
         "fingerprint": manifest.fingerprint,
         "mesh_devices": n_mesh,
+        # always present (empty on healthy runs): orchestrators branch on
+        # it, and the CLI maps non-empty to exit code 3
+        "tiles_quarantined": sorted(quarantined),
     }
     feed_cache_stats = blockcache.stats_delta(feed_cache_base)
     if cfg.feed_cache_mb:
         summary["feed_cache"] = feed_cache_stats
     summary["fetch"] = fetcher.summary()
-    if telemetry is not None:
-        if cfg.feed_cache_mb:
-            # one terminal rollup per run scope (matching the run-scoped
-            # stage_s), not a per-tile stream: the counters are cheap but
-            # the EVENT volume wouldn't be
-            telemetry.feed_cache(feed_cache_stats)
-        # same one-rollup-per-scope shape for the fetch subsystem
-        telemetry.fetch(summary["fetch"])
-        try:
-            telemetry.run_done(
-                "ok",
-                tiles_done=n_done,
-                pixels=n_px,
-                wall_s=summary["wall_s"],
-                px_per_s=summary["px_per_s"],
-                fit_rate=summary["fit_rate"],
-                stage_s=timer.summary(),
-            )
-        finally:
-            # the terminal-event emit may raise (full disk) and that error
-            # should surface on a succeeded run — but close() must still
-            # run, or the metrics port / exporter thread / event fd leak
-            # into the caller's process
-            summary["telemetry"] = {
-                "events": telemetry.events_file,
-                "metrics": telemetry.metrics_file,
-            }
-            if telemetry.metrics_port is not None:
-                summary["telemetry"]["metrics_port"] = telemetry.metrics_port
-            telemetry.close()  # final exposition flush before anyone reads it
-        if jax.process_count() > 1 and jax.process_index() == 0:
-            # primary-host fold: per-process event files live in the SHARED
-            # workdir (the manifest's filesystem is the pod's job state), so
-            # the merge is a bounded wait for every peer's run_done line —
-            # no collective, usable even when a peer aborted
-            from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+    # the success tail can itself raise (a full-disk run_done emit, a
+    # merge I/O error) — the plan must still disarm, or it leaks into
+    # the process's NEXT run and fires faults nobody scheduled
+    try:
+        if telemetry is not None:
+            if cfg.feed_cache_mb:
+                # one terminal rollup per run scope (matching the run-scoped
+                # stage_s), not a per-tile stream: the counters are cheap but
+                # the EVENT volume wouldn't be
+                telemetry.feed_cache(feed_cache_stats)
+            # same one-rollup-per-scope shape for the fetch subsystem
+            telemetry.fetch(summary["fetch"])
+            try:
+                telemetry.run_done(
+                    "ok",
+                    tiles_done=n_done,
+                    pixels=n_px,
+                    wall_s=summary["wall_s"],
+                    px_per_s=summary["px_per_s"],
+                    fit_rate=summary["fit_rate"],
+                    stage_s=timer.summary(),
+                    tiles_quarantined=len(quarantined),
+                )
+            finally:
+                # the terminal-event emit may raise (full disk) and that error
+                # should surface on a succeeded run — but close() must still
+                # run, or the metrics port / exporter thread / event fd leak
+                # into the caller's process
+                summary["telemetry"] = {
+                    "events": telemetry.events_file,
+                    "metrics": telemetry.metrics_file,
+                }
+                if telemetry.metrics_port is not None:
+                    summary["telemetry"]["metrics_port"] = telemetry.metrics_port
+                telemetry.close()  # final exposition flush before anyone reads it
+                # the closed event log can take no more fault_injected emits;
+                # merge.peer fires past this point are still counted/logged
+                # by the plan itself
+                faults.set_observer(None)
+            if jax.process_count() > 1 and jax.process_index() == 0:
+                # primary-host fold: per-process event files live in the SHARED
+                # workdir (the manifest's filesystem is the pod's job state), so
+                # the merge is a bounded wait for every peer's run_done line —
+                # no collective, usable even when a peer aborted
+                from land_trendr_tpu.parallel.multihost import merge_host_event_logs
 
-            # wait bound scaled to THIS run: all hosts started together on
-            # similar tile shares, so a straggler peer gets up to the
-            # primary's own wall again — but capped, because a peer that
-            # died WITHOUT its run_done line (OOM kill) must not make the
-            # primary of a 10-hour run poll for another 10 hours; then
-            # the partial fold (with its log warning) is the right answer
-            merge_timeout_s = max(60.0, min(2.0 * wall, 900.0))
-            summary["telemetry"]["hosts"] = merge_host_event_logs(
-                cfg.workdir,
-                expect_hosts=jax.process_count(),
-                timeout_s=merge_timeout_s,
-                # coarsen the straggler poll with the wait bound: a 900s
-                # wait does not need 10Hz probes of a shared filesystem
-                poll_s=max(0.1, min(2.0, merge_timeout_s / 600.0)),
-                # guard a reused workdir: a peer file untouched since this
-                # run began (60s clock-skew slack) holds only a PREVIOUS
-                # scope — its old run_done must not pass for a live host
-                newer_than=time.time() - wall - 60.0,
-            )
+                # wait bound scaled to THIS run: all hosts started together on
+                # similar tile shares, so a straggler peer gets up to the
+                # primary's own wall again — but capped, because a peer that
+                # died WITHOUT its run_done line (OOM kill) must not make the
+                # primary of a 10-hour run poll for another 10 hours; then
+                # the partial fold (with its log warning) is the right answer.
+                # cfg.merge_timeout_s overrides for pods whose straggler
+                # profile the operator knows better than this heuristic.
+                merge_timeout_s = (
+                    cfg.merge_timeout_s
+                    if cfg.merge_timeout_s is not None
+                    else max(60.0, min(2.0 * wall, 900.0))
+                )
+                summary["telemetry"]["hosts"] = merge_host_event_logs(
+                    cfg.workdir,
+                    expect_hosts=jax.process_count(),
+                    timeout_s=merge_timeout_s,
+                    # coarsen the straggler poll with the wait bound: a 900s
+                    # wait does not need 10Hz probes of a shared filesystem
+                    poll_s=max(0.1, min(2.0, merge_timeout_s / 600.0)),
+                    # guard a reused workdir: a peer file untouched since this
+                    # run began (60s clock-skew slack) holds only a PREVIOUS
+                    # scope — its old run_done must not pass for a live host
+                    newer_than=time.time() - wall - 60.0,
+                )
+    finally:
+        if fault_plan is not None:
+            # disarmed only now, AFTER the multihost merge — the
+            # merge.peer seam fires inside merge_host_event_logs; the
+            # injection log is collected last for the same reason
+            summary["faults_injected"] = [
+                {"seam": s, "index": i, "error": k}
+                for s, i, k in fault_plan.injected()
+            ]
+            faults.set_observer(None)
+            faults.deactivate()
     log.info("run complete: %s", summary)
     return summary
 

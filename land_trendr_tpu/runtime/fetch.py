@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from land_trendr_tpu.ops import indices as idx
+from land_trendr_tpu.runtime import faults
 
 if TYPE_CHECKING:  # pragma: no cover - type-only imports (cycle with driver)
     from land_trendr_tpu.ops.tile import TileOutputs
@@ -295,9 +296,10 @@ def _jit_f16(a):
 
 
 def _to_host(arr) -> np.ndarray:
-    """The one device→host materialization point (monkeypatch seam for
-    fault-injection tests: a device error in an in-flight async fetch
-    surfaces here, in the driver's drain, where the retry ladder runs)."""
+    """The one device→host materialization point (fault seam
+    ``fetch.wait``: a device error in an in-flight async fetch surfaces
+    here, in the driver's drain, where the retry ladder runs)."""
+    faults.check("fetch.wait")
     return np.asarray(arr)
 
 
@@ -434,8 +436,20 @@ class TileFetcher:
     def __init__(self, cfg: "RunConfig", packed: bool) -> None:
         self.cfg = cfg
         self.packed = packed
+        self.demoted = False
         self.plan: FetchPlan | None = None
         self.stats = _Stats()
+
+    def demote(self) -> None:
+        """Graceful degradation: drop to the per-product synchronous path
+        for the REST of the run (the driver calls this after repeated
+        packed-fetch failures — a sick link should not keep eating the
+        retry budget of every subsequent tile).  Artifacts are
+        byte-identical either way (the FetchPlan contract), so demotion
+        is safe mid-run; in-flight packed handles still drain normally.
+        """
+        self.packed = False
+        self.demoted = True
 
     def start(self, out: "TileOutputs") -> "PackedHandle | UnpackedHandle":
         """Issue one tile's fetch; packed handles begin landing NOW."""
@@ -462,6 +476,7 @@ class TileFetcher:
         with s._lock:
             return {
                 "packed": self.packed,
+                "demoted": self.demoted,
                 "tiles": s.tiles,
                 "transfers": s.transfers,
                 "bytes": s.bytes,

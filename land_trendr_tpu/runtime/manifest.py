@@ -27,6 +27,7 @@ from typing import Iterator
 import numpy as np
 
 from land_trendr_tpu.io import native
+from land_trendr_tpu.runtime import faults
 
 __all__ = ["TileManifest", "run_fingerprint"]
 
@@ -180,9 +181,26 @@ class TileManifest:
                 if rec.get("kind") != "tile":
                     continue
                 tid = int(rec["tile_id"])
-                if os.path.exists(self.tile_path(tid)):
+                if self._artifact_readable(tid):
                     done.add(tid)
         return done
+
+    def _artifact_readable(self, tile_id: int) -> bool:
+        """True when the tile's ``.npz`` exists and its zip directory
+        parses with at least one member.
+
+        The crash-safety leg of resume: ``record`` is atomic (tmp +
+        rename), but an OS crash can still leave a renamed artifact with
+        torn data blocks — and a truncated zip loses its END-of-file
+        central directory, exactly what this opens.  An unreadable
+        artifact counts as not-done (the tile recomputes) instead of
+        crashing the resumed run at assembly, hours later.
+        """
+        try:
+            with np.load(self.tile_path(tile_id)) as z:
+                return len(z.files) > 0
+        except Exception:
+            return False
 
     def _write_header(self, exclusive: bool = False) -> None:
         hdr = {"kind": "header", "fingerprint": self.fingerprint}
@@ -209,6 +227,10 @@ class TileManifest:
             raise ValueError(
                 f"compress={compress!r} not one of {ARTIFACT_COMPRESS}"
             )
+        # fault seam "manifest.record": the persist path's ENOSPC / I/O
+        # errors surface here, BEFORE the artifact — the atomic-write
+        # contract means a failed record leaves no partial final artifact
+        faults.check("manifest.record")
         t0 = time.perf_counter()
         # note: np.savez appends ".npz" unless the name already ends with it;
         # the pid keeps concurrent pod processes' tmp files distinct
@@ -217,12 +239,43 @@ class TileManifest:
         os.replace(tmp, self.tile_path(tile_id))
         with open(self.path, "a") as f:
             f.write(json.dumps({"kind": "tile", "tile_id": tile_id, **meta}) + "\n")
+        if faults.fired("manifest.torn"):
+            # behavioral seam: simulate an OS crash after the manifest
+            # line landed but before the artifact's data blocks were
+            # durable — the one torn state tmp+rename cannot prevent.
+            # open(resume=True)'s readability check must then treat the
+            # recorded tile as not-done.
+            with open(self.tile_path(tile_id), "r+b") as tf:
+                tf.truncate(max(1, os.path.getsize(self.tile_path(tile_id)) // 2))
+            raise OSError(
+                f"injected torn artifact write for tile {tile_id}"
+            )
         if self.telemetry is not None:
             self.telemetry.write_done(
                 tile_id,
                 os.path.getsize(self.tile_path(tile_id)),
                 time.perf_counter() - t0,
                 meta,
+            )
+
+    def record_failed(self, tile_id: int, attempts: int, error: str) -> None:
+        """Append a quarantine record for a tile that exhausted its retry
+        budget (``--quarantine-tiles``): the run continues without it, and
+        the record is post-mortem evidence — :meth:`open` only counts
+        ``kind == "tile"`` records as done, so a resumed run re-attempts
+        every quarantined tile automatically."""
+        with open(self.path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "tile_failed",
+                        "tile_id": tile_id,
+                        "attempts": attempts,
+                        "error": str(error)[:500],
+                        "t_wall": time.time(),
+                    }
+                )
+                + "\n"
             )
 
     def load_tile(self, tile_id: int) -> dict[str, np.ndarray]:

@@ -1,0 +1,50 @@
+"""Worker process for the crash-resume test (SIGKILL mid-run).
+
+Run as: ``python _crash_worker.py <workdir>``.  Builds the SAME
+deterministic synthetic stack as ``tests/test_faults.py``'s parent and
+runs the real production driver over it, with a ``slow`` fault schedule
+that paces every dispatch from tile 2 on — giving the parent a wide,
+reliable window to SIGKILL the process after the first artifacts have
+landed but before the run completes.  The parent then resumes in-process
+and asserts the merged artifacts are byte-identical to an uninterrupted
+run (the manifest-is-the-checkpoint contract under a hard crash).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# Must beat the sitecustomize's jax_platforms="axon,cpu" config selection
+# *before* any device/backend touch, or a down TPU tunnel hangs the worker.
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    workdir = sys.argv[1]
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import RunConfig, run_stack, stack_from_synthetic
+
+    spec = SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    rs = stack_from_synthetic(make_stack(spec))
+    cfg = RunConfig(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=20,
+        workdir=workdir,
+        out_dir=workdir + "_o",
+        retry_backoff_s=0.0,
+        # every dispatch from tile 2 on sleeps 0.6s then proceeds: the
+        # kill window after the first artifact is >= 2s wide
+        fault_schedule="seed=1,dispatch@2*999=slow:0.6",
+    )
+    run_stack(rs, cfg)
+    print("DONE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -487,18 +487,22 @@ def test_parallel_feeders_match_single(tmp_path, rstack):
 
 
 def test_feed_failure_aborts_run(tmp_path, rstack, monkeypatch):
-    """A feed error inside the worker pool propagates out of run_stack
-    (not swallowed by the executor) and the writer pool shuts down."""
+    """A persistent feed error propagates out of run_stack (not swallowed
+    by the executor) and the writer pool shuts down.  Since PR 5 it first
+    re-enters the per-tile retry budget and surfaces as the same
+    TileRetriesExhausted the device-fault ladder raises, with the
+    original feed error chained as the cause."""
     import land_trendr_tpu.runtime.driver as drv
 
-    cfg = make_cfg(tmp_path, feed_workers=2)
+    cfg = make_cfg(tmp_path, feed_workers=2, retry_backoff_s=0.0)
 
     def bad_feed(stack, t, tile_px, bands):
         raise OSError("stack read failed (injected)")
 
     monkeypatch.setattr(drv, "_feed_tile", bad_feed)
-    with pytest.raises(OSError, match="stack read failed"):
+    with pytest.raises(drv.TileRetriesExhausted, match="failed after") as ei:
         run_stack(rstack, cfg)
+    assert "stack read failed" in str(ei.value.__cause__)
 
 
 def test_writer_failure_fails_fast_parallel(tmp_path, rstack, monkeypatch):

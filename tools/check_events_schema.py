@@ -49,12 +49,18 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     "feed_cache": (
         "hits", "misses", "evictions", "decode_s", "inserted_bytes",
         "readahead_blocks", "readahead_hits", "readahead_dropped",
-        "cache_bytes", "budget_bytes",
+        "cache_bytes", "budget_bytes", "corrupt_dropped",
     ),
     "fetch": (
         "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
         "backlog_max",
     ),
+    # robustness events (PR 5): counters/indices/durations only go up
+    "fault_injected": ("index",),
+    "tile_quarantined": ("tile_id", "attempts"),
+    "stall": ("idle_s", "timeout_s"),
+    "fetch_demoted": ("failures",),
+    "run_done": ("tiles_quarantined",),
 }
 
 
@@ -144,12 +150,33 @@ class FetchValueLint:
         return errs
 
 
+def generic_nonneg_errors(rec, lineno: int) -> list[str]:
+    """Non-negativity for the event types without a dedicated lint class
+    (the robustness events + run_done's quarantine count) — one loop over
+    the same exported table the dedicated lints share."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    if ev not in NONNEG_FIELDS or ev in ("feed_cache", "fetch"):
+        return []
+    errs = []
+    for name in NONNEG_FIELDS[ev]:
+        v = rec.get(name)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+            errs.append(f"line {lineno}: {ev}: {name} is negative ({v})")
+    return errs
+
+
 def value_lints():
     """Fresh per-file ``extra`` hook chaining every value-level lint."""
     fetch_lint = FetchValueLint()
 
     def extra(rec, lineno: int) -> list[str]:
-        return feed_cache_value_errors(rec, lineno) + fetch_lint(rec, lineno)
+        return (
+            feed_cache_value_errors(rec, lineno)
+            + fetch_lint(rec, lineno)
+            + generic_nonneg_errors(rec, lineno)
+        )
 
     return extra
 

@@ -1,0 +1,290 @@
+"""Fault-injection soak: every seam fired, artifacts byte-identical.
+
+The robustness acceptance gate (ISSUE 5): for every injection seam of
+:mod:`land_trendr_tpu.runtime.faults`, run a seeded schedule that fires
+exactly there and assert the run recovers with **byte-identical tile
+artifacts** to a clean run — either in-run (retry ladder, feed retry,
+cache bypass, fetch demotion) or across an abort + resume (manifest
+persist faults, quarantine).  Determinism is the whole point: the same
+schedule replays the same faults at the same invocations, so a recovery
+regression fails this gate instead of waiting for real hardware to fail.
+
+Two scene tracks:
+
+* **eager** (in-RAM synthetic stack): the driver seams — ``feed``,
+  ``dispatch``, ``compute.wait``, ``fetch.wait`` (packed path forced, one
+  schedule also driving the demotion threshold), ``manifest.record``
+  (ENOSPC → abort → resume), ``manifest.torn`` (post-record truncation →
+  resume readability check), and a quarantine schedule (persistent tile
+  fault → run continues → resume completes it);
+* **lazy** (windowed C2 per-band stack): the decode seams —
+  ``feed.decode`` (transient window-read fault → feed retry) and
+  ``cache.corrupt`` (poisoned cached block → invalidate + re-decode).
+
+``--smoke`` is the seconds-scale tier-1 mode (``tests/test_faults.py``
+runs it in-process); the full mode adds probabilistic multi-seed rounds
+and writes a ``FAULTSOAK_*.json`` artifact.
+
+    python tools/fault_soak.py --smoke
+    python tools/fault_soak.py --seeds 5 --out FAULTSOAK_r09.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+def _digest_workdir(workdir: str) -> dict:
+    """tile_id → {array name → sha256 of raw bytes} for every artifact.
+
+    Array-content identity, not file identity: the ``.npz`` container
+    embeds zip metadata (mtimes) that legitimately differs run to run,
+    while the contract is about the DATA a resume/assembly consumes.
+    """
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(np.ascontiguousarray(z[name]).tobytes())
+                .hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _run(stack, cfg):
+    from land_trendr_tpu.runtime import run_stack
+
+    return run_stack(stack, cfg)
+
+
+@dataclasses.dataclass
+class Case:
+    name: str
+    schedule: str
+    cfg_kw: dict
+    #: "inrun" = must complete without raising; "resume" = first run may
+    #: abort, a clean resume must complete; "quarantine" = first run
+    #: completes WITH quarantined tiles, the resume finishes them
+    mode: str = "inrun"
+
+
+def _eager_cases(retries: int) -> list[Case]:
+    packed = {"fetch_packed": True}
+    return [
+        Case("feed_transient", "seed=1,feed@1=io", {}),
+        Case("dispatch_fault", "seed=1,dispatch@1", {}),
+        Case("compute_wait_fault", "seed=1,compute.wait@1", {}),
+        Case("fetch_wait_fault", "seed=1,fetch.wait@1=io", dict(packed)),
+        Case(
+            "fetch_demotion",
+            "seed=1,fetch.wait@0*3=io",
+            {**packed, "max_retries": 4},
+        ),
+        Case("manifest_enospc", "seed=1,manifest.record@1=enospc", {}, "resume"),
+        Case("manifest_torn", "seed=1,manifest.torn@1", {}, "resume"),
+        Case(
+            "quarantine",
+            f"seed=1,dispatch@1*{retries + 1}",
+            {"quarantine_tiles": True},
+            "quarantine",
+        ),
+    ]
+
+
+_LAZY_CASES = [
+    Case("decode_transient", "seed=1,feed.decode@2=value", {}),
+    Case("cache_corrupt", "seed=1,cache.corrupt@1", {}),
+]
+
+
+def _make_eager(size_y: int, size_x: int):
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import stack_from_synthetic
+
+    spec = SceneSpec(
+        width=size_x, height=size_y, year_start=1990, year_end=2013, seed=11
+    )
+    return stack_from_synthetic(make_stack(spec))
+
+
+def _make_lazy(root: str, size: int):
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack_c2
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    spec = SceneSpec(
+        width=size, height=size, year_start=2000, year_end=2006, seed=7
+    )
+    write_stack_c2(root, make_stack(spec))
+    return open_stack_dir_c2_lazy(root, bands=("nir", "swir2"))
+
+
+def soak(
+    smoke: bool = True,
+    seeds: int = 3,
+    keep: "str | None" = None,
+    verbose: bool = True,
+) -> dict:
+    """Run the soak matrix; returns the result report (raises on the
+    first broken recovery so failures carry a full traceback)."""
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.runtime import RunConfig
+
+    retries = 2
+    base_kw = dict(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=20,
+        max_retries=retries,
+        retry_backoff_s=0.0,  # the soak pins recovery, not pacing
+    )
+    root = Path(keep or tempfile.mkdtemp(prefix="lt_fault_soak_"))
+    root.mkdir(parents=True, exist_ok=True)
+    report: dict = {"smoke": smoke, "cases": []}
+
+    def run_track(track: str, stack, cases: list[Case], tile_size: int) -> None:
+        kw = {**base_kw, "tile_size": tile_size}
+        clean_wd = str(root / f"{track}_clean")
+        _run(stack, RunConfig(workdir=clean_wd, out_dir=clean_wd + "_o", **kw))
+        clean = _digest_workdir(clean_wd)
+        for case in cases:
+            wd = str(root / f"{track}_{case.name}")
+            cfg = RunConfig(
+                workdir=wd,
+                out_dir=wd + "_o",
+                fault_schedule=case.schedule,
+                **{**kw, **case.cfg_kw},
+            )
+            rec = {"track": track, "case": case.name, "schedule": case.schedule}
+            aborted = False
+            try:
+                summary = _run(stack, cfg)
+            except Exception as e:
+                if case.mode != "resume":
+                    raise
+                aborted = True
+                rec["abort_error"] = f"{type(e).__name__}: {e}"
+                # the recovery leg these seams pin: a plain resume
+                summary = _run(
+                    stack,
+                    RunConfig(workdir=wd, out_dir=wd + "_o", **{**kw, **case.cfg_kw}),
+                )
+            if case.mode == "quarantine":
+                if not summary["tiles_quarantined"]:
+                    raise AssertionError(
+                        f"{case.name}: expected quarantined tiles, got none"
+                    )
+                rec["quarantined"] = summary["tiles_quarantined"]
+                summary = _run(
+                    stack, RunConfig(workdir=wd, out_dir=wd + "_o", **kw)
+                )
+                if summary["tiles_quarantined"]:
+                    raise AssertionError(
+                        f"{case.name}: resume left tiles quarantined"
+                    )
+            if case.mode == "resume" and not aborted:
+                raise AssertionError(
+                    f"{case.name}: schedule {case.schedule!r} did not abort "
+                    "the first run — the seam no longer fires there"
+                )
+            got = _digest_workdir(wd)
+            if got != clean:
+                raise AssertionError(
+                    f"{case.name}: artifacts differ from the clean run "
+                    f"(schedule {case.schedule!r})"
+                )
+            rec["artifacts_identical"] = True
+            report["cases"].append(rec)
+            if verbose:
+                print(f"  ok: {track}/{case.name} ({case.schedule})")
+
+    eager = _make_eager(40, 48)
+    run_track("eager", eager, _eager_cases(retries), tile_size=20)
+    lazy = _make_lazy(str(root / "c2"), 96)
+    # lazy windows revisit strips across tiles: give the decode seams a
+    # real cache to poison
+    lazy_cases = [
+        dataclasses.replace(c, cfg_kw={**c.cfg_kw, "feed_cache_mb": 64})
+        for c in _LAZY_CASES
+    ]
+    run_track("lazy", lazy, lazy_cases, tile_size=48)
+
+    if not smoke:
+        # probabilistic rounds: every seed a different deterministic storm
+        # across the raising driver seams, still byte-identical
+        for seed in range(seeds):
+            wd = str(root / f"storm_{seed}")
+            sched = (
+                f"seed={seed},dispatch%0.1,compute.wait%0.1,"
+                "fetch.wait%0.1=io,feed%0.05=io"
+            )
+            cfg = RunConfig(
+                workdir=wd,
+                out_dir=wd + "_o",
+                fault_schedule=sched,
+                fetch_packed=True,
+                max_retries=6,
+                **{k: v for k, v in base_kw.items() if k != "max_retries"},
+            )
+            summary = _run(eager, cfg)
+            got = _digest_workdir(wd)
+            clean = _digest_workdir(str(root / "eager_clean"))
+            if got != clean:
+                raise AssertionError(f"storm seed={seed}: artifacts differ")
+            report["cases"].append(
+                {
+                    "track": "storm",
+                    "case": f"seed={seed}",
+                    "schedule": sched,
+                    "faults_fired": len(summary.get("faults_injected", [])),
+                    "artifacts_identical": True,
+                }
+            )
+            if verbose:
+                print(f"  ok: storm/seed={seed}")
+
+    report["ok"] = True
+    if keep is None:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale tier-1 mode (deterministic cases "
+                    "only, no artifact file)")
+    ap.add_argument("--seeds", type=int, default=3,
+                    help="probabilistic storm rounds in full mode")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep workdirs under DIR for post-mortem")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON report here (full mode artifact)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    report = soak(smoke=args.smoke, seeds=args.seeds, keep=args.keep)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(json.dumps({"ok": report["ok"], "cases": len(report["cases"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

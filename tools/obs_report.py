@@ -80,7 +80,8 @@ def _fresh_scope() -> dict:
     return {
         "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
-        "retries": 0, "failures": 0, "stage_s": {}, "feed_cache": None,
+        "retries": 0, "failures": 0, "quarantined": 0, "faults_injected": 0,
+        "stalls": 0, "stage_s": {}, "feed_cache": None,
         "fetch": None,
     }
 
@@ -295,6 +296,31 @@ def fold(
                             "name": f"FAILED tile {tile_id}", "t0": tw,
                             "args": {"error": rec.get("error")},
                         })
+                    elif ev == "tile_quarantined":
+                        tile_id = rec["tile_id"]
+                        cur["quarantined"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "device-wait",
+                            "name": f"QUARANTINED tile {tile_id}", "t0": tw,
+                            "args": {"error": rec.get("error")},
+                        })
+                    elif ev == "fault_injected":
+                        cur["faults_injected"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "device-wait",
+                            "name": f"fault {rec['seam']}#{rec['index']}",
+                            "t0": tw, "args": {"error": rec.get("error")},
+                        })
+                    elif ev == "stall":
+                        cur["stalls"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "device-wait",
+                            "name": "STALL", "t0": tw,
+                            "args": {
+                                "idle_s": rec.get("idle_s"),
+                                "timeout_s": rec.get("timeout_s"),
+                            },
+                        })
                     elif ev == "feed_cache":
                         # the per-run rollup from the feed-decode subsystem
                         # (io/blockcache): required counters must resolve
@@ -362,6 +388,9 @@ def fold(
         "tile_record_s": _stats([v for c in folded for v in c["record_s"]]),
         "retries": sum(c["retries"] for c in folded),
         "failures": sum(c["failures"] for c in folded),
+        "quarantined": sum(c["quarantined"] for c in folded),
+        "faults_injected": sum(c["faults_injected"] for c in folded),
+        "stalls": sum(c["stalls"] for c in folded),
         "max_feed_backlog": max((c["max_feed_backlog"] for c in folded), default=0),
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},

@@ -1,0 +1,27 @@
+#!/bin/sh
+# Pre-commit gate (README §Failure semantics / §Static analysis):
+#
+#   1. tools/lt_lint.py --changed  — the five LT AST invariant rules over
+#      files modified vs HEAD (repo-level coupling rules LT004/LT005 run
+#      whenever one of their sources changed);
+#   2. tools/check_events_schema.py — schema + value lint over any event
+#      streams passed as arguments (workdirs or events*.jsonl files);
+#      with no arguments this leg is skipped (there is no canonical
+#      committed event stream — the lint's tier-1 home is the test
+#      suite's generated streams).
+#
+# Install:  ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+# Exit codes follow the tools: 0 clean, 1 findings, 2 config error.
+
+set -e
+# git resolves the repo root regardless of how the hook is invoked —
+# $0 is .git/hooks/pre-commit when installed as a symlink, so deriving
+# the root from $0 would point inside .git/
+repo="$(git rev-parse --show-toplevel 2>/dev/null)"
+[ -n "$repo" ] || repo="$(cd "$(dirname "$0")/.." && pwd)"
+
+python "$repo/tools/lt_lint.py" --changed
+
+if [ "$#" -gt 0 ]; then
+    python "$repo/tools/check_events_schema.py" "$@"
+fi
