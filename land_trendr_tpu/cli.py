@@ -114,6 +114,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "i+fetch_depth compute (raise on high-latency "
                           "links; memory grows one packed tile + one fed "
                           "input per step)")
+    seg.add_argument("--no-packed-upload", action="store_true",
+                     help="force the per-array synchronous host->device "
+                          "dispatch (default 'auto' packs every tile's "
+                          "fed band/QA arrays into ONE async device_put "
+                          "on accelerator backends; artifacts are "
+                          "byte-identical either way)")
+    seg.add_argument("--packed-upload", action="store_true",
+                     help="force the packed upload path even on CPU "
+                          "backends (where device_put is near zero-copy "
+                          "and auto keeps the per-array path); "
+                          "incompatible with --mesh")
+    seg.add_argument("--upload-depth", type=int, default=2,
+                     help="bound on in-flight async packed uploads: up "
+                          "to this many fed tiles cross the link while "
+                          "the tile ahead computes (raise on "
+                          "high-latency links; memory grows one packed "
+                          "buffer + one fed input per step)")
+    seg.add_argument("--ingest-store-mb", type=int, default=0,
+                     help="persistent decoded-block store budget (MiB) "
+                          "under the workdir: decoded TIFF blocks spill "
+                          "to a memory-mapped on-disk store so a rerun "
+                          "over the same stacks skips decode entirely "
+                          "(ingest once, serve many); 0 = off")
+    seg.add_argument("--ingest-store-dir", default=None, metavar="DIR",
+                     help="store directory override (default "
+                          "WORKDIR/ingest_store) — share one store "
+                          "across runs/workdirs over the same stacks")
     seg.add_argument("--lazy", action="store_true",
                      help="windowed file-backed ingest (C2 per-band layout "
                           "only): no input cube in host RAM — for scenes "
@@ -634,6 +661,12 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.no_packed_upload and args.packed_upload:
+            print(
+                "error: --packed-upload conflicts with --no-packed-upload",
+                file=sys.stderr,
+            )
+            return 2
         try:
             cfg = RunConfig(
                 index=args.index,
@@ -655,6 +688,13 @@ def main(argv: list[str] | None = None) -> int:
                     else True if args.packed_fetch else "auto"
                 ),
                 fetch_depth=args.fetch_depth,
+                upload_packed=(
+                    False if args.no_packed_upload
+                    else True if args.packed_upload else "auto"
+                ),
+                upload_depth=args.upload_depth,
+                ingest_store_mb=args.ingest_store_mb,
+                ingest_store_dir=args.ingest_store_dir,
                 scale=args.scale,
                 offset=args.offset,
                 out_compress=args.out_compress,

@@ -32,6 +32,17 @@ configures it from ``RunConfig.feed_cache_mb`` / ``decode_workers``;
 (cached and uncached reads are byte-identical either way — the cache
 stores fully decoded, un-predicted blocks, so it is pure memoization).
 
+A configured **persistent store**
+(:class:`land_trendr_tpu.io.blockstore.BlockStore`, driven by
+``RunConfig.ingest_store_mb``) adds a second tier under the RAM cache:
+a RAM miss consults the store before decoding, a decoded block is
+persisted alongside its RAM insert, and a store-served block is
+promoted back into the RAM tier.  The ``hits``/``misses`` counters here
+keep describing the RAM tier (a store hit still counts a RAM miss —
+store effectiveness is the ``ingest_store`` rollup's story), and
+:func:`drop_corrupt` invalidates BOTH tiers, so a poisoned block —
+wherever it came from — degrades to one extra decode.
+
 Thread-safety: one module lock guards the cache map and the counters;
 entries are immutable by convention (every consumer only reads slices).
 A decode task spawned by :func:`prefetch_window` runs ON the shared
@@ -53,6 +64,7 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 
 __all__ = [
     "configure",
+    "detach_store",
     "cache_enabled",
     "cache_get",
     "cache_put",
@@ -88,6 +100,9 @@ _pool_size: int = 0
 # -- cache map: key -> [array, nbytes, readahead_pending] ------------------
 _entries: "OrderedDict[tuple, list]" = OrderedDict()
 _cache_bytes: int = 0
+
+# -- persistent second tier (io.blockstore.BlockStore or None) -------------
+_store = None
 
 # -- counters (guarded by _lock) -------------------------------------------
 _stats = {
@@ -144,7 +159,9 @@ def drop_corrupt(key: tuple) -> None:
     """Invalidate one cache entry whose consumer found it corrupt (wrong
     shape/dtype for its slot): the entry is removed and counted, and the
     caller re-decodes from the file — a poisoned block degrades to one
-    extra decode instead of failing the tile."""
+    extra decode instead of failing the tile.  With a persistent store
+    tier the drop propagates there too (the damaged block may have been
+    served from — or promoted out of — disk)."""
     with _lock:
         global _cache_bytes
         ent = _entries.pop(key, None)
@@ -154,19 +171,27 @@ def drop_corrupt(key: tuple) -> None:
             # must not double-count one corruption
             _cache_bytes -= ent[1]
             _stats["corrupt_dropped"] += 1
+        store = _store
+    if store is not None:
+        store.drop(key)
 
 
-def configure(budget_bytes: int = 0, workers: int | None = 0) -> None:
-    """Set the cache byte budget and the decode worker count.
+def configure(
+    budget_bytes: int = 0, workers: int | None = 0, store=None
+) -> None:
+    """Set the cache byte budget, decode worker count, and store tier.
 
     ``budget_bytes=0`` disables the cache (and clears it).  ``workers``:
     ``0`` = auto (``min(8, cpu)`` for the NumPy path, the native codec's
     own auto-threading), ``1`` = serial everywhere, ``N`` = that many
     threads in both paths, ``None`` = the unconfigured import-time
     default (serial NumPy, auto native — exactly the pre-cache codec).
+    ``store`` is a :class:`land_trendr_tpu.io.blockstore.BlockStore` (or
+    ``None`` = no persistent tier); its lifecycle — flush/close — stays
+    with the caller that built it (the driver).
     Counters are NOT reset — callers diff :func:`stats_snapshot`.
     """
-    global _budget_bytes, _workers
+    global _budget_bytes, _workers, _store
     if budget_bytes < 0:
         raise ValueError(f"budget_bytes={budget_bytes} must be >= 0")
     if workers is not None and workers < 0:
@@ -174,6 +199,7 @@ def configure(budget_bytes: int = 0, workers: int | None = 0) -> None:
     with _lock:
         _budget_bytes = int(budget_bytes)
         _workers = workers
+        _store = store
         _evict_to_budget_locked()
         if _budget_bytes == 0:
             _entries.clear()
@@ -193,9 +219,20 @@ def _evict_to_budget_locked() -> None:
         _stats["evictions"] += 1
 
 
+def detach_store(store) -> None:
+    """Drop the persistent tier iff it is still ``store`` — called by the
+    run that built it when it ends, so a later configure (or nothing at
+    all) cannot keep writing into a closed store.  The RAM tier persists
+    process-wide as before."""
+    global _store
+    with _lock:
+        if _store is store:
+            _store = None
+
+
 def cache_enabled() -> bool:
     with _lock:
-        return _budget_bytes > 0
+        return _budget_bytes > 0 or _store is not None
 
 
 def cache_get(key: tuple) -> "np.ndarray | None":
@@ -205,40 +242,65 @@ def cache_get(key: tuple) -> "np.ndarray | None":
     prefetch probing its own (or a sibling hint's) blocks is not demand
     traffic — counting it would floor-inflate the hit rate and consume
     the readahead-pending flag on lookups that never served a real read.
+
+    A RAM miss falls through to the persistent store tier when one is
+    configured: a store hit still counts a RAM ``miss`` here (the
+    counters describe the RAM tier; the store keeps its own), passes
+    the ``store.corrupt`` fault seam, and is promoted into the RAM
+    cache so revisits inside this run stay memory-speed.
     """
     demand = not getattr(_tl, "readahead", False)
     with _lock:
         ent = _entries.get(key)
-        if ent is None:
+        if ent is not None:
+            _entries.move_to_end(key)
             if demand:
-                _stats["misses"] += 1
-            return None
-        _entries.move_to_end(key)
+                _stats["hits"] += 1
+                if ent[2]:  # first real hit on a readahead-inserted block
+                    ent[2] = False
+                    _stats["readahead_hits"] += 1
+            return ent[0]
         if demand:
-            _stats["hits"] += 1
-            if ent[2]:  # first real hit on a readahead-inserted block
-                ent[2] = False
-                _stats["readahead_hits"] += 1
-        return ent[0]
+            _stats["misses"] += 1
+        store = _store
+    if store is None:
+        return None
+    arr = store.get(key, count=demand)
+    if arr is None:
+        return None
+    if demand:
+        # fault seam "store.corrupt" (demand reads only, like the cache
+        # seam): a damaged stand-in here flows through the SAME
+        # consumer-side shape/dtype validation as a poisoned RAM entry,
+        # whose drop_corrupt then invalidates both tiers
+        plan = _fault_plan
+        if plan is not None:
+            arr = plan.corrupt("store.corrupt", arr)
+    cache_put(key, arr)
+    return arr
 
 
 def cache_put(key: tuple, arr: "np.ndarray") -> None:
-    """Insert a decoded block (no-op when disabled or oversized)."""
+    """Insert a decoded block (RAM tier no-op when disabled/oversized;
+    a configured store tier persists it alongside — idempotently, so
+    store-promoted blocks are never re-written)."""
     nbytes = int(arr.nbytes)
     readahead = bool(getattr(_tl, "readahead", False))
     with _lock:
-        if _budget_bytes <= 0 or nbytes > _budget_bytes:
-            return
-        global _cache_bytes
-        old = _entries.pop(key, None)
-        if old is not None:
-            _cache_bytes -= old[1]
-        _entries[key] = [arr, nbytes, readahead]
-        _cache_bytes += nbytes
-        _stats["inserted_bytes"] += nbytes
-        if readahead:
-            _stats["readahead_blocks"] += 1
-        _evict_to_budget_locked()
+        store = _store
+        if _budget_bytes > 0 and nbytes <= _budget_bytes:
+            global _cache_bytes
+            old = _entries.pop(key, None)
+            if old is not None:
+                _cache_bytes -= old[1]
+            _entries[key] = [arr, nbytes, readahead]
+            _cache_bytes += nbytes
+            _stats["inserted_bytes"] += nbytes
+            if readahead:
+                _stats["readahead_blocks"] += 1
+            _evict_to_budget_locked()
+    if store is not None:
+        store.put(key, arr)
 
 
 def cache_clear() -> None:

@@ -45,6 +45,7 @@ FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "resume": ("no-resume",),
     "feed_readahead": ("no-feed-readahead",),
     "fetch_packed": ("packed-fetch", "no-packed-fetch"),
+    "upload_packed": ("packed-upload", "no-packed-upload"),
     "ftv_indices": ("ftv",),
     "change_filt": ("change",),
     "params": ("params-json",),

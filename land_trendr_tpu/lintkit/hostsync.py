@@ -15,10 +15,12 @@ scoped instead of typed: inside the modules that handle device values
 (``land_trendr_tpu/runtime/``, ``land_trendr_tpu/obs/``,
 ``land_trendr_tpu/parallel/``), every syncing call form is a finding —
 ``np.asarray(...)``, ``jax.device_get(...)``, ``jax.block_until_ready``
-/ ``.block_until_ready()``, and ``.item()``.  ``runtime/fetch.py`` is
-the blessed module (it IS the fetch path); the driver's two sanctioned
-compute-wait sites carry inline ``# lt: noqa[LT002]``, and host-side
-assembly seams live in ``LINT_BASELINE.json`` with their reasons.
+/ ``.block_until_ready()``, and ``.item()``.  ``runtime/fetch.py`` and
+``runtime/feed.py`` are the blessed modules (they ARE the fetch and
+upload paths — each owns exactly one sanctioned wait point); the
+driver's two sanctioned compute-wait sites carry inline
+``# lt: noqa[LT002]``, and host-side assembly seams live in
+``LINT_BASELINE.json`` with their reasons.
 (`float()` on a device scalar is the same hazard but indistinguishable
 from a host cast without types — ``.item()`` covers the idiom the
 codebase actually uses.)
@@ -40,8 +42,11 @@ SCOPED_PREFIXES = (
     "land_trendr_tpu/parallel/",
 )
 
-#: the one module allowed to sync: it is the fetch path
-BLESSED_FILES = ("land_trendr_tpu/runtime/fetch.py",)
+#: the modules allowed to sync: they ARE the fetch/upload paths
+BLESSED_FILES = (
+    "land_trendr_tpu/runtime/fetch.py",
+    "land_trendr_tpu/runtime/feed.py",
+)
 
 
 def _call_sync_kind(node: ast.Call) -> "str | None":

@@ -138,6 +138,31 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "wait_s": _NUM,
         "unpack_s": _NUM,
     },
+    # host→device upload subsystem rollup (runtime/feed): one terminal
+    # event per run scope — transfer counts (packed = 1 per tile), wire
+    # bytes, and the host-pack / landing-wait / device-unpack second
+    # split.  Additive event type, introduced without a schema bump.
+    "upload": {
+        "tiles": int,
+        "transfers": int,
+        "bytes": int,
+        "pack_s": _NUM,
+        "wait_s": _NUM,
+        "unpack_s": _NUM,
+    },
+    # graceful degradation: repeated packed-upload failures demoted the
+    # host→device path to per-array sync dispatch for the rest of the
+    # run (artifacts are byte-identical either way)
+    "upload_demoted": {"failures": int},
+    # persistent ingest-store rollup (io/blockstore): one terminal event
+    # per run scope on store-enabled runs — store tier effectiveness
+    # (hits avoid TIFF decode entirely) and ingest volume.  Additive.
+    "ingest_store": {
+        "hits": int,
+        "misses": int,
+        "put_blocks": int,
+        "put_bytes": int,
+    },
     "run_done": {
         "status": str,  # "ok" | "aborted"
         "tiles_done": int,
@@ -165,6 +190,15 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "corrupt_dropped": int,
     },
     "fetch": {"packed": bool, "backlog_max": int, "demoted": bool},
+    "upload": {"packed": bool, "backlog_max": int, "demoted": bool},
+    "ingest_store": {
+        "stale_dropped": int,
+        "corrupt_dropped": int,
+        "evicted_segments": int,
+        "bytes": int,
+        "budget_bytes": int,
+        "segments": int,
+    },
     "run_done": {"stage_s": dict, "tiles_quarantined": int},
 }
 

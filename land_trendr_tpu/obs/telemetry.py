@@ -209,6 +209,67 @@ class Telemetry:
         self._fx_backlog = r.gauge(
             "lt_fetch_backlog_max", "high watermark of in-flight async fetches"
         )
+        # host→device upload subsystem (runtime/feed): run-scoped
+        # counters folded in once per run by Telemetry.upload
+        self._up_tiles = r.counter(
+            "lt_upload_tiles_total", "tiles whose fed inputs were uploaded"
+        )
+        self._up_transfers = r.counter(
+            "lt_upload_transfers_total",
+            "host->device transfers issued (packed upload = 1 per tile)",
+        )
+        self._up_bytes = r.counter(
+            "lt_upload_bytes_total", "host->device wire bytes uploaded"
+        )
+        self._up_pack_s = r.counter(
+            "lt_upload_pack_seconds_total",
+            "host seconds packing fed arrays + issuing device_put",
+        )
+        self._up_wait_s = r.counter(
+            "lt_upload_wait_seconds_total",
+            "host seconds blocked waiting for uploaded bytes to land",
+        )
+        self._up_unpack_s = r.counter(
+            "lt_upload_unpack_seconds_total",
+            "host seconds dispatching the device-side unpack program",
+        )
+        self._up_backlog = r.gauge(
+            "lt_upload_backlog_max", "high watermark of in-flight async uploads"
+        )
+        self._up_demoted = r.gauge(
+            "lt_upload_demoted",
+            "1 once repeated packed-upload failures demoted the run to the "
+            "per-array sync dispatch",
+        )
+        # persistent ingest store (io/blockstore): run-scoped counters
+        # folded in once per run by Telemetry.ingest_store
+        self._is_hits = r.counter(
+            "lt_ingest_store_hits_total",
+            "decoded blocks served from the persistent store (decode skipped)",
+        )
+        self._is_misses = r.counter(
+            "lt_ingest_store_misses_total",
+            "store lookups that fell through to a TIFF decode",
+        )
+        self._is_put_blocks = r.counter(
+            "lt_ingest_store_put_blocks_total",
+            "decoded blocks persisted into the store",
+        )
+        self._is_put_bytes = r.counter(
+            "lt_ingest_store_put_bytes_total",
+            "bytes of decoded blocks persisted into the store",
+        )
+        self._is_stale = r.counter(
+            "lt_ingest_store_stale_dropped_total",
+            "stale-generation entries dropped (input file rewritten)",
+        )
+        self._is_corrupt = r.counter(
+            "lt_ingest_store_corrupt_dropped_total",
+            "corrupt store entries/segments dropped and re-decoded",
+        )
+        self._is_bytes = r.gauge(
+            "lt_ingest_store_bytes", "persistent store occupancy (bytes)"
+        )
         if fingerprint:
             r.gauge(
                 "lt_run_info",
@@ -418,6 +479,74 @@ class Telemetry:
         self._fx_unpack_s.inc(fields["unpack_s"])
         if "backlog_max" in fields:
             self._fx_backlog.set_max(fields["backlog_max"])
+
+    def upload_demoted(self, failures: int) -> None:
+        """Packed upload demoted to the per-array sync dispatch for the
+        rest of the run after repeated upload failures."""
+        self.events.emit("upload_demoted", failures=failures)
+        self._up_demoted.set(1)
+
+    def upload(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's host→device upload counters into the stream.
+
+        ``stats`` is a :meth:`land_trendr_tpu.runtime.feed.TileUploader.
+        summary` dict; the driver calls this once, right before
+        ``run_done`` (success and abort paths alike).  Emits the
+        ``upload`` event and advances the ``lt_upload_*`` instruments.
+        """
+        fields: dict[str, Any] = {
+            "tiles": int(stats.get("tiles", 0)),
+            "transfers": int(stats.get("transfers", 0)),
+            "bytes": int(stats.get("bytes", 0)),
+            "pack_s": round(float(stats.get("pack_s", 0.0)), 6),
+            "wait_s": round(float(stats.get("wait_s", 0.0)), 6),
+            "unpack_s": round(float(stats.get("unpack_s", 0.0)), 6),
+        }
+        if "backlog_max" in stats:
+            fields["backlog_max"] = int(stats["backlog_max"])
+        if "packed" in stats:
+            fields["packed"] = bool(stats["packed"])
+        if "demoted" in stats:
+            fields["demoted"] = bool(stats["demoted"])
+        self.events.emit("upload", **fields)
+        self._up_tiles.inc(fields["tiles"])
+        self._up_transfers.inc(fields["transfers"])
+        self._up_bytes.inc(fields["bytes"])
+        self._up_pack_s.inc(fields["pack_s"])
+        self._up_wait_s.inc(fields["wait_s"])
+        self._up_unpack_s.inc(fields["unpack_s"])
+        if "backlog_max" in fields:
+            self._up_backlog.set_max(fields["backlog_max"])
+
+    def ingest_store(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's persistent ingest-store counters into the stream.
+
+        ``stats`` is a :meth:`land_trendr_tpu.io.blockstore.BlockStore.
+        stats_delta` dict; the driver calls this once per store-enabled
+        run, right before ``run_done``.  Emits the ``ingest_store``
+        event and advances the ``lt_ingest_*`` instruments.
+        """
+        fields: dict[str, Any] = {
+            "hits": int(stats.get("hits", 0)),
+            "misses": int(stats.get("misses", 0)),
+            "put_blocks": int(stats.get("put_blocks", 0)),
+            "put_bytes": int(stats.get("put_bytes", 0)),
+        }
+        for opt in (
+            "stale_dropped", "corrupt_dropped", "evicted_segments",
+            "bytes", "budget_bytes", "segments",
+        ):
+            if opt in stats:
+                fields[opt] = int(stats[opt])
+        self.events.emit("ingest_store", **fields)
+        self._is_hits.inc(fields["hits"])
+        self._is_misses.inc(fields["misses"])
+        self._is_put_blocks.inc(fields["put_blocks"])
+        self._is_put_bytes.inc(fields["put_bytes"])
+        self._is_stale.inc(fields.get("stale_dropped", 0))
+        self._is_corrupt.inc(fields.get("corrupt_dropped", 0))
+        if "bytes" in fields:
+            self._is_bytes.set(fields["bytes"])
 
     def run_done(
         self,

@@ -54,6 +54,7 @@ from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
 from land_trendr_tpu.ops.tile import PALLAS_BLOCK, process_tile_dn, resolve_impl
+from land_trendr_tpu.runtime import feed as feedmod
 from land_trendr_tpu.runtime import fetch as fetchmod
 from land_trendr_tpu.runtime import faults
 from land_trendr_tpu.runtime.manifest import (
@@ -83,6 +84,10 @@ _warned_gather_fallback = False
 #: many fetch-wait failures in one run — a sick link must not keep
 #: spending every subsequent tile's retry budget on transfer faults
 _FETCH_DEMOTE_AFTER = 3
+
+#: the upload mirror: demote the packed host→device path to the
+#: per-array sync dispatch after this many CONSECUTIVE upload failures
+_UPLOAD_DEMOTE_AFTER = 3
 
 #: retry backoff ceiling: the exponential ladder never sleeps longer
 #: than this between attempts, whatever max_retries is set to
@@ -271,6 +276,41 @@ class RunConfig:
     #: depth step; 2 gives full compute/readback overlap for a
     #: steady-state pipeline.
     fetch_depth: int = 2
+    #: host→device upload strategy (:mod:`land_trendr_tpu.runtime.feed`):
+    #: ``"auto"`` (default) packs every tile's fed band/QA arrays into
+    #: ONE contiguous host buffer and issues a single asynchronous
+    #: ``jax.device_put`` per tile — the transfer crosses the link while
+    #: earlier tiles compute, and a tiny jitted device program unpacks it
+    #: back into the per-band arrays — on accelerator backends, and keeps
+    #: the per-array sync path on CPU (where ``device_put`` is near
+    #: zero-copy and packing is pure overhead) and on mesh runs (sharded
+    #: placement is per-array by construction).  ``True``/``False``
+    #: force; forcing ``True`` with a mesh raises.  A pure execution
+    #: strategy — the wire format is a bit-exact reinterpretation, so
+    #: packed and per-array artifacts are byte-identical and the knob is
+    #: never fingerprinted.
+    upload_packed: "bool | str" = "auto"
+    #: bound on in-flight packed uploads: up to this many fed tiles have
+    #: their packed buffers crossing the link ahead of dispatch (double-
+    #: buffering against the current tile's compute).  Host memory grows
+    #: by one packed buffer plus one fed input (retained for the retry
+    #: ladder — an upload error surfacing through the async wait
+    #: re-dispatches from it on the per-array path) per depth step.
+    upload_depth: int = 2
+    #: persistent decoded-block store budget (MiB) for the windowed feed
+    #: path (:mod:`land_trendr_tpu.io.blockstore`): decoded TIFF blocks
+    #: spill to a memory-mapped on-disk column store under the workdir,
+    #: keyed by the same ``(path, mtime_ns, size, page, block)``
+    #: fingerprint as the RAM cache — so a second run over the same
+    #: stacks ("ingest once, serve many") skips TIFF decode entirely.
+    #: ``0`` (default) disables the store.  An execution fact — NOT
+    #: fingerprinted; a rewritten input file invalidates itself via the
+    #: fingerprint key.
+    ingest_store_mb: int = 0
+    #: store directory override (default ``<workdir>/ingest_store``) —
+    #: point several runs' workdirs at one shared store for the
+    #: service-mode "same stacks, many runs" workload.
+    ingest_store_dir: "str | None" = None
     #: fuse on-device change-map selection into every tile's program
     #: (ops/change.select_change over arrays already in HBM); the per-tile
     #: change products ride the manifest and assemble into change_*.tif
@@ -412,6 +452,23 @@ class RunConfig:
             )
         if self.fetch_depth < 1:
             raise ValueError(f"fetch_depth={self.fetch_depth} must be >= 1")
+        if self.upload_packed not in (True, False, "auto"):
+            raise ValueError(
+                f"upload_packed={self.upload_packed!r} not one of True, "
+                "False, 'auto'"
+            )
+        if self.upload_depth < 1:
+            raise ValueError(f"upload_depth={self.upload_depth} must be >= 1")
+        if self.ingest_store_mb < 0:
+            raise ValueError(
+                f"ingest_store_mb={self.ingest_store_mb} must be >= 0 "
+                "(0 = off)"
+            )
+        if self.ingest_store_dir is not None and not self.ingest_store_mb:
+            raise ValueError(
+                "ingest_store_dir requires ingest_store_mb > 0 (there is "
+                "no store to place without a budget)"
+            )
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
         if self.feed_workers < 1:
@@ -676,11 +733,16 @@ def run_stack(
     (:mod:`land_trendr_tpu.runtime.fetch`): with the packed fetch path a
     completed tile's products leave the device as ONE asynchronous
     transfer that lands while the next tiles compute, bounded at
-    ``cfg.fetch_depth`` in flight.  The write queue is bounded at
-    ``write_workers`` in-flight jobs (the oldest is collected before a
-    new one is submitted — backpressure and fail-fast for writer
-    errors), so at most ``write_workers + fetch_depth + 2`` tiles are
-    live at once and host memory stays bounded.
+    ``cfg.fetch_depth`` in flight.  Host→device upload is its own stage
+    too (:mod:`land_trendr_tpu.runtime.feed`): with the packed upload
+    path a fed tile's band/QA arrays leave the host as ONE asynchronous
+    ``device_put`` issued as soon as its feed completes, crossing the
+    link while the tile ahead computes, bounded at ``cfg.upload_depth``
+    in flight.  The write queue is bounded at ``write_workers`` in-flight
+    jobs (the oldest is collected before a new one is submitted —
+    backpressure and fail-fast for writer errors), so at most
+    ``write_workers + fetch_depth + upload_depth + 2`` tiles are live at
+    once and host memory stays bounded.
 
     A tile that fails — at dispatch or when its result is awaited — is
     retried synchronously up to ``max_retries`` times before the run
@@ -714,11 +776,26 @@ def run_stack(
 
     # the feed-path decode subsystem (process-wide, like GDAL's block
     # cache): decoded-block LRU + shared decode pool + readahead — pure
-    # acceleration of the windowed lazy feed, byte-identical either way
+    # acceleration of the windowed lazy feed, byte-identical either way.
+    # With ingest_store_mb the decoded blocks additionally spill to the
+    # persistent on-disk store, so a rerun over the same stacks skips
+    # TIFF decode entirely ("ingest once, serve many").
+    store = None
+    if cfg.ingest_store_mb:
+        from land_trendr_tpu.io.blockstore import BlockStore
+
+        store = BlockStore(
+            cfg.ingest_store_dir
+            or os.path.join(cfg.workdir, "ingest_store"),
+            budget_bytes=cfg.ingest_store_mb << 20,
+        )
     blockcache.configure(
-        budget_bytes=cfg.feed_cache_mb << 20, workers=cfg.decode_workers
+        budget_bytes=cfg.feed_cache_mb << 20,
+        workers=cfg.decode_workers,
+        store=store,
     )
     feed_cache_base = blockcache.stats_snapshot()
+    store_base = store.stats_snapshot() if store is not None else None
 
     # validate the mesh configuration BEFORE touching the workdir, so a
     # rejected run cannot stamp a fresh manifest with a bad context
@@ -776,6 +853,18 @@ def run_stack(
 
     impl_resolved = resolve_impl(cfg.impl)
     fetch_packed = fetchmod.resolve_packed(cfg.fetch_packed)
+    upload_packed = feedmod.resolve_packed(cfg.upload_packed)
+    if mesh is not None and upload_packed:
+        if cfg.upload_packed is True:
+            # packed upload places ONE buffer; a sharded mesh needs the
+            # per-array NamedSharding placement loop — an explicit force
+            # is a config conflict, not something to silently drop
+            raise ValueError(
+                "upload_packed=True cannot be combined with a mesh "
+                "(sharded placement is per-array); use upload_packed="
+                "'auto' or False"
+            )
+        upload_packed = False
     if (
         impl_resolved == "pallas"
         and chunk is not None
@@ -806,6 +895,7 @@ def run_stack(
     # after telemetry so its stall event has somewhere to go)
     quarantined: list[int] = []
     fetch_failures = 0
+    upload_failures = 0
     watchdog: "_StallWatchdog | None" = None
 
     def _backoff(attempt: int) -> None:
@@ -844,6 +934,29 @@ def run_stack(
         """A landed fetch resets the consecutive-failure streak."""
         nonlocal fetch_failures
         fetch_failures = 0
+
+    def _note_upload_failure() -> None:
+        """The upload mirror of :func:`_note_fetch_failure`: demote the
+        packed host→device path to per-array sync dispatch after
+        ``_UPLOAD_DEMOTE_AFTER`` CONSECUTIVE upload-wait failures (the
+        per-array path produces byte-identical artifacts, so demotion
+        costs throughput, never correctness)."""
+        nonlocal upload_failures
+        upload_failures += 1
+        if upload_failures >= _UPLOAD_DEMOTE_AFTER and uploader.packed:
+            uploader.demote()
+            log.warning(
+                "packed upload demoted to per-array sync dispatch after "
+                "%d consecutive upload failures (artifacts unaffected)",
+                upload_failures,
+            )
+            if telemetry is not None:
+                telemetry.upload_demoted(upload_failures)
+
+    def _note_upload_ok() -> None:
+        """A landed upload resets the consecutive-failure streak."""
+        nonlocal upload_failures
+        upload_failures = 0
 
     def _retry_step(t: TileSpec, attempt: int, err, what: str = "") -> int:
         """One failed attempt's shared bookkeeping — the single copy of
@@ -919,6 +1032,12 @@ def run_stack(
     # overlaps compute of tile i+1; unpacked mode is the per-product
     # synchronous path, byte-identical artifacts either way
     fetcher = fetchmod.TileFetcher(cfg, packed=fetch_packed)
+    # its upload mirror (runtime/feed.py): packed mode moves every fed
+    # tile's band/QA arrays in ONE host→device transfer issued as soon
+    # as the feed completes, so tile i+1's upload crosses the link while
+    # tile i computes; sync mode is the per-array dispatch placement,
+    # byte-identical artifacts either way
+    uploader = feedmod.TileUploader(cfg, packed=upload_packed)
 
     def _write_job(t: TileSpec, handle, dt: float) -> tuple[int, int]:
         # StageTimer accumulation is locked, so concurrent writer threads
@@ -1295,13 +1414,23 @@ def run_stack(
         ra = todo[i + ra_depth] if readahead_on and i + ra_depth < len(todo) else None
         pending_feeds.append((todo[i], feeder.submit(_feed_job, todo[i], ra)))
 
-    run_ok = False
-    try:
-        next_i = min(ra_depth, len(todo))
-        for i in range(next_i):
-            _submit_feed(i)
-        pending = None
-        while pending_feeds:
+    pending_uploads: deque = deque()  # bounded at upload_depth in flight
+
+    def _pump_uploads() -> None:
+        """Resolve fed tiles and issue their uploads until the bounded
+        in-flight window is full (or the feed queue is empty).
+
+        On the packed path this is the double-buffering step: up to
+        ``cfg.upload_depth`` packed buffers cross the link while the
+        tile ahead of them computes.  On the per-array path the window
+        is 1 — the handle is a pass-through and a deeper queue would
+        only hold extra fed inputs in host memory for nothing.  A feed
+        failure re-enters the per-tile retry budget exactly as before
+        (``_refeed``); a quarantined feed never enters the queue.
+        """
+        nonlocal next_i
+        depth = cfg.upload_depth if uploader.packed else 1
+        while pending_feeds and len(pending_uploads) < depth:
             t, fut = pending_feeds.popleft()
             # top up the queue BEFORE resolving this feed: if it failed,
             # the synchronous retry below backs off for seconds — the
@@ -1321,13 +1450,61 @@ def run_stack(
                 dn, qa, attempt0 = fed
             if watchdog is not None:
                 watchdog.tick()
+            with timer.stage("upload"):
+                try:
+                    handle = uploader.start(dn, qa)
+                except Exception as e:
+                    # an ISSUE-time upload failure (device_put raising
+                    # eagerly, pack allocation) must not abort the run:
+                    # it counts toward demotion like a wait-side fault,
+                    # and this tile falls back to the per-array handle —
+                    # the dispatch path transfers (and retries) as before
+                    _note_upload_failure()
+                    log.warning(
+                        "tile %d packed-upload issue failed (%s); "
+                        "per-array dispatch for this tile", t.tile_id, e,
+                    )
+                    handle = feedmod.SyncUpload(uploader, dn, qa)
+            pending_uploads.append((t, handle, dn, qa, attempt0))
+            uploader.note_backlog(len(pending_uploads))
+
+    run_ok = False
+    try:
+        next_i = min(ra_depth, len(todo))
+        for i in range(next_i):
+            _submit_feed(i)
+        pending = None
+        while True:
+            _pump_uploads()
+            if not pending_uploads:
+                break  # feeds exhausted (or every remainder quarantined)
+            t, handle, dn, qa, attempt0 = pending_uploads.popleft()
             if telemetry is not None:
                 # attempt0 > 1 after feed retries: the stream's
                 # tile_retry(1..n) → tile_start(n+1) stays coherent, and
                 # dispatch retries continue the SAME per-tile budget
                 telemetry.tile_start(t.tile_id, attempt=attempt0)
             t0 = time.perf_counter()
-            out, err = _dispatch(dn, qa)
+            out = err = None
+            try:
+                with timer.stage("upload"):
+                    # packed: wait out the landing (short — it has been
+                    # crossing the link while earlier tiles computed) and
+                    # run the device unpack; sync: a pass-through of the
+                    # host arrays, transferred at dispatch as always
+                    u_dn, u_qa = handle.arrays()
+                if handle.packed:
+                    _note_upload_ok()
+            except Exception as e:
+                # an upload error surfacing through the async wait enters
+                # the SAME retry ladder as a dispatch fault — the ladder
+                # re-dispatches from the retained HOST inputs on the
+                # per-array path, so a sick link cannot wedge the tile
+                if handle.packed:
+                    _note_upload_failure()
+                err = e
+            if err is None:
+                out, err = _dispatch(u_dn, u_qa)
             dt_dispatch = time.perf_counter() - t0
             if pending is not None:
                 _finish(pending)
@@ -1374,6 +1551,21 @@ def run_stack(
                     px, fit = fut.result()
                     n_px += px
                     n_fit += fit
+            if store is not None:
+                # persist what this run ingested, abort path included —
+                # the next run's warm start is the whole point.  close()
+                # flushes AND releases the segment mmaps/fds, and the
+                # detach drops the process-global reference so nothing
+                # writes into a store whose owning run has ended (the
+                # RAM tier persists process-wide as before; stats reads
+                # below still work on a closed store).  An error here
+                # (the same full disk that killed the run) must not mask
+                # the propagating failure.
+                try:
+                    store.close()
+                except Exception as exc:
+                    log.error("ingest-store flush/close failed: %s", exc)
+                blockcache.detach_store(store)
             if fault_plan is not None and not run_ok:
                 # abort path: disarm here (after the writer drain, so seam
                 # indices stay deterministic through the last record()).  On
@@ -1402,6 +1594,12 @@ def run_stack(
                     # the one whose transfer/wait counters the post-mortem
                     # needs
                     telemetry.fetch(fetcher.summary())
+                    # and the upload/store rollups — a run that died
+                    # mid-ingest is the one whose upload-wait and
+                    # store-put counters the post-mortem needs
+                    telemetry.upload(uploader.summary())
+                    if store is not None:
+                        telemetry.ingest_store(store.stats_delta(store_base))
                     telemetry.run_done(
                         "aborted",
                         tiles_done=n_done,
@@ -1463,6 +1661,9 @@ def run_stack(
     if cfg.feed_cache_mb:
         summary["feed_cache"] = feed_cache_stats
     summary["fetch"] = fetcher.summary()
+    summary["upload"] = uploader.summary()
+    if store is not None:
+        summary["ingest_store"] = store.stats_delta(store_base)
     # the success tail can itself raise (a full-disk run_done emit, a
     # merge I/O error) — the plan must still disarm, or it leaks into
     # the process's NEXT run and fires faults nobody scheduled
@@ -1475,6 +1676,10 @@ def run_stack(
                 telemetry.feed_cache(feed_cache_stats)
             # same one-rollup-per-scope shape for the fetch subsystem
             telemetry.fetch(summary["fetch"])
+            # and for its upload mirror + the persistent ingest store
+            telemetry.upload(summary["upload"])
+            if store is not None:
+                telemetry.ingest_store(summary["ingest_store"])
             try:
                 telemetry.run_done(
                     "ok",

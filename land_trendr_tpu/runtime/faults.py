@@ -21,6 +21,10 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
 ``feed.decode``     windowed GeoTIFF block decode (``io/geotiff.py``)
 ``cache.corrupt``   decoded-block cache consumption — corruption, not an
                     exception (``io/geotiff.py`` via the blockcache hook)
+``store.corrupt``   persistent block-store consumption — corruption of a
+                    store-served block (``io/blockcache.py`` store tier)
+``upload.wait``     packed host→device upload landing
+                    (``runtime/feed.PackedUpload.arrays``)
 ``dispatch``        device dispatch of one tile's program (driver)
 ``compute.wait``    the sanctioned compute-waits (driver)
 ``fetch.wait``      device→host fetch landing (``runtime/fetch._to_host``)
@@ -86,6 +90,8 @@ SEAMS = (
     "feed",
     "feed.decode",
     "cache.corrupt",
+    "store.corrupt",
+    "upload.wait",
     "dispatch",
     "compute.wait",
     "fetch.wait",
@@ -101,6 +107,8 @@ _DEFAULT_KIND = {
     "feed": "io",
     "feed.decode": "value",
     "cache.corrupt": "corrupt",
+    "store.corrupt": "corrupt",
+    "upload.wait": "runtime",
     "dispatch": "runtime",
     "compute.wait": "runtime",
     "fetch.wait": "runtime",

@@ -1,0 +1,351 @@
+"""Packed async host→device upload: one transfer per tile, overlapped.
+
+`SCENE_TPU_r05.json` measured the surviving half of the host path: with
+the device→host side packed (PR 3, ``runtime/fetch.py``), feed (43.6 s)
++ dispatch (53.1 s) together now exceed device compute (87.7 s), and the
+dispatch stage was a synchronous per-array ``jax.device_put`` loop — one
+latency-bound transfer per band plus QA per tile.  This module is the
+upload mirror of the fetch subsystem, closing the pattern the
+massively-parallel break-detection literature names (Gieseke et al.,
+arXiv:1807.01751: continent-scale time-series runs dominated by data
+movement, not fitting).
+
+Three pieces, each the inverse of its fetch twin:
+
+* **Host-side pack** (:func:`pack_inputs`): every fed array — the
+  selected DN bands and QA, all ``(feed_px, NY)`` and 2-byte on real C2
+  stacks — is memcpy'd into ONE contiguous little-endian ``uint32`` word
+  buffer (each entry word-aligned), so a tile costs one
+  ``jax.device_put`` instead of ``len(bands)+1`` latency-bound ones.
+* **Async overlap**: ``device_put`` of the packed buffer is issued as
+  soon as the tile's feed completes; the driver keeps up to
+  ``RunConfig.upload_depth`` packed tiles in flight, so tile ``i+1``'s
+  upload crosses the link while tile ``i`` computes.
+  :meth:`PackedUpload.arrays` blocks only on transfers that have not
+  landed (the ``upload.wait`` fault seam + the run's ``upload`` wait_s
+  counter live there).
+* **Device-side unpack** (:func:`unpack_inputs`): one tiny jitted
+  program bitcasts the landed words back into the per-band device
+  arrays the tile program consumes — compiled once per run (every tile
+  shares the padded pixel count).
+
+The contract mirrors the fetch plan's: packed and per-array runs produce
+**byte-identical artifacts** (``tests/test_upload.py`` pins the matrix),
+because the packed wire format is a pure reinterpretation of the same
+fed bytes.  ``upload_packed="auto"`` resolves to packed only where a
+transfer is a real wire: on a CPU backend ``device_put`` is (near)
+zero-copy and packing is pure overhead, and a sharded mesh places
+per-array ``NamedSharding`` inputs, so both keep the per-array path.
+
+Upload errors surfacing through the async wait re-enter the driver's
+shared ``_retry_ladder`` (the retained host inputs ride the pending
+queue for exactly that), and repeated consecutive failures demote the
+run to the per-array sync path — mirroring ``TileFetcher.demote``.
+
+This module is, with ``runtime/fetch.py``, a blessed LT002 host-sync
+module: the one ``block_until_ready`` here IS the upload path's
+sanctioned wait point.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import threading
+import time
+from typing import TYPE_CHECKING, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from land_trendr_tpu.runtime import faults
+
+if TYPE_CHECKING:  # pragma: no cover - type-only import (cycle with driver)
+    from land_trendr_tpu.runtime.driver import RunConfig
+
+__all__ = [
+    "UploadPlan",
+    "UploadEntry",
+    "TileUploader",
+    "build_plan",
+    "pack_inputs",
+    "plan_wire_bytes",
+    "resolve_packed",
+    "unpack_inputs",
+]
+
+
+class UploadEntry(NamedTuple):
+    """One fed array's place in the packed wire format.
+
+    ``name`` is the band name (``"qa"`` for the QA plane); ``dtype`` the
+    host/device dtype whose raw bytes cross the link (uploads are
+    lossless reinterpretation — there is no f16 narrowing on the input
+    side, DNs are already 2-byte integers).
+    """
+
+    name: str
+    dtype: str
+
+
+class UploadPlan(NamedTuple):
+    """Hashable (jit-static) description of one run's tile upload."""
+
+    entries: tuple[UploadEntry, ...]
+    px: int  # PADDED feed pixel count every tile shares
+    ny: int
+
+
+def build_plan(dn: dict, qa: np.ndarray) -> UploadPlan:
+    """The run's upload plan, from the first fed tile's (shared) arrays.
+
+    Entry order is the feed dict's deterministic band order with QA
+    last — the device unpack re-emits the same structure, so both paths
+    hand ``process_tile_dn`` identical inputs.
+    """
+    entries = [UploadEntry(k, np.dtype(v.dtype).name) for k, v in dn.items()]
+    entries.append(UploadEntry("qa", np.dtype(qa.dtype).name))
+    px, ny = (int(s) for s in qa.shape)
+    return UploadPlan(entries=tuple(entries), px=px, ny=ny)
+
+
+@functools.lru_cache(maxsize=None)
+def _layout(plan: UploadPlan) -> tuple[tuple[tuple[int, int], ...], int]:
+    """Per-entry ``(word_offset, word_count)`` and the total wire words.
+
+    Every entry starts on a word boundary (odd 2-byte tails are
+    zero-padded to the next word), so the device unpack is a static
+    slice + bitcast at a known offset.
+    """
+    offs: list[tuple[int, int]] = []
+    off_w = 0
+    for e in plan.entries:
+        nbytes = plan.px * plan.ny * np.dtype(e.dtype).itemsize
+        nw = (nbytes + 3) // 4
+        offs.append((off_w, nw))
+        off_w += nw
+    return tuple(offs), off_w
+
+
+def plan_wire_bytes(plan: UploadPlan) -> int:
+    """Bytes one packed tile transfer moves (word padding included)."""
+    return _layout(plan)[1] * 4
+
+
+def pack_inputs(dn: dict, qa: np.ndarray, plan: UploadPlan) -> np.ndarray:
+    """Host-side pack: every planned array → one ``uint32`` buffer.
+
+    Pure memcpy (one per entry) into a preallocated word buffer — no
+    dtype conversion, no predictor, nothing lossy: the packed words are
+    the fed arrays' raw little-endian bytes, so the device unpack is a
+    bit-exact inverse.
+    """
+    offs, total_w = _layout(plan)
+    buf = np.zeros(total_w, dtype=np.uint32)  # zero word padding
+    u8 = buf.view(np.uint8)
+    for e, (off_w, _nw) in zip(plan.entries, offs):
+        a = qa if e.name == "qa" else dn[e.name]
+        raw = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+        u8[off_w * 4 : off_w * 4 + raw.size] = raw
+    return buf
+
+
+def _from_words(words: jnp.ndarray, dtype: str, n: int) -> jnp.ndarray:
+    """Reinterpret a word slice as ``n`` elements of ``dtype`` — the
+    inverse of the host pack's byte copy (little-endian both sides)."""
+    it = np.dtype(dtype).itemsize
+    if it == 4:
+        return jax.lax.bitcast_convert_type(words, dtype)[:n]
+    if it == 8:
+        pairs = words.reshape(-1, 2)
+        return jax.lax.bitcast_convert_type(pairs, dtype)[:n]
+    # sub-word dtypes gain a trailing (4 // itemsize) group dim
+    return jax.lax.bitcast_convert_type(words, dtype).reshape(-1)[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("plan",))
+def unpack_inputs(
+    words: jnp.ndarray, plan: UploadPlan
+) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
+    """One device program: the landed words → per-band arrays + QA.
+
+    Compiles once per run — every tile, edge tiles included, shares the
+    padded feed pixel count.  XLA fuses the bitcasts/slices, so the
+    unpack is effectively free next to the transfer it replaces.
+    """
+    offs, _total = _layout(plan)
+    n = plan.px * plan.ny
+    dn: dict[str, jnp.ndarray] = {}
+    qa = None
+    for e, (off_w, nw) in zip(plan.entries, offs):
+        a = _from_words(words[off_w : off_w + nw], e.dtype, n)
+        a = a.reshape(plan.px, plan.ny)
+        if e.name == "qa":
+            qa = a
+        else:
+            dn[e.name] = a
+    assert qa is not None  # build_plan always appends the QA entry
+    return dn, qa
+
+
+def resolve_packed(upload_packed: "bool | str") -> bool:
+    """Resolve ``RunConfig.upload_packed`` ("auto"/True/False) to a bool.
+
+    "auto" packs only where a transfer is a real wire: on the CPU
+    backend ``device_put`` shares host memory, so the pack would be a
+    pure extra memcpy.  The wire format is little-endian (the device
+    side of every supported backend); a big-endian HOST cannot produce
+    it, so auto falls back and an explicit ``True`` raises.  Mesh runs
+    are resolved by the driver (per-array ``NamedSharding`` placement
+    cannot consume one packed buffer).
+    """
+    if upload_packed == "auto":
+        return jax.default_backend() != "cpu" and sys.byteorder == "little"
+    if upload_packed and sys.byteorder != "little":
+        raise ValueError(
+            "upload_packed=True needs a little-endian host (the packed "
+            "wire format is the device's LE byte order); use "
+            "upload_packed=False"
+        )
+    return bool(upload_packed)
+
+
+class _Stats:
+    """Thread-safe upload counters (mirrors ``fetch._Stats``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.tiles = 0
+        self.transfers = 0
+        self.bytes = 0
+        self.pack_s = 0.0
+        self.wait_s = 0.0
+        self.unpack_s = 0.0
+        self.backlog_max = 0
+
+    def add(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_backlog(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.backlog_max:
+                self.backlog_max = depth
+
+
+class PackedUpload:
+    """One tile's in-flight packed host→device transfer.
+
+    ``arrays`` is called on the driver loop right before dispatch: it
+    waits out the remainder of the transfer (short by then — the buffer
+    has been crossing the link while earlier tiles computed), then runs
+    the jitted unpack.  A device error surfacing through the wait
+    propagates to the caller, where the retry ladder re-dispatches from
+    the retained host inputs on the per-array path.
+    """
+
+    packed = True
+
+    def __init__(self, uploader: "TileUploader", words) -> None:
+        self._uploader = uploader
+        self._words = words
+
+    def arrays(self) -> tuple[dict, "jnp.ndarray"]:
+        faults.check("upload.wait")
+        stats = self._uploader.stats
+        t0 = time.perf_counter()
+        # the upload path's ONE sanctioned host-blocks-on-device point:
+        # landing is awaited here so link errors surface at a named seam
+        # (and wait_s measures true un-overlapped upload time)
+        jax.block_until_ready(self._words)
+        t1 = time.perf_counter()
+        dn, qa = unpack_inputs(self._words, plan=self._uploader.plan)
+        stats.add(
+            wait_s=t1 - t0, unpack_s=time.perf_counter() - t1, tiles=1
+        )
+        return dn, qa
+
+
+class SyncUpload:
+    """The per-array fallback: the pre-packing path, byte for byte.
+
+    No transfer is issued here — the host arrays flow into the dispatch
+    exactly as before this subsystem existed (implicit per-array
+    ``device_put`` at the jit call, or the mesh's explicit
+    ``NamedSharding`` placement loop).  Transfers/bytes are counted at
+    construction: that per-array wire traffic is what the dispatch
+    pays.
+    """
+
+    packed = False
+
+    def __init__(self, uploader: "TileUploader", dn: dict, qa) -> None:
+        self._dn = dn
+        self._qa = qa
+        uploader.stats.add(
+            transfers=len(dn) + 1,
+            bytes=sum(a.nbytes for a in dn.values()) + qa.nbytes,
+        )
+        self._uploader = uploader
+
+    def arrays(self) -> tuple[dict, np.ndarray]:
+        self._uploader.stats.add(tiles=1)
+        return self._dn, self._qa
+
+
+class TileUploader:
+    """Per-run upload strategy: plan once, then one handle per tile."""
+
+    def __init__(self, cfg: "RunConfig", packed: bool) -> None:
+        self.cfg = cfg
+        self.packed = packed
+        self.demoted = False
+        self.plan: UploadPlan | None = None
+        self.stats = _Stats()
+
+    def demote(self) -> None:
+        """Graceful degradation: drop to the per-array sync path for the
+        REST of the run (the driver calls this after repeated upload
+        failures — a sick link must not keep eating every subsequent
+        tile's retry budget).  Artifacts are byte-identical either way
+        (the wire format is a pure reinterpretation), so demotion is
+        safe mid-run; in-flight packed handles still resolve normally.
+        """
+        self.packed = False
+        self.demoted = True
+
+    def start(self, dn: dict, qa: np.ndarray) -> "PackedUpload | SyncUpload":
+        """Issue one tile's upload; packed transfers begin crossing NOW."""
+        if self.plan is None:
+            self.plan = build_plan(dn, qa)
+        if not self.packed:
+            return SyncUpload(self, dn, qa)
+        t0 = time.perf_counter()
+        words = jax.device_put(pack_inputs(dn, qa, plan=self.plan))
+        self.stats.add(
+            pack_s=time.perf_counter() - t0,
+            transfers=1,
+            bytes=plan_wire_bytes(self.plan),
+        )
+        return PackedUpload(self, words)
+
+    def note_backlog(self, depth: int) -> None:
+        self.stats.note_backlog(depth)
+
+    def summary(self) -> dict:
+        """Run-scoped counters for the run summary / ``upload`` event."""
+        s = self.stats
+        with s._lock:
+            return {
+                "packed": self.packed,
+                "demoted": self.demoted,
+                "tiles": s.tiles,
+                "transfers": s.transfers,
+                "bytes": s.bytes,
+                "pack_s": round(s.pack_s, 6),
+                "wait_s": round(s.wait_s, 6),
+                "unpack_s": round(s.unpack_s, 6),
+                "backlog_max": s.backlog_max,
+            }

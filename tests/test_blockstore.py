@@ -1,0 +1,321 @@
+"""Persistent ingest-store tests: parity matrix, fingerprint
+invalidation, budget eviction, torn-segment recovery, and the
+driver-level "ingest once, serve many" rerun.
+
+The contract under test (io/blockstore.py + the blockcache store tier):
+store-served window reads are byte-identical to store-off reads across
+the codec matrix, warm/restart passes skip TIFF decode entirely, and a
+rewritten input file can never serve its predecessor's bytes.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io import blockcache
+from land_trendr_tpu.io.blockstore import BlockStore
+from land_trendr_tpu.io.geotiff import read_geotiff_window, write_geotiff
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_blockcache():
+    """Every test starts and ends with an unconfigured cache/store."""
+    blockcache.configure(0, None)
+    blockcache.cache_clear()
+    yield
+    blockcache.configure(0, None)
+    blockcache.cache_clear()
+
+
+def _scene(tmp_path, name, compress, predictor, tile, size=400, seed=7):
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size]
+    arr = ((yy * 3 + xx * 2) % 4096 + rng.integers(0, 64, (size, size))).astype(
+        np.uint16
+    )
+    p = os.path.join(tmp_path, f"{name}.tif")
+    write_geotiff(p, arr, compress=compress, tile=tile, predictor=predictor)
+    return p, arr
+
+
+WINDOWS = [(0, 0, 180, 180), (100, 100, 250, 250), (300, 250, 100, 150)]
+
+
+@pytest.mark.parametrize(
+    "compress,predictor,tile",
+    [
+        ("none", False, 256),
+        ("deflate", False, 256),
+        ("deflate", True, 256),
+        ("deflate", True, None),  # stripped layout
+        ("lzw", True, 256),
+    ],
+)
+def test_store_parity_matrix(tmp_path, compress, predictor, tile):
+    """Codec × predictor × layout under store off/cold/warm/restart:
+    every mode's window reads are byte-identical, and warm/restart
+    serve with zero misses (decode fully skipped)."""
+    p, arr = _scene(str(tmp_path), "s", compress, predictor, tile)
+    ref = {w: read_geotiff_window(p, *w) for w in WINDOWS}  # store off
+
+    store = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store)
+    cold = {w: read_geotiff_window(p, *w) for w in WINDOWS}
+    store.flush()
+    base = store.stats_snapshot()
+    warm = {w: read_geotiff_window(p, *w) for w in WINDOWS}
+    d = store.stats_delta(base)
+    assert d["misses"] == 0 and d["hits"] > 0
+    store.close()
+
+    store2 = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store2)
+    base = store2.stats_snapshot()
+    restart = {w: read_geotiff_window(p, *w) for w in WINDOWS}
+    d = store2.stats_delta(base)
+    assert d["misses"] == 0 and d["hits"] > 0
+    store2.close()
+
+    for w in WINDOWS:
+        for mode, got in (("cold", cold), ("warm", warm), ("restart", restart)):
+            assert got[w].dtype == ref[w].dtype
+            assert got[w].tobytes() == ref[w].tobytes(), (mode, w)
+
+
+def test_fingerprint_invalidation(tmp_path):
+    """A touched mtime_ns/size drops the stale entry and re-decodes —
+    the store can never serve a rewritten file's predecessor bytes."""
+    p, _arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    store = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store)
+    read_geotiff_window(p, 0, 0, 300, 300)
+    store.flush()
+
+    time.sleep(0.02)  # ensure a distinct mtime_ns
+    rng = np.random.default_rng(9)
+    arr2 = rng.integers(0, 4096, (400, 400)).astype(np.uint16)
+    write_geotiff(p, arr2, compress="deflate", tile=256, predictor=True)
+    blockcache.cache_clear()  # the RAM tier has its own mtime guard
+
+    base = store.stats_snapshot()
+    got = read_geotiff_window(p, 0, 0, 300, 300)
+    d = store.stats_delta(base)
+    assert np.array_equal(got, arr2[:300, :300])
+    assert d["hits"] == 0
+    assert d["stale_dropped"] >= 1
+    store.close()
+
+
+def test_budget_evicts_whole_segments(tmp_path):
+    """On-disk bytes stay within the budget by dropping oldest segments;
+    evicted blocks simply re-decode."""
+    p, arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    # tiny budget: one 256² uint16 block is 128 KiB; 2 blocks fit
+    store = BlockStore(
+        str(tmp_path / "store"), budget_bytes=256 << 10, segment_bytes=1
+    )  # segment_bytes=1: every put flushes its own segment
+    blockcache.configure(0, 1, store=store)
+    read_geotiff_window(p, 0, 0, 400, 400)  # 4 blocks -> evictions
+    s = store.stats_snapshot()
+    assert s["evicted_segments"] >= 2
+    assert s["bytes"] <= 256 << 10
+    # reads stay correct through the churn
+    got = read_geotiff_window(p, 100, 100, 200, 200)
+    assert np.array_equal(got, arr[100:300, 100:300])
+    store.close()
+
+
+def test_torn_segment_recovery(tmp_path):
+    """A truncated segment data file (crash/bit rot) is dropped at open
+    — reads fall back to decode, nothing raises."""
+    p, arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    root = str(tmp_path / "store")
+    store = BlockStore(root, budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store)
+    read_geotiff_window(p, 0, 0, 400, 400)
+    store.close()
+
+    bins = glob.glob(os.path.join(root, "seg-*.bin"))
+    assert bins
+    with open(bins[0], "r+b") as f:
+        f.truncate(10)  # torn far short of the index's claim
+
+    store2 = BlockStore(root, budget_bytes=64 << 20)
+    assert store2.stats_snapshot()["corrupt_dropped"] >= 1
+    blockcache.configure(0, 1, store=store2)
+    got = read_geotiff_window(p, 0, 0, 400, 400)
+    assert np.array_equal(got, arr)
+    store2.close()
+
+
+def test_orphan_and_tmp_gc(tmp_path):
+    """A STALE .bin with no committed index (crash between the two
+    renames) and stale leftover .tmp files are garbage-collected at
+    open; FRESH ones are left alone — in a shared store directory they
+    may be a live sibling process mid-commit."""
+    root = str(tmp_path / "store")
+    os.makedirs(root)
+    stale = ("seg-1-000000.bin", "seg-1-000001.bin.tmp", "x.tmp")
+    fresh = ("seg-2-000000.bin", "seg-2-000001.bin.tmp")
+    for name in (*stale, *fresh):
+        with open(os.path.join(root, name), "wb") as f:
+            f.write(b"garbage")
+    old = time.time() - 3600
+    for name in stale:
+        os.utime(os.path.join(root, name), (old, old))
+    store = BlockStore(root, budget_bytes=1 << 20)
+    left = sorted(os.path.basename(p) for p in glob.glob(os.path.join(root, "*")))
+    assert left == sorted(fresh)
+    store.close()
+
+
+def test_unopenable_segment_drops_whole_segment(tmp_path):
+    """A deleted segment data file (a sibling's eviction) costs ONE
+    whole-segment drop — not a failed open + corruption count per
+    sibling entry."""
+    p, arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    root = str(tmp_path / "store")
+    store = BlockStore(root, budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store)
+    read_geotiff_window(p, 0, 0, 400, 400)  # 4 blocks, one segment
+    store.flush()
+    for b in glob.glob(os.path.join(root, "seg-*.bin")):
+        os.unlink(b)
+    store2_stats = store.stats_snapshot()
+    got = read_geotiff_window(p, 0, 0, 400, 400)
+    d = store.stats_delta(store2_stats)
+    assert np.array_equal(got, arr)
+    assert d["corrupt_dropped"] == 1  # one drop for the whole segment
+    store.close()
+
+
+def test_store_with_ram_tier_promotion(tmp_path):
+    """With both tiers on, a restart serves from the store ONCE per
+    block and promotes into RAM — subsequent reads are RAM hits."""
+    p, _arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    store = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(64 << 20, 1, store=store)
+    read_geotiff_window(p, 0, 0, 400, 400)
+    store.flush()
+    store.close()
+    blockcache.cache_clear()
+
+    store2 = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(64 << 20, 1, store=store2)
+    cb = blockcache.stats_snapshot()
+    sb = store2.stats_snapshot()
+    read_geotiff_window(p, 0, 0, 400, 400)
+    read_geotiff_window(p, 0, 0, 400, 400)
+    cd = blockcache.stats_delta(cb)
+    sd = store2.stats_delta(sb)
+    assert sd["hits"] == 4  # one store hit per block, first pass only
+    assert cd["hits"] == 4  # second pass served from RAM
+    store2.close()
+
+
+def test_driver_ingest_once_serve_many(tmp_path):
+    """The service-mode workload: two driver runs over the same lazy
+    stack share one store directory; the second run decodes nothing new
+    and produces byte-identical rasters."""
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack_c2
+    from land_trendr_tpu.runtime import RunConfig, run_stack
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    c2 = str(tmp_path / "c2")
+    write_stack_c2(
+        c2, make_stack(SceneSpec(width=96, height=96, year_start=2000,
+                                 year_end=2006, seed=7))
+    )
+    stack = open_stack_dir_c2_lazy(c2, bands=("nir", "swir2"))
+    store_dir = str(tmp_path / "shared_store")
+    kw = dict(
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=48, feed_cache_mb=0, ingest_store_mb=64,
+        ingest_store_dir=store_dir, retry_backoff_s=0.0,
+    )
+    s1 = run_stack(stack, RunConfig(
+        workdir=str(tmp_path / "w1"), out_dir=str(tmp_path / "o1"), **kw
+    ))
+    assert s1["ingest_store"]["put_blocks"] > 0
+    # fresh workdir, same store: every block served persistently
+    blockcache.cache_clear()
+    s2 = run_stack(stack, RunConfig(
+        workdir=str(tmp_path / "w2"), out_dir=str(tmp_path / "o2"), **kw
+    ))
+    assert s2["ingest_store"]["misses"] == 0
+    assert s2["ingest_store"]["hits"] > 0
+    assert s2["ingest_store"]["put_blocks"] == 0
+
+    for p in sorted(glob.glob(os.path.join(str(tmp_path / "w1"), "tile_*.npz"))):
+        q = os.path.join(str(tmp_path / "w2"), os.path.basename(p))
+        with np.load(p) as a, np.load(q) as b:
+            for k in a.files:
+                assert a[k].tobytes() == b[k].tobytes()
+
+
+def test_ingest_store_telemetry_and_rollup(tmp_path):
+    """The ingest_store event passes schema + value lint, advances the
+    lt_ingest_store_* instruments, and folds into obs_report with the
+    derived hit_rate."""
+    import check_events_schema
+    import obs_report
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack_c2
+    from land_trendr_tpu.runtime import RunConfig, run_stack
+    from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+    c2 = str(tmp_path / "c2")
+    write_stack_c2(
+        c2, make_stack(SceneSpec(width=96, height=96, year_start=2000,
+                                 year_end=2004, seed=3))
+    )
+    stack = open_stack_dir_c2_lazy(c2, bands=("nir", "swir2"))
+    cfg = RunConfig(
+        workdir=str(tmp_path / "w"), out_dir=str(tmp_path / "o"),
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=48, feed_cache_mb=0, ingest_store_mb=64, telemetry=True,
+    )
+    summary = run_stack(stack, cfg)
+    assert check_events_schema.main([cfg.workdir]) == 0
+
+    report, _spans = obs_report.fold([summary["telemetry"]["events"]])
+    st = report["ingest_store"]
+    assert st["put_blocks"] == summary["ingest_store"]["put_blocks"] > 0
+    assert st["hit_rate"] is not None
+
+    prom = open(summary["telemetry"]["metrics"]).read()
+    for name in ("lt_ingest_store_hits_total", "lt_ingest_store_put_bytes_total",
+                 "lt_ingest_store_bytes"):
+        assert name in prom
+
+
+def test_store_corrupt_seam_recovers(tmp_path):
+    """The store.corrupt fault seam: a poisoned store-served block is
+    invalidated in both tiers and re-decoded — reads stay correct and
+    the drop is counted."""
+    from land_trendr_tpu.runtime import faults
+
+    p, arr = _scene(str(tmp_path), "s", "deflate", True, 256)
+    store = BlockStore(str(tmp_path / "store"), budget_bytes=64 << 20)
+    blockcache.configure(0, 1, store=store)
+    read_geotiff_window(p, 0, 0, 400, 400)
+    store.flush()
+
+    plan = faults.activate(faults.parse_schedule("seed=1,store.corrupt@1"))
+    try:
+        got = read_geotiff_window(p, 0, 0, 400, 400)
+    finally:
+        faults.deactivate()
+    assert np.array_equal(got, arr)
+    assert [s for s, _i, _k in plan.injected()] == ["store.corrupt"]
+    assert store.stats_snapshot()["corrupt_dropped"] >= 1
+    store.close()

@@ -1,0 +1,287 @@
+"""Upload-subsystem tests: packed ≡ per-array byte parity, async fault
+retry + demotion, CLI knobs, telemetry/lint/rollup wiring, and the
+upload_bench + perf_gate smokes (tier-1).
+
+The contract under test (runtime/feed.py): ``upload_packed`` is a pure
+execution strategy — packed and per-array runs must produce
+byte-identical tile artifacts, with the packed path costing ONE
+host→device transfer per tile instead of ``bands+1``.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import main as cli_main
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.runtime import (
+    RunConfig,
+    run_stack,
+    stack_from_synthetic,
+)
+from land_trendr_tpu.runtime import feed as feedmod
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+SPEC = SceneSpec(width=48, height=40, year_start=1990, year_end=2005, seed=11)
+PARAMS = LTParams(max_segments=4, vertex_count_overshoot=2)
+
+
+@pytest.fixture(scope="module")
+def rstack():
+    return stack_from_synthetic(make_stack(SPEC))
+
+
+def make_cfg(tmp, **kw):
+    kw.setdefault("params", PARAMS)
+    kw.setdefault("tile_size", 32)  # 48x40 scene -> edge tiles in both axes
+    kw.setdefault("retry_backoff_s", 0.0)
+    return RunConfig(
+        workdir=os.path.join(tmp, "work"), out_dir=os.path.join(tmp, "out"),
+        **kw,
+    )
+
+
+def load_artifacts(cfg, n_tiles):
+    out = []
+    for tid in range(n_tiles):
+        with np.load(os.path.join(cfg.workdir, f"tile_{tid:05d}.npz")) as z:
+            out.append({k: z[k] for k in z.files})
+    return out
+
+
+def test_packed_per_array_byte_parity(tmp_path, rstack):
+    """The tentpole claim: packed upload is one transfer per tile (vs
+    bands+1) and the artifacts are byte-identical to the per-array run."""
+    cfg_p = make_cfg(str(tmp_path / "p"), upload_packed=True)
+    cfg_u = make_cfg(str(tmp_path / "u"), upload_packed=False)
+    sp = run_stack(rstack, cfg_p)
+    su = run_stack(rstack, cfg_u)
+
+    assert sp["upload"]["packed"] is True
+    assert su["upload"]["packed"] is False
+    assert sp["upload"]["transfers"] == sp["tiles"]
+    # per-array: 2 NBR bands + QA = 3 transfers per tile
+    assert su["upload"]["transfers"] == su["tiles"] * 3
+    assert sp["upload"]["bytes"] == su["upload"]["bytes"] > 0
+    assert sp["fit_rate"] == su["fit_rate"]
+
+    for tid, (a, b) in enumerate(
+        zip(load_artifacts(cfg_p, sp["tiles"]), load_artifacts(cfg_u, su["tiles"]))
+    ):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            assert a[k].tobytes() == b[k].tobytes(), (
+                f"tile {tid} product {k} differs between packed and per-array"
+            )
+
+
+def test_pack_unpack_roundtrip_dtypes():
+    """The wire format is a bit-exact inverse across the element sizes
+    the codebase feeds (1/2/4/8-byte), odd pixel counts included."""
+    import jax
+
+    rng = np.random.default_rng(5)
+    for dt in (np.uint8, np.int16, np.uint16, np.int32, np.float64):
+        px, ny = 17, 7  # odd on purpose: sub-word tails must zero-pad
+        dn = {"b": rng.integers(0, 100, (px, ny)).astype(dt)}
+        qa = rng.integers(0, 2, (px, ny)).astype(np.uint16)
+        plan = feedmod.build_plan(dn, qa)
+        words = feedmod.pack_inputs(dn, qa, plan)
+        assert words.nbytes == feedmod.plan_wire_bytes(plan)
+        u_dn, u_qa = feedmod.unpack_inputs(jax.device_put(words), plan=plan)
+        assert np.asarray(u_dn["b"]).tobytes() == dn["b"].tobytes()
+        assert np.asarray(u_qa).tobytes() == qa.tobytes()
+
+
+def test_upload_auto_keeps_per_array_on_cpu(tmp_path, rstack):
+    """"auto" resolves to the per-array path on the CPU backend, where
+    device_put is near zero-copy and packing would be pure overhead."""
+    assert feedmod.resolve_packed("auto") is False
+    summary = run_stack(rstack, make_cfg(str(tmp_path)))
+    assert summary["upload"]["packed"] is False
+
+
+def test_packed_upload_mesh_conflict(tmp_path, rstack):
+    """Forcing packed upload with a sharded mesh is a config conflict
+    (placement is per-array); 'auto' silently keeps the per-array path."""
+    import jax
+
+    from land_trendr_tpu.parallel import make_mesh
+
+    mesh = make_mesh(jax.local_devices())
+    with pytest.raises(ValueError, match="upload_packed"):
+        run_stack(rstack, make_cfg(str(tmp_path / "f"), upload_packed=True),
+                  mesh=mesh)
+    summary = run_stack(rstack, make_cfg(str(tmp_path / "a")), mesh=mesh)
+    assert summary["upload"]["packed"] is False
+
+
+def test_upload_fault_reenters_retry_ladder(tmp_path, rstack):
+    """An error surfacing through the packed upload wait re-enters the
+    retry ladder (per-array re-dispatch from the retained host inputs)
+    and the run completes with clean-run artifacts."""
+    clean = make_cfg(str(tmp_path / "clean"), upload_packed=True)
+    run_stack(rstack, clean)
+    cfg = make_cfg(
+        str(tmp_path / "f"), upload_packed=True, telemetry=True,
+        fault_schedule="seed=1,upload.wait@1",
+    )
+    summary = run_stack(rstack, cfg)
+    assert summary["pixels"] == SPEC.height * SPEC.width
+    assert [f["seam"] for f in summary["faults_injected"]] == ["upload.wait"]
+    evs = [json.loads(l) for l in open(summary["telemetry"]["events"])]
+    retries = [e for e in evs if e["ev"] == "tile_retry"]
+    assert len(retries) == 1 and "upload.wait" in retries[0]["error"]
+    for a, b in zip(
+        load_artifacts(clean, summary["tiles"]),
+        load_artifacts(cfg, summary["tiles"]),
+    ):
+        for k in a:
+            assert a[k].tobytes() == b[k].tobytes()
+
+
+def test_upload_demotion_after_consecutive_failures(tmp_path, rstack):
+    """Three consecutive upload failures demote the run to per-array
+    sync dispatch for the rest of the run (artifacts unaffected)."""
+    cfg = make_cfg(
+        str(tmp_path), upload_packed=True, max_retries=4, telemetry=True,
+        fault_schedule="seed=1,upload.wait@0*3",
+    )
+    summary = run_stack(rstack, cfg)
+    assert summary["upload"]["demoted"] is True
+    assert summary["upload"]["packed"] is False
+    evs = [json.loads(l) for l in open(summary["telemetry"]["events"])]
+    dem = [e for e in evs if e["ev"] == "upload_demoted"]
+    assert len(dem) == 1 and dem[0]["failures"] == 3
+
+
+def test_runconfig_validates_upload_knobs(tmp_path):
+    with pytest.raises(ValueError, match="upload_depth"):
+        make_cfg(str(tmp_path), upload_depth=0)
+    with pytest.raises(ValueError, match="upload_packed"):
+        make_cfg(str(tmp_path), upload_packed="yes")
+    with pytest.raises(ValueError, match="ingest_store_mb"):
+        make_cfg(str(tmp_path), ingest_store_mb=-1)
+    with pytest.raises(ValueError, match="ingest_store_dir"):
+        make_cfg(str(tmp_path), ingest_store_dir=str(tmp_path))
+
+
+def test_upload_cli_knobs(tmp_path, capsys):
+    stack_dir = str(tmp_path / "stack")
+    assert cli_main(["synth", stack_dir, "--size", "32",
+                     "--year-start", "1990", "--year-end", "2001"]) == 0
+    capsys.readouterr()
+    assert cli_main([
+        "segment", stack_dir, "--tile-size", "32",
+        "--workdir", str(tmp_path / "work"), "--out-dir",
+        str(tmp_path / "out"), "--max-segments", "4",
+        "--vertex-count-overshoot", "2", "--packed-upload",
+        "--upload-depth", "3",
+    ]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["summary"]["upload"]["packed"] is True
+    assert rep["summary"]["upload"]["transfers"] == rep["summary"]["upload"]["tiles"]
+
+    # forcing both directions at once is an argument conflict
+    assert cli_main([
+        "segment", stack_dir, "--tile-size", "32",
+        "--workdir", str(tmp_path / "w2"), "--out-dir",
+        str(tmp_path / "o2"), "--packed-upload", "--no-packed-upload",
+    ]) == 2
+    assert "--no-packed-upload" in capsys.readouterr().err
+
+
+def test_upload_telemetry_schema_metrics_and_rollup(tmp_path, rstack):
+    """The upload event passes the schema + value lint, advances the
+    lt_upload_* instruments, and folds into obs_report with the derived
+    transfers_per_tile."""
+    import check_events_schema
+    import obs_report
+
+    cfg = make_cfg(str(tmp_path), upload_packed=True, telemetry=True)
+    summary = run_stack(rstack, cfg)
+    assert check_events_schema.main([cfg.workdir]) == 0
+
+    report, _spans = obs_report.fold([summary["telemetry"]["events"]])
+    up = report["upload"]
+    assert up["tiles"] == summary["tiles"]
+    assert up["transfers_per_tile"] == 1.0
+    assert up["packed"] is True
+    assert up["bytes"] == summary["upload"]["bytes"] > 0
+
+    prom = open(summary["telemetry"]["metrics"]).read()
+    for name in ("lt_upload_bytes_total", "lt_upload_transfers_total",
+                 "lt_upload_wait_seconds_total", "lt_upload_backlog_max"):
+        assert name in prom
+
+
+def test_upload_value_lint_catches_drift(tmp_path):
+    """The value-level upload lint: negative counters and transfers
+    below tiles are producer drift a type check alone cannot catch."""
+    from check_events_schema import main as lint_main
+
+    from land_trendr_tpu.obs.events import EventLog
+
+    def write_events(path, upload_fields):
+        log = EventLog(path)
+        log.run_start(
+            fingerprint="x", process_index=0, process_count=1,
+            tiles_total=1, tiles_todo=1, tiles_skipped_resume=0,
+            mesh_devices=1, impl="xla",
+        )
+        log.emit("upload", **upload_fields)
+        log.emit(
+            "run_done", status="ok", tiles_done=1, pixels=1, wall_s=1.0,
+            px_per_s=1.0, fit_rate=1.0,
+        )
+        log.close()
+
+    ok = dict(tiles=2, transfers=2, bytes=10, pack_s=0.1, wait_s=0.1,
+              unpack_s=0.1)
+    good = str(tmp_path / "good")
+    write_events(os.path.join(good, "events.jsonl"), ok)
+    assert lint_main([good]) == 0
+
+    for name, bad in (
+        ("neg", {**ok, "bytes": -1}),
+        ("short", {**ok, "transfers": 1}),
+    ):
+        d = str(tmp_path / name)
+        write_events(os.path.join(d, "events.jsonl"), bad)
+        assert lint_main([d]) == 1, name
+
+
+def test_upload_bench_smoke(tmp_path):
+    """Tier-1 upload_bench smoke: runs end to end, parity holds, the
+    packed path is one transfer per tile, and the warm/restart store
+    passes skip decode entirely."""
+    import upload_bench
+
+    out = str(tmp_path / "upload_smoke.json")
+    assert upload_bench.main(["--smoke", "--out", out]) == 0
+    rep = json.load(open(out))
+    assert rep["parity"]["ok"] is True
+    assert rep["workload"]["transfers_per_tile_packed"] == 1
+    assert rep["workload"]["transfers_per_tile_per_array"] == 3
+    assert rep["speedup_packed_sync"] > 0
+    assert rep["speedup_packed_async"] > 0
+    store = rep["ingest_store"]
+    assert store["parity_ok"] is True
+    assert store["store_warm"]["hit_rate"] == 1.0
+    assert store["store_restart"]["hit_rate"] == 1.0
+    assert store["store_warm"]["stats"]["misses"] == 0
+
+
+def test_perf_gate_smoke(tmp_path, capsys):
+    """The tier-1 perf-regression gate: the three bench smokes must meet
+    the bands derived from the committed artifacts."""
+    import perf_gate
+
+    rc = perf_gate.main(["--keep", str(tmp_path / "gate")])
+    out = capsys.readouterr()
+    assert rc == 0, f"perf gate regressions:\n{out.out}\n{out.err}"

@@ -55,6 +55,16 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
         "backlog_max",
     ),
+    "upload": (
+        "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
+        "backlog_max",
+    ),
+    "upload_demoted": ("failures",),
+    "ingest_store": (
+        "hits", "misses", "put_blocks", "put_bytes", "stale_dropped",
+        "corrupt_dropped", "evicted_segments", "bytes", "budget_bytes",
+        "segments",
+    ),
     # robustness events (PR 5): counters/indices/durations only go up
     "fault_injected": ("index",),
     "tile_quarantined": ("tile_id", "attempts"),
@@ -150,14 +160,36 @@ class FetchValueLint:
         return errs
 
 
+def upload_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for one ``upload`` record: non-negative counters
+    and at least one transfer per uploaded tile (the packed path's whole
+    claim is transfers == tiles; the per-array path's is bands+1 per
+    tile — below tiles means a broken stats split either way)."""
+    if not isinstance(rec, dict) or rec.get("ev") != "upload":
+        return []
+    errs = []
+    for name in NONNEG_FIELDS["upload"]:
+        v = rec.get(name)
+        if _num(v) and v < 0:
+            errs.append(f"line {lineno}: upload: {name} is negative ({v})")
+    tiles, transfers = rec.get("tiles"), rec.get("transfers")
+    if _num(tiles) and _num(transfers) and transfers < tiles:
+        errs.append(
+            f"line {lineno}: upload: transfers {transfers} below tiles "
+            f"{tiles} (every uploaded tile costs at least one transfer)"
+        )
+    return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
-    (the robustness events + run_done's quarantine count) — one loop over
-    the same exported table the dedicated lints share."""
+    (the robustness events, the ingest-store rollup, run_done's
+    quarantine count) — one loop over the same exported table the
+    dedicated lints share."""
     if not isinstance(rec, dict):
         return []
     ev = rec.get("ev")
-    if ev not in NONNEG_FIELDS or ev in ("feed_cache", "fetch"):
+    if ev not in NONNEG_FIELDS or ev in ("feed_cache", "fetch", "upload"):
         return []
     errs = []
     for name in NONNEG_FIELDS[ev]:
@@ -175,6 +207,7 @@ def value_lints():
         return (
             feed_cache_value_errors(rec, lineno)
             + fetch_lint(rec, lineno)
+            + upload_value_errors(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )
 

@@ -12,14 +12,17 @@ regression fails this gate instead of waiting for real hardware to fail.
 Two scene tracks:
 
 * **eager** (in-RAM synthetic stack): the driver seams — ``feed``,
-  ``dispatch``, ``compute.wait``, ``fetch.wait`` (packed path forced, one
-  schedule also driving the demotion threshold), ``manifest.record``
-  (ENOSPC → abort → resume), ``manifest.torn`` (post-record truncation →
-  resume readability check), and a quarantine schedule (persistent tile
-  fault → run continues → resume completes it);
+  ``dispatch``, ``compute.wait``, ``fetch.wait`` and ``upload.wait``
+  (packed paths forced; one schedule per direction also driving the
+  demotion threshold), ``manifest.record`` (ENOSPC → abort → resume),
+  ``manifest.torn`` (post-record truncation → resume readability
+  check), and a quarantine schedule (persistent tile fault → run
+  continues → resume completes it);
 * **lazy** (windowed C2 per-band stack): the decode seams —
-  ``feed.decode`` (transient window-read fault → feed retry) and
-  ``cache.corrupt`` (poisoned cached block → invalidate + re-decode).
+  ``feed.decode`` (transient window-read fault → feed retry),
+  ``cache.corrupt`` (poisoned cached block → invalidate + re-decode),
+  and ``store.corrupt`` (poisoned persistent-store block → both tiers
+  invalidated + re-decode).
 
 ``--smoke`` is the seconds-scale tier-1 mode (``tests/test_faults.py``
 runs it in-process); the full mode adds probabilistic multi-seed rounds
@@ -93,6 +96,15 @@ def _eager_cases(retries: int) -> list[Case]:
             "seed=1,fetch.wait@0*3=io",
             {**packed, "max_retries": 4},
         ),
+        # the upload mirror: an error surfacing through the packed
+        # host→device wait re-enters the same ladder; repeated failures
+        # demote to the per-array sync dispatch — artifacts identical
+        Case("upload_wait_fault", "seed=1,upload.wait@1", {"upload_packed": True}),
+        Case(
+            "upload_demotion",
+            "seed=1,upload.wait@0*3",
+            {"upload_packed": True, "max_retries": 4},
+        ),
         Case("manifest_enospc", "seed=1,manifest.record@1=enospc", {}, "resume"),
         Case("manifest_torn", "seed=1,manifest.torn@1", {}, "resume"),
         Case(
@@ -107,6 +119,14 @@ def _eager_cases(retries: int) -> list[Case]:
 _LAZY_CASES = [
     Case("decode_transient", "seed=1,feed.decode@2=value", {}),
     Case("cache_corrupt", "seed=1,cache.corrupt@1", {}),
+    # persistent-store corruption: the RAM tier is OFF so store-served
+    # blocks are demand traffic; a poisoned one is invalidated in BOTH
+    # tiers and re-decoded — byte-identical artifacts like every seam
+    Case(
+        "store_corrupt",
+        "seed=1,store.corrupt@1",
+        {"feed_cache_mb": 0, "ingest_store_mb": 64},
+    ),
 ]
 
 
@@ -213,9 +233,10 @@ def soak(
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a
-    # real cache to poison
+    # real cache to poison (cases that pin their own feed_cache_mb —
+    # the store seam needs the RAM tier OFF — keep it)
     lazy_cases = [
-        dataclasses.replace(c, cfg_kw={**c.cfg_kw, "feed_cache_mb": 64})
+        dataclasses.replace(c, cfg_kw={"feed_cache_mb": 64, **c.cfg_kw})
         for c in _LAZY_CASES
     ]
     run_track("lazy", lazy, lazy_cases, tile_size=48)

@@ -7,9 +7,10 @@ per-process files) and it emits
 * a JSON **report** on stdout — per-event-type counts, tile compute-latency
   and px/s distributions, retry/failure totals, backlog-depth maxima, the
   run_done stage split, the feed-cache rollup (hits/misses/decode seconds
-  with a derived hit rate), the fetch rollup (transfers/bytes and the
-  pack/wait/unpack split, with derived ``transfers_per_tile`` and
-  ``effective_gb_per_s`` — wire bytes over blocking wait seconds), and
+  with a derived hit rate), the fetch and upload rollups (transfers/bytes
+  and the pack/wait/unpack split, with derived ``transfers_per_tile`` and
+  ``effective_gb_per_s`` — wire bytes over blocking wait seconds), the
+  ingest-store rollup (store hits/puts with a derived hit rate), and
   per-host rollups — schema lint and fold run in a SINGLE pass per file
   (``fold(paths, schema_errors=...)``);
 * with ``--trace OUT.json``, a **Chrome trace-event file** (the
@@ -82,7 +83,7 @@ def _fresh_scope() -> dict:
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
         "retries": 0, "failures": 0, "quarantined": 0, "faults_injected": 0,
         "stalls": 0, "stage_s": {}, "feed_cache": None,
-        "fetch": None,
+        "fetch": None, "upload": None, "ingest_store": None,
     }
 
 
@@ -117,24 +118,25 @@ def _merge_feed_cache(folded: list[dict]) -> "dict | None":
     return out
 
 
-#: fetch event counters summed across files; backlog_max is a per-process
-#: high watermark, so the merge takes its maximum
-_FETCH_COUNTERS = (
+#: transfer-rollup counters (fetch AND its upload mirror) summed across
+#: files; backlog_max is a per-process high watermark, so the merge
+#: takes its maximum
+_XFER_COUNTERS = (
     "tiles", "transfers", "bytes", "pack_s", "wait_s", "unpack_s",
 )
 
 
-def _merge_fetch(folded: list[dict]) -> "dict | None":
-    """Cross-file merge of the per-scope fetch rollups (None when no
-    file's last scope carried one); derives the effective readback
-    bandwidth — wire bytes over *blocking* wait seconds, i.e. the rate
-    the driver loop actually experienced after async overlap — and the
-    per-tile transfer count (packed fetch = 1.0)."""
-    seen = [c["fetch"] for c in folded if c["fetch"] is not None]
+def _merge_xfer(folded: list[dict], key: str) -> "dict | None":
+    """Cross-file merge of the per-scope transfer rollups (``fetch`` or
+    ``upload``; None when no file's last scope carried one); derives the
+    effective link bandwidth — wire bytes over *blocking* wait seconds,
+    i.e. the rate the driver loop actually experienced after async
+    overlap — and the per-tile transfer count (packed = 1.0)."""
+    seen = [c[key] for c in folded if c[key] is not None]
     if not seen:
         return None
     out: dict = {}
-    for k in _FETCH_COUNTERS:
+    for k in _XFER_COUNTERS:
         vals = [fx[k] for fx in seen if k in fx]
         if vals:
             v = sum(vals)
@@ -153,6 +155,36 @@ def _merge_fetch(folded: list[dict]) -> "dict | None":
     out["effective_gb_per_s"] = (
         round(out.get("bytes", 0) / wait / 1e9, 3) if wait else None
     )
+    return out
+
+
+#: ingest_store counters summed across files; occupancy gauges are
+#: point-in-time, so the merge takes their maximum
+_INGEST_COUNTERS = (
+    "hits", "misses", "put_blocks", "put_bytes", "stale_dropped",
+    "corrupt_dropped", "evicted_segments",
+)
+_INGEST_GAUGES = ("bytes", "budget_bytes", "segments")
+
+
+def _merge_ingest_store(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the per-scope ingest-store rollups (None when
+    no file's last scope carried one); adds the derived ``hit_rate`` —
+    the fraction of store lookups that skipped TIFF decode entirely."""
+    seen = [c["ingest_store"] for c in folded if c["ingest_store"] is not None]
+    if not seen:
+        return None
+    out: dict = {}
+    for k in _INGEST_COUNTERS:
+        vals = [s[k] for s in seen if k in s]
+        if vals:
+            out[k] = sum(vals)
+    for k in _INGEST_GAUGES:
+        vals = [s[k] for s in seen if k in s]
+        if vals:
+            out[k] = max(vals)
+    lookups = out.get("hits", 0) + out.get("misses", 0)
+    out["hit_rate"] = round(out.get("hits", 0) / lookups, 4) if lookups else None
     return out
 
 
@@ -336,10 +368,11 @@ def fold(
                                 if k in rec
                             },
                         }
-                    elif ev == "fetch":
-                        # device→host fetch rollup (runtime/fetch): one per
+                    elif ev in ("fetch", "upload"):
+                        # device→host fetch rollup (runtime/fetch) and its
+                        # host→device upload mirror (runtime/feed): one per
                         # scope, last wins; required counters must resolve
-                        cur["fetch"] = {
+                        cur[ev] = {
                             "tiles": rec["tiles"],
                             "transfers": rec["transfers"],
                             "bytes": rec["bytes"],
@@ -349,6 +382,20 @@ def fold(
                             **{
                                 k: rec[k]
                                 for k in ("backlog_max", "packed")
+                                if k in rec
+                            },
+                        }
+                    elif ev == "ingest_store":
+                        # persistent ingest-store rollup (io/blockstore):
+                        # one per scope, last wins
+                        cur["ingest_store"] = {
+                            "hits": rec["hits"],
+                            "misses": rec["misses"],
+                            "put_blocks": rec["put_blocks"],
+                            "put_bytes": rec["put_bytes"],
+                            **{
+                                k: rec[k]
+                                for k in (*_INGEST_COUNTERS, *_INGEST_GAUGES)
                                 if k in rec
                             },
                         }
@@ -395,7 +442,9 @@ def fold(
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
         "feed_cache": _merge_feed_cache(folded),
-        "fetch": _merge_fetch(folded),
+        "fetch": _merge_xfer(folded, "fetch"),
+        "upload": _merge_xfer(folded, "upload"),
+        "ingest_store": _merge_ingest_store(folded),
         "hosts": hosts,
     }
     return report, spans

@@ -1,0 +1,192 @@
+"""Perf-regression smoke gate: bench smokes vs committed artifact bands.
+
+ROADMAP item 5's regression-gate down-payment: the feed/fetch/upload
+benches each have a ``--smoke`` tier-1 mode, but until now nothing
+FAILED when a PR regressed the structural wins their committed artifacts
+record (``FEED_r07.json``, ``FETCH_r08.json``, ``UPLOAD_r10.json``).
+This tool runs the three smokes into a temp dir and checks each against
+bands **derived from the committed artifact**, chosen to be robust to
+this container's scheduler noise:
+
+* structural invariants are exact — parity flags true, packed
+  transfers-per-tile == 1, warm-store decode fully skipped
+  (hit rate ≈ 100%);
+* ratio invariants are banded — a smoke speedup / hit rate must reach a
+  fraction of the committed value (a real regression to 1.0× fails; a
+  noisy-but-working run passes).
+
+Exit 0 = all bands met, 1 = regression (failed checks listed), 2 =
+usage/IO error.  Wired into tier-1 via ``tests/test_upload.py``.
+
+Usage:
+    python tools/perf_gate.py            # smoke benches vs committed bands
+    python tools/perf_gate.py --json     # machine-readable verdict only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+#: committed artifacts of record — the baselines the bands derive from
+FEED_BASELINE = REPO / "FEED_r07.json"
+FETCH_BASELINE = REPO / "FETCH_r08.json"
+UPLOAD_BASELINE = REPO / "UPLOAD_r10.json"
+
+#: a smoke ratio must reach this fraction of its committed value — loose
+#: enough for a 2-core container's noise, tight enough that a regression
+#: to parity (1.0×) always fails
+RATIO_BAND = 1 / 3
+#: speedup floor even when the band would dip below it (a "speedup" of
+#: 1.0 means the optimization is off, whatever the baseline said)
+SPEEDUP_FLOOR = 1.15
+
+
+def _hit_rate(stats: dict) -> float | None:
+    lookups = stats.get("hits", 0) + stats.get("misses", 0)
+    return stats.get("hits", 0) / lookups if lookups else None
+
+
+def run_gate(workdir: str, checks: list) -> None:
+    """Run the three bench smokes and append (name, ok, detail) rows."""
+    import feed_bench
+    import fetch_bench
+    import upload_bench
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    # -- feed (RAM decode cache) ------------------------------------------
+    base = json.loads(FEED_BASELINE.read_text())
+    out = str(Path(workdir) / "feed_smoke.json")
+    if feed_bench.main(["--smoke", "--out", out]) != 0:
+        check("feed.ran", False, "feed_bench --smoke exited nonzero")
+    else:
+        got = json.loads(Path(out).read_text())
+        check("feed.parity", got.get("parity_ok") is True, "cached reads byte-identical")
+        base_hr = _hit_rate(base["cache_stats"]) or 0.0
+        got_hr = _hit_rate(got["cache_stats"]) or 0.0
+        band = base_hr * 0.5
+        check(
+            "feed.hit_rate",
+            got_hr >= band,
+            f"smoke hit rate {got_hr:.3f} vs band {band:.3f} "
+            f"(committed {base_hr:.3f})",
+        )
+        check(
+            "feed.cache_hits",
+            got["cache_stats"].get("hits", 0) > 0,
+            "cache served at least one revisit",
+        )
+
+    # -- fetch (packed device→host) ---------------------------------------
+    base = json.loads(FETCH_BASELINE.read_text())
+    out = str(Path(workdir) / "fetch_smoke.json")
+    if fetch_bench.main(["--smoke", "--out", out]) != 0:
+        check("fetch.ran", False, "fetch_bench --smoke exited nonzero")
+    else:
+        got = json.loads(Path(out).read_text())
+        check("fetch.parity", got["parity"]["ok"] is True, "packed ≡ per-product")
+        check(
+            "fetch.transfers_per_tile",
+            got["workload"]["transfers_per_tile_packed"] == 1,
+            "packed fetch is one transfer per tile",
+        )
+        band = max(SPEEDUP_FLOOR, base["speedup_packed_sync"] * RATIO_BAND)
+        sp = max(got["speedup_packed_sync"], got["speedup_packed_async"])
+        check(
+            "fetch.speedup",
+            sp >= band,
+            f"smoke speedup {sp:.2f} vs band {band:.2f} "
+            f"(committed {base['speedup_packed_sync']:.2f})",
+        )
+
+    # -- upload (packed host→device) + ingest store -----------------------
+    base = json.loads(UPLOAD_BASELINE.read_text())
+    out = str(Path(workdir) / "upload_smoke.json")
+    if upload_bench.main(["--smoke", "--out", out]) != 0:
+        check("upload.ran", False, "upload_bench --smoke exited nonzero")
+    else:
+        got = json.loads(Path(out).read_text())
+        check("upload.parity", got["parity"]["ok"] is True, "unpacked ≡ fed arrays")
+        check(
+            "upload.transfers_per_tile",
+            got["workload"]["transfers_per_tile_packed"] == 1,
+            "packed upload is one transfer per tile",
+        )
+        band = max(SPEEDUP_FLOOR, base["speedup_packed_sync"] * RATIO_BAND)
+        sp = max(got["speedup_packed_sync"], got["speedup_packed_async"])
+        check(
+            "upload.speedup",
+            sp >= band,
+            f"smoke speedup {sp:.2f} vs band {band:.2f} "
+            f"(committed {base['speedup_packed_sync']:.2f})",
+        )
+        store = got.get("ingest_store")
+        if store is None:
+            check("store.ran", False, "smoke skipped the ingest-store phase")
+        else:
+            check(
+                "store.parity", store["parity_ok"] is True,
+                "store-served window reads byte-identical",
+            )
+            # structural, not a noisy wall ratio: the warm/restart passes
+            # must skip TIFF decode entirely (the acceptance invariant)
+            for leg in ("store_warm", "store_restart"):
+                check(
+                    f"store.{leg}_decode_skipped",
+                    store[leg]["stats"]["misses"] == 0
+                    and store[leg]["hit_rate"] is not None
+                    and store[leg]["hit_rate"] >= 0.99,
+                    f"{leg}: hit rate {store[leg]['hit_rate']} with "
+                    f"{store[leg]['stats']['misses']} misses",
+                )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable verdict only")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep the smoke artifacts under DIR")
+    args = ap.parse_args(argv)
+
+    for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE):
+        if not p.exists():
+            print(f"error: committed baseline {p.name} missing", file=sys.stderr)
+            return 2
+
+    workdir = args.keep or tempfile.mkdtemp(prefix="lt_perf_gate_")
+    Path(workdir).mkdir(parents=True, exist_ok=True)
+    checks: list = []
+    try:
+        run_gate(workdir, checks)
+    finally:
+        if args.keep is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    failed = [c for c in checks if not c["ok"]]
+    verdict = {"ok": not failed, "checks": checks}
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        for c in checks:
+            print(f"  {'ok  ' if c['ok'] else 'FAIL'} {c['check']}: {c['detail']}")
+        print(json.dumps({"ok": verdict["ok"], "checks": len(checks),
+                          "failed": len(failed)}))
+    if failed and not args.json:
+        for c in failed:
+            print(f"regression: {c['check']}: {c['detail']}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,441 @@
+"""Upload-path benchmark: packed async host→device upload + ingest store.
+
+The two halves of this PR's host-path work, measured in one artifact
+(``--out``, e.g. ``UPLOAD_r10.json``):
+
+**Upload** — builds a synthetic fed-tile workload (the band/QA arrays
+``_feed_tile`` produces: uint16 DN bands + uint16 QA, ``(tile_px, NY)``)
+and measures the dispatch-side transfer stage three ways over the same
+tile sweep, all through the real :class:`runtime.feed.TileUploader`:
+
+* ``per_array_sync`` — the pre-packing baseline: one synchronous
+  ``jax.device_put`` per fed array per tile (the driver's
+  ``--no-packed-upload`` fallback);
+* ``packed_sync``   — ONE host-side pack + ONE transfer per tile,
+  awaited immediately (isolates the transfer-count win);
+* ``packed_async``  — the driver's production pipeline: tile *i*'s
+  packed buffer crosses the link while tile *i-1* "computes", bounded at
+  ``--depth`` in flight (adds the overlap win).
+
+**Link model.** Same as ``tools/fetch_bench.py``: on this container's
+CPU backend a host→device "transfer" is near zero-copy, so the
+per-transfer cost that dominates real accelerator links is modeled at
+the transfer points — each transfer lands ``latency + bytes/bandwidth``
+after issue (``--link-ms`` / ``--link-gbps``, default PCIe-class 1 ms /
+8 GB/s; both 0 disables for raw hardware measurement).  All host work —
+the pack memcpy, ``device_put``, the jitted device unpack — is genuinely
+executed, and ``raw_local`` records the unmodeled walls.  Parity (packed
+unpack ≡ the original fed arrays, byte for byte) is asserted on real
+arrays every run.
+
+**Ingest store** — reuses ``tools/feed_bench.py``'s synthetic
+tiled-deflate scene and window sweep to measure the persistent
+decoded-block store (:mod:`land_trendr_tpu.io.blockstore`): store-off
+baseline, cold ingest, warm rerun (same process), and a restart rerun
+(fresh ``BlockStore`` over the same directory — the "second run over the
+same stacks" case).  The warm/restart passes must show TIFF decode fully
+skipped (store hit rate ≈ 100%, zero RAM-tier decodes) with
+byte-identical window reads vs store-off.
+
+``--smoke`` shrinks both workloads to seconds scale — the tier-1 mode
+``tests/test_upload.py`` runs in CI.
+
+Usage:
+    python tools/upload_bench.py --out UPLOAD_r10.json
+    python tools/upload_bench.py --smoke --out /tmp/upload_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, str(REPO / "tools"))
+from _platform_arg import pop_platform_arg  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", pop_platform_arg())
+
+from land_trendr_tpu.config import LTParams  # noqa: E402
+from land_trendr_tpu.io import blockcache  # noqa: E402
+from land_trendr_tpu.io.blockstore import BlockStore  # noqa: E402
+from land_trendr_tpu.runtime import RunConfig  # noqa: E402
+from land_trendr_tpu.runtime import feed as feedmod  # noqa: E402
+
+
+def synth_inputs(px: int, ny: int, bands: int, seed: int):
+    """One fed tile's arrays, the shapes/dtypes ``_feed_tile`` produces:
+    uint16 DN bands + uint16 QA, ``(px, ny)``.  Random data is fine —
+    the upload stage moves bytes, it never looks at them."""
+    rng = np.random.default_rng(seed)
+    names = [f"b{i}" for i in range(bands)]
+    dn = {
+        n: rng.integers(7273, 43636, (px, ny)).astype(np.uint16)
+        for n in names
+    }
+    qa = rng.integers(0, 2, (px, ny)).astype(np.uint16) * 21824
+    return dn, qa
+
+
+class LinkModel:
+    """Per-transfer cost model: a transfer issued now lands at
+    ``now + latency_s + bytes/bw``; waiting sleeps out the remainder."""
+
+    def __init__(self, latency_ms: float, gbps: float) -> None:
+        self.latency_s = latency_ms / 1e3
+        self.bps = gbps * 1e9
+
+    @property
+    def enabled(self) -> bool:
+        return self.latency_s > 0 or self.bps > 0
+
+    def land_at(self, nbytes: int) -> float:
+        dt = self.latency_s + (nbytes / self.bps if self.bps else 0.0)
+        return time.perf_counter() + dt
+
+    def wait(self, land_at: float) -> None:
+        while True:
+            dt = land_at - time.perf_counter()
+            if dt <= 0:
+                return
+            time.sleep(dt)
+
+
+def run_per_array(cfg, payloads, n_tiles, link: LinkModel) -> dict:
+    """The production fallback: one ``device_put`` per fed array per
+    tile, each paying the modeled per-transfer link cost synchronously
+    (the dispatch-stage shape of the pre-PR driver)."""
+    up = feedmod.TileUploader(cfg, packed=False)
+    t0 = time.perf_counter()
+    for i in range(n_tiles):
+        dn, qa = payloads[i % len(payloads)]
+        handle = up.start(dn, qa)
+        h_dn, h_qa = handle.arrays()
+        # one device_put per array, each paying the link's per-transfer
+        # cost before the next is issued — the synchronous per-array
+        # dispatch shape (nothing host-blocks on the placed arrays; the
+        # device consumes them, exactly like the real dispatch)
+        for a in (*h_dn.values(), h_qa):
+            jax.device_put(a)
+            if link.enabled:
+                link.wait(link.land_at(a.nbytes))
+    wall = time.perf_counter() - t0
+    return {"wall_s": wall, "stats": up.summary()}
+
+
+def run_packed(cfg, payloads, n_tiles, link: LinkModel, depth: int) -> dict:
+    """The driver's packed pipeline shape: pack + async device_put,
+    bounded in-flight queue, device unpack on landed buffers.
+    ``depth=1`` = fully sync."""
+    up = feedmod.TileUploader(cfg, packed=True)
+    queue: list[tuple[object, float]] = []
+
+    def drain(limit: int) -> None:
+        while len(queue) > limit:
+            handle, land_at = queue.pop(0)
+            if link.enabled:
+                link.wait(land_at)
+            # the driver's real resolution point: wait out the landing,
+            # dispatch the device unpack; the tile program consumes the
+            # unpacked arrays lazily (no host block on them)
+            handle.arrays()
+
+    t0 = time.perf_counter()
+    for i in range(n_tiles):
+        dn, qa = payloads[i % len(payloads)]
+        handle = up.start(dn, qa)
+        wire = feedmod.plan_wire_bytes(up.plan)
+        queue.append((handle, link.land_at(wire)))
+        drain(depth - 1)
+    drain(0)
+    wall = time.perf_counter() - t0
+    return {"wall_s": wall, "stats": up.summary()}
+
+
+def check_parity(cfg, payloads) -> int:
+    """Packed device arrays must be byte-identical to the fed host
+    arrays (real arrays, link model off)."""
+    up = feedmod.TileUploader(cfg, packed=True)
+    checked = 0
+    for dn, qa in payloads:
+        u_dn, u_qa = up.start(dn, qa).arrays()
+        for name, host in (*dn.items(), ("qa", qa)):
+            got = np.asarray(u_qa if name == "qa" else u_dn[name])
+            if (
+                got.dtype != host.dtype
+                or got.shape != host.shape
+                or got.tobytes() != host.tobytes()
+            ):
+                raise AssertionError(f"upload parity mismatch on {name}")
+            checked += 1
+    return checked
+
+
+def bench_store(args, tmp_root: str) -> dict:
+    """The ingest-store phase: store-off vs cold vs warm vs restart over
+    the feed bench's scene/sweep, with byte-identity asserted."""
+    import feed_bench
+
+    scene_dir = os.path.join(tmp_root, "scene")
+    store_dir = os.path.join(tmp_root, "store")
+    paths = feed_bench.build_scene(
+        scene_dir, args.store_size, args.store_years, args.seed
+    )
+    wins = feed_bench.plan_windows(args.store_size, args.store_window)
+    px = args.store_size * args.store_size * args.store_years
+
+    def timed_sweep() -> tuple[float, dict]:
+        cache_base = blockcache.stats_snapshot()
+        t0 = time.perf_counter()
+        feed_bench.sweep(paths, wins, readahead=False)
+        return time.perf_counter() - t0, blockcache.stats_delta(cache_base)
+
+    # RAM tier OFF throughout: this phase isolates the persistent store
+    # (the driver composes both; feed_bench measures the RAM tier)
+    blockcache.configure(0, 1)
+    timed_sweep()  # untimed warmup: page-cache the scene files
+    off_wall, off_cache = timed_sweep()
+
+    store = BlockStore(store_dir, budget_bytes=args.store_mb << 20)
+    blockcache.configure(0, 1, store=store)
+    base = store.stats_snapshot()
+    cold_wall, cold_cache = timed_sweep()
+    cold = store.stats_delta(base)
+    store.flush()
+
+    base = store.stats_snapshot()
+    warm_wall, warm_cache = timed_sweep()
+    warm = store.stats_delta(base)
+    parity_warm = feed_bench.check_parity(paths, wins)
+    store.close()
+
+    # restart: a FRESH BlockStore over the same directory — the "second
+    # run over the same stacks" service-mode case
+    store2 = BlockStore(store_dir, budget_bytes=args.store_mb << 20)
+    blockcache.configure(0, 1, store=store2)
+    base = store2.stats_snapshot()
+    restart_wall, restart_cache = timed_sweep()
+    restart = store2.stats_delta(base)
+    parity_restart = feed_bench.check_parity(paths, wins)
+    store2.close()
+    blockcache.configure(0, None)
+
+    def rate(s: dict) -> float | None:
+        lookups = s["hits"] + s["misses"]
+        return round(s["hits"] / lookups, 4) if lookups else None
+
+    for name, s in (("warm", warm), ("restart", restart)):
+        if s["misses"]:
+            raise AssertionError(
+                f"{name} store pass missed {s['misses']} blocks — decode "
+                "was not fully skipped"
+            )
+    return {
+        "scene": {
+            "size": args.store_size,
+            "years": args.store_years,
+            "window": args.store_window,
+            "windows": len(wins),
+            "pixels": px,
+            "layout": "tiled-256 deflate+predictor uint16",
+        },
+        "store_mb": args.store_mb,
+        "store_off": {"wall_s": round(off_wall, 4), "decode_s": off_cache["decode_s"]},
+        "store_cold": {
+            "wall_s": round(cold_wall, 4),
+            "decode_s": cold_cache["decode_s"],
+            "stats": cold,
+            "hit_rate": rate(cold),
+        },
+        "store_warm": {
+            "wall_s": round(warm_wall, 4),
+            "decode_s": warm_cache["decode_s"],
+            "stats": warm,
+            "hit_rate": rate(warm),
+        },
+        "store_restart": {
+            "wall_s": round(restart_wall, 4),
+            "decode_s": restart_cache["decode_s"],
+            "stats": restart,
+            "hit_rate": rate(restart),
+        },
+        "speedup_warm": round(off_wall / warm_wall, 3) if warm_wall else None,
+        "speedup_restart": (
+            round(off_wall / restart_wall, 3) if restart_wall else None
+        ),
+        "parity_windows_checked": parity_warm + parity_restart,
+        "parity_ok": True,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tile", type=int, default=128,
+                    help="tile edge in px (tile_px = tile^2)")
+    ap.add_argument("--years", type=int, default=24)
+    ap.add_argument("--bands", type=int, default=2,
+                    help="DN bands per tile (NBR needs 2; QA always rides)")
+    ap.add_argument("--tiles", type=int, default=16)
+    ap.add_argument("--depth", type=int, default=2,
+                    help="async in-flight bound (RunConfig.upload_depth)")
+    ap.add_argument("--link-ms", type=float, default=5.0,
+                    help="modeled per-transfer latency (0 = no model). "
+                    "Default 5 ms: conservative for the RPC-dispatch "
+                    "link class this stage is bound by in practice — "
+                    "SCENE_TPU_r05 measured ~531 ms of dispatch per "
+                    "3-transfer tile (~177 ms/transfer) through the "
+                    "tunneled chip; fetch_bench's PCIe-class 1 ms also "
+                    "shows the win, but on this 2-core container the "
+                    "packed path's genuine host work (pack memcpy + "
+                    "device_put copy — DMA'd on real accelerators) "
+                    "would then mask the 3-transfers-to-1 reduction "
+                    "the driver actually buys")
+    ap.add_argument("--link-gbps", type=float, default=8.0,
+                    help="modeled link bandwidth (0 = latency-only model)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per mode; MEDIAN wall reported")
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--store-size", type=int, default=2048,
+                    help="ingest-store phase: scene edge (px)")
+    ap.add_argument("--store-years", type=int, default=6)
+    ap.add_argument("--store-window", type=int, default=192)
+    ap.add_argument("--store-mb", type=int, default=256)
+    ap.add_argument("--no-store", action="store_true",
+                    help="skip the ingest-store phase")
+    ap.add_argument("--out", default="UPLOAD_r10.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny workload, seconds not minutes (tier-1 CI)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.tile = min(args.tile, 64)
+        args.years = min(args.years, 12)
+        args.tiles = min(args.tiles, 4)
+        args.reps = 1
+        args.store_size = min(args.store_size, 512)
+        args.store_years = min(args.store_years, 3)
+        args.store_window = min(args.store_window, 160)
+
+    px = args.tile * args.tile
+    cfg = RunConfig(
+        index="nbr", params=LTParams(), tile_size=args.tile,
+        upload_packed=True, upload_depth=args.depth,
+    )
+    # two distinct payloads alternated across the sweep (content never
+    # matters to the upload stage; two keep any caching honest)
+    payloads = [
+        synth_inputs(px, args.years, args.bands, args.seed + k)
+        for k in (0, 1)
+    ]
+    link = LinkModel(args.link_ms, args.link_gbps)
+    no_link = LinkModel(0.0, 0.0)
+
+    # parity first (and the compile warmup for the unpack program)
+    parity_arrays = check_parity(cfg, payloads)
+
+    def median(mode_fn) -> dict:
+        runs = [mode_fn() for _ in range(max(1, args.reps))]
+        runs.sort(key=lambda r: r["wall_s"])
+        return runs[len(runs) // 2]
+
+    n = args.tiles
+    per_array = median(lambda: run_per_array(cfg, payloads, n, link))
+    packed_sync = median(lambda: run_packed(cfg, payloads, n, link, 1))
+    packed_async = median(
+        lambda: run_packed(cfg, payloads, n, link, args.depth)
+    )
+    raw_pa = median(lambda: run_per_array(cfg, payloads, n, no_link))
+    raw_pk = median(lambda: run_packed(cfg, payloads, n, no_link, args.depth))
+
+    wire = packed_sync["stats"]["bytes"] // max(
+        1, packed_sync["stats"]["transfers"]
+    )
+    result = {
+        "workload": {
+            "tile_px": px,
+            "years": args.years,
+            "bands": args.bands,
+            "tiles": n,
+            "bytes_per_tile_packed": wire,
+            "transfers_per_tile_per_array": args.bands + 1,
+            "transfers_per_tile_packed": 1,
+        },
+        "platform": jax.default_backend(),
+        "link_model": {
+            "latency_ms": args.link_ms,
+            "gbps": args.link_gbps,
+            "note": (
+                "transfers land latency + bytes/bandwidth after issue; "
+                "models the per-transfer cost of a real accelerator link "
+                "(absent on this CPU backend's near-zero-copy device_put) "
+                "— all host work (pack/device_put/unpack) is real; "
+                "raw_local records the unmodeled walls"
+            ) if link.enabled else "disabled: raw hardware measurement",
+        },
+        "per_array_sync": {
+            "wall_s": round(per_array["wall_s"], 4),
+            "ms_per_tile": round(per_array["wall_s"] / n * 1e3, 3),
+        },
+        "packed_sync": {
+            "wall_s": round(packed_sync["wall_s"], 4),
+            "ms_per_tile": round(packed_sync["wall_s"] / n * 1e3, 3),
+        },
+        "packed_async": {
+            "wall_s": round(packed_async["wall_s"], 4),
+            "ms_per_tile": round(packed_async["wall_s"] / n * 1e3, 3),
+            "depth": args.depth,
+            "note": (
+                "depth>1 overlaps each tile's modeled link time with the "
+                "NEXT tiles' pack work — the stand-in for the device "
+                "compute the driver overlaps (it issues uploads as feeds "
+                "complete, so a landing transfer crosses while the tile "
+                "ahead computes)"
+            ),
+        },
+        "speedup_packed_sync": round(
+            per_array["wall_s"] / packed_sync["wall_s"], 3
+        ),
+        "speedup_packed_async": round(
+            per_array["wall_s"] / packed_async["wall_s"], 3
+        ),
+        "raw_local": {
+            "per_array_ms_per_tile": round(raw_pa["wall_s"] / n * 1e3, 3),
+            "packed_ms_per_tile": round(raw_pk["wall_s"] / n * 1e3, 3),
+            "note": "no link model; CPU-backend device_put is near zero-copy",
+        },
+        "parity": {
+            "tiles_checked": len(payloads),
+            "arrays_checked": parity_arrays,
+            "ok": True,
+        },
+    }
+
+    if not args.no_store:
+        tmp = tempfile.mkdtemp(prefix="lt_upload_bench_")
+        try:
+            result["ingest_store"] = bench_store(args, tmp)
+        finally:
+            blockcache.configure(0, None)
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
