@@ -353,6 +353,88 @@ def build_parser() -> argparse.ArgumentParser:
                      help="minimum mapping unit: drop 4-connected changed "
                      "patches smaller than this many pixels")
 
+    srv = sub.add_parser(
+        "serve",
+        help="long-lived segmentation server: warm compiled programs, a "
+        "bounded job queue over a loopback HTTP JSON API + filesystem "
+        "drop-box, admission control with per-tenant caps, and "
+        "request-scoped telemetry (README §Service mode)",
+    )
+    srv.add_argument("--workdir", default="lt_serve",
+                     help="server root: the server's events/metrics "
+                     "stream, default per-job jobs/<id>/{work,out} "
+                     "directories, and the shared ingest store")
+    srv.add_argument("--serve-port", type=int, default=0, metavar="PORT",
+                     help="loopback HTTP JSON API port (0 = ephemeral, "
+                     "reported in the startup line)")
+    srv.add_argument("--serve-host", default="127.0.0.1", metavar="HOST",
+                     help="bind address for the job API — loopback ONLY "
+                     "(127.0.0.1, localhost or ::1): the API is an "
+                     "unauthenticated control surface; front it with an "
+                     "authenticated proxy or use --dropbox-dir for "
+                     "remote batch submission")
+    srv.add_argument("--serve-queue-depth", type=int, default=16,
+                     help="admission control: submissions past this "
+                     "queue depth are rejected with HTTP 429 instead of "
+                     "building unbounded backlog")
+    srv.add_argument("--tenant-max-inflight", type=int, default=4,
+                     help="admission control: per-tenant bound on "
+                     "queued+running jobs (429 at the cap; other "
+                     "tenants' traffic proceeds)")
+    srv.add_argument("--job-timeout-s", type=float, default=None,
+                     metavar="SEC",
+                     help="default per-job wall bound, submit to "
+                     "terminal: an over-budget job is cancelled through "
+                     "the run's cancel event and reported 'stalled' "
+                     "(the exit-4 analog; manifest stays resumable). "
+                     "Jobs may override per request")
+    srv.add_argument("--dropbox-dir", default=None, metavar="DIR",
+                     help="filesystem drop-box: job-request JSON files "
+                     "under DIR are claimed atomically, run through the "
+                     "same admission control as HTTP, and answered with "
+                     ".rejected.json/.result.json sidecars")
+    srv.add_argument("--dropbox-poll-s", type=float, default=1.0,
+                     metavar="SEC", help="drop-box scan period")
+    srv.add_argument("--max-jobs", type=int, default=None, metavar="N",
+                     help="drain N jobs to a terminal state then shut "
+                     "down cleanly (bench/CI mode; default: serve "
+                     "forever)")
+    srv.add_argument("--feed-cache-mb", type=int, default=256,
+                     help="process-wide decoded-block cache budget "
+                     "(MiB) shared by every job — the server owns the "
+                     "cache configuration")
+    srv.add_argument("--decode-workers", type=int, default=0,
+                     help="shared feed-decode threads: 0 = auto, "
+                     "1 = serial, N = N threads")
+    srv.add_argument("--ingest-store-mb", type=int, default=0,
+                     help="shared persistent ingest store budget (MiB): "
+                     "decoded blocks from every job spill to one store "
+                     "under the server workdir, so a warm job over "
+                     "already-ingested stacks skips TIFF decode "
+                     "entirely; 0 = off")
+    srv.add_argument("--ingest-store-dir", default=None, metavar="DIR",
+                     help="store directory override (default "
+                     "WORKDIR/ingest_store)")
+    srv.add_argument("--no-telemetry", action="store_true",
+                     help="disable the server events/metrics stream AND "
+                     "per-job run telemetry (on by default in serve "
+                     "mode — the observability is the point)")
+    srv.add_argument("--metrics-port", type=int, default=None,
+                     metavar="PORT",
+                     help="serve the lt_serve_* registry's live "
+                     "/metrics on PORT (0 = ephemeral); the job API "
+                     "serves GET /metrics regardless")
+    srv.add_argument("--metrics-host", default="", metavar="HOST",
+                     help="bind address for --metrics-port (the scrape "
+                     "endpoint is read-only and may be non-loopback)")
+    srv.add_argument("--metrics-interval-s", type=float, default=5.0,
+                     metavar="SEC", help="metrics.prom refresh period")
+    srv.add_argument("--fault-schedule", default=None, metavar="SPEC",
+                     help="deterministic fault injection for soak runs "
+                     "(one process-wide plan shared by every job, incl. "
+                     "the serve.submit/serve.job seams); production "
+                     "servers leave this unset")
+
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
 
@@ -597,6 +679,71 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "params":
         print(_params_from_args(args).to_json())
+        return 0
+
+    if args.cmd == "serve":
+        from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+        try:
+            scfg = ServeConfig(
+                workdir=args.workdir,
+                serve_port=args.serve_port,
+                serve_host=args.serve_host,
+                serve_queue_depth=args.serve_queue_depth,
+                tenant_max_inflight=args.tenant_max_inflight,
+                job_timeout_s=args.job_timeout_s,
+                dropbox_dir=args.dropbox_dir,
+                dropbox_poll_s=args.dropbox_poll_s,
+                max_jobs=args.max_jobs,
+                feed_cache_mb=args.feed_cache_mb,
+                decode_workers=args.decode_workers,
+                ingest_store_mb=args.ingest_store_mb,
+                ingest_store_dir=args.ingest_store_dir,
+                telemetry=not args.no_telemetry,
+                metrics_port=args.metrics_port,
+                metrics_host=args.metrics_host,
+                metrics_interval_s=args.metrics_interval_s,
+                fault_schedule=args.fault_schedule,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        # probe the API port NOW (REUSEADDR-matched, like the
+        # --metrics-port preflight): the real bind happens inside the
+        # server constructor, where a busy port is a raw OSError
+        if scfg.serve_port:
+            import socket
+
+            try:
+                with socket.socket() as s:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((scfg.serve_host, scfg.serve_port))
+            except OSError as e:
+                print(
+                    f"error: --serve-port {scfg.serve_port} unusable: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+        try:
+            server = SegmentationServer(scfg)
+        except OSError as e:
+            print(f"error: server startup failed: {e}", file=sys.stderr)
+            return 2
+        # machine-readable startup line (the ephemeral-port contract):
+        # clients read the bound port from here
+        print(
+            json.dumps(
+                {"serving": True, "port": server.port,
+                 "workdir": scfg.workdir}
+            ),
+            flush=True,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            # Ctrl-C is the documented way to stop an unbounded server:
+            # drain state is already durable, exit clean
+            pass
         return 0
 
     if args.cmd == "info":

@@ -37,10 +37,12 @@ from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
 __all__ = ["ConfigDocChecker"]
 
 DRIVER = "land_trendr_tpu/runtime/driver.py"
+SERVE = "land_trendr_tpu/serve/config.py"
 CLI = "land_trendr_tpu/cli.py"
 README = "README.md"
 
-#: fields whose CLI projection is not the mechanical --dashed-name
+#: RunConfig fields whose CLI projection is not the mechanical
+#: --dashed-name
 FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "resume": ("no-resume",),
     "feed_readahead": ("no-feed-readahead",),
@@ -51,21 +53,51 @@ FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "params": ("params-json",),
 }
 
+#: the ServeConfig alias table (the serve triangle's exceptions)
+SERVE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
+    "telemetry": ("no-telemetry",),
+}
+
+#: the coupling triangles this rule checks: each names a config
+#: dataclass, the CLI subcommand projecting it, the README section
+#: documenting it, and the alias table for non-mechanical flags.  A new
+#: config surface (ServeConfig was the first) adds a row here and gets
+#: the same drift protection RunConfig has.
+TRIANGLES: tuple[dict, ...] = (
+    {
+        "file": DRIVER,
+        "cls": "RunConfig",
+        "subcommand": "segment",
+        "section": "## run configuration",
+        "aliases": FLAG_ALIASES,
+    },
+    {
+        "file": SERVE,
+        "cls": "ServeConfig",
+        "subcommand": "serve",
+        "section": "## serve configuration",
+        "aliases": SERVE_FLAG_ALIASES,
+    },
+)
+
 _ROW_RE = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)`")
 
 
-def _runconfig_fields(repo: RepoCtx) -> "list[tuple[str, int]]":
-    """(field, line) for every RunConfig dataclass field."""
-    tree = repo.file(DRIVER).tree
+def _dataclass_fields(
+    repo: RepoCtx, path: str, cls_name: str
+) -> "list[tuple[str, int]]":
+    """(field, line) for every dataclass field of ``cls_name``."""
+    tree = repo.file(path).tree
     if tree is None:
         return []
     for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "RunConfig":
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
             return [
                 (stmt.target.id, stmt.lineno)
                 for stmt in node.body
                 if isinstance(stmt, ast.AnnAssign)
                 and isinstance(stmt.target, ast.Name)
+                and not stmt.target.id.startswith("_")
             ]
     return []
 
@@ -77,16 +109,17 @@ def _flag_strings(node: ast.Call) -> Iterator[str]:
                 yield a.value[2:]
 
 
-def _cli_flags(repo: RepoCtx) -> set:
-    """``--flag`` strings reachable from the SEGMENT subparser.
+def _cli_flags(repo: RepoCtx, subcommand: str) -> set:
+    """``--flag`` strings reachable from ONE subcommand's subparser.
 
     Scoped, not global: several subcommands define same-named flags
-    (``--scale``/``--index`` exist on ``pixel`` too), so a flag dropped
-    from ``segment`` must not stay green via another subcommand.  The
-    scope is the variable assigned from ``add_parser("segment")``, plus
-    its ``add_argument_group``/mutually-exclusive-group variables, plus
-    every ``add_argument`` inside a module function the segment parser
-    is passed to (the ``_add_param_flags(seg)`` pattern).  If no segment
+    (``--scale``/``--index`` exist on ``pixel`` too; ``--workdir`` on
+    both ``segment`` and ``serve``), so a flag dropped from the checked
+    subcommand must not stay green via another one.  The scope is the
+    variable assigned from ``add_parser(subcommand)``, plus its
+    ``add_argument_group``/mutually-exclusive-group variables, plus
+    every ``add_argument`` inside a module function the parser is
+    passed to (the ``_add_param_flags(seg)`` pattern).  If no such
     subparser exists (a restructured cli.py), every flag counts — a
     conservative fallback rather than a wall of false positives.
     """
@@ -104,7 +137,7 @@ def _cli_flags(repo: RepoCtx) -> set:
             and node.value.func.attr == "add_parser"
             and node.value.args
             and isinstance(node.value.args[0], ast.Constant)
-            and node.value.args[0].value == "segment"
+            and node.value.args[0].value == subcommand
         ):
             seg_vars.update(
                 t.id for t in node.targets if isinstance(t, ast.Name)
@@ -167,13 +200,15 @@ def _cli_flags(repo: RepoCtx) -> set:
     return flags
 
 
-def _readme_config_rows(repo: RepoCtx) -> "list[tuple[str, int]]":
-    """(field, line) for each §Run configuration table row in README."""
+def _readme_config_rows(
+    repo: RepoCtx, section: str
+) -> "list[tuple[str, int]]":
+    """(field, line) for each table row of one README ``##`` section."""
     rows: list[tuple[str, int]] = []
     in_section = False
     for i, line in enumerate(repo.read_text(README).splitlines(), 1):
         if line.startswith("## "):
-            in_section = line.strip().lower() == "## run configuration"
+            in_section = line.strip().lower() == section
             continue
         if in_section:
             m = _ROW_RE.match(line)
@@ -184,39 +219,54 @@ def _readme_config_rows(repo: RepoCtx) -> "list[tuple[str, int]]":
 
 class ConfigDocChecker(Checker):
     rule_id = "LT004"
-    title = "RunConfig field without CLI flag / README row (or vice versa)"
+    title = "config field without CLI flag / README row (or vice versa)"
 
     def inputs(self, repo: RepoCtx) -> set:
-        return {DRIVER, CLI, README}
+        return {t["file"] for t in TRIANGLES} | {CLI, README}
 
     def check(self, repo: RepoCtx) -> Iterator[Finding]:
-        if not (repo.exists(DRIVER) and repo.exists(CLI)):
+        if not repo.exists(CLI):
             return
-        fields = _runconfig_fields(repo)
+        for tri in TRIANGLES:
+            if not repo.exists(tri["file"]):
+                continue
+            yield from self._check_triangle(repo, tri)
+
+    def _check_triangle(self, repo: RepoCtx, tri: dict) -> Iterator[Finding]:
+        cls, path = tri["cls"], tri["file"]
+        fields = _dataclass_fields(repo, path, cls)
         field_names = {f for f, _ in fields}
-        flags = _cli_flags(repo)
-        rows = _readme_config_rows(repo) if repo.exists(README) else []
+        flags = _cli_flags(repo, tri["subcommand"])
+        rows = (
+            _readme_config_rows(repo, tri["section"])
+            if repo.exists(README)
+            else []
+        )
         row_names = {r for r, _ in rows}
+        section_title = tri["section"][3:].capitalize()
 
         for field, line in fields:
-            expected = FLAG_ALIASES.get(field, (field.replace("_", "-"),))
+            expected = tri["aliases"].get(
+                field, (field.replace("_", "-"),)
+            )
             if not any(f in flags for f in expected):
                 yield Finding(
-                    DRIVER, line, self.rule_id,
-                    f"RunConfig.{field} has no CLI flag in cli.py (expected "
-                    f"one of {', '.join('--' + f for f in expected)}) — the "
+                    path, line, self.rule_id,
+                    f"{cls}.{field} has no CLI flag on the "
+                    f"'{tri['subcommand']}' subcommand (expected one of "
+                    f"{', '.join('--' + f for f in expected)}) — the "
                     "knob cannot be set from the command line",
                 )
             if field not in row_names:
                 yield Finding(
-                    DRIVER, line, self.rule_id,
-                    f"RunConfig.{field} has no row in README.md's "
-                    "'## Run configuration' table",
+                    path, line, self.rule_id,
+                    f"{cls}.{field} has no row in README.md's "
+                    f"'## {section_title}' table",
                 )
         for row, line in rows:
             if row not in field_names:
                 yield Finding(
                     README, line, self.rule_id,
-                    f"README Run-configuration row '{row}' names no "
-                    "RunConfig field (renamed or removed?)",
+                    f"README {section_title} row '{row}' names no "
+                    f"{cls} field (renamed or removed?)",
                 )

@@ -13,14 +13,23 @@ the artifacts stayed byte-identical.
 Static typing cannot prove a value is device-resident, so the rule is
 scoped instead of typed: inside the modules that handle device values
 (``land_trendr_tpu/runtime/``, ``land_trendr_tpu/obs/``,
-``land_trendr_tpu/parallel/``), every syncing call form is a finding —
-``np.asarray(...)``, ``jax.device_get(...)``, ``jax.block_until_ready``
-/ ``.block_until_ready()``, and ``.item()``.  ``runtime/fetch.py`` and
+``land_trendr_tpu/parallel/``, ``land_trendr_tpu/serve/``), every
+syncing call form is a finding — ``np.asarray(...)``,
+``jax.device_get(...)``, ``jax.block_until_ready`` /
+``.block_until_ready()``, and ``.item()``.  ``runtime/fetch.py`` and
 ``runtime/feed.py`` are the blessed modules (they ARE the fetch and
 upload paths — each owns exactly one sanctioned wait point); the
-driver's two sanctioned compute-wait sites carry inline
-``# lt: noqa[LT002]``, and host-side assembly seams live in
-``LINT_BASELINE.json`` with their reasons.
+driver's sanctioned compute-wait sites (the two pipeline waits and the
+serve-mode warm-probe wait) carry inline ``# lt: noqa[LT002]``, and
+host-side assembly seams live in ``LINT_BASELINE.json`` with their
+reasons.
+
+Scope decision for ``serve/`` (recorded rationale, ISSUE 7): the serve
+layer composes whole :class:`~land_trendr_tpu.runtime.driver.Run`
+objects and only ever touches their host-side summaries, so device
+values should never surface there — it is IN scope and NOT blessed; any
+sync call appearing in ``serve/`` is a design regression (device state
+leaking past the run boundary), exactly what this rule exists to catch.
 (`float()` on a device scalar is the same hazard but indistinguishable
 from a host cast without types — ``.item()`` covers the idiom the
 codebase actually uses.)
@@ -40,6 +49,9 @@ SCOPED_PREFIXES = (
     "land_trendr_tpu/runtime/",
     "land_trendr_tpu/obs/",
     "land_trendr_tpu/parallel/",
+    # serve/ composes Runs and reads their host-side summaries only:
+    # in scope, NOT blessed (see the module docstring's rationale)
+    "land_trendr_tpu/serve/",
 )
 
 #: the modules allowed to sync: they ARE the fetch/upload paths

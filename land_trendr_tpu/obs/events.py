@@ -45,6 +45,7 @@ from typing import Any, Callable, Iterator
 
 __all__ = [
     "SCHEMA_VERSION",
+    "COMMON_OPTIONAL_FIELDS",
     "EVENT_FIELDS",
     "OPTIONAL_FIELDS",
     "EventLog",
@@ -171,6 +172,28 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "px_per_s": _NUM,
         "fit_rate": _NUM,
     },
+    # --- segmentation-as-a-service events (land_trendr_tpu/serve) -------
+    # a job passed admission control and entered the queue (server scope)
+    "job_submitted": {
+        "job_id": str,
+        "tenant": str,
+        "priority": int,
+        "queue_depth": int,
+    },
+    # the dispatcher picked the job up; wait_s is its queue wait
+    "job_start": {"job_id": str, "tenant": str, "wait_s": _NUM},
+    # terminal job state (done / config_error / retries_exhausted /
+    # stalled / cancelled / error — README §Service mode maps these onto
+    # the CLI exit-code contract); wall_s is submit→terminal
+    "job_done": {"job_id": str, "status": str, "wall_s": _NUM},
+    # admission control refused a submission (429-style: queue full,
+    # tenant cap) or the submission itself failed validation
+    "job_rejected": {"reason": str, "queue_depth": int},
+    # warm program cache verdict: one per run scope in serve mode (a
+    # MISS paid compile_s compiling the run's programs against a dummy
+    # tile; a HIT ran zero compiles), plus a server-scope aggregate at
+    # shutdown.  Additive event type, like the subsystem rollups above.
+    "program_cache": {"hits": int, "misses": int, "compile_s": _NUM},
 }
 
 #: well-known OPTIONAL fields: type-checked when present, never required
@@ -200,7 +223,17 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "segments": int,
     },
     "run_done": {"stage_s": dict, "tiles_quarantined": int},
+    "job_submitted": {"source": str},
+    "job_done": {"tiles_quarantined": int, "error": str},
+    "job_rejected": {"job_id": str, "tenant": str},
+    "program_cache": {"keys": int},
 }
+
+#: fields optional on EVERY event type — request-scoped threading the
+#: serve layer stamps onto a whole run scope (``EventLog`` common
+#: fields), so any tile/write/rollup event can be attributed to the job
+#: that caused it.  Type-checked when present, never required.
+COMMON_OPTIONAL_FIELDS: dict[str, Any] = {"job_id": str}
 
 
 def events_path(workdir: str, process_index: int = 0, process_count: int = 1) -> str:
@@ -325,13 +358,19 @@ class EventLog:
     by callers, so every event's two clocks are sampled together.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(
+        self, path: str, common: "dict[str, Any] | None" = None
+    ) -> None:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fd: int | None = os.open(
             path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644
         )
         self._lock = threading.Lock()
+        #: fields stamped onto EVERY event of this log (request-scoped
+        #: threading — e.g. ``{"job_id": ...}`` in serve mode); explicit
+        #: per-emit fields win on collision
+        self._common = dict(common or {})
 
     def emit(self, ev: str, **fields: Any) -> dict:
         """Append one event line; returns the record as written."""
@@ -339,6 +378,7 @@ class EventLog:
             "ev": ev,
             "t_wall": time.time(),
             "t_mono": time.perf_counter(),
+            **self._common,
             **fields,
         }
         data = (json.dumps(rec, separators=(",", ":"), default=str) + "\n").encode()
@@ -499,7 +539,10 @@ def validate_event(rec: Any, lineno: int | None = None) -> list[str]:
                 f"{where}{ev}: field {name!r} has type "
                 f"{type(rec[name]).__name__}, expected {typ}"
             )
-    for name, typ in OPTIONAL_FIELDS.get(ev, {}).items():
+    optional = {**COMMON_OPTIONAL_FIELDS, **OPTIONAL_FIELDS.get(ev, {})}
+    for name, typ in optional.items():
+        if name in EVENT_FIELDS[ev]:
+            continue  # required wins (e.g. job_submitted.job_id)
         # same bool guard as required fields: isinstance(True, int) holds,
         # but a bool in a numeric field is producer drift, not a number
         if name in rec and (

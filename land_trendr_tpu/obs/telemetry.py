@@ -59,9 +59,16 @@ class Telemetry:
         metrics_port: int | None = None,
         metrics_host: str = "",
         metrics_interval_s: float = 5.0,
+        job_id: str | None = None,
     ) -> None:
         os.makedirs(workdir, exist_ok=True)
-        self.events = EventLog(events_path(workdir, process_index, process_count))
+        # serve mode threads the job id onto EVERY event of this run's
+        # scope (an EventLog common field — schema-optional everywhere),
+        # so a cross-job fold can attribute tile traffic per request
+        self.events = EventLog(
+            events_path(workdir, process_index, process_count),
+            common={"job_id": job_id} if job_id else None,
+        )
         try:
             self._init_metrics(
                 workdir, fingerprint, process_index, process_count,
@@ -547,6 +554,25 @@ class Telemetry:
         self._is_corrupt.inc(fields.get("corrupt_dropped", 0))
         if "bytes" in fields:
             self._is_bytes.set(fields["bytes"])
+
+    def program_cache(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's warm-program-cache verdict into the stream.
+
+        ``stats`` is the driver's per-run accounting over the serve
+        layer's :class:`~land_trendr_tpu.serve.programs.ProgramCache`
+        (one hit or one miss per run scope, plus the compile seconds a
+        miss paid); emitted right before ``run_done`` like the other
+        subsystem rollups.  The ``lt_serve_*`` warm-ratio instruments
+        live in the SERVER's registry, not here — a single run only
+        knows its own verdict.
+        """
+        self.events.emit(
+            "program_cache",
+            hits=int(stats.get("hits", 0)),
+            misses=int(stats.get("misses", 0)),
+            compile_s=round(float(stats.get("compile_s", 0.0)), 6),
+            **({"keys": int(stats["keys"])} if "keys" in stats else {}),
+        )
 
     def run_done(
         self,

@@ -1,6 +1,8 @@
 """runtime subpackage: host driver, tile manifest, stack loading."""
 
 from land_trendr_tpu.runtime.driver import (
+    Run,
+    RunCancelled,
     RunConfig,
     StallError,
     TileRetriesExhausted,
@@ -18,6 +20,8 @@ from land_trendr_tpu.runtime.stack import (
 )
 
 __all__ = [
+    "Run",
+    "RunCancelled",
     "RunConfig",
     "StallError",
     "TileRetriesExhausted",

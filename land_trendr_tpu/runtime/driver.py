@@ -66,6 +66,8 @@ from land_trendr_tpu.runtime.stack import RasterStack
 from land_trendr_tpu.utils.profiling import StageTimer
 
 __all__ = [
+    "Run",
+    "RunCancelled",
     "RunConfig",
     "StallError",
     "TileRetriesExhausted",
@@ -110,6 +112,13 @@ class TileRetriesExhausted(RuntimeError):
 class StallError(RuntimeError):
     """The stall watchdog aborted the run: no tile progress for
     ``RunConfig.stall_timeout_s`` (CLI exit code 4)."""
+
+
+class RunCancelled(RuntimeError):
+    """The run's cancel event was set (job cancel / job timeout in serve
+    mode): the run unwound through the normal abort path — every tile
+    recorded before the cancel stays durable, so the manifest is
+    resumable and a re-run completes exactly the remaining tiles."""
 
 
 class _StallWatchdog:
@@ -769,989 +778,1215 @@ def run_stack(
     Raster outputs are *not* written here — call :func:`assemble_outputs`
     after (or on a later resume; assembly only needs the workdir).
     """
-    if tiles is None:
-        tiles = plan_tiles(*stack.shape, cfg.tile_size)
-    tile_px = cfg.tile_size * cfg.tile_size
-    n_mesh = int(mesh.devices.size) if mesh is not None else 1
+    return Run(stack, cfg, tiles=tiles, mesh=mesh).execute()
 
-    # the feed-path decode subsystem (process-wide, like GDAL's block
-    # cache): decoded-block LRU + shared decode pool + readahead — pure
-    # acceleration of the windowed lazy feed, byte-identical either way.
-    # With ingest_store_mb the decoded blocks additionally spill to the
-    # persistent on-disk store, so a rerun over the same stacks skips
-    # TIFF decode entirely ("ingest once, serve many").
-    store = None
-    if cfg.ingest_store_mb:
-        from land_trendr_tpu.io.blockstore import BlockStore
 
-        store = BlockStore(
-            cfg.ingest_store_dir
-            or os.path.join(cfg.workdir, "ingest_store"),
-            budget_bytes=cfg.ingest_store_mb << 20,
+class Run:
+    """One segmentation run's explicit, per-run state.
+
+    ``run_stack`` used to keep every run-scoped object (manifest,
+    telemetry, fetcher/uploader, watchdog, quarantine ledger, stage
+    timer, ingest store, fault plan) as function locals — fine for the
+    one-shot CLI, fatal for a long-lived server where N runs must
+    coexist in one process.  This class makes the run scope explicit:
+
+    * **per-run** — manifest, telemetry (with an optional ``job_id``
+      threaded onto every event), fetcher/uploader, stall watchdog,
+      stage timer, quarantine ledger;
+    * **explicitly shared** — the process-wide decoded-block cache, an
+      optional ``shared_store`` (the server's persistent ingest store —
+      the run uses it but never closes it, and leaves the process cache
+      configuration to its owner), a ``programs``
+      :class:`~land_trendr_tpu.serve.programs.ProgramCache` (warm
+      compiled-program admission across runs), and the process-global
+      fault plan (a run only arms a plan when none is active; a
+      server-armed plan is used, never disarmed);
+    * **cancellable** — ``cancel`` (a ``threading.Event``) is polled at
+      every pipeline step boundary; once set the run raises
+      :class:`RunCancelled` and unwinds through the normal abort path,
+      so every tile already recorded stays durable and the manifest is
+      resumable.
+
+    ``run_stack`` remains the one-shot wrapper: construct + execute.
+    """
+
+    def __init__(
+        self,
+        stack: RasterStack,
+        cfg: RunConfig,
+        tiles: "Sequence[TileSpec] | None" = None,
+        mesh: "jax.sharding.Mesh | None" = None,
+        *,
+        job_id: "str | None" = None,
+        cancel: "threading.Event | None" = None,
+        programs=None,
+        shared_store=None,
+        shared_cache: bool = False,
+    ) -> None:
+        self.stack = stack
+        self.cfg = cfg
+        self.mesh = mesh
+        self.tiles = (
+            list(tiles) if tiles is not None
+            else plan_tiles(*stack.shape, cfg.tile_size)
         )
-    blockcache.configure(
-        budget_bytes=cfg.feed_cache_mb << 20,
-        workers=cfg.decode_workers,
-        store=store,
-    )
-    feed_cache_base = blockcache.stats_snapshot()
-    store_base = store.stats_snapshot() if store is not None else None
-
-    # validate the mesh configuration BEFORE touching the workdir, so a
-    # rejected run cannot stamp a fresh manifest with a bad context
-    if cfg.metrics_port and cfg.metrics_port + jax.process_count() - 1 > 65535:
-        # the per-process fan-out binds port + process_index; a
-        # near-ceiling base port must fail fast here, not as a bind
-        # OSError deep in a non-primary process minutes into the run
-        raise ValueError(
-            f"metrics_port={cfg.metrics_port}: port + process_index "
-            f"exceeds 65535 for a {jax.process_count()}-process run"
-        )
-    share = list(tiles)
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        from land_trendr_tpu.parallel import PIXEL_AXIS, host_share
-
-        # Tiles are the CROSS-HOST work unit (host_share below); the mesh
-        # shards one tile's pixels over this process's chips only.  A mesh
-        # spanning other processes' devices would make device_put treat
-        # each host's different tile as shards of one global array — a
-        # silent cross-host mix — so it is rejected outright.
-        me = jax.process_index()
-        if any(d.process_index != me for d in mesh.devices.flat):
+        self.job_id = job_id
+        self.cancel = cancel
+        self.programs = programs
+        self.shared_store = shared_store
+        #: True when the process-wide decoded-block cache is owned by the
+        #: caller (a server configures it ONCE at startup; per-run cache
+        #: knobs are then deliberately ignored).  Implied by
+        #: ``shared_store``.
+        self.shared_cache = bool(shared_cache or shared_store is not None)
+        if self.shared_cache and shared_store is None and cfg.ingest_store_mb:
+            # the run would build a store it can never attach (the cache
+            # configuration belongs to the caller): an explicit config
+            # conflict, not a silently-dead ingest
             raise ValueError(
-                "run_stack needs an ADDRESSABLE mesh — build it with "
-                "make_mesh(jax.local_devices()); tiles are distributed "
-                "across hosts by host_share, not by sharding one tile "
-                "over the pod"
+                "ingest_store_mb is set but the process cache is caller-"
+                "owned (shared_cache=True): pass the caller's store via "
+                "shared_store, or drop ingest_store_mb from this run's "
+                "config"
             )
-        # chunking a sharded pixel axis would reshard (lax.map reshapes),
-        # so the per-device slice itself must satisfy the HBM bound
-        if cfg.chunk_px is not None and tile_px / n_mesh > cfg.chunk_px:
+        # per-run state, populated by execute(); exposed so a serving
+        # layer can introspect a live or finished run
+        self.manifest: "TileManifest | None" = None
+        self.telemetry = None
+        self.fetcher = None
+        self.uploader = None
+        self.watchdog: "_StallWatchdog | None" = None
+        self.store = None
+        self.timer: "StageTimer | None" = None
+        self.quarantined: "list[int]" = []
+        self.fault_plan = None
+        self.program_stats: "dict | None" = None
+        self.summary: "dict | None" = None
+
+    def _check_cancel(self) -> None:
+        """Raise :class:`RunCancelled` once the cancel event is set.
+
+        Polled at pipeline step boundaries (tile loop, retry ladder), so
+        cancellation lands within about one tile's latency and unwinds
+        through the normal abort path — pending writes drain, recorded
+        tiles stay durable, the manifest stays resumable.
+        """
+        if self.cancel is not None and self.cancel.is_set():
+            raise RunCancelled(
+                "run cancelled"
+                + (f" (job {self.job_id})" if self.job_id else "")
+            )
+
+    def execute(self) -> dict:
+        """Run the tile pipeline; returns (and stores) the run summary."""
+        stack, cfg, mesh = self.stack, self.cfg, self.mesh
+        tiles = self.tiles
+        tile_px = cfg.tile_size * cfg.tile_size
+        n_mesh = int(mesh.devices.size) if mesh is not None else 1
+
+        # the feed-path decode subsystem (process-wide, like GDAL's block
+        # cache): decoded-block LRU + shared decode pool + readahead — pure
+        # acceleration of the windowed lazy feed, byte-identical either way.
+        # With ingest_store_mb the decoded blocks additionally spill to the
+        # persistent on-disk store, so a rerun over the same stacks skips
+        # TIFF decode entirely ("ingest once, serve many").  A serving
+        # layer instead passes its long-lived store via ``shared_store``:
+        # the run uses it but never closes it, and the store's owner (the
+        # server) owns the process-wide cache configuration too.
+        store = self.shared_store
+        owns_store = store is None and bool(cfg.ingest_store_mb)
+        if owns_store:
+            from land_trendr_tpu.io.blockstore import BlockStore
+
+            store = BlockStore(
+                cfg.ingest_store_dir
+                or os.path.join(cfg.workdir, "ingest_store"),
+                budget_bytes=cfg.ingest_store_mb << 20,
+            )
+        if not self.shared_cache:
+            blockcache.configure(
+                budget_bytes=cfg.feed_cache_mb << 20,
+                workers=cfg.decode_workers,
+                store=store,
+            )
+        self.store = store
+        feed_cache_base = blockcache.stats_snapshot()
+        store_base = store.stats_snapshot() if store is not None else None
+
+        # validate the mesh configuration BEFORE touching the workdir, so a
+        # rejected run cannot stamp a fresh manifest with a bad context
+        if cfg.metrics_port and cfg.metrics_port + jax.process_count() - 1 > 65535:
+            # the per-process fan-out binds port + process_index; a
+            # near-ceiling base port must fail fast here, not as a bind
+            # OSError deep in a non-primary process minutes into the run
             raise ValueError(
-                f"per-device pixel slice {tile_px // n_mesh} exceeds "
-                f"chunk_px={cfg.chunk_px}: reduce tile_size (or raise "
-                "chunk_px if the devices' HBM allows it) — chunking "
-                "cannot be combined with a sharded pixel axis"
+                f"metrics_port={cfg.metrics_port}: port + process_index "
+                f"exceeds 65535 for a {jax.process_count()}-process run"
             )
-        # Each process takes its share of the FULL deterministic tile list
-        # (identical on every process), THEN filters resume-done tiles.
-        # Sharing the post-resume list instead would race: processes that
-        # open the shared manifest at different times would partition
-        # different lists, leaving tiles in nobody's share.
-        share = host_share(share)
-        px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
-        # _feed_tile pads to feed_px with the QA fill bit, which also
-        # covers the divisibility the sharded pixel axis needs
-        feed_px = tile_px + (-tile_px) % n_mesh
-        chunk = None
-    else:
-        px_sharding = None
-        feed_px = tile_px
-        chunk = cfg.chunk_px
+        share = list(tiles)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-    impl_resolved = resolve_impl(cfg.impl)
-    fetch_packed = fetchmod.resolve_packed(cfg.fetch_packed)
-    upload_packed = feedmod.resolve_packed(cfg.upload_packed)
-    if mesh is not None and upload_packed:
-        if cfg.upload_packed is True:
-            # packed upload places ONE buffer; a sharded mesh needs the
-            # per-array NamedSharding placement loop — an explicit force
-            # is a config conflict, not something to silently drop
-            raise ValueError(
-                "upload_packed=True cannot be combined with a mesh "
-                "(sharded placement is per-array); use upload_packed="
-                "'auto' or False"
-            )
-        upload_packed = False
-    if (
-        impl_resolved == "pallas"
-        and chunk is not None
-        and chunk > PALLAS_BLOCK
-        and chunk % PALLAS_BLOCK
-    ):
-        raise ValueError(
-            f"chunk_px={chunk} must be a multiple of {PALLAS_BLOCK} (the "
-            "Pallas block) when the resolved impl is 'pallas' — adjust "
-            "chunk_px or pass impl='xla'"
-        )
-    manifest = TileManifest(
-        cfg.workdir,
-        cfg.fingerprint(stack),
-        context={"mesh_devices": n_mesh, "impl": impl_resolved},
-    )
-    done = manifest.open(cfg.resume)
-    years = stack.years.astype(np.int32)
-    bands = idx.required_bands(cfg.index, cfg.ftv_indices)
-    todo = [t for t in share if t.tile_id not in done]
-    n_resume_skipped = len(share) - len(todo)
+            from land_trendr_tpu.parallel import PIXEL_AXIS, host_share
 
-    t_run = time.perf_counter()
-    timer = StageTimer()
-
-    # robustness state: the quarantine ledger, the packed-fetch failure
-    # counter behind graceful demotion, and the stall watchdog (created
-    # after telemetry so its stall event has somewhere to go)
-    quarantined: list[int] = []
-    fetch_failures = 0
-    upload_failures = 0
-    watchdog: "_StallWatchdog | None" = None
-
-    def _backoff(attempt: int) -> None:
-        """Exponential backoff + jitter before re-dispatching a failed
-        tile: immediate retry hammers a sick device with the exact work
-        that just killed it.  Jitter (±50%) keeps a pod's hosts from
-        retrying in lockstep against a shared sick filesystem."""
-        if cfg.retry_backoff_s <= 0:
-            return
-        delay = cfg.retry_backoff_s * 2 ** (attempt - 1) * (0.5 + random.random())
-        # cap AFTER jitter: the 30s ceiling is documented as a hard bound
-        # (operators size stall_timeout_s against it)
-        time.sleep(min(delay, _BACKOFF_CAP_S))
-
-    def _note_fetch_failure() -> None:
-        """Count one fetch-wait failure; demote the packed path once the
-        run has seen ``_FETCH_DEMOTE_AFTER`` CONSECUTIVE ones (the
-        per-product sync path produces byte-identical artifacts, so
-        demotion costs throughput, never correctness).  Consecutive, not
-        cumulative: a compute fault XLA defers to the async wait, or a
-        transient blip recovered hours ago, must not push a 10k-tile run
-        over the threshold — a sick link fails back to back."""
-        nonlocal fetch_failures
-        fetch_failures += 1
-        if fetch_failures >= _FETCH_DEMOTE_AFTER and fetcher.packed:
-            fetcher.demote()
-            log.warning(
-                "packed fetch demoted to per-product sync transfers after "
-                "%d consecutive fetch failures (artifacts unaffected)",
-                fetch_failures,
-            )
-            if telemetry is not None:
-                telemetry.fetch_demoted(fetch_failures)
-
-    def _note_fetch_ok() -> None:
-        """A landed fetch resets the consecutive-failure streak."""
-        nonlocal fetch_failures
-        fetch_failures = 0
-
-    def _note_upload_failure() -> None:
-        """The upload mirror of :func:`_note_fetch_failure`: demote the
-        packed host→device path to per-array sync dispatch after
-        ``_UPLOAD_DEMOTE_AFTER`` CONSECUTIVE upload-wait failures (the
-        per-array path produces byte-identical artifacts, so demotion
-        costs throughput, never correctness)."""
-        nonlocal upload_failures
-        upload_failures += 1
-        if upload_failures >= _UPLOAD_DEMOTE_AFTER and uploader.packed:
-            uploader.demote()
-            log.warning(
-                "packed upload demoted to per-array sync dispatch after "
-                "%d consecutive upload failures (artifacts unaffected)",
-                upload_failures,
-            )
-            if telemetry is not None:
-                telemetry.upload_demoted(upload_failures)
-
-    def _note_upload_ok() -> None:
-        """A landed upload resets the consecutive-failure streak."""
-        nonlocal upload_failures
-        upload_failures = 0
-
-    def _retry_step(t: TileSpec, attempt: int, err, what: str = "") -> int:
-        """One failed attempt's shared bookkeeping — the single copy of
-        the retry contract for the ladder, the feed retry, and the
-        writer-path fetch retry: log, exhaustion check (``tile_failed``
-        emit + :class:`TileRetriesExhausted`), ``tile_retry`` emit,
-        watchdog tick, exponential backoff.  Returns the next attempt
-        number."""
-        log.warning(
-            "tile %d %sattempt %d/%d failed: %s",
-            t.tile_id, what, attempt, cfg.max_retries + 1, err,
-        )
-        if attempt > cfg.max_retries:
-            if telemetry is not None:
-                telemetry.tile_failed(t.tile_id, attempt, err)
-            exc = TileRetriesExhausted(t.tile_id, attempt, err)
-            exc.__cause__ = err
-            raise exc
-        if telemetry is not None:
-            telemetry.tile_retry(t.tile_id, attempt, err)
-        if watchdog is not None:
-            watchdog.tick()  # retrying is progress, not a stall
-        _backoff(attempt)
-        return attempt + 1
-
-    def _quarantine(t: TileSpec, exc: TileRetriesExhausted) -> None:
-        """Record an exhausted tile and keep going — or re-raise when
-        quarantine mode is off (the pre-PR abort semantics)."""
-        if not cfg.quarantine_tiles:
-            raise exc
-        quarantined.append(t.tile_id)
-        manifest.record_failed(t.tile_id, exc.attempts, str(exc.cause))
-        if telemetry is not None:
-            telemetry.tile_quarantined(t.tile_id, exc.attempts, str(exc.cause))
-        log.error(
-            "tile %d quarantined after %d attempts (%s); run continues — "
-            "resume will re-attempt it", t.tile_id, exc.attempts, exc.cause,
-        )
-
-    def _dispatch(dn, qa):
-        """Async-dispatch one tile; returns ``(out, None)`` or ``(None, exc)``."""
-        try:
-            with timer.stage("dispatch"):
-                faults.check("dispatch")
-                if px_sharding is not None:
-                    dn = {
-                        k: jax.device_put(v, px_sharding) for k, v in dn.items()
-                    }
-                    qa = jax.device_put(qa, px_sharding)
-                return (
-                    process_tile_dn(
-                        years,
-                        dn,
-                        qa,
-                        index=cfg.index,
-                        ftv_indices=cfg.ftv_indices,
-                        params=cfg.params,
-                        scale=cfg.scale,
-                        offset=cfg.offset,
-                        reject_bits=cfg.reject_bits,
-                        chunk=chunk,
-                        change_filt=cfg.change_filt,
-                        impl=impl_resolved,
-                    ),
-                    None,
+            # Tiles are the CROSS-HOST work unit (host_share below); the mesh
+            # shards one tile's pixels over this process's chips only.  A mesh
+            # spanning other processes' devices would make device_put treat
+            # each host's different tile as shards of one global array — a
+            # silent cross-host mix — so it is rejected outright.
+            me = jax.process_index()
+            if any(d.process_index != me for d in mesh.devices.flat):
+                raise ValueError(
+                    "run_stack needs an ADDRESSABLE mesh — build it with "
+                    "make_mesh(jax.local_devices()); tiles are distributed "
+                    "across hosts by host_share, not by sharding one tile "
+                    "over the pod"
                 )
-        except Exception as e:  # exercised via fault-injection tests
-            return None, e
+            # chunking a sharded pixel axis would reshard (lax.map reshapes),
+            # so the per-device slice itself must satisfy the HBM bound
+            if cfg.chunk_px is not None and tile_px / n_mesh > cfg.chunk_px:
+                raise ValueError(
+                    f"per-device pixel slice {tile_px // n_mesh} exceeds "
+                    f"chunk_px={cfg.chunk_px}: reduce tile_size (or raise "
+                    "chunk_px if the devices' HBM allows it) — chunking "
+                    "cannot be combined with a sharded pixel axis"
+                )
+            # Each process takes its share of the FULL deterministic tile list
+            # (identical on every process), THEN filters resume-done tiles.
+            # Sharing the post-resume list instead would race: processes that
+            # open the shared manifest at different times would partition
+            # different lists, leaving tiles in nobody's share.
+            share = host_share(share)
+            px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
+            # _feed_tile pads to feed_px with the QA fill bit, which also
+            # covers the divisibility the sharded pixel axis needs
+            feed_px = tile_px + (-tile_px) % n_mesh
+            chunk = None
+        else:
+            px_sharding = None
+            feed_px = tile_px
+            chunk = cfg.chunk_px
 
-    # the fetch subsystem (runtime/fetch.py): packed mode moves every
-    # tile's products in ONE device→host transfer issued asynchronously
-    # right after the tile's compute completes, so readback of tile i
-    # overlaps compute of tile i+1; unpacked mode is the per-product
-    # synchronous path, byte-identical artifacts either way
-    fetcher = fetchmod.TileFetcher(cfg, packed=fetch_packed)
-    # its upload mirror (runtime/feed.py): packed mode moves every fed
-    # tile's band/QA arrays in ONE host→device transfer issued as soon
-    # as the feed completes, so tile i+1's upload crosses the link while
-    # tile i computes; sync mode is the per-array dispatch placement,
-    # byte-identical artifacts either way
-    uploader = feedmod.TileUploader(cfg, packed=upload_packed)
+        impl_resolved = resolve_impl(cfg.impl)
+        fetch_packed = fetchmod.resolve_packed(cfg.fetch_packed)
+        upload_packed = feedmod.resolve_packed(cfg.upload_packed)
+        if mesh is not None and upload_packed:
+            if cfg.upload_packed is True:
+                # packed upload places ONE buffer; a sharded mesh needs the
+                # per-array NamedSharding placement loop — an explicit force
+                # is a config conflict, not something to silently drop
+                raise ValueError(
+                    "upload_packed=True cannot be combined with a mesh "
+                    "(sharded placement is per-array); use upload_packed="
+                    "'auto' or False"
+                )
+            upload_packed = False
+        if (
+            impl_resolved == "pallas"
+            and chunk is not None
+            and chunk > PALLAS_BLOCK
+            and chunk % PALLAS_BLOCK
+        ):
+            raise ValueError(
+                f"chunk_px={chunk} must be a multiple of {PALLAS_BLOCK} (the "
+                "Pallas block) when the resolved impl is 'pallas' — adjust "
+                "chunk_px or pass impl='xla'"
+            )
+        manifest = self.manifest = TileManifest(
+            cfg.workdir,
+            cfg.fingerprint(stack),
+            context={"mesh_devices": n_mesh, "impl": impl_resolved},
+        )
+        done = manifest.open(cfg.resume)
+        years = stack.years.astype(np.int32)
+        bands = idx.required_bands(cfg.index, cfg.ftv_indices)
+        todo = [t for t in share if t.tile_id not in done]
+        n_resume_skipped = len(share) - len(todo)
 
-    def _write_job(t: TileSpec, handle, dt: float) -> tuple[int, int]:
-        # StageTimer accumulation is locked, so concurrent writer threads
-        # may share the "write" key; with write_workers > 1 the summed
-        # write_s can legitimately exceed wall time.
-        with timer.stage("write"):
-            # packed: pure host unpack of already-landed bytes; unpacked:
-            # the per-product synchronous fetch (the pre-packing path).
-            # Either way model_valid rides the same payload, so the
-            # fit-rate metadata never costs a separate blocking device
-            # fetch (review r5 finding: --products without model_valid
-            # crashed every tile write; its fix cost one extra transfer
-            # per tile, now folded away).
-            # The per-product handle re-fetches from its retained device
-            # outputs, so a transient fetch fault HERE (the demoted /
-            # fallback path, where transfers run in writer threads) gets
-            # the same retry budget as the ladder instead of aborting the
-            # run; persistent failure still fails fast via the writer's
-            # backpressure collection.
+        t_run = time.perf_counter()
+        timer = self.timer = StageTimer()
+
+        # robustness state: the quarantine ledger, the packed-fetch failure
+        # counter behind graceful demotion, and the stall watchdog (created
+        # after telemetry so its stall event has somewhere to go)
+        quarantined = self.quarantined
+        fetch_failures = 0
+        upload_failures = 0
+        watchdog: "_StallWatchdog | None" = None
+
+        def _backoff(attempt: int) -> None:
+            """Exponential backoff + jitter before re-dispatching a failed
+            tile: immediate retry hammers a sick device with the exact work
+            that just killed it.  Jitter (±50%) keeps a pod's hosts from
+            retrying in lockstep against a shared sick filesystem."""
+            if cfg.retry_backoff_s <= 0:
+                return
+            delay = cfg.retry_backoff_s * 2 ** (attempt - 1) * (0.5 + random.random())
+            # cap AFTER jitter: the 30s ceiling is documented as a hard bound
+            # (operators size stall_timeout_s against it)
+            time.sleep(min(delay, _BACKOFF_CAP_S))
+
+        def _note_fetch_failure() -> None:
+            """Count one fetch-wait failure; demote the packed path once the
+            run has seen ``_FETCH_DEMOTE_AFTER`` CONSECUTIVE ones (the
+            per-product sync path produces byte-identical artifacts, so
+            demotion costs throughput, never correctness).  Consecutive, not
+            cumulative: a compute fault XLA defers to the async wait, or a
+            transient blip recovered hours ago, must not push a 10k-tile run
+            over the threshold — a sick link fails back to back."""
+            nonlocal fetch_failures
+            fetch_failures += 1
+            if fetch_failures >= _FETCH_DEMOTE_AFTER and fetcher.packed:
+                fetcher.demote()
+                log.warning(
+                    "packed fetch demoted to per-product sync transfers after "
+                    "%d consecutive fetch failures (artifacts unaffected)",
+                    fetch_failures,
+                )
+                if telemetry is not None:
+                    telemetry.fetch_demoted(fetch_failures)
+
+        def _note_fetch_ok() -> None:
+            """A landed fetch resets the consecutive-failure streak."""
+            nonlocal fetch_failures
+            fetch_failures = 0
+
+        def _note_upload_failure() -> None:
+            """The upload mirror of :func:`_note_fetch_failure`: demote the
+            packed host→device path to per-array sync dispatch after
+            ``_UPLOAD_DEMOTE_AFTER`` CONSECUTIVE upload-wait failures (the
+            per-array path produces byte-identical artifacts, so demotion
+            costs throughput, never correctness)."""
+            nonlocal upload_failures
+            upload_failures += 1
+            if upload_failures >= _UPLOAD_DEMOTE_AFTER and uploader.packed:
+                uploader.demote()
+                log.warning(
+                    "packed upload demoted to per-array sync dispatch after "
+                    "%d consecutive upload failures (artifacts unaffected)",
+                    upload_failures,
+                )
+                if telemetry is not None:
+                    telemetry.upload_demoted(upload_failures)
+
+        def _note_upload_ok() -> None:
+            """A landed upload resets the consecutive-failure streak."""
+            nonlocal upload_failures
+            upload_failures = 0
+
+        def _retry_step(t: TileSpec, attempt: int, err, what: str = "") -> int:
+            """One failed attempt's shared bookkeeping — the single copy of
+            the retry contract for the ladder, the feed retry, and the
+            writer-path fetch retry: log, exhaustion check (``tile_failed``
+            emit + :class:`TileRetriesExhausted`), ``tile_retry`` emit,
+            watchdog tick, exponential backoff.  Returns the next attempt
+            number."""
+            # a cancelled job must not keep burning its backoff ladder —
+            # checked here so cancellation also lands mid-retry
+            self._check_cancel()
+            log.warning(
+                "tile %d %sattempt %d/%d failed: %s",
+                t.tile_id, what, attempt, cfg.max_retries + 1, err,
+            )
+            if attempt > cfg.max_retries:
+                if telemetry is not None:
+                    telemetry.tile_failed(t.tile_id, attempt, err)
+                exc = TileRetriesExhausted(t.tile_id, attempt, err)
+                exc.__cause__ = err
+                raise exc
+            if telemetry is not None:
+                telemetry.tile_retry(t.tile_id, attempt, err)
+            if watchdog is not None:
+                watchdog.tick()  # retrying is progress, not a stall
+            _backoff(attempt)
+            return attempt + 1
+
+        def _quarantine(t: TileSpec, exc: TileRetriesExhausted) -> None:
+            """Record an exhausted tile and keep going — or re-raise when
+            quarantine mode is off (the pre-PR abort semantics)."""
+            if not cfg.quarantine_tiles:
+                raise exc
+            quarantined.append(t.tile_id)
+            manifest.record_failed(t.tile_id, exc.attempts, str(exc.cause))
+            if telemetry is not None:
+                telemetry.tile_quarantined(t.tile_id, exc.attempts, str(exc.cause))
+            log.error(
+                "tile %d quarantined after %d attempts (%s); run continues — "
+                "resume will re-attempt it", t.tile_id, exc.attempts, exc.cause,
+            )
+
+        def _dispatch(dn, qa):
+            """Async-dispatch one tile; returns ``(out, None)`` or ``(None, exc)``."""
+            try:
+                with timer.stage("dispatch"):
+                    faults.check("dispatch")
+                    if px_sharding is not None:
+                        dn = {
+                            k: jax.device_put(v, px_sharding) for k, v in dn.items()
+                        }
+                        qa = jax.device_put(qa, px_sharding)
+                    return (
+                        process_tile_dn(
+                            years,
+                            dn,
+                            qa,
+                            index=cfg.index,
+                            ftv_indices=cfg.ftv_indices,
+                            params=cfg.params,
+                            scale=cfg.scale,
+                            offset=cfg.offset,
+                            reject_bits=cfg.reject_bits,
+                            chunk=chunk,
+                            change_filt=cfg.change_filt,
+                            impl=impl_resolved,
+                        ),
+                        None,
+                    )
+            except Exception as e:  # exercised via fault-injection tests
+                return None, e
+
+        # the fetch subsystem (runtime/fetch.py): packed mode moves every
+        # tile's products in ONE device→host transfer issued asynchronously
+        # right after the tile's compute completes, so readback of tile i
+        # overlaps compute of tile i+1; unpacked mode is the per-product
+        # synchronous path, byte-identical artifacts either way
+        fetcher = self.fetcher = fetchmod.TileFetcher(cfg, packed=fetch_packed)
+        # its upload mirror (runtime/feed.py): packed mode moves every fed
+        # tile's band/QA arrays in ONE host→device transfer issued as soon
+        # as the feed completes, so tile i+1's upload crosses the link while
+        # tile i computes; sync mode is the per-array dispatch placement,
+        # byte-identical artifacts either way
+        uploader = self.uploader = feedmod.TileUploader(cfg, packed=upload_packed)
+
+        def _write_job(t: TileSpec, handle, dt: float) -> tuple[int, int]:
+            # StageTimer accumulation is locked, so concurrent writer threads
+            # may share the "write" key; with write_workers > 1 the summed
+            # write_s can legitimately exceed wall time.
+            with timer.stage("write"):
+                # packed: pure host unpack of already-landed bytes; unpacked:
+                # the per-product synchronous fetch (the pre-packing path).
+                # Either way model_valid rides the same payload, so the
+                # fit-rate metadata never costs a separate blocking device
+                # fetch (review r5 finding: --products without model_valid
+                # crashed every tile write; its fix cost one extra transfer
+                # per tile, now folded away).
+                # The per-product handle re-fetches from its retained device
+                # outputs, so a transient fetch fault HERE (the demoted /
+                # fallback path, where transfers run in writer threads) gets
+                # the same retry budget as the ladder instead of aborting the
+                # run; persistent failure still fails fast via the writer's
+                # backpressure collection.
+                attempt = 1
+                while True:
+                    try:
+                        arrays, fit = handle.tile_arrays(t)
+                        break
+                    except Exception as e:
+                        try:
+                            attempt = _retry_step(
+                                t, attempt, e, what="writer-fetch "
+                            )
+                        except TileRetriesExhausted as exc:
+                            # same quarantine contract as the ladder (one bad
+                            # tile never costs the other 10k — also on the
+                            # per-product / post-demotion path): record +
+                            # skip, or re-raise through the writer future →
+                            # _collect_write → run abort → CLI exit 3
+                            _quarantine(t, exc)
+                            return 0, 0
+                px = t.h * t.w
+                meta = {
+                    "y0": t.y0,
+                    "x0": t.x0,
+                    "h": t.h,
+                    "w": t.w,
+                    # dispatch + result-wait wall time: device compute + any
+                    # transfer stalls; host work overlapped by the pipeline is
+                    # excluded (an estimate, not a device-profile number)
+                    "px_per_s": round(tile_px / dt, 1),
+                    "no_fit_rate": round(1.0 - fit / px, 4),
+                }
+                manifest.record(
+                    t.tile_id, arrays, meta, compress=cfg.manifest_compress
+                )
+            log.info(
+                "tile %d (%d,%d %dx%d): %.2fM px/s, no-fit %.1f%%",
+                t.tile_id, t.y0, t.x0, t.h, t.w,
+                meta["px_per_s"] / 1e6, 100 * meta["no_fit_rate"],
+            )
+            return px, fit
+
+        writer = ThreadPoolExecutor(
+            max_workers=cfg.write_workers, thread_name_prefix="lt-writer"
+        )
+        pending_writes: deque = deque()  # bounded at write_workers in flight
+        pending_fetches: deque = deque()  # bounded at fetch_depth in flight
+        n_px = 0
+        n_fit = 0
+        n_done = 0
+
+        def _collect_write(fut) -> None:
+            """Backpressure + fail-fast: re-raises writer errors at the next tile."""
+            nonlocal n_px, n_fit
+            px, fit = fut.result()
+            if watchdog is not None:
+                watchdog.tick()
+            n_px += px
+            n_fit += fit
+
+        def _drain_writes(limit: int) -> None:
+            """Collect oldest write jobs until at most ``limit`` stay in flight."""
+            while len(pending_writes) > limit:
+                _collect_write(pending_writes.popleft())
+
+        def _submit_write(t: TileSpec, handle, dt: float) -> None:
+            _drain_writes(cfg.write_workers - 1)
+            pending_writes.append(writer.submit(_write_job, t, handle, dt))
+
+        def _retry_ladder(t: TileSpec, dn, qa, attempt: int, err):
+            """Synchronous tile retry from the retained inputs.
+
+            Shared by ``_finish`` (dispatch / device-wait / pack failures) and
+            ``_drain_fetches`` (a device error surfacing through an in-flight
+            async fetch): re-dispatches until the tile completes THROUGH a
+            landed fetch — the fault already broke the pipeline, so the
+            re-fetch is resolved synchronously before pipelining resumes.
+            Attempts are spaced by :func:`_backoff` (exponential + jitter) so
+            a sick device is not re-hammered immediately.  Returns
+            ``(handle, dt, attempt)`` or raises :class:`TileRetriesExhausted`
+            after ``max_retries``.
+            """
+            while True:
+                attempt = _retry_step(t, attempt, err)  # raises at exhaustion
+                if telemetry is not None:
+                    telemetry.tile_start(t.tile_id, attempt=attempt)
+                t0 = time.perf_counter()
+                out, err = _dispatch(dn, qa)
+                if err is not None:
+                    continue
+                try:
+                    with timer.stage("compute"):
+                        faults.check("compute.wait")
+                        # the retry ladder's sanctioned compute-wait: the fault
+                        # already broke the pipeline, nothing left to overlap
+                        jax.block_until_ready(out)  # lt: noqa[LT002]
+                    dt = time.perf_counter() - t0
+                except Exception as e:  # device-side failure surfaces here
+                    err = e
+                    continue
+                try:
+                    with timer.stage("fetch"):
+                        handle = fetcher.start(out)
+                        handle.wait()
+                    _note_fetch_ok()
+                    return handle, dt, attempt
+                except Exception as e:  # transfer failure: counts toward
+                    _note_fetch_failure()  # packed-path demotion
+                    err = e
+
+        def _tile_completed(t: TileSpec, dt: float) -> None:
+            """Emit tile_done and count the tile.
+
+            On the packed path this fires only once the async fetch has
+            LANDED — a tile whose fetch later exhausts its retries appears in
+            the stream as a failure only, never as done-then-failed.  The
+            per-product fallback keeps its historical semantics: tile_done at
+            compute completion, with the synchronous fetches in the write job
+            behind it — so on THAT path a quarantined writer-fetch tile shows
+            tile_done followed by tile_quarantined (done = device result
+            completed; ``write_done`` remains the stream's only durability
+            signal), and a non-quarantine error aborts the run via the
+            writer's fail-fast, exactly as before this subsystem existed."""
+            nonlocal n_done
+            n_done += 1
+            if watchdog is not None:
+                watchdog.tick()
+            if telemetry is not None:
+                telemetry.tile_done(
+                    t.tile_id,
+                    t.h * t.w,
+                    dt,
+                    feed_backlog=len(pending_feeds),
+                    write_backlog=len(pending_writes),
+                    device_bytes_in_use=_device_live_bytes(),
+                    fetch_backlog=len(pending_fetches),
+                )
+
+        def _drain_fetches(limit: int) -> None:
+            """Collect oldest in-flight fetches until at most ``limit`` remain.
+
+            The wait here is where the packed transfer's landing is awaited —
+            overlapped with the newer tiles' compute already dispatched behind
+            it.  A device error surfacing through the async fetch re-enters
+            the retry ladder; the fed inputs ride the backlog entry for
+            exactly that.  Landed tiles hand off to the writer pool.
+            """
+            while len(pending_fetches) > limit:
+                t, handle, dn, qa, dt, attempt = pending_fetches.popleft()
+                try:
+                    with timer.stage("fetch"):
+                        handle.wait()
+                    _note_fetch_ok()
+                except Exception as err:
+                    _note_fetch_failure()
+                    try:
+                        handle, dt, attempt = _retry_ladder(
+                            t, dn, qa, attempt, err
+                        )
+                    except TileRetriesExhausted as e:
+                        _quarantine(t, e)
+                        continue
+                _tile_completed(t, dt)
+                _submit_write(t, handle, dt)
+
+        def _finish(pending) -> None:
+            """Await one in-flight tile (retrying on failure), issue its async
+            fetch, and queue writes as the bounded fetch backlog drains.  The
+            pending tuple's attempt is > 1 when the tile's FEED already spent
+            retries — one budget per tile across phases."""
+            t, out, err, dn, qa, dt_dispatch, attempt = pending
+            handle = None
+            if err is None:
+                try:
+                    t0 = time.perf_counter()
+                    with timer.stage("compute"):
+                        faults.check("compute.wait")
+                        # THE sanctioned compute-wait of the pipeline (tile
+                        # i+1 is already dispatched behind it)
+                        jax.block_until_ready(out)  # lt: noqa[LT002]
+                    dt = dt_dispatch + (time.perf_counter() - t0)
+                    if watchdog is not None:
+                        watchdog.tick()
+                    with timer.stage("fetch"):
+                        # async: the packed buffer lands while the next tiles
+                        # compute; the per-product fallback defers its
+                        # (synchronous) transfers to the writer pool instead
+                        handle = fetcher.start(out)
+                except Exception as e:  # device-side failure surfaces here
+                    err = e
+            if err is not None:
+                try:
+                    handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
+                except TileRetriesExhausted as e:
+                    _quarantine(t, e)
+                    return
+            if not fetcher.packed:
+                # per-product fallback: the pre-packing flow exactly — the
+                # write job runs the synchronous fetches itself, nothing to
+                # overlap, no retained inputs beyond this call
+                _tile_completed(t, dt)
+                _submit_write(t, handle, dt)
+                return
+            # the retained (dn, qa) ride the backlog for the retry ladder: a
+            # device error surfacing through the in-flight fetch re-dispatches
+            # from them.  Bounded at fetch_depth entries.
+            pending_fetches.append((t, handle, dn, qa, dt, attempt))
+            fetcher.note_backlog(len(pending_fetches))
+            _drain_fetches(cfg.fetch_depth - 1)
+
+        # feed pool, mirroring the writer pool on the input side (VERDICT r3
+        # next-round item #3): ``cfg.feed_workers`` threads run the native
+        # gather for UPCOMING tiles while the current tile computes, keeping a
+        # bounded prefetch queue of ``feed_workers + 1`` fed tiles.  The
+        # native gather releases the GIL (threaded C++), so workers scale to
+        # real cores; HOSTPATH_r03.json's budget (4.1M px/s/core ⇒ ~2.4 cores
+        # at the 10M px/s north star) becomes ``feed_workers=3``.  Like
+        # ``write_s``, overlapped ``feed_s`` can exceed wall time.  Host
+        # memory stays bounded: at most ``feed_workers + 1`` fed inputs plus
+        # ``write_workers + 2`` finished tiles are live at once.
+        feeder = ThreadPoolExecutor(
+            max_workers=cfg.feed_workers, thread_name_prefix="lt-feeder"
+        )
+        pending_feeds: deque = deque()  # (tile, future), consumed in order
+
+        def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
+            with timer.stage("feed"):
+                faults.check("feed")  # injection seam: transient feed I/O
+                fed = _feed_tile(stack, t, feed_px, bands)
+            if readahead is not None:
+                # fire-and-forget: hint the next PLANNED tile (one past the
+                # feed queue) so its block decode overlaps the current tiles'
+                # device wait — lazy file-backed cubes only; eager ndarray
+                # stacks have no compressed blocks to prefetch
+                _prefetch_tile(stack, readahead, bands)
+            return fed
+
+        def _refeed(t: TileSpec, err: BaseException):
+            """Synchronous feed retry: a transient stack-read error (NFS blip,
+            decode hiccup) re-enters the same per-tile retry budget as device
+            faults instead of aborting the whole run.  Returns ``(dn, qa,
+            attempt)`` — the attempt number the tile continues from, so its
+            ``tile_start`` and any later dispatch retries share ONE per-tile
+            budget — or ``None`` when the tile was quarantined; an exhausted
+            budget raises :class:`TileRetriesExhausted` (chaining the original
+            feed error) exactly like the device-fault ladder, so the CLI's
+            exit-3 contract covers every per-tile failure class.
+            """
             attempt = 1
             while True:
                 try:
-                    arrays, fit = handle.tile_arrays(t)
-                    break
-                except Exception as e:
-                    try:
-                        attempt = _retry_step(
-                            t, attempt, e, what="writer-fetch "
-                        )
-                    except TileRetriesExhausted as exc:
-                        # same quarantine contract as the ladder (one bad
-                        # tile never costs the other 10k — also on the
-                        # per-product / post-demotion path): record +
-                        # skip, or re-raise through the writer future →
-                        # _collect_write → run abort → CLI exit 3
-                        _quarantine(t, exc)
-                        return 0, 0
-            px = t.h * t.w
-            meta = {
-                "y0": t.y0,
-                "x0": t.x0,
-                "h": t.h,
-                "w": t.w,
-                # dispatch + result-wait wall time: device compute + any
-                # transfer stalls; host work overlapped by the pipeline is
-                # excluded (an estimate, not a device-profile number)
-                "px_per_s": round(tile_px / dt, 1),
-                "no_fit_rate": round(1.0 - fit / px, 4),
-            }
-            manifest.record(
-                t.tile_id, arrays, meta, compress=cfg.manifest_compress
-            )
-        log.info(
-            "tile %d (%d,%d %dx%d): %.2fM px/s, no-fit %.1f%%",
-            t.tile_id, t.y0, t.x0, t.h, t.w,
-            meta["px_per_s"] / 1e6, 100 * meta["no_fit_rate"],
-        )
-        return px, fit
-
-    writer = ThreadPoolExecutor(
-        max_workers=cfg.write_workers, thread_name_prefix="lt-writer"
-    )
-    pending_writes: deque = deque()  # bounded at write_workers in flight
-    pending_fetches: deque = deque()  # bounded at fetch_depth in flight
-    n_px = 0
-    n_fit = 0
-    n_done = 0
-
-    def _collect_write(fut) -> None:
-        """Backpressure + fail-fast: re-raises writer errors at the next tile."""
-        nonlocal n_px, n_fit
-        px, fit = fut.result()
-        if watchdog is not None:
-            watchdog.tick()
-        n_px += px
-        n_fit += fit
-
-    def _drain_writes(limit: int) -> None:
-        """Collect oldest write jobs until at most ``limit`` stay in flight."""
-        while len(pending_writes) > limit:
-            _collect_write(pending_writes.popleft())
-
-    def _submit_write(t: TileSpec, handle, dt: float) -> None:
-        _drain_writes(cfg.write_workers - 1)
-        pending_writes.append(writer.submit(_write_job, t, handle, dt))
-
-    def _retry_ladder(t: TileSpec, dn, qa, attempt: int, err):
-        """Synchronous tile retry from the retained inputs.
-
-        Shared by ``_finish`` (dispatch / device-wait / pack failures) and
-        ``_drain_fetches`` (a device error surfacing through an in-flight
-        async fetch): re-dispatches until the tile completes THROUGH a
-        landed fetch — the fault already broke the pipeline, so the
-        re-fetch is resolved synchronously before pipelining resumes.
-        Attempts are spaced by :func:`_backoff` (exponential + jitter) so
-        a sick device is not re-hammered immediately.  Returns
-        ``(handle, dt, attempt)`` or raises :class:`TileRetriesExhausted`
-        after ``max_retries``.
-        """
-        while True:
-            attempt = _retry_step(t, attempt, err)  # raises at exhaustion
-            if telemetry is not None:
-                telemetry.tile_start(t.tile_id, attempt=attempt)
-            t0 = time.perf_counter()
-            out, err = _dispatch(dn, qa)
-            if err is not None:
-                continue
-            try:
-                with timer.stage("compute"):
-                    faults.check("compute.wait")
-                    # the retry ladder's sanctioned compute-wait: the fault
-                    # already broke the pipeline, nothing left to overlap
-                    jax.block_until_ready(out)  # lt: noqa[LT002]
-                dt = time.perf_counter() - t0
-            except Exception as e:  # device-side failure surfaces here
-                err = e
-                continue
-            try:
-                with timer.stage("fetch"):
-                    handle = fetcher.start(out)
-                    handle.wait()
-                _note_fetch_ok()
-                return handle, dt, attempt
-            except Exception as e:  # transfer failure: counts toward
-                _note_fetch_failure()  # packed-path demotion
-                err = e
-
-    def _tile_completed(t: TileSpec, dt: float) -> None:
-        """Emit tile_done and count the tile.
-
-        On the packed path this fires only once the async fetch has
-        LANDED — a tile whose fetch later exhausts its retries appears in
-        the stream as a failure only, never as done-then-failed.  The
-        per-product fallback keeps its historical semantics: tile_done at
-        compute completion, with the synchronous fetches in the write job
-        behind it — so on THAT path a quarantined writer-fetch tile shows
-        tile_done followed by tile_quarantined (done = device result
-        completed; ``write_done`` remains the stream's only durability
-        signal), and a non-quarantine error aborts the run via the
-        writer's fail-fast, exactly as before this subsystem existed."""
-        nonlocal n_done
-        n_done += 1
-        if watchdog is not None:
-            watchdog.tick()
-        if telemetry is not None:
-            telemetry.tile_done(
-                t.tile_id,
-                t.h * t.w,
-                dt,
-                feed_backlog=len(pending_feeds),
-                write_backlog=len(pending_writes),
-                device_bytes_in_use=_device_live_bytes(),
-                fetch_backlog=len(pending_fetches),
-            )
-
-    def _drain_fetches(limit: int) -> None:
-        """Collect oldest in-flight fetches until at most ``limit`` remain.
-
-        The wait here is where the packed transfer's landing is awaited —
-        overlapped with the newer tiles' compute already dispatched behind
-        it.  A device error surfacing through the async fetch re-enters
-        the retry ladder; the fed inputs ride the backlog entry for
-        exactly that.  Landed tiles hand off to the writer pool.
-        """
-        while len(pending_fetches) > limit:
-            t, handle, dn, qa, dt, attempt = pending_fetches.popleft()
-            try:
-                with timer.stage("fetch"):
-                    handle.wait()
-                _note_fetch_ok()
-            except Exception as err:
-                _note_fetch_failure()
+                    attempt = _retry_step(t, attempt, err, what="feed ")
+                except TileRetriesExhausted as exc:
+                    _quarantine(t, exc)
+                    return None
                 try:
-                    handle, dt, attempt = _retry_ladder(
-                        t, dn, qa, attempt, err
-                    )
-                except TileRetriesExhausted as e:
-                    _quarantine(t, e)
-                    continue
-            _tile_completed(t, dt)
-            _submit_write(t, handle, dt)
+                    return (*_feed_job(t), attempt)
+                except Exception as e:
+                    err = e
 
-    def _finish(pending) -> None:
-        """Await one in-flight tile (retrying on failure), issue its async
-        fetch, and queue writes as the bounded fetch backlog drains.  The
-        pending tuple's attempt is > 1 when the tile's FEED already spent
-        retries — one budget per tile across phases."""
-        t, out, err, dn, qa, dt_dispatch, attempt = pending
-        handle = None
-        if err is None:
-            try:
-                t0 = time.perf_counter()
-                with timer.stage("compute"):
-                    faults.check("compute.wait")
-                    # THE sanctioned compute-wait of the pipeline (tile
-                    # i+1 is already dispatched behind it)
-                    jax.block_until_ready(out)  # lt: noqa[LT002]
-                dt = dt_dispatch + (time.perf_counter() - t0)
-                if watchdog is not None:
-                    watchdog.tick()
-                with timer.stage("fetch"):
-                    # async: the packed buffer lands while the next tiles
-                    # compute; the per-product fallback defers its
-                    # (synchronous) transfers to the writer pool instead
-                    handle = fetcher.start(out)
-            except Exception as e:  # device-side failure surfaces here
-                err = e
-        if err is not None:
-            try:
-                handle, dt, attempt = _retry_ladder(t, dn, qa, attempt, err)
-            except TileRetriesExhausted as e:
-                _quarantine(t, e)
-                return
-        if not fetcher.packed:
-            # per-product fallback: the pre-packing flow exactly — the
-            # write job runs the synchronous fetches itself, nothing to
-            # overlap, no retained inputs beyond this call
-            _tile_completed(t, dt)
-            _submit_write(t, handle, dt)
-            return
-        # the retained (dn, qa) ride the backlog for the retry ladder: a
-        # device error surfacing through the in-flight fetch re-dispatches
-        # from them.  Bounded at fetch_depth entries.
-        pending_fetches.append((t, handle, dn, qa, dt, attempt))
-        fetcher.note_backlog(len(pending_fetches))
-        _drain_fetches(cfg.fetch_depth - 1)
+        # constructed LAST, immediately before the try/finally that owns its
+        # shutdown: an exception anywhere between construction and that
+        # finally would leak the exporter thread / metrics port / event fd
+        # and leave a stream with no terminal run_done
+        telemetry = None
+        if cfg.telemetry:
+            from land_trendr_tpu.obs import Telemetry
 
-    # feed pool, mirroring the writer pool on the input side (VERDICT r3
-    # next-round item #3): ``cfg.feed_workers`` threads run the native
-    # gather for UPCOMING tiles while the current tile computes, keeping a
-    # bounded prefetch queue of ``feed_workers + 1`` fed tiles.  The
-    # native gather releases the GIL (threaded C++), so workers scale to
-    # real cores; HOSTPATH_r03.json's budget (4.1M px/s/core ⇒ ~2.4 cores
-    # at the 10M px/s north star) becomes ``feed_workers=3``.  Like
-    # ``write_s``, overlapped ``feed_s`` can exceed wall time.  Host
-    # memory stays bounded: at most ``feed_workers + 1`` fed inputs plus
-    # ``write_workers + 2`` finished tiles are live at once.
-    feeder = ThreadPoolExecutor(
-        max_workers=cfg.feed_workers, thread_name_prefix="lt-feeder"
-    )
-    pending_feeds: deque = deque()  # (tile, future), consumed in order
-
-    def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
-        with timer.stage("feed"):
-            faults.check("feed")  # injection seam: transient feed I/O
-            fed = _feed_tile(stack, t, feed_px, bands)
-        if readahead is not None:
-            # fire-and-forget: hint the next PLANNED tile (one past the
-            # feed queue) so its block decode overlaps the current tiles'
-            # device wait — lazy file-backed cubes only; eager ndarray
-            # stacks have no compressed blocks to prefetch
-            _prefetch_tile(stack, readahead, bands)
-        return fed
-
-    def _refeed(t: TileSpec, err: BaseException):
-        """Synchronous feed retry: a transient stack-read error (NFS blip,
-        decode hiccup) re-enters the same per-tile retry budget as device
-        faults instead of aborting the whole run.  Returns ``(dn, qa,
-        attempt)`` — the attempt number the tile continues from, so its
-        ``tile_start`` and any later dispatch retries share ONE per-tile
-        budget — or ``None`` when the tile was quarantined; an exhausted
-        budget raises :class:`TileRetriesExhausted` (chaining the original
-        feed error) exactly like the device-fault ladder, so the CLI's
-        exit-3 contract covers every per-tile failure class.
-        """
-        attempt = 1
-        while True:
-            try:
-                attempt = _retry_step(t, attempt, err, what="feed ")
-            except TileRetriesExhausted as exc:
-                _quarantine(t, exc)
-                return None
-            try:
-                return (*_feed_job(t), attempt)
-            except Exception as e:
-                err = e
-
-    # constructed LAST, immediately before the try/finally that owns its
-    # shutdown: an exception anywhere between construction and that
-    # finally would leak the exporter thread / metrics port / event fd
-    # and leave a stream with no terminal run_done
-    telemetry = None
-    if cfg.telemetry:
-        from land_trendr_tpu.obs import Telemetry
-
-        # per-process port fan-out (port + process_index, like the
-        # per-process event/metrics FILE naming): a same-host pod would
-        # otherwise have every process after the first die binding the
-        # one configured port.  0 (ephemeral) needs no offset; each
-        # process's bound port lands in its own run summary.
-        metrics_port = cfg.metrics_port
-        if metrics_port:
-            metrics_port += jax.process_index()
-        telemetry = Telemetry(
-            cfg.workdir,
-            fingerprint=manifest.fingerprint,
-            process_index=jax.process_index(),
-            process_count=jax.process_count(),
-            metrics_port=metrics_port,
-            metrics_host=cfg.metrics_host,
-            metrics_interval_s=cfg.metrics_interval_s,
-        )
-        try:
-            # the manifest reports write_done events once each tile is
-            # durable
-            manifest.telemetry = telemetry
-            telemetry.run_start(
+            # per-process port fan-out (port + process_index, like the
+            # per-process event/metrics FILE naming): a same-host pod would
+            # otherwise have every process after the first die binding the
+            # one configured port.  0 (ephemeral) needs no offset; each
+            # process's bound port lands in its own run summary.
+            metrics_port = cfg.metrics_port
+            if metrics_port:
+                metrics_port += jax.process_index()
+            telemetry = self.telemetry = Telemetry(
+                cfg.workdir,
                 fingerprint=manifest.fingerprint,
                 process_index=jax.process_index(),
                 process_count=jax.process_count(),
-                tiles_total=len(tiles),
-                tiles_todo=len(todo),
-                tiles_skipped_resume=n_resume_skipped,
-                mesh_devices=n_mesh,
-                impl=impl_resolved,
+                metrics_port=metrics_port,
+                metrics_host=cfg.metrics_host,
+                metrics_interval_s=cfg.metrics_interval_s,
+                # serve mode: the job id rides EVERY event of this run's
+                # scope, so a fleet-wide fold can attribute tile traffic
+                # to the request that caused it
+                job_id=self.job_id,
             )
-        except BaseException:
-            # a failed run_start emit surfaces before the try/finally
-            # below owns shutdown — unwind here or the exporter thread /
-            # metrics port / event fd leak into the caller's process
-            manifest.telemetry = None
-            telemetry.close()
-            raise
-
-    # fault injection + stall watchdog are armed AFTER telemetry exists
-    # (their events need somewhere to go) and disarmed in the finally; a
-    # failure arming them must unwind telemetry like run_start's guard
-    fault_plan = None
-    try:
-        if cfg.fault_schedule:
-            fault_plan = faults.activate(
-                faults.parse_schedule(cfg.fault_schedule)
-            )
-            if telemetry is not None:
-                faults.set_observer(telemetry.fault_injected)
-            log.warning(
-                "fault injection ACTIVE (%s) — this is a test/soak run",
-                cfg.fault_schedule,
-            )
-        if cfg.stall_timeout_s is not None:
-            if threading.current_thread() is not threading.main_thread():
-                # the watchdog aborts via interrupt_main: armed from a
-                # worker thread it would interrupt an UNRELATED main
-                # thread and hard-exit the whole host process on stall
-                raise ValueError(
-                    "stall_timeout_s requires run_stack on the process "
-                    "main thread (the watchdog aborts via "
-                    "interrupt_main); run without the watchdog or move "
-                    "the run to the main thread"
+            try:
+                # the manifest reports write_done events once each tile is
+                # durable
+                manifest.telemetry = telemetry
+                telemetry.run_start(
+                    fingerprint=manifest.fingerprint,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count(),
+                    tiles_total=len(tiles),
+                    tiles_todo=len(todo),
+                    tiles_skipped_resume=n_resume_skipped,
+                    mesh_devices=n_mesh,
+                    impl=impl_resolved,
                 )
+            except BaseException:
+                # a failed run_start emit surfaces before the try/finally
+                # below owns shutdown — unwind here or the exporter thread /
+                # metrics port / event fd leak into the caller's process
+                manifest.telemetry = None
+                telemetry.close()
+                raise
 
-            def _on_stall(idle_s: float) -> None:
-                if telemetry is not None:
-                    telemetry.stall(idle_s, cfg.stall_timeout_s)
-
-            watchdog = _StallWatchdog(cfg.stall_timeout_s, _on_stall).start()
-    except BaseException:
-        if fault_plan is not None:
-            faults.set_observer(None)
-            faults.deactivate()
-        if telemetry is not None:
-            manifest.telemetry = None
-            telemetry.close()
-        raise
-
-    # readahead targets ride the feed submissions: the tile fed at index
-    # i hints the tile at i + feed_workers + 1 — the first one past the
-    # bounded feed queue, so its decode lands in the cache exactly when
-    # the feed pool would otherwise start it cold
-    ra_depth = cfg.feed_workers + 1
-    readahead_on = cfg.feed_readahead and cfg.feed_cache_mb > 0
-
-    def _submit_feed(i: int) -> None:
-        ra = todo[i + ra_depth] if readahead_on and i + ra_depth < len(todo) else None
-        pending_feeds.append((todo[i], feeder.submit(_feed_job, todo[i], ra)))
-
-    pending_uploads: deque = deque()  # bounded at upload_depth in flight
-
-    def _pump_uploads() -> None:
-        """Resolve fed tiles and issue their uploads until the bounded
-        in-flight window is full (or the feed queue is empty).
-
-        On the packed path this is the double-buffering step: up to
-        ``cfg.upload_depth`` packed buffers cross the link while the
-        tile ahead of them computes.  On the per-array path the window
-        is 1 — the handle is a pass-through and a deeper queue would
-        only hold extra fed inputs in host memory for nothing.  A feed
-        failure re-enters the per-tile retry budget exactly as before
-        (``_refeed``); a quarantined feed never enters the queue.
-        """
-        nonlocal next_i
-        depth = cfg.upload_depth if uploader.packed else 1
-        while pending_feeds and len(pending_uploads) < depth:
-            t, fut = pending_feeds.popleft()
-            # top up the queue BEFORE resolving this feed: if it failed,
-            # the synchronous retry below backs off for seconds — the
-            # feed pool should keep decoding tiles i+1.. meanwhile
-            if next_i < len(todo):
-                _submit_feed(next_i)
-                next_i += 1
-            attempt0 = 1
-            try:
-                dn, qa = fut.result()
-            except Exception as e:
-                # transient feed I/O enters the retry budget (sync,
-                # with backoff) instead of aborting the whole run
-                fed = _refeed(t, e)
-                if fed is None:
-                    continue  # tile quarantined; the rest of the run goes on
-                dn, qa, attempt0 = fed
-            if watchdog is not None:
-                watchdog.tick()
-            with timer.stage("upload"):
-                try:
-                    handle = uploader.start(dn, qa)
-                except Exception as e:
-                    # an ISSUE-time upload failure (device_put raising
-                    # eagerly, pack allocation) must not abort the run:
-                    # it counts toward demotion like a wait-side fault,
-                    # and this tile falls back to the per-array handle —
-                    # the dispatch path transfers (and retries) as before
-                    _note_upload_failure()
-                    log.warning(
-                        "tile %d packed-upload issue failed (%s); "
-                        "per-array dispatch for this tile", t.tile_id, e,
-                    )
-                    handle = feedmod.SyncUpload(uploader, dn, qa)
-            pending_uploads.append((t, handle, dn, qa, attempt0))
-            uploader.note_backlog(len(pending_uploads))
-
-    run_ok = False
-    try:
-        next_i = min(ra_depth, len(todo))
-        for i in range(next_i):
-            _submit_feed(i)
-        pending = None
-        while True:
-            _pump_uploads()
-            if not pending_uploads:
-                break  # feeds exhausted (or every remainder quarantined)
-            t, handle, dn, qa, attempt0 = pending_uploads.popleft()
-            if telemetry is not None:
-                # attempt0 > 1 after feed retries: the stream's
-                # tile_retry(1..n) → tile_start(n+1) stays coherent, and
-                # dispatch retries continue the SAME per-tile budget
-                telemetry.tile_start(t.tile_id, attempt=attempt0)
-            t0 = time.perf_counter()
-            out = err = None
-            try:
-                with timer.stage("upload"):
-                    # packed: wait out the landing (short — it has been
-                    # crossing the link while earlier tiles computed) and
-                    # run the device unpack; sync: a pass-through of the
-                    # host arrays, transferred at dispatch as always
-                    u_dn, u_qa = handle.arrays()
-                if handle.packed:
-                    _note_upload_ok()
-            except Exception as e:
-                # an upload error surfacing through the async wait enters
-                # the SAME retry ladder as a dispatch fault — the ladder
-                # re-dispatches from the retained HOST inputs on the
-                # per-array path, so a sick link cannot wedge the tile
-                if handle.packed:
-                    _note_upload_failure()
-                err = e
-            if err is None:
-                out, err = _dispatch(u_dn, u_qa)
-            dt_dispatch = time.perf_counter() - t0
-            if pending is not None:
-                _finish(pending)
-                pending = None
-            if err is not None:
-                # synchronous dispatch failure: resolve (retry or abort) now
-                # rather than dispatching further tiles behind a known fault
-                _finish((t, out, err, dn, qa, dt_dispatch, attempt0))
-            else:
-                pending = (t, out, err, dn, qa, dt_dispatch, attempt0)
-        if pending is not None:
-            _finish(pending)
-        _drain_fetches(0)
-        _drain_writes(0)
-        run_ok = True
-    except KeyboardInterrupt:
-        if watchdog is not None and watchdog.stalled:
-            # the watchdog's interrupt_main landed: convert it to the
-            # documented abort (CLI exit 4) — a real Ctrl-C propagates
-            raise StallError(
-                f"run stalled: no tile progress for over "
-                f"{cfg.stall_timeout_s}s (stall watchdog abort)"
-            ) from None
-        raise
-    finally:
+        # fault injection + stall watchdog are armed AFTER telemetry exists
+        # (their events need somewhere to go) and disarmed in the finally; a
+        # failure arming them must unwind telemetry like run_start's guard
+        fault_plan = None
         try:
-            # NOTE: the watchdog stays armed through this whole unwind — a
-            # writer thread hung in a native transfer would otherwise block
-            # writer.shutdown(wait=True) forever with the hard-exit grace
-            # clock already cancelled, reinstating exactly the infinite hang
-            # the watchdog exists to prevent.  A stall firing mid-unwind
-            # ends, at worst, in the documented os._exit(4).
-            feeder.shutdown(wait=False, cancel_futures=True)
-            writer.shutdown(wait=True)
-            for fut in pending_writes:
-                if (exc := fut.exception()):
-                    # a compute abort is already propagating; surface, don't mask
-                    log.error("tile write also failed during abort: %s", exc)
-                else:
-                    # writes the shutdown drain completed are real durable
-                    # tiles: fold them in so the aborted run_done's pixels /
-                    # fit_rate stay consistent with its own tiles_done
-                    # (success path drained everything before run_ok)
-                    px, fit = fut.result()
-                    n_px += px
-                    n_fit += fit
-            if store is not None:
-                # persist what this run ingested, abort path included —
-                # the next run's warm start is the whole point.  close()
-                # flushes AND releases the segment mmaps/fds, and the
-                # detach drops the process-global reference so nothing
-                # writes into a store whose owning run has ended (the
-                # RAM tier persists process-wide as before; stats reads
-                # below still work on a closed store).  An error here
-                # (the same full disk that killed the run) must not mask
-                # the propagating failure.
-                try:
-                    store.close()
-                except Exception as exc:
-                    log.error("ingest-store flush/close failed: %s", exc)
-                blockcache.detach_store(store)
-            if fault_plan is not None and not run_ok:
-                # abort path: disarm here (after the writer drain, so seam
-                # indices stay deterministic through the last record()).  On
-                # success the plan stays active through the multihost merge —
-                # the merge.peer seam fires there — and is disarmed at the
-                # end of run_stack.
+            if cfg.fault_schedule:
+                if faults.active() is not None:
+                    # a serving layer arms ONE process-wide plan for all
+                    # its jobs; a job additionally carrying its own
+                    # schedule is a config conflict, not something to
+                    # silently clobber
+                    raise ValueError(
+                        "fault_schedule set while another fault plan is "
+                        "already active in this process (a server-armed "
+                        "plan is shared by every run; per-run schedules "
+                        "need an idle process)"
+                    )
+                fault_plan = self.fault_plan = faults.activate(
+                    faults.parse_schedule(cfg.fault_schedule)
+                )
+                if telemetry is not None:
+                    faults.set_observer(telemetry.fault_injected)
+                log.warning(
+                    "fault injection ACTIVE (%s) — this is a test/soak run",
+                    cfg.fault_schedule,
+                )
+            if cfg.stall_timeout_s is not None:
+                if threading.current_thread() is not threading.main_thread():
+                    # the watchdog aborts via interrupt_main: armed from a
+                    # worker thread it would interrupt an UNRELATED main
+                    # thread and hard-exit the whole host process on stall
+                    raise ValueError(
+                        "stall_timeout_s requires run_stack on the process "
+                        "main thread (the watchdog aborts via "
+                        "interrupt_main); run without the watchdog or move "
+                        "the run to the main thread"
+                    )
+
+                def _on_stall(idle_s: float) -> None:
+                    if telemetry is not None:
+                        telemetry.stall(idle_s, cfg.stall_timeout_s)
+
+                watchdog = self.watchdog = _StallWatchdog(
+                    cfg.stall_timeout_s, _on_stall
+                ).start()
+        except BaseException:
+            if fault_plan is not None:
                 faults.set_observer(None)
                 faults.deactivate()
-            if telemetry is not None and not run_ok:
-                # abort visibility: the stream must say the run died, not just
-                # stop — consumers treat a missing run_done as "still running".
-                # Best-effort only: the run-failure exception is propagating
-                # through this finally, and a telemetry emit error (e.g. the
-                # SAME full disk that killed the write) must not replace it
-                abort_wall = time.perf_counter() - t_run
+            if telemetry is not None:
+                manifest.telemetry = None
+                telemetry.close()
+            raise
+
+        # readahead targets ride the feed submissions: the tile fed at index
+        # i hints the tile at i + feed_workers + 1 — the first one past the
+        # bounded feed queue, so its decode lands in the cache exactly when
+        # the feed pool would otherwise start it cold
+        ra_depth = cfg.feed_workers + 1
+        readahead_on = cfg.feed_readahead and cfg.feed_cache_mb > 0
+
+        def _submit_feed(i: int) -> None:
+            ra = todo[i + ra_depth] if readahead_on and i + ra_depth < len(todo) else None
+            pending_feeds.append((todo[i], feeder.submit(_feed_job, todo[i], ra)))
+
+        pending_uploads: deque = deque()  # bounded at upload_depth in flight
+
+        def _pump_uploads() -> None:
+            """Resolve fed tiles and issue their uploads until the bounded
+            in-flight window is full (or the feed queue is empty).
+
+            On the packed path this is the double-buffering step: up to
+            ``cfg.upload_depth`` packed buffers cross the link while the
+            tile ahead of them computes.  On the per-array path the window
+            is 1 — the handle is a pass-through and a deeper queue would
+            only hold extra fed inputs in host memory for nothing.  A feed
+            failure re-enters the per-tile retry budget exactly as before
+            (``_refeed``); a quarantined feed never enters the queue.
+            """
+            nonlocal next_i
+            depth = cfg.upload_depth if uploader.packed else 1
+            while pending_feeds and len(pending_uploads) < depth:
+                t, fut = pending_feeds.popleft()
+                # top up the queue BEFORE resolving this feed: if it failed,
+                # the synchronous retry below backs off for seconds — the
+                # feed pool should keep decoding tiles i+1.. meanwhile
+                if next_i < len(todo):
+                    _submit_feed(next_i)
+                    next_i += 1
+                attempt0 = 1
                 try:
-                    if cfg.feed_cache_mb:
-                        # the post-mortem of a died gigapixel run is exactly
-                        # where the cache/decode counters matter — emit the
-                        # rollup for the aborted scope too (still just before
-                        # its run_done, like the success path)
-                        telemetry.feed_cache(
-                            blockcache.stats_delta(feed_cache_base)
+                    dn, qa = fut.result()
+                except Exception as e:
+                    # transient feed I/O enters the retry budget (sync,
+                    # with backoff) instead of aborting the whole run
+                    fed = _refeed(t, e)
+                    if fed is None:
+                        continue  # tile quarantined; the rest of the run goes on
+                    dn, qa, attempt0 = fed
+                if watchdog is not None:
+                    watchdog.tick()
+                with timer.stage("upload"):
+                    try:
+                        handle = uploader.start(dn, qa)
+                    except Exception as e:
+                        # an ISSUE-time upload failure (device_put raising
+                        # eagerly, pack allocation) must not abort the run:
+                        # it counts toward demotion like a wait-side fault,
+                        # and this tile falls back to the per-array handle —
+                        # the dispatch path transfers (and retries) as before
+                        _note_upload_failure()
+                        log.warning(
+                            "tile %d packed-upload issue failed (%s); "
+                            "per-array dispatch for this tile", t.tile_id, e,
                         )
-                    # fetch rollup likewise: a run that died mid-readback is
-                    # the one whose transfer/wait counters the post-mortem
-                    # needs
-                    telemetry.fetch(fetcher.summary())
-                    # and the upload/store rollups — a run that died
-                    # mid-ingest is the one whose upload-wait and
-                    # store-put counters the post-mortem needs
-                    telemetry.upload(uploader.summary())
-                    if store is not None:
-                        telemetry.ingest_store(store.stats_delta(store_base))
+                        handle = feedmod.SyncUpload(uploader, dn, qa)
+                pending_uploads.append((t, handle, dn, qa, attempt0))
+                uploader.note_backlog(len(pending_uploads))
+
+        def _warm_programs() -> dict:
+            # serve-mode warm program cache: an explicit admission index
+            # over JAX's in-process executable cache.  On a MISS the run
+            # pays its compile NOW, against one fully-masked dummy tile
+            # pushed through the exact upload → dispatch → fetch program
+            # chain (same shapes, dtypes and static arguments as every
+            # real tile, so the executables JAX caches here are the ones
+            # the tiles reuse); on a HIT the dummy is skipped entirely —
+            # a warm job runs zero compiles.  The dummy tile rides the
+            # normal upload/fetch transfer stats (one phantom tile on
+            # miss runs) and, on injection runs, consumes one invocation
+            # index at each driver seam it crosses.
+            key = self.programs.key_for(
+                fingerprint=manifest.fingerprint,
+                backend=jax.default_backend(),
+                impl=impl_resolved,
+                mesh_devices=n_mesh,
+                feed_px=int(feed_px),
+                ny=int(stack.n_years),
+                chunk=chunk,
+                fetch_packed=bool(fetcher.packed),
+                upload_packed=bool(uploader.packed),
+                dtypes={
+                    name: str(np.dtype(stack.dn_bands[name].dtype))
+                    for name in bands
+                } | {"qa": str(np.dtype(stack.qa.dtype))},
+            )
+            t0_warm = time.perf_counter()
+            hit = self.programs.admit(key)
+            probe_ok = True
+            if not hit:
+                try:
+                    ny = int(stack.n_years)
+                    dummy_dn = {
+                        name: np.zeros(
+                            (feed_px, ny), dtype=stack.dn_bands[name].dtype
+                        )
+                        for name in bands
+                    }
+                    # QA fill bit set everywhere: the kernel masks every
+                    # pixel, so the warm tile costs compile + ~no compute
+                    dummy_qa = np.full((feed_px, ny), 1, dtype=stack.qa.dtype)
+                    wh = uploader.start(dummy_dn, dummy_qa)
+                    w_dn, w_qa = wh.arrays()
+                    w_out, w_err = _dispatch(w_dn, w_qa)
+                    if w_err is not None:
+                        raise w_err
+                    # warm compile wait: nothing is pipelined yet, the
+                    # whole point is to pay the compile before tile 0
+                    jax.block_until_ready(w_out)  # lt: noqa[LT002]
+                    fetcher.start(w_out).wait()
+                    _note_fetch_ok()
+                except Exception as e:
+                    # a failed warm probe is not a failed run: the first
+                    # real tile compiles inline (and retries) as always.
+                    # It is also NOT a compile — record(ok=False) leaves
+                    # the key unregistered so the next same-key run
+                    # probes again instead of being falsely admitted warm
+                    probe_ok = False
+                    log.warning(
+                        "program warm probe failed (%s); first tile "
+                        "compiles inline", e,
+                    )
+            if watchdog is not None:
+                watchdog.tick()  # the probe compile was progress
+            compile_s = 0.0 if hit else time.perf_counter() - t0_warm
+            self.programs.record(
+                key, hit=hit, compile_s=compile_s, ok=probe_ok
+            )
+            return {
+                "hits": int(hit),
+                "misses": int(not hit),
+                "compile_s": round(compile_s, 6),
+            }
+
+        program_stats = None
+        run_ok = False
+        try:
+            if self.programs is not None:
+                # inside the guarded try: a Ctrl-C / stall interrupt
+                # landing mid-compile unwinds through the normal abort
+                # path (run_done "aborted", pool shutdown, plan disarm)
+                # exactly like a tile-0 compile did before this existed
+                program_stats = self.program_stats = _warm_programs()
+            next_i = min(ra_depth, len(todo))
+            for i in range(next_i):
+                _submit_feed(i)
+            pending = None
+            while True:
+                self._check_cancel()
+                _pump_uploads()
+                if not pending_uploads:
+                    break  # feeds exhausted (or every remainder quarantined)
+                t, handle, dn, qa, attempt0 = pending_uploads.popleft()
+                if telemetry is not None:
+                    # attempt0 > 1 after feed retries: the stream's
+                    # tile_retry(1..n) → tile_start(n+1) stays coherent, and
+                    # dispatch retries continue the SAME per-tile budget
+                    telemetry.tile_start(t.tile_id, attempt=attempt0)
+                t0 = time.perf_counter()
+                out = err = None
+                try:
+                    with timer.stage("upload"):
+                        # packed: wait out the landing (short — it has been
+                        # crossing the link while earlier tiles computed) and
+                        # run the device unpack; sync: a pass-through of the
+                        # host arrays, transferred at dispatch as always
+                        u_dn, u_qa = handle.arrays()
+                    if handle.packed:
+                        _note_upload_ok()
+                except Exception as e:
+                    # an upload error surfacing through the async wait enters
+                    # the SAME retry ladder as a dispatch fault — the ladder
+                    # re-dispatches from the retained HOST inputs on the
+                    # per-array path, so a sick link cannot wedge the tile
+                    if handle.packed:
+                        _note_upload_failure()
+                    err = e
+                if err is None:
+                    out, err = _dispatch(u_dn, u_qa)
+                dt_dispatch = time.perf_counter() - t0
+                if pending is not None:
+                    _finish(pending)
+                    pending = None
+                if err is not None:
+                    # synchronous dispatch failure: resolve (retry or abort) now
+                    # rather than dispatching further tiles behind a known fault
+                    _finish((t, out, err, dn, qa, dt_dispatch, attempt0))
+                else:
+                    pending = (t, out, err, dn, qa, dt_dispatch, attempt0)
+            if pending is not None:
+                _finish(pending)
+            _drain_fetches(0)
+            _drain_writes(0)
+            run_ok = True
+        except KeyboardInterrupt:
+            if watchdog is not None and watchdog.stalled:
+                # the watchdog's interrupt_main landed: convert it to the
+                # documented abort (CLI exit 4) — a real Ctrl-C propagates
+                raise StallError(
+                    f"run stalled: no tile progress for over "
+                    f"{cfg.stall_timeout_s}s (stall watchdog abort)"
+                ) from None
+            raise
+        finally:
+            try:
+                # NOTE: the watchdog stays armed through this whole unwind — a
+                # writer thread hung in a native transfer would otherwise block
+                # writer.shutdown(wait=True) forever with the hard-exit grace
+                # clock already cancelled, reinstating exactly the infinite hang
+                # the watchdog exists to prevent.  A stall firing mid-unwind
+                # ends, at worst, in the documented os._exit(4).
+                feeder.shutdown(wait=False, cancel_futures=True)
+                writer.shutdown(wait=True)
+                for fut in pending_writes:
+                    if (exc := fut.exception()):
+                        # a compute abort is already propagating; surface, don't mask
+                        log.error("tile write also failed during abort: %s", exc)
+                    else:
+                        # writes the shutdown drain completed are real durable
+                        # tiles: fold them in so the aborted run_done's pixels /
+                        # fit_rate stay consistent with its own tiles_done
+                        # (success path drained everything before run_ok)
+                        px, fit = fut.result()
+                        n_px += px
+                        n_fit += fit
+                if store is not None and owns_store:
+                    # (a shared_store is the server's: it outlives this run
+                    # by design and only its owner closes it)
+                    # persist what this run ingested, abort path included —
+                    # the next run's warm start is the whole point.  close()
+                    # flushes AND releases the segment mmaps/fds, and the
+                    # detach drops the process-global reference so nothing
+                    # writes into a store whose owning run has ended (the
+                    # RAM tier persists process-wide as before; stats reads
+                    # below still work on a closed store).  An error here
+                    # (the same full disk that killed the run) must not mask
+                    # the propagating failure.
+                    try:
+                        store.close()
+                    except Exception as exc:
+                        log.error("ingest-store flush/close failed: %s", exc)
+                    blockcache.detach_store(store)
+                if fault_plan is not None and not run_ok:
+                    # abort path: disarm here (after the writer drain, so seam
+                    # indices stay deterministic through the last record()).  On
+                    # success the plan stays active through the multihost merge —
+                    # the merge.peer seam fires there — and is disarmed at the
+                    # end of run_stack.
+                    faults.set_observer(None)
+                    faults.deactivate()
+                if telemetry is not None and not run_ok:
+                    # abort visibility: the stream must say the run died, not just
+                    # stop — consumers treat a missing run_done as "still running".
+                    # Best-effort only: the run-failure exception is propagating
+                    # through this finally, and a telemetry emit error (e.g. the
+                    # SAME full disk that killed the write) must not replace it
+                    abort_wall = time.perf_counter() - t_run
+                    try:
+                        if cfg.feed_cache_mb:
+                            # the post-mortem of a died gigapixel run is exactly
+                            # where the cache/decode counters matter — emit the
+                            # rollup for the aborted scope too (still just before
+                            # its run_done, like the success path)
+                            telemetry.feed_cache(
+                                blockcache.stats_delta(feed_cache_base)
+                            )
+                        # fetch rollup likewise: a run that died mid-readback is
+                        # the one whose transfer/wait counters the post-mortem
+                        # needs
+                        telemetry.fetch(fetcher.summary())
+                        # and the upload/store rollups — a run that died
+                        # mid-ingest is the one whose upload-wait and
+                        # store-put counters the post-mortem needs
+                        telemetry.upload(uploader.summary())
+                        if store is not None:
+                            telemetry.ingest_store(store.stats_delta(store_base))
+                        if program_stats is not None:
+                            # the warm-cache verdict matters most on the
+                            # aborted/cancelled scope a serve post-mortem
+                            # reads
+                            telemetry.program_cache(program_stats)
+                        telemetry.run_done(
+                            "aborted",
+                            tiles_done=n_done,
+                            pixels=n_px,
+                            wall_s=round(abort_wall, 3),
+                            px_per_s=round(n_px / abort_wall, 1) if n_px else 0.0,
+                            fit_rate=(n_fit / n_px) if n_px else 0.0,
+                            stage_s=timer.summary(),
+                            tiles_quarantined=len(quarantined),
+                        )
+                    except Exception as exc:
+                        log.error("abort-path telemetry run_done failed: %s", exc)
+                    finally:
+                        try:
+                            telemetry.close()
+                        except Exception as exc:
+                            log.error("abort-path telemetry close failed: %s", exc)
+                if watchdog is not None:
+                    # LAST: disarmed only once the unwind is through — the
+                    # success tail below (merge wait included) has its own
+                    # bounded timeouts and must not be subject to stall aborts
+                    watchdog.stop()
+            except KeyboardInterrupt:
+                if watchdog is not None and watchdog.stalled:
+                    # the watchdog fired DURING the unwind (e.g. a writer
+                    # thread hung in a native transfer blocking the
+                    # shutdown drain above): the remaining cleanup cannot
+                    # run, the stall event is already durable — exit with
+                    # the documented stall code rather than dying as an
+                    # unexplained KeyboardInterrupt (~130) with the fault
+                    # plan still armed
+                    log.critical(
+                        "stall during abort unwind; hard abort (exit 4)"
+                    )
+                    if telemetry is not None:
+                        try:
+                            telemetry.close()
+                        except Exception:
+                            pass
+                    os._exit(4)
+                raise
+
+        wall = time.perf_counter() - t_run
+        summary = {
+            "tiles": len(tiles),
+            "tiles_skipped_resume": n_resume_skipped,
+            "pixels": n_px,
+            "fit_rate": (n_fit / n_px) if n_px else 0.0,
+            "wall_s": round(wall, 3),
+            "px_per_s": round(n_px / wall, 1) if n_px else 0.0,
+            "stage_s": timer.summary(),
+            "fingerprint": manifest.fingerprint,
+            "mesh_devices": n_mesh,
+            # always present (empty on healthy runs): orchestrators branch on
+            # it, and the CLI maps non-empty to exit code 3
+            "tiles_quarantined": sorted(quarantined),
+        }
+        feed_cache_stats = blockcache.stats_delta(feed_cache_base)
+        if cfg.feed_cache_mb:
+            summary["feed_cache"] = feed_cache_stats
+        summary["fetch"] = fetcher.summary()
+        summary["upload"] = uploader.summary()
+        if store is not None:
+            summary["ingest_store"] = store.stats_delta(store_base)
+        if program_stats is not None:
+            summary["program_cache"] = program_stats
+        # the success tail can itself raise (a full-disk run_done emit, a
+        # merge I/O error) — the plan must still disarm, or it leaks into
+        # the process's NEXT run and fires faults nobody scheduled
+        try:
+            if telemetry is not None:
+                if cfg.feed_cache_mb:
+                    # one terminal rollup per run scope (matching the run-scoped
+                    # stage_s), not a per-tile stream: the counters are cheap but
+                    # the EVENT volume wouldn't be
+                    telemetry.feed_cache(feed_cache_stats)
+                # same one-rollup-per-scope shape for the fetch subsystem
+                telemetry.fetch(summary["fetch"])
+                # and for its upload mirror + the persistent ingest store
+                telemetry.upload(summary["upload"])
+                if store is not None:
+                    telemetry.ingest_store(summary["ingest_store"])
+                if program_stats is not None:
+                    # one warm-cache rollup per run scope, like the
+                    # fetch/upload/store rollups above
+                    telemetry.program_cache(program_stats)
+                try:
                     telemetry.run_done(
-                        "aborted",
+                        "ok",
                         tiles_done=n_done,
                         pixels=n_px,
-                        wall_s=round(abort_wall, 3),
-                        px_per_s=round(n_px / abort_wall, 1) if n_px else 0.0,
-                        fit_rate=(n_fit / n_px) if n_px else 0.0,
+                        wall_s=summary["wall_s"],
+                        px_per_s=summary["px_per_s"],
+                        fit_rate=summary["fit_rate"],
                         stage_s=timer.summary(),
                         tiles_quarantined=len(quarantined),
                     )
-                except Exception as exc:
-                    log.error("abort-path telemetry run_done failed: %s", exc)
                 finally:
-                    try:
-                        telemetry.close()
-                    except Exception as exc:
-                        log.error("abort-path telemetry close failed: %s", exc)
-            if watchdog is not None:
-                # LAST: disarmed only once the unwind is through — the
-                # success tail below (merge wait included) has its own
-                # bounded timeouts and must not be subject to stall aborts
-                watchdog.stop()
-        except KeyboardInterrupt:
-            if watchdog is not None and watchdog.stalled:
-                # the watchdog fired DURING the unwind (e.g. a writer
-                # thread hung in a native transfer blocking the
-                # shutdown drain above): the remaining cleanup cannot
-                # run, the stall event is already durable — exit with
-                # the documented stall code rather than dying as an
-                # unexplained KeyboardInterrupt (~130) with the fault
-                # plan still armed
-                log.critical(
-                    "stall during abort unwind; hard abort (exit 4)"
-                )
-                if telemetry is not None:
-                    try:
-                        telemetry.close()
-                    except Exception:
-                        pass
-                os._exit(4)
-            raise
+                    # the terminal-event emit may raise (full disk) and that error
+                    # should surface on a succeeded run — but close() must still
+                    # run, or the metrics port / exporter thread / event fd leak
+                    # into the caller's process
+                    summary["telemetry"] = {
+                        "events": telemetry.events_file,
+                        "metrics": telemetry.metrics_file,
+                    }
+                    if telemetry.metrics_port is not None:
+                        summary["telemetry"]["metrics_port"] = telemetry.metrics_port
+                    telemetry.close()  # final exposition flush before anyone reads it
+                    # the closed event log can take no more fault_injected emits;
+                    # merge.peer fires past this point are still counted/logged
+                    # by the plan itself
+                    faults.set_observer(None)
+                if jax.process_count() > 1 and jax.process_index() == 0:
+                    # primary-host fold: per-process event files live in the SHARED
+                    # workdir (the manifest's filesystem is the pod's job state), so
+                    # the merge is a bounded wait for every peer's run_done line —
+                    # no collective, usable even when a peer aborted
+                    from land_trendr_tpu.parallel.multihost import merge_host_event_logs
 
-    wall = time.perf_counter() - t_run
-    summary = {
-        "tiles": len(tiles),
-        "tiles_skipped_resume": n_resume_skipped,
-        "pixels": n_px,
-        "fit_rate": (n_fit / n_px) if n_px else 0.0,
-        "wall_s": round(wall, 3),
-        "px_per_s": round(n_px / wall, 1) if n_px else 0.0,
-        "stage_s": timer.summary(),
-        "fingerprint": manifest.fingerprint,
-        "mesh_devices": n_mesh,
-        # always present (empty on healthy runs): orchestrators branch on
-        # it, and the CLI maps non-empty to exit code 3
-        "tiles_quarantined": sorted(quarantined),
-    }
-    feed_cache_stats = blockcache.stats_delta(feed_cache_base)
-    if cfg.feed_cache_mb:
-        summary["feed_cache"] = feed_cache_stats
-    summary["fetch"] = fetcher.summary()
-    summary["upload"] = uploader.summary()
-    if store is not None:
-        summary["ingest_store"] = store.stats_delta(store_base)
-    # the success tail can itself raise (a full-disk run_done emit, a
-    # merge I/O error) — the plan must still disarm, or it leaks into
-    # the process's NEXT run and fires faults nobody scheduled
-    try:
-        if telemetry is not None:
-            if cfg.feed_cache_mb:
-                # one terminal rollup per run scope (matching the run-scoped
-                # stage_s), not a per-tile stream: the counters are cheap but
-                # the EVENT volume wouldn't be
-                telemetry.feed_cache(feed_cache_stats)
-            # same one-rollup-per-scope shape for the fetch subsystem
-            telemetry.fetch(summary["fetch"])
-            # and for its upload mirror + the persistent ingest store
-            telemetry.upload(summary["upload"])
-            if store is not None:
-                telemetry.ingest_store(summary["ingest_store"])
-            try:
-                telemetry.run_done(
-                    "ok",
-                    tiles_done=n_done,
-                    pixels=n_px,
-                    wall_s=summary["wall_s"],
-                    px_per_s=summary["px_per_s"],
-                    fit_rate=summary["fit_rate"],
-                    stage_s=timer.summary(),
-                    tiles_quarantined=len(quarantined),
-                )
-            finally:
-                # the terminal-event emit may raise (full disk) and that error
-                # should surface on a succeeded run — but close() must still
-                # run, or the metrics port / exporter thread / event fd leak
-                # into the caller's process
-                summary["telemetry"] = {
-                    "events": telemetry.events_file,
-                    "metrics": telemetry.metrics_file,
-                }
-                if telemetry.metrics_port is not None:
-                    summary["telemetry"]["metrics_port"] = telemetry.metrics_port
-                telemetry.close()  # final exposition flush before anyone reads it
-                # the closed event log can take no more fault_injected emits;
-                # merge.peer fires past this point are still counted/logged
-                # by the plan itself
+                    # wait bound scaled to THIS run: all hosts started together on
+                    # similar tile shares, so a straggler peer gets up to the
+                    # primary's own wall again — but capped, because a peer that
+                    # died WITHOUT its run_done line (OOM kill) must not make the
+                    # primary of a 10-hour run poll for another 10 hours; then
+                    # the partial fold (with its log warning) is the right answer.
+                    # cfg.merge_timeout_s overrides for pods whose straggler
+                    # profile the operator knows better than this heuristic.
+                    merge_timeout_s = (
+                        cfg.merge_timeout_s
+                        if cfg.merge_timeout_s is not None
+                        else max(60.0, min(2.0 * wall, 900.0))
+                    )
+                    summary["telemetry"]["hosts"] = merge_host_event_logs(
+                        cfg.workdir,
+                        expect_hosts=jax.process_count(),
+                        timeout_s=merge_timeout_s,
+                        # coarsen the straggler poll with the wait bound: a 900s
+                        # wait does not need 10Hz probes of a shared filesystem
+                        poll_s=max(0.1, min(2.0, merge_timeout_s / 600.0)),
+                        # guard a reused workdir: a peer file untouched since this
+                        # run began (60s clock-skew slack) holds only a PREVIOUS
+                        # scope — its old run_done must not pass for a live host
+                        newer_than=time.time() - wall - 60.0,
+                    )
+        finally:
+            if fault_plan is not None:
+                # disarmed only now, AFTER the multihost merge — the
+                # merge.peer seam fires inside merge_host_event_logs; the
+                # injection log is collected last for the same reason
+                summary["faults_injected"] = [
+                    {"seam": s, "index": i, "error": k}
+                    for s, i, k in fault_plan.injected()
+                ]
                 faults.set_observer(None)
-            if jax.process_count() > 1 and jax.process_index() == 0:
-                # primary-host fold: per-process event files live in the SHARED
-                # workdir (the manifest's filesystem is the pod's job state), so
-                # the merge is a bounded wait for every peer's run_done line —
-                # no collective, usable even when a peer aborted
-                from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+                faults.deactivate()
+        log.info("run complete: %s", summary)
+        self.summary = summary
+        return summary
 
-                # wait bound scaled to THIS run: all hosts started together on
-                # similar tile shares, so a straggler peer gets up to the
-                # primary's own wall again — but capped, because a peer that
-                # died WITHOUT its run_done line (OOM kill) must not make the
-                # primary of a 10-hour run poll for another 10 hours; then
-                # the partial fold (with its log warning) is the right answer.
-                # cfg.merge_timeout_s overrides for pods whose straggler
-                # profile the operator knows better than this heuristic.
-                merge_timeout_s = (
-                    cfg.merge_timeout_s
-                    if cfg.merge_timeout_s is not None
-                    else max(60.0, min(2.0 * wall, 900.0))
-                )
-                summary["telemetry"]["hosts"] = merge_host_event_logs(
-                    cfg.workdir,
-                    expect_hosts=jax.process_count(),
-                    timeout_s=merge_timeout_s,
-                    # coarsen the straggler poll with the wait bound: a 900s
-                    # wait does not need 10Hz probes of a shared filesystem
-                    poll_s=max(0.1, min(2.0, merge_timeout_s / 600.0)),
-                    # guard a reused workdir: a peer file untouched since this
-                    # run began (60s clock-skew slack) holds only a PREVIOUS
-                    # scope — its old run_done must not pass for a live host
-                    newer_than=time.time() - wall - 60.0,
-                )
-    finally:
-        if fault_plan is not None:
-            # disarmed only now, AFTER the multihost merge — the
-            # merge.peer seam fires inside merge_host_event_logs; the
-            # injection log is collected last for the same reason
-            summary["faults_injected"] = [
-                {"seam": s, "index": i, "error": k}
-                for s, i, k in fault_plan.injected()
-            ]
-            faults.set_observer(None)
-            faults.deactivate()
-    log.info("run complete: %s", summary)
-    return summary
+
 
 
 def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:

@@ -33,6 +33,10 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     manifest truncates its own artifact, then raises)
 ``merge.peer``      multihost event merge — a probed peer reads as
                     not-terminal (slow/dead peer; behavioral)
+``serve.submit``    serve-mode job admission (``serve/server.py``): the
+                    submission fails and is rejected; the server lives
+``serve.job``       serve-mode job execution start: the job fails
+                    terminally; sibling jobs and the server live
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -98,6 +102,8 @@ SEAMS = (
     "manifest.record",
     "manifest.torn",
     "merge.peer",
+    "serve.submit",
+    "serve.job",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -115,6 +121,8 @@ _DEFAULT_KIND = {
     "manifest.record": "io",
     "manifest.torn": "fire",
     "merge.peer": "fire",
+    "serve.submit": "io",
+    "serve.job": "runtime",
 }
 
 

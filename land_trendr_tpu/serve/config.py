@@ -1,0 +1,181 @@
+"""Service-mode configuration: everything that defines one server process.
+
+:class:`ServeConfig` is the serve-layer sibling of
+:class:`~land_trendr_tpu.runtime.driver.RunConfig`: the one configuration
+surface of ``lt serve``, projected to the ``serve`` CLI subcommand and to
+README's ``## Serve configuration`` table (the LT004 coupling rule checks
+all three, exactly like the RunConfig triangle).
+
+Security posture: the job API is an **unauthenticated local control
+surface** (submit arbitrary segmentation work, read job state, cancel),
+so unlike the scrape-only ``/metrics`` endpoint it is loopback-ONLY —
+``serve_host`` must name a loopback address and the config refuses
+anything else at construction time.  Remote access goes through an
+authenticated proxy or the filesystem drop-box, never a raw bind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["LOOPBACK_HOSTS", "ServeConfig"]
+
+#: the bind addresses the job API accepts — loopback spellings only (the
+#: API is unauthenticated job submission; see the module docstring)
+LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Everything that defines one ``lt serve`` server process."""
+
+    #: server root: the server's own events/metrics stream, the default
+    #: per-job ``jobs/<job_id>/{work,out}`` directories, and (with
+    #: ``ingest_store_mb``) the shared persistent ingest store live here
+    workdir: str = "lt_serve"
+    #: loopback HTTP JSON API port (0 = ephemeral, reported at startup)
+    serve_port: int = 0
+    #: bind address for the job API — loopback only (``127.0.0.1``,
+    #: ``localhost`` or ``::1``); see the module docstring
+    serve_host: str = "127.0.0.1"
+    #: admission control: a submission that would grow the queue past
+    #: this depth is rejected with HTTP 429 (``job_rejected`` event,
+    #: ``lt_serve_rejections_total``) instead of building unbounded
+    #: backlog — the client owns the retry policy
+    serve_queue_depth: int = 16
+    #: admission control: per-tenant in-flight bound (queued + running
+    #: jobs); a tenant at its cap gets 429 while other tenants' traffic
+    #: proceeds — one hot tenant cannot monopolise the queue
+    tenant_max_inflight: int = 4
+    #: default per-job wall-clock bound, submit-accepted → terminal; a
+    #: job that exceeds it is cancelled through the run's cancel event
+    #: and reported ``stalled`` (the exit-4 analog — the stall
+    #: watchdog's job-level mirror).  Jobs may override per request.
+    #: ``None`` disables the default bound.
+    job_timeout_s: float | None = None
+    #: filesystem drop-box for batch submission: job-request JSON files
+    #: appearing under this directory are claimed atomically (rename),
+    #: submitted through the SAME admission control as HTTP, and answered
+    #: with ``<name>.rejected.json`` / terminal ``<name>.result.json``
+    #: sidecars.  ``None`` disables the scanner.
+    dropbox_dir: str | None = None
+    #: drop-box scan period, seconds
+    dropbox_poll_s: float = 1.0
+    #: drain this many jobs to a terminal state, then shut down cleanly —
+    #: the bounded mode benches and tests drive; ``None`` serves forever
+    max_jobs: int | None = None
+    #: process-wide decoded-block cache budget (MiB) shared by every job
+    #: (the server owns the :mod:`land_trendr_tpu.io.blockcache`
+    #: configuration; per-job RunConfig cache knobs are overridden)
+    feed_cache_mb: int = 256
+    #: shared feed-decode threads (the blockcache knob): 0 = auto
+    decode_workers: int = 0
+    #: shared persistent ingest store budget (MiB): decoded blocks from
+    #: EVERY job spill to one store under the server workdir, so a warm
+    #: job over already-ingested stacks skips TIFF decode entirely —
+    #: "ingest once, serve many" across requests.  0 = off.
+    ingest_store_mb: int = 0
+    #: store directory override (default ``<workdir>/ingest_store``)
+    ingest_store_dir: str | None = None
+    #: server + per-job telemetry: the server writes its own
+    #: ``events.jsonl`` scope (job lifecycle, admission, program-cache
+    #: aggregate) and ``lt_serve_*`` metrics under ``workdir``; each
+    #: job's run writes its own scope under the job workdir with the
+    #: job_id threaded onto every event
+    telemetry: bool = True
+    #: with ``telemetry``: serve the server registry's live ``/metrics``
+    #: on this port (0 = ephemeral).  ``None`` = no standalone metrics
+    #: server (the job API serves GET /metrics regardless).
+    metrics_port: int | None = None
+    #: bind address for ``metrics_port`` (the scrape endpoint may be
+    #: non-loopback — it is read-only, unlike the job API)
+    metrics_host: str = ""
+    #: ``metrics.prom`` refresh period, seconds
+    metrics_interval_s: float = 5.0
+    #: deterministic fault injection for soak runs: the server arms ONE
+    #: process-wide plan shared by every job (``serve.submit`` /
+    #: ``serve.job`` seams plus all the pipeline seams); production
+    #: servers leave this unset
+    fault_schedule: str | None = None
+
+    def __post_init__(self) -> None:
+        if not (0 <= self.serve_port <= 65535):
+            raise ValueError(
+                f"serve_port={self.serve_port} outside 0..65535"
+            )
+        if self.serve_host not in LOOPBACK_HOSTS:
+            raise ValueError(
+                f"serve_host={self.serve_host!r} is not a loopback "
+                f"address {LOOPBACK_HOSTS}: the job API is an "
+                "unauthenticated control surface and never binds a "
+                "routable interface (front it with an authenticated "
+                "proxy, or use the drop-box)"
+            )
+        if self.serve_queue_depth < 1:
+            raise ValueError(
+                f"serve_queue_depth={self.serve_queue_depth} must be >= 1"
+            )
+        if self.tenant_max_inflight < 1:
+            raise ValueError(
+                f"tenant_max_inflight={self.tenant_max_inflight} must be "
+                ">= 1"
+            )
+        if self.job_timeout_s is not None and self.job_timeout_s <= 0:
+            raise ValueError(
+                f"job_timeout_s={self.job_timeout_s} must be > 0 (or "
+                "None for no default bound)"
+            )
+        if self.dropbox_poll_s <= 0:
+            raise ValueError(
+                f"dropbox_poll_s={self.dropbox_poll_s} must be > 0"
+            )
+        if self.max_jobs is not None and self.max_jobs < 1:
+            raise ValueError(
+                f"max_jobs={self.max_jobs} must be >= 1 (or None to "
+                "serve forever)"
+            )
+        if self.feed_cache_mb < 0:
+            raise ValueError(
+                f"feed_cache_mb={self.feed_cache_mb} must be >= 0 (0 = off)"
+            )
+        if self.decode_workers < 0:
+            raise ValueError(
+                f"decode_workers={self.decode_workers} must be >= 0 "
+                "(0 = auto)"
+            )
+        if self.ingest_store_mb < 0:
+            raise ValueError(
+                f"ingest_store_mb={self.ingest_store_mb} must be >= 0 "
+                "(0 = off)"
+            )
+        if self.ingest_store_dir is not None and not self.ingest_store_mb:
+            raise ValueError(
+                "ingest_store_dir requires ingest_store_mb > 0 (there is "
+                "no store to place without a budget)"
+            )
+        if self.metrics_port is not None:
+            if not self.telemetry:
+                raise ValueError(
+                    "metrics_port requires telemetry=True (the registry "
+                    "the endpoint serves only exists on telemetry runs)"
+                )
+            if not (0 <= self.metrics_port <= 65535):
+                raise ValueError(
+                    f"metrics_port={self.metrics_port} outside 0..65535"
+                )
+        elif self.metrics_host:
+            raise ValueError(
+                "metrics_host requires metrics_port (there is no server "
+                "to bind without a port)"
+            )
+        if self.metrics_interval_s <= 0:
+            raise ValueError(
+                f"metrics_interval_s={self.metrics_interval_s} must be > 0"
+            )
+        if self.fault_schedule is not None:
+            # parse NOW: a typo'd seam is a config error at startup, not
+            # a dead injection discovered after the soak run (the same
+            # contract as RunConfig.fault_schedule)
+            from land_trendr_tpu.runtime import faults
+
+            faults.parse_schedule(self.fault_schedule)

@@ -1,0 +1,252 @@
+"""Job model: the request schema, lifecycle states, and exit-code mapping.
+
+A **job** is one AOI/param segmentation request — the serve-mode unit of
+work, exactly what one ``lt segment`` invocation does, minus the process
+start, config parse, jit compile and (with the shared ingest store) the
+TIFF decode that invocation would pay.  Requests arrive as JSON (HTTP
+POST or drop-box file), are validated into :class:`JobRequest`, and run
+through a warm :class:`~land_trendr_tpu.runtime.driver.Run`.
+
+Job states map onto the documented CLI exit-code contract (README
+§Failure semantics) so orchestrators reason about one table:
+
+=====================  ====  =================================================
+state                  exit  meaning
+=====================  ====  =================================================
+``done``               0     run + assembly completed
+``config_error``       2     bad request / bad stack (not retryable as-is)
+``retries_exhausted``  3     tile(s) exhausted retries / quarantined —
+                             manifest resumable (see below)
+``stalled``            4     job timeout (the stall watchdog's job-level
+                             analog) — manifest resumable
+``cancelled``          3     explicit cancel — manifest resumable like any
+                             retryable abort
+``error``              1     unclassified failure (server-side defect)
+=====================  ====  =================================================
+
+**Resuming**: each fresh submission gets a fresh ``jobs/<id>/work``
+manifest, so resuming a retryable job means resubmitting with the OLD
+job's ``workdir`` pinned in the request (the terminal error string and
+the job's status snapshot both carry it); only then does the new job
+complete exactly the remaining tiles.
+
+``queued`` / ``running`` are the non-terminal states; ``rejected``
+submissions never become jobs (they are answered at admission with the
+429-style response and a ``job_rejected`` event).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+__all__ = [
+    "EXIT_CODE_FOR_STATE",
+    "TERMINAL_STATES",
+    "Job",
+    "JobRequest",
+]
+
+#: terminal job states (see the module docstring's mapping table)
+TERMINAL_STATES = (
+    "done",
+    "config_error",
+    "retries_exhausted",
+    "stalled",
+    "cancelled",
+    "error",
+)
+
+#: job state → the CLI exit code the same outcome would have produced
+EXIT_CODE_FOR_STATE = {
+    "done": 0,
+    "error": 1,
+    "config_error": 2,
+    "retries_exhausted": 3,
+    "cancelled": 3,
+    "stalled": 4,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobRequest:
+    """One validated AOI/param request.
+
+    The fields mirror the ``segment`` CLI surface a client would
+    otherwise drive; ``run_overrides`` passes any further
+    :class:`~land_trendr_tpu.runtime.driver.RunConfig` field straight
+    through (validated by RunConfig itself — an unknown field or bad
+    value is a ``config_error``).  Cache/store knobs are NOT accepted:
+    the server owns the process-wide cache and the shared ingest store.
+    """
+
+    stack_dir: str
+    index: str = "nbr"
+    ftv: tuple[str, ...] = ()
+    params: "dict | None" = None
+    tile_size: int = 256
+    products: "tuple[str, ...] | None" = None
+    workdir: "str | None" = None  # default <serve workdir>/jobs/<id>/work
+    out_dir: "str | None" = None  # default <serve workdir>/jobs/<id>/out
+    tenant: str = "default"
+    priority: int = 0  # higher drains first; FIFO within a priority
+    timeout_s: "float | None" = None  # overrides ServeConfig.job_timeout_s
+    max_retries: int = 2
+    quarantine_tiles: bool = False
+    lazy: bool = False  # windowed C2 ingest (the ingest-store workload)
+    assemble: bool = True  # mosaic rasters after the run
+    #: resume the manifest found in THIS job's workdir — effective for
+    #: resubmissions only when the request pins the prior job's
+    #: ``workdir`` (fresh submissions get fresh jobs/<id>/work dirs)
+    resume: bool = True
+    run_overrides: "dict | None" = None
+
+    #: the per-run knobs the server owns (shared cache/store) or that
+    #: cannot mean anything inside a server process — rejected even via
+    #: run_overrides, so a request cannot clobber sibling jobs
+    _RESERVED_OVERRIDES = (
+        "feed_cache_mb",
+        "decode_workers",
+        "ingest_store_mb",
+        "ingest_store_dir",
+        "telemetry",
+        "metrics_port",
+        "metrics_host",
+        "fault_schedule",
+        "stall_timeout_s",
+    )
+
+    @classmethod
+    def from_payload(cls, payload: dict) -> "JobRequest":
+        """Parse + validate one submission payload (HTTP body or
+        drop-box file).  Raises ``ValueError`` on anything malformed —
+        the admission layer maps that to a 400-class rejection."""
+        if not isinstance(payload, dict):
+            raise ValueError(
+                f"job request must be a JSON object, got "
+                f"{type(payload).__name__}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(payload) - known)
+        if unknown:
+            raise ValueError(f"unknown job request field(s): {unknown}")
+        if "stack_dir" not in payload or not isinstance(
+            payload["stack_dir"], str
+        ):
+            raise ValueError("job request needs a string 'stack_dir'")
+        kw = dict(payload)
+        if "ftv" in kw:
+            if isinstance(kw["ftv"], str):
+                kw["ftv"] = tuple(s for s in kw["ftv"].split(",") if s)
+            else:
+                kw["ftv"] = tuple(kw["ftv"])
+        if kw.get("products") is not None:
+            kw["products"] = tuple(kw["products"])
+        req = cls(**kw)
+        if req.priority < -100 or req.priority > 100:
+            raise ValueError(
+                f"priority={req.priority} outside -100..100"
+            )
+        if req.timeout_s is not None and req.timeout_s <= 0:
+            raise ValueError(f"timeout_s={req.timeout_s} must be > 0")
+        if req.tile_size < 1:
+            raise ValueError(f"tile_size={req.tile_size} must be >= 1")
+        if req.max_retries < 0:
+            raise ValueError(
+                f"max_retries={req.max_retries} must be >= 0"
+            )
+        if not req.tenant or not isinstance(req.tenant, str):
+            raise ValueError("tenant must be a non-empty string")
+        overrides = req.run_overrides or {}
+        if not isinstance(overrides, dict):
+            raise ValueError("run_overrides must be a JSON object")
+        reserved = sorted(set(overrides) & set(cls._RESERVED_OVERRIDES))
+        if reserved:
+            raise ValueError(
+                f"run_overrides may not set server-owned field(s): "
+                f"{reserved}"
+            )
+        return req
+
+    def to_run_config(self, workdir: str, out_dir: str, telemetry: bool):
+        """Project this request onto a RunConfig over the job's resolved
+        directories.
+
+        The server's cache/store configuration deliberately does NOT
+        ride the RunConfig (the Run uses the process-wide cache and the
+        server's ``shared_store`` as configured once at startup);
+        RunConfig validation errors propagate as ``ValueError`` — the
+        ``config_error`` terminal state.
+        """
+        from land_trendr_tpu.config import LTParams
+        from land_trendr_tpu.runtime import RunConfig
+
+        kw = dict(
+            index=self.index,
+            ftv_indices=tuple(self.ftv),
+            params=LTParams.from_dict(self.params or {}),
+            tile_size=self.tile_size,
+            products=self.products,
+            workdir=workdir,
+            out_dir=out_dir,
+            resume=self.resume,
+            max_retries=self.max_retries,
+            quarantine_tiles=self.quarantine_tiles,
+            telemetry=telemetry,
+        )
+        kw.update(self.run_overrides or {})
+        return RunConfig(**kw)
+
+
+@dataclasses.dataclass
+class Job:
+    """One admitted job's mutable server-side record.
+
+    All mutation happens under the server's lock (the dispatcher and the
+    HTTP handler threads share these records); :meth:`status` snapshots
+    a JSON-safe view for the API.
+    """
+
+    job_id: str
+    request: JobRequest
+    source: str = "http"  # "http" | "dropbox"
+    state: str = "queued"
+    submitted_t: float = dataclasses.field(default_factory=time.time)
+    started_t: "float | None" = None
+    finished_t: "float | None" = None
+    error: "str | None" = None
+    summary: "dict | None" = None
+    outputs: "dict | None" = None
+    workdir: "str | None" = None
+    out_dir: "str | None" = None
+    #: the Run-level cancel event (explicit cancel AND job timeout)
+    cancel: threading.Event = dataclasses.field(
+        default_factory=threading.Event
+    )
+    timed_out: bool = False
+    dropbox_path: "str | None" = None
+
+    def status_locked(self) -> dict:
+        """JSON-safe snapshot; caller holds the server lock."""
+        out = {
+            "job_id": self.job_id,
+            "state": self.state,
+            "tenant": self.request.tenant,
+            "priority": self.request.priority,
+            "source": self.source,
+            "submitted_t": self.submitted_t,
+            "started_t": self.started_t,
+            "finished_t": self.finished_t,
+            "workdir": self.workdir,
+            "out_dir": self.out_dir,
+        }
+        if self.state in TERMINAL_STATES:
+            out["exit_code"] = EXIT_CODE_FOR_STATE.get(self.state, 1)
+        if self.error is not None:
+            out["error"] = self.error
+        if self.summary is not None:
+            out["summary"] = self.summary
+        if self.outputs is not None:
+            out["outputs"] = self.outputs
+        return out

@@ -1,0 +1,100 @@
+"""Warm program cache: compiled-program admission across jobs.
+
+Every device program the tile pipeline runs — the fused segmentation
+program (:func:`land_trendr_tpu.ops.tile.process_tile_dn`), the packed
+fetch/upload pack+unpack programs — is a **module-level** ``jax.jit``
+function with static arguments, so XLA executables live in JAX's
+in-process jit cache and stay resident for the life of the process.
+What a long-lived server needs on top is the *contract* and the
+*accounting*:
+
+* an explicit **cache key** over everything that selects a distinct
+  executable set — the run fingerprint (index, params, tile/chunk
+  geometry, products, years shape), the backend, the resolved kernel
+  impl, the mesh width, the packed-path choices, and the fed dtypes —
+  so "warm" is a checkable property, not a hope;
+* **admission**: the driver's serve path
+  (:class:`land_trendr_tpu.runtime.driver.Run` with ``programs=``) asks
+  this cache before the first tile.  A **miss** pays the compile right
+  there, against one fully-masked dummy tile pushed through the exact
+  upload → dispatch → fetch chain (the executables JAX caches are the
+  ones every real tile reuses); a **hit** skips the probe entirely — a
+  warm job runs **zero** compiles, which ``tools/serve_bench.py``
+  measures and the perf gate asserts structurally;
+* **observability**: per-run hit/miss/compile_s (the ``program_cache``
+  event) and server-wide totals for the ``lt_serve_*`` warm-ratio
+  instruments.
+
+The process is the residency boundary: keys index executables that JAX
+itself keeps alive, so there is nothing to pin and nothing to evict —
+the entry table is bytes per key, not megabytes per program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+__all__ = ["ProgramCache"]
+
+
+class ProgramCache:
+    """Thread-safe admission index + accounting over JAX's jit cache."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: key → compile seconds its miss paid
+        self._compiled: dict[str, float] = {}
+        self._hits = 0
+        self._misses = 0
+        self._compile_s = 0.0
+
+    @staticmethod
+    def key_for(**facts) -> str:
+        """Deterministic key over the compile-relevant run facts.
+
+        Callers pass plain JSON-able values (the driver passes the run
+        fingerprint, backend, impl, mesh width, padded pixel count,
+        years count, chunking, packed-path flags, and fed dtypes); the
+        key is the sorted-JSON digest, so fact ordering never matters
+        and new facts can ride along without a format change.
+        """
+        blob = json.dumps(facts, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def admit(self, key: str) -> bool:
+        """True when ``key``'s programs are already resident (a warm
+        run); False when the caller must compile (and then
+        :meth:`record` the miss)."""
+        with self._lock:
+            return key in self._compiled
+
+    def record(
+        self, key: str, hit: bool, compile_s: float = 0.0, ok: bool = True
+    ) -> None:
+        """Account one run's verdict; a SUCCESSFUL miss registers the
+        key as resident for every later run.  ``ok=False`` (the warm
+        probe failed — nothing was compiled) counts the miss but leaves
+        the key unregistered, so the next same-key run probes again
+        instead of being falsely admitted warm."""
+        with self._lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+                self._compile_s += float(compile_s)
+                if ok:
+                    self._compiled.setdefault(key, float(compile_s))
+
+    def stats(self) -> dict:
+        """Server-wide totals: hits/misses/compile_s plus the resident
+        key count (the ``program_cache`` server-scope aggregate and the
+        ``lt_serve_*`` warm-ratio feed)."""
+        with self._lock:
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "compile_s": round(self._compile_s, 6),
+                "keys": len(self._compiled),
+            }

@@ -1,0 +1,919 @@
+"""Segmentation-as-a-service: the long-lived server runtime.
+
+One :class:`SegmentationServer` process keeps everything a ``segment``
+invocation pays for *resident across requests* — the JAX executables
+(via the :class:`~land_trendr_tpu.serve.programs.ProgramCache` admission
+index), the process-wide decoded-block cache, and the shared persistent
+ingest store ("ingest once, serve many") — and drains a bounded job
+queue through warm :class:`~land_trendr_tpu.runtime.driver.Run` objects.
+A warm job (same request shape, same stacks) runs **zero** jit compiles
+and **zero** TIFF decodes; ``tools/serve_bench.py`` measures exactly
+that and the perf gate asserts it structurally.
+
+Layout:
+
+* **submission** — a loopback-only HTTP JSON API (stdlib
+  ``http.server``; the bind address is validated by
+  :class:`~land_trendr_tpu.serve.config.ServeConfig` — the job API is an
+  unauthenticated control surface) plus a filesystem drop-box for batch
+  use, both funneling through ONE admission path;
+* **admission control** — bounded queue depth and a per-tenant in-flight
+  cap, each rejected with HTTP 429 (``job_rejected`` event,
+  ``lt_serve_rejections_total``) so backlog is the client's problem, not
+  the server's memory;
+* **scheduling** — a priority queue (higher ``priority`` first, FIFO
+  within a priority) drained by ONE dispatcher on the thread that called
+  :meth:`SegmentationServer.serve_forever`; tiles inside a job already
+  pipeline across feed/upload/compute/fetch/write, so job-level
+  parallelism would only thrash the device;
+* **failure semantics** — per-job timeout and cancel ride the run's
+  cancel event (the manifest stays resumable; a resubmitted job resumes
+  it), tile-level faults keep their retry/quarantine contract, and a
+  job that exhausts retries is reported failed WITHOUT taking down the
+  server or sibling jobs (the ``serve.submit`` / ``serve.job`` fault
+  seams soak exactly this).  Job states map onto the CLI exit-code
+  contract (:data:`~land_trendr_tpu.serve.jobs.EXIT_CODE_FOR_STATE`).
+
+Observability: the server writes its own ``events.jsonl`` scope (job
+lifecycle + admission + the program-cache aggregate) and ``lt_serve_*``
+instruments under its workdir; every job's run writes its own scope
+under the job workdir with the ``job_id`` threaded onto every event.
+"""
+
+from __future__ import annotations
+
+import heapq
+import http.server
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any
+
+from land_trendr_tpu.io import blockcache
+from land_trendr_tpu.obs.events import EventLog
+from land_trendr_tpu.obs.metrics import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+    PromFileExporter,
+)
+from land_trendr_tpu.runtime import faults
+from land_trendr_tpu.serve.config import ServeConfig
+from land_trendr_tpu.serve.jobs import Job, JobRequest
+from land_trendr_tpu.serve.programs import ProgramCache
+
+__all__ = ["Rejection", "SegmentationServer"]
+
+log = logging.getLogger("land_trendr_tpu.serve")
+
+#: job-latency histogram buckets: sub-second warm smokes through
+#: multi-hour scene jobs
+_JOB_BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 300, 1800, 7200, 43200)
+
+
+class Rejection(Exception):
+    """A submission refused at admission: carries the HTTP status and a
+    machine-readable reason (``queue_full`` / ``tenant_cap`` /
+    ``bad_request`` / ``submit_error`` / ``shutting_down``)."""
+
+    def __init__(self, http_status: int, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.http_status = int(http_status)
+        self.reason = reason
+        self.detail = detail
+
+
+class _ServeTelemetry:
+    """The server's own events scope + ``lt_serve_*`` instruments.
+
+    Job lifecycle, admission verdicts and the warm-cache aggregate live
+    HERE (one scope for the server's whole life); per-job tile traffic
+    lives in each job's own run scope under the job workdir.  The stream
+    opens with a ``run_start`` (fingerprint ``"serve"``, zero tiles) and
+    closes with a ``run_done`` so every existing consumer — schema lint,
+    ``obs_report`` — folds it without special cases.
+    """
+
+    def __init__(self, cfg: ServeConfig) -> None:
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self.events = EventLog(os.path.join(cfg.workdir, "events.jsonl"))
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self._queue_depth = r.gauge(
+            "lt_serve_queue_depth", "jobs queued awaiting the dispatcher"
+        )
+        self._running = r.gauge(
+            "lt_serve_running", "1 while a job is executing, else 0"
+        )
+        self._submitted = r.counter(
+            "lt_serve_jobs_submitted_total", "jobs admitted into the queue"
+        )
+        self._rejections = r.counter(
+            "lt_serve_rejections_total",
+            "submissions refused by admission control (429-style)",
+        )
+        self._job_hist = r.histogram(
+            "lt_serve_job_seconds",
+            "job latency, submit to terminal state",
+            buckets=_JOB_BUCKETS,
+        )
+        self._prog_hits = r.counter(
+            "lt_serve_program_hits_total",
+            "runs admitted warm (zero jit compiles)",
+        )
+        self._prog_misses = r.counter(
+            "lt_serve_program_misses_total",
+            "runs that compiled their program set (cold)",
+        )
+        self._prog_compile_s = r.counter(
+            "lt_serve_compile_seconds_total",
+            "seconds spent compiling program sets on cache misses",
+        )
+        self._warm_ratio = r.gauge(
+            "lt_serve_warm_hit_ratio",
+            "program-cache hits / (hits + misses) over the server's life",
+        )
+        self._jobs_done: dict[str, Any] = {}
+        self._prog_lock = threading.Lock()
+        self._last_prog = {"hits": 0, "misses": 0, "compile_s": 0.0}
+        try:
+            self.events.run_start(
+                fingerprint="serve",
+                process_index=0,
+                process_count=1,
+                tiles_total=0,
+                tiles_todo=0,
+                tiles_skipped_resume=0,
+                mesh_devices=0,
+                impl="serve",
+            )
+            self._server = (
+                MetricsHTTPServer(
+                    self.registry, cfg.metrics_port, host=cfg.metrics_host
+                )
+                if cfg.metrics_port is not None
+                else None
+            )
+            self._exporter = PromFileExporter(
+                self.registry,
+                os.path.join(cfg.workdir, "metrics.prom"),
+                interval_s=cfg.metrics_interval_s,
+            ).start()
+        except BaseException:
+            # a half-built telemetry bundle must not leak the event fd /
+            # exporter thread / metrics port into the caller's process
+            srv = getattr(self, "_server", None)
+            if srv is not None:
+                srv.stop()
+            self.events.close()
+            raise
+
+    def _done_counter(self, status: str):
+        c = self._jobs_done.get(status)
+        if c is None:
+            c = self._jobs_done[status] = self.registry.counter(
+                "lt_serve_jobs_done_total",
+                "jobs reaching a terminal state, by status",
+                labels={"status": status},
+            )
+        return c
+
+    # -- server hooks ------------------------------------------------------
+    def job_submitted(self, job: Job, queue_depth: int) -> None:
+        self.events.emit(
+            "job_submitted",
+            job_id=job.job_id,
+            tenant=job.request.tenant,
+            priority=job.request.priority,
+            queue_depth=queue_depth,
+            source=job.source,
+        )
+        self._submitted.inc()
+        self._queue_depth.set(queue_depth)
+
+    def job_rejected(
+        self,
+        reason: str,
+        queue_depth: int,
+        tenant: "str | None" = None,
+    ) -> None:
+        fields: dict = {}
+        if tenant:
+            fields["tenant"] = tenant
+        self.events.emit(
+            "job_rejected", reason=reason, queue_depth=queue_depth, **fields
+        )
+        self._rejections.inc()
+
+    def job_start(self, job: Job, wait_s: float, queue_depth: int) -> None:
+        self.events.emit(
+            "job_start",
+            job_id=job.job_id,
+            tenant=job.request.tenant,
+            wait_s=round(wait_s, 6),
+        )
+        self._running.set(1)
+        self._queue_depth.set(queue_depth)
+
+    def job_done(self, job: Job, wall_s: float) -> None:
+        fields: dict = {}
+        if job.error:
+            fields["error"] = job.error
+        quarantined = (job.summary or {}).get("tiles_quarantined")
+        if quarantined:
+            fields["tiles_quarantined"] = len(quarantined)
+        self.events.emit(
+            "job_done",
+            job_id=job.job_id,
+            status=job.state,
+            wall_s=round(wall_s, 6),
+            **fields,
+        )
+        self._running.set(0)
+        self._job_hist.observe(wall_s)
+        self._done_counter(job.state).inc()
+
+    def program_cache(self, stats: dict) -> None:
+        """Refresh the warm-ratio instruments from the server-wide
+        totals (called after every job; the terminal aggregate event is
+        emitted once at :meth:`close`).  Counters advance by delta —
+        ``stats`` is cumulative."""
+        with self._prog_lock:
+            last = self._last_prog
+            self._prog_hits.inc(stats.get("hits", 0) - last["hits"])
+            self._prog_misses.inc(
+                stats.get("misses", 0) - last["misses"]
+            )
+            self._prog_compile_s.inc(
+                max(0.0, stats.get("compile_s", 0.0) - last["compile_s"])
+            )
+            self._last_prog = {
+                "hits": stats.get("hits", 0),
+                "misses": stats.get("misses", 0),
+                "compile_s": stats.get("compile_s", 0.0),
+            }
+        hits, misses = stats.get("hits", 0), stats.get("misses", 0)
+        if hits + misses:
+            self._warm_ratio.set(hits / (hits + misses))
+
+    def close(self, status: str, wall_s: float, stats: dict) -> None:
+        try:
+            self.events.emit(
+                "program_cache",
+                hits=int(stats.get("hits", 0)),
+                misses=int(stats.get("misses", 0)),
+                compile_s=round(float(stats.get("compile_s", 0.0)), 6),
+                keys=int(stats.get("keys", 0)),
+            )
+            self.events.emit(
+                "run_done",
+                status=status,
+                tiles_done=0,
+                pixels=0,
+                wall_s=round(wall_s, 3),
+                px_per_s=0.0,
+                fit_rate=0.0,
+            )
+        finally:
+            try:
+                if self._server is not None:
+                    self._server.stop()
+                    self._server = None
+            finally:
+                try:
+                    self._exporter.stop()
+                finally:
+                    self.events.close()
+
+
+class SegmentationServer:
+    """Long-lived segmentation server over one process's warm state."""
+
+    def __init__(self, cfg: ServeConfig) -> None:
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self._lock = threading.Lock()
+        # the condition WRAPS self._lock (same lock object): guarded
+        # state is always mutated under `with self._lock`, and the
+        # condition is only used for wait/notify while holding it
+        self._cond = threading.Condition(self._lock)
+        self._jobs: dict[str, Job] = {}
+        self._queue: list = []  # heap of (-priority, seq, job_id)
+        self._seq = 0
+        self._queued = 0
+        self._terminal = 0
+        self._stopping = False
+        self._running_id: "str | None" = None
+        self.programs = ProgramCache()
+
+        # the shared warm state every job rides: ONE process-wide cache
+        # configuration (the server owns it; Run skips reconfiguring when
+        # handed a shared store) and ONE persistent ingest store
+        self.store = None
+        if cfg.ingest_store_mb:
+            from land_trendr_tpu.io.blockstore import BlockStore
+
+            self.store = BlockStore(
+                cfg.ingest_store_dir
+                or os.path.join(cfg.workdir, "ingest_store"),
+                budget_bytes=cfg.ingest_store_mb << 20,
+            )
+        blockcache.configure(
+            budget_bytes=cfg.feed_cache_mb << 20,
+            workers=cfg.decode_workers,
+            store=self.store,
+        )
+
+        self.telemetry = _ServeTelemetry(cfg) if cfg.telemetry else None
+        self._t0 = time.time()
+
+        # one process-wide fault plan shared by every job (soak mode);
+        # jobs carrying their own schedule are rejected by the Run
+        self._fault_plan = None
+        if cfg.fault_schedule:
+            self._fault_plan = faults.activate(
+                faults.parse_schedule(cfg.fault_schedule)
+            )
+            log.warning(
+                "serve fault injection ACTIVE (%s) — this is a soak run",
+                cfg.fault_schedule,
+            )
+
+        try:
+            self._httpd = _JobAPIServer(
+                (cfg.serve_host, cfg.serve_port), self
+            )
+        except BaseException:
+            self._shutdown_shared(status="aborted")
+            raise
+        self.port = int(self._httpd.server_address[1])
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="lt-serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+
+        self._dropbox_stop = threading.Event()
+        self._dropbox_thread = None
+        if cfg.dropbox_dir:
+            os.makedirs(cfg.dropbox_dir, exist_ok=True)
+            self._dropbox_thread = threading.Thread(
+                target=self._dropbox_loop,
+                name="lt-serve-dropbox",
+                daemon=True,
+            )
+            self._dropbox_thread.start()
+        log.info(
+            "serving on %s:%d (queue depth %d, %s)",
+            cfg.serve_host, self.port, cfg.serve_queue_depth,
+            f"max_jobs={cfg.max_jobs}" if cfg.max_jobs else "unbounded",
+        )
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, payload: dict, source: str = "http") -> dict:
+        """One submission through admission control; returns the queued
+        job's status snapshot or raises :class:`Rejection`.
+
+        The ``serve.submit`` fault seam fires here: an injected
+        submission fault is a rejected request and a live server, never
+        a dead one.
+        """
+        tenant = (
+            payload.get("tenant", "default")
+            if isinstance(payload, dict)
+            else None
+        )
+        if not isinstance(tenant, str):
+            # an adversarial non-string tenant must not leak into the
+            # job_rejected event (its tenant field is schema-typed str)
+            tenant = None
+        req = None
+        rejection: "tuple[int, str, str] | None" = None
+        try:
+            faults.check("serve.submit")
+            req = JobRequest.from_payload(payload)
+        except ValueError as e:
+            rejection = (400, "bad_request", str(e))
+        except Exception as e:  # the injected-fault shape
+            rejection = (503, "submit_error", str(e))
+        snap = depth = job = None
+        if rejection is None:
+            with self._lock:
+                depth = self._queued
+                if self._stopping:
+                    rejection = (503, "shutting_down", "server is draining")
+                elif depth >= self.cfg.serve_queue_depth:
+                    rejection = (
+                        429,
+                        "queue_full",
+                        f"queue depth {depth} at the configured bound "
+                        f"{self.cfg.serve_queue_depth}; retry later",
+                    )
+                else:
+                    inflight = sum(
+                        1
+                        for j in self._jobs.values()
+                        if j.request.tenant == req.tenant
+                        and j.state in ("queued", "running")
+                    )
+                    if inflight >= self.cfg.tenant_max_inflight:
+                        rejection = (
+                            429,
+                            "tenant_cap",
+                            f"tenant {req.tenant!r} has {inflight} job(s) "
+                            f"in flight at the configured bound "
+                            f"{self.cfg.tenant_max_inflight}; retry later",
+                        )
+                if rejection is None:
+                    self._seq += 1
+                    job_id = f"job-{os.getpid()}-{self._seq:05d}"
+                    job = Job(job_id=job_id, request=req, source=source)
+                    job_root = os.path.join(
+                        self.cfg.workdir, "jobs", job_id
+                    )
+                    job.workdir = req.workdir or os.path.join(
+                        job_root, "work"
+                    )
+                    job.out_dir = req.out_dir or os.path.join(
+                        job_root, "out"
+                    )
+                    self._jobs[job_id] = job
+                    heapq.heappush(
+                        self._queue, (-req.priority, self._seq, job_id)
+                    )
+                    self._queued += 1
+                    depth = self._queued
+                    snap = job.status_locked()
+                    self._cond.notify_all()
+        # telemetry emits happen OUTSIDE the server lock (the event log
+        # has its own) — the admission path never holds both
+        if rejection is not None:
+            status, reason, detail = rejection
+            if depth is None:
+                with self._lock:
+                    depth = self._queued
+            log.warning(
+                "submission rejected (%s, tenant=%s)", reason,
+                req.tenant if req is not None else tenant,
+            )
+            if self.telemetry is not None:
+                self.telemetry.job_rejected(
+                    reason, depth,
+                    req.tenant if req is not None else tenant,
+                )
+            raise Rejection(status, reason, detail)
+        if self.telemetry is not None:
+            self.telemetry.job_submitted(job, depth)
+        return snap
+
+    # -- status / cancel ---------------------------------------------------
+    def job_status(self, job_id: str) -> "dict | None":
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return job.status_locked() if job is not None else None
+
+    def jobs(self) -> list:
+        with self._lock:
+            return [j.status_locked() for j in self._jobs.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            snap = {
+                "queue_depth": self._queued,
+                "running": self._running_id,
+                "jobs_terminal": self._terminal,
+                "jobs_total": len(self._jobs),
+            }
+        snap["program_cache"] = self.programs.stats()
+        return snap
+
+    def cancel(self, job_id: str) -> "dict | None":
+        """Cancel one job: a queued job goes terminal immediately; a
+        running job's cancel event unwinds its Run through the abort
+        path (manifest resumable)."""
+        finished = None
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            if job.state == "queued":
+                job.state = "cancelled"
+                job.finished_t = time.time()
+                self._queued -= 1
+                self._terminal += 1
+                finished = job
+            elif job.state == "running":
+                job.cancel.set()
+            snap = job.status_locked()
+        if finished is not None:
+            if self.telemetry is not None:
+                self.telemetry.job_done(
+                    finished, finished.finished_t - finished.submitted_t
+                )
+            self._write_result(finished)
+        with self._lock:
+            self._cond.notify_all()
+        return snap
+
+    def stop(self) -> None:
+        """Ask the dispatcher to shut down after the current job."""
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+
+    # -- the dispatcher ----------------------------------------------------
+    def serve_forever(self) -> None:
+        """Drain jobs on THIS thread until stopped (or ``max_jobs``
+        terminal states), then shut everything down."""
+        status = "ok"
+        try:
+            while True:
+                job = self._next_job()
+                if job is None:
+                    break
+                self._run_job(job)
+        except BaseException:
+            status = "aborted"
+            raise
+        finally:
+            self._shutdown_shared(status=status)
+
+    def _drained_locked(self) -> bool:
+        """Caller holds the lock: the bounded mode's exit condition."""
+        return (
+            self.cfg.max_jobs is not None
+            and self._terminal >= self.cfg.max_jobs
+        )
+
+    def _next_job(self) -> "Job | None":
+        with self._lock:
+            while True:
+                if self._stopping or self._drained_locked():
+                    return None
+                while self._queue:
+                    _, _, job_id = heapq.heappop(self._queue)
+                    job = self._jobs[job_id]
+                    if job.state != "queued":
+                        continue  # cancelled while queued
+                    job.state = "running"
+                    job.started_t = time.time()
+                    self._queued -= 1
+                    self._running_id = job_id
+                    return job
+                self._cond.wait(timeout=0.2)
+
+    def _open_stack(self, req: JobRequest):
+        from land_trendr_tpu.ops.indices import required_bands
+
+        bands = required_bands(req.index, tuple(req.ftv))
+        if req.lazy:
+            from land_trendr_tpu.runtime.stack import open_stack_dir_c2_lazy
+
+            return open_stack_dir_c2_lazy(req.stack_dir, bands=bands)
+        from land_trendr_tpu.runtime import load_stack_dir
+
+        return load_stack_dir(req.stack_dir, bands=bands)
+
+    def _run_job(self, job: Job) -> None:
+        from land_trendr_tpu.runtime import (
+            Run,
+            RunCancelled,
+            StallError,
+            TileRetriesExhausted,
+            assemble_outputs,
+        )
+
+        req = job.request
+        wait_s = job.started_t - job.submitted_t
+        if self.telemetry is not None:
+            with self._lock:
+                depth = self._queued
+            self.telemetry.job_start(job, wait_s, depth)
+        log.info(
+            "job %s start (tenant=%s, waited %.2fs)",
+            job.job_id, req.tenant, wait_s,
+        )
+
+        timeout_s = (
+            req.timeout_s
+            if req.timeout_s is not None
+            else self.cfg.job_timeout_s
+        )
+        timer = None
+        if timeout_s is not None:
+            timer = threading.Timer(timeout_s, self._timeout_job, [job])
+            timer.daemon = True
+            timer.start()
+
+        state, error, summary, outputs = "error", None, None, None
+        try:
+            faults.check("serve.job")
+            cfg = req.to_run_config(
+                job.workdir, job.out_dir, telemetry=self.cfg.telemetry
+            )
+            stack = self._open_stack(req)
+            run = Run(
+                stack,
+                cfg,
+                job_id=job.job_id,
+                cancel=job.cancel,
+                programs=self.programs,
+                shared_store=self.store,
+                # the server configured the process-wide cache once at
+                # startup; per-job cache knobs must not clobber it
+                shared_cache=True,
+            )
+            summary = run.execute()
+            # resuming needs the SAME manifest: fresh submissions get
+            # fresh jobs/<id>/work dirs, so every retryable error spells
+            # out the workdir the resubmission must pin
+            resume_hint = (
+                f"resubmit with \"workdir\": {job.workdir!r} to resume"
+            )
+            if summary.get("tiles_quarantined"):
+                state = "retries_exhausted"
+                error = (
+                    f"{len(summary['tiles_quarantined'])} tile(s) "
+                    f"quarantined after exhausting retries; {resume_hint}"
+                )
+            else:
+                if req.assemble:
+                    outputs = assemble_outputs(stack, cfg)
+                state = "done"
+        except RunCancelled as e:
+            state = "stalled" if job.timed_out else "cancelled"
+            error = (
+                f"job timeout after {timeout_s}s; manifest resumable — "
+                f"resubmit with \"workdir\": {job.workdir!r} to resume"
+                if job.timed_out
+                else f"{e}; resubmit with \"workdir\": {job.workdir!r} "
+                "to resume"
+            )
+        except StallError as e:
+            state, error = "stalled", str(e)
+        except TileRetriesExhausted as e:
+            state, error = (
+                "retries_exhausted",
+                f"{e}; resubmit with \"workdir\": {job.workdir!r} to resume",
+            )
+        except (ValueError, TypeError, FileNotFoundError, NotADirectoryError) as e:
+            state, error = "config_error", str(e)
+        except Exception as e:
+            # the residual class (and the serve.job fault seam's shape):
+            # the JOB is terminal, the server and sibling jobs live on
+            state, error = "error", f"{type(e).__name__}: {e}"
+            log.exception("job %s failed", job.job_id)
+        finally:
+            if timer is not None:
+                timer.cancel()
+
+        with self._lock:
+            job.state = state
+            job.error = error
+            job.summary = summary
+            job.outputs = outputs
+            job.finished_t = time.time()
+            self._terminal += 1
+            self._running_id = None
+            wall_s = job.finished_t - job.submitted_t
+        log.info(
+            "job %s %s in %.2fs%s",
+            job.job_id, state, wall_s, f" ({error})" if error else "",
+        )
+        if self.telemetry is not None:
+            self.telemetry.job_done(job, wall_s)
+            self.telemetry.program_cache(self.programs.stats())
+        self._write_result(job)
+        with self._lock:
+            self._cond.notify_all()
+
+    def _timeout_job(self, job: Job) -> None:
+        with self._lock:
+            if job.state != "running":
+                return
+            job.timed_out = True
+        log.warning(
+            "job %s exceeded its timeout; cancelling (manifest stays "
+            "resumable)", job.job_id,
+        )
+        job.cancel.set()
+
+    # -- drop-box ----------------------------------------------------------
+    def _dropbox_loop(self) -> None:
+        cfg = self.cfg
+        while not self._dropbox_stop.wait(cfg.dropbox_poll_s):
+            try:
+                names = sorted(os.listdir(cfg.dropbox_dir))
+            except OSError:
+                continue
+            for name in names:
+                if not name.endswith(".json") or name.endswith(
+                    (".rejected.json", ".result.json")
+                ):
+                    continue
+                path = os.path.join(cfg.dropbox_dir, name)
+                claimed = path + ".claimed"
+                try:
+                    os.rename(path, claimed)  # atomic claim
+                except OSError:
+                    continue  # a sibling scanner (or the client) won
+                self._submit_dropbox(path, claimed)
+
+    def _submit_dropbox(self, orig: str, claimed: str) -> None:
+        try:
+            with open(claimed) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            self._write_json(
+                orig + ".rejected.json",
+                {"reason": "bad_request", "detail": f"unreadable: {e}"},
+            )
+            return
+        try:
+            snap = self.submit(payload, source="dropbox")
+        except Rejection as e:
+            self._write_json(
+                orig + ".rejected.json",
+                {"reason": e.reason, "detail": e.detail},
+            )
+            return
+        with self._lock:
+            self._jobs[snap["job_id"]].dropbox_path = orig
+
+    def _write_result(self, job: Job) -> None:
+        """Durable terminal-state snapshot for EVERY job:
+        ``<workdir>/jobs/<job_id>/result.json`` (plus the drop-box
+        sidecar for drop-box jobs).  A ``max_jobs`` server closes its
+        API right after the last job goes terminal, so an HTTP client
+        can lose the race to one final GET — the result file is the
+        durable answer (and the crash-forensics record)."""
+        with self._lock:
+            snap = job.status_locked()
+        job_root = os.path.join(self.cfg.workdir, "jobs", job.job_id)
+        os.makedirs(job_root, exist_ok=True)
+        self._write_json(os.path.join(job_root, "result.json"), snap)
+        if job.dropbox_path:
+            self._write_json(job.dropbox_path + ".result.json", snap)
+
+    @staticmethod
+    def _write_json(path: str, payload: dict) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.error("drop-box sidecar write failed (%s): %s", path, e)
+
+    # -- shutdown ----------------------------------------------------------
+    def _shutdown_shared(self, status: str) -> None:
+        """Tear down the shared warm state (idempotent; the reverse of
+        construction).  Jobs already terminal keep their durable
+        manifests/outputs whatever happens here."""
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+        self._dropbox_stop.set()
+        httpd = getattr(self, "_httpd", None)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            self._httpd = None
+        thread = getattr(self, "_http_thread", None)
+        if thread is not None:
+            thread.join(timeout=10)
+            self._http_thread = None
+        if self._dropbox_thread is not None:
+            self._dropbox_thread.join(timeout=10)
+            self._dropbox_thread = None
+        if self.store is not None:
+            try:
+                self.store.close()
+            except Exception as exc:
+                log.error("ingest-store flush/close failed: %s", exc)
+            blockcache.detach_store(self.store)
+            self.store = None
+        if self._fault_plan is not None:
+            faults.set_observer(None)
+            faults.deactivate()
+            self._fault_plan = None
+        if self.telemetry is not None:
+            try:
+                self.telemetry.close(
+                    status, time.time() - self._t0, self.programs.stats()
+                )
+            except Exception as exc:
+                log.error("serve telemetry close failed: %s", exc)
+            self.telemetry = None
+
+
+class _JobAPIServer(http.server.ThreadingHTTPServer):
+    """The loopback job API: thin JSON routing over the server object.
+
+    Handler threads only ever call the server's locked methods; the
+    dispatcher never runs here, so a slow client cannot stall a job.
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, server: SegmentationServer) -> None:
+        self.lt_server = server
+        super().__init__(addr, _JobAPIHandler)
+
+    def handle_error(self, request, client_address) -> None:
+        import sys
+
+        if isinstance(
+            sys.exc_info()[1], (BrokenPipeError, ConnectionResetError)
+        ):
+            return
+        super().handle_error(request, client_address)
+
+
+class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
+    """Routes::
+
+        POST /jobs              submit (JSON body → job snapshot | 429/400)
+        GET  /jobs              every job's snapshot
+        GET  /jobs/<id>         one job's snapshot
+        POST /jobs/<id>/cancel  cancel (queued → terminal; running → event)
+        GET  /healthz           liveness + queue stats
+        GET  /metrics           the lt_serve_* exposition
+    """
+
+    server: _JobAPIServer
+
+    def _send_json(self, status: int, payload) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if status == 429:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib API name
+        srv = self.server.lt_server
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/healthz":
+            self._send_json(200, {"ok": True, **srv.stats()})
+        elif path == "/metrics":
+            if srv.telemetry is None:
+                self.send_error(404)
+                return
+            body = srv.telemetry.registry.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/jobs":
+            self._send_json(200, {"jobs": srv.jobs()})
+        elif path.startswith("/jobs/"):
+            snap = srv.job_status(path[len("/jobs/"):])
+            if snap is None:
+                self._send_json(404, {"error": "no such job"})
+            else:
+                self._send_json(200, snap)
+        else:
+            self.send_error(404)
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib API name
+        srv = self.server.lt_server
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/jobs":
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(
+                    400, {"error": "bad_request", "detail": f"bad JSON: {e}"}
+                )
+                return
+            try:
+                snap = srv.submit(payload, source="http")
+            except Rejection as e:
+                self._send_json(
+                    e.http_status,
+                    {"error": e.reason, "detail": e.detail},
+                )
+                return
+            self._send_json(200, snap)
+        elif path.startswith("/jobs/") and path.endswith("/cancel"):
+            job_id = path[len("/jobs/"):-len("/cancel")]
+            snap = srv.cancel(job_id)
+            if snap is None:
+                self._send_json(404, {"error": "no such job"})
+            else:
+                self._send_json(200, snap)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *a) -> None:  # quiet: no per-request stderr
+        pass

@@ -1,0 +1,424 @@
+"""Segmentation-as-a-service tests (ISSUE 7).
+
+Pins the serve subsystem's contracts:
+
+* two jobs submitted concurrently produce artifacts **byte-identical**
+  to two sequential CLI runs of the same request (server mode is a pure
+  execution strategy, never a numerics change), with the second job
+  admitted **warm** (``program_cache.misses == 0`` — zero jit compiles);
+* admission control: queue-depth and per-tenant 429-style rejections,
+  with ``job_rejected`` telemetry;
+* cancel mid-job leaves a **resumable** manifest (recorded tiles stay
+  durable; a plain resume completes to the clean digests), and a job
+  timeout reports the ``stalled`` state;
+* the new ``job_*`` / ``program_cache`` events schema-lint clean in the
+  server scope, the job scopes (with ``job_id`` threaded onto every
+  event), and the committed fixture stream;
+* priority scheduling drains higher-priority jobs first;
+* config/request validation fails fast (loopback-only API included).
+
+Scene shapes are shared across tests so the process-wide jit cache makes
+every server after the first warm — the suite exercises exactly the
+residency the subsystem exists to provide.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import main as cli_main
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.serve import (
+    EXIT_CODE_FOR_STATE,
+    JobRequest,
+    Rejection,
+    SegmentationServer,
+    ServeConfig,
+    TERMINAL_STATES,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+#: one scene shape for the whole module: identical program-cache keys
+#: across tests keep every server after the first warm
+_PARAM_FLAGS = ["--max-segments", "4", "--vertex-count-overshoot", "2"]
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("serve_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+def _digest_workdir(workdir: str) -> dict:
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _job(stack_dir: str, **kw) -> dict:
+    return {
+        "stack_dir": stack_dir,
+        "tile_size": _TILE,
+        "params": dict(_PARAMS),
+        **kw,
+    }
+
+
+def _post(port: int, path: str, payload) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port: int, path: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# config / request validation
+
+
+def test_serve_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="loopback"):
+        ServeConfig(serve_host="0.0.0.0")
+    with pytest.raises(ValueError, match="serve_queue_depth"):
+        ServeConfig(serve_queue_depth=0)
+    with pytest.raises(ValueError, match="job_timeout_s"):
+        ServeConfig(job_timeout_s=0)
+    with pytest.raises(ValueError, match="ingest_store_dir"):
+        ServeConfig(ingest_store_dir=str(tmp_path))
+    with pytest.raises(ValueError):  # typo'd seam = config error NOW
+        ServeConfig(fault_schedule="serve.submitt@0")
+    with pytest.raises(ValueError, match="metrics_port"):
+        ServeConfig(telemetry=False, metrics_port=0)
+    # the CLI maps the same failures to the documented exit 2
+    assert cli_main(["serve", "--serve-host", "0.0.0.0",
+                     "--workdir", str(tmp_path / "srv")]) == 2
+
+
+def test_job_request_validation():
+    with pytest.raises(ValueError, match="stack_dir"):
+        JobRequest.from_payload({})
+    with pytest.raises(ValueError, match="unknown job request field"):
+        JobRequest.from_payload({"stack_dir": "s", "nope": 1})
+    with pytest.raises(ValueError, match="server-owned"):
+        JobRequest.from_payload(
+            {"stack_dir": "s", "run_overrides": {"telemetry": False}}
+        )
+    with pytest.raises(ValueError, match="priority"):
+        JobRequest.from_payload({"stack_dir": "s", "priority": 1000})
+    req = JobRequest.from_payload(
+        {"stack_dir": "s", "ftv": "ndvi,tcw", "priority": 3}
+    )
+    assert req.ftv == ("ndvi", "tcw") and req.priority == 3
+    # every terminal state maps onto the documented exit-code contract
+    assert set(EXIT_CODE_FOR_STATE) == set(TERMINAL_STATES)
+
+
+def test_program_cache_failed_probe_is_not_resident():
+    """A miss whose warm probe FAILED compiled nothing: the key must not
+    be registered, or the next run is falsely admitted warm while it
+    actually compiles inline on tile 0."""
+    from land_trendr_tpu.serve import ProgramCache
+
+    pc = ProgramCache()
+    key = pc.key_for(fingerprint="f", backend="cpu")
+    assert not pc.admit(key)
+    pc.record(key, hit=False, compile_s=1.0, ok=False)  # probe failed
+    assert not pc.admit(key), "failed probe must not register the key"
+    pc.record(key, hit=False, compile_s=2.0)  # later successful compile
+    assert pc.admit(key)
+    stats = pc.stats()
+    assert stats == {
+        "hits": 0, "misses": 2, "compile_s": 3.0, "keys": 1,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the headline contract: concurrent jobs ≡ sequential CLI runs, warm admission
+
+
+def test_concurrent_jobs_match_cli_and_second_is_warm(stack_dir, tmp_path):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=2, feed_cache_mb=32)
+    )
+    # both jobs queued over the API BEFORE the dispatcher starts — truly
+    # concurrent submissions (different tenants dodge the in-flight cap)
+    st1, j1 = _post(server.port, "/jobs", _job(stack_dir))
+    st2, j2 = _post(server.port, "/jobs", _job(stack_dir, tenant="b"))
+    assert st1 == st2 == 200
+    server.serve_forever()  # drains both, then shuts down
+
+    s1 = server.job_status(j1["job_id"])
+    s2 = server.job_status(j2["job_id"])
+    assert s1["state"] == s2["state"] == "done"
+    assert s1["exit_code"] == 0
+    # warm admission: the second job ran ZERO jit compiles
+    assert s1["summary"]["program_cache"]["misses"] in (0, 1)
+    assert s2["summary"]["program_cache"] == {
+        "hits": 1, "misses": 0, "compile_s": 0.0,
+    }
+
+    # two sequential CLI runs of the same request are the reference
+    cli = []
+    for i in (1, 2):
+        wd, od = str(tmp_path / f"cli{i}_w"), str(tmp_path / f"cli{i}_o")
+        assert cli_main(["segment", stack_dir, "--tile-size", str(_TILE),
+                         "--workdir", wd, "--out-dir", od,
+                         *_PARAM_FLAGS]) == 0
+        cli.append((wd, od))
+    ref = _digest_workdir(cli[0][0])
+    assert _digest_workdir(cli[1][0]) == ref
+    assert _digest_workdir(s1["workdir"]) == ref
+    assert _digest_workdir(s2["workdir"]) == ref
+    # assembled rasters byte-identical too (server mode is pure strategy)
+    for snap in (s1, s2):
+        for name, path in snap["outputs"].items():
+            want = Path(cli[0][1], Path(path).name).read_bytes()
+            assert Path(path).read_bytes() == want, name
+
+    # the new events schema-lint clean: server scope + both job scopes
+    # (job_id threaded onto every job-scope event)
+    from check_events_schema import main as lint_main
+
+    assert lint_main([srv_dir]) == 0
+    for snap in (s1, s2):
+        assert lint_main([snap["workdir"]]) == 0
+        evs = [
+            json.loads(l)
+            for l in open(os.path.join(snap["workdir"], "events.jsonl"))
+        ]
+        assert evs and all(e["job_id"] == snap["job_id"] for e in evs)
+        assert [e for e in evs if e["ev"] == "program_cache"]
+    server_evs = [
+        json.loads(l) for l in open(os.path.join(srv_dir, "events.jsonl"))
+    ]
+    kinds = [e["ev"] for e in server_evs]
+    assert kinds.count("job_submitted") == 2
+    assert kinds.count("job_done") == 2
+    assert kinds[-1] == "run_done" and "program_cache" in kinds
+
+    # obs_report folds the serve scope into its rollup
+    import obs_report
+
+    report, _spans = obs_report.fold(
+        [os.path.join(srv_dir, "events.jsonl")]
+    )
+    assert report["serve"]["submitted"] == 2
+    assert report["serve"]["by_status"] == {"done": 2}
+    assert report["program_cache"]["keys"] == 1
+
+
+def test_fixture_stream_lints_clean():
+    """The committed fixture (precommit's schema-drift guard) stays
+    valid against the live schema."""
+    from check_events_schema import main as lint_main
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "serve.events.jsonl"
+    )
+    assert lint_main([fixture]) == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control
+
+
+def test_admission_rejections(stack_dir, tmp_path):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(
+            workdir=srv_dir,
+            serve_queue_depth=2,
+            tenant_max_inflight=1,
+        )
+    )
+    try:
+        st, _ = _post(server.port, "/jobs", _job(stack_dir, tenant="a"))
+        assert st == 200
+        # tenant cap: a's second submission is refused, b's proceeds
+        st, body = _post(server.port, "/jobs", _job(stack_dir, tenant="a"))
+        assert st == 429 and body["error"] == "tenant_cap"
+        st, _ = _post(server.port, "/jobs", _job(stack_dir, tenant="b"))
+        assert st == 200
+        # queue full: depth 2 reached, tenant c is refused anyway
+        st, body = _post(server.port, "/jobs", _job(stack_dir, tenant="c"))
+        assert st == 429 and body["error"] == "queue_full"
+        # malformed request: 400, not a server error
+        st, body = _post(server.port, "/jobs", {"nope": 1})
+        assert st == 400 and body["error"] == "bad_request"
+        st, h = _get(server.port, "/healthz")
+        assert st == 200 and h["queue_depth"] == 2
+    finally:
+        server.stop()
+        server.serve_forever()  # immediate drain-free shutdown
+    evs = [
+        json.loads(l) for l in open(os.path.join(srv_dir, "events.jsonl"))
+    ]
+    rejected = [e for e in evs if e["ev"] == "job_rejected"]
+    assert sorted(e["reason"] for e in rejected) == [
+        "bad_request", "queue_full", "tenant_cap",
+    ]
+
+
+def test_direct_submit_rejection_raises(stack_dir, tmp_path):
+    server = SegmentationServer(
+        ServeConfig(workdir=str(tmp_path / "srv"), serve_queue_depth=1)
+    )
+    try:
+        server.submit(_job(stack_dir))
+        with pytest.raises(Rejection) as exc:
+            server.submit(_job(stack_dir, tenant="b"))
+        assert exc.value.reason == "queue_full"
+        assert exc.value.http_status == 429
+    finally:
+        server.stop()
+        server.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# cancel / timeout — the resumable-manifest contract
+
+
+def test_cancel_mid_job_leaves_resumable_manifest(stack_dir, tmp_path):
+    # pace the job with a deterministic slow fault so the cancel lands
+    # mid-run: every dispatch sleeps 0.4s, and the warm probe plus four
+    # tiles make the job take >2s
+    server = SegmentationServer(
+        ServeConfig(
+            workdir=str(tmp_path / "srv"),
+            max_jobs=1,
+            fault_schedule="seed=1,dispatch%1.0=slow:0.4",
+        )
+    )
+    snap = server.submit(_job(stack_dir))
+    job_id = snap["job_id"]
+
+    def cancel_after_first_tile():
+        deadline = time.monotonic() + 30
+        wd = Path(snap["workdir"])
+        while time.monotonic() < deadline:
+            if list(wd.glob("tile_*.npz")):
+                break
+            time.sleep(0.05)
+        _post(server.port, f"/jobs/{job_id}/cancel", {})
+
+    t = threading.Thread(target=cancel_after_first_tile)
+    t.start()
+    server.serve_forever()
+    t.join(timeout=30)
+
+    s = server.job_status(job_id)
+    assert s["state"] == "cancelled"
+    assert s["exit_code"] == EXIT_CODE_FOR_STATE["cancelled"] == 3
+    done = _digest_workdir(s["workdir"])
+    assert 1 <= len(done) < 4, "cancel must land mid-run"
+    # the job's own stream records the aborted scope
+    evs = [
+        json.loads(l)
+        for l in open(os.path.join(s["workdir"], "events.jsonl"))
+    ]
+    assert evs[-1]["ev"] == "run_done" and evs[-1]["status"] == "aborted"
+
+    # a plain resume (the CLI path a resubmitted job also takes)
+    # completes exactly the remaining tiles, byte-identical to clean
+    assert cli_main(["segment", stack_dir, "--tile-size", str(_TILE),
+                     "--workdir", s["workdir"],
+                     "--out-dir", str(tmp_path / "resume_o"),
+                     *_PARAM_FLAGS]) == 0
+    resumed = _digest_workdir(s["workdir"])
+    assert len(resumed) == 4
+    clean_wd = str(tmp_path / "clean_w")
+    assert cli_main(["segment", stack_dir, "--tile-size", str(_TILE),
+                     "--workdir", clean_wd,
+                     "--out-dir", str(tmp_path / "clean_o"),
+                     *_PARAM_FLAGS]) == 0
+    assert resumed == _digest_workdir(clean_wd)
+    # the tiles recorded before the cancel were not recomputed
+    assert all(resumed[k] == v for k, v in done.items())
+
+
+def test_job_timeout_reports_stalled(stack_dir, tmp_path):
+    server = SegmentationServer(
+        ServeConfig(
+            workdir=str(tmp_path / "srv"),
+            max_jobs=1,
+            job_timeout_s=0.6,
+            fault_schedule="seed=1,dispatch%1.0=slow:0.4",
+        )
+    )
+    snap = server.submit(_job(stack_dir))
+    server.serve_forever()
+    s = server.job_status(snap["job_id"])
+    assert s["state"] == "stalled", s.get("error")
+    assert s["exit_code"] == EXIT_CODE_FOR_STATE["stalled"] == 4
+    assert "timeout" in s["error"]
+    # a per-request override beats the server default (and 'timeout_s'
+    # rides request validation)
+    with pytest.raises(ValueError, match="timeout_s"):
+        JobRequest.from_payload({"stack_dir": "s", "timeout_s": 0})
+
+
+# ---------------------------------------------------------------------------
+# scheduling
+
+
+def test_priority_drains_before_fifo(stack_dir, tmp_path):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=3, feed_cache_mb=32)
+    )
+    lo1 = server.submit(_job(stack_dir, tenant="a"))
+    lo2 = server.submit(_job(stack_dir, tenant="b"))
+    hi = server.submit(_job(stack_dir, tenant="c", priority=5))
+    server.serve_forever()
+    started = {
+        s["job_id"]: s["started_t"]
+        for s in (server.job_status(j["job_id"]) for j in (lo1, lo2, hi))
+    }
+    assert started[hi["job_id"]] < started[lo1["job_id"]]
+    # FIFO within a priority
+    assert started[lo1["job_id"]] < started[lo2["job_id"]]

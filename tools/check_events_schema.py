@@ -71,6 +71,13 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     "stall": ("idle_s", "timeout_s"),
     "fetch_demoted": ("failures",),
     "run_done": ("tiles_quarantined",),
+    # serve-mode events (land_trendr_tpu/serve): queue depths, waits,
+    # latencies and warm-cache counters only go up / never negative
+    "job_submitted": ("queue_depth",),
+    "job_start": ("wait_s",),
+    "job_done": ("wall_s", "tiles_quarantined"),
+    "job_rejected": ("queue_depth",),
+    "program_cache": ("hits", "misses", "compile_s", "keys"),
 }
 
 

@@ -229,8 +229,117 @@ def soak(
             if verbose:
                 print(f"  ok: {track}/{case.name} ({case.schedule})")
 
+    def run_serve_track() -> None:
+        """Serve-mode failure semantics: with the server's ONE armed
+        plan firing at ``serve.submit`` (first submission rejected, the
+        server lives) and at ``dispatch`` (job A's first tile exhausts
+        its retries and is quarantined → the job reports
+        ``retries_exhausted``), sibling job B still completes with
+        artifacts byte-identical to a plain clean run — a failing job
+        never takes down the server or its neighbours."""
+        from land_trendr_tpu.io.synthetic import (
+            SceneSpec,
+            make_stack,
+            write_stack,
+        )
+        from land_trendr_tpu.ops.indices import required_bands
+        from land_trendr_tpu.runtime import load_stack_dir
+        from land_trendr_tpu.serve import (
+            Rejection,
+            SegmentationServer,
+            ServeConfig,
+        )
+
+        sdir = str(root / "serve_stack")
+        write_stack(
+            sdir,
+            make_stack(
+                SceneSpec(
+                    width=48, height=40, year_start=1990, year_end=2013,
+                    seed=11,
+                )
+            ),
+        )
+        # the reference digest: a plain clean run over the SAME on-disk
+        # stack (the serve jobs must reproduce it byte for byte)
+        clean_wd = str(root / "serve_clean")
+        _run(
+            load_stack_dir(sdir, bands=required_bands("nbr", ())),
+            RunConfig(workdir=clean_wd, out_dir=clean_wd + "_o", **base_kw),
+        )
+        clean = _digest_workdir(clean_wd)
+
+        # dispatch invocation 0 is job A's warm probe (program-cache
+        # miss); its first real tile then burns attempts 1..retries+1
+        schedule = f"seed=1,serve.submit@0=io,dispatch@1*{retries + 1}"
+        server = SegmentationServer(
+            ServeConfig(
+                workdir=str(root / "serve_srv"),
+                max_jobs=2,
+                feed_cache_mb=64,
+                fault_schedule=schedule,
+            )
+        )
+        job = {
+            "stack_dir": sdir,
+            "tile_size": base_kw["tile_size"],
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "max_retries": retries,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+        try:
+            server.submit(dict(job))
+        except Rejection as e:
+            if e.reason != "submit_error":
+                raise AssertionError(
+                    f"serve.submit seam: expected submit_error, got "
+                    f"{e.reason}"
+                )
+        else:
+            raise AssertionError(
+                "serve.submit@0 did not reject the first submission — "
+                "the seam no longer fires there"
+            )
+        a = server.submit({**job, "quarantine_tiles": True})
+        b = server.submit(dict(job))
+        server.serve_forever()  # drains both jobs, then shuts down
+        sa = server.job_status(a["job_id"])
+        sb = server.job_status(b["job_id"])
+        if sa["state"] != "retries_exhausted" or not sa["summary"][
+            "tiles_quarantined"
+        ]:
+            raise AssertionError(
+                f"job A: expected retries_exhausted with quarantined "
+                f"tiles, got {sa['state']} "
+                f"({sa.get('summary', {}).get('tiles_quarantined')})"
+            )
+        if sb["state"] != "done":
+            raise AssertionError(
+                f"job B: expected done beside the failing sibling, got "
+                f"{sb['state']} ({sb.get('error')})"
+            )
+        got = _digest_workdir(sb["workdir"])
+        if got != clean:
+            raise AssertionError(
+                "serve job B artifacts differ from the clean run"
+            )
+        report["cases"].append(
+            {
+                "track": "serve",
+                "case": "submit_reject_and_sibling_quarantine",
+                "schedule": schedule,
+                "job_a": sa["state"],
+                "job_b": sb["state"],
+                "artifacts_identical": True,
+            }
+        )
+        if verbose:
+            print(f"  ok: serve/submit_reject_and_sibling_quarantine "
+                  f"({schedule})")
+
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
+    run_serve_track()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a
     # real cache to poison (cases that pin their own feed_cache_mb —

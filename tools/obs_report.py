@@ -84,7 +84,62 @@ def _fresh_scope() -> dict:
         "retries": 0, "failures": 0, "quarantined": 0, "faults_injected": 0,
         "stalls": 0, "stage_s": {}, "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
+        "serve": None, "program_cache": None,
     }
+
+
+def _serve_scope(cur: dict) -> dict:
+    """The lazily-created serve sub-aggregate of one scope (the server's
+    own events file carries the job lifecycle; job run scopes carry only
+    their program_cache verdicts)."""
+    if cur["serve"] is None:
+        cur["serve"] = {
+            "submitted": 0, "rejected": 0, "by_status": {},
+            "wait_s": [], "job_s": [],
+        }
+    return cur["serve"]
+
+
+def _merge_serve(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the serve job-lifecycle rollups (None when no
+    file's last scope carried any job events); derives queue-wait and
+    job-latency distributions."""
+    seen = [c["serve"] for c in folded if c["serve"] is not None]
+    if not seen:
+        return None
+    by_status: dict = {}
+    for s in seen:
+        for k, v in s["by_status"].items():
+            by_status[k] = by_status.get(k, 0) + v
+    return {
+        "submitted": sum(s["submitted"] for s in seen),
+        "rejected": sum(s["rejected"] for s in seen),
+        "by_status": dict(sorted(by_status.items())),
+        "queue_wait_s": _stats([v for s in seen for v in s["wait_s"]]),
+        "job_s": _stats([v for s in seen for v in s["job_s"]]),
+    }
+
+
+def _merge_program_cache(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the warm-program-cache rollups (one per job
+    run scope, plus the server's terminal aggregate); adds the derived
+    ``hit_rate`` — the fraction of runs that compiled nothing."""
+    seen = [
+        c["program_cache"] for c in folded if c["program_cache"] is not None
+    ]
+    if not seen:
+        return None
+    out = {
+        "hits": sum(s["hits"] for s in seen),
+        "misses": sum(s["misses"] for s in seen),
+        "compile_s": round(sum(s["compile_s"] for s in seen), 4),
+    }
+    keys = [s["keys"] for s in seen if "keys" in s]
+    if keys:
+        out["keys"] = max(keys)
+    runs = out["hits"] + out["misses"]
+    out["hit_rate"] = round(out["hits"] / runs, 4) if runs else None
+    return out
 
 
 #: feed_cache event counters summed across files in the report; the
@@ -225,7 +280,8 @@ def fold(
         scopes: list[dict] = []
         cur = _fresh_scope()
         host_info: dict = {"events_file": path, "process_index": fileno}
-        starts: dict[int, float] = {}  # tile_id -> wall-anchored start
+        # tile_id (and "job:<id>") -> wall-anchored start
+        starts: dict = {}
         any_line = False
         with open(path) as f:
             for i, line in enumerate(f, 1):
@@ -399,6 +455,55 @@ def fold(
                                 if k in rec
                             },
                         }
+                    elif ev == "job_submitted":
+                        job_id = rec["job_id"]
+                        _serve_scope(cur)["submitted"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": f"submitted {job_id}", "t0": tw,
+                            "args": {
+                                "tenant": rec.get("tenant"),
+                                "queue_depth": rec.get("queue_depth"),
+                            },
+                        })
+                    elif ev == "job_rejected":
+                        _serve_scope(cur)["rejected"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": f"REJECTED ({rec['reason']})", "t0": tw,
+                            "args": {"queue_depth": rec.get("queue_depth")},
+                        })
+                    elif ev == "job_start":
+                        job_id, w_s = rec["job_id"], rec["wait_s"]
+                        _serve_scope(cur)["wait_s"].append(w_s)
+                        starts[f"job:{job_id}"] = tw
+                    elif ev == "job_done":
+                        job_id, w_s = rec["job_id"], rec["wall_s"]
+                        sv = _serve_scope(cur)
+                        sv["job_s"].append(w_s)
+                        status = rec["status"]
+                        sv["by_status"][status] = (
+                            sv["by_status"].get(status, 0) + 1
+                        )
+                        t0 = starts.pop(f"job:{job_id}", tw - w_s)
+                        spans.append({
+                            "kind": "slice", "file": fileno, "tid": "jobs",
+                            "name": f"{job_id} [{status}]", "t0": t0,
+                            "dur": max(tw - t0, 0.0),
+                            "args": {
+                                "status": status, "wall_s": w_s,
+                                "error": rec.get("error"),
+                            },
+                        })
+                    elif ev == "program_cache":
+                        # warm-cache verdict: one per job run scope (and a
+                        # server-scope aggregate); last wins per scope
+                        cur["program_cache"] = {
+                            "hits": rec["hits"],
+                            "misses": rec["misses"],
+                            "compile_s": rec["compile_s"],
+                            **({"keys": rec["keys"]} if "keys" in rec else {}),
+                        }
                     elif ev == "run_done":
                         host_info.update(
                             status=rec.get("status"), wall_s=rec.get("wall_s"),
@@ -445,6 +550,8 @@ def fold(
         "fetch": _merge_xfer(folded, "fetch"),
         "upload": _merge_xfer(folded, "upload"),
         "ingest_store": _merge_ingest_store(folded),
+        "serve": _merge_serve(folded),
+        "program_cache": _merge_program_cache(folded),
         "hosts": hosts,
     }
     return report, spans

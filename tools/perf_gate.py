@@ -40,6 +40,7 @@ sys.path.insert(0, str(REPO / "tools"))
 FEED_BASELINE = REPO / "FEED_r07.json"
 FETCH_BASELINE = REPO / "FETCH_r08.json"
 UPLOAD_BASELINE = REPO / "UPLOAD_r10.json"
+SERVE_BASELINE = REPO / "SERVE_r11.json"
 
 #: a smoke ratio must reach this fraction of its committed value — loose
 #: enough for a 2-core container's noise, tight enough that a regression
@@ -56,9 +57,10 @@ def _hit_rate(stats: dict) -> float | None:
 
 
 def run_gate(workdir: str, checks: list) -> None:
-    """Run the three bench smokes and append (name, ok, detail) rows."""
+    """Run the four bench smokes and append (name, ok, detail) rows."""
     import feed_bench
     import fetch_bench
+    import serve_bench
     import upload_bench
 
     def check(name: str, ok: bool, detail: str) -> None:
@@ -150,6 +152,41 @@ def run_gate(workdir: str, checks: list) -> None:
                     f"{store[leg]['stats']['misses']} misses",
                 )
 
+    # -- serve (warm program cache + shared ingest store) -----------------
+    base = json.loads(SERVE_BASELINE.read_text())
+    out = str(Path(workdir) / "serve_smoke.json")
+    if serve_bench.main(["--smoke", "--out", out]) != 0:
+        check("serve.ran", False, "serve_bench --smoke exited nonzero")
+    else:
+        got = json.loads(Path(out).read_text())
+        check(
+            "serve.parity", got["parity_ok"] is True,
+            "warm job artifacts ≡ cold job artifacts",
+        )
+        # THE structural acceptance invariant: a warm job submitted to a
+        # running server performs zero jit compiles (program-cache hit)
+        # and zero TIFF decodes (every block store-served) — exact, not
+        # a noisy wall ratio
+        inv = got["invariants"]
+        check(
+            "serve.warm_zero_compiles",
+            inv["warm_zero_compiles"] is True,
+            f"warm program_cache: {got['warm']['program_cache']}",
+        )
+        check(
+            "serve.warm_zero_decodes",
+            inv["warm_zero_decodes"] is True,
+            f"warm ingest_store: {got['warm']['ingest_store']}",
+        )
+        band = max(SPEEDUP_FLOOR, base["speedup_warm"] * RATIO_BAND)
+        check(
+            "serve.warm_speedup",
+            got["speedup_warm"] is not None
+            and got["speedup_warm"] >= band,
+            f"smoke warm speedup {got['speedup_warm']} vs band "
+            f"{band:.2f} (committed {base['speedup_warm']})",
+        )
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -159,7 +196,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="keep the smoke artifacts under DIR")
     args = ap.parse_args(argv)
 
-    for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE):
+    for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE, SERVE_BASELINE):
         if not p.exists():
             print(f"error: committed baseline {p.name} missing", file=sys.stderr)
             return 2

@@ -4,11 +4,12 @@
 #   1. tools/lt_lint.py --changed  — the five LT AST invariant rules over
 #      files modified vs HEAD (repo-level coupling rules LT004/LT005 run
 #      whenever one of their sources changed);
-#   2. tools/check_events_schema.py — schema + value lint over any event
-#      streams passed as arguments (workdirs or events*.jsonl files);
-#      with no arguments this leg is skipped (there is no canonical
-#      committed event stream — the lint's tier-1 home is the test
-#      suite's generated streams).
+#   2. tools/check_events_schema.py over the COMMITTED event-stream
+#      fixtures under tests/ (*.events.jsonl) — a fixture drifting from
+#      the current schema (a renamed/removed field, a new required one)
+#      fails here, pre-commit, instead of as a tier-1 surprise;
+#   3. tools/check_events_schema.py — additionally over any event
+#      streams passed as arguments (workdirs or events*.jsonl files).
 #
 # Install:  ln -s ../../tools/precommit.sh .git/hooks/pre-commit
 # Exit codes follow the tools: 0 clean, 1 findings, 2 config error.
@@ -21,6 +22,14 @@ repo="$(git rev-parse --show-toplevel 2>/dev/null)"
 [ -n "$repo" ] || repo="$(cd "$(dirname "$0")/.." && pwd)"
 
 python "$repo/tools/lt_lint.py" --changed
+
+# committed fixture streams lint against the CURRENT schema (newline-safe
+# iteration is unnecessary: fixture names are repo-controlled)
+fixtures="$(find "$repo/tests" -name '*.events.jsonl' 2>/dev/null)"
+if [ -n "$fixtures" ]; then
+    # shellcheck disable=SC2086
+    python "$repo/tools/check_events_schema.py" $fixtures
+fi
 
 if [ "$#" -gt 0 ]; then
     python "$repo/tools/check_events_schema.py" "$@"

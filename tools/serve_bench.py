@@ -1,0 +1,275 @@
+"""Serve-mode cold-vs-warm bench: the headline number of service mode.
+
+Starts a real :class:`~land_trendr_tpu.serve.server.SegmentationServer`
+(loopback HTTP, shared ingest store, RAM cache tier OFF so every demand
+read consults the store), submits the SAME lazy-ingest job twice over
+the API, and measures client-side latency submit → terminal:
+
+* the **cold** job pays jit compile (the program-cache miss compiles the
+  whole upload→dispatch→fetch program chain) AND TIFF decode (the store
+  ingests every block it decodes);
+* the **warm** job must run **zero jit compiles** (program-cache hit —
+  ``program_cache.misses == 0``) and **zero TIFF decodes** (every block
+  served from the ingest store — ``ingest_store.misses == 0``), the
+  structural invariants ``tools/perf_gate.py`` asserts against this
+  bench's ``--smoke`` artifact.
+
+Artifacts are digest-compared across the two job workdirs (warm ≡ cold,
+byte-identical), so the speedup is never bought with correctness.
+
+    python tools/serve_bench.py --smoke --out /tmp/serve_smoke.json
+    python tools/serve_bench.py --out SERVE_r11.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import shutil
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+def _digest_workdir(workdir: str) -> dict:
+    """tile_id → {array name → sha256} (array-content identity, like
+    fault_soak: npz zip metadata legitimately differs run to run)."""
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _post(port: int, path: str, payload: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+def _await_terminal(server, job_id: str, timeout_s: float) -> dict:
+    """Poll over HTTP; fall back to the in-process job table when the
+    API is already shutting down (a ``max_jobs`` server closes its
+    socket right after the last job goes terminal — losing the race to
+    one final GET is not a bench failure)."""
+    from land_trendr_tpu.serve import TERMINAL_STATES
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            snap = _get(server.port, f"/jobs/{job_id}")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            snap = server.job_status(job_id)
+        if snap is not None and snap["state"] in TERMINAL_STATES:
+            return snap
+        time.sleep(0.05)
+    raise TimeoutError(f"job {job_id} not terminal within {timeout_s}s")
+
+
+def _job_leg(server, request: dict, timeout_s: float) -> tuple[dict, float]:
+    """Submit one job over the API, await its terminal state; returns
+    (terminal snapshot, client-side submit→terminal latency seconds)."""
+    t0 = time.perf_counter()
+    status, snap = _post(server.port, "/jobs", request)
+    if status != 200:
+        raise RuntimeError(f"submission failed ({status}): {snap}")
+    snap = _await_terminal(server, snap["job_id"], timeout_s)
+    latency = time.perf_counter() - t0
+    if snap["state"] != "done":
+        raise RuntimeError(
+            f"job {snap['job_id']} ended {snap['state']}: "
+            f"{snap.get('error')}"
+        )
+    return snap, latency
+
+
+def run_bench(size: int, years: int, tile: int, root: str) -> dict:
+    from land_trendr_tpu.io.synthetic import (
+        SceneSpec,
+        make_stack,
+        write_stack_c2,
+    )
+    from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+    stack_dir = str(Path(root) / "stack")
+    write_stack_c2(
+        stack_dir,
+        make_stack(
+            SceneSpec(
+                width=size,
+                height=size,
+                year_start=2000,
+                year_end=2000 + years - 1,
+                seed=11,
+            )
+        ),
+    )
+
+    cfg = ServeConfig(
+        workdir=str(Path(root) / "serve"),
+        serve_port=0,
+        max_jobs=2,
+        # RAM tier OFF: every demand read consults the persistent store,
+        # so the warm leg's zero-decode claim is structural, not an
+        # artifact of RAM caching (the store tier is what survives a
+        # server restart)
+        feed_cache_mb=0,
+        ingest_store_mb=256,
+    )
+    server = SegmentationServer(cfg)
+    request = {
+        "stack_dir": stack_dir,
+        "tile_size": tile,
+        "lazy": True,
+        "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+    }
+    legs: dict = {}
+    errors: list = []
+
+    def drive() -> None:
+        try:
+            for leg in ("cold", "warm"):
+                snap, latency = _job_leg(server, request, 600.0)
+                legs[leg] = {"snap": snap, "latency_s": latency}
+        except Exception as e:  # surfaces in the report, fails the bench
+            errors.append(f"{type(e).__name__}: {e}")
+            server.stop()
+
+    t = threading.Thread(target=drive, name="serve-bench-client")
+    t.start()
+    server.serve_forever()  # drains both jobs, then shuts down
+    t.join(timeout=30)
+    if errors:
+        raise RuntimeError(f"bench client failed: {errors[0]}")
+
+    def leg_report(leg: str) -> dict:
+        snap = legs[leg]["snap"]
+        summary = snap["summary"]
+        return {
+            "latency_s": round(legs[leg]["latency_s"], 4),
+            "job_wall_s": round(
+                snap["finished_t"] - snap["submitted_t"], 4
+            ),
+            "run_wall_s": summary["wall_s"],
+            "program_cache": summary["program_cache"],
+            "ingest_store": summary.get("ingest_store"),
+        }
+
+    cold, warm = leg_report("cold"), leg_report("warm")
+    parity_ok = bool(
+        _digest_workdir(legs["cold"]["snap"]["workdir"])
+        == _digest_workdir(legs["warm"]["snap"]["workdir"])
+    ) and bool(_digest_workdir(legs["cold"]["snap"]["workdir"]))
+    warm_store = warm["ingest_store"] or {}
+    report = {
+        "workload": {
+            "scene_px": size * size,
+            "years": years,
+            "tile_size": tile,
+            "tiles": (size // tile) ** 2,
+            "lazy": True,
+            "ingest_store_mb": cfg.ingest_store_mb,
+            "feed_cache_mb": cfg.feed_cache_mb,
+        },
+        "cold": cold,
+        "warm": warm,
+        # the headline: a warm job skips compile AND decode
+        "speedup_warm": round(cold["latency_s"] / warm["latency_s"], 2)
+        if warm["latency_s"]
+        else None,
+        "invariants": {
+            "warm_zero_compiles": warm["program_cache"]["misses"] == 0,
+            "warm_zero_decodes": warm_store.get("misses", -1) == 0
+            and warm_store.get("hits", 0) > 0,
+            "cold_compiled": cold["program_cache"]["misses"] == 1,
+        },
+        "parity_ok": parity_ok,
+    }
+    return report
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale tier-1 mode (tiny scene)")
+    ap.add_argument("--size", type=int, default=None,
+                    help="scene edge px (default: 64 smoke / 256 full)")
+    ap.add_argument("--years", type=int, default=None,
+                    help="stack years (default: 7 smoke / 16 full)")
+    ap.add_argument("--tile", type=int, default=None,
+                    help="tile size (default: 32 smoke / 64 full)")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep the bench workdirs under DIR")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    size = args.size or (64 if args.smoke else 256)
+    years = args.years or (7 if args.smoke else 16)
+    tile = args.tile or (32 if args.smoke else 64)
+
+    root = args.keep or tempfile.mkdtemp(prefix="lt_serve_bench_")
+    Path(root).mkdir(parents=True, exist_ok=True)
+    try:
+        report = run_bench(size, years, tile, root)
+    finally:
+        if args.keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    ok = report["parity_ok"] and all(report["invariants"].values())
+    report["ok"] = ok
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(
+        json.dumps(
+            {
+                "ok": ok,
+                "cold_s": report["cold"]["latency_s"],
+                "warm_s": report["warm"]["latency_s"],
+                "speedup_warm": report["speedup_warm"],
+                "invariants": report["invariants"],
+                "parity_ok": report["parity_ok"],
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
