@@ -725,6 +725,11 @@ def main(argv: list[str] | None = None) -> int:
                 )
                 return 2
         try:
+            # the server owns its whole teardown: serve_forever's finally
+            # runs _shutdown_shared on every exit path (Ctrl-C included)
+            # and a failed constructor unwinds itself, so no stop() call
+            # exists at this layer by design
+            # lt: noqa[LT008]
             server = SegmentationServer(scfg)
         except OSError as e:
             print(f"error: server startup failed: {e}", file=sys.stderr)

@@ -121,6 +121,7 @@ class BlockStore:
         self._flushing: dict[tuple, np.ndarray] = {}
         self._pending_bytes = 0
         self._flush_lock = threading.Lock()  # one segment write at a time
+        self._closed = False
         self._seq = 0
         self._stats = {
             "hits": 0,
@@ -216,62 +217,100 @@ class BlockStore:
         so a rewritten file can never serve its predecessor's bytes.
         ``count=False`` makes the lookup invisible to the hit/miss
         counters (readahead probing, like the in-memory cache's).
+
+        A COLD segment's ``open``/``mmap`` runs OUTSIDE the instance
+        lock (LT007: the PR-6 flush bug's read-path twin — a cold open
+        on a tiered filesystem stalled every concurrent ``get``/``put``
+        behind disk latency), then registers under the lock and retries
+        the lookup; a segment evicted during the unlocked window simply
+        misses, exactly as if the eviction had won the race under one
+        big lock.
         """
-        with self._lock:
-            arr = self._pending.get(key)
-            if arr is None:
-                arr = self._flushing.get(key)
-            if arr is not None:
-                if count:
-                    self._stats["hits"] += 1
-                return arr
-            ent = self._index.get(key)
-            if ent is None:
-                stale = self._by_block.get(self._block_id(key))
-                if stale is not None and stale != key:
-                    self._drop_locked(stale, "stale_dropped")
-                if count:
-                    self._stats["misses"] += 1
-                return None
-            name, off, nbytes, dtype, shape = ent
+        while True:
+            with self._lock:
+                arr = self._pending.get(key)
+                if arr is None:
+                    arr = self._flushing.get(key)
+                if arr is not None:
+                    if count:
+                        self._stats["hits"] += 1
+                    return arr
+                ent = self._index.get(key)
+                if ent is None:
+                    stale = self._by_block.get(self._block_id(key))
+                    if stale is not None and stale != key:
+                        self._drop_locked(stale, "stale_dropped")
+                    if count:
+                        self._stats["misses"] += 1
+                    return None
+                name, off, nbytes, dtype, shape = ent
+                mm = self._mmaps.get(name)
+                if mm is not None:
+                    return self._read_view_locked(
+                        key, mm, off, nbytes, dtype, shape, count
+                    )
+            # cold segment: open + map with the lock RELEASED, then loop
+            # to re-validate — the entry may be gone by the time the map
+            # is ready (sibling eviction), in which case the next pass
+            # resolves it like any other miss
             try:
-                mm = self._mmap_locked(name)
+                with open(
+                    os.path.join(self.root, name + ".bin"), "rb"
+                ) as f:
+                    mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
             except OSError:
                 # unopenable segment (deleted by a sibling's eviction,
                 # bit rot): EVERY entry of it is gone — drop the whole
                 # segment once instead of paying a failed open (and a
                 # corruption count) per sibling entry
-                self._drop_segment_locked(name)
-                self._stats["corrupt_dropped"] += 1
-                if count:
-                    self._stats["misses"] += 1
+                with self._lock:
+                    if name in self._segments:
+                        self._drop_segment_locked(name)
+                        self._stats["corrupt_dropped"] += 1
+                    if count:
+                        self._stats["misses"] += 1
                 return None
-            try:
-                if off + nbytes > len(mm):
-                    raise ValueError("entry outside segment")
-                arr = np.frombuffer(
-                    mm, dtype=np.dtype(dtype), count=int(
-                        nbytes // np.dtype(dtype).itemsize
-                    ), offset=off,
-                ).reshape(shape)
-            except ValueError:
-                # entry-level inconsistency: drop just it — the caller
-                # re-decodes
-                self._drop_locked(key, "corrupt_dropped")
-                if count:
-                    self._stats["misses"] += 1
-                return None
-            if count:
-                self._stats["hits"] += 1
-            return arr
+            registered = closed = False
+            with self._lock:
+                if self._closed:
+                    # close() tore the mmap table down while we were in
+                    # the unlocked open: registering now would leak a map
+                    # nothing ever closes — refuse and miss
+                    closed = True
+                    if count:
+                        self._stats["misses"] += 1
+                elif name in self._segments and name not in self._mmaps:
+                    self._mmaps[name] = mm
+                    registered = True
+            if not registered:
+                # lost the race (another reader mapped it, or the
+                # segment was dropped meanwhile): this map is surplus
+                mm.close()
+                if closed:
+                    return None
 
-    def _mmap_locked(self, name: str) -> mmap.mmap:
-        mm = self._mmaps.get(name)
-        if mm is None:
-            with open(os.path.join(self.root, name + ".bin"), "rb") as f:
-                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-            self._mmaps[name] = mm
-        return mm
+    def _read_view_locked(
+        self, key, mm, off, nbytes, dtype, shape, count: bool
+    ) -> "np.ndarray | None":
+        """Zero-copy view over an already-mapped segment (lock held)."""
+        try:
+            if off + nbytes > len(mm):
+                raise ValueError("entry outside segment")
+            arr = np.frombuffer(
+                mm, dtype=np.dtype(dtype), count=int(
+                    nbytes // np.dtype(dtype).itemsize
+                ), offset=off,
+            ).reshape(shape)
+        except ValueError:
+            # entry-level inconsistency: drop just it — the caller
+            # re-decodes
+            self._drop_locked(key, "corrupt_dropped")
+            if count:
+                self._stats["misses"] += 1
+            return None
+        if count:
+            self._stats["hits"] += 1
+        return arr
 
     # -- write path --------------------------------------------------------
     def put(self, key: tuple, arr: "np.ndarray") -> None:
@@ -449,9 +488,13 @@ class BlockStore:
     # -- lifecycle / stats -------------------------------------------------
     def close(self) -> None:
         """Flush pending blocks and release the mmaps (views stay valid —
-        they hold their own buffer references)."""
+        they hold their own buffer references).  Marks the store closed
+        so a reader mid-cold-open cannot register a fresh mmap into the
+        torn-down table (it misses instead); index/stats reads keep
+        working on a closed store."""
         self.flush()
         with self._lock:
+            self._closed = True
             for mm in self._mmaps.values():
                 try:
                     mm.close()

@@ -1,9 +1,11 @@
 """lt-lint: AST-based invariant checks for the concurrent subsystems.
 
-Five repo-specific rules over a small parent-linked-AST framework
-(:mod:`.core`); the CLI is ``tools/lt_lint.py`` (``--json``,
-``--changed``, exit 1 on any finding not suppressed by an inline
-``# lt: noqa[rule]`` or a reasoned ``LINT_BASELINE.json`` entry):
+Eight repo-specific rules over a parent-linked-AST framework
+(:mod:`.core`) and an interprocedural call-graph engine
+(:mod:`.callgraph`); the CLI is ``tools/lt_lint.py`` (``--json``,
+``--sarif``, ``--changed``, ``--prune-baseline``, exit 1 on any finding
+not suppressed by an inline ``# lt: noqa[rule]`` or a reasoned
+``LINT_BASELINE.json`` entry):
 
 ========  ==========================================================
 LT001     shared state mutated / snapshot-read outside its lock
@@ -11,12 +13,19 @@ LT002     blocking host sync outside ``runtime/fetch.py``
 LT003     side effects inside (or reachable from) jitted functions
 LT004     RunConfig ↔ CLI flag ↔ README-table coupling
 LT005     Telemetry emit-site fields vs the event schema
+LT006     lock-order cycles in the acquired-while-held graph
+LT007     blocking operation reachable while a lock is held
+LT008     resource not discharged (close/stop/shutdown) on every path
 ========  ==========================================================
 
-See README.md §Static analysis for the rule table with rationale and
+LT001–LT005 are statement-local; LT006–LT008 share one project call
+graph per run (resolved within the package, method dispatch approximated
+by receiver-type inference + attribute-name/class-index matching).  See
+README.md §Static analysis for the rule table with rationale and
 example findings.
 """
 
+from land_trendr_tpu.lintkit.blocking import BlockingUnderLockChecker
 from land_trendr_tpu.lintkit.configdoc import ConfigDocChecker
 from land_trendr_tpu.lintkit.core import (
     Baseline,
@@ -30,12 +39,15 @@ from land_trendr_tpu.lintkit.core import (
 from land_trendr_tpu.lintkit.eventschema import EventSchemaChecker
 from land_trendr_tpu.lintkit.hostsync import HostSyncChecker
 from land_trendr_tpu.lintkit.jitpurity import JitPurityChecker
+from land_trendr_tpu.lintkit.lifecycle import ResourceLifecycleChecker
+from land_trendr_tpu.lintkit.lockorder import LockOrderChecker
 from land_trendr_tpu.lintkit.locks import LockDisciplineChecker
 
 __all__ = [
     "ALL_CHECKERS",
     "Baseline",
     "BaselineError",
+    "BlockingUnderLockChecker",
     "Checker",
     "ConfigDocChecker",
     "EventSchemaChecker",
@@ -44,7 +56,9 @@ __all__ = [
     "HostSyncChecker",
     "JitPurityChecker",
     "LockDisciplineChecker",
+    "LockOrderChecker",
     "RepoCtx",
+    "ResourceLifecycleChecker",
     "default_checkers",
     "run_rules",
 ]
@@ -56,6 +70,9 @@ ALL_CHECKERS = (
     JitPurityChecker,
     ConfigDocChecker,
     EventSchemaChecker,
+    LockOrderChecker,
+    BlockingUnderLockChecker,
+    ResourceLifecycleChecker,
 )
 
 

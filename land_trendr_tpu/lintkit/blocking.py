@@ -1,0 +1,129 @@
+"""LT007 — blocking work reachable while a known lock is held.
+
+The exact PR-6 bug class: :class:`~land_trendr_tpu.io.blockstore.
+BlockStore`'s segment flush originally wrote multi-MiB data files while
+holding the instance lock, stalling every decode thread's ``get``/``put``
+behind a disk write — invisible to tests (artifacts identical), paid in
+tail latency on every tiered machine.  The fix pattern (detach the batch,
+write outside the lock, commit under it) is a design idiom this rule
+makes mandatory: **no blocking operation while a lintkit-known lock is
+held**, where "reachable" includes resolved calls — a lock-held call into
+a function whose transitive summary blocks is the same bug wearing a
+function boundary.
+
+Blocking operations (see :mod:`.callgraph`'s primitive table): file and
+socket IO (``open``, ``os.write``/``read``/``fsync``, ``mmap.mmap``,
+``.recv``/``.send``/…, file-handle ``.read``/``.write``), device
+transfers and waits (``device_put``, ``device_get``,
+``block_until_ready``), ``Future.result()``, ``sleep``, ``subprocess``,
+thread ``.join()``, ``Event``/``Condition`` ``.wait()``, and executor /
+server ``.shutdown()`` (unless ``wait=False``).
+
+Exemptions, each load-bearing:
+
+* **Condition.wait on the held lock** — ``Condition(self._lock)``
+  aliases the wrapped lock, and ``wait`` *releases* it for the
+  duration: the sanctioned dispatcher pattern
+  (``serve/server.py::_next_job``) is not a finding.  A ``wait`` on a
+  condition wrapping some *other* lock still is.
+* **construction-only functions** — a function reachable only from
+  ``__init__`` holds its lock uncontended (nothing else can see the
+  object yet); ``BlockStore._load``'s under-lock recovery scan is the
+  canonical example.  This is LT001's ``__init__`` exemption carried
+  through the call graph.
+* **``*_locked`` convention** — the suffix documents "caller holds the
+  lock", so the body is checked as if a lock were held even when no
+  ``with`` is visible: blocking work inside ``_foo_locked`` is a finding
+  at the operation, not at every caller.
+
+Deliberate serialization locks (a lock whose entire purpose is to order
+IO, like the event log's append lock or the store's one-flush-at-a-time
+lock) are baselined with their rationale, not exempted structurally —
+the next reader should find the justification written down.
+
+Scope: ``tests/`` is excluded (fixtures model violations on purpose).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.callgraph import get_graph
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+
+__all__ = ["BlockingUnderLockChecker"]
+
+
+class BlockingUnderLockChecker(Checker):
+    rule_id = "LT007"
+    title = "blocking operation reachable while a lock is held"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        return {f for f in repo.py_files if not f.startswith("tests/")}
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        graph = get_graph(repo)
+        seen: set = set()
+        for info in graph.functions():
+            if info.file.startswith("tests/"):
+                continue
+            symbol = f"{info.cls}.{info.name}" if info.cls else info.name
+            convention = info.locked_convention
+            if graph.construction_only(info.qname):
+                continue
+            for op in info.blocking:
+                if not op.held and not convention:
+                    continue
+                lock = (
+                    graph.lock_name(op.held[-1])
+                    if op.held
+                    else "the caller's lock (*_locked convention)"
+                )
+                key = (info.file, op.line, op.desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    info.file, op.line, self.rule_id,
+                    f"{op.desc} while holding '{lock}' — blocking work "
+                    "under a lock stalls every thread contending for it; "
+                    "move the IO/wait outside the critical section "
+                    "(detach-then-commit) or record the serialization "
+                    "rationale in the baseline",
+                    symbol=symbol,
+                )
+            for site in info.calls:
+                if not site.held and not convention:
+                    continue
+                for callee in site.resolved:
+                    if callee == info.qname:
+                        continue
+                    cinfo = graph.funcs.get(callee)
+                    if cinfo is not None and graph.construction_only(callee):
+                        continue
+                    chain = graph.blocking_chain(callee)
+                    if chain is None:
+                        continue
+                    desc, line, path = chain
+                    lock = (
+                        graph.lock_name(site.held[-1])
+                        if site.held
+                        else "the caller's lock (*_locked convention)"
+                    )
+                    via = " -> ".join(
+                        q.split("::", 1)[-1] for q in path
+                    )
+                    key = (info.file, site.line, callee)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(
+                        info.file, site.line, self.rule_id,
+                        f"call to {site.label}() blocks ({desc} at "
+                        f"{path[-1].split('::', 1)[0]}:{line} via {via}) "
+                        f"while holding '{lock}' — the lock is held "
+                        "across the whole call; restructure so the "
+                        "blocking step runs outside the critical section",
+                        symbol=symbol,
+                    )
+                    break  # one finding per call site, not per candidate

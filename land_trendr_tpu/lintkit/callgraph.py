@@ -1,0 +1,860 @@
+"""Interprocedural analysis engine: project call graph + effect summaries.
+
+lt-lint v1 (LT001–LT005) is statement-local by design: every rule asks a
+question one AST walk can answer.  The defect classes the review logs
+keep finding by hand are not — a lock-ordering hazard spans two
+functions that each look fine alone, the PR-6 blockstore bug was
+multi-MiB blocking work reached *through a call* made under a lock, and
+the PR-7 leaks were resources created in one method and (not) closed in
+another.  This module is the shared machinery the interprocedural rules
+(:mod:`.lockorder` LT006, :mod:`.blocking` LT007, :mod:`.lifecycle`
+LT008) stand on:
+
+* a **project call graph** — every function/method in the linted tree,
+  with call sites resolved by name within the package: direct names to
+  same-module (or ``from``-imported) functions, ``self.m()`` through the
+  class and its bases, ``obj.m()`` through a light receiver-type
+  inference (``self.x = ClassName(...)`` in ``__init__``, local
+  ``x = ClassName(...)`` bindings, module aliases), and — last resort —
+  **attribute-name dispatch** against the project class index when the
+  method name is distinctive (defined by at most two project classes and
+  not a common container-method name);
+* per-function **summaries** — locks acquired (``with <lock>`` /
+  ``.acquire()``, with :class:`threading.Condition` objects aliased to
+  the lock they wrap, so ``with self._cond`` and ``with self._lock``
+  unify when the condition was built as ``Condition(self._lock)``),
+  primitive **blocking operations** (file/socket IO, ``device_put`` /
+  ``block_until_ready``, ``Future.result``, ``sleep``, subprocess,
+  thread ``join``, ``Event``/``Condition`` ``wait``), and the held-lock
+  context of every call site;
+* **fixpoints** over the graph — the transitive lock-acquisition set of
+  a function and a witness chain to the nearest blocking operation —
+  plus a **construction-only** classification (functions reachable only
+  from ``__init__``, where a held lock is uncontended by construction,
+  mirroring LT001's ``__init__`` exemption).
+
+Identity model: a lock is ``(file, owner, attr)`` where ``owner`` is the
+defining class name ("" for module locks).  Class-level identity is the
+standard approximation for ordering analysis — two instances of one
+class are distinct locks at runtime, but an ordering hazard between the
+*classes* is exactly what a reviewer needs to see.  ``Condition.wait``
+releases (and reacquires) the wrapped lock, so a ``wait`` whose receiver
+aliases a lock held at that site is *not* a blocking operation for
+LT007, and nothing "acquired inside the wait" creates LT006 edges.
+
+Everything is stdlib ``ast``; the graph for the whole tree builds in
+well under a second and is memoized per :class:`RepoCtx` via
+``repo.cache`` so the three rules share one build.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import RepoCtx
+
+__all__ = [
+    "LockId",
+    "CallSite",
+    "BlockingOp",
+    "FuncInfo",
+    "ProjectGraph",
+    "get_graph",
+]
+
+#: receiver-less attribute dispatch is only attempted for method names
+#: defined by at most this many project classes
+_DISPATCH_FANOUT = 2
+
+#: method names too generic for receiver-less dispatch: linking every
+#: ``d.get(...)`` to a project class named method would drown the graph
+#: in false edges (dict/list/set/queue/logger vocabulary)
+_COMMON_METHODS = frozenset(
+    {
+        "get", "put", "pop", "items", "keys", "values", "update", "append",
+        "add", "remove", "discard", "clear", "copy", "setdefault", "extend",
+        "insert", "sort", "reverse", "close", "open", "start", "stop", "run",
+        "read", "write", "emit", "set", "submit", "result", "join", "wait",
+        "acquire", "release", "send", "recv", "flush", "shutdown", "cancel",
+        "info", "warning", "error", "debug", "exception", "critical", "log",
+        "match", "search", "split", "strip", "format", "encode", "decode",
+        "tick", "check", "record", "observe", "inc", "dec", "render",
+    }
+)
+
+#: os.* calls that move bytes (the PR-6 class); metadata operations
+#: (unlink/replace/stat) are deliberately excluded — flagging every
+#: eviction unlink under a lock would drown the multi-MiB signal
+_OS_BLOCKING = frozenset({"write", "read", "fsync", "sendfile", "pread", "pwrite"})
+
+_SUBPROCESS_CALLS = frozenset({"run", "Popen", "call", "check_call", "check_output"})
+
+_SOCKET_METHODS = frozenset({"recv", "recv_into", "send", "sendall", "accept", "connect"})
+
+_LOCK_CTORS = ("Lock", "RLock")
+
+
+# ---------------------------------------------------------------------------
+# identity / data model
+
+#: (file, owner-class ("" = module scope), attribute/name)
+LockId = tuple
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call expression inside a function body."""
+
+    line: int
+    held: tuple  # LockIds held (syntactically) at the site, outermost first
+    resolved: tuple  # qnames of candidate callees ("" when unresolved)
+    label: str  # human form of the callee expression ("self.flush", "open")
+
+
+@dataclasses.dataclass
+class BlockingOp:
+    """One primitive blocking operation inside a function body."""
+
+    line: int
+    desc: str
+    held: tuple  # LockIds held at the site
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method of the linted tree plus its effect summary."""
+
+    qname: str  # "path.py::Class.method" / "path.py::func"
+    file: str
+    cls: "str | None"
+    name: str
+    node: ast.AST
+    # -- summary (filled by _summarize) -----------------------------------
+    acquires: set = dataclasses.field(default_factory=set)  # direct LockIds
+    blocking: list = dataclasses.field(default_factory=list)  # [BlockingOp]
+    calls: list = dataclasses.field(default_factory=list)  # [CallSite]
+    lock_edges: list = dataclasses.field(default_factory=list)
+    #: direct (held, inner, line) with-nesting edges
+
+    @property
+    def locked_convention(self) -> bool:
+        return self.name.endswith("_locked")
+
+
+def _terminal_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "mutex" in low or low.endswith("_cond") or low == "cond"
+
+
+class _Module:
+    """Per-file symbol tables: classes, functions, imports, locks, types."""
+
+    def __init__(self, file: str, tree: ast.AST) -> None:
+        self.file = file
+        self.tree = tree
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.functions: dict[str, str] = {}  # name -> qname
+        self.imports: dict[str, tuple] = {}  # alias -> ("mod"|"sym", dotted)
+        self.module_locks: dict[str, LockId] = {}
+        self.lock_kind: dict[LockId, str] = {}  # "Lock"|"RLock"|"Condition"
+        # (cls, attr) -> LockId for class locks; cls "" = module scope
+        self.attr_locks: dict[tuple, LockId] = {}
+        # (cls, attr) -> constructed class name (receiver-type inference)
+        self.attr_types: dict[tuple, str] = {}
+
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Import,)):
+                for a in stmt.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        "mod", a.name,
+                    )
+            elif isinstance(stmt, ast.ImportFrom) and stmt.module and not stmt.level:
+                for a in stmt.names:
+                    self.imports[a.asname or a.name] = (
+                        "sym", f"{stmt.module}.{a.name}",
+                    )
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = stmt
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        kind = _lock_ctor_kind(stmt.value)
+                        if kind is not None:
+                            lid = (self.file, "", t.id)
+                            self.module_locks[t.id] = lid
+                            self.lock_kind[lid] = kind
+
+
+def _lock_ctor_kind(value: ast.AST) -> "str | None":
+    """``threading.Lock()``/``RLock()``/``Condition(...)`` → its kind."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = _terminal_name(value.func)
+    if name in _LOCK_CTORS:
+        return name
+    if name == "Condition":
+        return "Condition"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the graph
+
+
+class ProjectGraph:
+    """Call graph + summaries over every parsed file of a RepoCtx."""
+
+    def __init__(self, repo: RepoCtx) -> None:
+        self.repo = repo
+        self.modules: dict[str, _Module] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        #: project-wide indexes
+        self.class_files: dict[str, list] = {}  # class name -> [(file, node)]
+        self.methods_by_name: dict[str, list] = {}  # meth -> [qname]
+        self.class_methods: dict[tuple, str] = {}  # (file, cls, meth) -> qname
+        self.class_bases: dict[tuple, list] = {}  # (file, cls) -> base names
+        self.callers: dict[str, set] = {}  # qname -> {caller qnames}
+        self.lock_kind: dict[LockId, str] = {}
+        self._trans_acquires: "dict[str, set] | None" = None
+        #: qname -> (terminal desc, terminal line, next-hop qname|None);
+        #: a worklist fixpoint, NOT memoized recursion — a cycle-guard
+        #: None cached mid-cycle would silently drop real chains
+        #: depending on query order
+        self._blocking_map: "dict[str, tuple] | None" = None
+        self._construction_only: "set | None" = None
+
+        for relpath in repo.py_files:
+            ctx = repo.file(relpath)
+            if ctx.tree is None:
+                continue
+            mod = _Module(relpath, ctx.tree)
+            self.modules[relpath] = mod
+            for cname, cnode in mod.classes.items():
+                self.class_files.setdefault(cname, []).append((relpath, cnode))
+                self.class_bases[(relpath, cname)] = [
+                    _terminal_name(b) for b in cnode.bases
+                ]
+            self._index_functions(mod)
+
+        for mod in self.modules.values():
+            self._collect_class_state(mod)
+        for info in self.funcs.values():
+            self._summarize(info)
+        for info in self.funcs.values():
+            for site in info.calls:
+                for q in site.resolved:
+                    self.callers.setdefault(q, set()).add(info.qname)
+
+    # -- indexing ----------------------------------------------------------
+    def _index_functions(self, mod: _Module) -> None:
+        def add(node, cls: "str | None") -> None:
+            qname = (
+                f"{mod.file}::{cls}.{node.name}" if cls else f"{mod.file}::{node.name}"
+            )
+            # first definition wins (overloads/conditionals are rare and
+            # the first is the common branch)
+            if qname in self.funcs:
+                return
+            info = FuncInfo(qname, mod.file, cls, node.name, node)
+            self.funcs[qname] = info
+            if cls is None:
+                mod.functions.setdefault(node.name, qname)
+            else:
+                self.class_methods[(mod.file, cls, node.name)] = qname
+                self.methods_by_name.setdefault(node.name, []).append(qname)
+
+        for stmt in mod.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add(stmt, None)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        add(sub, stmt.name)
+        # nested defs participate as callees of their parent only; they
+        # are walked inline by the summaries, not indexed
+
+    def _collect_class_state(self, mod: _Module) -> None:
+        """Lock attributes and receiver types per class (whole class body:
+        locks are conventionally made in ``__init__`` but shared locks
+        arrive through parameters anywhere)."""
+        for cname, cnode in mod.classes.items():
+            for node in ast.walk(cnode):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    kind = _lock_ctor_kind(node.value)
+                    if kind == "Condition":
+                        # Condition(self._lock) ALIASES the wrapped lock;
+                        # Condition() owns its own
+                        args = node.value.args  # type: ignore[union-attr]
+                        target = None
+                        if args:
+                            wrapped = _terminal_name(args[0])
+                            target = mod.attr_locks.get((cname, wrapped))
+                            if target is None and wrapped:
+                                target = (mod.file, cname, wrapped)
+                                mod.attr_locks[(cname, wrapped)] = target
+                                mod.lock_kind.setdefault(target, "Lock")
+                        lid = target if target is not None else (
+                            mod.file, cname, t.attr
+                        )
+                        mod.attr_locks[(cname, t.attr)] = lid
+                        mod.lock_kind.setdefault(lid, "Condition")
+                        if target is not None:
+                            # remember the alias is condition-typed for
+                            # the wait() exemption
+                            mod.lock_kind[(mod.file, cname, t.attr)] = "Condition"
+                    elif kind is not None:
+                        lid = (mod.file, cname, t.attr)
+                        mod.attr_locks[(cname, t.attr)] = lid
+                        mod.lock_kind[lid] = kind
+                    elif (
+                        isinstance(node.value, ast.Name)
+                        and _is_lockish_name(node.value.id)
+                    ):
+                        # a lock handed in by the owner (obs/metrics
+                        # instruments share the registry lock)
+                        lid = (mod.file, cname, t.attr)
+                        mod.attr_locks[(cname, t.attr)] = lid
+                        mod.lock_kind.setdefault(lid, "Lock")
+                    elif isinstance(node.value, ast.Call):
+                        ctor = self._resolve_class_name(mod, node.value.func)
+                        if ctor is not None:
+                            mod.attr_types[(cname, t.attr)] = ctor
+        self.lock_kind.update(mod.lock_kind)
+
+    def _resolve_class_name(self, mod: _Module, func: ast.AST) -> "str | None":
+        """The project class a constructor expression names, if any."""
+        name = _terminal_name(func)
+        if name in mod.classes or name in self.class_files:
+            return name
+        imp = mod.imports.get(name)
+        if imp is not None and imp[0] == "sym":
+            tail = imp[1].rsplit(".", 1)[-1]
+            if tail in self.class_files:
+                return tail
+        return None
+
+    # -- per-function summaries -------------------------------------------
+    def _lock_id_for(
+        self, mod: _Module, cls: "str | None", expr: ast.AST,
+        local_types: dict,
+    ) -> "LockId | None":
+        """The lock identity a ``with`` context / receiver expression
+        names, or None when it is not lock-like."""
+        if isinstance(expr, ast.Name):
+            lid = mod.module_locks.get(expr.id)
+            if lid is not None:
+                return lid
+            if _is_lockish_name(expr.id):
+                lid = (mod.file, "", expr.id)
+                mod.lock_kind.setdefault(lid, "Lock")
+                self.lock_kind.setdefault(lid, "Lock")
+                return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                owner: "str | None" = None
+                if base.id == "self" and cls is not None:
+                    owner = cls
+                    lid = self._class_lock(mod, cls, expr.attr)
+                    if lid is not None:
+                        return lid
+                else:
+                    owner = local_types.get(base.id)
+                    if owner is not None:
+                        ofile = self._class_file(mod, owner)
+                        if ofile is not None:
+                            omod = self.modules.get(ofile)
+                            if omod is not None:
+                                lid = self._class_lock(omod, owner, expr.attr)
+                                if lid is not None:
+                                    return lid
+                if _is_lockish_name(expr.attr):
+                    lid = (mod.file, owner or "?", expr.attr)
+                    mod.lock_kind.setdefault(lid, "Lock")
+                    self.lock_kind.setdefault(lid, "Lock")
+                    return lid
+        return None
+
+    def _class_lock(self, mod: _Module, cls: str, attr: str) -> "LockId | None":
+        """Lock attr of ``cls`` or (same-project) base classes."""
+        seen = set()
+        frontier = [(mod, cls)]
+        while frontier:
+            m, c = frontier.pop()
+            if (m.file, c) in seen:
+                continue
+            seen.add((m.file, c))
+            lid = m.attr_locks.get((c, attr))
+            if lid is not None:
+                return lid
+            for base in self.class_bases.get((m.file, c), ()):
+                bfile = self._class_file(m, base)
+                if bfile is not None and bfile in self.modules:
+                    frontier.append((self.modules[bfile], base))
+        return None
+
+    def _class_file(self, mod: _Module, cls: str) -> "str | None":
+        """The file defining ``cls``, same module preferred."""
+        if cls in mod.classes:
+            return mod.file
+        entries = self.class_files.get(cls)
+        if entries and len(entries) == 1:
+            return entries[0][0]
+        imp = mod.imports.get(cls)
+        if imp is not None and imp[0] == "sym" and entries:
+            dotted_mod = imp[1].rsplit(".", 1)[0].replace(".", "/") + ".py"
+            for file, _node in entries:
+                if file == dotted_mod:
+                    return file
+        if entries:
+            return entries[0][0]
+        return None
+
+    def _module_for_dotted(self, dotted: str) -> "str | None":
+        file = dotted.replace(".", "/") + ".py"
+        if file in self.modules:
+            return file
+        init = dotted.replace(".", "/") + "/__init__.py"
+        if init in self.modules:
+            return init
+        return None
+
+    def _resolve_call(
+        self,
+        mod: _Module,
+        cls: "str | None",
+        func: ast.AST,
+        local_types: dict,
+    ) -> list:
+        """Candidate callee qnames for a call expression's func."""
+        # plain name: local function, from-import, or class constructor
+        if isinstance(func, ast.Name):
+            q = mod.functions.get(func.id)
+            if q is not None:
+                return [q]
+            ctor = self._resolve_class_name(mod, func)
+            if ctor is not None:
+                cfile = self._class_file(mod, ctor)
+                if cfile is not None:
+                    q = self.class_methods.get((cfile, ctor, "__init__"))
+                    return [q] if q is not None else []
+            imp = mod.imports.get(func.id)
+            if imp is not None and imp[0] == "sym":
+                dotted, sym = imp[1].rsplit(".", 1)
+                mfile = self._module_for_dotted(dotted)
+                if mfile is not None:
+                    q = self.modules[mfile].functions.get(sym)
+                    if q is not None:
+                        return [q]
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        meth = func.attr
+        base = func.value
+        # self.m() — the class and its bases
+        if isinstance(base, ast.Name):
+            if base.id == "self" and cls is not None:
+                q = self._method_on(mod, cls, meth)
+                if q is not None:
+                    return [q]
+                return []
+            # module alias: blockcache.configure(...)
+            imp = mod.imports.get(base.id)
+            if imp is not None:
+                if imp[0] == "mod":
+                    mfile = self._module_for_dotted(imp[1])
+                elif imp[0] == "sym":
+                    mfile = self._module_for_dotted(imp[1])
+                else:
+                    mfile = None
+                if mfile is not None:
+                    q = self.modules[mfile].functions.get(meth)
+                    if q is not None:
+                        return [q]
+                    # ClassName.method(...) via from-import of a class
+                    tail = imp[1].rsplit(".", 1)[-1]
+                    q = self.class_methods.get((mfile, tail, meth))
+                    if q is not None:
+                        return [q]
+            # typed local receiver: store = BlockStore(...); store.get()
+            tname = local_types.get(base.id)
+            if tname is not None:
+                q = self._method_on(mod, tname, meth)
+                return [q] if q is not None else []
+            # ClassName.method(x) static-style call
+            if base.id in mod.classes or base.id in self.class_files:
+                q = self._method_on(mod, base.id, meth)
+                if q is not None:
+                    return [q]
+        # typed attribute receiver: self.store.put() with
+        # self.store = BlockStore(...) recorded in __init__
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and cls is not None
+        ):
+            tname = self._attr_type_on(mod, cls, base.attr)
+            if tname is not None:
+                q = self._method_on(mod, tname, meth)
+                return [q] if q is not None else []
+        # receiver-less attribute-name dispatch (the documented
+        # approximation): only distinctive names, bounded fanout
+        if meth in _COMMON_METHODS:
+            return []
+        candidates = self.methods_by_name.get(meth, ())
+        if 0 < len(candidates) <= _DISPATCH_FANOUT:
+            return list(candidates)
+        return []
+
+    def _method_on(self, mod: _Module, cls: str, meth: str) -> "str | None":
+        """Method ``meth`` on ``cls`` or its (project) bases."""
+        seen = set()
+        frontier = [(mod, cls)]
+        while frontier:
+            m, c = frontier.pop()
+            if (m.file, c) in seen:
+                continue
+            seen.add((m.file, c))
+            cfile = self._class_file(m, c)
+            if cfile is None:
+                continue
+            q = self.class_methods.get((cfile, c, meth))
+            if q is not None:
+                return q
+            if cfile in self.modules:
+                cm = self.modules[cfile]
+                for base in self.class_bases.get((cfile, c), ()):
+                    frontier.append((cm, base))
+        return None
+
+    def _attr_type_on(self, mod: _Module, cls: str, attr: str) -> "str | None":
+        t = mod.attr_types.get((cls, attr))
+        if t is not None:
+            return t
+        for base in self.class_bases.get((mod.file, cls), ()):
+            bfile = self._class_file(mod, base)
+            if bfile is not None and bfile in self.modules:
+                t = self._attr_type_on(self.modules[bfile], base, attr)
+                if t is not None:
+                    return t
+        return None
+
+    def _local_types(self, fn: ast.AST, mod: _Module) -> dict:
+        """Local ``x = ClassName(...)`` bindings (last write wins is
+        ignored: first binding is the common case)."""
+        out: dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = self._resolve_class_name(mod, node.value.func)
+                if ctor is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, ctor)
+        return out
+
+    def _summarize(self, info: FuncInfo) -> None:
+        mod = self.modules[info.file]
+        local_types = self._local_types(info.node, mod)
+        open_aliases = {
+            item.optional_vars.id
+            for node in ast.walk(info.node)
+            if isinstance(node, ast.With)
+            for item in node.items
+            if isinstance(item.context_expr, ast.Call)
+            and _terminal_name(item.context_expr.func) == "open"
+            and isinstance(item.optional_vars, ast.Name)
+        }
+
+        def held_at(node: ast.AST) -> tuple:
+            """Locks syntactically held at ``node``, outermost first.
+            Stops at the nearest enclosing function definition: a nested
+            def's body runs when the closure is CALLED, not where it is
+            defined, so an outer ``with lock`` does not cover it."""
+            held = []
+            cur = getattr(node, "parent", None)
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        lid = self._lock_id_for(
+                            mod, info.cls, item.context_expr, local_types
+                        )
+                        if lid is not None:
+                            held.append(lid)
+                cur = getattr(cur, "parent", None)
+            held.reverse()  # outermost first
+            return tuple(held)
+
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.With):
+                # `with A, B:` acquires in item order — B is taken while
+                # A is held, exactly like the nested form, so earlier
+                # items of the SAME statement are held context too
+                stmt_held: list = []
+                for item in node.items:
+                    lid = self._lock_id_for(
+                        mod, info.cls, item.context_expr, local_types
+                    )
+                    if lid is not None:
+                        info.acquires.add(lid)
+                        for outer in tuple(held_at(node)) + tuple(stmt_held):
+                            if outer != lid:
+                                info.lock_edges.append(
+                                    (outer, lid, node.lineno)
+                                )
+                        stmt_held.append(lid)
+            elif isinstance(node, ast.Call):
+                held = held_at(node)
+                resolved = self._resolve_call(
+                    mod, info.cls, node.func, local_types
+                )
+                if resolved:
+                    info.calls.append(
+                        CallSite(
+                            node.lineno, held, tuple(resolved),
+                            ast.unparse(node.func) if hasattr(ast, "unparse")
+                            else _terminal_name(node.func),
+                        )
+                    )
+                    continue
+                if _terminal_name(node.func) == "acquire":
+                    recv = (
+                        node.func.value
+                        if isinstance(node.func, ast.Attribute)
+                        else None
+                    )
+                    lid = (
+                        self._lock_id_for(mod, info.cls, recv, local_types)
+                        if recv is not None
+                        else None
+                    )
+                    if lid is not None:
+                        info.acquires.add(lid)
+                        for outer in held:
+                            if outer != lid:
+                                info.lock_edges.append(
+                                    (outer, lid, node.lineno)
+                                )
+                    continue
+                desc = self._blocking_kind(
+                    mod, info.cls, node, local_types, open_aliases, held
+                )
+                if desc is not None:
+                    info.blocking.append(BlockingOp(node.lineno, desc, held))
+
+    def _blocking_kind(
+        self,
+        mod: _Module,
+        cls: "str | None",
+        node: ast.Call,
+        local_types: dict,
+        open_aliases: set,
+        held: tuple,
+    ) -> "str | None":
+        """The primitive blocking idiom an *unresolved* call expresses."""
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "open":
+                return "open() file IO"
+            if fn.id == "sleep":
+                return "sleep()"
+            if fn.id == "device_put":
+                return "device_put (host->device transfer)"
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = fn.value.id if isinstance(fn.value, ast.Name) else None
+        meth = fn.attr
+        if base == "os" and meth in _OS_BLOCKING:
+            return f"os.{meth}() file IO"
+        if base == "time" and meth == "sleep":
+            return "time.sleep()"
+        if base == "subprocess" and meth in _SUBPROCESS_CALLS:
+            return f"subprocess.{meth}()"
+        if base == "mmap" and meth == "mmap":
+            return "mmap.mmap() file mapping"
+        if base == "jax" and meth in ("device_put", "device_get"):
+            return f"jax.{meth}() (device transfer)"
+        if meth == "block_until_ready":
+            return "block_until_ready() device wait"
+        if meth in _SOCKET_METHODS:
+            return f".{meth}() socket IO"
+        if meth in ("read", "write") and base in open_aliases:
+            return f"file .{meth}() on '{base}'"
+        if meth == "result" and not _kw(node, "timeout"):
+            return ".result() future wait"
+        if meth == "get" and "queue" in _terminal_name(fn.value).lower():
+            # queue.Queue.get() blocks indefinitely by default; typing is
+            # name-based (a receiver CALLED a queue — `q.get()`,
+            # `self._job_queue.get()`) — the idiom the codebase uses
+            b = _kw(node, "block")
+            if not (isinstance(b, ast.Constant) and b.value is False):
+                return (
+                    f".get() on queue '{_terminal_name(fn.value)}' "
+                    "(blocking wait)"
+                )
+        if meth == "join":
+            # thread/process join: no positional args, or timeout only —
+            # ``sep.join(parts)`` always has exactly one positional arg
+            if not node.args:
+                return ".join() thread wait"
+            return None
+        if meth in ("wait", "wait_for"):
+            recv_lid = (
+                self._lock_id_for(mod, cls, fn.value, local_types)
+                if isinstance(fn.value, (ast.Name, ast.Attribute))
+                else None
+            )
+            if recv_lid is not None and recv_lid in held:
+                # Condition.wait on the HELD lock releases it for the
+                # duration of the wait — the sanctioned dispatcher
+                # pattern, not blocking-under-lock
+                return None
+            return f".{meth}() blocking wait"
+        if meth == "shutdown":
+            w = _kw(node, "wait")
+            if w is not None and isinstance(w, ast.Constant) and w.value is False:
+                return None
+            return ".shutdown() pool/server drain"
+        return None
+
+    # -- fixpoints ---------------------------------------------------------
+    def trans_acquires(self, qname: str) -> set:
+        """Every lock a call to ``qname`` may acquire, transitively."""
+        if self._trans_acquires is None:
+            acq = {q: set(f.acquires) for q, f in self.funcs.items()}
+            changed = True
+            while changed:
+                changed = False
+                for q, f in self.funcs.items():
+                    mine = acq[q]
+                    before = len(mine)
+                    for site in f.calls:
+                        for callee in site.resolved:
+                            if callee in acq:
+                                mine |= acq[callee]
+                    if len(mine) != before:
+                        changed = True
+            self._trans_acquires = acq
+        return self._trans_acquires.get(qname, set())
+
+    def blocking_chain(self, qname: str) -> "tuple | None":
+        """``(desc, line, chain)`` witnessing the nearest blocking op
+        reachable from ``qname`` (chain = list of qnames walked, the
+        last one containing the op), or None.  Blocking ops that sit
+        under a lock acquired INSIDE the callee are still reported: the
+        caller's lock is held around the whole call either way."""
+        if self._blocking_map is None:
+            blocks: dict[str, tuple] = {}
+            for q, f in self.funcs.items():
+                if f.blocking:
+                    op = f.blocking[0]
+                    blocks[q] = (op.desc, op.line, None)
+            changed = True
+            while changed:
+                changed = False
+                for q, f in self.funcs.items():
+                    if q in blocks:
+                        continue
+                    hit = next(
+                        (
+                            c
+                            for site in f.calls
+                            for c in site.resolved
+                            if c != q and c in blocks
+                        ),
+                        None,
+                    )
+                    if hit is not None:
+                        sub = blocks[hit]
+                        blocks[q] = (sub[0], sub[1], hit)
+                        changed = True
+            self._blocking_map = blocks
+        ent = self._blocking_map.get(qname)
+        if ent is None:
+            return None
+        chain = [qname]
+        seen = {qname}
+        cur = ent[2]
+        while cur is not None and cur not in seen and len(chain) < 32:
+            chain.append(cur)
+            seen.add(cur)
+            cur = self._blocking_map[cur][2]
+        return (ent[0], ent[1], chain)
+
+    def construction_only(self, qname: str) -> bool:
+        """True when every (resolved) caller chain roots in ``__init__``
+        — the lock is uncontended by construction (LT001's ``__init__``
+        exemption, carried through the call graph)."""
+        if self._construction_only is None:
+            # start optimistic for everything with callers, then strip
+            inits = {
+                q for q, f in self.funcs.items() if f.name == "__init__"
+            }
+            candidates = {
+                q for q in self.funcs if q in self.callers and q not in inits
+            }
+            changed = True
+            while changed:
+                changed = False
+                for q in list(candidates):
+                    ok = all(
+                        c in inits or c in candidates
+                        for c in self.callers.get(q, ())
+                    )
+                    if not ok:
+                        candidates.discard(q)
+                        changed = True
+            self._construction_only = inits | candidates
+        return qname in self._construction_only
+
+    # -- iteration helpers -------------------------------------------------
+    def functions(self) -> Iterator[FuncInfo]:
+        return iter(self.funcs.values())
+
+    def lock_name(self, lid: LockId) -> str:
+        file, owner, attr = lid
+        if owner and owner not in ("?",):
+            return f"{owner}.{attr}"
+        return attr
+
+    def kind(self, lid: LockId) -> str:
+        return self.lock_kind.get(lid, "Lock")
+
+
+def _kw(node: ast.Call, name: str) -> "ast.AST | None":
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def get_graph(repo: RepoCtx) -> ProjectGraph:
+    """The memoized project graph for this lint run (built once, shared
+    by LT006/LT007/LT008)."""
+    g = repo.cache.get("callgraph")
+    if g is None:
+        g = repo.cache["callgraph"] = ProjectGraph(repo)
+    return g

@@ -72,16 +72,21 @@ class Finding:
     """One rule violation at a source location.
 
     ``file`` is repo-relative (what the baseline keys on and what CI
-    prints); ``line`` is 1-based.
+    prints); ``line`` is 1-based.  ``symbol`` is the enclosing
+    ``Class.method`` / function qualname (stamped by :func:`run_rules`
+    from the AST when the rule did not set it) — baselines key on it so
+    entries survive unrelated edits that shift line numbers.
     """
 
     file: str
     line: int
     rule_id: str
     message: str
+    symbol: str = ""
 
     def render(self) -> str:
-        return f"{self.file}:{self.line}: {self.rule_id} {self.message}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.file}:{self.line}: {self.rule_id}{sym} {self.message}"
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -135,13 +140,18 @@ class FileCtx:
         self.source = source
         self.lines = source.splitlines()
         self._tree: "ast.AST | None" = None
+        self._tree_parsed = False
         self._noqa: "dict[int, set[str]] | None" = None
+        self._symbols: "list[tuple[int, int, str]] | None" = None
 
     @property
     def tree(self) -> "ast.AST | None":
         """Parent-linked AST, or None when the file does not parse (a
-        syntax error is pytest/import-time territory, not lint's)."""
-        if self._tree is None:
+        syntax error is pytest/import-time territory, not lint's).  The
+        parse failure is cached too — without the flag every access
+        re-parsed a broken file."""
+        if not self._tree_parsed:
+            self._tree_parsed = True
             try:
                 self._tree = link_parents(ast.parse(self.source))
             except SyntaxError:
@@ -163,6 +173,41 @@ class FileCtx:
                         self._noqa[i] = {ALL_RULES}
         return self._noqa.get(line, set())
 
+    def symbol_at(self, line: int) -> str:
+        """The innermost enclosing ``Class.method``/function qualname
+        containing ``line``, or ``""`` at module level.  This is the
+        line-number-independent key baselines use: renaming or moving a
+        function invalidates its entries (the code changed), but edits
+        elsewhere in the file do not."""
+        if self._symbols is None:
+            self._symbols = []
+            tree = self.tree
+            if tree is not None:
+                def visit(node, prefix: str) -> None:
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(
+                            child,
+                            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                        ):
+                            qname = (
+                                f"{prefix}.{child.name}" if prefix else child.name
+                            )
+                            end = getattr(child, "end_lineno", child.lineno)
+                            self._symbols.append((child.lineno, end, qname))
+                            visit(child, qname)
+                        else:
+                            visit(child, prefix)
+
+                visit(tree, "")
+        best = ""
+        best_span = None
+        for start, end, qname in self._symbols:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qname, span
+        return best
+
     def suppressed(self, finding: Finding) -> bool:
         """Inline suppression: a ``# lt: noqa[...]`` on the finding's own
         line, or anywhere in the comment-only block immediately above it
@@ -183,6 +228,10 @@ class RepoCtx:
         self.root = os.path.abspath(root)
         self._files = sorted(files) if files is not None else None
         self._ctx: dict[str, FileCtx] = {}
+        #: scratch shared across rules in one run (the interprocedural
+        #: rules memoize their project graph here so LT006/7/8 build it
+        #: once, not three times)
+        self.cache: dict = {}
 
     @property
     def py_files(self) -> list[str]:
@@ -246,12 +295,15 @@ class Baseline:
     Entry shape::
 
         {"rule": "LT002", "file": "land_trendr_tpu/parallel/multihost.py",
-         "contains": "np.asarray", "reason": "gather path: ..."}
+         "symbol": "gather_local_rows", "contains": "np.asarray",
+         "reason": "gather path: ..."}
 
-    ``contains`` (optional) must be a substring of the finding message —
-    entries key on content, not line numbers, so unrelated edits to the
-    file do not invalidate them.  Every entry MUST carry a non-empty
-    ``reason``; an exception nobody can explain is not an exception.
+    Entries key on content, never line numbers, so unrelated edits to
+    the file do not invalidate them: ``symbol`` (optional) must equal
+    the finding's enclosing ``Class.method``/function qualname, and
+    ``contains`` (optional) must be a substring of the finding message.
+    Every entry MUST carry a non-empty ``reason``; an exception nobody
+    can explain is not an exception.
     """
 
     def __init__(self, entries: "list[dict] | None" = None) -> None:
@@ -277,6 +329,8 @@ class Baseline:
     def match(self, finding: Finding) -> "dict | None":
         for i, e in enumerate(self.entries):
             if e["rule"] != finding.rule_id or e["file"] != finding.file:
+                continue
+            if e.get("symbol") and e["symbol"] != finding.symbol:
                 continue
             if e.get("contains") and e["contains"] not in finding.message:
                 continue
@@ -324,7 +378,12 @@ def run_rules(
             ):
                 continue
             if finding.file.endswith(".py") and repo.exists(finding.file):
-                if repo.file(finding.file).suppressed(finding):
+                fctx = repo.file(finding.file)
+                if not finding.symbol:
+                    finding = dataclasses.replace(
+                        finding, symbol=fctx.symbol_at(finding.line)
+                    )
+                if fctx.suppressed(finding):
                     noqa_count += 1
                     continue
             entry = baseline.match(finding) if baseline is not None else None

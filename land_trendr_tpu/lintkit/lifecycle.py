@@ -1,0 +1,771 @@
+"""LT008 — resource lifecycle: every path must discharge the obligation.
+
+The PR-7 review found two of these by hand: a ``Run`` configured with
+``shared_cache=True`` could build an ingest store it never attached (so
+nothing ever closed it), and a server teardown ordering bug left the
+process-global cache pointing at a closed store.  The class is general:
+an object whose type carries a ``close``/``stop``/``shutdown``/``join``
+obligation is created on one line, and *some* path — usually the
+exception path nobody tests — exits without discharging it.  Leaked
+mmaps/fds keep segment files pinned past eviction, leaked executors keep
+non-daemon threads alive past the run, and a leaked fault plan poisons
+the next run in the process.
+
+Tracked resources:
+
+* stdlib constructors — ``open`` (outside ``with``), ``mmap.mmap``,
+  ``ThreadPoolExecutor``, non-daemon ``threading.Thread``,
+  ``threading.Timer``, ``subprocess.Popen``, ``socket.socket``;
+* **project classes that define** ``close``/``stop``/``shutdown`` —
+  resolved through :mod:`.callgraph`'s class index, so ``BlockStore``,
+  ``EventLog``, ``Telemetry``, the metrics exporter/server and the
+  serve-layer objects are all first-class.
+
+Per creation, a path-sensitive walk of the creating function checks:
+
+* **local ownership** (the function later calls the obligation method on
+  the name): every normal exit must have discharged — discharge inside
+  an ``if`` whose test mentions the name counts for the whole branch
+  point (the ``if x is not None: x.stop()`` idiom) — and every
+  may-raise statement executed while the resource is live must sit
+  inside a ``try`` whose handler or ``finally`` discharges it
+  (directly, or for ``self.`` attributes via a same-class method that
+  transitively discharges — ``except BaseException:
+  self._shutdown_shared()`` counts).  "May raise" means any call not on
+  the infallible-builtin whitelist, so the finding reads "leaks if line
+  N raises before the owning try/finally" — the exact shape of the PR-7
+  constructor bugs;
+* **escape** (returned, yielded, passed to a callee, stored into a
+  container or another object) transfers ownership and ends local
+  tracking — except a ``self.attr`` store, which converts the obligation
+  to the **class level**: somewhere in the project an obligation method
+  must be invoked on that attribute (``anything.attr.close()``); a
+  module-``global`` store likewise requires a discharge site in the same
+  module.  This is deliberately name-based — it cannot prove the close
+  runs, only that one *exists*; a store nobody closes anywhere is the
+  PR-7 bug with no false-positive risk;
+* a creation that is never discharged, never escapes, and never enters a
+  ``with`` is a certain leak, reported unconditionally.
+
+Scope: ``land_trendr_tpu/`` only (plus bare fixture files).  Tools and
+tests are process-scoped — their resources die with the interpreter —
+and fixtures model leaks on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.callgraph import _terminal_name, get_graph
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+
+__all__ = ["ResourceLifecycleChecker"]
+
+#: obligation methods that discharge a tracked resource
+_OBLIGATIONS = frozenset(
+    {"close", "stop", "shutdown", "join", "terminate", "kill", "cancel", "wait"}
+)
+
+#: class-defining methods that make a project class a tracked resource
+_RESOURCE_DEFS = ("close", "stop", "shutdown")
+
+#: stdlib constructor name -> human label
+_BUILTIN_CTORS = {
+    "ThreadPoolExecutor": "executor",
+    "Popen": "subprocess",
+    "Timer": "timer",
+}
+
+#: calls that cannot realistically raise — they do not count as
+#: "may raise before the owning try/finally"
+_INFALLIBLE = frozenset(
+    {
+        "deque", "list", "dict", "set", "tuple", "frozenset", "min", "max",
+        "len", "sorted", "int", "float", "str", "bool", "round", "abs",
+        "enumerate", "range", "zip", "iter", "getattr", "hasattr",
+        "isinstance", "id", "repr", "format", "perf_counter", "monotonic",
+        "time", "append", "appendleft", "popleft", "pop", "add", "discard",
+        "info", "warning", "error", "debug", "exception", "critical", "get",
+        "items", "keys", "values", "join", "split", "strip", "startswith",
+        "endswith", "rstrip", "lstrip", "copy", "setdefault", "update",
+        "field", "dataclass", "is_set", "astype",
+    }
+)
+
+
+def _names_in(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _shallow_walk(node: ast.AST):
+    """``ast.walk`` that does not descend into nested function bodies —
+    a closure's statements run when it is CALLED, not where it is
+    defined."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+class _Resource:
+    """One tracked creation inside one function."""
+
+    def __init__(self, label: str, line: int, target: "tuple | None") -> None:
+        self.label = label  # "BlockStore 'store'" for messages
+        self.line = line
+        #: ("name", x) local binding | ("attr", y) self.y | None (bare)
+        self.target = target
+
+    def is_expr(self, expr: ast.AST) -> bool:
+        """Does ``expr`` denote this resource?"""
+        if self.target is None:
+            return False
+        kind, name = self.target
+        if kind == "name":
+            return isinstance(expr, ast.Name) and expr.id == name
+        return (
+            isinstance(expr, ast.Attribute)
+            and expr.attr == name
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        )
+
+    def name_token(self) -> str:
+        return self.target[1] if self.target else ""
+
+
+class ResourceLifecycleChecker(Checker):
+    rule_id = "LT008"
+    title = "resource created but not discharged on every path"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        return {f for f in repo.py_files if not f.startswith("tests/")}
+
+    # -- project-level indexes --------------------------------------------
+    def _project_state(self, repo: RepoCtx) -> dict:
+        graph = get_graph(repo)
+        state = repo.cache.get("lifecycle")
+        if state is not None:
+            return state
+        # project classes that ARE resources: own/inherited close/stop/...
+        resource_classes: dict[str, str] = {}
+        for cname, entries in graph.class_files.items():
+            for file, _node in entries:
+                for meth in _RESOURCE_DEFS:
+                    if (file, cname, meth) in graph.class_methods:
+                        resource_classes.setdefault(cname, meth)
+        # attrs with a discharge site anywhere: .attr.<obl>() call
+        discharged_attrs: set = set()
+        # module-global names with a discharge site, per file
+        discharged_globals: dict[str, set] = {}
+        # (cls qname-prefix) methods that transitively discharge attr y
+        attr_discharging_methods: dict[tuple, set] = {}
+        for relpath in repo.py_files:
+            if relpath.startswith("tests/"):
+                continue
+            ctx = repo.file(relpath)
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _OBLIGATIONS
+                ):
+                    continue
+                recv = node.func.value
+                if isinstance(recv, ast.Attribute):
+                    discharged_attrs.add(recv.attr)
+                elif isinstance(recv, ast.Name):
+                    discharged_globals.setdefault(relpath, set()).add(recv.id)
+        # alias-aware global discharge: `old = _pool; ...; old.shutdown()`
+        # (the resize idiom) discharges the global it was read from
+        for relpath, names in list(discharged_globals.items()):
+            ctx = repo.file(relpath)
+            if ctx.tree is None:
+                continue
+            aliases: dict[str, str] = {}
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases[t.id] = node.value.id
+            for name in list(names):
+                if name in aliases:
+                    names.add(aliases[name])
+        # same-class methods that discharge self.<y>: one AST pass
+        # collecting per-method facts (direct attr discharges + self
+        # calls), then a table-only propagation — no re-walking
+        self_calls: dict[tuple, set] = {}
+        for (file, cls, meth), qname in graph.class_methods.items():
+            info = graph.funcs.get(qname)
+            if info is None:
+                continue
+            calls = self_calls.setdefault((file, cls, meth), set())
+            for node in ast.walk(info.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                ):
+                    continue
+                recv = node.func.value
+                if (
+                    node.func.attr in _OBLIGATIONS
+                    and isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"
+                ):
+                    attr_discharging_methods.setdefault(
+                        (file, cls, recv.attr), set()
+                    ).add(meth)
+                elif isinstance(recv, ast.Name) and recv.id == "self":
+                    calls.add(node.func.attr)
+        for _ in range(2):  # two hops: __init__ guard -> teardown -> close
+            for (file, cls, meth), calls in self_calls.items():
+                for (f2, c2, attr), meths in attr_discharging_methods.items():
+                    if f2 == file and c2 == cls and calls & meths:
+                        meths.add(meth)
+        state = repo.cache["lifecycle"] = {
+            "graph": graph,
+            "resource_classes": resource_classes,
+            "discharged_attrs": discharged_attrs,
+            "discharged_globals": discharged_globals,
+            "attr_methods": attr_discharging_methods,
+        }
+        return state
+
+    # -- creation recognition ---------------------------------------------
+    def _ctor_label(self, graph, mod, call: ast.Call) -> "str | None":
+        """Human label when ``call`` constructs a tracked resource."""
+        name = _terminal_name(call.func)
+        base = (
+            call.func.value.id
+            if isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            else None
+        )
+        if name == "open" and base in (None, "io"):
+            return "open() handle"
+        if name == "mmap" and base == "mmap":
+            return "mmap"
+        if name == "socket" and base == "socket":
+            return "socket"
+        if name == "Thread":
+            daemon = next(
+                (kw.value for kw in call.keywords if kw.arg == "daemon"), None
+            )
+            if isinstance(daemon, ast.Constant) and daemon.value is True:
+                return None  # daemon threads die with the process
+            return "thread"
+        if name in _BUILTIN_CTORS:
+            return _BUILTIN_CTORS[name]
+        state = self._state
+        cls = graph._resolve_class_name(mod, call.func)
+        if cls is not None and cls in state["resource_classes"]:
+            return f"{cls} (has .{state['resource_classes'][cls]}())"
+        return None
+
+    # -- the rule ----------------------------------------------------------
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        self._state = self._project_state(repo)
+        graph = self._state["graph"]
+        for info in graph.functions():
+            file = info.file
+            in_scope = file.startswith("land_trendr_tpu/") or "/" not in file
+            if not in_scope or file.startswith("tests/"):
+                continue
+            yield from self._check_function(graph, info)
+
+    def _check_function(self, graph, info) -> Iterator[Finding]:
+        # the outer function and each nested def are separate walks: a
+        # resource created AND discharged inside a closure belongs to
+        # the closure's own statement tree (collecting its creation at
+        # the outer level while walking only outer statements reported
+        # phantom "certain leak"s)
+        for fn in self._fn_and_nested(info.node):
+            yield from self._check_one_scope(graph, info, fn)
+
+    @staticmethod
+    def _fn_and_nested(fn: ast.AST):
+        out = [fn]
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn
+            ):
+                out.append(node)
+        return out
+
+    def _check_one_scope(self, graph, info, fn) -> Iterator[Finding]:
+        mod = graph.modules[info.file]
+        symbol = f"{info.cls}.{info.name}" if info.cls else info.name
+        global_names = {
+            n
+            for node in _shallow_walk(fn)
+            if isinstance(node, ast.Global)
+            for n in node.names
+        }
+        # with-managed and immediately-chained creations are discharged
+        with_ctx: set = set()
+        for node in _shallow_walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    with_ctx.add(id(item.context_expr))
+
+        for node in _shallow_walk(fn):
+            if not isinstance(node, ast.Assign) and not isinstance(node, ast.Expr):
+                continue
+            value = node.value
+            if isinstance(value, ast.IfExp):
+                # `self.x = Ctor(...) if flag else None` — the common
+                # optional-subsystem idiom: track the constructing arm
+                value = (
+                    value.body
+                    if isinstance(value.body, ast.Call)
+                    else value.orelse
+                )
+            call = None
+            if isinstance(value, ast.Call):
+                call = value
+                # the `X(...).start()` chain: the ctor is the receiver
+                if (
+                    self._ctor_label(graph, mod, call) is None
+                    and isinstance(value.func, ast.Attribute)
+                    and isinstance(value.func.value, ast.Call)
+                ):
+                    call = value.func.value
+            if call is None or id(call) in with_ctx:
+                continue
+            label = self._ctor_label(graph, mod, call)
+            if label is None:
+                continue
+            if isinstance(node, ast.Expr):
+                # constructed, never bound: nothing can ever discharge it
+                yield Finding(
+                    info.file, node.lineno, self.rule_id,
+                    f"{label} constructed but never bound — no path can "
+                    "discharge its close/stop/shutdown obligation",
+                    symbol=symbol,
+                )
+                continue
+            target = None
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    kind = "global" if t.id in global_names else "name"
+                    target = (kind, t.id)
+                    break
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    target = ("attr", t.attr)
+                    break
+            if target is None:
+                continue  # stored into a container: ownership transferred
+            yield from self._check_creation(
+                graph, info, fn, symbol, label, node, target
+            )
+
+    def _check_creation(
+        self, graph, info, fn, symbol, label, assign, target
+    ) -> Iterator[Finding]:
+        state = self._state
+        kind, name = target
+        if kind == "global":
+            # module-owned singleton: a discharge site must exist in the
+            # same module (process-wide pools are reconfigured there)
+            sites = state["discharged_globals"].get(info.file, set())
+            if name not in sites:
+                yield Finding(
+                    info.file, assign.lineno, self.rule_id,
+                    f"{label} stored to module global '{name}' but this "
+                    f"module never calls an obligation method on it — "
+                    "the resource outlives every owner",
+                    symbol=symbol,
+                )
+            return
+        res = _Resource(
+            f"{label} '{'self.' if kind == 'attr' else ''}{name}'",
+            assign.lineno,
+            ("attr", name) if kind == "attr" else ("name", name),
+        )
+        walker = _Walker(self, graph, info, fn, res, assign)
+        walker.run()
+        if kind == "attr":
+            # class-level obligation: SOME discharge site must exist
+            if name not in state["discharged_attrs"]:
+                yield Finding(
+                    info.file, assign.lineno, self.rule_id,
+                    f"{res.label} stored but no '.{name}.<close/stop/"
+                    "shutdown>()' call exists anywhere in the project — "
+                    "nothing ever discharges it (the PR-7 unattached-"
+                    "store class)",
+                    symbol=symbol,
+                )
+            # exception path within the creating function still applies
+            if walker.exc_leak is not None:
+                yield Finding(
+                    info.file, assign.lineno, self.rule_id,
+                    f"{res.label} leaks if line {walker.exc_leak} raises: "
+                    "the statements after the store are not guarded by a "
+                    "try whose handler/finally discharges it",
+                    symbol=symbol,
+                )
+            return
+        # local binding
+        if walker.escaped and not walker.discharges:
+            return  # ownership transferred wholesale
+        if not walker.discharges and not walker.escaped:
+            yield Finding(
+                info.file, assign.lineno, self.rule_id,
+                f"{res.label} is never closed, never escapes, and is not "
+                "a context manager here — a certain leak on every path",
+                symbol=symbol,
+            )
+            return
+        if walker.normal_leak:
+            yield Finding(
+                info.file, assign.lineno, self.rule_id,
+                f"{res.label} is not discharged on every normal path "
+                "(a branch returns/falls through with it live) — use "
+                "try/finally or `with`",
+                symbol=symbol,
+            )
+        if walker.exc_leak is not None:
+            yield Finding(
+                info.file, assign.lineno, self.rule_id,
+                f"{res.label} leaks if line {walker.exc_leak} raises "
+                "before the owning try/finally — move the creation "
+                "inside the try (or guard the gap with except "
+                "BaseException: discharge; raise)",
+                symbol=symbol,
+            )
+
+
+class _Walker:
+    """Path-sensitive walk of one function for one resource.
+
+    States are sets drawn from {"unborn", "live", "done"}; statements
+    map state sets to state sets, branches union, ``try`` handlers see
+    every state the body could be in, and a discharging ``finally``
+    (or handler) makes the gap between creation and the try safe.
+    """
+
+    def __init__(
+        self, checker, graph, info, fn, res: _Resource, assign
+    ) -> None:
+        self.checker = checker
+        self.graph = graph
+        self.info = info
+        self.fn = fn  # the scope being walked (outer fn OR a nested def)
+        self.res = res
+        self.assign = assign
+        self.discharges = False  # any obligation call on the resource
+        self.escaped = False
+        self.normal_leak = False
+        self.exc_leak: "int | None" = None
+        #: the function discharges this resource SOMEWHERE: it owns it,
+        #: so handing the name to a callee is a share, not a transfer —
+        #: escapes stop ending the walk and the exception-path analysis
+        #: stays armed (the driver stores the ingest store into the
+        #: process-global cache AND closes it in its finally: owned)
+        self.owned = False
+        #: nested defs whose body discharges this resource: a handler
+        #: calling `_release_setup()` counts as discharging everything
+        #: that closure releases (the telescoping-unwind idiom)
+        self._discharging_locals: set = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if sub is fn:
+                    continue
+                for n in ast.walk(sub):
+                    if (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _OBLIGATIONS
+                        and res.is_expr(n.func.value)
+                    ):
+                        self._discharging_locals.add(sub.name)
+                        break
+
+    # -- classification helpers -------------------------------------------
+    def _is_discharge(self, node: ast.AST) -> bool:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _OBLIGATIONS
+            and self.res.is_expr(node.func.value)
+        ):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in self._discharging_locals
+        ):
+            return True
+        # self._teardown() that transitively discharges self.<attr>
+        if (
+            self.res.target
+            and self.res.target[0] == "attr"
+            and isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+            and self.info.cls is not None
+        ):
+            meths = self.checker._state["attr_methods"].get(
+                (self.info.file, self.info.cls, self.res.target[1]), set()
+            )
+            if node.func.attr in meths:
+                return True
+        return False
+
+    def _block_discharges(self, stmts: list) -> bool:
+        for stmt in stmts:
+            for node in _shallow_walk(stmt):
+                if self._is_discharge(node):
+                    return True
+        return False
+
+    def _carries_resource(self, expr: ast.AST, name: str) -> bool:
+        """Does a returned/yielded expression hand the HANDLE out?
+        ``return fh`` / ``return (a, fh)`` / ``return wrap(fh)`` do;
+        ``return fh.read()`` returns derived data — the receiver of a
+        method call is not ownership transfer."""
+        if isinstance(expr, ast.Name):
+            return expr.id == name
+        if isinstance(expr, ast.Attribute):
+            return False
+        if isinstance(expr, ast.Call):
+            return any(
+                self._carries_resource(a, name) for a in expr.args
+            ) or any(
+                self._carries_resource(kw.value, name)
+                for kw in expr.keywords
+            )
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._carries_resource(e, name) for e in expr.elts)
+        if isinstance(expr, ast.Dict):
+            return any(
+                self._carries_resource(v, name) for v in expr.values
+            )
+        if isinstance(expr, ast.IfExp):
+            return self._carries_resource(
+                expr.body, name
+            ) or self._carries_resource(expr.orelse, name)
+        return name in _names_in(expr)  # odd shapes: stay conservative
+
+    def _stmt_escapes(self, stmt: ast.AST) -> bool:
+        """The resource's NAME leaves this function's ownership."""
+        if self.res.target is None or self.res.target[0] != "name":
+            return False
+        name = self.res.name_token()
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and self._carries_resource(
+                    node.value, name
+                ):
+                    return True
+            elif isinstance(node, ast.Call):
+                if self._is_discharge(node):
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == name:
+                        return True
+            elif isinstance(node, ast.Assign):
+                if isinstance(node.value, ast.Name) and node.value.id == name:
+                    return True
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # captured by a closure: lifetime leaves this walk
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        return True
+        return False
+
+    def _may_raise(self, stmt: ast.AST) -> bool:
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            return True
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False  # a def itself does not run its body
+        # compound statements recurse through _walk_inner, where their
+        # bodies see the right protection context — only the HEADER
+        # expression is evaluated at this level
+        if isinstance(stmt, (ast.If, ast.While)):
+            exprs: list = [stmt.test]
+        elif isinstance(stmt, ast.For):
+            exprs = [stmt.iter]
+        elif isinstance(stmt, ast.With):
+            exprs = [item.context_expr for item in stmt.items]
+        elif isinstance(stmt, ast.Try):
+            return False
+        else:
+            exprs = [stmt]
+        for expr in exprs:
+            for node in _shallow_walk(expr):
+                if isinstance(node, ast.Call):
+                    if self._is_discharge(node):
+                        continue
+                    if _terminal_name(node.func) not in _INFALLIBLE:
+                        return True
+        return False
+
+    def _is_daemon_mark(self, stmt: ast.AST) -> bool:
+        """``x.daemon = True`` — a daemon thread/timer dies with the
+        process; the join/cancel obligation evaporates."""
+        return (
+            isinstance(stmt, ast.Assign)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is True
+            and any(
+                isinstance(t, ast.Attribute)
+                and t.attr == "daemon"
+                and self.res.is_expr(t.value)
+                for t in stmt.targets
+            )
+        )
+
+    # -- the walk ----------------------------------------------------------
+    def run(self) -> None:
+        self.owned = self._block_discharges(list(self.fn.body))
+        self._walk(
+            list(self.fn.body), {"unborn"}, protected=False, sinks=(),
+            fin=False,
+        )
+
+    def _note_exit(self, states: set) -> None:
+        if "live" in states:
+            self.normal_leak = True
+
+    def _walk(
+        self, stmts: list, states: set, protected: bool, sinks: tuple,
+        fin: bool,
+    ) -> set:
+        states = set(states)
+        for stmt in stmts:
+            if stmt is self.assign:
+                states = (states - {"unborn"}) | {"live"}
+                continue
+            if "live" not in states:
+                # before creation / after discharge on all paths: the
+                # statement cannot leak this resource
+                if isinstance(stmt, (ast.Return,)):
+                    return set()
+                states = self._walk_inner(stmt, states, protected, sinks, fin)
+                continue
+            # discharge / daemon-mark / escape checks (same-statement wins)
+            if self._block_discharges([stmt]) and not isinstance(
+                stmt, (ast.Try, ast.If, ast.For, ast.While, ast.With)
+            ):
+                self.discharges = True
+                states = (states - {"live"}) | {"done"}
+                continue
+            if self._is_daemon_mark(stmt):
+                self.discharges = True
+                states = (states - {"live"}) | {"done"}
+                continue
+            if not self.owned and self._stmt_escapes(stmt):
+                self.escaped = True
+                states = (states - {"live"}) | {"done"}
+                continue
+            if self._may_raise(stmt) and not isinstance(stmt, (ast.Try,)):
+                for sink in sinks:
+                    sink |= states
+                if not protected and self.exc_leak is None:
+                    self.exc_leak = stmt.lineno
+            if isinstance(stmt, ast.Return):
+                # a discharging finally runs ON return too: leaving
+                # through it is a clean exit, not a normal-path leak
+                if not fin:
+                    self._note_exit(states)
+                return set()
+            if isinstance(stmt, ast.Raise):
+                return set()
+            states = self._walk_inner(stmt, states, protected, sinks, fin)
+        return states
+
+    def _walk_inner(
+        self, stmt: ast.AST, states: set, protected: bool, sinks: tuple,
+        fin: bool,
+    ) -> set:
+        if isinstance(stmt, ast.If):
+            mentions = self.res.name_token() and (
+                self.res.name_token() in _names_in(stmt.test)
+                or (
+                    self.res.target
+                    and self.res.target[0] == "attr"
+                    and any(
+                        isinstance(n, ast.Attribute)
+                        and n.attr == self.res.name_token()
+                        for n in ast.walk(stmt.test)
+                    )
+                )
+            )
+            a = self._walk(list(stmt.body), states, protected, sinks, fin)
+            b = self._walk(list(stmt.orelse), states, protected, sinks, fin)
+            out = a | b
+            if mentions and ("done" in a or "done" in b):
+                # `if x is not None: x.stop()` — the None branch holds
+                # nothing; treat the branch point as discharging
+                self.discharges = True
+                out = (out - {"live"}) | {"done"}
+            return out
+        if isinstance(stmt, (ast.For, ast.While)):
+            body = self._walk(list(stmt.body), states, protected, sinks, fin)
+            orelse = self._walk(
+                list(stmt.orelse), states | body, protected, sinks, fin
+            )
+            return states | body | orelse
+        if isinstance(stmt, ast.With):
+            return self._walk(list(stmt.body), states, protected, sinks, fin)
+        if isinstance(stmt, ast.Try):
+            protects_finally = self._block_discharges(stmt.finalbody)
+            protects = protects_finally or any(
+                self._block_discharges(h.body) for h in stmt.handlers
+            )
+            #: states observed at may-raise statements inside the body —
+            #: what a handler can actually see on entry (entry/exit
+            #: states would claim "live" for a creation that is the
+            #: body's LAST statement, a false leak)
+            raised: set = set()
+            body = self._walk(
+                list(stmt.body), states,
+                protected or protects,
+                sinks + (raised,),
+                fin or protects_finally,
+            )
+            handler_entry = raised or (states - {"live"} or {"unborn"})
+            hstates: set = set()
+            for h in stmt.handlers:
+                # a discharging finally runs even when the HANDLER
+                # raises (or returns), so it protects handler bodies too
+                hstates |= self._walk(
+                    list(h.body), handler_entry,
+                    protected or protects_finally, sinks,
+                    fin or protects_finally,
+                )
+            orelse = self._walk(
+                list(stmt.orelse), body, protected or protects, sinks,
+                fin or protects_finally,
+            )
+            merged = orelse | hstates if stmt.handlers else orelse
+            if stmt.finalbody:
+                if self._block_discharges(stmt.finalbody):
+                    self.discharges = True
+                    merged = (merged - {"live"}) | {"done"}
+                else:
+                    merged = self._walk(
+                        list(stmt.finalbody), merged, protected, sinks, fin
+                    )
+            return merged
+        return states

@@ -1,0 +1,190 @@
+"""LT006 — lock-order cycles (deadlock candidates) across the call graph.
+
+Two threads that acquire the same two locks in opposite orders will,
+eventually, do so at the same time — and the continent-scale runs this
+system targets (arXiv:1807.01751) turn "eventually" into "this week".
+The hazard is invisible statement-locally: each ``with`` looks fine; the
+cycle only exists in the *acquired-while-held* relation, and after PR 7
+that relation spans modules (a server callback holding the serve lock
+can reach the metrics registry lock through three calls).
+
+The rule computes, over :mod:`.callgraph`'s project graph:
+
+* the **acquired-while-held edge set**: lock ``A`` → lock ``B`` when
+  some function acquires ``B`` (a nested ``with``/``.acquire()``) while
+  ``A`` is held — directly, or transitively through resolved call edges
+  (the callee's transitive acquisition set);
+* **cycles** in that digraph (Tarjan SCC): each strongly-connected
+  component with more than one lock is a deadlock candidate, reported
+  once with every witness edge (file:line and the call it rides);
+* **same-instance re-acquisition**: a function holding non-reentrant
+  ``threading.Lock`` ``A`` whose direct ``self.``/same-module callee
+  acquires ``A`` again — not a cycle, a certain deadlock on first
+  execution.
+
+``Condition.wait`` gets its documented caveat for free: a condition
+built as ``Condition(self._lock)`` *aliases* the wrapped lock in the
+identity model, so ``with self._cond`` and ``with self._lock`` are one
+node (no false A→B edge between them), and the wait itself acquires
+nothing.  Lock identity is class-level — instance-level ordering
+(``a._lock`` before ``b._lock`` of one class, sorted by some key) is
+indistinguishable statically and would be flagged; such deliberate
+protocols belong in the baseline with the ordering rule written down.
+
+Scope: ``tests/`` is excluded (fixtures model violations on purpose).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.callgraph import get_graph
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+
+__all__ = ["LockOrderChecker"]
+
+
+def _sccs(nodes: set, edges: dict) -> list:
+    """Tarjan strongly-connected components (iterative)."""
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    out: list = []
+    counter = [0]
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(edges.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(edges.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out
+
+
+class LockOrderChecker(Checker):
+    rule_id = "LT006"
+    title = "lock-order cycle (deadlock candidate) in the acquired-while-held graph"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        # interprocedural: any package/tool file can add an edge
+        return {f for f in repo.py_files if not f.startswith("tests/")}
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        graph = get_graph(repo)
+        # edge -> (file, line, symbol, via); first witness wins
+        edges: dict = {}
+        reacq: list = []
+        for info in graph.functions():
+            if info.file.startswith("tests/"):
+                continue
+            symbol = f"{info.cls}.{info.name}" if info.cls else info.name
+            for held, inner, line in info.lock_edges:
+                edges.setdefault(
+                    (held, inner),
+                    (info.file, line, symbol, "nested with"),
+                )
+            for site in info.calls:
+                if not site.held:
+                    continue
+                same_instance = site.label.startswith("self.") or "." not in site.label
+                for callee in site.resolved:
+                    acquired = graph.trans_acquires(callee)
+                    direct = (
+                        graph.funcs[callee].acquires
+                        if callee in graph.funcs
+                        else set()
+                    )
+                    for held in site.held:
+                        for lid in acquired:
+                            if lid == held:
+                                continue
+                            edges.setdefault(
+                                (held, lid),
+                                (
+                                    info.file, site.line, symbol,
+                                    f"call to {site.label}()",
+                                ),
+                            )
+                        if (
+                            same_instance
+                            and held in direct
+                            and graph.kind(held) == "Lock"
+                            and callee in graph.funcs
+                            and not graph.funcs[callee].locked_convention
+                        ):
+                            reacq.append(
+                                (info.file, site.line, symbol, held, site.label)
+                            )
+
+        for file, line, symbol, held, label in reacq:
+            yield Finding(
+                file, line, self.rule_id,
+                f"re-acquisition deadlock: '{label}()' acquires non-"
+                f"reentrant lock '{graph.lock_name(held)}' already held at "
+                "the call site — threading.Lock is not reentrant; this "
+                "blocks forever on first execution",
+                symbol=symbol,
+            )
+
+        adj: dict = {}
+        nodes: set = set()
+        for (a, b), _w in edges.items():
+            adj.setdefault(a, set()).add(b)
+            nodes.add(a)
+            nodes.add(b)
+        for comp in _sccs(nodes, adj):
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            witnesses = sorted(
+                (w for (a, b), w in edges.items()
+                 if a in comp_set and b in comp_set),
+                key=lambda w: (w[0], w[1]),
+            )
+            names = " <-> ".join(
+                sorted(graph.lock_name(lid) for lid in comp)
+            )
+            detail = "; ".join(
+                f"{w[2]} at {w[0]}:{w[1]} ({w[3]})" for w in witnesses[:4]
+            )
+            first = witnesses[0]
+            yield Finding(
+                first[0], first[1], self.rule_id,
+                f"lock-order cycle between {{{names}}} — two threads "
+                "taking these locks in opposite orders deadlock; order "
+                f"them consistently or split the critical sections "
+                f"[witnesses: {detail}]",
+                symbol=first[2],
+            )

@@ -77,10 +77,15 @@ class Telemetry:
         except BaseException:
             # a half-built Telemetry (e.g. --metrics-port already bound)
             # must not leak the event fd, the exporter thread, or the
-            # server — the caller only gets the exception, never a handle
-            if getattr(self, "_server", None) is not None:
-                self._server.stop()
-            self.events.close()
+            # server — the caller only gets the exception, never a handle.
+            # The event-fd close rides a finally: a server stop that
+            # ALSO fails (LT008 found this gap) must not leak the fd too
+            try:
+                srv = getattr(self, "_server", None)
+                if srv is not None:
+                    srv.stop()
+            finally:
+                self.events.close()
             raise
 
     def _init_metrics(
@@ -292,11 +297,20 @@ class Telemetry:
             if metrics_port is not None
             else None
         )
-        self._exporter = PromFileExporter(
-            self.registry,
-            metrics_path(workdir, process_index, process_count),
-            interval_s=metrics_interval_s,
-        ).start()
+        try:
+            self._exporter = PromFileExporter(
+                self.registry,
+                metrics_path(workdir, process_index, process_count),
+                interval_s=metrics_interval_s,
+            ).start()
+        except BaseException:
+            # exporter construction/first-write failing after the port
+            # bound: release the server HERE (locality — the __init__
+            # guard then only owns the event fd) and mark it released
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+            raise
 
     # -- paths the run summary reports -------------------------------------
     @property

@@ -884,34 +884,11 @@ class Run:
         tile_px = cfg.tile_size * cfg.tile_size
         n_mesh = int(mesh.devices.size) if mesh is not None else 1
 
-        # the feed-path decode subsystem (process-wide, like GDAL's block
-        # cache): decoded-block LRU + shared decode pool + readahead — pure
-        # acceleration of the windowed lazy feed, byte-identical either way.
-        # With ingest_store_mb the decoded blocks additionally spill to the
-        # persistent on-disk store, so a rerun over the same stacks skips
-        # TIFF decode entirely ("ingest once, serve many").  A serving
-        # layer instead passes its long-lived store via ``shared_store``:
-        # the run uses it but never closes it, and the store's owner (the
-        # server) owns the process-wide cache configuration too.
-        store = self.shared_store
-        owns_store = store is None and bool(cfg.ingest_store_mb)
-        if owns_store:
-            from land_trendr_tpu.io.blockstore import BlockStore
-
-            store = BlockStore(
-                cfg.ingest_store_dir
-                or os.path.join(cfg.workdir, "ingest_store"),
-                budget_bytes=cfg.ingest_store_mb << 20,
-            )
-        if not self.shared_cache:
-            blockcache.configure(
-                budget_bytes=cfg.feed_cache_mb << 20,
-                workers=cfg.decode_workers,
-                store=store,
-            )
-        self.store = store
-        feed_cache_base = blockcache.stats_snapshot()
-        store_base = store.stats_snapshot() if store is not None else None
+        # NOTE: the ingest store / process cache configuration happens
+        # further down, immediately before telemetry construction — a
+        # config-validation ValueError below must not leave an owned
+        # store's mmaps open and attached to the process-global cache
+        # (LT008 found exactly that gap)
 
         # validate the mesh configuration BEFORE touching the workdir, so a
         # rejected run cannot stamp a fresh manifest with a bad context
@@ -1394,9 +1371,15 @@ class Run:
         # ``write_s``, overlapped ``feed_s`` can exceed wall time.  Host
         # memory stays bounded: at most ``feed_workers + 1`` fed inputs plus
         # ``write_workers + 2`` finished tiles are live at once.
-        feeder = ThreadPoolExecutor(
-            max_workers=cfg.feed_workers, thread_name_prefix="lt-feeder"
-        )
+        try:
+            feeder = ThreadPoolExecutor(
+                max_workers=cfg.feed_workers, thread_name_prefix="lt-feeder"
+            )
+        except BaseException:
+            # feed_workers<=0 is a config error surfacing HERE: the
+            # already-built writer pool must not outlive the failed run
+            writer.shutdown(wait=False, cancel_futures=True)
+            raise
         pending_feeds: deque = deque()  # (tile, future), consumed in order
 
         def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
@@ -1434,6 +1417,59 @@ class Run:
                 except Exception as e:
                     err = e
 
+        # the feed-path decode subsystem (process-wide, like GDAL's block
+        # cache): decoded-block LRU + shared decode pool + readahead — pure
+        # acceleration of the windowed lazy feed, byte-identical either way.
+        # With ingest_store_mb the decoded blocks additionally spill to the
+        # persistent on-disk store, so a rerun over the same stacks skips
+        # TIFF decode entirely ("ingest once, serve many").  A serving
+        # layer instead passes its long-lived store via ``shared_store``:
+        # the run uses it but never closes it, and the store's owner (the
+        # server) owns the process-wide cache configuration too.
+        store = self.shared_store
+        owns_store = store is None and bool(cfg.ingest_store_mb)
+
+        def _release_setup() -> None:
+            """Reverse-order unwind for a failure between resource
+            acquisition and the owning try/finally below: the executor
+            pools and an OWNED store (close + process-cache detach) must
+            not outlive a run whose telemetry/fault arming failed."""
+            feeder.shutdown(wait=False, cancel_futures=True)
+            writer.shutdown(wait=False, cancel_futures=True)
+            if store is not None and owns_store:
+                try:
+                    store.close()
+                except Exception as exc:
+                    log.error(
+                        "ingest-store close failed during setup unwind: %s",
+                        exc,
+                    )
+                blockcache.detach_store(store)
+
+        try:
+            if owns_store:
+                from land_trendr_tpu.io.blockstore import BlockStore
+
+                store = BlockStore(
+                    cfg.ingest_store_dir
+                    or os.path.join(cfg.workdir, "ingest_store"),
+                    budget_bytes=cfg.ingest_store_mb << 20,
+                )
+            if not self.shared_cache:
+                blockcache.configure(
+                    budget_bytes=cfg.feed_cache_mb << 20,
+                    workers=cfg.decode_workers,
+                    store=store,
+                )
+            feed_cache_base = blockcache.stats_snapshot()
+            store_base = (
+                store.stats_snapshot() if store is not None else None
+            )
+        except BaseException:
+            _release_setup()
+            raise
+        self.store = store
+
         # constructed LAST, immediately before the try/finally that owns its
         # shutdown: an exception anywhere between construction and that
         # finally would leak the exporter thread / metrics port / event fd
@@ -1442,27 +1478,34 @@ class Run:
         if cfg.telemetry:
             from land_trendr_tpu.obs import Telemetry
 
-            # per-process port fan-out (port + process_index, like the
-            # per-process event/metrics FILE naming): a same-host pod would
-            # otherwise have every process after the first die binding the
-            # one configured port.  0 (ephemeral) needs no offset; each
-            # process's bound port lands in its own run summary.
-            metrics_port = cfg.metrics_port
-            if metrics_port:
-                metrics_port += jax.process_index()
-            telemetry = self.telemetry = Telemetry(
-                cfg.workdir,
-                fingerprint=manifest.fingerprint,
-                process_index=jax.process_index(),
-                process_count=jax.process_count(),
-                metrics_port=metrics_port,
-                metrics_host=cfg.metrics_host,
-                metrics_interval_s=cfg.metrics_interval_s,
-                # serve mode: the job id rides EVERY event of this run's
-                # scope, so a fleet-wide fold can attribute tile traffic
-                # to the request that caused it
-                job_id=self.job_id,
-            )
+            try:
+                # per-process port fan-out (port + process_index, like
+                # the per-process event/metrics FILE naming): a same-host
+                # pod would otherwise have every process after the first
+                # die binding the one configured port.  0 (ephemeral)
+                # needs no offset; each process's bound port lands in its
+                # own run summary.
+                metrics_port = cfg.metrics_port
+                if metrics_port:
+                    metrics_port += jax.process_index()
+                telemetry = self.telemetry = Telemetry(
+                    cfg.workdir,
+                    fingerprint=manifest.fingerprint,
+                    process_index=jax.process_index(),
+                    process_count=jax.process_count(),
+                    metrics_port=metrics_port,
+                    metrics_host=cfg.metrics_host,
+                    metrics_interval_s=cfg.metrics_interval_s,
+                    # serve mode: the job id rides EVERY event of this
+                    # run's scope, so a fleet-wide fold can attribute
+                    # tile traffic to the request that caused it
+                    job_id=self.job_id,
+                )
+            except BaseException:
+                # e.g. a busy --metrics-port: Telemetry cleans up its own
+                # half-built state; the pools and owned store are ours
+                _release_setup()
+                raise
             try:
                 # the manifest reports write_done events once each tile is
                 # durable
@@ -1482,7 +1525,10 @@ class Run:
                 # below owns shutdown — unwind here or the exporter thread /
                 # metrics port / event fd leak into the caller's process
                 manifest.telemetry = None
-                telemetry.close()
+                try:
+                    telemetry.close()
+                finally:
+                    _release_setup()
                 raise
 
         # fault injection + stall watchdog are armed AFTER telemetry exists
@@ -1531,12 +1577,20 @@ class Run:
                     cfg.stall_timeout_s, _on_stall
                 ).start()
         except BaseException:
-            if fault_plan is not None:
-                faults.set_observer(None)
-                faults.deactivate()
-            if telemetry is not None:
-                manifest.telemetry = None
-                telemetry.close()
+            # telescoped: each step may itself raise (LT008 found the
+            # skip), so the later steps ride finallys — the event fd and
+            # the owned store must close even if the fault disarm fails
+            try:
+                if fault_plan is not None:
+                    faults.set_observer(None)
+                    faults.deactivate()
+            finally:
+                try:
+                    if telemetry is not None:
+                        manifest.telemetry = None
+                        telemetry.close()
+                finally:
+                    _release_setup()
             raise
 
         # readahead targets ride the feed submissions: the tile fed at index

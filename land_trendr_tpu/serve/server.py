@@ -98,6 +98,34 @@ class _ServeTelemetry:
     def __init__(self, cfg: ServeConfig) -> None:
         os.makedirs(cfg.workdir, exist_ok=True)
         self.events = EventLog(os.path.join(cfg.workdir, "events.jsonl"))
+        self._server: "MetricsHTTPServer | None" = None
+        self._exporter: "PromFileExporter | None" = None
+        try:
+            self._init_instruments(cfg)
+        except BaseException:
+            # a half-built telemetry bundle must not leak the event fd /
+            # exporter thread / metrics port into the caller's process
+            self._release()
+            raise
+
+    def _release(self) -> None:
+        """Tear the bundle down in reverse acquisition order — ONE copy
+        shared by the construction guard and :meth:`close`.  The event-fd
+        close rides the innermost finally so a server/exporter stop that
+        ALSO fails cannot skip it (LT008)."""
+        try:
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+        finally:
+            try:
+                if self._exporter is not None:
+                    self._exporter.stop()
+                    self._exporter = None
+            finally:
+                self.events.close()
+
+    def _init_instruments(self, cfg: ServeConfig) -> None:
         self.registry = MetricsRegistry()
         r = self.registry
         self._queue_depth = r.gauge(
@@ -137,36 +165,36 @@ class _ServeTelemetry:
         self._jobs_done: dict[str, Any] = {}
         self._prog_lock = threading.Lock()
         self._last_prog = {"hits": 0, "misses": 0, "compile_s": 0.0}
+        self.events.run_start(
+            fingerprint="serve",
+            process_index=0,
+            process_count=1,
+            tiles_total=0,
+            tiles_todo=0,
+            tiles_skipped_resume=0,
+            mesh_devices=0,
+            impl="serve",
+        )
+        self._server = (
+            MetricsHTTPServer(
+                self.registry, cfg.metrics_port, host=cfg.metrics_host
+            )
+            if cfg.metrics_port is not None
+            else None
+        )
         try:
-            self.events.run_start(
-                fingerprint="serve",
-                process_index=0,
-                process_count=1,
-                tiles_total=0,
-                tiles_todo=0,
-                tiles_skipped_resume=0,
-                mesh_devices=0,
-                impl="serve",
-            )
-            self._server = (
-                MetricsHTTPServer(
-                    self.registry, cfg.metrics_port, host=cfg.metrics_host
-                )
-                if cfg.metrics_port is not None
-                else None
-            )
             self._exporter = PromFileExporter(
                 self.registry,
                 os.path.join(cfg.workdir, "metrics.prom"),
                 interval_s=cfg.metrics_interval_s,
             ).start()
         except BaseException:
-            # a half-built telemetry bundle must not leak the event fd /
-            # exporter thread / metrics port into the caller's process
-            srv = getattr(self, "_server", None)
-            if srv is not None:
-                srv.stop()
-            self.events.close()
+            # exporter construction/first-write failing after the port
+            # bound: release the server HERE (locality) and mark it
+            # released so __init__'s guard only owns the event fd
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
             raise
 
     def _done_counter(self, status: str):
@@ -276,15 +304,7 @@ class _ServeTelemetry:
                 fit_rate=0.0,
             )
         finally:
-            try:
-                if self._server is not None:
-                    self._server.stop()
-                    self._server = None
-            finally:
-                try:
-                    self._exporter.stop()
-                finally:
-                    self.events.close()
+            self._release()
 
 
 class SegmentationServer:
@@ -307,64 +327,84 @@ class SegmentationServer:
         self._running_id: "str | None" = None
         self.programs = ProgramCache()
 
-        # the shared warm state every job rides: ONE process-wide cache
-        # configuration (the server owns it; Run skips reconfiguring when
-        # handed a shared store) and ONE persistent ingest store
+        # every teardown-touched handle exists BEFORE anything that can
+        # fail: _shutdown_shared must be callable from any depth of a
+        # partially finished construction.  (Previously a busy
+        # --serve-port died in the cleanup path on the not-yet-bound
+        # self._dropbox_stop — an AttributeError MASKING the bind error —
+        # and a telemetry/fault-arming failure leaked the store's mmaps
+        # plus its process-global cache attachment; LT008 found both.)
         self.store = None
-        if cfg.ingest_store_mb:
-            from land_trendr_tpu.io.blockstore import BlockStore
-
-            self.store = BlockStore(
-                cfg.ingest_store_dir
-                or os.path.join(cfg.workdir, "ingest_store"),
-                budget_bytes=cfg.ingest_store_mb << 20,
-            )
-        blockcache.configure(
-            budget_bytes=cfg.feed_cache_mb << 20,
-            workers=cfg.decode_workers,
-            store=self.store,
-        )
-
-        self.telemetry = _ServeTelemetry(cfg) if cfg.telemetry else None
+        self.telemetry = None
+        self._fault_plan = None
+        self._httpd = None
+        self._http_thread = None
+        self._dropbox_stop = threading.Event()
+        self._dropbox_thread = None
         self._t0 = time.time()
 
-        # one process-wide fault plan shared by every job (soak mode);
-        # jobs carrying their own schedule are rejected by the Run
-        self._fault_plan = None
-        if cfg.fault_schedule:
-            self._fault_plan = faults.activate(
-                faults.parse_schedule(cfg.fault_schedule)
-            )
-            log.warning(
-                "serve fault injection ACTIVE (%s) — this is a soak run",
-                cfg.fault_schedule,
+        try:
+            # the shared warm state every job rides: ONE process-wide
+            # cache configuration (the server owns it; Run skips
+            # reconfiguring when handed a shared store) and ONE
+            # persistent ingest store
+            if cfg.ingest_store_mb:
+                from land_trendr_tpu.io.blockstore import BlockStore
+
+                self.store = BlockStore(
+                    cfg.ingest_store_dir
+                    or os.path.join(cfg.workdir, "ingest_store"),
+                    budget_bytes=cfg.ingest_store_mb << 20,
+                )
+            blockcache.configure(
+                budget_bytes=cfg.feed_cache_mb << 20,
+                workers=cfg.decode_workers,
+                store=self.store,
             )
 
-        try:
+            self.telemetry = _ServeTelemetry(cfg) if cfg.telemetry else None
+
+            # one process-wide fault plan shared by every job (soak
+            # mode); jobs carrying their own schedule are rejected by
+            # the Run
+            if cfg.fault_schedule:
+                self._fault_plan = faults.activate(
+                    faults.parse_schedule(cfg.fault_schedule)
+                )
+                log.warning(
+                    "serve fault injection ACTIVE (%s) — this is a "
+                    "soak run", cfg.fault_schedule,
+                )
+
             self._httpd = _JobAPIServer(
                 (cfg.serve_host, cfg.serve_port), self
             )
-        except BaseException:
-            self._shutdown_shared(status="aborted")
-            raise
-        self.port = int(self._httpd.server_address[1])
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            name="lt-serve-http",
-            daemon=True,
-        )
-        self._http_thread.start()
-
-        self._dropbox_stop = threading.Event()
-        self._dropbox_thread = None
-        if cfg.dropbox_dir:
-            os.makedirs(cfg.dropbox_dir, exist_ok=True)
-            self._dropbox_thread = threading.Thread(
-                target=self._dropbox_loop,
-                name="lt-serve-dropbox",
+            self.port = int(self._httpd.server_address[1])
+            http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="lt-serve-http",
                 daemon=True,
             )
-            self._dropbox_thread.start()
+            # bound only AFTER a successful start: _shutdown_shared keys
+            # httpd.shutdown() on it, and socketserver's shutdown()
+            # deadlocks forever unless serve_forever is actually running
+            http_thread.start()
+            self._http_thread = http_thread
+
+            if cfg.dropbox_dir:
+                os.makedirs(cfg.dropbox_dir, exist_ok=True)
+                self._dropbox_thread = threading.Thread(
+                    target=self._dropbox_loop,
+                    name="lt-serve-dropbox",
+                    daemon=True,
+                )
+                self._dropbox_thread.start()
+        except BaseException:
+            # construction failed partway: tear down exactly what exists
+            # — store close + cache detach, armed fault plan, telemetry,
+            # API socket — so nothing outlives the failed server
+            self._shutdown_shared(status="aborted")
+            raise
         log.info(
             "serving on %s:%d (queue depth %d, %s)",
             cfg.serve_host, self.port, cfg.serve_queue_depth,
@@ -777,11 +817,15 @@ class SegmentationServer:
             self._cond.notify_all()
         self._dropbox_stop.set()
         httpd = getattr(self, "_httpd", None)
+        thread = getattr(self, "_http_thread", None)
         if httpd is not None:
-            httpd.shutdown()
+            if thread is not None:
+                # shutdown() handshakes with a RUNNING serve_forever;
+                # called before the loop thread ever started it waits
+                # forever — a failed construction closes the socket only
+                httpd.shutdown()
             httpd.server_close()
             self._httpd = None
-        thread = getattr(self, "_http_thread", None)
         if thread is not None:
             thread.join(timeout=10)
             self._http_thread = None

@@ -1,6 +1,6 @@
 """lt-lint CLI: run the repo's AST invariant checks (CI seam).
 
-Runs the five LT rules (``land_trendr_tpu/lintkit``) over the tree and
+Runs the eight LT rules (``land_trendr_tpu/lintkit``) over the tree and
 exits 1 on any finding that is neither ``# lt: noqa[rule]``-suppressed
 inline nor recorded (with a reason) in ``LINT_BASELINE.json``.  Exit 0 =
 clean, 2 = usage/configuration error (including a baseline entry with no
@@ -9,12 +9,21 @@ reason — an exception nobody wrote down is not an exception).
     python tools/lt_lint.py                 # whole tree
     python tools/lt_lint.py --changed       # files touched vs git HEAD
     python tools/lt_lint.py --json          # machine-readable report
+    python tools/lt_lint.py --sarif out.sarif   # SARIF 2.1.0 artifact
+    python tools/lt_lint.py --prune-baseline    # drop stale entries
     python tools/lt_lint.py land_trendr_tpu/io/blockcache.py
 
 ``--changed`` is the pre-commit invocation (README §Static analysis):
 per-file rules run only on modified/untracked Python files; the
-repo-level coupling rules (LT004/LT005) run whenever one of their
-source files (driver/cli/README, telemetry/schema) changed.
+repo-level rules (LT004/LT005 coupling, LT006–LT008 interprocedural)
+run whenever one of their source files changed.  ``--sarif`` writes a
+SARIF 2.1.0 log alongside whatever else was requested (``-`` =
+stdout) — active findings as ``error`` results, baselined ones as
+suppressed results carrying their written justification — so CI can
+annotate PRs without parsing our JSON.  ``--prune-baseline`` rewrites
+``LINT_BASELINE.json`` without the entries a FULL run no longer
+matches (partial runs refuse: staleness is only meaningful over the
+whole tree).
 
 Wired into tier-1 as ``tests/test_lint.py::test_repo_tree_is_clean``,
 so producer drift fails the suite the same way schema drift in an
@@ -25,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -42,6 +52,108 @@ from land_trendr_tpu.lintkit import (  # noqa: E402
 )
 
 BASELINE_FILE = "LINT_BASELINE.json"
+
+
+def sarif_report(report: dict, files_checked: int) -> dict:
+    """SARIF 2.1.0 log for one run: active findings as ``error``
+    results, baselined ones as suppressed results (kind ``external``,
+    justification = the baseline reason).  Minimal but valid — CI
+    annotators need ruleId/message/location and nothing else."""
+    from land_trendr_tpu.lintkit import ALL_CHECKERS
+
+    results = []
+    for f in report["findings"]:
+        results.append(
+            {
+                "ruleId": f.rule_id,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [_sarif_location(f)],
+            }
+        )
+    for f, entry in report["baselined"]:
+        results.append(
+            {
+                "ruleId": f.rule_id,
+                "level": "note",
+                "message": {"text": f.message},
+                "locations": [_sarif_location(f)],
+                "suppressions": [
+                    {
+                        "kind": "external",
+                        "justification": entry["reason"],
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "lt-lint",
+                        # NOTE: informationUri is deliberately omitted —
+                        # SARIF 2.1.0 §3.19.17 requires an ABSOLUTE URI
+                        # and this repo has no canonical URL; the rule
+                        # docs live in README.md §Static analysis
+                        "rules": [
+                            {
+                                "id": cls.rule_id,
+                                "shortDescription": {"text": cls.title},
+                            }
+                            for cls in ALL_CHECKERS
+                        ],
+                    }
+                },
+                "results": results,
+                "properties": {
+                    "filesChecked": files_checked,
+                    "noqaSuppressed": report["noqa_suppressed"],
+                },
+            }
+        ],
+    }
+
+
+def _sarif_location(f) -> dict:
+    loc = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": f.file},
+            "region": {"startLine": max(1, f.line)},
+        }
+    }
+    if f.symbol:
+        loc["logicalLocations"] = [
+            {"fullyQualifiedName": f.symbol, "kind": "function"}
+        ]
+    return loc
+
+
+def prune_baseline(path: Path, unused: list) -> int:
+    """Rewrite the baseline without ``unused`` entries; returns how many
+    were dropped.  Preserves the header comment and key order."""
+    with open(path) as f:
+        data = json.load(f)
+    drop = {json.dumps(e, sort_keys=True) for e in unused}
+    kept = [
+        e
+        for e in data.get("entries", [])
+        if json.dumps(e, sort_keys=True) not in drop
+    ]
+    n = len(data.get("entries", [])) - len(kept)
+    if n:
+        data["entries"] = kept
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    return n
 
 
 def changed_files(root: Path) -> "set[str] | None":
@@ -82,6 +194,13 @@ def main(argv: "list[str] | None" = None) -> int:
                     help=f"baseline file (default: <repo>/{BASELINE_FILE})")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline (every finding counts)")
+    ap.add_argument("--sarif", default=None, metavar="FILE",
+                    help="additionally write a SARIF 2.1.0 log to FILE "
+                         "('-' = stdout); baselined findings ride along "
+                         "as suppressed results with their reasons")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite the baseline without entries this FULL "
+                         "run no longer matches (refused on partial runs)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule table and exit")
     args = ap.parse_args(argv)
@@ -90,6 +209,15 @@ def main(argv: "list[str] | None" = None) -> int:
         for cls in ALL_CHECKERS:
             print(f"{cls.rule_id}  {cls.title}")
         return 0
+
+    if args.as_json and args.sarif == "-":
+        # both reports on stdout would concatenate two JSON documents,
+        # breaking every consumer of either
+        print(
+            "error: --json and --sarif - both claim stdout; write the "
+            "SARIF to a file", file=sys.stderr,
+        )
+        return 2
 
     files = None
     if args.paths:
@@ -142,10 +270,49 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    if args.paths or only is not None:
+    partial = bool(args.paths) or only is not None
+    if partial:
         # partial runs trivially leave other files' baseline entries
         # unmatched — staleness is only meaningful over the full tree
         report["unused_baseline"] = []
+
+    if args.prune_baseline:
+        if partial:
+            print(
+                "error: --prune-baseline needs a full run (no paths, no "
+                "--changed) — a partial run cannot tell stale from "
+                "unvisited", file=sys.stderr,
+            )
+            return 2
+        if args.no_baseline or baseline is None:
+            print(
+                "error: --prune-baseline without a baseline in effect",
+                file=sys.stderr,
+            )
+            return 2
+        bpath = Path(args.baseline) if args.baseline else REPO / BASELINE_FILE
+        n = prune_baseline(bpath, report["unused_baseline"])
+        print(
+            f"lt-lint: pruned {n} stale baseline entr"
+            f"{'y' if n == 1 else 'ies'} from {bpath.name}",
+            file=sys.stderr,
+        )
+        report["unused_baseline"] = []
+
+    if args.sarif:
+        sarif = sarif_report(report, len(repo.py_files))
+        if args.sarif == "-":
+            print(json.dumps(sarif, indent=2))
+        else:
+            try:
+                with open(args.sarif, "w") as f:
+                    json.dump(sarif, f, indent=2)
+                    f.write("\n")
+            except OSError as e:
+                # an unwritable artifact path is a CONFIG error (exit 2),
+                # not "findings present" (exit 1)
+                print(f"error: --sarif {args.sarif}: {e}", file=sys.stderr)
+                return 2
 
     findings = report["findings"]
     if args.as_json:
@@ -175,7 +342,9 @@ def main(argv: "list[str] | None" = None) -> int:
         print(
             f"lt-lint: {len(findings)} finding(s), {n_base} baselined, "
             f"{report['noqa_suppressed']} noqa-suppressed over "
-            f"{len(repo.py_files)} files"
+            f"{len(repo.py_files)} files",
+            # SARIF-on-stdout owns stdout; the human summary moves aside
+            file=sys.stderr if args.sarif == "-" else sys.stdout,
         )
     return 1 if findings else 0
 

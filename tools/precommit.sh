@@ -1,9 +1,12 @@
 #!/bin/sh
 # Pre-commit gate (README §Failure semantics / §Static analysis):
 #
-#   1. tools/lt_lint.py --changed  — the five LT AST invariant rules over
-#      files modified vs HEAD (repo-level coupling rules LT004/LT005 run
-#      whenever one of their sources changed);
+#   1. tools/lt_lint.py --changed  — the eight LT AST invariant rules over
+#      files modified vs HEAD (repo-level rules — LT004/LT005 coupling,
+#      LT006-LT008 interprocedural — run whenever one of their sources
+#      changed).  A SARIF 2.1.0 log lands at $LT_LINT_SARIF (default
+#      .git/lt-lint.sarif, untracked) so CI annotators can consume the
+#      findings without parsing our JSON;
 #   2. tools/check_events_schema.py over the COMMITTED event-stream
 #      fixtures under tests/ (*.events.jsonl) — a fixture drifting from
 #      the current schema (a renamed/removed field, a new required one)
@@ -21,7 +24,20 @@ set -e
 repo="$(git rev-parse --show-toplevel 2>/dev/null)"
 [ -n "$repo" ] || repo="$(cd "$(dirname "$0")/.." && pwd)"
 
-python "$repo/tools/lt_lint.py" --changed
+# machine-readable findings artifact: inside the git dir by default so
+# it is never committed; CI overrides LT_LINT_SARIF to its artifact dir.
+# git rev-parse resolves the REAL git dir (a worktree's .git is a file,
+# so a bare -d test would silently skip the artifact there)
+sarif="${LT_LINT_SARIF:-}"
+if [ -z "$sarif" ]; then
+    gitdir="$(git -C "$repo" rev-parse --absolute-git-dir 2>/dev/null)"
+    [ -n "$gitdir" ] && sarif="$gitdir/lt-lint.sarif"
+fi
+if [ -n "$sarif" ]; then
+    python "$repo/tools/lt_lint.py" --changed --sarif "$sarif"
+else
+    python "$repo/tools/lt_lint.py" --changed
+fi
 
 # committed fixture streams lint against the CURRENT schema (newline-safe
 # iteration is unnecessary: fixture names are repo-controlled)
