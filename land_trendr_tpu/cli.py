@@ -234,6 +234,21 @@ def build_parser() -> argparse.ArgumentParser:
                      help="bind address for --metrics-port (default: all "
                      "interfaces; pass 127.0.0.1 to keep the "
                      "unauthenticated endpoint off the network)")
+    seg.add_argument("--flight", action="store_true",
+                     help="with --telemetry: flight recorder — a bounded "
+                     "in-memory ring mirroring every telemetry emit plus "
+                     "a periodic resource sampler (flight_sample events: "
+                     "RSS, fds, threads, backlogs, cache occupancy, HBM "
+                     "watermark), dumped to WORKDIR/flight.jsonl at run "
+                     "end (success and abort — the post-mortem window)")
+    seg.add_argument("--flight-ring-events", type=int, default=2048,
+                     metavar="N",
+                     help="flight-ring capacity in events (the 'last N "
+                     "events' window a dump shows); 0 disables the ring "
+                     "and the sampler, as in serve mode")
+    seg.add_argument("--sampler-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="flight resource-sampler period in seconds")
     seg.add_argument("--max-retries", type=int, default=2)
     seg.add_argument("--retry-backoff-s", type=float, default=0.5,
                      metavar="SEC",
@@ -434,6 +449,22 @@ def build_parser() -> argparse.ArgumentParser:
                      "(one process-wide plan shared by every job, incl. "
                      "the serve.submit/serve.job seams); production "
                      "servers leave this unset")
+    srv.add_argument("--no-debug-endpoints", action="store_true",
+                     help="disable the live /debug surface "
+                     "(/debug/flight, /debug/stacks, /debug/jobs, POST "
+                     "/debug/profile — loopback-only like the job API; "
+                     "on by default)")
+    srv.add_argument("--flight-ring-events", type=int, default=2048,
+                     metavar="N",
+                     help="flight-recorder ring capacity in events: the "
+                     "/debug/flight window over server AND job events, "
+                     "dumped to WORKDIR/flight.jsonl at shutdown; 0 "
+                     "disables the ring and the resource sampler")
+    srv.add_argument("--sampler-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="flight resource-sampler period (flight_sample "
+                     "events: RSS, fds, threads, queue depth, backlogs, "
+                     "cache occupancy)")
 
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
@@ -704,6 +735,9 @@ def main(argv: list[str] | None = None) -> int:
                 metrics_host=args.metrics_host,
                 metrics_interval_s=args.metrics_interval_s,
                 fault_schedule=args.fault_schedule,
+                debug_endpoints=not args.no_debug_endpoints,
+                flight_ring_events=args.flight_ring_events,
+                sampler_interval_s=args.sampler_interval_s,
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -870,6 +904,9 @@ def main(argv: list[str] | None = None) -> int:
                 telemetry=args.telemetry,
                 metrics_port=args.metrics_port,
                 metrics_host=args.metrics_host,
+                flight=args.flight,
+                flight_ring_events=args.flight_ring_events,
+                sampler_interval_s=args.sampler_interval_s,
             )
         except ValueError as e:
             # argument errors (bad --products name, out-of-range workers…)

@@ -65,6 +65,7 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 __all__ = [
     "configure",
     "detach_store",
+    "store_bytes_snapshot",
     "cache_enabled",
     "cache_get",
     "cache_put",
@@ -228,6 +229,32 @@ def detach_store(store) -> None:
     with _lock:
         if _store is store:
             _store = None
+
+
+def store_bytes_snapshot() -> "int | None":
+    """Current persistent-store occupancy in bytes — the flight
+    sampler's ``store_bytes`` gauge (None without an attached store).
+    The store's own snapshot runs OUTSIDE the cache lock (it takes the
+    store lock; nesting the two here would add a lock-order edge)."""
+    with _lock:
+        store = _store
+    if store is None:
+        return None
+    try:
+        return int(store.stats_snapshot().get("bytes", 0))
+    except Exception:
+        return None
+
+
+def occupancy_probe() -> dict:
+    """The flight sampler's cache/store occupancy gauges in one place:
+    ``cache_bytes`` always, ``store_bytes`` only with an attached store
+    (a missing gauge is "no store", not "empty store")."""
+    out = {"cache_bytes": int(stats_snapshot()["cache_bytes"])}
+    store_bytes = store_bytes_snapshot()
+    if store_bytes is not None:
+        out["store_bytes"] = store_bytes
+    return out
 
 
 def cache_enabled() -> bool:

@@ -56,6 +56,7 @@ FLAG_ALIASES: dict[str, tuple[str, ...]] = {
 #: the ServeConfig alias table (the serve triangle's exceptions)
 SERVE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "telemetry": ("no-telemetry",),
+    "debug_endpoints": ("no-debug-endpoints",),
 }
 
 #: the coupling triangles this rule checks: each names a config

@@ -21,6 +21,12 @@ from land_trendr_tpu.obs.events import (
     validate_event,
     validate_events_file,
 )
+from land_trendr_tpu.obs.flight import (
+    FlightRecorder,
+    ResourceSampler,
+    flight_path,
+    thread_stacks,
+)
 from land_trendr_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -42,8 +48,12 @@ __all__ = [
     "validate_event",
     "validate_events_file",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "ResourceSampler",
+    "flight_path",
+    "thread_stacks",
     "MetricsHTTPServer",
     "MetricsRegistry",
     "PromFileExporter",

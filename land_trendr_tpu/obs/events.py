@@ -194,6 +194,30 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # tile; a HIT ran zero compiles), plus a server-scope aggregate at
     # shutdown.  Additive event type, like the subsystem rollups above.
     "program_cache": {"hits": int, "misses": int, "compile_s": _NUM},
+    # --- flight recorder / live debug surface (obs/flight) --------------
+    # periodic resource sample from the flight sampler thread: process
+    # vitals required, host-contributed gauges (queue depths, backlogs,
+    # cache/store occupancy, HBM watermark) optional.  Emitted through
+    # the normal event log, so it lands in the stream, the flight ring,
+    # and the obs_report trace counter tracks alike.  Additive.
+    "flight_sample": {"rss_bytes": int, "open_fds": int, "threads": int},
+    # one on-demand profiler capture attempt (POST /debug/profile): a
+    # FAILED capture carries ok=false + error — the capture fails, the
+    # job and the server do not.  Additive.
+    "profile_captured": {"ok": bool, "duration_s": _NUM, "path": str},
+    # per-job SLO accounting (serve): the latency split (queue wait vs
+    # execution) and the deadline verdict for one terminal job.  A
+    # deadline miss is ACCOUNTING, never enforcement — the job ran to
+    # its natural terminal state (job_timeout_s is the enforcement
+    # knob).  Additive.
+    "job_slo": {
+        "job_id": str,
+        "tenant": str,
+        "queue_wait_s": _NUM,
+        "exec_s": _NUM,
+        "latency_s": _NUM,
+        "met": bool,
+    },
 }
 
 #: well-known OPTIONAL fields: type-checked when present, never required
@@ -227,6 +251,21 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     "job_done": {"tiles_quarantined": int, "error": str},
     "job_rejected": {"job_id": str, "tenant": str},
     "program_cache": {"keys": int},
+    "flight_sample": {
+        "feed_backlog": int,
+        "write_backlog": int,
+        "fetch_backlog": int,
+        "upload_backlog": int,
+        "queue_depth": int,
+        "running": int,
+        "jobs_total": int,
+        "warm_program_count": int,
+        "cache_bytes": int,
+        "store_bytes": int,
+        "device_bytes_in_use": _NUM,
+    },
+    "profile_captured": {"error": str, "bytes": int},
+    "job_slo": {"deadline_s": _NUM},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the
@@ -359,7 +398,10 @@ class EventLog:
     """
 
     def __init__(
-        self, path: str, common: "dict[str, Any] | None" = None
+        self,
+        path: str,
+        common: "dict[str, Any] | None" = None,
+        mirror: "Callable[[dict], None] | None" = None,
     ) -> None:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -371,6 +413,11 @@ class EventLog:
         #: threading — e.g. ``{"job_id": ...}`` in serve mode); explicit
         #: per-emit fields win on collision
         self._common = dict(common or {})
+        #: optional per-record tap — the flight recorder's ring
+        #: (:meth:`land_trendr_tpu.obs.flight.FlightRecorder.record`):
+        #: called with the full stamped record AFTER the durable write,
+        #: outside the write lock (the ring has its own, cheaper one)
+        self._mirror = mirror
 
     def emit(self, ev: str, **fields: Any) -> dict:
         """Append one event line; returns the record as written."""
@@ -392,6 +439,8 @@ class EventLog:
                 raise OSError(
                     f"short write to {self.path}: {n}/{len(data)} bytes"
                 )
+        if self._mirror is not None:
+            self._mirror(rec)
         return rec
 
     def run_start(self, **fields: Any) -> dict:

@@ -60,14 +60,21 @@ class Telemetry:
         metrics_host: str = "",
         metrics_interval_s: float = 5.0,
         job_id: str | None = None,
+        flight=None,
     ) -> None:
         os.makedirs(workdir, exist_ok=True)
         # serve mode threads the job id onto EVERY event of this run's
         # scope (an EventLog common field — schema-optional everywhere),
-        # so a cross-job fold can attribute tile traffic per request
+        # so a cross-job fold can attribute tile traffic per request.
+        # ``flight`` (an obs.flight.FlightRecorder) mirrors every emit
+        # into the in-memory ring behind the /debug surface — the run's
+        # own ring on --flight runs, the SERVER's shared ring in serve
+        # mode (so job tile traffic shows up in /debug/flight live).
+        self.flight = flight
         self.events = EventLog(
             events_path(workdir, process_index, process_count),
             common={"job_id": job_id} if job_id else None,
+            mirror=flight.record if flight is not None else None,
         )
         try:
             self._init_metrics(

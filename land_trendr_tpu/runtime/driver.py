@@ -407,6 +407,20 @@ class RunConfig:
     metrics_host: str = ""
     #: ``metrics.prom`` refresh period, seconds
     metrics_interval_s: float = 5.0
+    #: with ``telemetry``: flight recorder (:mod:`land_trendr_tpu.obs.
+    #: flight`) — a bounded in-memory ring mirroring every telemetry
+    #: emit plus a periodic resource sampler thread (``flight_sample``
+    #: events: RSS, open fds, threads, pipeline backlogs, cache
+    #: occupancy, HBM watermark), dumped to ``<workdir>/flight.jsonl``
+    #: at run end (success AND abort — the post-mortem window).  An
+    #: execution fact, never fingerprinted; overhead is within the
+    #: telemetry noise band (``FLIGHT_r12.json``).
+    flight: bool = False
+    #: flight-ring capacity, events: the "last N events" window the ring
+    #: holds (a dump/debug read shows at most this much history)
+    flight_ring_events: int = 2048
+    #: flight resource-sampler period, seconds
+    sampler_interval_s: float = 5.0
 
     def __post_init__(self) -> None:
         # fail fast: an invalid choice must not surface only at
@@ -514,6 +528,23 @@ class RunConfig:
         if self.telemetry and self.metrics_interval_s <= 0:
             raise ValueError(
                 f"metrics_interval_s={self.metrics_interval_s} must be > 0"
+            )
+        if self.flight and not self.telemetry:
+            raise ValueError(
+                "flight requires telemetry=True (the ring mirrors the "
+                "telemetry event stream; there is nothing to record "
+                "without one)"
+            )
+        if self.flight_ring_events < 2 and self.flight_ring_events != 0:
+            raise ValueError(
+                f"flight_ring_events={self.flight_ring_events} must be "
+                ">= 2 (a useful ring holds at least a run_start and one "
+                "event) or 0 (ring + sampler disabled, the serve "
+                "convention)"
+            )
+        if self.sampler_interval_s <= 0:
+            raise ValueError(
+                f"sampler_interval_s={self.sampler_interval_s} must be > 0"
             )
         if self.retry_backoff_s < 0:
             raise ValueError(
@@ -822,6 +853,7 @@ class Run:
         programs=None,
         shared_store=None,
         shared_cache: bool = False,
+        flight=None,
     ) -> None:
         self.stack = stack
         self.cfg = cfg
@@ -849,6 +881,31 @@ class Run:
                 "shared_store, or drop ingest_store_mb from this run's "
                 "config"
             )
+        #: the flight ring this run's telemetry mirrors into.  Passed in
+        #: by a serving layer (the SERVER's shared ring — job tile
+        #: traffic then shows up in /debug/flight live) or created here
+        #: when ``cfg.flight`` asks for a standalone one; only an owned
+        #: ring gets a sampler thread and a run-end dump.
+        self.flight = flight
+        self.owns_flight = False
+        self.sampler = None
+        #: live progress snapshot for the /debug surface and the flight
+        #: sampler.  Keys are FIXED at construction (values overwrite in
+        #: place), so a point-in-time ``dict(run.progress)`` from another
+        #: thread can never race a dict resize; the int/str stores are
+        #: atomic and advisory — introspection data, not run state.
+        self.progress: dict = {
+            "phase": "init",
+            "tiles_total": 0,
+            "tiles_todo": 0,
+            "tiles_done": 0,
+            "tiles_quarantined": 0,
+            "retries": 0,
+            "feed_backlog": 0,
+            "write_backlog": 0,
+            "fetch_backlog": 0,
+            "upload_backlog": 0,
+        }
         # per-run state, populated by execute(); exposed so a serving
         # layer can introspect a live or finished run
         self.manifest: "TileManifest | None" = None
@@ -862,6 +919,42 @@ class Run:
         self.fault_plan = None
         self.program_stats: "dict | None" = None
         self.summary: "dict | None" = None
+
+    def _sampler_probes(self) -> dict:
+        """Host gauges for the flight sampler's ``flight_sample`` events:
+        pipeline backlogs, decode-cache occupancy, and the device
+        allocator watermark where the backend exposes one."""
+        p = self.progress
+        out = {
+            k: int(p[k])
+            for k in (
+                "feed_backlog", "write_backlog", "fetch_backlog",
+                "upload_backlog",
+            )
+        }
+        out.update(blockcache.occupancy_probe())
+        dev = _device_live_bytes()
+        if dev is not None:
+            out["device_bytes_in_use"] = dev
+        return out
+
+    def _dump_flight(self) -> "str | None":
+        """Dump an OWNED ring to ``<workdir>/flight.jsonl`` (per-process
+        under multihost), best-effort: the dump is a post-mortem aid and
+        must never mask the run's own outcome."""
+        if self.flight is None or not self.owns_flight:
+            return None
+        from land_trendr_tpu.obs.flight import flight_path
+
+        path = flight_path(
+            self.cfg.workdir, jax.process_index(), jax.process_count()
+        )
+        try:
+            self.flight.dump(path)
+        except Exception as exc:
+            log.error("flight-ring dump failed (%s): %s", path, exc)
+            return None
+        return path
 
     def _check_cancel(self) -> None:
         """Raise :class:`RunCancelled` once the cancel event is set.
@@ -969,6 +1062,22 @@ class Run:
                 "Pallas block) when the resolved impl is 'pallas' — adjust "
                 "chunk_px or pass impl='xla'"
             )
+        if (
+            cfg.telemetry and self.flight is None and cfg.flight
+            and cfg.flight_ring_events
+        ):
+            # standalone --flight run: this run owns its ring (and, in
+            # the arming block further down, the sampler + run-end
+            # dump).  A serving layer passes the SERVER's shared ring
+            # instead — shared rings are mirrored into but never sampled
+            # or dumped here.  Created BEFORE any leakable resource
+            # (executor pools, store, telemetry): the ring is a plain
+            # deque, safe to abandon on any later unwind.
+            from land_trendr_tpu.obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(cfg.flight_ring_events)
+            self.owns_flight = True
+
         manifest = self.manifest = TileManifest(
             cfg.workdir,
             cfg.fingerprint(stack),
@@ -979,6 +1088,9 @@ class Run:
         bands = idx.required_bands(cfg.index, cfg.ftv_indices)
         todo = [t for t in share if t.tile_id not in done]
         n_resume_skipped = len(share) - len(todo)
+        self.progress.update(
+            phase="setup", tiles_total=len(tiles), tiles_todo=len(todo)
+        )
 
         t_run = time.perf_counter()
         timer = self.timer = StageTimer()
@@ -1071,6 +1183,7 @@ class Run:
                 exc = TileRetriesExhausted(t.tile_id, attempt, err)
                 exc.__cause__ = err
                 raise exc
+            self.progress["retries"] += 1
             if telemetry is not None:
                 telemetry.tile_retry(t.tile_id, attempt, err)
             if watchdog is not None:
@@ -1084,6 +1197,7 @@ class Run:
             if not cfg.quarantine_tiles:
                 raise exc
             quarantined.append(t.tile_id)
+            self.progress["tiles_quarantined"] = len(quarantined)
             manifest.record_failed(t.tile_id, exc.attempts, str(exc.cause))
             if telemetry is not None:
                 telemetry.tile_quarantined(t.tile_id, exc.attempts, str(exc.cause))
@@ -1276,6 +1390,13 @@ class Run:
             writer's fail-fast, exactly as before this subsystem existed."""
             nonlocal n_done
             n_done += 1
+            self.progress.update(
+                tiles_done=n_done,
+                feed_backlog=len(pending_feeds),
+                write_backlog=len(pending_writes),
+                fetch_backlog=len(pending_fetches),
+                upload_backlog=len(pending_uploads),
+            )
             if watchdog is not None:
                 watchdog.tick()
             if telemetry is not None:
@@ -1500,6 +1621,7 @@ class Run:
                     # run's scope, so a fleet-wide fold can attribute
                     # tile traffic to the request that caused it
                     job_id=self.job_id,
+                    flight=self.flight,
                 )
             except BaseException:
                 # e.g. a busy --metrics-port: Telemetry cleans up its own
@@ -1531,10 +1653,12 @@ class Run:
                     _release_setup()
                 raise
 
-        # fault injection + stall watchdog are armed AFTER telemetry exists
-        # (their events need somewhere to go) and disarmed in the finally; a
-        # failure arming them must unwind telemetry like run_start's guard
+        # fault injection + stall watchdog + flight sampler are armed AFTER
+        # telemetry exists (their events need somewhere to go) and disarmed
+        # in the finally; a failure arming them must unwind telemetry like
+        # run_start's guard
         fault_plan = None
+        sampler = None
         try:
             if cfg.fault_schedule:
                 if faults.active() is not None:
@@ -1576,21 +1700,43 @@ class Run:
                 watchdog = self.watchdog = _StallWatchdog(
                     cfg.stall_timeout_s, _on_stall
                 ).start()
+            if self.owns_flight:
+                # the resource sampler emits flight_sample events through
+                # the normal event log (file + ring alike), started only
+                # AFTER run_start so the stream still opens its scope
+                from land_trendr_tpu.obs.flight import ResourceSampler
+
+                sampler = self.sampler = ResourceSampler(
+                    telemetry.events.emit,
+                    cfg.sampler_interval_s,
+                    probes=self._sampler_probes,
+                ).start()
         except BaseException:
             # telescoped: each step may itself raise (LT008 found the
             # skip), so the later steps ride finallys — the event fd and
             # the owned store must close even if the fault disarm fails
             try:
-                if fault_plan is not None:
-                    faults.set_observer(None)
-                    faults.deactivate()
+                if sampler is not None:
+                    sampler.stop()
             finally:
                 try:
-                    if telemetry is not None:
-                        manifest.telemetry = None
-                        telemetry.close()
+                    if watchdog is not None:
+                        # armed a step above: a sampler-start failure
+                        # must not leave the watchdog ticking toward an
+                        # interrupt of a run that never started
+                        watchdog.stop()
                 finally:
-                    _release_setup()
+                    try:
+                        if fault_plan is not None:
+                            faults.set_observer(None)
+                            faults.deactivate()
+                    finally:
+                        try:
+                            if telemetry is not None:
+                                manifest.telemetry = None
+                                telemetry.close()
+                        finally:
+                            _release_setup()
             raise
 
         # readahead targets ride the feed submissions: the tile fed at index
@@ -1741,7 +1887,9 @@ class Run:
                 # landing mid-compile unwinds through the normal abort
                 # path (run_done "aborted", pool shutdown, plan disarm)
                 # exactly like a tile-0 compile did before this existed
+                self.progress["phase"] = "warmup"
                 program_stats = self.program_stats = _warm_programs()
+            self.progress["phase"] = "pipeline"
             next_i = min(ra_depth, len(todo))
             for i in range(next_i):
                 _submit_feed(i)
@@ -1790,6 +1938,7 @@ class Run:
                     pending = (t, out, err, dn, qa, dt_dispatch, attempt0)
             if pending is not None:
                 _finish(pending)
+            self.progress["phase"] = "drain"
             _drain_fetches(0)
             _drain_writes(0)
             run_ok = True
@@ -1804,6 +1953,12 @@ class Run:
             raise
         finally:
             try:
+                self.progress["phase"] = "done" if run_ok else "aborted"
+                if sampler is not None:
+                    # before the terminal rollups: a sample emitted into a
+                    # closing log is a lost beat, not an error — but the
+                    # stream reads better when run_done is the scope's tail
+                    sampler.stop()
                 # NOTE: the watchdog stays armed through this whole unwind — a
                 # writer thread hung in a native transfer would otherwise block
                 # writer.shutdown(wait=True) forever with the hard-exit grace
@@ -1897,6 +2052,10 @@ class Run:
                             telemetry.close()
                         except Exception as exc:
                             log.error("abort-path telemetry close failed: %s", exc)
+                        # the flight dump is MOST valuable here: the last
+                        # N events + resource samples of a run that died
+                        # (dumped after close so run_done is in the ring)
+                        self._dump_flight()
                 if watchdog is not None:
                     # LAST: disarmed only once the unwind is through — the
                     # success tail below (merge wait included) has its own
@@ -1993,6 +2152,12 @@ class Run:
                     # merge.peer fires past this point are still counted/logged
                     # by the plan itself
                     faults.set_observer(None)
+                    # owned-ring dump (run_done included — the close above
+                    # already mirrored it): the "how did the end look"
+                    # slice next to the full stream
+                    flight_file = self._dump_flight()
+                    if flight_file is not None:
+                        summary["telemetry"]["flight"] = flight_file
                 if jax.process_count() > 1 and jax.process_index() == 0:
                     # primary-host fold: per-process event files live in the SHARED
                     # workdir (the manifest's filesystem is the pod's job state), so

@@ -37,6 +37,9 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     submission fails and is rejected; the server lives
 ``serve.job``       serve-mode job execution start: the job fails
                     terminally; sibling jobs and the server live
+``debug.profile``   on-demand profiler capture (``POST /debug/profile``):
+                    the capture fails (``profile_captured`` carries
+                    ``ok=false``); the job and the server live
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -104,6 +107,7 @@ SEAMS = (
     "merge.peer",
     "serve.submit",
     "serve.job",
+    "debug.profile",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -123,6 +127,7 @@ _DEFAULT_KIND = {
     "merge.peer": "fire",
     "serve.submit": "io",
     "serve.job": "runtime",
+    "debug.profile": "runtime",
 }
 
 

@@ -97,6 +97,20 @@ class ServeConfig:
     #: ``serve.job`` seams plus all the pipeline seams); production
     #: servers leave this unset
     fault_schedule: str | None = None
+    #: the live ``/debug`` surface on the job API (``/debug/flight``,
+    #: ``/debug/stacks``, ``/debug/jobs``, ``POST /debug/profile``) —
+    #: loopback-only like the rest of the API (it reads process
+    #: internals and triggers profiler captures).  ``False`` turns every
+    #: ``/debug`` route into a 404.
+    debug_endpoints: bool = True
+    #: flight-recorder ring capacity, events: with ``telemetry``, a
+    #: bounded in-memory ring mirrors every server AND job event (the
+    #: ``/debug/flight`` window, dumped to ``<workdir>/flight.jsonl`` at
+    #: shutdown) and a sampler thread emits periodic ``flight_sample``
+    #: resource events.  ``0`` disables the ring + sampler.
+    flight_ring_events: int = 2048
+    #: flight resource-sampler period, seconds
+    sampler_interval_s: float = 5.0
 
     def __post_init__(self) -> None:
         if not (0 <= self.serve_port <= 65535):
@@ -171,6 +185,16 @@ class ServeConfig:
         if self.metrics_interval_s <= 0:
             raise ValueError(
                 f"metrics_interval_s={self.metrics_interval_s} must be > 0"
+            )
+        if self.flight_ring_events < 0 or self.flight_ring_events == 1:
+            raise ValueError(
+                f"flight_ring_events={self.flight_ring_events} must be 0 "
+                "(off) or >= 2 (a useful ring holds at least a run_start "
+                "and one event)"
+            )
+        if self.sampler_interval_s <= 0:
+            raise ValueError(
+                f"sampler_interval_s={self.sampler_interval_s} must be > 0"
             )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam is a config error at startup, not

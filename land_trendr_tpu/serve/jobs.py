@@ -92,6 +92,12 @@ class JobRequest:
     tenant: str = "default"
     priority: int = 0  # higher drains first; FIFO within a priority
     timeout_s: "float | None" = None  # overrides ServeConfig.job_timeout_s
+    #: SLO deadline, seconds, submit→terminal — ACCOUNTING ONLY: a job
+    #: past its deadline keeps running to its natural terminal state
+    #: (use ``timeout_s`` to enforce a bound); the miss surfaces as
+    #: ``deadline_exceeded`` in the status snapshot, ``met=false`` on
+    #: the ``job_slo`` event, and the ``lt_slo_*`` instruments
+    deadline_s: "float | None" = None
     max_retries: int = 2
     quarantine_tiles: bool = False
     lazy: bool = False  # windowed C2 ingest (the ingest-store workload)
@@ -150,6 +156,8 @@ class JobRequest:
             )
         if req.timeout_s is not None and req.timeout_s <= 0:
             raise ValueError(f"timeout_s={req.timeout_s} must be > 0")
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            raise ValueError(f"deadline_s={req.deadline_s} must be > 0")
         if req.tile_size < 1:
             raise ValueError(f"tile_size={req.tile_size} must be >= 1")
         if req.max_retries < 0:
@@ -226,6 +234,44 @@ class Job:
     )
     timed_out: bool = False
     dropbox_path: "str | None" = None
+    #: the live Run object while the job executes (the /debug/jobs
+    #: progress feed); RELEASED at terminal — a Run pins the job's whole
+    #: decoded stack, which a long-lived server must not accumulate
+    run: "object | None" = None
+
+    def _latency_split_locked(self) -> "tuple[float, float]":
+        """``(queue_wait_s, exec_s)`` with each leg clamped ≥ 0; caller
+        holds the server lock.  Latency is DERIVED as their sum, never
+        re-measured end−submit: a backwards wall-clock step between the
+        three stamps could otherwise break the ``queue_wait + exec <=
+        latency`` split the schema value-lint hard-enforces — and this
+        one derivation serves both ``slo_locked`` (terminal verdict) and
+        ``status_locked`` (live ``deadline_exceeded``) so the two can
+        never disagree about the same job."""
+        end = self.finished_t if self.finished_t is not None else time.time()
+        start = self.started_t if self.started_t is not None else end
+        return max(0.0, start - self.submitted_t), max(0.0, end - start)
+
+    def slo_locked(self) -> dict:
+        """SLO accounting for a TERMINAL job (caller holds the server
+        lock): the latency split — queue wait (submit→dispatch) vs
+        execution (dispatch→terminal) — and the deadline verdict.
+        A job cancelled while still queued has ``exec_s`` 0 and a queue
+        wait spanning its whole life.  The verdict is accounting, never
+        enforcement: ``met`` is True when no ``deadline_s`` was set.
+        """
+        queue_wait, exec_s = self._latency_split_locked()
+        latency = queue_wait + exec_s
+        deadline = self.request.deadline_s
+        out = {
+            "queue_wait_s": round(queue_wait, 6),
+            "exec_s": round(exec_s, 6),
+            "latency_s": round(latency, 6),
+            "met": deadline is None or latency <= deadline,
+        }
+        if deadline is not None:
+            out["deadline_s"] = deadline
+        return out
 
     def status_locked(self) -> dict:
         """JSON-safe snapshot; caller holds the server lock."""
@@ -243,6 +289,13 @@ class Job:
         }
         if self.state in TERMINAL_STATES:
             out["exit_code"] = EXIT_CODE_FOR_STATE.get(self.state, 1)
+        deadline = self.request.deadline_s
+        if deadline is not None:
+            # live surfacing: a RUNNING job past its deadline already
+            # reads deadline_exceeded — the SLO is about the requester's
+            # clock, not the job's eventual terminal state
+            if sum(self._latency_split_locked()) > deadline:
+                out["deadline_exceeded"] = True
         if self.error is not None:
             out["error"] = self.error
         if self.summary is not None:

@@ -42,6 +42,7 @@ under the job workdir with the ``job_id`` threaded onto every event.
 
 from __future__ import annotations
 
+import collections
 import heapq
 import http.server
 import json
@@ -53,6 +54,11 @@ from typing import Any
 
 from land_trendr_tpu.io import blockcache
 from land_trendr_tpu.obs.events import EventLog
+from land_trendr_tpu.obs.flight import (
+    FlightRecorder,
+    ResourceSampler,
+    flight_path,
+)
 from land_trendr_tpu.obs.metrics import (
     MetricsHTTPServer,
     MetricsRegistry,
@@ -70,6 +76,9 @@ log = logging.getLogger("land_trendr_tpu.serve")
 #: job-latency histogram buckets: sub-second warm smokes through
 #: multi-hour scene jobs
 _JOB_BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 300, 1800, 7200, 43200)
+
+#: ``lt_slo_burn_rate`` window, terminal jobs
+_SLO_WINDOW_JOBS = 100
 
 
 class Rejection(Exception):
@@ -95,13 +104,28 @@ class _ServeTelemetry:
     ``obs_report`` — folds it without special cases.
     """
 
-    def __init__(self, cfg: ServeConfig) -> None:
+    def __init__(
+        self, cfg: ServeConfig, probes: "Any | None" = None
+    ) -> None:
         os.makedirs(cfg.workdir, exist_ok=True)
-        self.events = EventLog(os.path.join(cfg.workdir, "events.jsonl"))
+        #: the flight ring behind /debug/flight: mirrors every SERVER
+        #: event here plus every JOB run's events (the server threads
+        #: this recorder into each Run's telemetry), so the ring shows
+        #: the process's whole recent story in one window
+        self.flight = (
+            FlightRecorder(cfg.flight_ring_events)
+            if cfg.flight_ring_events
+            else None
+        )
+        self.events = EventLog(
+            os.path.join(cfg.workdir, "events.jsonl"),
+            mirror=self.flight.record if self.flight is not None else None,
+        )
         self._server: "MetricsHTTPServer | None" = None
         self._exporter: "PromFileExporter | None" = None
+        self._sampler: "ResourceSampler | None" = None
         try:
-            self._init_instruments(cfg)
+            self._init_instruments(cfg, probes)
         except BaseException:
             # a half-built telemetry bundle must not leak the event fd /
             # exporter thread / metrics port into the caller's process
@@ -111,21 +135,26 @@ class _ServeTelemetry:
     def _release(self) -> None:
         """Tear the bundle down in reverse acquisition order — ONE copy
         shared by the construction guard and :meth:`close`.  The event-fd
-        close rides the innermost finally so a server/exporter stop that
-        ALSO fails cannot skip it (LT008)."""
+        close rides the innermost finally so a server/exporter/sampler
+        stop that ALSO fails cannot skip it (LT008)."""
         try:
-            if self._server is not None:
-                self._server.stop()
-                self._server = None
+            if self._sampler is not None:
+                self._sampler.stop()
+                self._sampler = None
         finally:
             try:
-                if self._exporter is not None:
-                    self._exporter.stop()
-                    self._exporter = None
+                if self._server is not None:
+                    self._server.stop()
+                    self._server = None
             finally:
-                self.events.close()
+                try:
+                    if self._exporter is not None:
+                        self._exporter.stop()
+                        self._exporter = None
+                finally:
+                    self.events.close()
 
-    def _init_instruments(self, cfg: ServeConfig) -> None:
+    def _init_instruments(self, cfg: ServeConfig, probes=None) -> None:
         self.registry = MetricsRegistry()
         r = self.registry
         self._queue_depth = r.gauge(
@@ -162,6 +191,43 @@ class _ServeTelemetry:
             "lt_serve_warm_hit_ratio",
             "program-cache hits / (hits + misses) over the server's life",
         )
+        # per-job SLO accounting: the latency split and the deadline
+        # verdict (job_slo events carry the same numbers per job)
+        self._queue_wait_hist = r.histogram(
+            "lt_serve_queue_wait_seconds",
+            "job queue wait, submit to dispatch",
+            buckets=_JOB_BUCKETS,
+        )
+        self._exec_hist = r.histogram(
+            "lt_serve_exec_seconds",
+            "job execution, dispatch to terminal state",
+            buckets=_JOB_BUCKETS,
+        )
+        self._slo_met = r.counter(
+            "lt_slo_met_total",
+            "terminal jobs inside their deadline_s (or with none set)",
+        )
+        self._slo_missed = r.counter(
+            "lt_slo_missed_total",
+            "terminal jobs past their deadline_s (accounting, not "
+            "enforcement — the job still ran to its terminal state)",
+        )
+        self._slo_burn = r.gauge(
+            "lt_slo_burn_rate",
+            f"fraction of the last {_SLO_WINDOW_JOBS} DEADLINED "
+            "terminal jobs that missed their deadline (jobs without a "
+            "deadline_s never enter the window)",
+        )
+        #: burn-rate window: the last N deadlined terminal jobs' met
+        #: verdicts.  A dedicated deque, NOT the flight ring — one busy
+        #: job's tile events would evict every prior ``job_slo`` record
+        #: from the ring, collapsing the burn denominator to the job
+        #: just ended.  Deadline-scoped, like obs_report's hit_rate: a
+        #: no-deadline job is ``met`` by definition, and 99 of those
+        #: must not dilute one missed deadline into burn 0.01.
+        self._slo_window: collections.deque = collections.deque(
+            maxlen=_SLO_WINDOW_JOBS
+        )
         self._jobs_done: dict[str, Any] = {}
         self._prog_lock = threading.Lock()
         self._last_prog = {"hits": 0, "misses": 0, "compile_s": 0.0}
@@ -196,6 +262,29 @@ class _ServeTelemetry:
                 self._server.stop()
                 self._server = None
             raise
+        if self.flight is not None:
+            # started LAST (after run_start, so the stream still opens
+            # its scope) — flight_sample events ride the normal event
+            # log into the file AND the ring
+            try:
+                self._sampler = ResourceSampler(
+                    self.events.emit, cfg.sampler_interval_s, probes=probes
+                ).start()
+            except BaseException:
+                # sampler-thread start failing after the exporter/server
+                # exist: release them HERE (locality, like the exporter
+                # guard above) so __init__'s guard only owns the event
+                # fd; telescoped so an exporter-stop failure cannot skip
+                # the server release
+                try:
+                    if self._exporter is not None:
+                        self._exporter.stop()
+                        self._exporter = None
+                finally:
+                    if self._server is not None:
+                        self._server.stop()
+                        self._server = None
+                raise
 
     def _done_counter(self, status: str):
         c = self._jobs_done.get(status)
@@ -262,6 +351,49 @@ class _ServeTelemetry:
         self._job_hist.observe(wall_s)
         self._done_counter(job.state).inc()
 
+    def job_slo(self, job: Job, slo: dict) -> None:
+        """One terminal job's SLO accounting: the ``job_slo`` event plus
+        the latency-split histograms, met/missed counters, and the burn
+        rate over the last ``_SLO_WINDOW_JOBS`` deadlined terminal
+        jobs."""
+        self.events.emit(
+            "job_slo",
+            job_id=job.job_id,
+            tenant=job.request.tenant,
+            **slo,
+        )
+        self._queue_wait_hist.observe(slo["queue_wait_s"])
+        self._exec_hist.observe(slo["exec_s"])
+        (self._slo_met if slo["met"] else self._slo_missed).inc()
+        if "deadline_s" in slo:
+            self._slo_window.append(bool(slo["met"]))
+            window = list(self._slo_window)
+            self._slo_burn.set(window.count(False) / len(window))
+
+    def profile_captured(
+        self,
+        ok: bool,
+        duration_s: float,
+        path: str,
+        error: "str | None" = None,
+        nbytes: "int | None" = None,
+    ) -> None:
+        """One on-demand profiler capture attempt (POST /debug/profile);
+        a failed capture is an event with ``ok=false``, never a failed
+        job or server."""
+        fields: dict = {}
+        if error:
+            fields["error"] = str(error)
+        if nbytes is not None:
+            fields["bytes"] = int(nbytes)
+        self.events.emit(
+            "profile_captured",
+            ok=bool(ok),
+            duration_s=round(float(duration_s), 6),
+            path=path,
+            **fields,
+        )
+
     def program_cache(self, stats: dict) -> None:
         """Refresh the warm-ratio instruments from the server-wide
         totals (called after every job; the terminal aggregate event is
@@ -324,6 +456,11 @@ class SegmentationServer:
         self._queued = 0
         self._terminal = 0
         self._stopping = False
+        #: shutdown has BEGUN (vs _stopping = drain requested): new
+        #: profiler captures are refused past this point, and the
+        #: teardown waits out the ones already in flight
+        self._closing = False
+        self._captures = 0
         self._running_id: "str | None" = None
         self.programs = ProgramCache()
 
@@ -362,7 +499,11 @@ class SegmentationServer:
                 store=self.store,
             )
 
-            self.telemetry = _ServeTelemetry(cfg) if cfg.telemetry else None
+            self.telemetry = (
+                _ServeTelemetry(cfg, probes=self._sampler_probes)
+                if cfg.telemetry
+                else None
+            )
 
             # one process-wide fault plan shared by every job (soak
             # mode); jobs carrying their own schedule are rejected by
@@ -410,6 +551,36 @@ class SegmentationServer:
             cfg.serve_host, self.port, cfg.serve_queue_depth,
             f"max_jobs={cfg.max_jobs}" if cfg.max_jobs else "unbounded",
         )
+
+    def _sampler_probes(self) -> dict:
+        """Host gauges for the flight sampler's ``flight_sample``
+        events: queue/admission state, warm-program residency, cache
+        occupancy, and — while a job runs — its pipeline backlogs."""
+        with self._lock:
+            out = {
+                "queue_depth": self._queued,
+                "running": 1 if self._running_id is not None else 0,
+                "jobs_total": len(self._jobs),
+            }
+            running = (
+                self._jobs.get(self._running_id)
+                if self._running_id is not None
+                else None
+            )
+            run = running.run if running is not None else None
+        out["warm_program_count"] = int(
+            self.programs.stats().get("keys", 0)
+        )
+        out.update(blockcache.occupancy_probe())
+        if run is not None:
+            p = getattr(run, "progress", None)
+            if p is not None:
+                for k in (
+                    "feed_backlog", "write_backlog", "fetch_backlog",
+                    "upload_backlog",
+                ):
+                    out[k] = int(p.get(k, 0))
+        return out
 
     # -- admission ---------------------------------------------------------
     def submit(self, payload: dict, source: str = "http") -> dict:
@@ -527,6 +698,102 @@ class SegmentationServer:
                 "jobs_total": len(self._jobs),
             }
         snap["program_cache"] = self.programs.stats()
+        # load-balancer-grade health facts ride /healthz directly so an
+        # LB check need not scrape (and parse) the Prometheus exposition
+        snap["warm_program_count"] = int(
+            snap["program_cache"].get("keys", 0)
+        )
+        snap["uptime_s"] = round(time.time() - self._t0, 3)
+        return snap
+
+    # -- the /debug surface ------------------------------------------------
+    def flight_snapshot(self, n: "int | None" = None) -> "dict | None":
+        """The flight ring's recent window (None when telemetry or the
+        ring is off): ring stats plus the newest ``n`` (default: all
+        held) mirrored event records, oldest first.  ``held`` preserves
+        the ring's occupancy (stats' integer ``events``), which the
+        record list — possibly truncated to ``n`` — replaces."""
+        flight = self.telemetry.flight if self.telemetry is not None else None
+        if flight is None:
+            return None
+        stats = flight.stats()
+        stats["held"] = stats["events"]
+        stats["events"] = flight.snapshot(n)
+        return stats
+
+    def debug_jobs(self) -> list:
+        """Per-job live state: the status snapshot plus — for a running
+        job — the Run's progress (phase, tiles done/total, retry count,
+        pipeline backlog depths)."""
+        with self._lock:
+            pairs = [(j, j.status_locked()) for j in self._jobs.values()]
+        for job, snap in pairs:
+            run = job.run
+            if run is not None and snap["state"] == "running":
+                # point-in-time copy: progress keys are fixed at Run
+                # construction, so the copy can never race a dict resize
+                snap["progress"] = dict(run.progress)
+        return [snap for _, snap in pairs]
+
+    def capture_profile(self, duration_s: float) -> dict:
+        """On-demand, duration-bounded profiler capture of the LIVE
+        process (POST /debug/profile): whatever the dispatcher and its
+        job do during the window is what the trace shows.  Never raises:
+        a failed capture — the ``debug.profile`` fault seam, a
+        concurrent capture, a profiler error mid-job — is an
+        ``ok=false`` verdict (and a ``profile_captured`` event), not a
+        failed job or server."""
+        t0 = time.perf_counter()
+        logdir = os.path.join(
+            self.cfg.workdir, "profiles",
+            f"profile-{int(time.time() * 1000)}-{os.getpid()}",
+        )
+        with self._lock:
+            if self._closing:
+                # shutdown in progress: a capture started now could not
+                # flush before the process (and the native profiler
+                # session) tears down under it
+                return {
+                    "ok": False,
+                    "path": logdir,
+                    "duration_s": 0.0,
+                    "error": "shutting_down: server is tearing down",
+                }
+            self._captures += 1
+        try:
+            try:
+                faults.check("debug.profile")
+                from land_trendr_tpu.utils.profiling import capture_profile
+
+                snap = {"ok": True, **capture_profile(logdir, duration_s)}
+            except Exception as e:
+                snap = {
+                    "ok": False,
+                    "path": logdir,
+                    "duration_s": round(time.perf_counter() - t0, 6),
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            # the event emit happens BEFORE the _captures release: the
+            # shutdown drain cannot close telemetry while we still hold
+            # a capture slot, so the emit can never race the teardown.
+            # Best-effort beyond that (a full disk must not turn the
+            # capture verdict into a lost HTTP response).
+            telemetry = self.telemetry
+            if telemetry is not None:
+                try:
+                    telemetry.profile_captured(
+                        snap["ok"],
+                        snap["duration_s"],
+                        snap["path"],
+                        error=snap.get("error"),
+                        nbytes=snap.get("bytes"),
+                    )
+                except Exception as exc:
+                    log.error("profile_captured emit failed: %s", exc)
+        finally:
+            with self._lock:
+                self._captures -= 1
+                self._cond.notify_all()
         return snap
 
     def cancel(self, job_id: str) -> "dict | None":
@@ -552,6 +819,9 @@ class SegmentationServer:
                 self.telemetry.job_done(
                     finished, finished.finished_t - finished.submitted_t
                 )
+                with self._lock:
+                    slo = finished.slo_locked()
+                self.telemetry.job_slo(finished, slo)
             self._write_result(finished)
         with self._lock:
             self._cond.notify_all()
@@ -664,7 +934,16 @@ class SegmentationServer:
                 # the server configured the process-wide cache once at
                 # startup; per-job cache knobs must not clobber it
                 shared_cache=True,
+                # job events mirror into the SERVER's flight ring, so
+                # /debug/flight shows live tile traffic; the run's
+                # progress dict feeds /debug/jobs and the sampler
+                flight=(
+                    self.telemetry.flight
+                    if self.telemetry is not None
+                    else None
+                ),
             )
+            job.run = run
             summary = run.execute()
             # resuming needs the SAME manifest: fresh submissions get
             # fresh jobs/<id>/work dirs, so every retryable error spells
@@ -715,6 +994,12 @@ class SegmentationServer:
             job.summary = summary
             job.outputs = outputs
             job.finished_t = time.time()
+            # release the Run: it pins the job's whole decoded stack
+            # (plus manifest/fetcher/uploader) — retained across
+            # terminal jobs it would grow the long-lived server by a
+            # full scene per job.  /debug/jobs only reads progress for
+            # RUNNING jobs, so nothing observes it past this point.
+            job.run = None
             self._terminal += 1
             self._running_id = None
             wall_s = job.finished_t - job.submitted_t
@@ -724,6 +1009,9 @@ class SegmentationServer:
         )
         if self.telemetry is not None:
             self.telemetry.job_done(job, wall_s)
+            with self._lock:
+                slo = job.slo_locked()
+            self.telemetry.job_slo(job, slo)
             self.telemetry.program_cache(self.programs.stats())
         self._write_result(job)
         with self._lock:
@@ -814,7 +1102,18 @@ class SegmentationServer:
         manifests/outputs whatever happens here."""
         with self._lock:
             self._stopping = True
+            self._closing = True
             self._cond.notify_all()
+            # drain in-flight profiler captures BEFORE closing anything:
+            # a drain-mode (--max-jobs) server otherwise exits while a
+            # handler thread is inside the native profiler session —
+            # observed as a SIGSEGV at interpreter teardown, and a lost
+            # response for the client.  Bounded by the capture's own
+            # duration ceiling plus flush slack; new captures are
+            # refused once _closing is set, so this converges.
+            deadline = time.monotonic() + _JobAPIHandler.MAX_PROFILE_S + 60
+            while self._captures and time.monotonic() < deadline:
+                self._cond.wait(timeout=1.0)
         self._dropbox_stop.set()
         httpd = getattr(self, "_httpd", None)
         thread = getattr(self, "_http_thread", None)
@@ -850,6 +1149,15 @@ class SegmentationServer:
                 )
             except Exception as exc:
                 log.error("serve telemetry close failed: %s", exc)
+            # final flight dump AFTER close, so the terminal
+            # program_cache/run_done events are in the ring too — the
+            # "how did the end look" slice beside the full stream
+            flight = self.telemetry.flight
+            if flight is not None:
+                try:
+                    flight.dump(flight_path(self.cfg.workdir))
+                except Exception as exc:
+                    log.error("flight-ring dump failed: %s", exc)
             self.telemetry = None
 
 
@@ -884,11 +1192,27 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
         GET  /jobs              every job's snapshot
         GET  /jobs/<id>         one job's snapshot
         POST /jobs/<id>/cancel  cancel (queued → terminal; running → event)
-        GET  /healthz           liveness + queue stats
+        GET  /healthz           liveness + queue/uptime/warm-program stats
         GET  /metrics           the lt_serve_* exposition
+        GET  /debug/flight      the flight ring's recent events (?n=100)
+        GET  /debug/stacks      all-thread tracebacks (sys._current_frames)
+        GET  /debug/jobs        per-job live state incl. run progress
+        POST /debug/profile     on-demand bounded jax.profiler capture
+
+    The ``/debug`` surface shares the job API's loopback-only bind (it
+    reads process internals and triggers profiler captures) and is a
+    404 wall when ``ServeConfig.debug_endpoints`` is off.  Handler
+    threads only ever read locked snapshots; ``/debug/stacks`` in
+    particular takes NO locks, so it answers even while the dispatcher
+    is wedged — the question it exists for.
     """
 
     server: _JobAPIServer
+
+    #: POST /debug/profile duration bound, seconds: long enough for any
+    #: useful window, short enough that a typo'd duration cannot pin the
+    #: process-global profiler for an hour
+    MAX_PROFILE_S = 300.0
 
     def _send_json(self, status: int, payload) -> None:
         body = json.dumps(payload, default=str).encode()
@@ -902,7 +1226,43 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - stdlib API name
         srv = self.server.lt_server
-        path = self.path.split("?")[0].rstrip("/")
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/")
+        if path.startswith("/debug"):
+            if not srv.cfg.debug_endpoints:
+                self.send_error(404)
+                return
+            if path == "/debug/flight":
+                n = None
+                try:
+                    from urllib.parse import parse_qs
+
+                    raw = parse_qs(query).get("n")
+                    if raw:
+                        n = max(1, int(raw[0]))
+                except ValueError:
+                    self._send_json(
+                        400, {"error": "bad_request", "detail": "n must be int"}
+                    )
+                    return
+                snap = srv.flight_snapshot(n)
+                if snap is None:
+                    self._send_json(
+                        404,
+                        {"error": "no flight ring (telemetry off or "
+                                  "flight_ring_events=0)"},
+                    )
+                else:
+                    self._send_json(200, snap)
+            elif path == "/debug/stacks":
+                from land_trendr_tpu.obs.flight import thread_stacks
+
+                self._send_json(200, {"threads": thread_stacks()})
+            elif path == "/debug/jobs":
+                self._send_json(200, {"jobs": srv.debug_jobs()})
+            else:
+                self.send_error(404)
+            return
         if path == "/healthz":
             self._send_json(200, {"ok": True, **srv.stats()})
         elif path == "/metrics":
@@ -931,6 +1291,50 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 - stdlib API name
         srv = self.server.lt_server
         path = self.path.split("?")[0].rstrip("/")
+        if path == "/debug/profile":
+            if not srv.cfg.debug_endpoints:
+                self.send_error(404)
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(
+                    400, {"error": "bad_request", "detail": str(e)}
+                )
+                return
+            if not isinstance(payload, dict):
+                self._send_json(
+                    400,
+                    {"error": "bad_request",
+                     "detail": "body must be a JSON object"},
+                )
+                return
+            duration_s = payload.get("duration_s", 1.0)
+            # bool is an int subclass; `true` as a duration is a typo
+            if isinstance(duration_s, bool) or not isinstance(
+                duration_s, (int, float)
+            ):
+                self._send_json(
+                    400,
+                    {"error": "bad_request",
+                     "detail": "duration_s must be a number"},
+                )
+                return
+            duration_s = float(duration_s)
+            if not (0 < duration_s <= self.MAX_PROFILE_S):
+                self._send_json(
+                    400,
+                    {"error": "bad_request",
+                     "detail": f"duration_s must be in (0, "
+                               f"{self.MAX_PROFILE_S}]"},
+                )
+                return
+            # synchronous by design: the capture is duration-bounded and
+            # runs on THIS handler thread — the dispatcher (and its job)
+            # keep running, which is exactly what the trace captures
+            self._send_json(200, srv.capture_profile(duration_s))
+            return
         if path == "/jobs":
             try:
                 n = int(self.headers.get("Content-Length", 0))

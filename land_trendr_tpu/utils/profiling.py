@@ -30,7 +30,13 @@ from typing import Any, Callable, Iterator
 
 import jax
 
-__all__ = ["trace", "profile_op", "StageTimer", "STAGE_SCOPES"]
+__all__ = [
+    "trace",
+    "profile_op",
+    "capture_profile",
+    "StageTimer",
+    "STAGE_SCOPES",
+]
 
 #: named_scope labels emitted by the segmentation kernel, in pipeline order.
 #: Single source of truth — :mod:`land_trendr_tpu.ops.segment` imports these.
@@ -105,6 +111,68 @@ def profile_op(
         "wall_s_per_iter": dt / iters,
         "logdir_bytes": float(_tree_bytes() - before),
     }
+
+
+# one capture at a time: jax's profiler session is a process-global
+# singleton — a second concurrent start_trace raises deep inside it.
+# The flag flips under a lock; the capture itself (a multi-second sleep)
+# runs OUTSIDE any lock.
+_capture_active = False
+_capture_flag_lock = threading.Lock()
+
+
+def _capture_begin() -> None:
+    global _capture_active
+    with _capture_flag_lock:
+        if _capture_active:
+            raise RuntimeError(
+                "a profiler capture is already in flight (the jax profiler "
+                "is process-global; retry when it finishes)"
+            )
+        _capture_active = True
+
+
+def _capture_end() -> None:
+    global _capture_active
+    with _capture_flag_lock:
+        _capture_active = False
+
+
+def capture_profile(logdir: str, duration_s: float) -> dict:
+    """On-demand, duration-bounded device+host capture of a LIVE run.
+
+    The ``POST /debug/profile`` workhorse: opens a ``jax.profiler``
+    trace under ``logdir`` and holds it open for ``duration_s`` —
+    whatever the process's other threads (the serve dispatcher, the tile
+    pipeline, transfer waits) do in that window is what the trace shows.
+    Returns ``{"path", "duration_s", "bytes"}`` (``bytes`` counts only
+    this capture's output — a sanity check that the profiler actually
+    wrote something).  Raises ``RuntimeError`` when a capture is already
+    in flight, and ``ValueError`` on a non-positive duration.
+    """
+    if duration_s <= 0:
+        raise ValueError(f"duration_s={duration_s} must be > 0")
+    _capture_begin()
+    try:
+        t0 = time.perf_counter()
+
+        def _tree_bytes() -> int:
+            return sum(
+                os.path.getsize(os.path.join(root, f))
+                for root, _, files in os.walk(logdir)
+                for f in files
+            )
+
+        before = _tree_bytes() if os.path.isdir(logdir) else 0
+        with trace(logdir):
+            time.sleep(duration_s)
+        return {
+            "path": logdir,
+            "duration_s": round(time.perf_counter() - t0, 6),
+            "bytes": int(_tree_bytes() - before),
+        }
+    finally:
+        _capture_end()
 
 
 class StageTimer:

@@ -1,0 +1,675 @@
+"""Flight recorder + live debug surface tests (ISSUE 9).
+
+Pins the introspection layer's contracts:
+
+* the **ring** mirrors every telemetry emit, stays bounded, and dumps as
+  a schema-valid ``events.jsonl`` slice even after the scope's
+  ``run_start`` has been evicted (the sticky-header property);
+* the **sampler** emits schema-valid ``flight_sample`` events with the
+  process vitals required and host probe gauges merged in — and a sick
+  probe degrades the sample, never the run;
+* a standalone ``--flight`` run dumps ``flight.jsonl`` under the
+  workdir, lint-clean, with a non-empty sampler series;
+* the serve ``/debug`` surface: ``/debug/stacks`` answers (showing the
+  wedged frame) **while a hang fault is armed**, ``POST /debug/profile``
+  against a server running a real job produces a loadable profiler
+  trace under the workdir, a ``debug.profile`` fault fails the capture
+  (``ok=false``) but never the job, ``/debug/jobs`` exposes live run
+  progress, and ``debug_endpoints=False`` is a 404 wall;
+* per-job SLO: ``deadline_s`` is accounting (``job_slo`` events,
+  ``lt_slo_*`` instruments, ``deadline_exceeded`` in the snapshot) —
+  the job still runs to its natural terminal state;
+* ``/healthz`` carries the load-balancer facts, and ``lt top --once``
+  renders a live server;
+* the new value lints catch a broken SLO split and negative sampler
+  gauges; ``obs_report`` folds the SLO and resource sections.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from land_trendr_tpu.cli import main as cli_main
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.obs.events import EventLog, validate_events_file
+from land_trendr_tpu.obs.flight import (
+    FlightRecorder,
+    ResourceSampler,
+    thread_stacks,
+)
+from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+#: same scene shape as tests/test_serve.py, so the process-wide jit
+#: cache keeps every server after the first warm
+_PARAM_FLAGS = ["--max-segments", "4", "--vertex-count-overshoot", "2"]
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("flight_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+def _job(stack_dir: str, **kw) -> dict:
+    return {
+        "stack_dir": stack_dir,
+        "tile_size": _TILE,
+        "params": dict(_PARAMS),
+        **kw,
+    }
+
+
+def _get(port: int, path: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except json.JSONDecodeError:
+            return e.code, {}
+
+
+def _post(port: int, path: str, payload) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except json.JSONDecodeError:
+            return e.code, {}
+
+
+# ---------------------------------------------------------------------------
+# the ring
+
+
+def test_ring_mirrors_emits_and_dumps_schema_valid(tmp_path):
+    ring = FlightRecorder(capacity=8)
+    log = EventLog(str(tmp_path / "events.jsonl"), mirror=ring.record)
+    log.run_start(
+        fingerprint="t", process_index=0, process_count=1, tiles_total=99,
+        tiles_todo=99, tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+    )
+    for i in range(20):  # far past capacity: run_start evicted
+        log.emit("tile_start", tile_id=i, attempt=1)
+    log.close()
+
+    stats = ring.stats()
+    assert stats["capacity"] == 8
+    assert stats["events"] == 8
+    assert stats["recorded_total"] == 21
+    assert stats["dropped"] == 13
+    # snapshot: bounded window, oldest first, n-limit honored
+    snap = ring.snapshot()
+    assert len(snap) == 8 and snap[-1]["tile_id"] == 19
+    assert [r["tile_id"] for r in ring.snapshot(3)] == [17, 18, 19]
+
+    # dump: the sticky run_start re-heads the slice, so the dump passes
+    # the SAME schema lint as a real stream — the acceptance property
+    dump = tmp_path / "flight.jsonl"
+    n = ring.dump(str(dump))
+    assert n == 9  # 8 ring entries + the re-headed run_start
+    assert validate_events_file(str(dump)) == []
+    first = json.loads(dump.read_text().splitlines()[0])
+    assert first["ev"] == "run_start" and first["tiles_total"] == 99
+
+
+def test_ring_dump_trims_orphaned_tail_instead_of_duplicating_header(
+    tmp_path,
+):
+    """Multi-scope ring (the serve shared-ring shape): when a later
+    scope's ``run_start`` is still IN the ring, the dump must open at it
+    — prepending the sticky copy above the previous scope's tail would
+    duplicate the header and re-anchor that tail under the wrong scope's
+    clocks."""
+    ring = FlightRecorder(capacity=8)
+    log = EventLog(str(tmp_path / "events.jsonl"), mirror=ring.record)
+    rs = dict(
+        fingerprint="t", process_index=0, process_count=1, tiles_total=1,
+        tiles_todo=1, tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+    )
+    log.run_start(**rs)
+    for i in range(6):  # scope 1 traffic; its run_start gets evicted
+        log.emit("tile_start", tile_id=i, attempt=1)
+    log.run_start(**rs)  # scope 2 opens mid-ring
+    log.emit("tile_start", tile_id=100, attempt=1)
+    log.close()
+
+    dump = ring.dump_records()
+    assert [r["ev"] for r in dump].count("run_start") == 1
+    assert dump[0]["ev"] == "run_start"
+    assert dump[-1]["tile_id"] == 100
+    path = tmp_path / "flight.jsonl"
+    ring.dump(str(path))
+    assert validate_events_file(str(path)) == []
+
+
+def test_ring_capacity_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=1)
+
+
+# ---------------------------------------------------------------------------
+# the sampler
+
+
+def test_sampler_emits_schema_valid_samples_with_probes(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path)
+    log.run_start(
+        fingerprint="t", process_index=0, process_count=1, tiles_total=0,
+        tiles_todo=0, tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+    )
+    sampler = ResourceSampler(
+        log.emit, interval_s=60.0,
+        probes=lambda: {"queue_depth": 3, "cache_bytes": 123,
+                        "skipped": None},
+    )
+    fields = sampler.sample()
+    assert fields["threads"] >= 1
+    assert fields["rss_bytes"] >= 0 and fields["open_fds"] >= 0
+    assert fields["queue_depth"] == 3 and fields["cache_bytes"] == 123
+    assert "skipped" not in fields  # None-valued probe gauges drop out
+
+    # a sick probe degrades to the base sample — never raises
+    def bad_probes():
+        raise RuntimeError("probe exploded")
+
+    sampler._probes = bad_probes
+    fields = sampler.sample()
+    assert fields["threads"] >= 1 and "queue_depth" not in fields
+    log.close()
+    assert validate_events_file(path) == []
+
+    with pytest.raises(ValueError, match="interval_s"):
+        ResourceSampler(log.emit, interval_s=0)
+
+
+def test_thread_stacks_sees_other_threads():
+    gate = threading.Event()
+    started = threading.Event()
+
+    def parked():
+        started.set()
+        gate.wait(30)
+
+    t = threading.Thread(target=parked, name="lt-test-parked", daemon=True)
+    t.start()
+    try:
+        assert started.wait(10)
+        stacks = thread_stacks()
+        mine = [k for k in stacks if "lt-test-parked" in k]
+        assert mine, f"parked thread missing from {list(stacks)}"
+        frames = stacks[mine[0]]
+        assert any("parked" in line for line in frames)
+        # the caller's own thread is visible too
+        assert any("MainThread" in k for k in stacks)
+    finally:
+        gate.set()
+        t.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# standalone --flight runs
+
+
+def test_run_flight_dumps_and_lints_clean(stack_dir, tmp_path):
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.runtime import RunConfig, load_stack_dir, run_stack
+    from land_trendr_tpu.ops.indices import required_bands
+
+    wd = str(tmp_path / "w")
+    cfg = RunConfig(
+        params=LTParams(**_PARAMS), tile_size=_TILE,
+        workdir=wd, out_dir=str(tmp_path / "o"),
+        telemetry=True, flight=True,
+        sampler_interval_s=0.05, flight_ring_events=64,
+    )
+    stack = load_stack_dir(stack_dir, bands=required_bands("nbr", ()))
+    summary = run_stack(stack, cfg)
+    flight_file = summary["telemetry"]["flight"]
+    assert flight_file == os.path.join(wd, "flight.jsonl")
+
+    from check_events_schema import main as lint_main
+
+    # both the stream AND the ring dump pass the full value-lint chain
+    assert lint_main([wd]) == 0
+    assert lint_main([flight_file]) == 0
+    stream = [json.loads(l) for l in open(summary["telemetry"]["events"])]
+    dump = [json.loads(l) for l in open(flight_file)]
+    assert dump[0]["ev"] == "run_start"
+    assert any(e["ev"] == "flight_sample" for e in stream)
+    assert any(e["ev"] == "flight_sample" for e in dump)
+    # the dump's tail is the stream's tail (the ring mirrors the log)
+    assert dump[-1]["ev"] == "run_done"
+    sample = next(e for e in stream if e["ev"] == "flight_sample")
+    for req in ("rss_bytes", "open_fds", "threads"):
+        assert req in sample
+    assert "feed_backlog" in sample and "cache_bytes" in sample
+
+
+def test_flight_config_validation():
+    from land_trendr_tpu.runtime import RunConfig
+
+    with pytest.raises(ValueError, match="flight requires telemetry"):
+        RunConfig(flight=True)
+    with pytest.raises(ValueError, match="flight_ring_events"):
+        RunConfig(telemetry=True, flight=True, flight_ring_events=1)
+    with pytest.raises(ValueError, match="sampler_interval_s"):
+        RunConfig(telemetry=True, flight=True, sampler_interval_s=0)
+    with pytest.raises(ValueError, match="flight_ring_events"):
+        ServeConfig(flight_ring_events=1)
+    with pytest.raises(ValueError, match="sampler_interval_s"):
+        ServeConfig(sampler_interval_s=0)
+    # 0 disables the ring + sampler on BOTH surfaces (the serve
+    # convention) — run mode must not reject the same spelling
+    RunConfig(telemetry=True, flight=True, flight_ring_events=0)
+    RunConfig(flight_ring_events=0)
+    ServeConfig(flight_ring_events=0)
+
+
+# ---------------------------------------------------------------------------
+# the serve /debug surface — live server, real job, armed hang fault
+
+
+def test_debug_surface_on_live_wedged_server(stack_dir, tmp_path):
+    """The acceptance scenario end to end: while a ``hang`` fault wedges
+    the dispatcher mid-job, ``/debug/stacks`` answers and shows the
+    wedged frame; ``POST /debug/profile`` captures a loadable trace
+    under the workdir; a ``debug.profile`` fault fails a capture with
+    ``ok=false``; ``/debug/jobs`` exposes live progress; and the job
+    still finishes ``done`` with its SLO accounted."""
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(
+            workdir=srv_dir,
+            feed_cache_mb=32,
+            sampler_interval_s=0.1,
+            # dispatch#0 is the warm probe; hanging the first two
+            # dispatches holds the debug window open.  debug.profile@1
+            # fails the SECOND capture only.
+            fault_schedule="seed=1,dispatch@0*2=hang:1.0,debug.profile@1",
+        )
+    )
+    snap = server.submit(
+        _job(stack_dir, deadline_s=0.001)  # SLO miss by construction
+    )
+    t = threading.Thread(target=server.serve_forever, name="lt-dispatcher")
+    t.start()
+    try:
+        # /debug/stacks responds WHILE the hang fault is armed and shows
+        # the dispatcher wedged inside the injected hang
+        deadline = time.monotonic() + 60
+        wedged = False
+        while time.monotonic() < deadline and not wedged:
+            st, body = _get(server.port, "/debug/stacks")
+            assert st == 200
+            wedged = any(
+                any("_hang" in line for line in frames)
+                for frames in body["threads"].values()
+            )
+            if not wedged:
+                time.sleep(0.05)
+        assert wedged, "dispatcher never seen wedged in the armed hang"
+
+        # live job state with run progress
+        st, body = _get(server.port, "/debug/jobs")
+        assert st == 200
+        job = body["jobs"][0]
+        assert job["state"] == "running"
+        assert job["progress"]["tiles_total"] == 4
+        assert job["progress"]["phase"] in (
+            "setup", "warmup", "pipeline", "drain"
+        )
+
+        # the flight ring shows the live story (server + job events)
+        st, body = _get(server.port, "/debug/flight?n=100")
+        assert st == 200
+        kinds = [e["ev"] for e in body["events"]]
+        assert "job_submitted" in kinds or "job_start" in kinds
+        assert body["capacity"] == 2048
+        # ring occupancy survives beside the (possibly n-truncated) list
+        assert body["held"] >= len(body["events"])
+
+        # on-demand profile of the RUNNING job: loadable trace under the
+        # workdir (the capture may outlast duration_s while an XLA
+        # compile holds the profiler's flush — that is the documented
+        # synchronous contract)
+        st, prof = _post(server.port, "/debug/profile", {"duration_s": 0.2})
+        assert st == 200 and prof["ok"] is True, prof
+        assert prof["path"].startswith(srv_dir)
+        assert prof["bytes"] > 0
+        xplanes = list(Path(prof["path"]).rglob("*.xplane.pb"))
+        assert xplanes and all(p.stat().st_size > 0 for p in xplanes)
+        try:  # loadable, when the protobuf runtime is present
+            sys.path.insert(
+                0,
+                os.path.join(
+                    os.path.dirname(__file__), "..", "tools", "_proto"
+                ),
+            )
+            import lt_xplane_pb2
+
+            xs = lt_xplane_pb2.XSpace()
+            xs.ParseFromString(xplanes[0].read_bytes())
+            assert len(xs.planes) >= 1
+        except ImportError:
+            pass  # bytes + naming already prove the capture wrote a trace
+
+        # the second capture hits the armed debug.profile fault: the
+        # CAPTURE fails, the job (still running or finishing) does not
+        st, prof2 = _post(server.port, "/debug/profile", {"duration_s": 0.1})
+        assert st == 200 and prof2["ok"] is False
+        assert "injected fault" in prof2["error"]
+
+        # malformed profile requests are 400s, never captures or 500s
+        st, body = _post(server.port, "/debug/profile", {"duration_s": -1})
+        assert st == 400
+        st, body = _post(server.port, "/debug/profile", {"duration_s": None})
+        assert st == 400
+        st, body = _post(server.port, "/debug/profile", [1, 2])
+        assert st == 400
+    finally:
+        server.stop()
+        t.join(timeout=300)
+
+    s = server.job_status(snap["job_id"])
+    assert s["state"] == "done", s.get("error")
+    assert s["deadline_exceeded"] is True  # SLO surfaced, job unharmed
+
+    # the server stream carries the new events, lint-clean end to end
+    from check_events_schema import main as lint_main
+
+    assert lint_main([srv_dir]) == 0
+    flight_dump = os.path.join(srv_dir, "flight.jsonl")
+    assert os.path.exists(flight_dump)
+    assert lint_main([flight_dump]) == 0
+
+    evs = [json.loads(l) for l in open(os.path.join(srv_dir, "events.jsonl"))]
+    slo = [e for e in evs if e["ev"] == "job_slo"]
+    assert len(slo) == 1
+    assert slo[0]["met"] is False and slo[0]["deadline_s"] == 0.001
+    assert slo[0]["queue_wait_s"] + slo[0]["exec_s"] <= slo[0]["latency_s"] + 5e-3
+    captures = [e for e in evs if e["ev"] == "profile_captured"]
+    assert [c["ok"] for c in captures] == [True, False]
+    assert captures[1]["error"]
+    assert any(e["ev"] == "flight_sample" for e in evs)
+
+    # the job's OWN stream mirrored into the server ring: the dump holds
+    # job-scope events (tile traffic) beside the server's
+    dump = [json.loads(l) for l in open(flight_dump)]
+    assert any(e.get("job_id") == snap["job_id"] for e in dump)
+
+    # obs_report folds the SLO + resources sections from the server scope
+    import obs_report
+
+    report, spans = obs_report.fold([os.path.join(srv_dir, "events.jsonl")])
+    assert report["slo"]["jobs"] == 1 and report["slo"]["missed"] == 1
+    tenant = report["slo"]["by_tenant"]["default"]
+    assert tenant["deadline"] == {
+        "with_deadline": 1, "met": 0, "missed": 1, "hit_rate": 0.0,
+    }
+    assert tenant["queue_wait_s"]["p99"] >= 0
+    assert report["resources"]["samples"] >= 1
+    assert report["resources"]["rss_bytes_max"] > 0
+    counters = [s for s in spans if s["kind"] == "counter"]
+    assert any(s["name"] == "resources" for s in counters)
+    assert any(s["name"] == "sampler_backlog" for s in counters)
+    trace_out = str(tmp_path / "trace.json")
+    n = obs_report.export_trace(spans, report["hosts"], trace_out)
+    assert n > 0
+
+    # lt top renders the finished server's story... from files we can't
+    # (server is down) — lt top is covered by its own live test below.
+
+
+def test_shutdown_drains_inflight_profile_capture(tmp_path):
+    """A drain-mode server exiting mid-capture used to tear the process
+    down while a handler thread was inside the native profiler session
+    (observed SIGSEGV + lost response).  The shutdown must wait out the
+    capture — and refuse captures that arrive after teardown began."""
+    server = SegmentationServer(
+        ServeConfig(workdir=str(tmp_path / "srv"), telemetry=False)
+    )
+    result: dict = {}
+
+    def capture():
+        result.update(server.capture_profile(1.0))
+
+    t = threading.Thread(target=capture)
+    t.start()
+    time.sleep(0.2)  # let the capture open the profiler session
+    server.stop()
+    server.serve_forever()  # tears down — must WAIT for the capture
+    t.join(timeout=30)
+    assert result.get("ok") is True, result
+    assert result["bytes"] > 0
+    # past teardown, a new capture is refused rather than racing exit
+    late = server.capture_profile(0.1)
+    assert late["ok"] is False and "shutting_down" in late["error"]
+
+
+def test_debug_endpoints_disabled_is_404(tmp_path):
+    server = SegmentationServer(
+        ServeConfig(workdir=str(tmp_path / "srv"), debug_endpoints=False)
+    )
+    try:
+        for path in ("/debug/flight", "/debug/stacks", "/debug/jobs"):
+            st, _ = _get(server.port, path)
+            assert st == 404, path
+        st, _ = _post(server.port, "/debug/profile", {"duration_s": 0.1})
+        assert st == 404
+    finally:
+        server.stop()
+        server.serve_forever()
+
+
+def test_deadline_met_and_slo_instruments(stack_dir, tmp_path):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=1, feed_cache_mb=32)
+    )
+    snap = server.submit(_job(stack_dir, deadline_s=3600.0))
+    server.serve_forever()
+    s = server.job_status(snap["job_id"])
+    assert s["state"] == "done"
+    assert "deadline_exceeded" not in s
+    evs = [json.loads(l) for l in open(os.path.join(srv_dir, "events.jsonl"))]
+    slo = [e for e in evs if e["ev"] == "job_slo"]
+    assert len(slo) == 1 and slo[0]["met"] is True
+    # the metrics exposition carried the SLO instruments
+    prom = (Path(srv_dir) / "metrics.prom").read_text()
+    assert "lt_slo_met_total 1" in prom
+    assert "lt_slo_missed_total 0" in prom
+    assert "lt_serve_queue_wait_seconds_count 1" in prom
+    assert "lt_serve_exec_seconds_count 1" in prom
+
+    # deadline_s rides request validation like every other knob
+    from land_trendr_tpu.serve import JobRequest
+
+    with pytest.raises(ValueError, match="deadline_s"):
+        JobRequest.from_payload({"stack_dir": "s", "deadline_s": 0})
+
+
+# ---------------------------------------------------------------------------
+# healthz + lt top
+
+
+def test_healthz_and_lt_top_once(stack_dir, tmp_path, capsys):
+    server = SegmentationServer(
+        ServeConfig(workdir=str(tmp_path / "srv"), feed_cache_mb=32)
+    )
+    try:
+        snap = server.submit(_job(stack_dir, tenant="topper"))
+        st, h = _get(server.port, "/healthz")
+        assert st == 200 and h["ok"] is True
+        # the load-balancer facts ride /healthz directly (no Prometheus
+        # parse needed): queue depth, running, warm programs, uptime
+        assert h["queue_depth"] == 1
+        assert h["running"] is None  # dispatcher not started
+        assert isinstance(h["warm_program_count"], int)
+        assert h["uptime_s"] >= 0
+
+        import lt_top
+
+        assert lt_top.main(["--port", str(server.port), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "lt top" in out and "queue 1" in out
+        assert snap["job_id"] in out and "topper" in out
+
+        assert lt_top.main(["--port", str(server.port), "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["healthz"]["queue_depth"] == 1
+        assert isinstance(parsed["jobs"], list) and parsed["jobs"]
+    finally:
+        server.stop()
+        server.serve_forever()
+    # a downed server is exit 2, not a traceback
+    assert lt_top.main(["--port", str(server.port), "--once"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# value lints
+
+
+def test_job_slo_and_flight_sample_value_lints(tmp_path):
+    from check_events_schema import main as lint_main
+
+    head = {
+        "ev": "run_start", "t_wall": 1.0, "t_mono": 1.0, "schema": 1,
+        "fingerprint": "f", "pid": 1, "host": "h", "process_index": 0,
+        "process_count": 1, "tiles_total": 0, "tiles_todo": 0,
+        "tiles_skipped_resume": 0, "mesh_devices": 1, "impl": "xla",
+    }
+
+    def stream(*recs) -> str:
+        p = tmp_path / f"s{len(list(tmp_path.iterdir()))}.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in (head, *recs)) + "\n")
+        return str(p)
+
+    ok_slo = {
+        "ev": "job_slo", "t_wall": 2.0, "t_mono": 2.0, "job_id": "j",
+        "tenant": "t", "queue_wait_s": 1.0, "exec_s": 2.0,
+        "latency_s": 3.0, "met": True,
+    }
+    assert lint_main([stream(ok_slo)]) == 0
+    # the split must fit inside the end-to-end latency
+    bad_split = {**ok_slo, "latency_s": 2.0}
+    assert lint_main([stream(bad_split)]) == 1
+    # negative durations are producer bugs
+    assert lint_main([stream({**ok_slo, "queue_wait_s": -1.0})]) == 1
+
+    ok_sample = {
+        "ev": "flight_sample", "t_wall": 2.0, "t_mono": 2.0,
+        "rss_bytes": 10, "open_fds": 3, "threads": 2,
+    }
+    assert lint_main([stream(ok_sample)]) == 0
+    assert lint_main([stream({**ok_sample, "rss_bytes": -5})]) == 1
+    assert lint_main([stream({**ok_sample, "queue_depth": -1})]) == 1
+
+    ok_prof = {
+        "ev": "profile_captured", "t_wall": 2.0, "t_mono": 2.0,
+        "ok": True, "duration_s": 0.5, "path": "/p", "bytes": 10,
+    }
+    assert lint_main([stream(ok_prof)]) == 0
+    assert lint_main([stream({**ok_prof, "bytes": -1})]) == 1
+
+
+def test_burn_rate_window_survives_ring_flood(tmp_path):
+    """lt_slo_burn_rate is a fraction of the last N terminal JOBS — a
+    busy job flooding the flight ring with tile events must not shrink
+    the burn denominator to just the job that ended last."""
+    from types import SimpleNamespace
+
+    from land_trendr_tpu.serve.server import _ServeTelemetry
+
+    tel = _ServeTelemetry(
+        ServeConfig(
+            workdir=str(tmp_path / "srv"),
+            flight_ring_events=16,  # tiny ring, easy to flood
+            sampler_interval_s=60.0,
+        )
+    )
+    try:
+        def job(i):
+            return SimpleNamespace(
+                job_id=f"j{i}", request=SimpleNamespace(tenant="default")
+            )
+
+        def slo(met, deadline=True):
+            out = {
+                "queue_wait_s": 0.0, "exec_s": 0.1, "latency_s": 0.1,
+                "met": met,
+            }
+            if deadline:
+                out["deadline_s"] = 0.05 if not met else 60.0
+            return out
+
+        tel.job_slo(job(0), slo(False))
+        tel.job_slo(job(1), slo(True))
+        tel.job_slo(job(2), slo(True))
+        # flood: one busy job's traffic evicts every job_slo record
+        # from the 16-slot ring
+        for _ in range(64):
+            tel.events.emit(
+                "flight_sample", rss_bytes=1, open_fds=1, threads=1
+            )
+        assert not any(
+            r.get("ev") == "job_slo" for r in tel.flight.snapshot()
+        )
+        tel.job_slo(job(3), slo(True))
+        assert tel._slo_burn.value == pytest.approx(1 / 4)
+        # deadline-scoped: a flood of no-deadline jobs (met by
+        # definition) must not dilute the burn window
+        for i in range(4, 20):
+            tel.job_slo(job(i), slo(True, deadline=False))
+        assert tel._slo_burn.value == pytest.approx(1 / 4)
+    finally:
+        tel.close("done", 0.0, {})
+
+
+def test_store_bytes_probe(monkeypatch):
+    """flight_sample's store_bytes gauge: attached-store occupancy, or
+    absent (not 0, not an error) without a store."""
+    from land_trendr_tpu.io import blockcache
+
+    class FakeStore:
+        def stats_snapshot(self):
+            return {"bytes": 123}
+
+    monkeypatch.setattr(blockcache, "_store", FakeStore())
+    assert blockcache.store_bytes_snapshot() == 123
+    monkeypatch.setattr(blockcache, "_store", None)
+    assert blockcache.store_bytes_snapshot() is None

@@ -292,6 +292,13 @@ def test_admission_rejections(stack_dir, tmp_path):
         assert st == 400 and body["error"] == "bad_request"
         st, h = _get(server.port, "/healthz")
         assert st == 200 and h["queue_depth"] == 2
+        # load-balancer-grade facts ride /healthz directly — no
+        # Prometheus scrape/parse needed for an LB check
+        assert h["ok"] is True
+        assert h["running"] is None  # dispatcher not started yet
+        assert h["jobs_total"] == 2
+        assert isinstance(h["warm_program_count"], int)
+        assert h["uptime_s"] >= 0
     finally:
         server.stop()
         server.serve_forever()  # immediate drain-free shutdown

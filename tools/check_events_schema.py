@@ -78,6 +78,16 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     "job_done": ("wall_s", "tiles_quarantined"),
     "job_rejected": ("queue_depth",),
     "program_cache": ("hits", "misses", "compile_s", "keys"),
+    # flight recorder / live debug surface: resource samples, capture
+    # verdicts and SLO accounting are gauges/durations — never negative
+    "flight_sample": (
+        "rss_bytes", "open_fds", "threads", "feed_backlog",
+        "write_backlog", "fetch_backlog", "upload_backlog", "queue_depth",
+        "running", "jobs_total", "warm_program_count", "cache_bytes",
+        "store_bytes", "device_bytes_in_use",
+    ),
+    "profile_captured": ("duration_s", "bytes"),
+    "job_slo": ("queue_wait_s", "exec_s", "latency_s", "deadline_s"),
 }
 
 
@@ -188,11 +198,40 @@ def upload_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+#: slack for the SLO split cross-check: queue_wait_s + exec_s and
+#: latency_s are rounded independently to 6 dp at the producer
+_SLO_SPLIT_SLACK_S = 5e-3
+
+
+def job_slo_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for one ``job_slo`` record: the split must ADD
+    UP — ``queue_wait_s + exec_s`` cannot exceed ``latency_s`` beyond
+    rounding slack (the three come from the same two timestamps; a
+    larger gap means a broken split).  Non-negativity rides the generic
+    loop — only the cross-field check lives here."""
+    if not isinstance(rec, dict) or rec.get("ev") != "job_slo":
+        return []
+    errs = []
+    qw, ex, lat = (
+        rec.get("queue_wait_s"), rec.get("exec_s"), rec.get("latency_s")
+    )
+    if (
+        _num(qw) and _num(ex) and _num(lat)
+        and qw + ex > lat + _SLO_SPLIT_SLACK_S
+    ):
+        errs.append(
+            f"line {lineno}: job_slo: queue_wait_s {qw} + exec_s {ex} "
+            f"exceeds latency_s {lat} (the split must fit inside the "
+            "end-to-end latency)"
+        )
+    return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
-    (the robustness events, the ingest-store rollup, run_done's
-    quarantine count) — one loop over the same exported table the
-    dedicated lints share."""
+    (the robustness events, the ingest-store rollup, the flight-sampler
+    gauges, run_done's quarantine count) — one loop over the same
+    exported table the dedicated lints share."""
     if not isinstance(rec, dict):
         return []
     ev = rec.get("ev")
@@ -215,6 +254,7 @@ def value_lints():
             feed_cache_value_errors(rec, lineno)
             + fetch_lint(rec, lineno)
             + upload_value_errors(rec, lineno)
+            + job_slo_value_errors(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )
 

@@ -41,6 +41,7 @@ import json
 import shutil
 import sys
 import tempfile
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -336,6 +337,89 @@ def soak(
         if verbose:
             print(f"  ok: serve/submit_reject_and_sibling_quarantine "
                   f"({schedule})")
+
+        # debug-surface soak: with a hang fault wedging the dispatcher,
+        # /debug/stacks must still answer (and show the wedged frame),
+        # and a debug.profile fault must fail the CAPTURE
+        # (profile_captured ok=false) — never the job or the server
+        import threading as _threading
+        import urllib.request as _request
+
+        schedule2 = "seed=2,dispatch@0*2=hang:1.0,debug.profile@0"
+        server2 = SegmentationServer(
+            ServeConfig(
+                workdir=str(root / "serve_dbg"),
+                max_jobs=1,
+                feed_cache_mb=64,
+                sampler_interval_s=0.2,
+                fault_schedule=schedule2,
+            )
+        )
+        c = server2.submit(dict(job))
+        t = _threading.Thread(target=server2.serve_forever)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{server2.port}"
+
+            def _get(path: str):
+                with _request.urlopen(base + path, timeout=30) as r:
+                    return json.loads(r.read())
+
+            deadline = time.monotonic() + 60
+            wedged = False
+            while time.monotonic() < deadline and not wedged:
+                stacks = _get("/debug/stacks")["threads"]
+                wedged = any(
+                    any("_hang" in line for line in frames)
+                    for frames in stacks.values()
+                )
+                if not wedged:
+                    time.sleep(0.05)
+            if not wedged:
+                raise AssertionError(
+                    "/debug/stacks never showed the dispatcher wedged in "
+                    "the armed hang fault"
+                )
+            req = _request.Request(
+                base + "/debug/profile",
+                data=b'{"duration_s": 0.1}',
+                method="POST",
+            )
+            with _request.urlopen(req, timeout=60) as r:
+                prof = json.loads(r.read())
+            if prof["ok"] is not False:
+                raise AssertionError(
+                    "debug.profile@0 did not fail the capture — the seam "
+                    "no longer fires there"
+                )
+        finally:
+            t.join(timeout=600)
+        sc = server2.job_status(c["job_id"])
+        if sc["state"] != "done":
+            raise AssertionError(
+                f"job beside the failed capture: expected done, got "
+                f"{sc['state']} ({sc.get('error')})"
+            )
+        if _digest_workdir(sc["workdir"]) != clean:
+            raise AssertionError(
+                "debug-soak job artifacts differ from the clean run"
+            )
+        report["cases"].append(
+            {
+                "track": "serve",
+                "case": "debug_stacks_under_hang_and_profile_fault",
+                "schedule": schedule2,
+                "stacks_responsive_while_wedged": True,
+                "profile_fault_ok_false": True,
+                "job": sc["state"],
+                "artifacts_identical": True,
+            }
+        )
+        if verbose:
+            print(
+                f"  ok: serve/debug_stacks_under_hang_and_profile_fault "
+                f"({schedule2})"
+            )
 
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)

@@ -1,0 +1,221 @@
+"""Flight-recorder overhead proof: ring+sampler on vs off on the smoke scene.
+
+The ``OBS_OVERHEAD_r06`` methodology (telemetry on vs off, alternating
+reps, median of the wall times) extended to the flight recorder: BOTH
+sides run with telemetry on; the "on" side additionally mirrors every
+emit into the flight ring and runs the resource sampler at an
+aggressive period (far faster than the production default, so the
+sampler actually fires many times inside a short smoke run).  The claim
+under test is the tentpole's "lock-light" promise: mirroring an emit is
+a deque append, sampling is a /proc read every interval — the run's
+wall time must stay within the container's run-to-run noise band.
+
+Structural checks ride along (the perf-gate legs that cannot be noisy):
+the on-runs' ``flight.jsonl`` dump exists, passes the schema lint, and
+carries ``flight_sample`` events.
+
+Committed artifact: ``FLIGHT_r12.json`` (full mode, 5 alternating
+reps).  ``--smoke`` (2 reps) is the ``tools/perf_gate.py`` leg.
+
+Usage:
+    python tools/flight_overhead.py --out FLIGHT_r12.json
+    python tools/flight_overhead.py --smoke --out /tmp/flight_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+#: the documented noise band the perf gate enforces: a 2-core CI
+#: container's run-to-run wall noise dwarfs the ring's actual cost
+#: (measured negative-to-low-single-digit %), so the bound is about
+#: catching a REAL regression (an accidental lock, an O(n) ring scan
+#: per emit), not about resolving the sub-noise true cost
+NOISE_BAND_PCT = 10.0
+
+
+def run_bench(smoke: bool, out_path: "str | None") -> dict:
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+    from land_trendr_tpu.ops.indices import required_bands
+    from land_trendr_tpu.runtime import RunConfig, load_stack_dir, run_stack
+
+    sys.path.insert(0, str(REPO / "tools"))
+    from check_events_schema import value_lints
+
+    from land_trendr_tpu.obs.events import validate_events_file
+
+    # smoke keeps the rep count minimal: the gate compares MIN-of-reps
+    # (a floor estimator — jitter only inflates), so two alternating
+    # pairs already bound a real regression while keeping the tier-1
+    # wall cost down; full mode's 5 reps feed the committed medians
+    reps = 2 if smoke else 5
+    # the 4-tile scene is the FLOOR of meaningful run length: shorter
+    # runs (tried at 2 tiles) put the per-run wall inside the host's
+    # GC/page-cache noise and the gate false-fails — ~2s runs keep the
+    # fixed noise under a few percent of wall
+    height = 256
+    sampler_interval_s = 0.2
+    ring_events = 256
+    root = tempfile.mkdtemp(prefix="lt_flight_overhead_")
+    try:
+        stack_dir = os.path.join(root, "stack")
+        write_stack(
+            stack_dir,
+            make_stack(
+                SceneSpec(width=256, height=height, year_start=1990,
+                          year_end=2013, seed=7)
+            ),
+        )
+        stack = load_stack_dir(stack_dir, bands=required_bands("nbr", ()))
+
+        def one_run(tag: str, flight: bool) -> tuple[float, dict]:
+            import gc
+
+            # drain collector garbage BEFORE the timed region: inside
+            # the perf gate this bench runs after four others in one
+            # process, and an unlucky GC pause landing in an on-rep
+            # reads as flight overhead
+            gc.collect()
+            wd = os.path.join(root, tag)
+            cfg = RunConfig(
+                params=LTParams(max_segments=4),
+                tile_size=128,
+                workdir=wd,
+                out_dir=wd + "_o",
+                telemetry=True,
+                flight=flight,
+                flight_ring_events=ring_events,
+                sampler_interval_s=sampler_interval_s,
+            )
+            t0 = time.perf_counter()
+            summary = run_stack(stack, cfg)
+            return time.perf_counter() - t0, summary
+
+        one_run("warmup", flight=False)  # compile outside the medians
+
+        off_s: list[float] = []
+        on_s: list[float] = []
+        flight_checks: dict = {}
+        for rep in range(reps):
+            dt_off, _ = one_run(f"off{rep}", flight=False)
+            off_s.append(round(dt_off, 3))
+            dt_on, summary = one_run(f"on{rep}", flight=True)
+            on_s.append(round(dt_on, 3))
+            dump = summary.get("telemetry", {}).get("flight")
+            if rep == reps - 1:
+                # structural: the dump exists, lints clean, and carries
+                # the sampler series (checked once — every on-run is the
+                # same code path)
+                errs = (
+                    validate_events_file(dump, extra=value_lints())
+                    if dump and os.path.exists(dump)
+                    else ["flight dump missing"]
+                )
+                samples = 0
+                events = 0
+                if not errs:
+                    with open(dump) as f:
+                        for line in f:
+                            events += 1
+                            if '"ev":"flight_sample"' in line:
+                                samples += 1
+                flight_checks = {
+                    "dump_valid": not errs,
+                    "dump_errors": errs[:5],
+                    "dump_events": events,
+                    "samples": samples,
+                }
+
+        med_off = statistics.median(off_s)
+        med_on = statistics.median(on_s)
+        overhead_pct = round(100.0 * (med_on - med_off) / med_off, 2)
+        # the GATE metric: min-of-reps.  Scheduler/thermal interference
+        # only ever ADDS wall time, so the minima are the noise-robust
+        # cost floors — a real regression (a lock on the emit path, an
+        # O(n) ring scan) inflates the floor itself, while a CI
+        # container's jitter cannot push min_on above min_off by more
+        # than the true cost
+        min_off, min_on = min(off_s), min(on_s)
+        overhead_min_pct = round(100.0 * (min_on - min_off) / min_off, 2)
+        result = {
+            "what": (
+                f"flight recorder + sampler wall overhead: run_stack over "
+                f"a 256x{height} synthetic scene ({height // 64} tiles of "
+                "128², CPU backend, warm compile), telemetry ON both "
+                "sides, flight ring+sampler on vs off, sampler at "
+                f"{sampler_interval_s}s (25x the production default "
+                f"rate), median of {reps} alternating reps"
+            ),
+            "scene_px": 256 * height,
+            "tiles": height // 64,
+            "reps": reps,
+            "sampler_interval_s": sampler_interval_s,
+            "flight_ring_events": ring_events,
+            "off_s": off_s,
+            "on_s": on_s,
+            "median_off_s": round(med_off, 3),
+            "median_on_s": round(med_on, 3),
+            "overhead_pct": overhead_pct,
+            "min_off_s": round(min_off, 3),
+            "min_on_s": round(min_on, 3),
+            "overhead_min_pct": overhead_min_pct,
+            "noise_band_pct": NOISE_BAND_PCT,
+            "flight": flight_checks,
+            "smoke": smoke,
+            "note": (
+                "acceptance bound: overhead_min_pct <= noise_band_pct — "
+                "min-of-reps, because container jitter only inflates wall "
+                "time while a real regression inflates the floor itself "
+                "(ring mirror is a deque append per emit; sampler is a "
+                "/proc read per interval).  The median overhead is the "
+                "OBS_OVERHEAD_r06-comparable headline.  The dump must "
+                "additionally be schema-valid with a non-empty "
+                "flight_sample series"
+            ),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="2 reps (the perf-gate leg) instead of 5")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the result JSON here")
+    args = ap.parse_args(argv)
+    result = run_bench(args.smoke, args.out)
+    print(json.dumps(
+        {k: result[k] for k in (
+            "median_off_s", "median_on_s", "overhead_pct",
+            "min_off_s", "min_on_s", "overhead_min_pct",
+            "noise_band_pct", "flight",
+        )},
+        indent=2,
+    ))
+    ok = (
+        result["overhead_min_pct"] <= result["noise_band_pct"]
+        and result["flight"].get("dump_valid")
+        and result["flight"].get("samples", 0) >= 1
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,209 @@
+"""`lt top` — curses-free terminal status view for a running server.
+
+Polls a live ``lt serve`` process's HTTP surface — ``/healthz`` (queue /
+uptime / warm-program facts), ``/debug/jobs`` (per-job live state incl.
+the running job's pipeline progress) and ``/metrics`` (the ``lt_serve_*``
+and ``lt_slo_*`` instruments) — and renders a one-screen status view,
+refreshed in place with plain ANSI (no curses, so it works in any dumb
+terminal, a CI log, or piped to a file).  This is how a gigapixel
+service run is *watchable* the way README promises runs are inspectable
+in flight.
+
+Modes:
+
+* default — refresh every ``--interval`` seconds until Ctrl-C;
+* ``--once`` — print one snapshot and exit (tests / CI / cron);
+* ``--json`` — emit the merged raw snapshot as JSON instead of the
+  rendered view (scripting; implies one-shot).
+
+Exit codes: 0 ok, 2 connection/usage error (the server is down or the
+debug surface is disabled).
+
+Usage:
+    python tools/lt_top.py --port 8800            # live view
+    python tools/lt_top.py --port 8800 --once     # one snapshot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _get_json(base: str, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(base: str, path: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def parse_prom(text: str) -> list:
+    """Prometheus text exposition → ``(name, labels dict, value)`` rows
+    (enough of the 0.0.4 format for our own exporter's output)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            continue
+        labels: dict = {}
+        name = name_part
+        if "{" in name_part and name_part.endswith("}"):
+            name, _, raw = name_part.partition("{")
+            for item in raw[:-1].split('","'):
+                if "=" in item:
+                    k, _, v = item.partition("=")
+                    labels[k] = v.strip('"')
+        try:
+            value = float(value_part)
+        except ValueError:
+            continue
+        out.append((name, labels, value))
+    return out
+
+
+def _metric(rows: list, name: str, default: float = 0.0) -> float:
+    for n, _, v in rows:
+        if n == name:
+            return v
+    return default
+
+
+def snapshot(base: str) -> dict:
+    """One merged poll of the three endpoints (metrics/debug optional —
+    a --no-telemetry or --no-debug-endpoints server still tops)."""
+    snap: dict = {"healthz": _get_json(base, "/healthz")}
+    try:
+        snap["metrics"] = parse_prom(_get_text(base, "/metrics"))
+    except urllib.error.HTTPError:
+        snap["metrics"] = []
+    try:
+        snap["jobs"] = _get_json(base, "/debug/jobs")["jobs"]
+    except urllib.error.HTTPError:
+        # debug surface off: fall back to the plain jobs listing
+        snap["jobs"] = _get_json(base, "/jobs")["jobs"]
+    return snap
+
+
+def _fmt_age(secs: float) -> str:
+    if secs < 90:
+        return f"{secs:.0f}s"
+    if secs < 5400:
+        return f"{secs / 60:.1f}m"
+    return f"{secs / 3600:.1f}h"
+
+
+def render(snap: dict) -> str:
+    """The one-screen view (a plain string — the caller owns the
+    terminal)."""
+    h = snap["healthz"]
+    rows = snap["metrics"]
+    now = time.time()
+    lines = []
+    lines.append(
+        f"lt top — uptime {_fmt_age(h.get('uptime_s', 0))}   "
+        f"queue {h.get('queue_depth', '?')}   "
+        f"running {h.get('running') or '-'}   "
+        f"terminal {h.get('jobs_terminal', '?')}/{h.get('jobs_total', '?')}"
+        f"   warm programs {h.get('warm_program_count', '?')}"
+    )
+    met = _metric(rows, "lt_slo_met_total")
+    missed = _metric(rows, "lt_slo_missed_total")
+    burn = _metric(rows, "lt_slo_burn_rate")
+    rej = _metric(rows, "lt_serve_rejections_total")
+    if rows:
+        lines.append(
+            f"slo: met {met:.0f}  missed {missed:.0f}  "
+            f"burn {burn:.2f}   rejections {rej:.0f}   "
+            f"warm-hit {_metric(rows, 'lt_serve_warm_hit_ratio'):.2f}"
+        )
+    lines.append("")
+    lines.append(
+        f"{'JOB':<22} {'STATE':<18} {'TENANT':<10} {'PRI':>3} "
+        f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'BKLG f/w/x/u':>12} "
+        f"{'AGE':>6}"
+    )
+    for job in snap["jobs"]:
+        p = job.get("progress") or {}
+        tiles = (
+            f"{p.get('tiles_done', '-')}/{p.get('tiles_total', '-')}"
+            if p else "-"
+        )
+        backlog = (
+            "/".join(
+                str(p.get(k, 0))
+                for k in (
+                    "feed_backlog", "write_backlog", "fetch_backlog",
+                    "upload_backlog",
+                )
+            )
+            if p else "-"
+        )
+        state = job.get("state", "?")
+        if job.get("deadline_exceeded"):
+            state += "!SLO"
+        age = now - job.get("submitted_t", now)
+        lines.append(
+            f"{job.get('job_id', '?'):<22} {state:<18} "
+            f"{job.get('tenant', '?'):<10} {job.get('priority', 0):>3} "
+            f"{p.get('phase', '-'):<9} {tiles:>9} "
+            f"{p.get('retries', '-') if p else '-':>5} {backlog:>12} "
+            f"{_fmt_age(age):>6}"
+        )
+    if not snap["jobs"]:
+        lines.append("(no jobs)")
+    return "\n".join(lines)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--port", type=int, required=True,
+                    help="the server's job-API port (from the startup line)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="the server's job-API host (loopback)")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="SEC",
+                    help="refresh period for the live view")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (tests / CI)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw merged snapshot as JSON (one-shot)")
+    args = ap.parse_args(argv)
+    base = f"http://{args.host}:{args.port}"
+
+    try:
+        if args.json:
+            snap = snapshot(base)
+            snap["metrics"] = [
+                {"name": n, "labels": l, "value": v}
+                for n, l, v in snap["metrics"]
+            ]
+            print(json.dumps(snap, indent=2, default=str))
+            return 0
+        if args.once:
+            print(render(snapshot(base)))
+            return 0
+        while True:
+            view = render(snapshot(base))
+            sys.stdout.write(_CLEAR + view + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot poll {base}: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,14 +10,20 @@ per-process files) and it emits
   with a derived hit rate), the fetch and upload rollups (transfers/bytes
   and the pack/wait/unpack split, with derived ``transfers_per_tile`` and
   ``effective_gb_per_s`` — wire bytes over blocking wait seconds), the
-  ingest-store rollup (store hits/puts with a derived hit rate), and
-  per-host rollups — schema lint and fold run in a SINGLE pass per file
+  ingest-store rollup (store hits/puts with a derived hit rate), the
+  per-tenant SLO rollup (p50/p95/p99 queue-wait and exec latency,
+  deadline hit-rate — from ``job_slo`` events), the resource high-water
+  section (RSS / fd / thread / backlog watermarks from the flight
+  sampler's ``flight_sample`` series), and per-host rollups — schema
+  lint and fold run in a SINGLE pass per file
   (``fold(paths, schema_errors=...)``);
 * with ``--trace OUT.json``, a **Chrome trace-event file** (the
   ``chrome://tracing`` / Perfetto JSON array format): per-tile device-wait
-  and artifact-write slices, retry instants, and backlog counter tracks,
-  one trace "process" per event file — so the driver's host-side phases
-  line up next to the device traces ``utils/profiling.trace`` captures.
+  and artifact-write slices, retry instants, backlog counter tracks, and
+  the flight sampler's counter tracks (``resources``: RSS/threads/fds;
+  ``sampler_backlog``: pipeline backlogs + queue depth), one trace
+  "process" per event file — so the driver's host-side phases line up
+  next to the device traces ``utils/profiling.trace`` captures.
 
 Timeline construction: every event carries wall + monotonic clocks; each
 run scope (a ``run_start`` and what follows it) anchors its monotonic
@@ -61,6 +67,7 @@ def _stats(values: list[float]) -> dict | None:
         "p50": round(q(0.50), 6),
         "mean": round(sum(v) / len(v), 6),
         "p95": round(q(0.95), 6),
+        "p99": round(q(0.99), 6),
         "max": round(v[-1], 6),
     }
 
@@ -85,7 +92,49 @@ def _fresh_scope() -> dict:
         "stalls": 0, "stage_s": {}, "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
+        "slo": None, "resources": None,
     }
+
+
+def _slo_scope(cur: dict) -> dict:
+    """The lazily-created per-tenant SLO sub-aggregate of one scope
+    (fed by ``job_slo`` events — the serve layer's accounting stream)."""
+    if cur["slo"] is None:
+        cur["slo"] = {}
+    return cur["slo"]
+
+
+def _slo_tenant(slo: dict, tenant: str) -> dict:
+    t = slo.get(tenant)
+    if t is None:
+        t = slo[tenant] = {
+            "queue_wait_s": [], "exec_s": [], "met": 0, "missed": 0,
+            "with_deadline": 0,
+        }
+    return t
+
+
+#: flight_sample gauges folded into the resource high-water section,
+#: name → report key (each merges as a maximum — watermarks)
+_RESOURCE_HIGHWATER = {
+    "rss_bytes": "rss_bytes_max",
+    "open_fds": "open_fds_max",
+    "threads": "threads_max",
+    "feed_backlog": "feed_backlog_max",
+    "write_backlog": "write_backlog_max",
+    "fetch_backlog": "fetch_backlog_max",
+    "upload_backlog": "upload_backlog_max",
+    "queue_depth": "queue_depth_max",
+    "cache_bytes": "cache_bytes_max",
+    "store_bytes": "store_bytes_max",
+    "device_bytes_in_use": "device_bytes_max",
+}
+
+
+def _resources_scope(cur: dict) -> dict:
+    if cur["resources"] is None:
+        cur["resources"] = {"samples": 0}
+    return cur["resources"]
 
 
 def _serve_scope(cur: dict) -> dict:
@@ -118,6 +167,79 @@ def _merge_serve(folded: list[dict]) -> "dict | None":
         "queue_wait_s": _stats([v for s in seen for v in s["wait_s"]]),
         "job_s": _stats([v for s in seen for v in s["job_s"]]),
     }
+
+
+def _merge_slo(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the per-tenant SLO aggregates (None when no
+    file's last scope carried a ``job_slo``): per-tenant p50/p95/p99
+    queue-wait and exec latency plus the deadline hit-rate (over jobs
+    that SET a deadline; jobs without one count as met overall)."""
+    seen = [c["slo"] for c in folded if c["slo"] is not None]
+    if not seen:
+        return None
+    by_tenant: dict = {}
+    for s in seen:
+        for tenant, t in s.items():
+            agg = by_tenant.setdefault(
+                tenant,
+                {"queue_wait_s": [], "exec_s": [], "met": 0, "missed": 0,
+                 "with_deadline": 0},
+            )
+            agg["queue_wait_s"].extend(t["queue_wait_s"])
+            agg["exec_s"].extend(t["exec_s"])
+            for k in ("met", "missed", "with_deadline"):
+                agg[k] += t[k]
+    out: dict = {"by_tenant": {}}
+    tot_met = tot_missed = tot_deadline = 0
+    for tenant in sorted(by_tenant):
+        t = by_tenant[tenant]
+        tot_met += t["met"]
+        tot_missed += t["missed"]
+        tot_deadline += t["with_deadline"]
+        out["by_tenant"][tenant] = {
+            "jobs": t["met"] + t["missed"],
+            "queue_wait_s": _stats(t["queue_wait_s"]),
+            "exec_s": _stats(t["exec_s"]),
+            # deadline-scoped: ``met`` on a no-deadline job is true by
+            # definition, so the hit rate divides over jobs that HAD a
+            # deadline (a miss implies one) — 99 no-deadline jobs must
+            # not dilute one missed deadline into a 0.99 hit rate
+            "deadline": {
+                "with_deadline": t["with_deadline"],
+                "met": t["with_deadline"] - t["missed"],
+                "missed": t["missed"],
+                "hit_rate": (
+                    round(
+                        (t["with_deadline"] - t["missed"])
+                        / t["with_deadline"],
+                        4,
+                    )
+                    if t["with_deadline"] else None
+                ),
+            },
+        }
+    out["jobs"] = tot_met + tot_missed
+    out["missed"] = tot_missed
+    out["hit_rate"] = (
+        round((tot_deadline - tot_missed) / tot_deadline, 4)
+        if tot_deadline else None
+    )
+    return out
+
+
+def _merge_resources(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the flight-sampler high-water sections (None
+    when no file's last scope carried a ``flight_sample``): every gauge
+    merges as a maximum — the resource watermark the run actually hit."""
+    seen = [c["resources"] for c in folded if c["resources"] is not None]
+    if not seen:
+        return None
+    out: dict = {"samples": sum(s["samples"] for s in seen)}
+    for key in _RESOURCE_HIGHWATER.values():
+        vals = [s[key] for s in seen if key in s]
+        if vals:
+            out[key] = max(vals)
+    return out
 
 
 def _merge_program_cache(folded: list[dict]) -> "dict | None":
@@ -495,6 +617,90 @@ def fold(
                                 "error": rec.get("error"),
                             },
                         })
+                    elif ev == "job_slo":
+                        # every field read FIRST: a torn/foreign record
+                        # raising mid-branch must not leave itself
+                        # half-folded AND counted malformed
+                        tenant, qw, ex = (
+                            rec["tenant"], rec["queue_wait_s"], rec["exec_s"]
+                        )
+                        met, slo_job = rec["met"], rec["job_id"]
+                        t = _slo_tenant(_slo_scope(cur), tenant)
+                        t["queue_wait_s"].append(qw)
+                        t["exec_s"].append(ex)
+                        t["met" if met else "missed"] += 1
+                        if "deadline_s" in rec:
+                            t["with_deadline"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": (
+                                f"SLO {'met' if met else 'MISSED'} "
+                                f"{slo_job}"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "tenant": tenant, "queue_wait_s": qw,
+                                "exec_s": ex,
+                                "deadline_s": rec.get("deadline_s"),
+                            },
+                        })
+                    elif ev == "flight_sample":
+                        # required vitals read FIRST (see job_slo): a
+                        # record missing one must not bump the sample
+                        # count or the watermarks before it raises
+                        rss, thr, fds = (
+                            rec["rss_bytes"], rec["threads"],
+                            rec["open_fds"],
+                        )
+                        res = _resources_scope(cur)
+                        res["samples"] += 1
+                        for name, key in _RESOURCE_HIGHWATER.items():
+                            v = rec.get(name)
+                            if isinstance(v, (int, float)) and not isinstance(
+                                v, bool
+                            ):
+                                res[key] = max(res.get(key, 0), v)
+                        # counter tracks for the sampler series: process
+                        # vitals on one track, pipeline backlogs on another
+                        spans.append({
+                            "kind": "counter", "file": fileno, "t0": tw,
+                            "name": "resources",
+                            "args": {
+                                "rss_mb": round(rss / 1e6, 1),
+                                "threads": thr,
+                                "open_fds": fds,
+                            },
+                        })
+                        backlogs = {
+                            k: rec[k]
+                            for k in (
+                                "feed_backlog", "write_backlog",
+                                "fetch_backlog", "upload_backlog",
+                                "queue_depth",
+                            )
+                            if k in rec
+                        }
+                        if backlogs:
+                            spans.append({
+                                "kind": "counter", "file": fileno,
+                                "t0": tw, "name": "sampler_backlog",
+                                "args": backlogs,
+                            })
+                    elif ev == "profile_captured":
+                        spans.append({
+                            "kind": "instant", "file": fileno,
+                            "tid": "jobs",
+                            "name": (
+                                "profile captured" if rec["ok"]
+                                else "profile FAILED"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "path": rec.get("path"),
+                                "duration_s": rec.get("duration_s"),
+                                "error": rec.get("error"),
+                            },
+                        })
                     elif ev == "program_cache":
                         # warm-cache verdict: one per job run scope (and a
                         # server-scope aggregate); last wins per scope
@@ -552,6 +758,8 @@ def fold(
         "ingest_store": _merge_ingest_store(folded),
         "serve": _merge_serve(folded),
         "program_cache": _merge_program_cache(folded),
+        "slo": _merge_slo(folded),
+        "resources": _merge_resources(folded),
         "hosts": hosts,
     }
     return report, spans

@@ -41,6 +41,7 @@ FEED_BASELINE = REPO / "FEED_r07.json"
 FETCH_BASELINE = REPO / "FETCH_r08.json"
 UPLOAD_BASELINE = REPO / "UPLOAD_r10.json"
 SERVE_BASELINE = REPO / "SERVE_r11.json"
+FLIGHT_BASELINE = REPO / "FLIGHT_r12.json"
 
 #: a smoke ratio must reach this fraction of its committed value — loose
 #: enough for a 2-core container's noise, tight enough that a regression
@@ -57,9 +58,10 @@ def _hit_rate(stats: dict) -> float | None:
 
 
 def run_gate(workdir: str, checks: list) -> None:
-    """Run the four bench smokes and append (name, ok, detail) rows."""
+    """Run the five bench smokes and append (name, ok, detail) rows."""
     import feed_bench
     import fetch_bench
+    import flight_overhead
     import serve_bench
     import upload_bench
 
@@ -187,6 +189,40 @@ def run_gate(workdir: str, checks: list) -> None:
             f"{band:.2f} (committed {base['speedup_warm']})",
         )
 
+    # -- flight recorder (ring + sampler overhead) ------------------------
+    base = json.loads(FLIGHT_BASELINE.read_text())
+    out = str(Path(workdir) / "flight_smoke.json")
+    try:
+        got = flight_overhead.run_bench(smoke=True, out_path=out)
+    except Exception as e:
+        check("flight.ran", False, f"flight_overhead smoke raised: {e}")
+    else:
+        # structural, exact: the on-run's ring dump is a schema-valid
+        # events slice and the sampler series is non-empty
+        fl = got.get("flight", {})
+        check(
+            "flight.dump_valid",
+            fl.get("dump_valid") is True,
+            f"flight.jsonl schema-valid ({fl.get('dump_errors')})",
+        )
+        check(
+            "flight.sampler_fired",
+            fl.get("samples", 0) >= 1,
+            f"{fl.get('samples', 0)} flight_sample events in the dump",
+        )
+        # the documented noise band from the committed artifact, checked
+        # on the MIN-of-reps overhead (container jitter only inflates
+        # wall time; a real regression — a lock on the emit path, an
+        # O(n) ring scan — inflates the cost floor itself)
+        band = float(base["noise_band_pct"])
+        check(
+            "flight.overhead",
+            got["overhead_min_pct"] <= band,
+            f"smoke min-rep overhead {got['overhead_min_pct']}% (median "
+            f"{got['overhead_pct']}%) vs documented noise band {band}% "
+            f"(committed {base['overhead_min_pct']}%)",
+        )
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -196,7 +232,8 @@ def main(argv: list[str] | None = None) -> int:
                     help="keep the smoke artifacts under DIR")
     args = ap.parse_args(argv)
 
-    for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE, SERVE_BASELINE):
+    for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE,
+              SERVE_BASELINE, FLIGHT_BASELINE):
         if not p.exists():
             print(f"error: committed baseline {p.name} missing", file=sys.stderr)
             return 2
