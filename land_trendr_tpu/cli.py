@@ -272,6 +272,17 @@ def build_parser() -> argparse.ArgumentParser:
                      help="multihost only: bound on the primary's wait for "
                      "straggler peers' run_done during the event-log merge "
                      "(default: derived from this run's wall time)")
+    seg.add_argument("--straggler-k", type=float, default=4.0, metavar="K",
+                     help="live straggler threshold: a tile in flight "
+                     "longer than K x the rolling median of recent tile "
+                     "durations emits a tile_straggler event and counts "
+                     "in lt_stragglers_total (observability only — the "
+                     "tile keeps running); must be >= 1")
+    seg.add_argument("--straggler-min-tiles", type=int, default=5,
+                     metavar="N",
+                     help="no straggler verdicts until N tiles completed "
+                     "(the first tile carries the jit compile and must "
+                     "never false-positive)")
     seg.add_argument("--fault-schedule", default=None, metavar="SPEC",
                      help="deterministic fault injection for test/soak "
                      "runs (land_trendr_tpu.runtime.faults), e.g. "
@@ -896,6 +907,8 @@ def main(argv: list[str] | None = None) -> int:
                 quarantine_tiles=args.quarantine_tiles,
                 stall_timeout_s=args.stall_timeout_s,
                 merge_timeout_s=args.merge_timeout_s,
+                straggler_k=args.straggler_k,
+                straggler_min_tiles=args.straggler_min_tiles,
                 fault_schedule=args.fault_schedule,
                 metrics_interval_s=args.metrics_interval_s,
                 impl=args.impl,

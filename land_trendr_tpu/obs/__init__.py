@@ -27,6 +27,12 @@ from land_trendr_tpu.obs.flight import (
     flight_path,
     thread_stacks,
 )
+from land_trendr_tpu.obs.spans import (
+    SPAN_STAGES,
+    StragglerDetector,
+    assemble_pod_trace,
+    critical_path,
+)
 from land_trendr_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -57,6 +63,10 @@ __all__ = [
     "MetricsHTTPServer",
     "MetricsRegistry",
     "PromFileExporter",
+    "SPAN_STAGES",
+    "StragglerDetector",
     "Telemetry",
+    "assemble_pod_trace",
+    "critical_path",
     "metrics_path",
 ]

@@ -41,6 +41,7 @@ import re
 import socket
 import threading
 import time
+import uuid
 from typing import Any, Callable, Iterator
 
 __all__ = [
@@ -85,6 +86,27 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     },
     # a tile's device program was dispatched (attempt 1) or re-dispatched
     "tile_start": {"tile_id": int, "attempt": int},
+    # one per-tile pipeline-stage span (obs/spans.py): ``start``/``end``
+    # are monotonic-clock values (the same clock as ``t_mono``), sampled
+    # at the stage boundary and emitted as ONE event at span end — so a
+    # span can never be torn across lines.  ``name`` is a stage from
+    # spans.SPAN_STAGES (the vocabulary is open: unknown names still
+    # validate, consumers group by name).  Emitted from the driver
+    # thread only, so spans always precede their scope's run_done.
+    # Additive event type, introduced without a schema bump.
+    "span": {"name": str, "tile_id": int, "start": _NUM, "end": _NUM},
+    # live straggler verdict (obs/spans.StragglerDetector): this tile's
+    # in-flight duration exceeded k x the rolling median of recent tile
+    # durations.  duration_s >= threshold_s by construction (the value
+    # lint in tools/check_events_schema.py pins it); in_flight=true
+    # means the tile was still running when flagged (sampler scan) vs
+    # flagged at completion.  Additive event type.
+    "tile_straggler": {
+        "tile_id": int,
+        "duration_s": _NUM,
+        "threshold_s": _NUM,
+        "median_s": _NUM,
+    },
     # the tile's result is ready on host (dispatch + device wait)
     "tile_done": {
         "tile_id": int,
@@ -222,6 +244,16 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
 
 #: well-known OPTIONAL fields: type-checked when present, never required
 OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
+    # run identity + the scope's clock anchor: ``run_id`` names the run
+    # scope pod-wide (correlation ID on every assembled span), and the
+    # ``(anchor_wall, anchor_mono)`` pair — sampled TOGETHER by
+    # EventLog.run_start — is what the pod-trace assembler
+    # (obs/spans.assemble_pod_trace) aligns cross-host clocks with.
+    # Optional so pre-anchor streams keep validating (consumers fall
+    # back to the record's own t_wall/t_mono).
+    "run_start": {"run_id": str, "anchor_wall": _NUM, "anchor_mono": _NUM},
+    "span": {"attempt": int},
+    "tile_straggler": {"in_flight": bool, "attempt": int},
     "tile_done": {"device_bytes_in_use": _NUM, "fetch_backlog": int},
     # no px_per_s here: the manifest meta's rate is over PADDED tile
     # pixels; tile_done's real-pixel px_per_s is the stream's one
@@ -263,6 +295,7 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "cache_bytes": int,
         "store_bytes": int,
         "device_bytes_in_use": _NUM,
+        "stragglers": int,
     },
     "profile_captured": {"error": str, "bytes": int},
     "job_slo": {"deadline_s": _NUM},
@@ -444,10 +477,33 @@ class EventLog:
         return rec
 
     def run_start(self, **fields: Any) -> dict:
-        """``run_start`` with the ambient process facts filled in."""
+        """``run_start`` with the ambient process facts filled in.
+
+        Beyond the process identity, this stamps the scope's tracing
+        correlation facts: a fresh ``run_id`` (names the scope pod-wide)
+        and the ``(anchor_wall, anchor_mono)`` clock-anchor pair,
+        sampled back to back HERE so the pair is as atomic as two clock
+        reads get — the pod-trace assembler maps every event's ``t_mono``
+        through this anchor, so pairing skew would become trace skew.
+        """
         fields.setdefault("schema", SCHEMA_VERSION)
         fields.setdefault("pid", os.getpid())
         fields.setdefault("host", socket.gethostname())
+        fields.setdefault("run_id", uuid.uuid4().hex[:12])
+        has_wall = "anchor_wall" in fields
+        has_mono = "anchor_mono" in fields
+        if has_wall != has_mono:
+            # half a pair is worse than none: pairing an explicit anchor
+            # with a clock read taken NOW would silently shift every
+            # assembled span by the gap between the two instants
+            raise ValueError(
+                "run_start needs anchor_wall and anchor_mono together "
+                "(they are one atomically-sampled pair) or neither — got "
+                f"only anchor_{'wall' if has_wall else 'mono'}"
+            )
+        if not has_wall:
+            fields["anchor_wall"] = time.time()
+            fields["anchor_mono"] = time.perf_counter()
         return self.emit("run_start", **fields)
 
     def close(self) -> None:
@@ -480,6 +536,7 @@ def run_scope_reset(rec: Any, default_process_index: "int | None" = None) -> dic
         "process_index": get("process_index", default_process_index),
         "host": get("host"),
         "pid": get("pid"),
+        "run_id": get("run_id"),
         "status": None,
         "wall_s": None,
         "px_per_s": None,
@@ -503,10 +560,12 @@ def summarize_events_file(path: str) -> dict:
         "process_index": None,
         "host": None,
         "pid": None,
+        "run_id": None,
         "tiles_done": 0,
         "tile_retries": 0,
         "tiles_failed": 0,
         "tiles_quarantined": 0,
+        "stragglers": 0,
         "pixels": 0,
         "wall_s": None,
         "px_per_s": None,
@@ -531,6 +590,7 @@ def summarize_events_file(path: str) -> dict:
                     tile_retries=0,
                     tiles_failed=0,
                     tiles_quarantined=0,
+                    stragglers=0,
                     pixels=0,
                     # the torn final line of a crashed PREVIOUS scope must
                     # not flag the healthy resumed scope as corrupt
@@ -545,6 +605,8 @@ def summarize_events_file(path: str) -> dict:
                 agg["tiles_failed"] += 1
             elif ev == "tile_quarantined":
                 agg["tiles_quarantined"] += 1
+            elif ev == "tile_straggler":
+                agg["stragglers"] += 1
             elif ev == "run_done":
                 agg["status"] = rec.get("status")
                 agg["wall_s"] = rec.get("wall_s")

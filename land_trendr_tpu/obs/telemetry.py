@@ -196,6 +196,11 @@ class Telemetry:
         self._stalls = r.counter(
             "lt_stalls_total", "stall-watchdog aborts (no tile progress)"
         )
+        self._stragglers = r.counter(
+            "lt_stragglers_total",
+            "tiles whose in-flight duration exceeded k x the rolling "
+            "median (obs/spans.StragglerDetector)",
+        )
         self._demoted = r.gauge(
             "lt_fetch_demoted",
             "1 once repeated packed-fetch failures demoted the run to the "
@@ -333,8 +338,11 @@ class Telemetry:
         return self._server.port if self._server is not None else None
 
     # -- driver hooks ------------------------------------------------------
-    def run_start(self, **fields: Any) -> None:
-        self.events.run_start(**fields)
+    def run_start(self, **fields: Any) -> dict:
+        """Open the run scope; returns the emitted record — the caller
+        reads the stamped ``run_id`` / clock-anchor pair back (the
+        driver mirrors them into the manifest for pod-trace assembly)."""
+        return self.events.run_start(**fields)
 
     def tile_start(self, tile_id: int, attempt: int = 1) -> None:
         self.events.emit("tile_start", tile_id=tile_id, attempt=attempt)
@@ -400,6 +408,54 @@ class Telemetry:
             error=str(error),
         )
         self._quarantined.inc()
+
+    def span(
+        self,
+        name: str,
+        tile_id: int,
+        start: float,
+        end: float,
+        attempt: "int | None" = None,
+    ) -> None:
+        """One per-tile stage span (``start``/``end`` on the monotonic
+        clock — the same clock as ``t_mono``, so consumers anchor them
+        through the scope's ``run_start`` anchor pair).  Emitted at span
+        END from the driver thread, so spans always precede their
+        scope's ``run_done``.  Events only — span volume would swamp a
+        counter registry; the per-stage instruments stay the run-scoped
+        ``lt_stage_seconds`` gauges."""
+        self.events.emit(
+            "span",
+            name=name,
+            tile_id=tile_id,
+            start=round(start, 6),
+            end=round(end, 6),
+            **({"attempt": attempt} if attempt is not None else {}),
+        )
+
+    def tile_straggler(
+        self,
+        tile_id: int,
+        duration_s: float,
+        threshold_s: float,
+        median_s: float,
+        in_flight: bool = False,
+        attempt: "int | None" = None,
+    ) -> None:
+        """This tile's in-flight duration exceeded the straggler
+        threshold (``k x`` rolling median — obs/spans).  May fire from
+        the flight-sampler thread (``in_flight=True``) while the driver
+        is blocked inside the straggler's own wait."""
+        self.events.emit(
+            "tile_straggler",
+            tile_id=tile_id,
+            duration_s=round(duration_s, 6),
+            threshold_s=round(threshold_s, 6),
+            median_s=round(median_s, 6),
+            in_flight=in_flight,
+            **({"attempt": attempt} if attempt is not None else {}),
+        )
+        self._stragglers.inc()
 
     def fault_injected(self, seam: str, index: int, error: str) -> None:
         """One scheduled fault fired (the runtime.faults observer hook)."""

@@ -212,6 +212,14 @@ def merge_host_event_logs(
     tell from a live one.  Files not modified since ``newer_than`` are
     never counted terminal (the timeout warning surfaces the missing
     peer) and their summaries carry ``"stale": True``.
+
+    Each summary also carries the peer's ``run_id`` and straggler count
+    (``summarize_events_file``): every host's ``run_start`` records a
+    ``(anchor_wall, anchor_mono)`` clock-anchor pair (mirrored into the
+    shared manifest as ``kind="clock_anchor"`` lines), which is what
+    ``tools/lt_trace.py`` aligns the per-host streams with — the merge
+    itself stays a pure shared-filesystem fold with no clock trust
+    beyond the existing mtime staleness guard.
     """
     import time
 

@@ -50,6 +50,7 @@ import numpy as np
 
 from land_trendr_tpu.config import LTParams
 from land_trendr_tpu.io import blockcache, native
+from land_trendr_tpu.obs.spans import StragglerDetector
 from land_trendr_tpu.io.geotiff import GeoTiffStreamWriter
 from land_trendr_tpu.ops import indices as idx
 from land_trendr_tpu.ops.change import ChangeFilter
@@ -237,6 +238,18 @@ class RunConfig:
     #: 900))``); operators who know their pod's straggler profile set it
     #: explicitly.
     merge_timeout_s: float | None = None
+    #: live straggler threshold: a tile whose in-flight duration exceeds
+    #: ``straggler_k`` x the rolling median of recent tile durations is
+    #: flagged (``tile_straggler`` event, ``lt_stragglers_total``,
+    #: ``/debug/jobs`` and ``lt top`` on serve runs).  Pure observability
+    #: — a flagged tile keeps running; the elastic scheduler (ROADMAP
+    #: item 2) is the consumer this contract is built for.  Must be
+    #: >= 1 (below the median would flag typical tiles).
+    straggler_k: float = 4.0
+    #: no straggler verdicts until this many tiles have completed in the
+    #: run — the first tile carries the jit compile and a one-sample
+    #: median is noise, so early tiles must never false-positive.
+    straggler_min_tiles: int = 5
     #: deterministic fault-injection schedule
     #: (:func:`land_trendr_tpu.runtime.faults.parse_schedule`, e.g.
     #: ``"seed=7,dispatch@1,fetch.wait@0*2=io"``) — fires scheduled
@@ -559,6 +572,17 @@ class RunConfig:
             raise ValueError(
                 f"merge_timeout_s={self.merge_timeout_s} must be > 0 "
                 "(or None for the wall-time-derived bound)"
+            )
+        if self.straggler_k < 1.0:
+            raise ValueError(
+                f"straggler_k={self.straggler_k} must be >= 1.0 (a "
+                "threshold below the rolling median would flag typical "
+                "tiles as stragglers)"
+            )
+        if self.straggler_min_tiles < 1:
+            raise ValueError(
+                f"straggler_min_tiles={self.straggler_min_tiles} must be "
+                ">= 1"
             )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam/spec is a config error at exit-2
@@ -901,11 +925,23 @@ class Run:
             "tiles_done": 0,
             "tiles_quarantined": 0,
             "retries": 0,
+            "stragglers": 0,
             "feed_backlog": 0,
             "write_backlog": 0,
             "fetch_backlog": 0,
             "upload_backlog": 0,
         }
+        #: live straggler detector (obs/spans): the driver registers
+        #: every dispatched attempt and checks completions; the flight
+        #: sampler additionally scans in-flight tiles, so a tile wedging
+        #: the driver's own wait still gets flagged.  Verdicts land in
+        #: telemetry (``tile_straggler`` + ``lt_stragglers_total``) and
+        #: this progress dict (``/debug/jobs``, ``lt top``).
+        self.straggler = StragglerDetector(
+            k=cfg.straggler_k,
+            min_tiles=cfg.straggler_min_tiles,
+            on_straggler=self._note_straggler,
+        )
         # per-run state, populated by execute(); exposed so a serving
         # layer can introspect a live or finished run
         self.manifest: "TileManifest | None" = None
@@ -920,16 +956,52 @@ class Run:
         self.program_stats: "dict | None" = None
         self.summary: "dict | None" = None
 
+    def _note_straggler(
+        self,
+        tile_id: int,
+        duration_s: float,
+        threshold_s: float,
+        median_s: float,
+        in_flight: bool,
+        attempt: int,
+    ) -> None:
+        """Detector verdict → progress + telemetry (``tile_straggler``
+        event and ``lt_stragglers_total``).  Runs on the driver thread
+        (completion checks) or the flight-sampler thread (in-flight
+        scans) — both stop before ``run_done``, so the stream's scope
+        tail stays terminal."""
+        self.progress["stragglers"] = self.straggler.stats()["stragglers"]
+        log.warning(
+            "tile %d is a straggler: in-flight %.3fs > %.3fs "
+            "(%.1fx rolling median %.3fs%s)",
+            tile_id, duration_s, threshold_s, self.cfg.straggler_k,
+            median_s, ", still running" if in_flight else "",
+        )
+        tel = self.telemetry
+        if tel is not None:
+            tel.tile_straggler(
+                tile_id, duration_s, threshold_s, median_s,
+                in_flight=in_flight, attempt=attempt,
+            )
+
     def _sampler_probes(self) -> dict:
         """Host gauges for the flight sampler's ``flight_sample`` events:
         pipeline backlogs, decode-cache occupancy, and the device
-        allocator watermark where the backend exposes one."""
+        allocator watermark where the backend exposes one.  Also the
+        liveness half of straggler detection: the sampler thread scans
+        in-flight tiles here, so a tile wedging the driver's own device
+        wait is still flagged while it runs.  Only while the run is live
+        — the phase flips to done/aborted at the top of teardown, BEFORE
+        the terminal ``run_done``, so a late sampler beat must not append
+        verdicts behind the scope's terminal event."""
+        if self.progress.get("phase") not in ("done", "aborted"):
+            self.straggler.scan()
         p = self.progress
         out = {
             k: int(p[k])
             for k in (
                 "feed_backlog", "write_backlog", "fetch_backlog",
-                "upload_backlog",
+                "upload_backlog", "stragglers",
             )
         }
         out.update(blockcache.occupancy_probe())
@@ -1197,6 +1269,9 @@ class Run:
             if not cfg.quarantine_tiles:
                 raise exc
             quarantined.append(t.tile_id)
+            # no straggler verdict for a tile that is GONE — the failure
+            # events already tell its story
+            self.straggler.drop(t.tile_id)
             self.progress["tiles_quarantined"] = len(quarantined)
             manifest.record_failed(t.tile_id, exc.attempts, str(exc.cause))
             if telemetry is not None:
@@ -1351,6 +1426,10 @@ class Run:
                 attempt = _retry_step(t, attempt, err)  # raises at exhaustion
                 if telemetry is not None:
                     telemetry.tile_start(t.tile_id, attempt=attempt)
+                # fresh attempt, fresh in-flight clock: the ladder's
+                # backoff already separates attempts, so the straggler
+                # verdict judges this attempt, not the whole ladder
+                self.straggler.start(t.tile_id, attempt)
                 t0 = time.perf_counter()
                 out, err = _dispatch(dn, qa)
                 if err is not None:
@@ -1366,10 +1445,16 @@ class Run:
                     err = e
                     continue
                 try:
+                    t0_fx = time.perf_counter()
                     with timer.stage("fetch"):
                         handle = fetcher.start(out)
                         handle.wait()
                     _note_fetch_ok()
+                    if telemetry is not None:
+                        telemetry.span(
+                            "fetch", t.tile_id, t0_fx, time.perf_counter(),
+                            attempt=attempt,
+                        )
                     return handle, dt, attempt
                 except Exception as e:  # transfer failure: counts toward
                     _note_fetch_failure()  # packed-path demotion
@@ -1397,6 +1482,11 @@ class Run:
                 fetch_backlog=len(pending_fetches),
                 upload_backlog=len(pending_uploads),
             )
+            # completion verdict + an in-flight sweep of the tiles still
+            # behind this one (the sampler thread also sweeps on flight
+            # runs; the detector flags each tile at most once)
+            self.straggler.finish(t.tile_id)
+            self.straggler.scan()
             if watchdog is not None:
                 watchdog.tick()
             if telemetry is not None:
@@ -1422,9 +1512,18 @@ class Run:
             while len(pending_fetches) > limit:
                 t, handle, dn, qa, dt, attempt = pending_fetches.popleft()
                 try:
+                    t0_fx = time.perf_counter()
                     with timer.stage("fetch"):
                         handle.wait()
                     _note_fetch_ok()
+                    if telemetry is not None:
+                        # the BLOCKING remainder of the async fetch — the
+                        # host-experienced cost after overlap, which is
+                        # what critical-path attribution decomposes
+                        telemetry.span(
+                            "fetch", t.tile_id, t0_fx, time.perf_counter(),
+                            attempt=attempt,
+                        )
                 except Exception as err:
                     _note_fetch_failure()
                     try:
@@ -1504,27 +1603,35 @@ class Run:
         pending_feeds: deque = deque()  # (tile, future), consumed in order
 
         def _feed_job(t: TileSpec, readahead: "TileSpec | None" = None):
+            """Returns ``(dn, qa, (t0, t1))`` — the fed arrays plus the
+            feed span's monotonic bounds.  The feed span is EMITTED by
+            the consumer on the driver thread, not here: a feeder thread
+            still finishing through an abort unwind must never append
+            events behind the scope's terminal ``run_done``."""
+            t0_span = time.perf_counter()
             with timer.stage("feed"):
                 faults.check("feed")  # injection seam: transient feed I/O
                 fed = _feed_tile(stack, t, feed_px, bands)
+            t1_span = time.perf_counter()
             if readahead is not None:
                 # fire-and-forget: hint the next PLANNED tile (one past the
                 # feed queue) so its block decode overlaps the current tiles'
                 # device wait — lazy file-backed cubes only; eager ndarray
                 # stacks have no compressed blocks to prefetch
                 _prefetch_tile(stack, readahead, bands)
-            return fed
+            return (*fed, (t0_span, t1_span))
 
         def _refeed(t: TileSpec, err: BaseException):
             """Synchronous feed retry: a transient stack-read error (NFS blip,
             decode hiccup) re-enters the same per-tile retry budget as device
             faults instead of aborting the whole run.  Returns ``(dn, qa,
-            attempt)`` — the attempt number the tile continues from, so its
-            ``tile_start`` and any later dispatch retries share ONE per-tile
-            budget — or ``None`` when the tile was quarantined; an exhausted
-            budget raises :class:`TileRetriesExhausted` (chaining the original
-            feed error) exactly like the device-fault ladder, so the CLI's
-            exit-3 contract covers every per-tile failure class.
+            feed_span, attempt)`` — the attempt number the tile continues
+            from, so its ``tile_start`` and any later dispatch retries share
+            ONE per-tile budget — or ``None`` when the tile was quarantined;
+            an exhausted budget raises :class:`TileRetriesExhausted`
+            (chaining the original feed error) exactly like the device-fault
+            ladder, so the CLI's exit-3 contract covers every per-tile
+            failure class.
             """
             attempt = 1
             while True:
@@ -1632,7 +1739,7 @@ class Run:
                 # the manifest reports write_done events once each tile is
                 # durable
                 manifest.telemetry = telemetry
-                telemetry.run_start(
+                rs_rec = telemetry.run_start(
                     fingerprint=manifest.fingerprint,
                     process_index=jax.process_index(),
                     process_count=jax.process_count(),
@@ -1641,7 +1748,32 @@ class Run:
                     tiles_skipped_resume=n_resume_skipped,
                     mesh_devices=n_mesh,
                     impl=impl_resolved,
+                    # the POD-WIDE correlation id, agreed through the
+                    # shared manifest header (one process stamps it,
+                    # every process reads it back) — all N per-host
+                    # streams of one pod run carry the same run_id.
+                    # Pre-run_id manifests leave it None: run_start then
+                    # stamps a per-process fallback id
+                    **(
+                        {"run_id": manifest.run_id}
+                        if manifest.run_id is not None
+                        else {}
+                    ),
                 )
+                # mirror the scope's clock anchor into the shared
+                # manifest (pod-trace assembly can then align a host
+                # whose event file was lost); best-effort — a full-disk
+                # manifest append must not kill a run telemetry survived
+                try:
+                    manifest.record_clock_anchor(
+                        run_id=rs_rec.get("run_id", ""),
+                        host=rs_rec.get("host", ""),
+                        process_index=jax.process_index(),
+                        anchor_wall=rs_rec.get("anchor_wall", rs_rec["t_wall"]),
+                        anchor_mono=rs_rec.get("anchor_mono", rs_rec["t_mono"]),
+                    )
+                except OSError as exc:
+                    log.warning("manifest clock-anchor append failed: %s", exc)
             except BaseException:
                 # a failed run_start emit surfaces before the try/finally
                 # below owns shutdown — unwind here or the exporter thread /
@@ -1776,14 +1908,20 @@ class Run:
                     next_i += 1
                 attempt0 = 1
                 try:
-                    dn, qa = fut.result()
+                    dn, qa, feed_span = fut.result()
                 except Exception as e:
                     # transient feed I/O enters the retry budget (sync,
                     # with backoff) instead of aborting the whole run
                     fed = _refeed(t, e)
                     if fed is None:
                         continue  # tile quarantined; the rest of the run goes on
-                    dn, qa, attempt0 = fed
+                    dn, qa, feed_span, attempt0 = fed
+                if telemetry is not None:
+                    # emitted HERE (driver thread) from the feeder's
+                    # recorded bounds — see _feed_job's ordering note
+                    telemetry.span(
+                        "feed", t.tile_id, *feed_span, attempt=attempt0
+                    )
                 if watchdog is not None:
                     watchdog.tick()
                 with timer.stage("upload"):
@@ -1905,6 +2043,9 @@ class Run:
                     # tile_retry(1..n) → tile_start(n+1) stays coherent, and
                     # dispatch retries continue the SAME per-tile budget
                     telemetry.tile_start(t.tile_id, attempt=attempt0)
+                # the tile's in-flight clock starts here — dispatch is the
+                # point a straggler verdict is measured from
+                self.straggler.start(t.tile_id, attempt0)
                 t0 = time.perf_counter()
                 out = err = None
                 try:
@@ -1916,6 +2057,13 @@ class Run:
                         u_dn, u_qa = handle.arrays()
                     if handle.packed:
                         _note_upload_ok()
+                    if telemetry is not None:
+                        # the BLOCKING remainder of the async upload (the
+                        # landing wait + device unpack the driver paid)
+                        telemetry.span(
+                            "upload", t.tile_id, t0, time.perf_counter(),
+                            attempt=attempt0,
+                        )
                 except Exception as e:
                     # an upload error surfacing through the async wait enters
                     # the SAME retry ladder as a dispatch fault — the ladder
@@ -2095,6 +2243,9 @@ class Run:
             # always present (empty on healthy runs): orchestrators branch on
             # it, and the CLI maps non-empty to exit code 3
             "tiles_quarantined": sorted(quarantined),
+            # live straggler verdicts (obs/spans): tiles whose in-flight
+            # duration exceeded straggler_k x the rolling median
+            "stragglers": self.straggler.stats()["stragglers"],
         }
         feed_cache_stats = blockcache.stats_delta(feed_cache_base)
         if cfg.feed_cache_mb:

@@ -21,6 +21,7 @@ import hashlib
 import json
 import os
 import time
+import uuid
 import zipfile
 from typing import Iterator
 
@@ -105,6 +106,18 @@ class TileManifest:
     fingerprint: str
     context: dict | None = None
     telemetry: "object | None" = None
+    #: pod-wide run correlation ID, agreed through the shared manifest
+    #: header: exactly ONE process of a pod run writes the header
+    #: (exclusive create) and stamps a fresh id; every other process —
+    #: and every resume — reads the SAME id back at :meth:`open`.  The
+    #: driver passes it to ``run_start`` so all N per-host event streams
+    #: of one pod run carry one ``run_id`` (the span model's correlation
+    #: contract).  A resume shares its predecessor's id by design: it is
+    #: the same logical run over the same workdir, and pod-trace assembly
+    #: folds each stream's LAST scope anyway.  ``None`` until ``open()``
+    #: (or when resuming a pre-run_id manifest — callers fall back to a
+    #: per-process id).
+    run_id: "str | None" = None
 
     @property
     def path(self) -> str:
@@ -167,6 +180,10 @@ class TileManifest:
                             f"!= {self.fingerprint}); pass resume=False to "
                             "discard it"
                         )
+                    # the pod-wide correlation id the header's writer
+                    # stamped (None on pre-run_id manifests — the driver
+                    # falls back to a per-process id)
+                    self.run_id = rec.get("run_id")
                     # headers written before context existed were all
                     # single-device runs — treat a missing key as that
                     stored = rec.get("context", {"mesh_devices": 1})
@@ -203,7 +220,12 @@ class TileManifest:
             return False
 
     def _write_header(self, exclusive: bool = False) -> None:
-        hdr = {"kind": "header", "fingerprint": self.fingerprint}
+        self.run_id = uuid.uuid4().hex[:12]
+        hdr = {
+            "kind": "header",
+            "fingerprint": self.fingerprint,
+            "run_id": self.run_id,
+        }
         if self.context is not None:
             hdr["context"] = self.context
         with open(self.path, "x" if exclusive else "w") as f:
@@ -256,6 +278,37 @@ class TileManifest:
                 os.path.getsize(self.tile_path(tile_id)),
                 time.perf_counter() - t0,
                 meta,
+            )
+
+    def record_clock_anchor(
+        self,
+        run_id: str,
+        host: str,
+        process_index: int,
+        anchor_wall: float,
+        anchor_mono: float,
+    ) -> None:
+        """Append this process's run-scope clock anchor to the shared
+        manifest (``kind="clock_anchor"``) — the manifest-side copy of
+        the ``run_start`` anchor pair, so pod-trace assembly can align a
+        host whose ``events.p<i>.jsonl`` was lost/truncated (the
+        manifest lives on the shared filesystem and survives the host).
+        Append-only like every record; :meth:`open` ignores the kind, so
+        resumes and assembly are unaffected."""
+        with open(self.path, "a") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "kind": "clock_anchor",
+                        "run_id": run_id,
+                        "host": host,
+                        "process_index": int(process_index),
+                        "pid": os.getpid(),
+                        "anchor_wall": anchor_wall,
+                        "anchor_mono": anchor_mono,
+                    }
+                )
+                + "\n"
             )
 
     def record_failed(self, tile_id: int, attempts: int, error: str) -> None:

@@ -573,11 +573,24 @@ class SegmentationServer:
         )
         out.update(blockcache.occupancy_probe())
         if run is not None:
+            # liveness half of straggler detection for serve jobs: the
+            # SERVER's sampler thread sweeps the running job's in-flight
+            # tiles, so a tile wedging the job's own device wait is
+            # flagged while it runs (the detector flags each tile once).
+            # Only while the run is live — its phase flips to
+            # done/aborted at the top of teardown, BEFORE the terminal
+            # run_done, so scanning a finishing run here would append
+            # verdicts behind the scope's terminal event
+            detector = getattr(run, "straggler", None)
             p = getattr(run, "progress", None)
+            if detector is not None and p is not None and p.get(
+                "phase"
+            ) not in ("done", "aborted"):
+                detector.scan()
             if p is not None:
                 for k in (
                     "feed_backlog", "write_backlog", "fetch_backlog",
-                    "upload_backlog",
+                    "upload_backlog", "stragglers",
                 ):
                     out[k] = int(p.get(k, 0))
         return out

@@ -219,6 +219,18 @@ def test_two_process_driver_shares_tiles(tmp_path):
     assert sum(h["tiles_done"] for h in hosts) == 6
     assert "hosts" not in per_proc[1].get("telemetry", {})  # primary-only fold
 
+    # pod-wide correlation: both processes stamped the shared manifest
+    # header's ONE run_id into their run_start — the span model's join
+    # key (obs/spans; one pod run = one run_id across all host streams)
+    run_ids = []
+    for i in range(2):
+        with open(events_path(workdir, i, 2)) as f:
+            rs = json.loads(f.readline())
+        assert rs["ev"] == "run_start"
+        run_ids.append(rs["run_id"])
+    assert run_ids[0] == run_ids[1]
+    assert [h["run_id"] for h in hosts] == run_ids
+
     # assembly from the shared workdir sees ALL tiles (mesh-blind consumer)
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.io.synthetic import SceneSpec, make_stack

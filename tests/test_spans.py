@@ -1,0 +1,492 @@
+"""Span model + pod tracing tests: cross-host clock alignment, trace
+assembly, critical-path attribution, and the live straggler detector.
+
+Pins the tracing contract end to end — the :class:`StragglerDetector`
+rules (rolling-median window, k threshold, no false positive before
+``min_tiles``, flag-once), the ``span``/``tile_straggler`` schema and
+value lints, the pod-trace assembler over the committed two-host
+skewed-clock fixtures (monotone, offset-corrected, byte-stable across
+folds), ``tools/lt_trace.py``, ``tools/obs_report.py``'s per-host
+rollups, and a real CPU-backend driver run where an injected ``slow``
+fault produces a ``tile_straggler`` in the stream.
+"""
+
+import json
+import os
+
+import pytest
+
+from land_trendr_tpu.config import LTParams
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+from land_trendr_tpu.obs import EventLog, validate_event
+from land_trendr_tpu.obs.spans import (
+    StragglerDetector,
+    assemble_pod_trace,
+    busy_union_s,
+    critical_path,
+    tail_ratio,
+)
+from land_trendr_tpu.runtime import RunConfig, run_stack, stack_from_synthetic
+from tools import check_events_schema, lt_top, lt_trace, obs_report
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+POD_FIXTURE = [
+    os.path.join(FIXTURES, "podtrace_skew.p0.events.jsonl"),
+    os.path.join(FIXTURES, "podtrace_skew.p1.events.jsonl"),
+]
+
+#: the wall skew baked into the committed p1 fixture (host-b's clock
+#: reads this many seconds ahead of host-a's at run_start)
+FIXTURE_SKEW_S = 1800.5
+
+
+# ---------------------------------------------------------------------------
+# StragglerDetector
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_detector(**kw):
+    clock = FakeClock()
+    verdicts = []
+
+    def on_straggler(tile_id, dur, thr, med, in_flight, attempt):
+        verdicts.append(
+            {"tile": tile_id, "dur": dur, "thr": thr, "med": med,
+             "in_flight": in_flight, "attempt": attempt}
+        )
+
+    kw.setdefault("k", 2.0)
+    kw.setdefault("min_tiles", 3)
+    det = StragglerDetector(on_straggler=on_straggler, clock=clock, **kw)
+    return det, clock, verdicts
+
+
+def run_tile(det, clock, tile_id, duration):
+    det.start(tile_id)
+    clock.t += duration
+    return det.finish(tile_id)
+
+
+def test_no_false_positive_before_min_tiles():
+    """The first tiles — including a slow compile-carrying tile 0 —
+    must never flag: there is no median to judge against yet."""
+    det, clock, verdicts = make_detector(min_tiles=3)
+    run_tile(det, clock, 0, 30.0)  # the compile tile: huge, NOT a straggler
+    run_tile(det, clock, 1, 1.0)
+    run_tile(det, clock, 2, 1.0)
+    assert verdicts == []
+    assert det.stats()["stragglers"] == 0
+
+
+def test_completion_flagging_k_threshold():
+    det, clock, verdicts = make_detector(k=2.0, min_tiles=3)
+    for i, d in enumerate((1.0, 1.0, 1.0)):
+        run_tile(det, clock, i, d)
+    # at threshold (2 x median 1.0 = 2.0): NOT over — strict inequality
+    run_tile(det, clock, 3, 2.0)
+    assert verdicts == []
+    run_tile(det, clock, 4, 2.5)
+    assert [v["tile"] for v in verdicts] == [4]
+    v = verdicts[0]
+    assert v["dur"] == pytest.approx(2.5)
+    assert v["thr"] == pytest.approx(2.0)
+    assert v["med"] == pytest.approx(1.0)
+    assert v["in_flight"] is False
+    assert det.stats()["stragglers"] == 1
+
+
+def test_rolling_window_median():
+    """The median is over the last ``window`` completions only — a run
+    whose tiles slow down re-baselines instead of flagging forever."""
+    det, clock, verdicts = make_detector(k=2.0, min_tiles=2, window=4)
+    for i in range(4):
+        run_tile(det, clock, i, 1.0)
+    # four slow-but-steady tiles push the old fast baseline out...
+    for i in range(4, 8):
+        run_tile(det, clock, i, 1.9)  # under 2x the evolving median
+    assert verdicts == []
+    assert det.stats()["median_s"] == pytest.approx(1.9)
+    # ...so 3.0s is now under the refreshed 3.8s threshold
+    run_tile(det, clock, 8, 3.0)
+    assert verdicts == []
+
+
+def test_scan_flags_in_flight_once():
+    det, clock, verdicts = make_detector(k=2.0, min_tiles=2)
+    for i in range(3):
+        run_tile(det, clock, i, 1.0)
+    det.start(99)
+    clock.t += 5.0
+    assert det.scan() == [99]
+    assert verdicts[-1]["in_flight"] is True
+    # already flagged: neither a re-scan nor the completion re-fires
+    assert det.scan() == []
+    det.finish(99)
+    assert [v["tile"] for v in verdicts] == [99]
+    assert det.stats()["stragglers"] == 1
+
+
+def test_drop_and_retry_restart():
+    det, clock, verdicts = make_detector(k=2.0, min_tiles=2)
+    for i in range(3):
+        run_tile(det, clock, i, 1.0)
+    # quarantine path: a dropped tile gets no verdict however long it ran
+    det.start(50)
+    clock.t += 10.0
+    det.drop(50)
+    assert det.scan() == []
+    # retry path: re-start resets the in-flight clock
+    det.start(51, attempt=1)
+    clock.t += 10.0
+    det.start(51, attempt=2)
+    clock.t += 0.5
+    det.finish(51)
+    assert verdicts == []
+
+
+def test_failed_callback_unflags_for_retry():
+    """A verdict whose callback raised never landed anywhere (the sampler
+    swallows probe errors) — the tile must stay eligible so a later scan
+    retries instead of losing its only verdict forever."""
+    calls = []
+
+    def flaky(tile_id, *rest):
+        calls.append(tile_id)
+        if len(calls) == 1:
+            raise OSError("telemetry emit failed")
+
+    clock = FakeClock()
+    det = StragglerDetector(k=2.0, min_tiles=2, on_straggler=flaky,
+                            clock=clock)
+    for tid in (0, 1):
+        det.start(tid)
+        clock.t += 1.0
+        det.finish(tid)
+    det.start(9)
+    clock.t += 10.0
+    with pytest.raises(OSError):
+        det.scan()
+    assert det.stats()["stragglers"] == 0  # un-flagged: verdict not lost
+    assert det.scan() == [9]  # the retry lands
+    assert calls == [9, 9]
+    assert det.scan() == []  # then flags-once as usual
+
+
+def test_detector_validation():
+    with pytest.raises(ValueError, match="k=0.5"):
+        StragglerDetector(k=0.5)
+    with pytest.raises(ValueError, match="min_tiles=0"):
+        StragglerDetector(min_tiles=0)
+
+
+# ---------------------------------------------------------------------------
+# schema + value lints
+# ---------------------------------------------------------------------------
+
+
+def test_span_and_straggler_events_validate():
+    span = {"ev": "span", "t_wall": 1.0, "t_mono": 2.0, "name": "feed",
+            "tile_id": 3, "start": 1.5, "end": 2.0}
+    assert validate_event(span) == []
+    assert validate_event({**span, "attempt": 2}) == []
+    assert validate_event({k: v for k, v in span.items() if k != "end"})
+    strag = {"ev": "tile_straggler", "t_wall": 1.0, "t_mono": 2.0,
+             "tile_id": 3, "duration_s": 5.0, "threshold_s": 2.0,
+             "median_s": 1.0, "in_flight": True}
+    assert validate_event(strag) == []
+    assert validate_event({**strag, "in_flight": "yes"})  # type error
+
+
+def test_span_value_lint_end_before_start():
+    errs = check_events_schema.span_value_errors(
+        {"ev": "span", "name": "feed", "tile_id": 1,
+         "start": 5.0, "end": 4.0}, 7)
+    assert errs and "end 4.0 precedes start 5.0" in errs[0]
+    assert check_events_schema.span_value_errors(
+        {"ev": "span", "name": "feed", "tile_id": 1,
+         "start": 4.0, "end": 4.0}, 7) == []
+
+
+def test_straggler_value_lint_duration_vs_threshold():
+    bad = {"ev": "tile_straggler", "tile_id": 1, "duration_s": 1.0,
+           "threshold_s": 2.0, "median_s": 1.0}
+    errs = check_events_schema.tile_straggler_value_errors(bad, 3)
+    assert errs and "below threshold_s" in errs[0]
+    ok = {**bad, "duration_s": 2.5}
+    assert check_events_schema.tile_straggler_value_errors(ok, 3) == []
+    inverted = {**ok, "threshold_s": 0.5}
+    errs = check_events_schema.tile_straggler_value_errors(inverted, 3)
+    assert errs and "below median_s" in errs[0]
+
+
+def test_run_start_stamps_anchor_pair(tmp_path):
+    log = EventLog(str(tmp_path / "events.jsonl"))
+    rec = log.run_start(
+        fingerprint="f", process_index=0, process_count=1, tiles_total=1,
+        tiles_todo=1, tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+    )
+    log.close()
+    assert validate_event(rec) == []
+    assert isinstance(rec["run_id"], str) and rec["run_id"]
+    # the anchor pair is sampled back to back with the emit's own stamps
+    assert abs(rec["anchor_wall"] - rec["t_wall"]) < 1.0
+    assert abs(rec["anchor_mono"] - rec["t_mono"]) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# helpers: busy union, tail ratio, critical path
+# ---------------------------------------------------------------------------
+
+
+def test_busy_union_merges_overlaps():
+    assert busy_union_s([]) == 0.0
+    assert busy_union_s([(0, 1), (0.5, 2), (3, 4)]) == pytest.approx(3.0)
+
+
+def test_tail_ratio():
+    assert tail_ratio([1.0]) is None
+    assert tail_ratio([1.0] * 19 + [5.0]) == pytest.approx(5.0)
+
+
+def test_critical_path_two_sided_bound():
+    cp = critical_path({"compute": 8.0, "feed": 3.0, "write": 1.0}, 10.0)
+    assert cp["bound_stage"] == "compute"
+    # removing compute: serial view saves 8 -> wall 2, but feed's 3s
+    # still bounds the pipeline
+    assert cp["if_free"]["compute"]["est_wall_s"] == pytest.approx(3.0)
+    assert cp["if_free"]["compute"]["faster_pct"] == pytest.approx(70.0)
+    # removing feed saves at most its own 3s
+    assert cp["if_free"]["feed"]["est_wall_s"] == pytest.approx(8.0)
+    # attempt spans overlap the others and must not enter the path
+    assert "attempt" not in critical_path(
+        {"compute": 8.0, "attempt": 9.0}, 10.0
+    )["if_free"]
+
+
+# ---------------------------------------------------------------------------
+# pod-trace assembly over the committed two-host skewed fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_assembles_offset_corrected():
+    trace = assemble_pod_trace(POD_FIXTURE)
+    assert trace["files"] == 2 and trace["malformed"] == 0
+    h0, h1 = trace["hosts"]
+    assert (h0["host"], h1["host"]) == ("host-a", "host-b")
+    # the alignment reports the skew it removed, and removes it: both
+    # hosts' activity overlaps on the pod timeline despite the half-hour
+    # wall-clock disagreement baked into the fixture
+    assert h0["wall_skew_s"] == pytest.approx(0.0)
+    assert h1["wall_skew_s"] == pytest.approx(FIXTURE_SKEW_S)
+    span_range = {}
+    for fileno in (0, 1):
+        ts = [s["t0"] for s in trace["spans"] if s["file"] == fileno]
+        span_range[fileno] = (min(ts), max(ts))
+    assert span_range[0][0] < span_range[1][1]
+    assert span_range[1][0] < span_range[0][1]
+    # monotone: causally ordered output
+    t0s = [s["t0"] for s in trace["spans"]]
+    assert t0s == sorted(t0s)
+    assert all(s["dur"] >= 0 for s in trace["spans"])
+    # correlation IDs ride every span: one pod run = ONE run_id (agreed
+    # through the shared manifest header), hosts distinguished by
+    # host/process_index
+    assert {s["run_id"] for s in trace["spans"]} == {"fixturerun000"}
+    assert {s["host"] for s in trace["spans"]} == {"host-a", "host-b"}
+
+
+def test_fixture_assembly_byte_stable():
+    a = json.dumps(assemble_pod_trace(POD_FIXTURE), sort_keys=True)
+    b = json.dumps(assemble_pod_trace(POD_FIXTURE), sort_keys=True)
+    assert a == b
+
+
+def test_fixture_critical_path_and_imbalance():
+    trace = assemble_pod_trace(POD_FIXTURE)
+    pod = trace["pod"]
+    # host-b (wall 6.2) lags host-a (4.4): the pod ends with host-b
+    assert pod["wall_s"] == pytest.approx(6.2)
+    assert pod["host_imbalance"] == pytest.approx(6.2 / 5.3, rel=1e-3)
+    cp = pod["critical_path"]
+    assert cp["bound_stage"] == "compute"
+    # compute-free still pays the slower host's next-binding stage
+    assert 0 < cp["if_free"]["compute"]["est_wall_s"] < 6.2
+    assert cp["if_free"]["compute"]["faster_pct"] > 50
+    # the fixture's straggler lands in markers and the host rollup
+    assert [m["tile"] for m in trace["markers"]] == [5]
+    assert h_by_name(trace, "host-b")["stragglers"] == 1
+    assert h_by_name(trace, "host-a")["stragglers"] == 0
+    assert h_by_name(trace, "host-b")["tail_ratio"] == pytest.approx(2.5)
+
+
+def h_by_name(trace, name):
+    return next(h for h in trace["hosts"] if h["host"] == name)
+
+
+def test_lt_trace_cli(tmp_path, capsys):
+    out = str(tmp_path / "pod_trace.json")
+    assert lt_trace.main([*POD_FIXTURE, "--trace", out]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["files"] == 2
+    assert report["pod"]["critical_path"]["bound_stage"] == "compute"
+    assert report["trace"]["events"] > 0
+    chrome = json.load(open(out))
+    evs = chrome["traceEvents"]
+    # one trace process per host, stage names as threads, ts rebased >= 0
+    assert {e["args"]["name"] for e in evs if e.get("name") == "process_name"} \
+        == {"proc 0 @ host-a", "proc 1 @ host-b"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert xs and all(e["ts"] >= 0 for e in xs)
+    assert any(e["name"].startswith("STRAGGLER") for e in evs if e["ph"] == "i")
+
+
+def test_lt_trace_cli_missing_path(tmp_path, capsys):
+    assert lt_trace.main([str(tmp_path / "nope")]) == 2
+
+
+def test_obs_report_per_host_section():
+    report, _spans = obs_report.fold(POD_FIXTURE)
+    assert report["stragglers"] == 1
+    ph = report["per_host"]
+    assert [p["host"] for p in ph] == ["host-a", "host-b"]
+    assert [p["stragglers"] for p in ph] == [0, 1]
+    # per-host stage shares alongside the run-level rollup: the pod-sum
+    # stage_s hid which host a stage bound — these must be per host
+    for p in ph:
+        assert p["stage_s"] and abs(sum(p["stage_share"].values()) - 1.0) < 0.01
+        assert p["idle_gap_s"] >= 0
+        assert p["span_s"]["feed"] == pytest.approx(0.6, abs=0.01)
+    assert ph[1]["tail_ratio"] == pytest.approx(2.5)
+    assert report["event_counts"]["span"] == 18
+    assert report["event_counts"]["tile_straggler"] == 1
+
+
+def test_lt_top_renders_straggler_column():
+    snap = {
+        "healthz": {"uptime_s": 5.0, "queue_depth": 0, "running": "j1",
+                    "jobs_terminal": 0, "jobs_total": 1,
+                    "warm_program_count": 1},
+        "metrics": [],
+        "jobs": [{
+            "job_id": "j1", "state": "running", "tenant": "t", "priority": 0,
+            "submitted_t": 0.0,
+            "progress": {"phase": "pipeline", "tiles_done": 3,
+                         "tiles_total": 6, "retries": 0, "stragglers": 2,
+                         "feed_backlog": 1, "write_backlog": 0,
+                         "fetch_backlog": 0, "upload_backlog": 0},
+        }],
+    }
+    view = lt_top.render(snap)
+    assert "STRAG" in view
+    row = [ln for ln in view.splitlines() if ln.startswith("j1")][0]
+    assert " 2 " in row  # the straggler count renders in the job row
+
+
+# ---------------------------------------------------------------------------
+# driver integration: injected slow fault -> tile_straggler in the stream
+# ---------------------------------------------------------------------------
+
+
+def test_driver_slow_fault_emits_straggler(tmp_path):
+    stack = stack_from_synthetic(make_stack(
+        SceneSpec(width=48, height=40, year_start=1990, year_end=2013, seed=11)
+    ))
+    cfg = RunConfig(
+        workdir=str(tmp_path / "w"), out_dir=str(tmp_path / "o"),
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        tile_size=20, telemetry=True,
+        fault_schedule="seed=1,compute.wait@4=slow:0.8",
+        straggler_k=3.0, straggler_min_tiles=2,
+    )
+    summary = run_stack(stack, cfg)
+    assert summary["stragglers"] >= 1
+    ev_file = summary["telemetry"]["events"]
+    # stream is schema-valid INCLUDING the new value lints
+    assert check_events_schema.main([ev_file]) == 0
+    recs = [json.loads(ln) for ln in open(ev_file)]
+    stragglers = [r for r in recs if r["ev"] == "tile_straggler"]
+    # the slow-faulted tile (compute.wait invocation 4 = tile 4) flagged
+    assert 4 in {r["tile_id"] for r in stragglers}
+    for r in stragglers:
+        assert r["duration_s"] >= r["threshold_s"] >= r["median_s"]
+    # explicit spans rode the stream with correlation ids intact
+    spans = [r for r in recs if r["ev"] == "span"]
+    assert {"feed", "upload"} <= {r["name"] for r in spans}
+    assert all(r["end"] >= r["start"] for r in spans)
+    # straggler events precede the scope's terminal run_done
+    assert recs[-1]["ev"] == "run_done"
+    # the whole workdir assembles into a one-host pod trace
+    trace = assemble_pod_trace([ev_file])
+    assert trace["hosts"][0]["stragglers"] == len(stragglers)
+    assert trace["pod"]["critical_path"] is not None
+    # the clock anchor is mirrored into the shared manifest
+    from land_trendr_tpu.runtime.manifest import TileManifest
+
+    anchors = [
+        r for r in TileManifest(cfg.workdir, "x").iter_records()
+        if r.get("kind") == "clock_anchor"
+    ]
+    assert len(anchors) == 1
+    rs = next(r for r in recs if r["ev"] == "run_start")
+    assert anchors[0]["run_id"] == rs["run_id"]
+    assert anchors[0]["anchor_wall"] == pytest.approx(rs["anchor_wall"])
+    assert anchors[0]["anchor_mono"] == pytest.approx(rs["anchor_mono"])
+    # run_id is the POD-WIDE id the manifest header carries — the stream
+    # stamped the manifest's id, not a private per-process one
+    hdr = next(
+        r for r in TileManifest(cfg.workdir, "x").iter_records()
+        if r.get("kind") == "header"
+    )
+    assert rs["run_id"] == hdr["run_id"]
+
+
+def test_manifest_header_agrees_run_id_across_processes(tmp_path):
+    """The pod-wide run_id channel: one process wins the exclusive header
+    create and stamps the id; every other process of the pod (and every
+    resume) reads the SAME id back — no collective involved."""
+    from land_trendr_tpu.runtime.manifest import TileManifest
+
+    wd = str(tmp_path / "w")
+    primary = TileManifest(wd, "samefp")
+    primary.open(resume=True)
+    assert isinstance(primary.run_id, str) and primary.run_id
+    peer = TileManifest(wd, "samefp")
+    peer.open(resume=True)
+    assert peer.run_id == primary.run_id
+    # resume=False rewrites the header: a NEW logical run, new id
+    fresh = TileManifest(wd, "samefp")
+    fresh.open(resume=False)
+    assert fresh.run_id != primary.run_id
+
+
+def test_run_start_rejects_half_anchor_pair(tmp_path):
+    """The (anchor_wall, anchor_mono) pair is atomic: half a pair would
+    silently pair two clock reads taken at different instants, shifting
+    every assembled span by the gap."""
+    log_ = EventLog(str(tmp_path / "events.jsonl"))
+    try:
+        with pytest.raises(ValueError, match="anchor_wall and anchor_mono"):
+            log_.run_start(schema=1, fingerprint="x", anchor_wall=1.0)
+        with pytest.raises(ValueError, match="anchor_wall and anchor_mono"):
+            log_.run_start(schema=1, fingerprint="x", anchor_mono=2.0)
+        rec = log_.run_start(
+            schema=1, fingerprint="x", anchor_wall=1.0, anchor_mono=2.0
+        )
+        assert (rec["anchor_wall"], rec["anchor_mono"]) == (1.0, 2.0)
+    finally:
+        log_.close()
+
+
+def test_runconfig_straggler_validation(tmp_path):
+    with pytest.raises(ValueError, match="straggler_k"):
+        RunConfig(workdir=str(tmp_path), straggler_k=0.5)
+    with pytest.raises(ValueError, match="straggler_min_tiles"):
+        RunConfig(workdir=str(tmp_path), straggler_min_tiles=0)

@@ -65,6 +65,13 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "corrupt_dropped", "evicted_segments", "bytes", "budget_bytes",
         "segments",
     ),
+    # tracing events (obs/spans): monotonic-clock values and durations
+    # are non-negative by construction; a negative one means a broken
+    # producer clock pairing
+    "span": ("tile_id", "start", "end", "attempt"),
+    "tile_straggler": (
+        "tile_id", "duration_s", "threshold_s", "median_s", "attempt",
+    ),
     # robustness events (PR 5): counters/indices/durations only go up
     "fault_injected": ("index",),
     "tile_quarantined": ("tile_id", "attempts"),
@@ -84,7 +91,7 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "rss_bytes", "open_fds", "threads", "feed_backlog",
         "write_backlog", "fetch_backlog", "upload_backlog", "queue_depth",
         "running", "jobs_total", "warm_program_count", "cache_bytes",
-        "store_bytes", "device_bytes_in_use",
+        "store_bytes", "device_bytes_in_use", "stragglers",
     ),
     "profile_captured": ("duration_s", "bytes"),
     "job_slo": ("queue_wait_s", "exec_s", "latency_s", "deadline_s"),
@@ -227,6 +234,53 @@ def job_slo_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+#: slack for the span end >= start cross-check: both ends are rounded
+#: to 6 dp at the producer (rounding is monotone, so a producer-true
+#: ordering survives; the slack only forgives foreign re-rounding)
+_SPAN_SLACK_S = 1e-6
+
+
+def span_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for one ``span`` record: the span must close
+    after it opened (``end >= start`` — both are the same monotonic
+    clock, so a violation means a broken producer pairing, not skew)."""
+    if not isinstance(rec, dict) or rec.get("ev") != "span":
+        return []
+    s, e = rec.get("start"), rec.get("end")
+    if _num(s) and _num(e) and e < s - _SPAN_SLACK_S:
+        return [
+            f"line {lineno}: span: end {e} precedes start {s} "
+            "(a span closes after it opens)"
+        ]
+    return []
+
+
+def tile_straggler_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for one ``tile_straggler`` record: a straggler
+    is BY DEFINITION over its threshold (``duration_s >= threshold_s``)
+    and the threshold derives from the median (``threshold_s >=
+    median_s`` — k >= 1 is enforced at the detector).  Non-negativity
+    rides the generic loop."""
+    if not isinstance(rec, dict) or rec.get("ev") != "tile_straggler":
+        return []
+    errs = []
+    dur, thr, med = (
+        rec.get("duration_s"), rec.get("threshold_s"), rec.get("median_s")
+    )
+    if _num(dur) and _num(thr) and dur < thr:
+        errs.append(
+            f"line {lineno}: tile_straggler: duration_s {dur} below "
+            f"threshold_s {thr} (a straggler is over its threshold by "
+            "definition)"
+        )
+    if _num(thr) and _num(med) and thr < med:
+        errs.append(
+            f"line {lineno}: tile_straggler: threshold_s {thr} below "
+            f"median_s {med} (threshold = k x median with k >= 1)"
+        )
+    return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
     (the robustness events, the ingest-store rollup, the flight-sampler
@@ -255,6 +309,8 @@ def value_lints():
             + fetch_lint(rec, lineno)
             + upload_value_errors(rec, lineno)
             + job_slo_value_errors(rec, lineno)
+            + span_value_errors(rec, lineno)
+            + tile_straggler_value_errors(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )
 

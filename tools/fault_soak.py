@@ -18,6 +18,12 @@ Two scene tracks:
   ``manifest.torn`` (post-record truncation → resume readability
   check), and a quarantine schedule (persistent tile fault → run
   continues → resume completes it);
+The eager track also carries the straggler observability case: a
+``slow`` fault parked on one tile's compute wait must emit a
+``tile_straggler`` event (``duration_s ≥ threshold_s``) in the run's
+telemetry stream while artifacts stay byte-identical — detection
+observes, never steers.
+
 * **lazy** (windowed C2 per-band stack): the decode seams —
   ``feed.decode`` (transient window-read fault → feed retry),
   ``cache.corrupt`` (poisoned cached block → invalidate + re-decode),
@@ -230,6 +236,71 @@ def soak(
             if verbose:
                 print(f"  ok: {track}/{case.name} ({case.schedule})")
 
+    def run_straggler_case(stack) -> None:
+        """Observability contract under an injected straggler (ISSUE 10):
+        a ``slow`` fault parked on one tile's compute wait must surface
+        as a ``tile_straggler`` event in the run's telemetry stream —
+        with ``duration_s`` over its ``threshold_s``, the value lint's
+        invariant — while the run completes with artifacts byte-identical
+        to the clean run (a straggler is an observation, never a
+        behavior change)."""
+        wd = str(root / "eager_straggler")
+        cfg = RunConfig(
+            workdir=wd,
+            out_dir=wd + "_o",
+            # invocation 4 = the 5th tile's sanctioned compute wait on
+            # this 6-tile stack: enough completions before it to seed
+            # the rolling median, and the 1s park dwarfs k x median
+            fault_schedule="seed=1,compute.wait@4=slow:1.0",
+            telemetry=True,
+            straggler_k=2.0,
+            straggler_min_tiles=2,
+            **base_kw,
+        )
+        _run(stack, cfg)
+        events = [
+            json.loads(line)
+            for line in (Path(wd) / "events.jsonl").read_text().splitlines()
+            if line.strip()
+        ]
+        stragglers = [e for e in events if e.get("ev") == "tile_straggler"]
+        if not stragglers:
+            raise AssertionError(
+                "slow fault on compute.wait@4 produced no tile_straggler "
+                "event — the detector no longer sees the parked tile"
+            )
+        bad = [
+            e for e in stragglers
+            if not e["duration_s"] >= e["threshold_s"] > 0
+        ]
+        if bad:
+            raise AssertionError(
+                f"tile_straggler events violate duration >= threshold > 0: "
+                f"{bad}"
+            )
+        got = _digest_workdir(wd)
+        clean = _digest_workdir(str(root / "eager_clean"))
+        if got != clean:
+            raise AssertionError(
+                "straggler run artifacts differ from the clean run — the "
+                "verdict changed behavior"
+            )
+        report["cases"].append(
+            {
+                "track": "eager",
+                "case": "straggler_slow",
+                "schedule": cfg.fault_schedule,
+                "straggler_events": len(stragglers),
+                "straggler_tiles": sorted({e["tile_id"] for e in stragglers}),
+                "artifacts_identical": True,
+            }
+        )
+        if verbose:
+            print(
+                f"  ok: eager/straggler_slow ({cfg.fault_schedule}; "
+                f"{len(stragglers)} tile_straggler event(s))"
+            )
+
     def run_serve_track() -> None:
         """Serve-mode failure semantics: with the server's ONE armed
         plan firing at ``serve.submit`` (first submission rejected, the
@@ -423,6 +494,7 @@ def soak(
 
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
+    run_straggler_case(eager)
     run_serve_track()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a

@@ -131,8 +131,8 @@ def render(snap: dict) -> str:
     lines.append("")
     lines.append(
         f"{'JOB':<22} {'STATE':<18} {'TENANT':<10} {'PRI':>3} "
-        f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'BKLG f/w/x/u':>12} "
-        f"{'AGE':>6}"
+        f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'STRAG':>5} "
+        f"{'BKLG f/w/x/u':>12} {'AGE':>6}"
     )
     for job in snap["jobs"]:
         p = job.get("progress") or {}
@@ -158,7 +158,8 @@ def render(snap: dict) -> str:
             f"{job.get('job_id', '?'):<22} {state:<18} "
             f"{job.get('tenant', '?'):<10} {job.get('priority', 0):>3} "
             f"{p.get('phase', '-'):<9} {tiles:>9} "
-            f"{p.get('retries', '-') if p else '-':>5} {backlog:>12} "
+            f"{p.get('retries', '-') if p else '-':>5} "
+            f"{p.get('stragglers', '-') if p else '-':>5} {backlog:>12} "
             f"{_fmt_age(age):>6}"
         )
     if not snap["jobs"]:

@@ -1,0 +1,141 @@
+"""Assemble N per-host event streams into one pod trace + imbalance report.
+
+The pod-scale consumer of the span model (:mod:`land_trendr_tpu.obs.
+spans`): give it a shared workdir (or the per-host ``events.p<i>.jsonl``
+files explicitly) and it emits
+
+* a JSON **report** on stdout — per-host wall/busy/idle-gap seconds,
+  tail ratio (p95/p50 of tile compute durations), straggler and retry
+  counts, span-derived stage seconds with a per-host critical path,
+  plus the pod rollup: host imbalance (max wall / mean wall), pod-wide
+  critical-path attribution ("if stage X were free the run would be Y%
+  faster" — the estimate is ``max(wall - stage_s[X], next-binding
+  stage)`` per host, max'd over hosts because the run ends with its
+  last host), and the apparent wall skew removed per host;
+* with ``--trace OUT.json``, a **Chrome trace-event file** of the whole
+  pod on ONE offset-corrected timeline — one trace process per host,
+  one thread per pipeline stage, straggler verdicts as instants.
+
+Clock alignment: every host's ``run_start`` carries a ``(anchor_wall,
+anchor_mono)`` pair sampled together; the assembler puts ``t=0`` at each
+host's ``run_start``, so wall skew between hosts (bad NTP, a rebooted
+peer) never shifts the trace — the distributed-init barrier means hosts
+enter the run together.  The skew this removes is *reported* per host
+(``wall_skew_s``), never trusted.  Caveat: genuine start stagger beyond
+the barrier (sub-second) is folded into the alignment; and only each
+file's LAST run scope assembles (a resumed workdir traces the current
+run, not its aborted predecessor).
+
+Exit codes: 0 ok, 2 usage/IO error (missing files / event-less workdir).
+
+Usage:
+    python tools/lt_trace.py WORKDIR | EVENTS.jsonl ... [--trace out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import obs_report  # noqa: E402  (the shared Chrome-trace exporter)
+
+from land_trendr_tpu.obs.events import expand_event_paths  # noqa: E402
+from land_trendr_tpu.obs.spans import assemble_pod_trace  # noqa: E402
+
+#: report keys per host, in display order (the assembler's host summary
+#: carries more — this is the imbalance view)
+_HOST_KEYS = (
+    "host", "process_index", "run_id", "status", "wall_skew_s", "wall_s",
+    "busy_s", "idle_gap_s", "tail_ratio", "tiles_done", "pixels",
+    "px_per_s", "retries", "stragglers", "stage_s", "critical_path",
+)
+
+
+def report_from_trace(trace: dict) -> dict:
+    """The imbalance/critical-path report view of an assembled trace
+    (everything except the raw span list)."""
+    return {
+        "files": trace["files"],
+        "malformed": trace["malformed"],
+        "spans": len(trace["spans"]),
+        "stragglers": [
+            {k: m.get(k) for k in ("tile", "t0", "duration_s", "threshold_s")}
+            for m in trace["markers"]
+            if m.get("name") == "straggler"
+        ],
+        "hosts": [
+            {k: h.get(k) for k in _HOST_KEYS} for h in trace["hosts"]
+        ],
+        "pod": trace["pod"],
+    }
+
+
+def trace_events(trace: dict) -> "tuple[list[dict], list[dict]]":
+    """Assembled spans/markers → the ``obs_report.export_trace`` source
+    shape (slices keyed by host ordinal; stage name becomes the trace
+    thread), so both tools share ONE Chrome-trace writer."""
+    src: "list[dict]" = []
+    for s in trace["spans"]:
+        src.append({
+            "kind": "slice",
+            "file": s["file"],
+            "tid": s["name"],
+            "name": f"tile {s['tile']}",
+            "t0": s["t0"],
+            "dur": s["dur"],
+            "args": {
+                k: s[k]
+                for k in ("attempt", "run_id", "job_id")
+                if s.get(k) is not None
+            },
+        })
+    for m in trace["markers"]:
+        src.append({
+            "kind": "instant",
+            "file": m["file"],
+            "tid": "compute",
+            "name": f"STRAGGLER tile {m['tile']}",
+            "t0": m["t0"],
+            "args": {
+                "duration_s": m.get("duration_s"),
+                "threshold_s": m.get("threshold_s"),
+            },
+        })
+    return src, trace["hosts"]
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="events.jsonl files, or workdirs containing them "
+                    "(a pod workdir expands to its events.p<i>.jsonl set)")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also export the pod-wide chrome://tracing / "
+                    "Perfetto trace")
+    args = ap.parse_args(argv)
+
+    try:
+        paths = expand_event_paths(args.paths)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    trace = assemble_pod_trace(paths)
+    report = report_from_trace(trace)
+    if args.trace:
+        src, hosts = trace_events(trace)
+        report["trace"] = {
+            "path": args.trace,
+            "events": obs_report.export_trace(src, hosts, args.trace),
+        }
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

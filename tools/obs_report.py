@@ -49,6 +49,10 @@ from land_trendr_tpu.obs.events import (  # noqa: E402
     run_scope_reset,
     validate_event,
 )
+from land_trendr_tpu.obs.spans import (  # noqa: E402
+    busy_union_s,
+    tail_ratio,
+)
 
 _US = 1e6  # trace-event timestamps are microseconds
 
@@ -84,12 +88,22 @@ def _wall_anchored(scopes: list[dict], rec: dict) -> float:
     return rec.get("t_wall", 0.0)
 
 
+def _mono_anchored(scopes: list[dict], mono: float, fallback: float) -> float:
+    """A raw monotonic-clock value (a ``span`` event's start/end) on the
+    same wall axis as :func:`_wall_anchored`."""
+    if scopes:
+        a = scopes[-1]
+        return a["t_wall"] + (mono - a["t_mono"])
+    return fallback
+
+
 def _fresh_scope() -> dict:
     return {
         "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
         "retries": 0, "failures": 0, "quarantined": 0, "faults_injected": 0,
-        "stalls": 0, "stage_s": {}, "feed_cache": None,
+        "stalls": 0, "stragglers": 0, "stage_s": {}, "span_s": {},
+        "intervals": [], "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
         "slo": None, "resources": None,
@@ -453,6 +467,36 @@ def fold(
                             impl=rec.get("impl"),
                             mesh_devices=rec.get("mesh_devices"),
                         )
+                    elif ev == "span":
+                        # per-tile stage span (obs/spans): start/end are
+                        # monotonic values on the scope's anchor clock
+                        name, tile_id = rec["name"], rec["tile_id"]
+                        s0, s1 = rec["start"], rec["end"]
+                        dur = max(s1 - s0, 0.0)
+                        t0 = _mono_anchored(scopes, s0, tw - dur)
+                        cur["span_s"][name] = (
+                            cur["span_s"].get(name, 0.0) + dur
+                        )
+                        cur["intervals"].append((t0, t0 + dur))
+                        spans.append({
+                            "kind": "slice", "file": fileno,
+                            "tid": str(name), "name": f"tile {tile_id}",
+                            "t0": t0, "dur": dur,
+                            "args": {"attempt": rec.get("attempt")},
+                        })
+                    elif ev == "tile_straggler":
+                        tile_id = rec["tile_id"]
+                        cur["stragglers"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno,
+                            "tid": "device-wait",
+                            "name": f"STRAGGLER tile {tile_id}", "t0": tw,
+                            "args": {
+                                "duration_s": rec.get("duration_s"),
+                                "threshold_s": rec.get("threshold_s"),
+                                "in_flight": rec.get("in_flight"),
+                            },
+                        })
                     elif ev == "tile_start":
                         starts[rec["tile_id"]] = tw
                     elif ev == "tile_done":
@@ -467,6 +511,7 @@ def fold(
                             cur["max_write_backlog"], rec.get("write_backlog", 0)
                         )
                         t0 = starts.pop(tile_id, tw - c_s)
+                        cur["intervals"].append((t0, t0 + max(c_s, tw - t0)))
                         spans.append({
                             "kind": "slice", "file": fileno, "tid": "device-wait",
                             "name": f"tile {tile_id}", "t0": t0,
@@ -484,6 +529,7 @@ def fold(
                     elif ev == "write_done":
                         tile_id, r_s = rec["tile_id"], rec["record_s"]
                         cur["record_s"].append(r_s)
+                        cur["intervals"].append((tw - r_s, tw))
                         spans.append({
                             "kind": "slice", "file": fileno, "tid": "write",
                             "name": f"tile {tile_id}",
@@ -736,6 +782,45 @@ def fold(
             counts[k] = counts.get(k, 0) + v
         for k, v in c["stage_s"].items():
             stage_s[k] = stage_s.get(k, 0.0) + v
+
+    # per-host rollup (the pod-imbalance view the run-level merge above
+    # cannot show): each file's LAST scope gets its own stage shares —
+    # the pre-existing fold summed stage_s across hosts, so a pod where
+    # one host's write stage dominates read as a pod-wide write problem
+    # — plus the span-derived idle gap, tail ratio and straggler count.
+    per_host = []
+    for i, c in enumerate(folded):
+        h = hosts[i]
+        total = sum(c["stage_s"].values())
+        entry: dict = {
+            "host": h.get("host"),
+            "process_index": h.get("process_index"),
+            "run_id": h.get("run_id"),
+            "status": h.get("status"),
+            "wall_s": h.get("wall_s"),
+            "px_per_s": h.get("px_per_s"),
+            "pixels": c["pixels"],
+            "tiles_done": len(c["compute_s"]),
+            "retries": c["retries"],
+            "stragglers": c["stragglers"],
+            "stage_s": {
+                k: round(v, 4) for k, v in sorted(c["stage_s"].items())
+            },
+            "stage_share": {
+                k: round(v / total, 4)
+                for k, v in sorted(c["stage_s"].items())
+            } if total else {},
+            "span_s": {
+                k: round(v, 4) for k, v in sorted(c["span_s"].items())
+            },
+            "tail_ratio": tail_ratio(c["compute_s"]),
+        }
+        busy = busy_union_s(c["intervals"])
+        entry["busy_s"] = round(busy, 4)
+        if isinstance(h.get("wall_s"), (int, float)):
+            entry["idle_gap_s"] = round(max(h["wall_s"] - busy, 0.0), 4)
+        per_host.append(entry)
+
     report = {
         "files": len(paths),
         "event_counts": counts,
@@ -749,6 +834,7 @@ def fold(
         "quarantined": sum(c["quarantined"] for c in folded),
         "faults_injected": sum(c["faults_injected"] for c in folded),
         "stalls": sum(c["stalls"] for c in folded),
+        "stragglers": sum(c["stragglers"] for c in folded),
         "max_feed_backlog": max((c["max_feed_backlog"] for c in folded), default=0),
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},
@@ -761,6 +847,7 @@ def fold(
         "slo": _merge_slo(folded),
         "resources": _merge_resources(folded),
         "hosts": hosts,
+        "per_host": per_host,
     }
     return report, spans
 

@@ -57,8 +57,193 @@ def _hit_rate(stats: dict) -> float | None:
     return stats.get("hits", 0) / lookups if lookups else None
 
 
+#: trace-assembly leg: synthetic pod shape (events scale linearly with
+#: tiles — ~7 events/tile/host) and the bands.  The throughput floor is
+#: deliberately an order of magnitude under a cold local measurement
+#: (~100k events/s): the gate fails an accidentally-quadratic assembler,
+#: not a noisy container.
+TRACE_TILES_PER_HOST = 400
+TRACE_HOSTS = 2
+TRACE_SKEW_S = 1800.5
+TRACE_MIN_EVENTS_PER_S = 5_000
+
+
+def _synth_pod_stream(
+    path: str, pidx: int, anchor_wall: float, anchor_mono: float,
+    tiles: range, straggle_last: bool,
+) -> int:
+    """One schema-valid per-host event stream for the trace leg (spans +
+    lifecycle per tile, one straggler on the lagging host); returns the
+    event count."""
+    import json as _json
+
+    recs: list = []
+
+    def ev(evname: str, dt: float, **fields) -> None:
+        recs.append({
+            "ev": evname,
+            "t_wall": round(anchor_wall + dt, 6),
+            "t_mono": round(anchor_mono + dt, 6),
+            **fields,
+        })
+
+    ev("run_start", 0.0, schema=1, fingerprint="perfgate-trace", pid=1000 + pidx,
+       host=f"gate-host-{pidx}", process_index=pidx, process_count=TRACE_HOSTS,
+       tiles_total=len(tiles) * TRACE_HOSTS, tiles_todo=len(tiles),
+       tiles_skipped_resume=0, mesh_devices=1, impl="xla",
+       run_id=f"gatetrace{pidx:03d}", anchor_wall=anchor_wall,
+       anchor_mono=anchor_mono)
+    t = 0.05
+    for n, tile in enumerate(tiles):
+        slow = straggle_last and n == len(tiles) - 1
+        compute_s = 0.25 if slow else 0.01
+        ev("span", t + 0.002, name="feed", tile_id=tile,
+           start=round(anchor_mono + t, 6), end=round(anchor_mono + t + 0.002, 6))
+        ev("tile_start", t + 0.003, tile_id=tile, attempt=1)
+        ev("span", t + 0.004, name="upload", tile_id=tile,
+           start=round(anchor_mono + t + 0.003, 6),
+           end=round(anchor_mono + t + 0.004, 6), attempt=1)
+        done = t + 0.004 + compute_s
+        ev("tile_done", done, tile_id=tile, px=400, compute_s=compute_s,
+           px_per_s=round(400 / compute_s, 1), feed_backlog=1, write_backlog=0)
+        ev("span", done + 0.001, name="fetch", tile_id=tile,
+           start=round(anchor_mono + done, 6),
+           end=round(anchor_mono + done + 0.001, 6))
+        ev("write_done", done + 0.004, tile_id=tile, bytes=1024,
+           record_s=0.003)
+        if slow:
+            ev("tile_straggler", done + 0.004, tile_id=tile,
+               duration_s=compute_s, threshold_s=0.05, median_s=0.01,
+               in_flight=False)
+        t = done + 0.005
+    ev("run_done", t, status="ok", tiles_done=len(tiles),
+       pixels=400 * len(tiles), wall_s=round(t, 4),
+       px_per_s=round(400 * len(tiles) / t, 1), fit_rate=0.8)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(_json.dumps(r, separators=(",", ":")) + "\n")
+    return len(recs)
+
+
+def run_trace_leg(workdir: str, check) -> None:
+    """Pod-trace assembly checks (obs/spans + tools/lt_trace).
+
+    Structural, exact: two synthetic skewed-clock host streams must lint
+    clean against the schema, assemble into one offset-corrected
+    monotone trace with the straggler and critical path folded, and
+    export a well-formed Chrome trace; the assembler's throughput is
+    banded so an accidentally-quadratic fold fails here rather than on
+    a real gigarun stream.  Callable on its own (``tests/test_spans``)
+    — it needs no bench baselines.
+    """
+    import contextlib
+    import io
+    import time as _time
+
+    import lt_trace
+    from check_events_schema import value_lints
+
+    from land_trendr_tpu.obs.events import validate_events_file
+    from land_trendr_tpu.obs.spans import assemble_pod_trace
+
+    stream_paths: list = []
+    n_events = 0
+    for pidx in range(TRACE_HOSTS):
+        p = str(Path(workdir) / f"gate_trace.p{pidx}.events.jsonl")
+        n_events += _synth_pod_stream(
+            p, pidx,
+            anchor_wall=1.7e9 + pidx * TRACE_SKEW_S,
+            anchor_mono=100.0 + pidx * 7000.0,
+            tiles=range(pidx * TRACE_TILES_PER_HOST,
+                        (pidx + 1) * TRACE_TILES_PER_HOST),
+            straggle_last=pidx == TRACE_HOSTS - 1,
+        )
+        stream_paths.append(p)
+    lint_errs = [
+        e for p in stream_paths
+        for e in validate_events_file(p, extra=value_lints())
+    ]
+    check(
+        "trace.streams_schema_valid", not lint_errs,
+        f"{n_events} synthetic events lint clean ({lint_errs[:2]})",
+    )
+    t0 = _time.perf_counter()
+    trace = assemble_pod_trace(stream_paths)
+    assemble_s = _time.perf_counter() - t0
+    t0s = [s["t0"] for s in trace["spans"]]
+    skew = trace["hosts"][-1].get("wall_skew_s")
+    check(
+        "trace.assembled",
+        len(trace["hosts"]) == TRACE_HOSTS and len(trace["spans"]) > 0
+        and trace["malformed"] == 0,
+        f"{len(trace['spans'])} spans from {TRACE_HOSTS} hosts",
+    )
+    # causality, checked against the GENERATOR's known timeline (sorted
+    # t0s / non-negative durs alone are true by construction — the
+    # assembler sorts and clamps): every tile's stages must land in
+    # pipeline order, and every span must sit inside the synthetic run's
+    # ~10s envelope — a mis-anchored fold (wall instead of mono, a
+    # host's anchor not subtracted) throws spans out by 1e3–1e9 seconds
+    by_tile: dict = {}
+    for s in trace["spans"]:
+        if s["name"] in ("feed", "upload", "compute", "fetch", "write"):
+            by_tile.setdefault((s["file"], s["tile"]), {})[s["name"]] = s["t0"]
+    order = ("feed", "upload", "compute", "fetch", "write")
+    complete = [s for s in by_tile.values() if len(s) == len(order)]
+    pipeline_ok = len(complete) == TRACE_HOSTS * TRACE_TILES_PER_HOST and all(
+        tuple(sorted(stages, key=stages.get)) == order for stages in complete
+    )
+    t_env = max((s["t0"] + s["dur"] for s in trace["spans"]), default=-1.0)
+    check(
+        "trace.monotone",
+        t0s == sorted(t0s) and pipeline_ok and 0.0 <= t_env < 60.0,
+        f"per-tile stages in pipeline order across {len(by_tile)} tiles, "
+        f"all spans inside the run envelope (max end {t_env:.3f}s)",
+    )
+    check(
+        "trace.skew_corrected",
+        skew is not None and abs(skew - TRACE_SKEW_S) < 1.0
+        and min(t0s, default=float("inf")) < 1.0,
+        f"reported wall skew {skew}s (injected {TRACE_SKEW_S}s), "
+        "activity aligned at the run_start origin",
+    )
+    # .get(): a degenerate assembly (no host wall → no critical_path key)
+    # is exactly the regression this leg gates — it must read as a clean
+    # FAIL row, never a KeyError traceback that loses the --json verdict
+    check(
+        "trace.straggler_folded",
+        trace["pod"]["stragglers"] == 1
+        and trace["pod"].get("critical_path") is not None,
+        f"pod stragglers={trace['pod']['stragglers']}, critical path "
+        f"bound={(trace['pod'].get('critical_path') or {}).get('bound_stage')}",
+    )
+    ev_per_s = n_events / assemble_s if assemble_s > 0 else float("inf")
+    check(
+        "trace.overhead",
+        ev_per_s >= TRACE_MIN_EVENTS_PER_S,
+        f"assembled {n_events} events in {assemble_s:.3f}s "
+        f"({ev_per_s:,.0f} ev/s vs floor {TRACE_MIN_EVENTS_PER_S:,})",
+    )
+    chrome_out = str(Path(workdir) / "gate_pod_trace.json")
+    # lt_trace prints its report to stdout; the gate's --json contract
+    # promises ONLY the verdict there, so the report is swallowed
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc = lt_trace.main([*stream_paths, "--trace", chrome_out])
+    ok_chrome = False
+    if rc == 0 and Path(chrome_out).exists():
+        chrome = json.loads(Path(chrome_out).read_text())
+        xs = [e for e in chrome.get("traceEvents", []) if e.get("ph") == "X"]
+        ok_chrome = bool(xs) and all(e["ts"] >= 0 for e in xs)
+    check(
+        "trace.chrome_export",
+        ok_chrome,
+        f"lt_trace rc={rc}, slices well-formed in {chrome_out}",
+    )
+
+
 def run_gate(workdir: str, checks: list) -> None:
-    """Run the five bench smokes and append (name, ok, detail) rows."""
+    """Run the bench smokes + the trace-assembly leg; append
+    (name, ok, detail) rows."""
     import feed_bench
     import fetch_bench
     import flight_overhead
@@ -188,6 +373,8 @@ def run_gate(workdir: str, checks: list) -> None:
             f"smoke warm speedup {got['speedup_warm']} vs band "
             f"{band:.2f} (committed {base['speedup_warm']})",
         )
+
+    run_trace_leg(workdir, check)
 
     # -- flight recorder (ring + sampler overhead) ------------------------
     base = json.loads(FLIGHT_BASELINE.read_text())
