@@ -249,6 +249,20 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--sampler-interval-s", type=float, default=5.0,
                      metavar="SEC",
                      help="flight resource-sampler period in seconds")
+    seg.add_argument("--publish", action="store_true",
+                     help="with --telemetry: fleet telemetry publish — "
+                     "periodically snapshot this process's metrics + "
+                     "live progress into an atomic "
+                     "TELEMETRY_DIR/<host>.<pid>.snap.json, the "
+                     "per-process feed tools/lt_fleet.py and 'lt top "
+                     "--dir' fold into one pod view")
+    seg.add_argument("--publish-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="fleet snapshot refresh period in seconds")
+    seg.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="shared telemetry directory for --publish "
+                     "(default WORKDIR/telemetry); point a pod's "
+                     "processes at one DIR to aggregate them")
     seg.add_argument("--max-retries", type=int, default=2)
     seg.add_argument("--retry-backoff-s", type=float, default=0.5,
                      metavar="SEC",
@@ -476,6 +490,25 @@ def build_parser() -> argparse.ArgumentParser:
                      help="flight resource-sampler period (flight_sample "
                      "events: RSS, fds, threads, queue depth, backlogs, "
                      "cache occupancy)")
+    srv.add_argument("--publish", action="store_true",
+                     help="fleet telemetry plane: publish this replica's "
+                     "snapshot under TELEMETRY_DIR, fold every snapshot "
+                     "there into one pod view each beat, retain the "
+                     "timeline in the on-disk history ring, and evaluate "
+                     "the alert rules over it (alert events, lt_alerts_* "
+                     "metrics, active alerts on /healthz and lt top)")
+    srv.add_argument("--publish-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="fleet beat period (snapshot + fold + alert "
+                     "evaluation)")
+    srv.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="shared telemetry directory for --publish "
+                     "(default WORKDIR/telemetry); point N replicas at "
+                     "one DIR to aggregate the fleet")
+    srv.add_argument("--alert-rules", default=None, metavar="FILE",
+                     help="alert-rules JSON for the fleet loop "
+                     "(land_trendr_tpu.obs.alerts); default: built-in "
+                     "host-staleness + SLO-burn rules")
 
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
@@ -749,6 +782,10 @@ def main(argv: list[str] | None = None) -> int:
                 debug_endpoints=not args.no_debug_endpoints,
                 flight_ring_events=args.flight_ring_events,
                 sampler_interval_s=args.sampler_interval_s,
+                publish=args.publish,
+                publish_interval_s=args.publish_interval_s,
+                telemetry_dir=args.telemetry_dir,
+                alert_rules=args.alert_rules,
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -920,6 +957,9 @@ def main(argv: list[str] | None = None) -> int:
                 flight=args.flight,
                 flight_ring_events=args.flight_ring_events,
                 sampler_interval_s=args.sampler_interval_s,
+                publish=args.publish,
+                publish_interval_s=args.publish_interval_s,
+                telemetry_dir=args.telemetry_dir,
             )
         except ValueError as e:
             # argument errors (bad --products name, out-of-range workers…)

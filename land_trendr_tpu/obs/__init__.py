@@ -41,6 +41,15 @@ from land_trendr_tpu.obs.metrics import (
     MetricsRegistry,
     PromFileExporter,
 )
+from land_trendr_tpu.obs.aggregate import (
+    fold_dir,
+    merge_instruments,
+    pod_sample,
+    render_prom,
+)
+from land_trendr_tpu.obs.alerts import AlertEngine, AlertRule, load_rules
+from land_trendr_tpu.obs.history import HistoryRing, counter_rate
+from land_trendr_tpu.obs.publish import TelemetryPublisher, telemetry_dir
 from land_trendr_tpu.obs.telemetry import Telemetry, metrics_path
 
 __all__ = [
@@ -66,7 +75,18 @@ __all__ = [
     "SPAN_STAGES",
     "StragglerDetector",
     "Telemetry",
+    "AlertEngine",
+    "AlertRule",
+    "HistoryRing",
+    "TelemetryPublisher",
     "assemble_pod_trace",
+    "counter_rate",
     "critical_path",
+    "fold_dir",
+    "load_rules",
+    "merge_instruments",
     "metrics_path",
+    "pod_sample",
+    "render_prom",
+    "telemetry_dir",
 ]

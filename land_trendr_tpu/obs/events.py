@@ -240,6 +240,26 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "latency_s": _NUM,
         "met": bool,
     },
+    # --- fleet telemetry plane (obs/publish + aggregate + history +
+    # --- alerts) --------------------------------------------------------
+    # one alert-rule lifecycle transition (obs/alerts.AlertEngine over
+    # the aggregated history ring): state is "firing" | "resolved" (the
+    # value lint pins the enum AND firing-before-resolved ordering per
+    # rule within a run scope).  duration_s is how long the condition
+    # held before firing / how long the alert was firing before it
+    # resolved — >= 0 by construction.  Additive event type.
+    "alert": {
+        "rule": str,
+        "state": str,
+        "value": _NUM,
+        "threshold": _NUM,
+        "duration_s": _NUM,
+    },
+    # one fleet-loop beat: the pod fold's host-health counts (the same
+    # numbers the lt_fleet_* meta-gauges and the history-ring sample
+    # carry), emitted through the server's event log so the pod's
+    # health timeline rides the normal stream.  Additive event type.
+    "fleet_sample": {"hosts": int, "stale_hosts": int},
 }
 
 #: well-known OPTIONAL fields: type-checked when present, never required
@@ -299,6 +319,12 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     },
     "profile_captured": {"error": str, "bytes": int},
     "job_slo": {"deadline_s": _NUM},
+    "alert": {"window_s": _NUM},
+    "fleet_sample": {
+        "corrupt_snaps": int,
+        "alerts_firing": int,
+        "history_samples": int,
+    },
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

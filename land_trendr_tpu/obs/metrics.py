@@ -285,6 +285,40 @@ class MetricsRegistry:
                     lines.extend(m._render())
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> list:
+        """JSON-able dump of every instrument — the fleet-publish wire
+        format (:mod:`land_trendr_tpu.obs.publish`).
+
+        One dict per instrument: ``name`` / ``kind`` / ``help`` /
+        ``labels`` plus ``value`` (counter, gauge) or ``sum`` /
+        ``count`` / ``bounds`` / ``buckets`` (histogram — per-bucket
+        RAW counts, last entry the ``+Inf`` overflow, so a cross-host
+        merge is a plain elementwise sum).  Sorted by ``(name,
+        labels)`` so two snapshots of identical state are byte-identical
+        once serialised — the aggregate layer's determinism contract
+        starts here.
+        """
+        out: list = []
+        with self._lock:
+            for (name, lkey), m in self._metrics.items():
+                kind, help = self._families[name]
+                d: dict = {
+                    "name": name,
+                    "kind": kind,
+                    "help": help,
+                    "labels": dict(m.labels),
+                }
+                if kind == "histogram":
+                    d["sum"] = m._sum
+                    d["count"] = m._count
+                    d["bounds"] = list(m.bounds)
+                    d["buckets"] = list(m._counts)
+                else:
+                    d["value"] = m._value
+                out.append(d)
+        out.sort(key=lambda d: (d["name"], sorted(d["labels"].items())))
+        return out
+
 
 class PromFileExporter:
     """Daemon thread atomically refreshing a ``.prom`` exposition file.

@@ -61,8 +61,20 @@ class Telemetry:
         metrics_interval_s: float = 5.0,
         job_id: str | None = None,
         flight=None,
+        publish_dir: str | None = None,
+        publish_interval_s: float = 5.0,
+        publish_probes=None,
     ) -> None:
         os.makedirs(workdir, exist_ok=True)
+        # fleet publish (obs/publish): with ``publish_dir``, a daemon
+        # thread snapshots this registry + the host's ``publish_probes``
+        # state into <publish_dir>/<host>.<pid>.snap.json every
+        # ``publish_interval_s`` — the per-process feed the pod
+        # aggregate (obs/aggregate, tools/lt_fleet.py) folds
+        self._publish_dir = publish_dir
+        self._publish_interval_s = publish_interval_s
+        self._publish_probes = publish_probes
+        self._publisher = None
         # serve mode threads the job id onto EVERY event of this run's
         # scope (an EventLog common field — schema-optional everywhere),
         # so a cross-job fold can attribute tile traffic per request.
@@ -323,6 +335,30 @@ class Telemetry:
                 self._server.stop()
                 self._server = None
             raise
+        if self._publish_dir:
+            from land_trendr_tpu.obs.publish import TelemetryPublisher
+
+            try:
+                self._publisher = TelemetryPublisher(
+                    self._publish_dir,
+                    self.registry,
+                    probes=self._publish_probes,
+                    interval_s=self._publish_interval_s,
+                    kind="run",
+                ).start()
+            except BaseException:
+                # publisher construction failing (unwritable telemetry
+                # dir) after the exporter/server exist: release them
+                # HERE (locality, like the exporter guard) so __init__'s
+                # guard only owns the event fd; telescoped so an
+                # exporter-stop failure cannot skip the server release
+                try:
+                    self._exporter.stop()
+                finally:
+                    if self._server is not None:
+                        self._server.stop()
+                        self._server = None
+                raise
 
     # -- paths the run summary reports -------------------------------------
     @property
@@ -336,6 +372,11 @@ class Telemetry:
     @property
     def metrics_port(self) -> int | None:
         return self._server.port if self._server is not None else None
+
+    @property
+    def publish_file(self) -> str | None:
+        """The fleet snapshot this process refreshes (None = publish off)."""
+        return self._publisher.path if self._publisher is not None else None
 
     # -- driver hooks ------------------------------------------------------
     def run_start(self, **fields: Any) -> dict:
@@ -693,11 +734,19 @@ class Telemetry:
         metrics flush raises.
         """
         try:
-            if self._server is not None:
-                self._server.stop()
-                self._server = None
+            # the publisher stops FIRST (its final snapshot reads the
+            # registry, which outlives it; a publisher-stop failure must
+            # not skip the exporter/server/event-fd releases below)
+            if self._publisher is not None:
+                self._publisher.stop()
+                self._publisher = None
         finally:
             try:
-                self._exporter.stop()
+                if self._server is not None:
+                    self._server.stop()
+                    self._server = None
             finally:
-                self.events.close()
+                try:
+                    self._exporter.stop()
+                finally:
+                    self.events.close()

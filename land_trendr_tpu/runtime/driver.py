@@ -434,6 +434,22 @@ class RunConfig:
     flight_ring_events: int = 2048
     #: flight resource-sampler period, seconds
     sampler_interval_s: float = 5.0
+    #: with ``telemetry``: fleet telemetry publish (:mod:`land_trendr_tpu.
+    #: obs.publish`) — periodically snapshot this process's metrics
+    #: registry + live progress/straggler/quarantine state into an
+    #: atomic ``<telemetry_dir>/<host>.<pid>.snap.json``, the
+    #: per-process feed the pod aggregate (``tools/lt_fleet.py``,
+    #: ``lt top --dir``, the serve fleet loop) folds into one pane of
+    #: glass.  An execution fact, never fingerprinted; a failed publish
+    #: beat is a skipped beat (the host ages toward stale), never a
+    #: failed run.
+    publish: bool = False
+    #: fleet snapshot refresh period, seconds
+    publish_interval_s: float = 5.0
+    #: shared telemetry directory override (default
+    #: ``<workdir>/telemetry``) — point a pod's processes (or several
+    #: runs) at one directory to aggregate them as one fleet
+    telemetry_dir: "str | None" = None
 
     def __post_init__(self) -> None:
         # fail fast: an invalid choice must not surface only at
@@ -558,6 +574,21 @@ class RunConfig:
         if self.sampler_interval_s <= 0:
             raise ValueError(
                 f"sampler_interval_s={self.sampler_interval_s} must be > 0"
+            )
+        if self.publish and not self.telemetry:
+            raise ValueError(
+                "publish requires telemetry=True (the fleet snapshot is "
+                "a dump of the telemetry registry; there is nothing to "
+                "publish without one)"
+            )
+        if self.publish_interval_s <= 0:
+            raise ValueError(
+                f"publish_interval_s={self.publish_interval_s} must be > 0"
+            )
+        if self.telemetry_dir is not None and not self.publish:
+            raise ValueError(
+                "telemetry_dir requires publish=True (there is no "
+                "snapshot to place without a publisher)"
             )
         if self.retry_backoff_s < 0:
             raise ValueError(
@@ -1009,6 +1040,21 @@ class Run:
         if dev is not None:
             out["device_bytes_in_use"] = dev
         return out
+
+    def _publish_probes(self) -> dict:
+        """The ``state`` block of this run's fleet snapshot
+        (obs/publish): the live progress dict plus the
+        straggler/quarantine verdicts — a point-in-time copy (progress
+        keys are fixed at construction, so the copy can never race a
+        dict resize).  Read-only: unlike the flight sampler's probes,
+        publishing never scans the straggler detector — the snapshot
+        observes, the sampler judges."""
+        return {
+            "progress": dict(self.progress),
+            "stragglers": self.straggler.stats()["stragglers"],
+            "tiles_quarantined": len(self.quarantined),
+            "job_id": self.job_id,
+        }
 
     def _dump_flight(self) -> "str | None":
         """Dump an OWNED ring to ``<workdir>/flight.jsonl`` (per-process
@@ -1705,6 +1751,7 @@ class Run:
         telemetry = None
         if cfg.telemetry:
             from land_trendr_tpu.obs import Telemetry
+            from land_trendr_tpu.obs import publish as obs_publish
 
             try:
                 # per-process port fan-out (port + process_index, like
@@ -1729,6 +1776,20 @@ class Run:
                     # tile traffic to the request that caused it
                     job_id=self.job_id,
                     flight=self.flight,
+                    # fleet publish: the per-process snapshot feed the
+                    # pod aggregate folds (lifecycle owned by the
+                    # telemetry bundle — stopped in close(), success
+                    # and abort paths alike)
+                    publish_dir=(
+                        (
+                            cfg.telemetry_dir
+                            or obs_publish.telemetry_dir(cfg.workdir)
+                        )
+                        if cfg.publish
+                        else None
+                    ),
+                    publish_interval_s=cfg.publish_interval_s,
+                    publish_probes=self._publish_probes,
                 )
             except BaseException:
                 # e.g. a busy --metrics-port: Telemetry cleans up its own
@@ -2298,6 +2359,8 @@ class Run:
                     }
                     if telemetry.metrics_port is not None:
                         summary["telemetry"]["metrics_port"] = telemetry.metrics_port
+                    if telemetry.publish_file is not None:
+                        summary["telemetry"]["snapshot"] = telemetry.publish_file
                     telemetry.close()  # final exposition flush before anyone reads it
                     # the closed event log can take no more fault_injected emits;
                     # merge.peer fires past this point are still counted/logged

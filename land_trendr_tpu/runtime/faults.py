@@ -40,6 +40,11 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
 ``debug.profile``   on-demand profiler capture (``POST /debug/profile``):
                     the capture fails (``profile_captured`` carries
                     ``ok=false``); the job and the server live
+``obs.publish``     fleet snapshot publish (``obs/publish.py``): the
+                    beat is skipped, the host ages toward stale; the
+                    run lives
+``history.append``  fleet history-ring append (``obs/history.py``):
+                    one sample is lost; the ring stays consistent
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -108,6 +113,8 @@ SEAMS = (
     "serve.submit",
     "serve.job",
     "debug.profile",
+    "obs.publish",
+    "history.append",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -128,6 +135,8 @@ _DEFAULT_KIND = {
     "serve.submit": "io",
     "serve.job": "runtime",
     "debug.profile": "runtime",
+    "obs.publish": "io",
+    "history.append": "io",
 }
 
 
@@ -342,14 +351,18 @@ _active: "FaultPlan | None" = None
 
 
 def activate(plan: FaultPlan) -> FaultPlan:
-    """Install ``plan`` as the process's active schedule and register the
-    io-layer hook (:func:`land_trendr_tpu.io.blockcache.set_fault_plan`)
-    so decode-path seams see it without importing ``runtime/``."""
+    """Install ``plan`` as the process's active schedule and register
+    the layer hooks (:func:`land_trendr_tpu.io.blockcache.
+    set_fault_plan` for the decode-path seams, :func:`land_trendr_tpu.
+    obs.publish.set_fault_plan` for the fleet-telemetry seams) so those
+    layers see it without importing ``runtime/``."""
     global _active
     _active = plan
     from land_trendr_tpu.io import blockcache
+    from land_trendr_tpu.obs import publish as obs_publish
 
     blockcache.set_fault_plan(plan)
+    obs_publish.set_fault_plan(plan)
     return plan
 
 
@@ -357,8 +370,10 @@ def deactivate() -> None:
     global _active
     _active = None
     from land_trendr_tpu.io import blockcache
+    from land_trendr_tpu.obs import publish as obs_publish
 
     blockcache.set_fault_plan(None)
+    obs_publish.set_fault_plan(None)
 
 
 def active() -> "FaultPlan | None":

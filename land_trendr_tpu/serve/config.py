@@ -111,6 +111,30 @@ class ServeConfig:
     flight_ring_events: int = 2048
     #: flight resource-sampler period, seconds
     sampler_interval_s: float = 5.0
+    #: fleet telemetry plane (:mod:`land_trendr_tpu.obs` publish /
+    #: aggregate / history / alerts): with ``telemetry``, the server
+    #: periodically (1) snapshots its registry + queue/SLO state into
+    #: an atomic ``<telemetry_dir>/<host>.<pid>.snap.json``, (2) folds
+    #: EVERY snapshot under that shared directory into one pod view —
+    #: sibling replicas and standalone runs pointed at the same dir
+    #: included — (3) appends the fold to the on-disk history ring
+    #: under ``<workdir>/history``, and (4) evaluates the alert rules
+    #: over that history (``alert`` events, ``lt_alerts_*`` metrics,
+    #: active alerts on ``/healthz`` and ``lt top``).
+    publish: bool = False
+    #: fleet beat period, seconds (snapshot refresh + fold + alert
+    #: evaluation)
+    publish_interval_s: float = 5.0
+    #: shared telemetry directory override (default
+    #: ``<workdir>/telemetry``) — point N replicas at one directory to
+    #: aggregate the fleet
+    telemetry_dir: str | None = None
+    #: alert-rules file (JSON, :func:`land_trendr_tpu.obs.alerts.
+    #: load_rules`) — ``None`` uses the built-in defaults (host
+    #: staleness/absence + SLO burn).  Parsed at config time: a typo'd
+    #: rule is a startup error, not a dead rule discovered after the
+    #: incident.
+    alert_rules: str | None = None
 
     def __post_init__(self) -> None:
         if not (0 <= self.serve_port <= 65535):
@@ -196,6 +220,37 @@ class ServeConfig:
             raise ValueError(
                 f"sampler_interval_s={self.sampler_interval_s} must be > 0"
             )
+        if self.publish and not self.telemetry:
+            raise ValueError(
+                "publish requires telemetry=True (the fleet snapshot is "
+                "a dump of the telemetry registry; there is nothing to "
+                "publish without one)"
+            )
+        if self.publish_interval_s <= 0:
+            raise ValueError(
+                f"publish_interval_s={self.publish_interval_s} must be > 0"
+            )
+        if self.telemetry_dir is not None and not self.publish:
+            raise ValueError(
+                "telemetry_dir requires publish=True (there is no "
+                "snapshot to place without a publisher)"
+            )
+        if self.alert_rules is not None:
+            if not self.publish:
+                raise ValueError(
+                    "alert_rules requires publish=True (rules are "
+                    "evaluated by the fleet loop)"
+                )
+            # parse NOW: a typo'd rule is a startup error, like
+            # fault_schedule below
+            from land_trendr_tpu.obs.alerts import load_rules
+
+            try:
+                load_rules(self.alert_rules)
+            except OSError as e:
+                raise ValueError(
+                    f"alert_rules file unreadable: {e}"
+                ) from None
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam is a config error at startup, not
             # a dead injection discovered after the soak run (the same

@@ -105,9 +105,23 @@ class _ServeTelemetry:
     """
 
     def __init__(
-        self, cfg: ServeConfig, probes: "Any | None" = None
+        self,
+        cfg: ServeConfig,
+        probes: "Any | None" = None,
+        publish_probes: "Any | None" = None,
     ) -> None:
         os.makedirs(cfg.workdir, exist_ok=True)
+        # fleet telemetry plane handles: predeclared so _release() is
+        # callable from any depth of a partially finished construction
+        # (the LT008 lesson the rest of this class already carries)
+        self._publisher = None
+        self.history = None
+        self.engine = None
+        self._fleet_thread: "threading.Thread | None" = None
+        self._fleet_stop = threading.Event()
+        self._fleet_lock = threading.Lock()
+        self._active_alerts: list = []
+        self._fleet_counts = {"folded": 0, "stale": 0, "corrupt": 0}
         #: the flight ring behind /debug/flight: mirrors every SERVER
         #: event here plus every JOB run's events (the server threads
         #: this recorder into each Run's telemetry), so the ring shows
@@ -126,6 +140,8 @@ class _ServeTelemetry:
         self._sampler: "ResourceSampler | None" = None
         try:
             self._init_instruments(cfg, probes)
+            if cfg.publish:
+                self._init_fleet(cfg, publish_probes)
         except BaseException:
             # a half-built telemetry bundle must not leak the event fd /
             # exporter thread / metrics port into the caller's process
@@ -138,21 +154,26 @@ class _ServeTelemetry:
         close rides the innermost finally so a server/exporter/sampler
         stop that ALSO fails cannot skip it (LT008)."""
         try:
-            if self._sampler is not None:
-                self._sampler.stop()
-                self._sampler = None
+            # fleet loop first: it emits into the event log and reads
+            # the registry, both of which the later steps tear down
+            self._stop_fleet()
         finally:
             try:
-                if self._server is not None:
-                    self._server.stop()
-                    self._server = None
+                if self._sampler is not None:
+                    self._sampler.stop()
+                    self._sampler = None
             finally:
                 try:
-                    if self._exporter is not None:
-                        self._exporter.stop()
-                        self._exporter = None
+                    if self._server is not None:
+                        self._server.stop()
+                        self._server = None
                 finally:
-                    self.events.close()
+                    try:
+                        if self._exporter is not None:
+                            self._exporter.stop()
+                            self._exporter = None
+                    finally:
+                        self.events.close()
 
     def _init_instruments(self, cfg: ServeConfig, probes=None) -> None:
         self.registry = MetricsRegistry()
@@ -285,6 +306,170 @@ class _ServeTelemetry:
                         self._server.stop()
                         self._server = None
                 raise
+
+    def _stop_fleet(self) -> None:
+        """Stop the fleet loop, flush the terminal snapshot, close the
+        history ring.  Idempotent; called from :meth:`close` BEFORE the
+        terminal events (so ``run_done`` stays the scope's tail — the
+        sampler convention) and again from :meth:`_release` for the
+        construction-guard path."""
+        self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=10)
+            self._fleet_thread = None
+        if self._publisher is not None:
+            self._publisher.stop()
+            self._publisher = None
+        if self.history is not None:
+            self.history.close()
+            self.history = None
+
+    # -- the fleet telemetry plane (obs publish/aggregate/history/alerts) --
+    #: history read window for alert evaluation, seconds — comfortably
+    #: above any sane rule window so rate()/absence rules always see
+    #: their full span
+    _FLEET_HISTORY_S = 600.0
+
+    def _init_fleet(self, cfg: ServeConfig, publish_probes) -> None:
+        from land_trendr_tpu.obs.alerts import (
+            DEFAULT_RULES,
+            AlertEngine,
+            load_rules,
+        )
+        from land_trendr_tpu.obs.history import HistoryRing
+        from land_trendr_tpu.obs.publish import (
+            TelemetryPublisher,
+            telemetry_dir,
+        )
+
+        r = self.registry
+        self._alerts_fired = r.counter(
+            "lt_alerts_fired_total",
+            "alert-rule firing transitions (obs/alerts over the fleet "
+            "history)",
+        )
+        self._alerts_resolved = r.counter(
+            "lt_alerts_resolved_total", "alert-rule resolved transitions"
+        )
+        self._alerts_firing = r.gauge(
+            "lt_alerts_firing", "alert rules currently firing"
+        )
+        self._fleet_hosts = r.gauge(
+            "lt_fleet_hosts", "snapshots folded into the latest pod view"
+        )
+        self._fleet_stale = r.gauge(
+            "lt_fleet_stale_hosts", "hosts past their staleness bound"
+        )
+        self._telemetry_dir = cfg.telemetry_dir or telemetry_dir(cfg.workdir)
+        self._publisher = TelemetryPublisher(
+            self._telemetry_dir,
+            self.registry,
+            probes=publish_probes,
+            interval_s=cfg.publish_interval_s,
+            kind="serve",
+        )
+        try:
+            self.history = HistoryRing(os.path.join(cfg.workdir, "history"))
+            self.engine = AlertEngine(
+                load_rules(cfg.alert_rules)
+                if cfg.alert_rules
+                else DEFAULT_RULES
+            )
+            self._fleet_interval_s = cfg.publish_interval_s
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_loop, name="lt-fleet-loop", daemon=True
+            )
+            self._fleet_thread.start()
+        except BaseException:
+            # a later step raising (unwritable history dir, a rules file
+            # deleted since config validation, thread-start failure) must
+            # not leak the publisher/ring — released HERE (locality, the
+            # exporter-guard pattern) so __init__'s guard stays a backstop
+            self._stop_fleet()
+            raise
+
+    def _fleet_loop(self) -> None:
+        # first beat right away (a short-lived server still folds once),
+        # then every publish_interval_s until _release sets the stop
+        while True:
+            try:
+                self.fleet_beat()
+            except Exception:
+                # a sick beat (full disk on the emit, FS churn mid-fold)
+                # skips — the fleet plane must never take down the
+                # server it watches
+                log.debug("fleet beat failed", exc_info=True)
+            if self._fleet_stop.wait(self._fleet_interval_s):
+                return
+
+    def fleet_beat(self, now: "float | None" = None) -> dict:
+        """One fleet beat: publish own snapshot → fold the shared dir →
+        append the pod sample to the history ring → evaluate alert
+        rules → emit ``alert`` transitions + one ``fleet_sample``.
+        Called from the loop thread (and directly by tests, with a
+        pinned ``now`` for determinism); returns the pod view."""
+        from land_trendr_tpu.obs import aggregate
+
+        if now is None:
+            now = time.time()
+        try:
+            self._publisher.publish_now()
+        except Exception:
+            pass  # a skipped beat is staleness, never a failed server
+        # newer_than bounds how long a departed host haunts the fold: a
+        # restarted replica's dead predecessor (same workdir, new pid)
+        # reads STALE — and alerts — for the history window, then drops
+        # to listed-but-excluded instead of double-counting its counters
+        # and paging forever after every routine restart
+        view = aggregate.fold_dir(
+            self._telemetry_dir,
+            now=now,
+            newer_than=now - self._FLEET_HISTORY_S,
+        )
+        sample = aggregate.pod_sample(view)
+        try:
+            self.history.append(sample)
+        except Exception:
+            pass  # one lost sample (history.append seam, FS pressure)
+        samples, _ = self.history.read(newer_than=now - self._FLEET_HISTORY_S)
+        transitions = self.engine.evaluate(samples, now)
+        for tr in transitions:
+            self.events.emit("alert", **tr)
+            if tr["state"] == "firing":
+                self._alerts_fired.inc()
+            else:
+                self._alerts_resolved.inc()
+        active = self.engine.active()
+        counts = view["counts"]
+        with self._fleet_lock:
+            self._active_alerts = active
+            self._fleet_counts = {
+                "folded": counts["folded"],
+                "stale": counts["stale"],
+                "corrupt": counts["corrupt"],
+            }
+        self._alerts_firing.set(len(active))
+        self._fleet_hosts.set(counts["folded"])
+        self._fleet_stale.set(counts["stale"])
+        self.events.emit(
+            "fleet_sample",
+            hosts=counts["folded"],
+            stale_hosts=counts["stale"],
+            corrupt_snaps=counts["corrupt"],
+            alerts_firing=len(active),
+            history_samples=len(samples),
+        )
+        return view
+
+    def active_alerts(self) -> list:
+        """Currently-firing alerts (JSON-safe; ``/healthz``, the
+        publisher's ``state.alerts`` block, ``lt top``)."""
+        with self._fleet_lock:
+            return list(self._active_alerts)
+
+    def fleet_counts(self) -> dict:
+        with self._fleet_lock:
+            return dict(self._fleet_counts)
 
     def _done_counter(self, status: str):
         c = self._jobs_done.get(status)
@@ -419,6 +604,13 @@ class _ServeTelemetry:
 
     def close(self, status: str, wall_s: float, stats: dict) -> None:
         try:
+            # fleet loop down FIRST: a beat landing between the terminal
+            # events below and _release would append fleet_sample/alert
+            # lines behind the scope's run_done
+            self._stop_fleet()
+        except Exception as exc:
+            log.error("fleet-loop stop failed: %s", exc)
+        try:
             self.events.emit(
                 "program_cache",
                 hits=int(stats.get("hits", 0)),
@@ -500,7 +692,11 @@ class SegmentationServer:
             )
 
             self.telemetry = (
-                _ServeTelemetry(cfg, probes=self._sampler_probes)
+                _ServeTelemetry(
+                    cfg,
+                    probes=self._sampler_probes,
+                    publish_probes=self._fleet_probes,
+                )
                 if cfg.telemetry
                 else None
             )
@@ -593,6 +789,24 @@ class SegmentationServer:
                     "upload_backlog", "stragglers",
                 ):
                     out[k] = int(p.get(k, 0))
+        return out
+
+    def _fleet_probes(self) -> dict:
+        """The ``state`` block of this replica's fleet snapshot
+        (obs/publish): queue/job facts plus the currently-firing alerts
+        — so ``lt_fleet`` and ``lt top --dir`` surface a replica's
+        alerts straight from the shared directory, no HTTP needed."""
+        with self._lock:
+            progress = {
+                "queue_depth": self._queued,
+                "running": 1 if self._running_id is not None else 0,
+                "jobs_total": len(self._jobs),
+                "jobs_terminal": self._terminal,
+            }
+        out: dict = {"progress": progress}
+        tel = self.telemetry
+        if tel is not None:
+            out["alerts"] = tel.active_alerts()
         return out
 
     # -- admission ---------------------------------------------------------
@@ -717,6 +931,13 @@ class SegmentationServer:
             snap["program_cache"].get("keys", 0)
         )
         snap["uptime_s"] = round(time.time() - self._t0, 3)
+        tel = self.telemetry
+        if tel is not None and self.cfg.publish:
+            # fleet facts ride /healthz directly (like the warm-program
+            # count): an LB/operator check sees firing alerts and stale
+            # hosts without scraping the exposition
+            snap["alerts"] = tel.active_alerts()
+            snap["fleet"] = tel.fleet_counts()
         return snap
 
     # -- the /debug surface ------------------------------------------------

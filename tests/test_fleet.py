@@ -1,0 +1,657 @@
+"""Fleet telemetry plane tests (ISSUE 11).
+
+Pins the publish → aggregate → history → alerts contracts:
+
+* the **publisher** writes atomic per-process snapshots (registry dump +
+  host state) whose metrics round-trip losslessly;
+* the **aggregate** fold: counters equal per-host sums, gauges follow
+  the per-instrument policy table, histogram bucket merge has exact
+  parity with observing everything in one registry, a torn snapshot is
+  flagged corrupt (never a crash), a host beyond ``newer_than`` is
+  listed-but-excluded, pid reuse is superseded by ``generation``, and
+  two folds render byte-identical exposition;
+* the **history ring**: whole-oldest-segment eviction under a byte
+  budget, reopen-after-crash GC adopts a torn live tail (dropping only
+  the torn line), and counter rates never go negative across a process
+  restart's counter reset;
+* the **alert engine**: threshold fire → hold-down → resolve on a
+  scripted history, deterministically; absence rules fire on stale
+  hosts; the ``alert`` / ``fleet_sample`` events validate and their
+  value lints catch a bad state enum and resolved-before-firing;
+* **wiring**: a real ``--publish`` run leaves a foldable snapshot and
+  ``lt_fleet`` / ``lt top --dir`` render it; a publish-enabled server
+  beats its fleet loop, fires a firing → resolved alert on a planted
+  stale host, and surfaces it on ``/healthz`` and in the event stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from land_trendr_tpu.obs import aggregate
+from land_trendr_tpu.obs.alerts import (
+    ALERT_STATES,
+    DEFAULT_RULES,
+    AlertEngine,
+    AlertRule,
+    load_rules,
+    parse_rules,
+)
+from land_trendr_tpu.obs.events import EventLog, validate_events_file
+from land_trendr_tpu.obs.history import HistoryRing, counter_rate
+from land_trendr_tpu.obs.metrics import MetricsRegistry
+from land_trendr_tpu.obs.publish import SNAP_SCHEMA, TelemetryPublisher
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def _registry(tiles: int = 5, backlog: int = 2, burn: float = 0.1):
+    r = MetricsRegistry()
+    r.counter("lt_tiles_done_total", "tiles").inc(tiles)
+    r.gauge("lt_feed_backlog", "backlog").set(backlog)
+    r.gauge("lt_slo_burn_rate", "burn").set(burn)
+    return r
+
+
+def _publish(tmp_path, host: str, registry, **kw) -> TelemetryPublisher:
+    pub = TelemetryPublisher(
+        str(tmp_path), registry, interval_s=kw.pop("interval_s", 5.0),
+        host=host, **kw,
+    )
+    pub.publish_now()
+    return pub
+
+
+# ---------------------------------------------------------------------------
+# publish
+
+
+def test_publisher_snapshot_shape_and_seq(tmp_path):
+    reg = _registry()
+    pub = _publish(
+        tmp_path, "h1", reg,
+        probes=lambda: {"progress": {"phase": "pipeline", "tiles_done": 3}},
+    )
+    snap = json.loads(Path(pub.path).read_text())
+    assert snap["schema"] == SNAP_SCHEMA
+    assert snap["host"] == "h1" and snap["pid"] == os.getpid()
+    assert snap["seq"] == 1 and snap["generation"] > 0
+    assert snap["state"]["progress"]["phase"] == "pipeline"
+    names = {m["name"] for m in snap["metrics"]}
+    assert {"lt_tiles_done_total", "lt_feed_backlog"} <= names
+    pub.publish_now()
+    assert json.loads(Path(pub.path).read_text())["seq"] == 2
+    # no tmp litter: every write renamed or cleaned
+    assert list(Path(tmp_path).glob("*.tmp")) == []
+
+
+def test_publisher_probe_failure_degrades_not_raises(tmp_path):
+    def sick():
+        raise RuntimeError("probe died")
+
+    pub = _publish(tmp_path, "h1", _registry(), probes=sick)
+    snap = json.loads(Path(pub.path).read_text())
+    assert snap["state"] == {}  # degraded, not dead
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+
+
+def test_fold_counters_sum_gauges_policy(tmp_path):
+    _publish(tmp_path, "h1", _registry(tiles=5, backlog=2, burn=0.1))
+    _publish(tmp_path, "h2", _registry(tiles=7, backlog=3, burn=0.4))
+    view = aggregate.fold_dir(str(tmp_path))
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 12  # counters sum
+    assert m["lt_feed_backlog"]["value"] == 5  # GAUGE_SUM policy
+    assert m["lt_slo_burn_rate"]["value"] == pytest.approx(0.4)  # max
+    assert view["counts"] == {
+        "snapshots": 2, "folded": 2, "stale": 0, "corrupt": 0, "excluded": 0,
+    }
+
+
+def test_histogram_bucket_merge_parity(tmp_path):
+    bounds = (0.1, 1.0, 10.0)
+    obs_a, obs_b = [0.05, 0.5, 5.0, 50.0], [0.07, 0.07, 2.0]
+    ra, rb, rall = MetricsRegistry(), MetricsRegistry(), MetricsRegistry()
+    for r, obs in ((ra, obs_a), (rb, obs_b), (rall, obs_a + obs_b)):
+        h = r.histogram("lt_tile_compute_seconds", "h", buckets=bounds)
+        for v in obs:
+            h.observe(v)
+    _publish(tmp_path, "a", ra)
+    _publish(tmp_path, "b", rb)
+    merged = {
+        i["name"]: i
+        for i in aggregate.fold_dir(str(tmp_path))["metrics"]
+    }["lt_tile_compute_seconds"]
+    direct = rall.snapshot()[0]
+    assert merged["buckets"] == direct["buckets"]
+    assert merged["count"] == direct["count"]
+    assert merged["sum"] == pytest.approx(direct["sum"])
+
+
+def test_histogram_bounds_mismatch_is_flagged_conflict(tmp_path):
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    ra.histogram("lt_h", "h", buckets=(1.0, 2.0)).observe(1.5)
+    rb.histogram("lt_h", "h", buckets=(5.0, 6.0)).observe(5.5)
+    _publish(tmp_path, "a", ra)
+    _publish(tmp_path, "b", rb)
+    view = aggregate.fold_dir(str(tmp_path))
+    assert any("bounds differ" in c for c in view["conflicts"])
+
+
+def test_torn_snapshot_flagged_corrupt_not_fatal(tmp_path):
+    _publish(tmp_path, "ok-host", _registry(tiles=5))
+    (tmp_path / "torn-host.99.snap.json").write_text('{"schema": 1, "ho')
+    view = aggregate.fold_dir(str(tmp_path))
+    assert view["counts"]["corrupt"] == 1
+    assert view["counts"]["folded"] == 1
+    torn = [h for h in view["hosts"] if h.get("corrupt")]
+    assert len(torn) == 1 and torn[0]["excluded"]  # listed, not folded
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 5  # the healthy host folds
+
+
+def test_stale_host_flagged_and_newer_than_excludes(tmp_path):
+    _publish(tmp_path, "fresh", _registry(tiles=5))
+    old = json.loads(
+        Path(_publish(tmp_path, "dead", _registry(tiles=100)).path).read_text()
+    )
+    old["t_wall"] = time.time() - 3600
+    old["host"] = "dead"
+    dead = tmp_path / "dead.1.snap.json"
+    dead.write_text(json.dumps(old))
+    # staleness judges the FRESHER of t_wall and mtime: a genuinely dead
+    # host's file has both old
+    os.utime(dead, (old["t_wall"], old["t_wall"]))
+    os.unlink(aggregate.discover_snapshots(str(tmp_path))[1])  # the live dup
+    now = time.time()
+    # stale (beyond 3x interval) but still folded: flagged, not dropped
+    view = aggregate.fold_dir(str(tmp_path), now=now)
+    stale = [h for h in view["hosts"] if h["stale"]]
+    assert [h["host"] for h in stale] == ["dead"]
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 105
+    # beyond newer_than: excluded from the value fold, still LISTED
+    view = aggregate.fold_dir(
+        str(tmp_path), now=now, newer_than=now - 600
+    )
+    assert [h["host"] for h in view["hosts"] if h["excluded"]] == ["dead"]
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 5
+
+
+def test_pid_reuse_superseded_by_generation(tmp_path):
+    pub = _publish(tmp_path, "h1", _registry(tiles=100))
+    old = json.loads(Path(pub.path).read_text())
+    # the dead predecessor: same (host, pid), LOWER generation, stamped
+    # under a different filename (a reused telemetry dir)
+    old["generation"] -= 1
+    (tmp_path / "h1.stale-dup.snap.json").write_text(json.dumps(old))
+    view = aggregate.fold_dir(str(tmp_path))
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 100  # not 200: no double count
+    sup = [h for h in view["hosts"] if h.get("superseded")]
+    assert len(sup) == 1
+
+
+def test_fold_byte_stable_across_folds(tmp_path):
+    _publish(tmp_path, "h1", _registry(tiles=5))
+    _publish(tmp_path, "h2", _registry(tiles=7))
+    now = time.time()
+    a = aggregate.render_prom(aggregate.fold_dir(str(tmp_path), now=now))
+    b = aggregate.render_prom(aggregate.fold_dir(str(tmp_path), now=now))
+    assert a == b and "lt_fleet_hosts 2" in a
+
+
+# ---------------------------------------------------------------------------
+# history
+
+
+def test_history_ring_segment_eviction(tmp_path):
+    d = str(tmp_path / "hist")
+    sample = {"t": 0.0, "hosts": 1, "stale_hosts": 0, "metrics": {"x": 1.0}}
+    seg_bytes = (len(json.dumps(sample, separators=(",", ":"))) + 30) * 4
+    ring = HistoryRing(d, budget_bytes=seg_bytes * 2, samples_per_segment=4)
+    for i in range(40):
+        ring.append({**sample, "t": float(i)})
+    ring.close()
+    segs = HistoryRing(d).segments()
+    assert 1 <= len(segs) <= 3  # whole-oldest-segment eviction kept it bounded
+    samples, malformed = HistoryRing(d).read()
+    assert malformed == 0
+    assert samples[-1]["t"] == 39.0  # the newest survive
+    assert len(samples) <= 12
+
+
+def test_history_reopen_after_crash_adopts_torn_tail(tmp_path):
+    d = str(tmp_path / "hist")
+    os.makedirs(d)
+    # a crashed writer's live segment: two good lines + one torn line
+    left = Path(d) / "hist-100-999.open.jsonl"
+    left.write_text(
+        '{"t": 1.0, "hosts": 1}\n{"t": 2.0, "hosts": 1}\n{"t": 3.0, "ho'
+    )
+    old = time.time() - 3600
+    os.utime(left, (old, old))
+    ring = HistoryRing(d)
+    assert ring.adopted_segments == 1
+    assert ring.dropped_torn_lines == 1
+    samples, malformed = ring.read()
+    assert [s["t"] for s in samples] == [1.0, 2.0]
+    assert malformed == 0  # the torn line was GC'd at adopt, not re-read
+    assert not list(Path(d).glob("*.open.jsonl"))
+    ring.close()
+
+
+def test_history_fresh_open_of_live_sibling_left_alone(tmp_path):
+    d = str(tmp_path / "hist")
+    os.makedirs(d)
+    sibling = Path(d) / "hist-200-888.open.jsonl"
+    sibling.write_text('{"t": 5.0, "hosts": 1}\n')  # fresh mtime: live
+    ring = HistoryRing(d)
+    assert ring.adopted_segments == 0
+    assert sibling.exists()
+    samples, _ = ring.read()
+    assert [s["t"] for s in samples] == [5.0]  # still readable as the tail
+    ring.close()
+
+
+def test_counter_rate_reset_never_negative():
+    # a process restart resets the counter 100 -> 3: the reset-aware
+    # rate counts the post-reset value as growth from zero, never a
+    # negative increase
+    samples = [
+        {"t": 0.0, "metrics": {"c": 90.0}},
+        {"t": 10.0, "metrics": {"c": 100.0}},
+        {"t": 20.0, "metrics": {"c": 3.0}},
+        {"t": 30.0, "metrics": {"c": 9.0}},
+    ]
+    rate = counter_rate(samples, "c", window_s=100.0, now=30.0)
+    assert rate == pytest.approx((10 + 3 + 6) / 30.0)
+    assert counter_rate(samples[:1], "c", 100.0, now=0.0) is None
+    # monotone decrease everywhere still clamps at zero
+    down = [
+        {"t": 0.0, "metrics": {"c": 5.0}},
+        {"t": 10.0, "metrics": {"c": 0.0}},
+    ]
+    assert counter_rate(down, "c", 100.0, now=10.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# alerts
+
+
+def test_alert_threshold_fire_holddown_resolve_deterministic():
+    rule = AlertRule(
+        name="q", kind="threshold", metric="q", op=">", value=10,
+        for_s=2.0, hold_down_s=3.0,
+    )
+
+    def run() -> list:
+        eng = AlertEngine((rule,))
+        out = []
+        for t in range(20):
+            q = 20.0 if 5 <= t < 10 else 0.0
+            out += [
+                (t, tr["state"], tr["duration_s"])
+                for tr in eng.evaluate(
+                    [{"t": float(t), "metrics": {"q": q}}], float(t)
+                )
+            ]
+        return out
+
+    a, b = run(), run()
+    assert a == b == [(7, "firing", 2.0), (13, "resolved", 6.0)]
+
+
+def test_alert_transient_below_for_s_never_fires():
+    rule = AlertRule(
+        name="q", kind="threshold", metric="q", op=">", value=10, for_s=5.0,
+    )
+    eng = AlertEngine((rule,))
+    trs = []
+    for t in range(10):
+        q = 20.0 if t in (2, 3) else 0.0  # a 2s transient under for_s=5
+        trs += eng.evaluate([{"t": float(t), "metrics": {"q": q}}], float(t))
+    assert trs == []
+
+
+def test_alert_absent_rule_fires_on_stale_host_and_dark_plane():
+    rule = AlertRule(name="stale", kind="absent", window_s=30.0)
+    eng = AlertEngine((rule,))
+    trs = eng.evaluate([{"t": 100.0, "hosts": 2, "stale_hosts": 1}], 100.0)
+    assert [t["state"] for t in trs] == ["firing"]
+    assert eng.active()[0]["rule"] == "stale"
+    # a dark plane (no sample in the window at all) keeps it firing
+    eng2 = AlertEngine((rule,))
+    assert [t["state"] for t in eng2.evaluate([], 100.0)] == ["firing"]
+
+
+def test_alert_rate_rule_over_history():
+    rule = AlertRule(
+        name="fail_rate", kind="rate", metric="lt_tiles_failed_total",
+        op=">", value=0.5, window_s=100.0,
+    )
+    eng = AlertEngine((rule,))
+    samples = [
+        {"t": float(t), "metrics": {"lt_tiles_failed_total": t * 2.0}}
+        for t in range(5)
+    ]
+    trs = eng.evaluate(samples, 4.0)
+    assert [t["state"] for t in trs] == ["firing"]  # 2 fails/s > 0.5
+
+
+def test_rules_parse_validation():
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_rules([{"name": "x", "metrik": "q"}])
+    with pytest.raises(ValueError, match="kind"):
+        parse_rules([{"name": "x", "kind": "nope"}])
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_rules([{"name": "x", "metric": "q"}, {"name": "x", "metric": "q"}])
+    assert parse_rules('{"rules": [{"name": "x", "metric": "q"}]}')[0].name == "x"
+
+
+def test_alert_event_schema_and_value_lints(tmp_path):
+    from check_events_schema import ALERT_STATES as LINT_STATES
+    from check_events_schema import value_lints
+
+    assert LINT_STATES == ALERT_STATES  # the lint table cannot drift
+    path = str(tmp_path / "events.jsonl")
+    with EventLog(path) as log:
+        log.run_start(
+            fingerprint="fleet", process_index=0, process_count=1,
+            tiles_total=0, tiles_todo=0, tiles_skipped_resume=0,
+            mesh_devices=0, impl="serve",
+        )
+        log.emit("fleet_sample", hosts=2, stale_hosts=1, corrupt_snaps=0,
+                 alerts_firing=1, history_samples=7)
+        log.emit("alert", rule="q", state="firing", value=20.0,
+                 threshold=10.0, duration_s=2.0, window_s=60.0)
+        log.emit("alert", rule="q", state="resolved", value=0.0,
+                 threshold=10.0, duration_s=6.0)
+    assert validate_events_file(path, extra=value_lints()) == []
+
+    # negative cases: bad enum, resolved-before-firing, double firing,
+    # negative duration
+    bad = str(tmp_path / "bad.jsonl")
+    with EventLog(bad) as log:
+        log.run_start(
+            fingerprint="fleet", process_index=0, process_count=1,
+            tiles_total=0, tiles_todo=0, tiles_skipped_resume=0,
+            mesh_devices=0, impl="serve",
+        )
+        log.emit("alert", rule="a", state="flapping", value=1.0,
+                 threshold=1.0, duration_s=1.0)
+        log.emit("alert", rule="b", state="resolved", value=0.0,
+                 threshold=1.0, duration_s=1.0)
+        log.emit("alert", rule="c", state="firing", value=1.0,
+                 threshold=1.0, duration_s=1.0)
+        log.emit("alert", rule="c", state="firing", value=1.0,
+                 threshold=1.0, duration_s=-2.0)
+    errs = "\n".join(validate_events_file(bad, extra=value_lints()))
+    assert "not one of" in errs
+    assert "resolved without a prior firing" in errs
+    assert "fired twice" in errs
+    assert "duration_s is negative" in errs
+
+
+# ---------------------------------------------------------------------------
+# wiring: driver run, lt_fleet, lt top
+
+
+@pytest.fixture(scope="module")
+def publish_run(tmp_path_factory):
+    """One tiny --publish run; returns (summary, workdir)."""
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import (
+        RunConfig,
+        run_stack,
+        stack_from_synthetic,
+    )
+
+    wd = str(tmp_path_factory.mktemp("fleet_run") / "work")
+    stack = stack_from_synthetic(
+        make_stack(SceneSpec(width=40, height=20, year_start=2000,
+                             year_end=2006, seed=5))
+    )
+    cfg = RunConfig(
+        workdir=wd,
+        out_dir=wd + "_o",
+        tile_size=20,
+        params=LTParams(max_segments=4, vertex_count_overshoot=2),
+        telemetry=True,
+        publish=True,
+        publish_interval_s=60.0,
+    )
+    return run_stack(stack, cfg), wd
+
+
+def test_run_publishes_foldable_snapshot(publish_run):
+    summary, wd = publish_run
+    snap_file = summary["telemetry"]["snapshot"]
+    assert os.path.exists(snap_file)
+    snap = json.loads(Path(snap_file).read_text())
+    assert snap["kind"] == "run"
+    # the terminal flush carries the finished run's state
+    assert snap["state"]["progress"]["phase"] == "done"
+    assert snap["state"]["progress"]["tiles_done"] == 2
+    view = aggregate.fold_dir(os.path.join(wd, "telemetry"))
+    m = {i["name"]: i for i in view["metrics"]}
+    assert m["lt_tiles_done_total"]["value"] == 2
+
+
+def test_publish_config_validation():
+    from land_trendr_tpu.runtime import RunConfig
+
+    with pytest.raises(ValueError, match="publish requires telemetry"):
+        RunConfig(publish=True)
+    with pytest.raises(ValueError, match="telemetry_dir requires publish"):
+        RunConfig(telemetry_dir="/tmp/t")
+    with pytest.raises(ValueError, match="publish_interval_s"):
+        RunConfig(telemetry=True, publish=True, publish_interval_s=0)
+
+
+def test_lt_fleet_report_and_prom(publish_run, tmp_path, capsys):
+    _, wd = publish_run
+    import lt_fleet
+
+    tel = os.path.join(wd, "telemetry")
+    assert lt_fleet.main([tel]) == 0
+    out = capsys.readouterr().out
+    assert "lt fleet — 1 host(s) folded" in out
+    assert "alerts: none firing" in out
+    prom = str(tmp_path / "pod.prom")
+    assert lt_fleet.main([tel, "--prom", prom, "--json"]) == 0
+    text = Path(prom).read_text()
+    assert "lt_fleet_hosts 1" in text
+    assert "lt_tiles_done_total 2" in text
+    view = json.loads(capsys.readouterr().out)
+    assert view["counts"]["folded"] == 1
+    # an empty dir is a clean exit 2, not a traceback
+    assert lt_fleet.main([str(tmp_path / "empty_nonexistent")]) == 2
+
+
+def test_lt_top_dir_mode(publish_run, capsys):
+    _, wd = publish_run
+    import lt_top
+
+    assert lt_top.main(["--dir", os.path.join(wd, "telemetry"), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "lt fleet — 1 host(s) folded" in out
+    # target modes are mutually exclusive and required
+    assert lt_top.main(["--once"]) == 2
+    assert lt_top.main(["--dir", "x", "--port", "1", "--once"]) == 2
+
+
+def test_lt_top_prom_instruments_merge_policy():
+    """The multi-url aggregate header shares obs.aggregate's merge
+    policy: counters sum, burn-rate gauges take the max, histogram
+    sum/count series sum."""
+    import lt_top
+
+    text = (
+        "# TYPE lt_slo_met_total counter\n"
+        "lt_slo_met_total 3\n"
+        "# TYPE lt_slo_burn_rate gauge\n"
+        "lt_slo_burn_rate 0.25\n"
+        "# TYPE lt_serve_job_seconds histogram\n"
+        'lt_serve_job_seconds_bucket{le="1"} 2\n'
+        "lt_serve_job_seconds_sum 1.5\n"
+        "lt_serve_job_seconds_count 2\n"
+    )
+    text2 = text.replace("0.25", "0.75").replace("lt_slo_met_total 3",
+                                                 "lt_slo_met_total 4")
+    merged, conflicts = aggregate.merge_instruments([
+        (0.0, lt_top.prom_instruments(text)),
+        (1.0, lt_top.prom_instruments(text2)),
+    ])
+    assert conflicts == []
+    by = {m["name"]: m["value"] for m in merged}
+    assert by["lt_slo_met_total"] == 7
+    assert by["lt_slo_burn_rate"] == 0.75
+    assert by["lt_serve_job_seconds_sum"] == 3.0
+    assert by["lt_serve_job_seconds_count"] == 4
+    assert "lt_serve_job_seconds_bucket" not in by  # cumulative rows skipped
+
+
+# ---------------------------------------------------------------------------
+# wiring: serve fleet loop
+
+
+def test_serve_fleet_loop_alert_lifecycle(tmp_path):
+    """A publish-enabled server: the fleet loop publishes + folds +
+    appends history; a planted stale foreign snapshot fires the default
+    host-staleness alert (event stream + /healthz + lt_alerts_*), and
+    removing it resolves the alert through the hold-down — the
+    firing → resolved lifecycle over a REAL server."""
+    import urllib.request
+
+    from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+    wd = str(tmp_path / "srv")
+    cfg = ServeConfig(
+        workdir=wd,
+        publish=True,
+        publish_interval_s=0.1,
+        flight_ring_events=0,
+        alert_rules=None,  # the built-in defaults
+    )
+    server = SegmentationServer(cfg)
+    tel_dir = os.path.join(wd, "telemetry")
+    try:
+        # beat 1+: own snapshot folds, no alerts
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if glob_count(tel_dir) >= 1 and server.telemetry.history is not None:
+                samples, _ = server.telemetry.history.read()
+                if samples:
+                    break
+            time.sleep(0.05)
+        assert glob_count(tel_dir) >= 1
+        # plant a STALE foreign snapshot: 120s old — past its own
+        # staleness bound (3 x 5s interval) but inside the serve loop's
+        # newer_than window, so it reads stale (alertable) rather than
+        # departed (excluded); the absent rule must fire
+        stale = {
+            "schema": SNAP_SCHEMA, "kind": "run", "host": "ghost",
+            "pid": 1, "generation": 1, "seq": 1,
+            "t_wall": time.time() - 120, "uptime_s": 1.0,
+            "interval_s": 5.0, "metrics": [], "state": {},
+        }
+        ghost = Path(tel_dir) / "ghost.1.snap.json"
+        ghost.write_text(json.dumps(stale))
+        # both clocks old: staleness judges the fresher of t_wall/mtime
+        os.utime(ghost, (stale["t_wall"], stale["t_wall"]))
+        deadline = time.monotonic() + 30
+        fired = False
+        while time.monotonic() < deadline and not fired:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=10
+            ) as r:
+                h = json.loads(r.read())
+            fired = any(
+                a["rule"] == "fleet_host_stale" for a in h.get("alerts", [])
+            )
+            time.sleep(0.05)
+        assert fired, "planted stale host never fired the staleness alert"
+        assert h["fleet"]["stale"] >= 1
+        # remove the ghost: the alert must resolve through the hold-down
+        ghost.unlink()
+        deadline = time.monotonic() + 60
+        resolved = False
+        while time.monotonic() < deadline and not resolved:
+            resolved = not server.telemetry.active_alerts()
+            time.sleep(0.05)
+        assert resolved, "alert never resolved after the stale host left"
+    finally:
+        server.stop()
+        server.serve_forever()  # drains nothing; runs the shared shutdown
+    # the event stream carries the firing → resolved pair, schema-clean
+    from check_events_schema import value_lints
+
+    events_file = os.path.join(wd, "events.jsonl")
+    assert validate_events_file(events_file, extra=value_lints()) == []
+    states = [
+        json.loads(line)["state"]
+        for line in Path(events_file).read_text().splitlines()
+        if line.strip() and json.loads(line).get("ev") == "alert"
+        and json.loads(line).get("rule") == "fleet_host_stale"
+    ]
+    assert states[:2] == ["firing", "resolved"]
+    # metrics advanced
+    prom = Path(wd, "metrics.prom").read_text()
+    assert "lt_alerts_fired_total 1" in prom
+    assert "lt_alerts_resolved_total 1" in prom
+
+
+def glob_count(d: str) -> int:
+    return len(aggregate.discover_snapshots(d))
+
+
+def test_serve_publish_config_validation():
+    from land_trendr_tpu.serve import ServeConfig
+
+    with pytest.raises(ValueError, match="publish requires telemetry"):
+        ServeConfig(publish=True, telemetry=False)
+    with pytest.raises(ValueError, match="alert_rules requires publish"):
+        ServeConfig(alert_rules="/nonexistent/rules.json")
+    with pytest.raises(ValueError, match="unreadable"):
+        ServeConfig(publish=True, alert_rules="/nonexistent/rules.json")
+
+
+def test_load_rules_file_and_defaults(tmp_path):
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps([
+        {"name": "deep_queue", "kind": "threshold",
+         "metric": "lt_serve_queue_depth", "op": ">=", "value": 10,
+         "for_s": 5, "hold_down_s": 10},
+    ]))
+    rules = load_rules(str(p))
+    assert rules[0].name == "deep_queue" and rules[0].value == 10
+    assert {r.kind for r in DEFAULT_RULES} == {"absent", "slo_burn"}
+
+
+def test_perf_gate_fleet_leg(tmp_path):
+    """The gate's fleet leg passes against the live implementation —
+    the acceptance invariant (sums exact, staleness flagged, alerts
+    deterministic, folds byte-stable) wired into tier-1."""
+    import perf_gate
+
+    checks: list = []
+
+    def check(name, ok, detail):
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+
+    perf_gate.run_fleet_leg(str(tmp_path), check)
+    failed = [c for c in checks if not c["ok"]]
+    assert not failed, failed
+    assert len(checks) == 8

@@ -95,6 +95,14 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     ),
     "profile_captured": ("duration_s", "bytes"),
     "job_slo": ("queue_wait_s", "exec_s", "latency_s", "deadline_s"),
+    # fleet telemetry plane: alert lifecycle durations and pod-fold
+    # host-health counts only go up / never negative (the state-enum and
+    # firing-before-resolved checks live in AlertValueLint below)
+    "alert": ("duration_s", "window_s"),
+    "fleet_sample": (
+        "hosts", "stale_hosts", "corrupt_snaps", "alerts_firing",
+        "history_samples",
+    ),
 }
 
 
@@ -281,6 +289,59 @@ def tile_straggler_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+#: the alert event's state vocabulary (mirrors
+#: land_trendr_tpu.obs.alerts.ALERT_STATES — asserted equal in
+#: tests/test_fleet.py so the two cannot drift)
+ALERT_STATES = ("firing", "resolved")
+
+
+class AlertValueLint:
+    """Value lint for ``alert`` records, one instance per file.
+
+    Stateful because the lifecycle is cross-event: a ``resolved``
+    transition for a rule must follow a ``firing`` one in the same run
+    scope (the engine can only resolve what fired), and two ``firing``
+    transitions without a resolve between them mean a broken state
+    machine.  ``run_start`` opens a new scope and resets every rule.
+    """
+
+    def __init__(self) -> None:
+        self._firing: set = set()
+
+    def __call__(self, rec, lineno: int) -> list[str]:
+        if not isinstance(rec, dict):
+            return []
+        ev = rec.get("ev")
+        if ev == "run_start":
+            self._firing.clear()
+            return []
+        if ev != "alert":
+            return []
+        errs = []
+        state, rule = rec.get("state"), rec.get("rule")
+        if isinstance(state, str) and state not in ALERT_STATES:
+            errs.append(
+                f"line {lineno}: alert: state {state!r} not one of "
+                f"{ALERT_STATES}"
+            )
+        if isinstance(rule, str) and state in ALERT_STATES:
+            if state == "firing":
+                if rule in self._firing:
+                    errs.append(
+                        f"line {lineno}: alert: rule {rule!r} fired twice "
+                        "without resolving (broken lifecycle)"
+                    )
+                self._firing.add(rule)
+            else:  # resolved
+                if rule not in self._firing:
+                    errs.append(
+                        f"line {lineno}: alert: rule {rule!r} resolved "
+                        "without a prior firing in this scope"
+                    )
+                self._firing.discard(rule)
+        return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
     (the robustness events, the ingest-store rollup, the flight-sampler
@@ -302,6 +363,7 @@ def generic_nonneg_errors(rec, lineno: int) -> list[str]:
 def value_lints():
     """Fresh per-file ``extra`` hook chaining every value-level lint."""
     fetch_lint = FetchValueLint()
+    alert_lint = AlertValueLint()
 
     def extra(rec, lineno: int) -> list[str]:
         return (
@@ -311,6 +373,7 @@ def value_lints():
             + job_slo_value_errors(rec, lineno)
             + span_value_errors(rec, lineno)
             + tile_straggler_value_errors(rec, lineno)
+            + alert_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )
 

@@ -301,6 +301,126 @@ def soak(
                 f"{len(stragglers)} tile_straggler event(s))"
             )
 
+    def run_fleet_case(stack) -> None:
+        """Fleet-telemetry failure semantics (ISSUE 11): with the
+        ``obs.publish`` seam armed, the run's START snapshot lands
+        (invocation 0), the terminal STOP flush faults (invocation 1)
+        and is swallowed — the run completes with artifacts
+        byte-identical to the clean run, the host simply reads as a
+        late/stale snapshot.  The aggregator over the telemetry dir —
+        with a TORN snapshot planted beside the real one — flags the
+        torn host corrupt and the real host's fold stays intact (never
+        a crash, never silent omission).  Then the ``history.append``
+        seam over a live ring: the faulted append loses ONE sample, the
+        ring reads back consistent and reopens clean."""
+        from land_trendr_tpu.obs import aggregate
+        from land_trendr_tpu.obs.history import HistoryRing
+        from land_trendr_tpu.runtime import faults
+
+        wd = str(root / "eager_fleet")
+        cfg = RunConfig(
+            workdir=wd,
+            out_dir=wd + "_o",
+            # the START snapshot publishes during telemetry construction,
+            # BEFORE the driver arms the plan (so it lands, un-indexed);
+            # with a 60s interval no loop beat fires on this seconds-scale
+            # run — seam invocation 0 is exactly the terminal STOP flush
+            fault_schedule="seed=1,obs.publish@0=io",
+            telemetry=True,
+            publish=True,
+            publish_interval_s=60.0,
+            **base_kw,
+        )
+        summary = _run(stack, cfg)
+        fired = [
+            f for f in summary.get("faults_injected", [])
+            if f["seam"] == "obs.publish"
+        ]
+        if not fired:
+            raise AssertionError(
+                "obs.publish@0 never fired — the seam no longer guards "
+                "the publisher"
+            )
+        tel_dir = Path(wd) / "telemetry"
+        snaps = sorted(tel_dir.glob("*.snap.json"))
+        if len(snaps) != 1:
+            raise AssertionError(
+                f"expected exactly the start snapshot, found "
+                f"{[s.name for s in snaps]}"
+            )
+        (tel_dir / "torn-host.4242.snap.json").write_text(
+            '{"schema": 1, "host": "torn-host", "pid": 4242, "t_w'
+        )
+        view = aggregate.fold_dir(str(tel_dir))
+        if view["counts"]["corrupt"] != 1 or view["counts"]["folded"] != 1:
+            raise AssertionError(
+                f"aggregate must flag the torn snap and fold the real "
+                f"host: {view['counts']}"
+            )
+        tiles = [
+            m for m in view["metrics"] if m["name"] == "lt_tiles_done_total"
+        ]
+        # the faulted beat was the TERMINAL flush, so the surviving
+        # snapshot is the start-of-run one: its counters fold (proving
+        # the torn sibling never corrupted the merge) at their honest
+        # pre-run value of zero
+        if not tiles or tiles[0]["value"] != 0:
+            raise AssertionError(
+                f"the surviving host's counters did not fold cleanly: "
+                f"{tiles}"
+            )
+        got = _digest_workdir(wd)
+        clean = _digest_workdir(str(root / "eager_clean"))
+        if got != clean:
+            raise AssertionError(
+                "fleet-publish run artifacts differ from the clean run — "
+                "the publisher changed behavior"
+            )
+        # history.append seam: one lost sample, never a corrupted ring
+        hist_dir = str(root / "fleet_history")
+        plan = faults.activate(
+            faults.parse_schedule("seed=1,history.append@1=io")
+        )
+        try:
+            ring = HistoryRing(hist_dir, samples_per_segment=4)
+            lost = 0
+            for i in range(6):
+                try:
+                    ring.append({"t": float(i), "hosts": 1, "stale_hosts": 0})
+                except OSError:
+                    lost += 1
+            ring.close()
+        finally:
+            faults.deactivate()
+        if lost != 1:
+            raise AssertionError(
+                f"history.append@1 should cost exactly one sample, lost "
+                f"{lost}"
+            )
+        ring2 = HistoryRing(hist_dir)
+        samples, malformed = ring2.read()
+        ring2.close()
+        if len(samples) != 5 or malformed:
+            raise AssertionError(
+                f"ring after a faulted append: {len(samples)} samples "
+                f"(want 5), {malformed} malformed"
+            )
+        report["cases"].append(
+            {
+                "track": "eager",
+                "case": "fleet_publish_and_history_faults",
+                "schedule": cfg.fault_schedule,
+                "torn_snap_flagged": True,
+                "history_samples_lost": lost,
+                "artifacts_identical": True,
+            }
+        )
+        if verbose:
+            print(
+                "  ok: eager/fleet_publish_and_history_faults "
+                f"({cfg.fault_schedule} + history.append@1=io)"
+            )
+
     def run_serve_track() -> None:
         """Serve-mode failure semantics: with the server's ONE armed
         plan firing at ``serve.submit`` (first submission rejected, the
@@ -495,6 +615,7 @@ def soak(
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
     run_straggler_case(eager)
+    run_fleet_case(eager)
     run_serve_track()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a

@@ -1,0 +1,284 @@
+"""`lt fleet` — the pod's single pane of glass over a telemetry dir.
+
+Folds every per-process snapshot the fleet publishers
+(:mod:`land_trendr_tpu.obs.publish`) wrote under a shared telemetry
+directory into one pod view (:mod:`land_trendr_tpu.obs.aggregate`) and
+renders the fleet report: per-host freshness (stale/corrupt/superseded
+flagged, never silently dropped), the aggregated key metrics and SLO
+counters, and every active alert the replicas' fleet loops are firing.
+
+Modes:
+
+* default — print one report and exit;
+* ``--watch`` — refresh every ``--interval`` seconds until Ctrl-C;
+* ``--json`` — the raw pod view as JSON (scripting; one-shot);
+* ``--prom FILE`` — additionally write the aggregated Prometheus
+  exposition (atomic tmp + rename; ``-`` prints it to stdout instead
+  of the report) — the file a node_exporter textfile collector or any
+  scraper ingests as THE pod's metrics;
+* ``--serve-port N`` — serve the live aggregated exposition on
+  ``GET /metrics`` and the pod view on ``GET /fleet`` (loopback by
+  default), refreshed per request — N per-process snapshot files
+  become one scrape target.
+
+Exit codes: 0 ok, 2 usage/empty-directory error.
+
+Usage:
+    python tools/lt_fleet.py lt_work/telemetry
+    python tools/lt_fleet.py lt_serve/telemetry --prom pod.prom
+    python tools/lt_fleet.py lt_serve/telemetry --serve-port 9800
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from land_trendr_tpu.obs import aggregate  # noqa: E402
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def _fmt_age(secs: float) -> str:
+    if secs < 90:
+        return f"{secs:.1f}s"
+    if secs < 5400:
+        return f"{secs / 60:.1f}m"
+    return f"{secs / 3600:.1f}h"
+
+
+def _metric(view: dict, name: str) -> "float | None":
+    for inst in view.get("metrics", []):
+        if inst["name"] == name and not inst.get("labels"):
+            v = inst.get("value")
+            return None if v is None else float(v)
+    return None
+
+
+def render(view: dict) -> str:
+    """The fleet report (a plain string — the caller owns the
+    terminal)."""
+    counts = view["counts"]
+    lines = [
+        f"lt fleet — {counts['folded']} host(s) folded, "
+        f"{counts['stale']} stale, {counts['corrupt']} corrupt, "
+        f"{counts['excluded']} excluded "
+        f"(of {counts['snapshots']} snapshot(s))"
+    ]
+    lines.append("")
+    lines.append(
+        f"{'HOST':<18} {'PID':>7} {'KIND':<6} {'AGE':>7} {'FLAGS':<10} "
+        f"{'PHASE':<9} {'TILES':>11} {'QUEUE':>5} {'STRAG':>5}"
+    )
+    for h in view["hosts"]:
+        flags = ",".join(
+            f for f, on in (
+                ("stale", h.get("stale") and not h.get("corrupt")),
+                ("corrupt", h.get("corrupt")),
+                ("old-gen", h.get("superseded")),
+                ("excl", h.get("excluded") and not h.get("corrupt")
+                 and not h.get("superseded")),
+            ) if on
+        ) or "ok"
+        state = h.get("state") or {}
+        p = state.get("progress") or {}
+        tiles = (
+            f"{p.get('tiles_done', '-')}/{p.get('tiles_total', '-')}"
+            if "tiles_done" in p else "-"
+        )
+        lines.append(
+            f"{str(h.get('host') or h['path']):<18} "
+            f"{str(h.get('pid') or '-'):>7} {h.get('kind', '-'):<6} "
+            f"{_fmt_age(h['age_s']):>7} {flags:<10} "
+            f"{str(p.get('phase', '-')):<9} {tiles:>11} "
+            f"{str(p.get('queue_depth', '-')):>5} "
+            f"{str(state.get('stragglers', '-')):>5}"
+        )
+    lines.append("")
+    agg = []
+    for label, name in (
+        ("tiles", "lt_tiles_done_total"),
+        ("pixels", "lt_pixels_total"),
+        ("px/s", "lt_px_per_s"),
+        ("retries", "lt_tile_retries_total"),
+        ("stragglers", "lt_stragglers_total"),
+        ("quarantined", "lt_tiles_quarantined_total"),
+    ):
+        v = _metric(view, name)
+        if v is not None:
+            agg.append(f"{label} {v:,.0f}")
+    if agg:
+        lines.append("pod: " + "  ".join(agg))
+    slo = []
+    for label, name in (
+        ("met", "lt_slo_met_total"),
+        ("missed", "lt_slo_missed_total"),
+        ("burn(max)", "lt_slo_burn_rate"),
+        ("queue", "lt_serve_queue_depth"),
+        ("running", "lt_serve_running"),
+    ):
+        v = _metric(view, name)
+        if v is not None:
+            slo.append(f"{label} {v:g}")
+    if slo:
+        lines.append("slo: " + "  ".join(slo))
+    for c in view.get("conflicts", []):
+        lines.append(f"merge conflict: {c}")
+    lines.append("")
+    if view.get("alerts"):
+        lines.append("ALERTS:")
+        for a in view["alerts"]:
+            since = a.get("since_t")
+            age = (
+                f" for {_fmt_age(max(0.0, view['generated_t'] - since))}"
+                if isinstance(since, (int, float)) else ""
+            )
+            lines.append(
+                f"  {a.get('state', 'firing').upper():<9} "
+                f"{a.get('rule', '?')} on {a.get('host', '?')}"
+                f" (value {a.get('value')}, threshold "
+                f"{a.get('threshold')}){age}"
+            )
+    else:
+        lines.append("alerts: none firing")
+    return "\n".join(lines)
+
+
+def write_prom(view: dict, path: str) -> None:
+    """Aggregated exposition via atomic tmp + rename (a scraper's cat
+    never sees a torn file — the PromFileExporter discipline)."""
+    text = aggregate.render_prom(view)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def serve(directory: str, port: int, host: str, stale_after_s: "float | None") -> int:
+    """Serve the live aggregated exposition (+ pod view JSON)."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 - stdlib API name
+            path = self.path.split("?")[0].rstrip("/")
+            view = aggregate.fold_dir(directory, stale_after_s=stale_after_s)
+            if path == "/metrics":
+                body = aggregate.render_prom(view).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in ("", "/fleet"):
+                body = json.dumps(view, indent=2, default=str).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a) -> None:  # quiet: no per-scrape stderr
+            pass
+
+    httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    print(
+        json.dumps({"serving": True, "port": httpd.server_address[1],
+                    "dir": directory}),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="the shared telemetry directory "
+                    "(WORKDIR/telemetry) the fleet publishes into")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw pod view as JSON (one-shot)")
+    ap.add_argument("--prom", default=None, metavar="FILE",
+                    help="also write the aggregated Prometheus "
+                    "exposition to FILE (atomic; '-' = stdout)")
+    ap.add_argument("--watch", action="store_true",
+                    help="refresh the report every --interval seconds")
+    ap.add_argument("--interval", type=float, default=2.0, metavar="SEC")
+    ap.add_argument("--stale-after-s", type=float, default=None,
+                    metavar="SEC",
+                    help="staleness bound override (default: 3x each "
+                    "snapshot's own publish interval)")
+    ap.add_argument("--newer-than-age", type=float, default=None,
+                    metavar="SEC",
+                    help="exclude snapshots older than SEC from the "
+                    "value fold (dead leftovers in a reused dir); they "
+                    "stay listed as excluded")
+    ap.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                    help="serve the live aggregated /metrics exposition "
+                    "and /fleet JSON on PORT (0 = ephemeral)")
+    ap.add_argument("--serve-host", default="127.0.0.1", metavar="HOST",
+                    help="bind address for --serve-port (loopback by "
+                    "default; the exposition is read-only)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.dir):
+        print(f"error: {args.dir} is not a directory", file=sys.stderr)
+        return 2
+
+    def fold() -> dict:
+        now = time.time()
+        return aggregate.fold_dir(
+            args.dir,
+            now=now,
+            stale_after_s=args.stale_after_s,
+            newer_than=(
+                now - args.newer_than_age
+                if args.newer_than_age is not None else None
+            ),
+        )
+
+    if args.serve_port is not None:
+        return serve(
+            args.dir, args.serve_port, args.serve_host, args.stale_after_s
+        )
+
+    view = fold()
+    if not view["counts"]["snapshots"]:
+        print(
+            f"error: no *.snap.json under {args.dir} (is --publish on?)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.prom:
+        if args.prom == "-":
+            print(aggregate.render_prom(view), end="")
+            return 0
+        write_prom(view, args.prom)
+    if args.json:
+        print(json.dumps(view, indent=2, default=str))
+        return 0
+    if not args.watch:
+        print(render(view))
+        return 0
+    try:
+        while True:
+            sys.stdout.write(_CLEAR + render(fold()) + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

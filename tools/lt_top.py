@@ -1,13 +1,25 @@
-"""`lt top` — curses-free terminal status view for a running server.
+"""`lt top` — curses-free terminal status view for a server or a fleet.
 
-Polls a live ``lt serve`` process's HTTP surface — ``/healthz`` (queue /
-uptime / warm-program facts), ``/debug/jobs`` (per-job live state incl.
-the running job's pipeline progress) and ``/metrics`` (the ``lt_serve_*``
-and ``lt_slo_*`` instruments) — and renders a one-screen status view,
-refreshed in place with plain ANSI (no curses, so it works in any dumb
-terminal, a CI log, or piped to a file).  This is how a gigapixel
-service run is *watchable* the way README promises runs are inspectable
-in flight.
+Polls live ``lt serve`` processes' HTTP surfaces — ``/healthz`` (queue /
+uptime / warm-program facts, active alerts), ``/debug/jobs`` (per-job
+live state incl. the running job's pipeline progress) and ``/metrics``
+(the ``lt_serve_*`` and ``lt_slo_*`` instruments) — and renders a
+one-screen status view, refreshed in place with plain ANSI (no curses,
+so it works in any dumb terminal, a CI log, or piped to a file).  This
+is how a gigapixel service run is *watchable* the way README promises
+runs are inspectable in flight.
+
+Targets (one or many — the fleet is first-class):
+
+* ``--port N`` (with ``--host``) — one server, the classic view;
+* ``--url BASE`` (repeatable) — N replicas: per-replica rows under an
+  AGGREGATE header whose instruments merge through the fleet plane's
+  per-instrument policy table (``land_trendr_tpu.obs.aggregate`` —
+  counters sum, burn rates take the pod max; one merge policy, no
+  duplicate), plus every replica's jobs and the union of active alerts;
+* ``--dir TELEMETRY_DIR`` — no HTTP at all: fold the fleet snapshot
+  files under a shared telemetry directory (standalone pod runs
+  included) and render the ``lt_fleet`` report.
 
 Modes:
 
@@ -20,18 +32,26 @@ Exit codes: 0 ok, 2 connection/usage error (the server is down or the
 debug surface is disabled).
 
 Usage:
-    python tools/lt_top.py --port 8800            # live view
-    python tools/lt_top.py --port 8800 --once     # one snapshot
+    python tools/lt_top.py --port 8800                  # one server
+    python tools/lt_top.py --url http://127.0.0.1:8800 \\
+                           --url http://127.0.0.1:8801  # a fleet
+    python tools/lt_top.py --dir lt_serve/telemetry     # shared-FS pod
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
 
 _CLEAR = "\x1b[2J\x1b[H"
 
@@ -46,12 +66,19 @@ def _get_text(base: str, path: str, timeout: float = 5.0) -> str:
         return r.read().decode()
 
 
-def parse_prom(text: str) -> list:
+def parse_prom(text: str, types: "dict | None" = None) -> list:
     """Prometheus text exposition → ``(name, labels dict, value)`` rows
-    (enough of the 0.0.4 format for our own exporter's output)."""
+    (enough of the 0.0.4 format for our own exporter's output).  With a
+    ``types`` dict, ``# TYPE`` lines fill it ``{family: kind}`` — what
+    the fleet merge needs to apply the right per-instrument policy."""
     out = []
     for line in text.splitlines():
         line = line.strip()
+        if line.startswith("# TYPE ") and types is not None:
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
         if not line or line.startswith("#"):
             continue
         name_part, _, value_part = line.rpartition(" ")
@@ -73,6 +100,37 @@ def parse_prom(text: str) -> list:
     return out
 
 
+def prom_instruments(text: str) -> list:
+    """Exposition text → the instrument-dict shape
+    ``land_trendr_tpu.obs.aggregate.merge_instruments`` folds.
+
+    Histogram series are carried as their ``_sum`` / ``_count``
+    counters (summing those across replicas IS the histogram merge for
+    the header's purposes; the cumulative ``_bucket`` rows are
+    skipped — re-deriving raw buckets from N cumulative series belongs
+    to the snapshot path, which ships them raw).
+    """
+    types: dict = {}
+    rows = parse_prom(text, types=types)
+    out: list = []
+    for name, labels, value in rows:
+        kind = types.get(name)
+        if kind is None:
+            kind = "gauge"  # untyped rows merge conservatively
+            for suffix in ("_bucket", "_sum", "_count"):
+                if (
+                    name.endswith(suffix)
+                    and types.get(name[: -len(suffix)]) == "histogram"
+                ):
+                    kind = None if suffix == "_bucket" else "counter"
+                    break
+            if kind is None:
+                continue  # cumulative bucket rows: not mergeable as-is
+        out.append({"name": name, "kind": kind, "labels": labels,
+                    "value": value})
+    return out
+
+
 def _metric(rows: list, name: str, default: float = 0.0) -> float:
     for n, _, v in rows:
         if n == name:
@@ -83,11 +141,14 @@ def _metric(rows: list, name: str, default: float = 0.0) -> float:
 def snapshot(base: str) -> dict:
     """One merged poll of the three endpoints (metrics/debug optional —
     a --no-telemetry or --no-debug-endpoints server still tops)."""
-    snap: dict = {"healthz": _get_json(base, "/healthz")}
+    snap: dict = {"healthz": _get_json(base, "/healthz"), "base": base}
     try:
-        snap["metrics"] = parse_prom(_get_text(base, "/metrics"))
+        text = _get_text(base, "/metrics")
+        snap["metrics"] = parse_prom(text)
+        snap["metrics_text"] = text
     except urllib.error.HTTPError:
         snap["metrics"] = []
+        snap["metrics_text"] = ""
     try:
         snap["jobs"] = _get_json(base, "/debug/jobs")["jobs"]
     except urllib.error.HTTPError:
@@ -164,15 +225,109 @@ def render(snap: dict) -> str:
         )
     if not snap["jobs"]:
         lines.append("(no jobs)")
+    alerts = snap["healthz"].get("alerts") or []
+    if alerts:
+        lines.append("")
+        lines.append("ALERTS:")
+        for a in alerts:
+            lines.append(
+                f"  FIRING    {a.get('rule', '?')} (value "
+                f"{a.get('value')}, threshold {a.get('threshold')})"
+            )
+    return "\n".join(lines)
+
+
+def render_fleet(snaps: list) -> str:
+    """N replica snapshots → one view: the AGGREGATE header (instruments
+    merged through the fleet plane's per-instrument policy table —
+    ``obs.aggregate.merge_instruments``, the single copy of that
+    logic), per-replica rows, every replica's jobs, and the union of
+    active alerts."""
+    from land_trendr_tpu.obs.aggregate import merge_instruments
+
+    merged, _ = merge_instruments(
+        (float(i), prom_instruments(s.get("metrics_text", "")))
+        for i, s in enumerate(snaps)
+    )
+    by_name = {
+        m["name"]: m["value"] for m in merged
+        if not m.get("labels") and m.get("value") is not None
+    }
+
+    def agg(name: str, default: float = 0.0) -> float:
+        return float(by_name.get(name, default))
+
+    lines = [
+        f"lt top — fleet of {len(snaps)} replica(s)   "
+        f"queue {agg('lt_serve_queue_depth'):.0f}   "
+        f"running {agg('lt_serve_running'):.0f}   "
+        f"slo: met {agg('lt_slo_met_total'):.0f} "
+        f"missed {agg('lt_slo_missed_total'):.0f} "
+        f"burn(max) {agg('lt_slo_burn_rate'):.2f}   "
+        f"rejections {agg('lt_serve_rejections_total'):.0f}"
+    ]
+    lines.append("")
+    lines.append(
+        f"{'REPLICA':<28} {'UP':>6} {'QUEUE':>5} {'RUN':>3} "
+        f"{'TERM':>9} {'WARM':>4} {'BURN':>5} {'ALRT':>4}"
+    )
+    alerts: list = []
+    for s in snaps:
+        h = s["healthz"]
+        rows = s["metrics"]
+        for a in h.get("alerts") or []:
+            alerts.append({**a, "replica": s.get("base", "?")})
+        lines.append(
+            f"{s.get('base', '?'):<28} "
+            f"{_fmt_age(h.get('uptime_s', 0)):>6} "
+            f"{h.get('queue_depth', '?'):>5} "
+            f"{1 if h.get('running') else 0:>3} "
+            f"{str(h.get('jobs_terminal', '?')) + '/' + str(h.get('jobs_total', '?')):>9} "
+            f"{h.get('warm_program_count', '?'):>4} "
+            f"{_metric(rows, 'lt_slo_burn_rate'):>5.2f} "
+            f"{len(h.get('alerts') or []):>4}"
+        )
+    lines.append("")
+    jobs = [
+        {**job, "_replica": s.get("base", "?")}
+        for s in snaps for job in s["jobs"]
+    ]
+    if jobs:
+        lines.append(f"{'JOB':<22} {'STATE':<18} {'TENANT':<10} {'REPLICA'}")
+        for job in jobs:
+            state = job.get("state", "?")
+            if job.get("deadline_exceeded"):
+                state += "!SLO"
+            lines.append(
+                f"{job.get('job_id', '?'):<22} {state:<18} "
+                f"{job.get('tenant', '?'):<10} {job['_replica']}"
+            )
+    else:
+        lines.append("(no jobs)")
+    if alerts:
+        lines.append("")
+        lines.append("ALERTS:")
+        for a in alerts:
+            lines.append(
+                f"  FIRING    {a.get('rule', '?')} on "
+                f"{a.get('replica', '?')} (value {a.get('value')}, "
+                f"threshold {a.get('threshold')})"
+            )
     return "\n".join(lines)
 
 
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--port", type=int, required=True,
-                    help="the server's job-API port (from the startup line)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="one server's job-API port (from the startup line)")
     ap.add_argument("--host", default="127.0.0.1",
                     help="the server's job-API host (loopback)")
+    ap.add_argument("--url", action="append", default=[], metavar="BASE",
+                    help="a replica's base URL (repeatable — two or more "
+                    "render the fleet view with an aggregate header)")
+    ap.add_argument("--dir", default=None, metavar="TELEMETRY_DIR",
+                    help="no HTTP: fold the fleet snapshot files under a "
+                    "shared telemetry directory (lt_fleet's view)")
     ap.add_argument("--interval", type=float, default=2.0, metavar="SEC",
                     help="refresh period for the live view")
     ap.add_argument("--once", action="store_true",
@@ -180,29 +335,80 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the raw merged snapshot as JSON (one-shot)")
     args = ap.parse_args(argv)
-    base = f"http://{args.host}:{args.port}"
+
+    bases = list(args.url)
+    if args.port is not None:
+        bases.append(f"http://{args.host}:{args.port}")
+    if bool(bases) == bool(args.dir):
+        print(
+            "error: pick a target — --port/--url (HTTP) or --dir "
+            "(telemetry directory)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.dir:
+        # shared-FS fleet mode: the lt_fleet report over the snapshot
+        # files (one view implementation — not a second copy here)
+        import lt_fleet
+
+        from land_trendr_tpu.obs import aggregate
+
+        if not os.path.isdir(args.dir):
+            print(f"error: {args.dir} is not a directory", file=sys.stderr)
+            return 2
+        try:
+            if args.json:
+                print(json.dumps(
+                    aggregate.fold_dir(args.dir), indent=2, default=str
+                ))
+                return 0
+            if args.once:
+                print(lt_fleet.render(aggregate.fold_dir(args.dir)))
+                return 0
+            while True:
+                view = lt_fleet.render(aggregate.fold_dir(args.dir))
+                sys.stdout.write(_CLEAR + view + "\n")
+                sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    def poll() -> "dict | list":
+        if len(bases) == 1:
+            return snapshot(bases[0])
+        return [snapshot(b) for b in bases]
+
+    def show(polled) -> str:
+        return (
+            render(polled) if isinstance(polled, dict)
+            else render_fleet(polled)
+        )
 
     try:
         if args.json:
-            snap = snapshot(base)
-            snap["metrics"] = [
-                {"name": n, "labels": l, "value": v}
-                for n, l, v in snap["metrics"]
-            ]
-            print(json.dumps(snap, indent=2, default=str))
+            polled = poll()
+            snaps = [polled] if isinstance(polled, dict) else polled
+            for snap in snaps:
+                snap["metrics"] = [
+                    {"name": n, "labels": l, "value": v}
+                    for n, l, v in snap["metrics"]
+                ]
+                snap.pop("metrics_text", None)
+            print(json.dumps(polled, indent=2, default=str))
             return 0
         if args.once:
-            print(render(snapshot(base)))
+            print(show(poll()))
             return 0
         while True:
-            view = render(snapshot(base))
+            view = show(poll())
             sys.stdout.write(_CLEAR + view + "\n")
             sys.stdout.flush()
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
     except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
-        print(f"error: cannot poll {base}: {e}", file=sys.stderr)
+        print(f"error: cannot poll {', '.join(bases)}: {e}", file=sys.stderr)
         return 2
 
 

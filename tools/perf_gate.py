@@ -241,6 +241,211 @@ def run_trace_leg(workdir: str, check) -> None:
     )
 
 
+#: fleet-telemetry leg: synthetic pod shape and the bands.  The
+#: aggregator floor is an order of magnitude under a cold local
+#: measurement (the fold parses 16 small JSON files): it fails an
+#: accidentally-quadratic merge, not a noisy container.  The publisher
+#: ceiling is min-of-reps (container jitter only inflates the median; a
+#: real regression — an O(instruments²) dump, a lock across the write —
+#: inflates the cost floor itself).
+FLEET_HOSTS = 16
+FLEET_MIN_FOLDS_PER_S = 20.0
+FLEET_PUBLISH_MAX_MIN_S = 0.05
+
+
+def _synth_fleet_snaps(directory: str, now: float) -> dict:
+    """Write a deterministic FLEET_HOSTS-snapshot set: per-host counters
+    with a known sum, one shared histogram, one host stamped stale, one
+    torn file.  Returns the expected aggregates."""
+    import json as _json
+    import os as _os
+
+    from land_trendr_tpu.obs.publish import SNAP_SCHEMA
+
+    tiles_sum = 0
+    hist_count = 0
+    for i in range(FLEET_HOSTS):
+        tiles = 10 * (i + 1)
+        tiles_sum += tiles
+        hist_count += 3
+        stale = i == FLEET_HOSTS - 1
+        snap = {
+            "schema": SNAP_SCHEMA,
+            "kind": "run",
+            "host": f"fleet-host-{i:02d}",
+            "pid": 1000 + i,
+            "generation": 1,
+            "seq": 5,
+            "t_wall": now - (3600.0 if stale else 1.0),
+            "uptime_s": 60.0,
+            "interval_s": 5.0,
+            "metrics": [
+                {"name": "lt_tiles_done_total", "kind": "counter",
+                 "help": "t", "labels": {}, "value": float(tiles)},
+                {"name": "lt_feed_backlog", "kind": "gauge", "help": "b",
+                 "labels": {}, "value": 2.0},
+                {"name": "lt_slo_burn_rate", "kind": "gauge", "help": "br",
+                 "labels": {}, "value": 0.01 * i},
+                {"name": "lt_tile_compute_seconds", "kind": "histogram",
+                 "help": "c", "labels": {}, "sum": 3.0, "count": 3,
+                 "bounds": [0.1, 1.0, 10.0], "buckets": [1, 1, 1, 0]},
+            ],
+            "state": {"progress": {"phase": "pipeline", "tiles_done": tiles}},
+        }
+        p = _os.path.join(directory, f"fleet-host-{i:02d}.1000.snap.json")
+        with open(p, "w") as f:
+            f.write(_json.dumps(snap, separators=(",", ":")))
+        # mtime pinned to the snapshot's own stamp: staleness is judged
+        # on the FRESHER of t_wall and the shared-FS mtime, and the
+        # synthetic `now` is decoupled from the real clock
+        _os.utime(p, (snap["t_wall"], snap["t_wall"]))
+    with open(_os.path.join(directory, "torn-host.999.snap.json"), "w") as f:
+        f.write('{"schema": 1, "host": "torn-host", "pid": 999, "t_wa')
+    return {
+        "tiles_sum": float(tiles_sum),
+        "backlog_sum": 2.0 * FLEET_HOSTS,
+        "burn_max": 0.01 * (FLEET_HOSTS - 1),
+        "hist_count": hist_count,
+    }
+
+
+def run_fleet_leg(workdir: str, check) -> None:
+    """Fleet-telemetry plane checks (obs publish/aggregate/history/alerts).
+
+    Structural, exact: the pod fold's counters equal the per-host sums,
+    gauges follow the merge-policy table, the stale host and the torn
+    snapshot are flagged (never silently dropped, never a crash), two
+    folds render byte-identical exposition, and a scripted history
+    drives a firing → resolved alert lifecycle deterministically.
+    Banded: aggregator fold throughput and publisher min-of-reps
+    snapshot cost.  Callable on its own (``tests/test_fleet.py``) — it
+    needs no bench baselines.
+    """
+    import time as _time
+
+    from land_trendr_tpu.obs import aggregate
+    from land_trendr_tpu.obs.alerts import AlertEngine, AlertRule
+    from land_trendr_tpu.obs.metrics import MetricsRegistry
+    from land_trendr_tpu.obs.publish import TelemetryPublisher
+
+    snap_dir = str(Path(workdir) / "fleet_snaps")
+    Path(snap_dir).mkdir(parents=True, exist_ok=True)
+    now = 1.8e9
+    expect = _synth_fleet_snaps(snap_dir, now)
+
+    view = aggregate.fold_dir(snap_dir, now=now)
+    by_name = {
+        m["name"]: m for m in view["metrics"] if not m.get("labels")
+    }
+    check(
+        "fleet.counters_sum_exact",
+        by_name.get("lt_tiles_done_total", {}).get("value")
+        == expect["tiles_sum"],
+        f"pod lt_tiles_done_total "
+        f"{by_name.get('lt_tiles_done_total', {}).get('value')} == "
+        f"per-host sum {expect['tiles_sum']}",
+    )
+    check(
+        "fleet.gauge_policy",
+        by_name.get("lt_feed_backlog", {}).get("value")
+        == expect["backlog_sum"]
+        and abs(
+            (by_name.get("lt_slo_burn_rate", {}).get("value") or 0)
+            - expect["burn_max"]
+        ) < 1e-9,
+        f"backlog sums to {expect['backlog_sum']}, burn rate takes the "
+        f"pod max {expect['burn_max']}",
+    )
+    hist = by_name.get("lt_tile_compute_seconds", {})
+    check(
+        "fleet.histogram_merge",
+        hist.get("count") == expect["hist_count"]
+        and hist.get("buckets") == [FLEET_HOSTS, FLEET_HOSTS, FLEET_HOSTS, 0],
+        f"merged histogram count {hist.get('count')} buckets "
+        f"{hist.get('buckets')}",
+    )
+    counts = view["counts"]
+    check(
+        "fleet.staleness_flagged",
+        counts["stale"] == 1 and counts["corrupt"] == 1
+        and counts["folded"] == FLEET_HOSTS
+        and len(view["hosts"]) == FLEET_HOSTS + 1,
+        f"{counts['stale']} stale + {counts['corrupt']} torn flagged, "
+        f"all {FLEET_HOSTS + 1} files listed, none dropped silently",
+    )
+    prom_a = aggregate.render_prom(view)
+    prom_b = aggregate.render_prom(aggregate.fold_dir(snap_dir, now=now))
+    check(
+        "fleet.byte_stable",
+        prom_a == prom_b and len(prom_a) > 0,
+        f"two independent folds render identical exposition "
+        f"({len(prom_a)} bytes)",
+    )
+
+    reps = 20
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        aggregate.fold_dir(snap_dir, now=now)
+    folds_per_s = reps / (_time.perf_counter() - t0)
+    check(
+        "fleet.aggregator_throughput",
+        folds_per_s >= FLEET_MIN_FOLDS_PER_S,
+        f"{folds_per_s:,.0f} folds/s of a {FLEET_HOSTS}-host set vs "
+        f"floor {FLEET_MIN_FOLDS_PER_S:,.0f}",
+    )
+
+    # alert lifecycle on a SCRIPTED history: deterministic and replayable
+    rule = AlertRule(
+        name="gate_queue", kind="threshold", metric="q", op=">",
+        value=5.0, for_s=2.0, hold_down_s=3.0,
+    )
+
+    def _script() -> list:
+        eng = AlertEngine((rule,))
+        out = []
+        for t in range(20):
+            q = 10.0 if 4 <= t < 9 else 0.0
+            for tr in eng.evaluate(
+                [{"t": float(t), "metrics": {"q": q}}], float(t)
+            ):
+                out.append((t, tr["state"], tr["duration_s"]))
+        return out
+
+    run1, run2 = _script(), _script()
+    check(
+        "fleet.alert_deterministic",
+        run1 == run2
+        and [(t, s) for t, s, _ in run1] == [(6, "firing"), (12, "resolved")]
+        and all(d >= 0 for _, _, d in run1),
+        f"scripted history replays to identical transitions: {run1}",
+    )
+
+    # publisher overhead, min-of-reps: a populated registry snapshots +
+    # writes atomically well under the ceiling
+    reg = MetricsRegistry()
+    for i in range(40):
+        reg.counter(f"lt_gate_counter_{i}", "g").inc(i)
+        reg.gauge(f"lt_gate_gauge_{i}", "g").set(i)
+    for i in range(8):
+        reg.histogram(f"lt_gate_hist_{i}", "g").observe(0.5)
+    pub = TelemetryPublisher(
+        str(Path(workdir) / "fleet_pub"), reg, interval_s=5.0,
+        host="gate-pub",
+    )
+    costs = []
+    for _ in range(10):
+        t0 = _time.perf_counter()
+        pub.publish_now()
+        costs.append(_time.perf_counter() - t0)
+    check(
+        "fleet.publisher_overhead",
+        min(costs) <= FLEET_PUBLISH_MAX_MIN_S,
+        f"min-of-reps publish {min(costs) * 1e3:.2f}ms vs ceiling "
+        f"{FLEET_PUBLISH_MAX_MIN_S * 1e3:.0f}ms (median "
+        f"{sorted(costs)[len(costs) // 2] * 1e3:.2f}ms)",
+    )
+
+
 def run_gate(workdir: str, checks: list) -> None:
     """Run the bench smokes + the trace-assembly leg; append
     (name, ok, detail) rows."""
@@ -375,6 +580,7 @@ def run_gate(workdir: str, checks: list) -> None:
         )
 
     run_trace_leg(workdir, check)
+    run_fleet_leg(workdir, check)
 
     # -- flight recorder (ring + sampler overhead) ------------------------
     base = json.loads(FLIGHT_BASELINE.read_text())
