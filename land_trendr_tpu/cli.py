@@ -297,6 +297,28 @@ def build_parser() -> argparse.ArgumentParser:
                      help="no straggler verdicts until N tiles completed "
                      "(the first tile carries the jit compile and must "
                      "never false-positive)")
+    seg.add_argument("--lease-batch", type=int, default=0, metavar="N",
+                     help="elastic pod scheduling: replace the static "
+                     "host_share tile split with the shared-manifest "
+                     "lease queue — this process claims N tiles at a "
+                     "time, renews leases on progress, and steals tiles "
+                     "whose leases expired (dead/slow peer) or were "
+                     "never claimed, so hosts may join/leave mid-run; "
+                     "0 (default) keeps the static split.  Artifacts "
+                     "are byte-identical either way")
+    seg.add_argument("--lease-ttl-s", type=float, default=30.0,
+                     metavar="SEC",
+                     help="lease time-to-live: a lease not renewed "
+                     "within SEC is stealable by siblings.  Size it "
+                     "above the slowest tile and the pod's clock skew "
+                     "(a short TTL only costs benign duplicate work, "
+                     "never correctness)")
+    seg.add_argument("--speculate", action="store_true",
+                     help="with --lease-batch: straggler-steered "
+                     "speculative execution — an idle host re-leases a "
+                     "tile the owner's live straggler detector flagged; "
+                     "first durable write wins, the loser lands as an "
+                     "identical no-op")
     seg.add_argument("--fault-schedule", default=None, metavar="SPEC",
                      help="deterministic fault injection for test/soak "
                      "runs (land_trendr_tpu.runtime.faults), e.g. "
@@ -946,6 +968,9 @@ def main(argv: list[str] | None = None) -> int:
                 merge_timeout_s=args.merge_timeout_s,
                 straggler_k=args.straggler_k,
                 straggler_min_tiles=args.straggler_min_tiles,
+                lease_batch=args.lease_batch,
+                lease_ttl_s=args.lease_ttl_s,
+                speculate=args.speculate,
                 fault_schedule=args.fault_schedule,
                 metrics_interval_s=args.metrics_interval_s,
                 impl=args.impl,

@@ -107,6 +107,20 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "threshold_s": _NUM,
         "median_s": _NUM,
     },
+    # --- elastic pod scheduling (runtime/leases) -------------------------
+    # this host claimed a never-leased (or cleanly released) tile from
+    # the shared-manifest lease queue at generation ``gen``.  Additive
+    # event type, introduced without a schema bump.
+    "tile_leased": {"tile_id": int, "gen": int},
+    # this host STOLE a tile whose lease expired (dead/wedged peer) —
+    # gen is the successor generation the steal claimed (>= 1 by
+    # construction; the value lint pins it).  Additive.
+    "lease_stolen": {"tile_id": int, "gen": int},
+    # this host speculatively re-leased a straggler-flagged tile still
+    # in flight on its owner: first durable write wins, the loser's
+    # write lands as an identical no-op.  gen >= 1 like a steal.
+    # Additive.
+    "tile_speculated": {"tile_id": int, "gen": int},
     # the tile's result is ready on host (dispatch + device wait)
     "tile_done": {
         "tile_id": int,
@@ -298,7 +312,17 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "budget_bytes": int,
         "segments": int,
     },
-    "run_done": {"stage_s": dict, "tiles_quarantined": int},
+    "run_done": {
+        "stage_s": dict,
+        "tiles_quarantined": int,
+        # elastic scheduling rollups (lease runs only): tiles this host
+        # STOLE from expired leases / ran speculatively
+        "tiles_stolen": int,
+        "tiles_speculated": int,
+    },
+    "tile_leased": {"owner": str},
+    "lease_stolen": {"owner": str, "from_owner": str},
+    "tile_speculated": {"owner": str, "from_owner": str},
     "job_submitted": {"source": str},
     "job_done": {"tiles_quarantined": int, "error": str},
     "job_rejected": {"job_id": str, "tenant": str},
@@ -316,6 +340,8 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "store_bytes": int,
         "device_bytes_in_use": _NUM,
         "stragglers": int,
+        "tiles_stolen": int,
+        "tiles_speculated": int,
     },
     "profile_captured": {"error": str, "bytes": int},
     "job_slo": {"deadline_s": _NUM},

@@ -251,6 +251,11 @@ def _fold_host_scope(
         "tiles_done": 0,
         "stragglers": 0,
         "retries": 0,
+        # elastic pod scheduling (runtime/leases): acquisitions this
+        # host won, split by kind — the steal/speculation imbalance view
+        "tiles_leased": 0,
+        "tiles_stolen": 0,
+        "tiles_speculated": 0,
     }
     spans: "list[dict]" = []
     markers: "list[dict]" = []
@@ -355,6 +360,26 @@ def _fold_host_scope(
                     "duration_s": rec.get("duration_s"),
                     "threshold_s": rec.get("threshold_s"),
                 })
+            elif ev == "tile_leased":
+                host["tiles_leased"] += 1
+            elif ev in ("lease_stolen", "tile_speculated"):
+                # steals and speculative re-leases are the elastic
+                # scheduler ACTING — instants on the trace, like the
+                # straggler verdicts that steered them
+                host["tiles_leased"] += 1
+                key = (
+                    "tiles_stolen" if ev == "lease_stolen"
+                    else "tiles_speculated"
+                )
+                host[key] += 1
+                markers.append({
+                    "name": "steal" if ev == "lease_stolen" else "speculate",
+                    "tile": rec["tile_id"],
+                    "t0": round(t, 6),
+                    "file": fileno,
+                    "host": host["host"],
+                    "gen": rec.get("gen"),
+                })
             elif ev == "run_done":
                 host["status"] = rec.get("status")
                 if _num(rec.get("wall_s")):
@@ -442,6 +467,9 @@ def assemble_pod_trace(paths: "list[str]") -> dict:
         "wall_s": round(pod_wall, 4),
         "stage_s": {k: round(v, 4) for k, v in sorted(pod_stage.items())},
         "stragglers": sum(h["stragglers"] for h in hosts),
+        "tiles_leased": sum(h["tiles_leased"] for h in hosts),
+        "tiles_stolen": sum(h["tiles_stolen"] for h in hosts),
+        "tiles_speculated": sum(h["tiles_speculated"] for h in hosts),
         "pixels": sum(h["pixels"] for h in hosts),
         "px_per_s": (
             round(sum(h["pixels"] for h in hosts) / pod_wall, 1)

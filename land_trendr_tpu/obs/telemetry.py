@@ -213,6 +213,31 @@ class Telemetry:
             "tiles whose in-flight duration exceeded k x the rolling "
             "median (obs/spans.StragglerDetector)",
         )
+        # elastic pod scheduling (runtime/leases): per-acquisition
+        # counters advanced by the tile_leased / lease_stolen /
+        # tile_speculated emits, plus the run-end lease rollup
+        self._lease_acquired = r.counter(
+            "lt_lease_acquired_total",
+            "tile leases this process won from the shared-manifest queue "
+            "(claims + steals + speculative re-leases)",
+        )
+        self._lease_stolen = r.counter(
+            "lt_lease_stolen_total",
+            "expired tile leases this process stole from dead/wedged peers",
+        )
+        self._lease_renewals = r.counter(
+            "lt_lease_renewals_total",
+            "lease renewal records appended for held in-flight tiles",
+        )
+        self._spec_tiles = r.counter(
+            "lt_speculative_tiles_total",
+            "straggler-flagged tiles this process re-leased speculatively",
+        )
+        self._spec_wins = r.counter(
+            "lt_speculative_wins_total",
+            "speculative tiles whose first durable done record was this "
+            "process's (the straggler's owner lost the race)",
+        )
         self._demoted = r.gauge(
             "lt_fetch_demoted",
             "1 once repeated packed-fetch failures demoted the run to the "
@@ -498,6 +523,65 @@ class Telemetry:
         )
         self._stragglers.inc()
 
+    def tile_leased(
+        self, tile_id: int, gen: int, owner: "str | None" = None
+    ) -> None:
+        """This process claimed a never-leased (or released) tile from
+        the shared-manifest lease queue (runtime/leases)."""
+        self.events.emit(
+            "tile_leased",
+            tile_id=tile_id,
+            gen=gen,
+            **({"owner": owner} if owner is not None else {}),
+        )
+        self._lease_acquired.inc()
+
+    def lease_stolen(
+        self,
+        tile_id: int,
+        gen: int,
+        owner: "str | None" = None,
+        from_owner: "str | None" = None,
+    ) -> None:
+        """This process stole a tile whose lease expired (dead or wedged
+        peer); ``gen`` is the successor generation the steal claimed."""
+        self.events.emit(
+            "lease_stolen",
+            tile_id=tile_id,
+            gen=gen,
+            **({"owner": owner} if owner is not None else {}),
+            **({"from_owner": from_owner} if from_owner is not None else {}),
+        )
+        self._lease_acquired.inc()
+        self._lease_stolen.inc()
+
+    def tile_speculated(
+        self,
+        tile_id: int,
+        gen: int,
+        owner: "str | None" = None,
+        from_owner: "str | None" = None,
+    ) -> None:
+        """This process speculatively re-leased a straggler-flagged tile
+        still in flight on its owner (first durable write wins)."""
+        self.events.emit(
+            "tile_speculated",
+            tile_id=tile_id,
+            gen=gen,
+            **({"owner": owner} if owner is not None else {}),
+            **({"from_owner": from_owner} if from_owner is not None else {}),
+        )
+        self._lease_acquired.inc()
+        self._spec_tiles.inc()
+
+    def lease_summary(self, stats: Mapping[str, Any]) -> None:
+        """Fold one run's terminal lease-queue counters into the metrics
+        registry (``stats`` is :meth:`runtime.leases.LeaseQueue.stats`).
+        Metrics only — the per-acquisition events above already carry
+        the stream's story, and ``run_done`` carries the rollup fields."""
+        self._lease_renewals.inc(int(stats.get("renewals", 0)))
+        self._spec_wins.inc(int(stats.get("spec_wins", 0)))
+
     def fault_injected(self, seam: str, index: int, error: str) -> None:
         """One scheduled fault fired (the runtime.faults observer hook)."""
         self.events.emit("fault_injected", seam=seam, index=index, error=error)
@@ -702,6 +786,8 @@ class Telemetry:
         fit_rate: float,
         stage_s: Mapping[str, float] | None = None,
         tiles_quarantined: int | None = None,
+        tiles_stolen: int | None = None,
+        tiles_speculated: int | None = None,
     ) -> None:
         self.events.emit(
             "run_done",
@@ -715,6 +801,18 @@ class Telemetry:
             **(
                 {"tiles_quarantined": tiles_quarantined}
                 if tiles_quarantined
+                else {}
+            ),
+            # lease runs only (None = static split; 0 is a real value on
+            # an elastic run that stole/speculated nothing)
+            **(
+                {"tiles_stolen": tiles_stolen}
+                if tiles_stolen is not None
+                else {}
+            ),
+            **(
+                {"tiles_speculated": tiles_speculated}
+                if tiles_speculated is not None
                 else {}
             ),
         )

@@ -151,6 +151,15 @@ def host_share(items: Sequence[_T]) -> list[_T]:
     a host's input reads stay sequential on its local storage view.  The
     remainder spreads one-per-host from process 0 (``np.array_split``
     semantics, computed with plain slicing so items pass through untouched).
+
+    This is the STATIC split: deterministic and coordination-free, but one
+    slow or dead host strands its whole share.  The tile driver's elastic
+    mode (``RunConfig.lease_batch > 0`` —
+    :mod:`land_trendr_tpu.runtime.leases`) supersedes it with a
+    shared-manifest lease queue: hosts claim tiles in small batches,
+    finishing hosts steal expired/unclaimed work, and hosts may join or
+    leave mid-run.  ``host_share`` remains for row-sharded global batches
+    and for lease-free runs.
     """
     n, i = jax.process_count(), jax.process_index()
     q, r = divmod(len(items), n)

@@ -11,6 +11,7 @@ from land_trendr_tpu.runtime.driver import (
     plan_tiles,
     run_stack,
 )
+from land_trendr_tpu.runtime.leases import LeaseQueue
 from land_trendr_tpu.runtime.manifest import TileManifest, run_fingerprint
 from land_trendr_tpu.runtime.stack import (
     RasterStack,
@@ -33,6 +34,7 @@ __all__ = [
     "load_stack_dir",
     "load_stack_dir_c2",
     "stack_from_synthetic",
+    "LeaseQueue",
     "TileManifest",
     "run_fingerprint",
 ]

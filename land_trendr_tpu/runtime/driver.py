@@ -58,6 +58,7 @@ from land_trendr_tpu.ops.tile import PALLAS_BLOCK, process_tile_dn, resolve_impl
 from land_trendr_tpu.runtime import feed as feedmod
 from land_trendr_tpu.runtime import fetch as fetchmod
 from land_trendr_tpu.runtime import faults
+from land_trendr_tpu.runtime.leases import LeaseQueue
 from land_trendr_tpu.runtime.manifest import (
     ARTIFACT_COMPRESS,
     TileManifest,
@@ -250,6 +251,31 @@ class RunConfig:
     #: run — the first tile carries the jit compile and a one-sample
     #: median is noise, so early tiles must never false-positive.
     straggler_min_tiles: int = 5
+    #: elastic pod scheduling (:mod:`land_trendr_tpu.runtime.leases`):
+    #: ``0`` (default) keeps the static ``host_share`` tile split; ``N >
+    #: 0`` replaces it with the shared-manifest lease queue — this
+    #: process claims tiles ``N`` at a time, renews its leases on
+    #: progress ticks, and steals tiles whose leases expired (dead or
+    #: wedged peer) or were never claimed, so hosts may join/leave
+    #: mid-run and one slow host no longer strands a static share.
+    #: Correctness never rides the lease: the done record stays the one
+    #: durability signal and double execution resolves to byte-identical
+    #: artifacts at the atomic rename.  An execution fact — never
+    #: fingerprinted; a resume may freely mix static and leased runs.
+    lease_batch: int = 0
+    #: lease time-to-live, seconds: a lease not renewed within this
+    #: window is stealable by any sibling.  Size it comfortably above
+    #: the slowest tile (renewals tick from the driver loop, so a tile
+    #: longer than the TTL invites a benign duplicate execution) and
+    #: above the pod's worst wall-clock skew.  A throughput knob, never
+    #: a correctness one.
+    lease_ttl_s: float = 30.0
+    #: with ``lease_batch > 0``: straggler-steered speculative
+    #: execution — an idle host re-leases a tile the owner's live
+    #: StragglerDetector flagged (still in flight, lease unexpired);
+    #: first durable write wins, the loser's write lands as an identical
+    #: no-op.  The PR-10 verdicts steer instead of merely watch.
+    speculate: bool = False
     #: deterministic fault-injection schedule
     #: (:func:`land_trendr_tpu.runtime.faults.parse_schedule`, e.g.
     #: ``"seed=7,dispatch@1,fetch.wait@0*2=io"``) — fires scheduled
@@ -615,6 +641,18 @@ class RunConfig:
                 f"straggler_min_tiles={self.straggler_min_tiles} must be "
                 ">= 1"
             )
+        if self.lease_batch < 0:
+            raise ValueError(
+                f"lease_batch={self.lease_batch} must be >= 0 (0 = static "
+                "host_share split, N = elastic lease queue)"
+            )
+        if self.lease_ttl_s <= 0:
+            raise ValueError(f"lease_ttl_s={self.lease_ttl_s} must be > 0")
+        if self.speculate and not self.lease_batch:
+            raise ValueError(
+                "speculate requires lease_batch > 0 (speculative execution "
+                "is a lease-queue path; there is no queue to re-lease from)"
+            )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam/spec is a config error at exit-2
             # time, not a dead injection discovered after the soak run
@@ -957,6 +995,9 @@ class Run:
             "tiles_quarantined": 0,
             "retries": 0,
             "stragglers": 0,
+            "tiles_leased": 0,
+            "tiles_stolen": 0,
+            "tiles_speculated": 0,
             "feed_backlog": 0,
             "write_backlog": 0,
             "fetch_backlog": 0,
@@ -975,6 +1016,7 @@ class Run:
         )
         # per-run state, populated by execute(); exposed so a serving
         # layer can introspect a live or finished run
+        self.lease_q: "LeaseQueue | None" = None
         self.manifest: "TileManifest | None" = None
         self.telemetry = None
         self.fetcher = None
@@ -1014,6 +1056,21 @@ class Run:
                 tile_id, duration_s, threshold_s, median_s,
                 in_flight=in_flight, attempt=attempt,
             )
+        # elastic mode: the verdict STEERS, not merely watches — flag the
+        # held lease in the shared manifest so an idle sibling may
+        # speculatively re-lease the tile (first durable write wins).
+        # Only in-flight verdicts matter (a completed straggler is done);
+        # best-effort: a flag append failing on a sick shared FS must
+        # never kill the sampler thread or the run.
+        lq = self.lease_q
+        if lq is not None and in_flight:
+            try:
+                lq.flag(tile_id)
+            except Exception as exc:
+                log.warning(
+                    "straggler flag append failed for tile %d: %s",
+                    tile_id, exc,
+                )
 
     def _sampler_probes(self) -> dict:
         """Host gauges for the flight sampler's ``flight_sample`` events:
@@ -1032,7 +1089,8 @@ class Run:
             k: int(p[k])
             for k in (
                 "feed_backlog", "write_backlog", "fetch_backlog",
-                "upload_backlog", "stragglers",
+                "upload_backlog", "stragglers", "tiles_stolen",
+                "tiles_speculated",
             )
         }
         out.update(blockcache.occupancy_probe())
@@ -1144,7 +1202,12 @@ class Run:
             # Sharing the post-resume list instead would race: processes that
             # open the shared manifest at different times would partition
             # different lists, leaving tiles in nobody's share.
-            share = host_share(share)
+            # Elastic mode (lease_batch > 0) replaces the static split
+            # with the shared-manifest lease queue: every process sees
+            # the FULL list and claims work dynamically, so a slow or
+            # dead host strands nothing and late joiners just claim.
+            if not cfg.lease_batch:
+                share = host_share(share)
             px_sharding = NamedSharding(mesh, PartitionSpec(PIXEL_AXIS, None))
             # _feed_tile pads to feed_px with the QA fill bit, which also
             # covers the divisibility the sharded pixel axis needs
@@ -1204,10 +1267,27 @@ class Run:
         done = manifest.open(cfg.resume)
         years = stack.years.astype(np.int32)
         bands = idx.required_bands(cfg.index, cfg.ftv_indices)
-        todo = [t for t in share if t.tile_id not in done]
-        n_resume_skipped = len(share) - len(todo)
+        lease_q: "LeaseQueue | None" = None
+        if cfg.lease_batch:
+            # the elastic work source: tiles are claimed from the shared
+            # manifest in lease_batch batches instead of being assigned
+            # up front — ``todo`` starts empty and grows as claims win
+            lease_q = self.lease_q = LeaseQueue(
+                manifest.path,
+                [t.tile_id for t in share],
+                ttl_s=cfg.lease_ttl_s,
+                done0=done,
+            )
+            spec_by_id = {t.tile_id: t for t in share}
+            todo: "list[TileSpec]" = []
+            n_todo_start = sum(1 for t in share if t.tile_id not in done)
+            n_resume_skipped = len(share) - n_todo_start
+        else:
+            todo = [t for t in share if t.tile_id not in done]
+            n_todo_start = len(todo)
+            n_resume_skipped = len(share) - len(todo)
         self.progress.update(
-            phase="setup", tiles_total=len(tiles), tiles_todo=len(todo)
+            phase="setup", tiles_total=len(tiles), tiles_todo=n_todo_start
         )
 
         t_run = time.perf_counter()
@@ -1412,6 +1492,15 @@ class Run:
                     "x0": t.x0,
                     "h": t.h,
                     "w": t.w,
+                    # elastic runs stamp the done record with its writer:
+                    # the FIRST done record's owner is the race winner —
+                    # how speculative wins are attributed (and how the
+                    # soaks audit who completed what)
+                    **(
+                        {"owner": self.lease_q.owner}
+                        if self.lease_q is not None
+                        else {}
+                    ),
                     # dispatch + result-wait wall time: device compute + any
                     # transfer stalls; host work overlapped by the pipeline is
                     # excluded (an estimate, not a device-profile number)
@@ -1805,7 +1894,7 @@ class Run:
                     process_index=jax.process_index(),
                     process_count=jax.process_count(),
                     tiles_total=len(tiles),
-                    tiles_todo=len(todo),
+                    tiles_todo=n_todo_start,
                     tiles_skipped_resume=n_resume_skipped,
                     mesh_devices=n_mesh,
                     impl=impl_resolved,
@@ -2078,6 +2167,76 @@ class Run:
                 "compile_s": round(compile_s, 6),
             }
 
+        def _prime_feeds() -> None:
+            """Fill the bounded feed queue from ``todo`` — the shared
+            priming step for run start and for elastic refills (the
+            pipeline must restart itself after running dry)."""
+            nonlocal next_i
+            while next_i < len(todo) and len(pending_feeds) < ra_depth:
+                _submit_feed(next_i)
+                next_i += 1
+
+        def _refill_work() -> int:
+            """Elastic mode: claim another lease batch and feed the won
+            tiles.  Returns the number won.  Acquisition failures (the
+            lease.acquire / lease.steal fault seams, a shared-FS blip)
+            are logged and retried next cycle — a filesystem hiccup must
+            not kill a run the artifact path would have survived."""
+            try:
+                won = lease_q.acquire(
+                    cfg.lease_batch, speculate=cfg.speculate
+                )
+            except Exception as e:
+                log.warning(
+                    "lease acquisition failed (%s); retrying next cycle", e
+                )
+                if watchdog is not None:
+                    watchdog.tick()  # a failed claim is still liveness
+                return 0
+            for tile_id, mode, lease in won:
+                todo.append(spec_by_id[tile_id])
+                self.progress["tiles_leased"] += 1
+                if mode == "steal":
+                    self.progress["tiles_stolen"] += 1
+                    log.info(
+                        "stole tile %d (lease expired; claimed gen %d)",
+                        tile_id, lease.gen,
+                    )
+                    if telemetry is not None:
+                        telemetry.lease_stolen(
+                            tile_id, lease.gen, owner=lease_q.owner,
+                            from_owner=lease.prev_owner,
+                        )
+                elif mode == "spec":
+                    self.progress["tiles_speculated"] += 1
+                    log.info(
+                        "speculatively re-leased straggler tile %d "
+                        "(gen %d; first durable write wins)",
+                        tile_id, lease.gen,
+                    )
+                    if telemetry is not None:
+                        telemetry.tile_speculated(
+                            tile_id, lease.gen, owner=lease_q.owner,
+                            from_owner=lease.prev_owner,
+                        )
+                elif telemetry is not None:
+                    telemetry.tile_leased(
+                        tile_id, lease.gen, owner=lease_q.owner
+                    )
+            if won:
+                _prime_feeds()
+            return len(won)
+
+        def _lease_idle_wait() -> None:
+            """Nothing claimable, yet undone tiles remain on peers: wait
+            one bounded beat.  Deliberate idleness is progress for the
+            watchdog (waiting out a live peer's lease is not a stall);
+            the cancel event still lands within a beat via the loop's
+            ``_check_cancel``."""
+            if watchdog is not None:
+                watchdog.tick()
+            time.sleep(min(0.5, max(cfg.lease_ttl_s / 8.0, 0.05)))
+
         program_stats = None
         run_ok = False
         try:
@@ -2089,15 +2248,52 @@ class Run:
                 self.progress["phase"] = "warmup"
                 program_stats = self.program_stats = _warm_programs()
             self.progress["phase"] = "pipeline"
-            next_i = min(ra_depth, len(todo))
-            for i in range(next_i):
-                _submit_feed(i)
+            next_i = 0
+            if lease_q is not None:
+                _refill_work()
+            _prime_feeds()
             pending = None
             while True:
                 self._check_cancel()
+                if lease_q is not None:
+                    lease_q.renew()
+                    if len(todo) - next_i <= ra_depth:
+                        _refill_work()
                 _pump_uploads()
                 if not pending_uploads:
-                    break  # feeds exhausted (or every remainder quarantined)
+                    if lease_q is None:
+                        break  # feeds exhausted (or remainder quarantined)
+                    # elastic: the local pipeline ran dry — resolve the
+                    # in-flight tail first (its done records are what
+                    # retire our held leases), then claim / steal /
+                    # speculate, and only then wait on live peers
+                    if pending is not None:
+                        _finish(pending)
+                        pending = None
+                        continue
+                    if pending_fetches:
+                        _drain_fetches(0)
+                        continue
+                    if pending_writes:
+                        _drain_writes(0)
+                        continue
+                    if _refill_work():
+                        continue
+                    try:
+                        complete = lease_q.run_complete()
+                    except Exception as e:
+                        # same contract as _refill_work: a shared-FS blip
+                        # while polling completion must not abort a run
+                        # the artifact path would have survived
+                        log.warning(
+                            "lease completion poll failed (%s); retrying "
+                            "next cycle", e,
+                        )
+                        complete = False
+                    if complete:
+                        break
+                    _lease_idle_wait()
+                    continue
                 t, handle, dn, qa, attempt0 = pending_uploads.popleft()
                 if telemetry is not None:
                     # attempt0 > 1 after feed retries: the stream's
@@ -2205,6 +2401,23 @@ class Run:
                     except Exception as exc:
                         log.error("ingest-store flush/close failed: %s", exc)
                     blockcache.detach_store(store)
+                if lease_q is not None and not run_ok:
+                    # relinquish unfinished claims so siblings may claim
+                    # NOW instead of waiting out the TTL.  Best-effort
+                    # and AFTER the writer drain (tiles whose writes the
+                    # drain completed are done, not released); a
+                    # SIGKILLed host never runs this — the TTL is the
+                    # backstop that keeps its tiles stealable.
+                    try:
+                        n_rel = lease_q.release_held("aborted")
+                        if n_rel:
+                            log.warning(
+                                "released %d unfinished tile lease(s) on "
+                                "abort; siblings may claim them immediately",
+                                n_rel,
+                            )
+                    except Exception as exc:
+                        log.error("abort-path lease release failed: %s", exc)
                 if fault_plan is not None and not run_ok:
                     # abort path: disarm here (after the writer drain, so seam
                     # indices stay deterministic through the last record()).  On
@@ -2244,6 +2457,11 @@ class Run:
                             # aborted/cancelled scope a serve post-mortem
                             # reads
                             telemetry.program_cache(program_stats)
+                        lease_stats = (
+                            lease_q.stats() if lease_q is not None else None
+                        )
+                        if lease_stats is not None:
+                            telemetry.lease_summary(lease_stats)
                         telemetry.run_done(
                             "aborted",
                             tiles_done=n_done,
@@ -2253,6 +2471,14 @@ class Run:
                             fit_rate=(n_fit / n_px) if n_px else 0.0,
                             stage_s=timer.summary(),
                             tiles_quarantined=len(quarantined),
+                            tiles_stolen=(
+                                lease_stats["stolen"]
+                                if lease_stats is not None else None
+                            ),
+                            tiles_speculated=(
+                                lease_stats["speculated"]
+                                if lease_stats is not None else None
+                            ),
                         )
                     except Exception as exc:
                         log.error("abort-path telemetry run_done failed: %s", exc)
@@ -2308,6 +2534,13 @@ class Run:
             # duration exceeded straggler_k x the rolling median
             "stragglers": self.straggler.stats()["stragglers"],
         }
+        if lease_q is not None:
+            # elastic scheduling rollup: acquisitions, steals,
+            # speculative re-leases and their win count (first durable
+            # done record ours), renewals, torn lease-log lines skipped
+            summary["lease"] = lease_q.stats()
+            summary["tiles_stolen"] = summary["lease"]["stolen"]
+            summary["tiles_speculated"] = summary["lease"]["speculated"]
         feed_cache_stats = blockcache.stats_delta(feed_cache_base)
         if cfg.feed_cache_mb:
             summary["feed_cache"] = feed_cache_stats
@@ -2337,6 +2570,10 @@ class Run:
                     # one warm-cache rollup per run scope, like the
                     # fetch/upload/store rollups above
                     telemetry.program_cache(program_stats)
+                if lease_q is not None:
+                    # terminal lease counters (renewals, speculative
+                    # wins) into the lt_lease_*/lt_speculative_* gauges
+                    telemetry.lease_summary(summary["lease"])
                 try:
                     telemetry.run_done(
                         "ok",
@@ -2347,6 +2584,14 @@ class Run:
                         fit_rate=summary["fit_rate"],
                         stage_s=timer.summary(),
                         tiles_quarantined=len(quarantined),
+                        tiles_stolen=(
+                            summary.get("tiles_stolen")
+                            if lease_q is not None else None
+                        ),
+                        tiles_speculated=(
+                            summary.get("tiles_speculated")
+                            if lease_q is not None else None
+                        ),
                     )
                 finally:
                     # the terminal-event emit may raise (full disk) and that error

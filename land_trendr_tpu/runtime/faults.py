@@ -31,6 +31,16 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
 ``manifest.record`` tile artifact + manifest-line persist (entry)
 ``manifest.torn``   post-rename artifact truncation (behavioral: the
                     manifest truncates its own artifact, then raises)
+``lease.acquire``   elastic lease-batch claim (``runtime/leases.py``):
+                    the whole acquisition fails; the host backs off and
+                    retries next cycle
+``lease.steal``     an expired-lease steal claim: the steal write fails
+                    (the tile stays stealable; a sibling or the next
+                    cycle takes it)
+``lease.expire``    behavioral: a live foreign lease reads as EXPIRED to
+                    the probing host — forces the steal-while-the-owner-
+                    still-runs double-execution race deterministically
+                    (first durable write wins, artifacts byte-identical)
 ``merge.peer``      multihost event merge — a probed peer reads as
                     not-terminal (slow/dead peer; behavioral)
 ``serve.submit``    serve-mode job admission (``serve/server.py``): the
@@ -109,6 +119,9 @@ SEAMS = (
     "fetch.wait",
     "manifest.record",
     "manifest.torn",
+    "lease.acquire",
+    "lease.steal",
+    "lease.expire",
     "merge.peer",
     "serve.submit",
     "serve.job",
@@ -131,6 +144,9 @@ _DEFAULT_KIND = {
     "fetch.wait": "runtime",
     "manifest.record": "io",
     "manifest.torn": "fire",
+    "lease.acquire": "io",
+    "lease.steal": "io",
+    "lease.expire": "fire",
     "merge.peer": "fire",
     "serve.submit": "io",
     "serve.job": "runtime",

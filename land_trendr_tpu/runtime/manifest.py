@@ -106,6 +106,14 @@ class TileManifest:
     fingerprint: str
     context: dict | None = None
     telemetry: "object | None" = None
+    #: torn/malformed manifest lines skipped by the last tolerant scan
+    #: (:meth:`open` resume pass / :meth:`iter_records`).  A reader
+    #: racing a concurrent append — the elastic lease queue, a pod
+    #: sibling's done record, an ENOSPC half-line — sees at most a torn
+    #: tail; skip-and-count (the blockstore GC's posture) instead of
+    #: dying in ``json.loads``: a lost done record at worst recomputes
+    #: an idempotent tile, while a crashed scan loses the whole run.
+    skipped_lines: int = dataclasses.field(default=0, init=False)
     #: pod-wide run correlation ID, agreed through the shared manifest
     #: header: exactly ONE process of a pod run writes the header
     #: (exclusive create) and stamps a fresh id; every other process —
@@ -166,41 +174,79 @@ class TileManifest:
             return set()
 
         done: set[int] = set()
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
+        header_seen = False
+        any_record = False
+        deadline: "float | None" = None
+        while True:
+            done.clear()
+            header_seen = False
+            any_record = False
+            for rec in self._iter_tolerant():
+                any_record = True
+                self._fold_open_record(rec, done)
                 if rec.get("kind") == "header":
-                    if rec.get("fingerprint") != self.fingerprint:
-                        raise ValueError(
-                            f"workdir {self.workdir} belongs to a different "
-                            f"run (manifest fingerprint {rec.get('fingerprint')} "
-                            f"!= {self.fingerprint}); pass resume=False to "
-                            "discard it"
-                        )
-                    # the pod-wide correlation id the header's writer
-                    # stamped (None on pre-run_id manifests — the driver
-                    # falls back to a per-process id)
-                    self.run_id = rec.get("run_id")
-                    # headers written before context existed were all
-                    # single-device runs — treat a missing key as that
-                    stored = rec.get("context", {"mesh_devices": 1})
-                    if self.context is not None and stored != self.context:
-                        raise ValueError(
-                            f"workdir {self.workdir} was produced under a "
-                            f"different execution context "
-                            f"({stored} != {self.context}); "
-                            "pass resume=False to discard it"
-                        )
-                    continue
-                if rec.get("kind") != "tile":
-                    continue
-                tid = int(rec["tile_id"])
-                if self._artifact_readable(tid):
-                    done.add(tid)
+                    header_seen = True
+            if header_seen or any_record:
+                break
+            # the shared-workdir creation window: a pod sibling holds the
+            # exclusive create and is inside its buffered header write —
+            # an EMPTY manifest (or one whose only line is the header
+            # still mid-flush, visible as a torn fragment) is a peer
+            # mid-write, not a damaged workdir.  Wait it out boundedly
+            # before judging.  Parseable records without a header never
+            # retry: appends only happen after an open() that saw the
+            # header, so that state is real damage.
+            if deadline is None:
+                deadline = time.time() + 2.0
+            elif time.time() > deadline:
+                break
+            time.sleep(0.02)
+        if not header_seen:
+            # the fingerprint guard must not be skippable by corruption:
+            # a manifest whose header line cannot be read is a foreign /
+            # damaged workdir, not an empty done set
+            raise ValueError(
+                f"manifest {self.path} has no readable header "
+                f"({self.skipped_lines} torn/malformed line(s) skipped); "
+                "pass resume=False to discard the workdir"
+            )
         return done
+
+    def _fold_open_record(self, rec: dict, done: "set[int]") -> None:
+        """One record of the :meth:`open` resume scan: validate a header,
+        count an artifact-verified tile as done, ignore the rest."""
+        if rec.get("kind") == "header":
+            if rec.get("fingerprint") != self.fingerprint:
+                raise ValueError(
+                    f"workdir {self.workdir} belongs to a different "
+                    f"run (manifest fingerprint {rec.get('fingerprint')} "
+                    f"!= {self.fingerprint}); pass resume=False to "
+                    "discard it"
+                )
+            # the pod-wide correlation id the header's writer
+            # stamped (None on pre-run_id manifests — the driver
+            # falls back to a per-process id)
+            self.run_id = rec.get("run_id")
+            # headers written before context existed were all
+            # single-device runs — treat a missing key as that
+            stored = rec.get("context", {"mesh_devices": 1})
+            if self.context is not None and stored != self.context:
+                raise ValueError(
+                    f"workdir {self.workdir} was produced under a "
+                    f"different execution context "
+                    f"({stored} != {self.context}); "
+                    "pass resume=False to discard it"
+                )
+            return
+        if rec.get("kind") != "tile":
+            return
+        try:
+            tid = int(rec["tile_id"])
+        except (KeyError, TypeError, ValueError):
+            self.skipped_lines += 1  # parsed JSON, broken record
+            return
+        if self._artifact_readable(tid):
+            done.add(tid)
 
     def _artifact_readable(self, tile_id: int) -> bool:
         """True when the tile's ``.npz`` exists and its zip directory
@@ -335,9 +381,35 @@ class TileManifest:
         with np.load(self.tile_path(tile_id)) as z:
             return {k: z[k] for k in z.files}
 
-    def iter_records(self) -> Iterator[dict]:
+    def _iter_tolerant(self) -> Iterator[dict]:
+        """Parsed manifest records, torn/malformed lines skipped.
+
+        Resets then counts into :attr:`skipped_lines`.  In a shared pod
+        workdir a reader legitimately races concurrent appenders (lease
+        claims, sibling done records): the in-flight append shows up as
+        a torn trailing line, and an ENOSPC half-line buried by later
+        appends shows up as one unparseable mid-file line.  Both are
+        skipped and counted, like the blockstore GC's tolerant scan —
+        never a crashed reader.
+        """
+        self.skipped_lines = 0
         with open(self.path) as f:
             for line in f:
                 line = line.strip()
-                if line:
-                    yield json.loads(line)
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    self.skipped_lines += 1
+                    continue
+                if isinstance(rec, dict):
+                    yield rec
+                else:
+                    self.skipped_lines += 1
+
+    def iter_records(self) -> Iterator[dict]:
+        """Every readable manifest record; a torn tail (a concurrent
+        appender mid-write) or malformed line is skipped and counted in
+        :attr:`skipped_lines` instead of raising."""
+        return self._iter_tolerant()

@@ -786,7 +786,8 @@ class SegmentationServer:
             if p is not None:
                 for k in (
                     "feed_backlog", "write_backlog", "fetch_backlog",
-                    "upload_backlog", "stragglers",
+                    "upload_backlog", "stragglers", "tiles_stolen",
+                    "tiles_speculated",
                 ):
                     out[k] = int(p.get(k, 0))
         return out

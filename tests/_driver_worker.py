@@ -1,9 +1,13 @@
 """Worker process for the true multi-process DRIVER test.
 
 Run as: ``python _driver_worker.py <coordinator> <num_procs> <proc_id>
-<workdir> <summary_json> [size] [tile] [telemetry]``.  Each worker owns 4 virtual CPU
+<workdir> <summary_json> [size] [tile] [telemetry] [overrides_json]``.
+Each worker owns 4 virtual CPU
 devices (``size``/``tile`` default to the test's tiny 48×40/20 scene;
-``tools/multihost_bench.py`` passes larger ones for its artifact).  The
+``tools/multihost_bench.py`` passes larger ones for its artifact).
+``overrides_json`` (optional) is a path to a JSON dict of extra
+``RunConfig`` fields merged per process — how the elastic-scheduling
+tests/soaks give one host a fault schedule or lease knobs.  The
 worker joins the ``jax.distributed`` cluster, builds the SAME deterministic
 synthetic stack as its peers, and calls the real production entry point —
 ``run_stack`` with a LOCAL device mesh over a SHARED workdir.  Inside
@@ -34,6 +38,10 @@ def main() -> int:
     size = int(sys.argv[6]) if len(sys.argv) > 6 else 0
     tile = int(sys.argv[7]) if len(sys.argv) > 7 else 20
     telemetry = bool(int(sys.argv[8])) if len(sys.argv) > 8 else False
+    overrides = {}
+    if len(sys.argv) > 9 and sys.argv[9]:
+        with open(sys.argv[9]) as f:
+            overrides = json.load(f)
 
     from land_trendr_tpu.config import LTParams
     from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
@@ -59,6 +67,7 @@ def main() -> int:
         # per-process events.p<i>.jsonl in the shared workdir; the primary
         # folds every host's stream into its summary["telemetry"]["hosts"]
         telemetry=telemetry,
+        **overrides,
     )
     summary = run_stack(rs, cfg, mesh=mesh)
     with open(out_path, "w") as f:

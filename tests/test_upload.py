@@ -279,9 +279,13 @@ def test_upload_bench_smoke(tmp_path):
 
 def test_perf_gate_smoke(tmp_path, capsys):
     """The tier-1 perf-regression gate: the three bench smokes must meet
-    the bands derived from the committed artifacts."""
+    the bands derived from the committed artifacts.  The elastic
+    scheduler leg is skipped here — it spawns two 2-process jax pods
+    (minutes-scale, timing-sensitive under suite load); CLI gate runs
+    carry it, and the lease invariants stay tier-1-covered by
+    tests/test_leases.py + fault_soak's lease case."""
     import perf_gate
 
-    rc = perf_gate.main(["--keep", str(tmp_path / "gate")])
+    rc = perf_gate.main(["--keep", str(tmp_path / "gate"), "--skip-scheduler"])
     out = capsys.readouterr()
     assert rc == 0, f"perf gate regressions:\n{out.out}\n{out.err}"

@@ -1,0 +1,79 @@
+"""Standalone elastic-pod worker: one independent process, one shared workdir.
+
+Run as: ``python tools/_elastic_worker.py CONFIG.json``.  Unlike
+``tests/_driver_worker.py`` this worker joins NO ``jax.distributed``
+cluster — elastic lease scheduling coordinates purely through the shared
+filesystem manifest, so a "pod" here is any set of independent processes
+pointed at one workdir, and a host can join a run that is already in
+flight (the late-joiner leg of ``tools/elastic_soak.py``) or be SIGKILLed
+without taking anyone else down (the kill leg).
+
+``CONFIG.json``::
+
+    {
+      "workdir": ..., "out_dir": ...,
+      "width": 80, "height": 80, "tile_size": 20, "seed": 11,
+      "summary_path": ...,            # where the run summary JSON lands
+      "run": { ... RunConfig overrides: lease_batch, lease_ttl_s,
+               speculate, fault_schedule, telemetry, ... }
+    }
+
+The synthetic scene is deterministic in (width, height, seed), so every
+worker — and the soak's clean reference run — feeds identical pixels.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+
+# must beat any boot-hook platform pin before a backend is touched
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    with open(sys.argv[1]) as f:
+        cfg_json = json.load(f)
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack
+    from land_trendr_tpu.runtime import (
+        RunConfig,
+        run_stack,
+        stack_from_synthetic,
+    )
+
+    spec = SceneSpec(
+        width=int(cfg_json["width"]),
+        height=int(cfg_json["height"]),
+        year_start=1990,
+        year_end=2013,
+        seed=int(cfg_json.get("seed", 11)),
+    )
+    rs = stack_from_synthetic(make_stack(spec))
+    run_kw = dict(cfg_json.get("run", {}))
+    params = run_kw.pop("params", {"max_segments": 4, "vertex_count_overshoot": 2})
+    cfg = RunConfig(
+        params=LTParams.from_dict(params),
+        tile_size=int(cfg_json["tile_size"]),
+        workdir=cfg_json["workdir"],
+        out_dir=cfg_json["out_dir"],
+        retry_backoff_s=0.0,
+        **run_kw,
+    )
+    summary = run_stack(rs, cfg)
+    out = cfg_json.get("summary_path")
+    if out:
+        with open(out, "w") as f:
+            json.dump(summary, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

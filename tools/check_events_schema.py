@@ -77,7 +77,12 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     "tile_quarantined": ("tile_id", "attempts"),
     "stall": ("idle_s", "timeout_s"),
     "fetch_demoted": ("failures",),
-    "run_done": ("tiles_quarantined",),
+    "run_done": ("tiles_quarantined", "tiles_stolen", "tiles_speculated"),
+    # elastic pod scheduling (runtime/leases): tile ids and lease
+    # generations only count up
+    "tile_leased": ("tile_id", "gen"),
+    "lease_stolen": ("tile_id", "gen"),
+    "tile_speculated": ("tile_id", "gen"),
     # serve-mode events (land_trendr_tpu/serve): queue depths, waits,
     # latencies and warm-cache counters only go up / never negative
     "job_submitted": ("queue_depth",),
@@ -92,6 +97,7 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "write_backlog", "fetch_backlog", "upload_backlog", "queue_depth",
         "running", "jobs_total", "warm_program_count", "cache_bytes",
         "store_bytes", "device_bytes_in_use", "stragglers",
+        "tiles_stolen", "tiles_speculated",
     ),
     "profile_captured": ("duration_s", "bytes"),
     "job_slo": ("queue_wait_s", "exec_s", "latency_s", "deadline_s"),
@@ -289,6 +295,25 @@ def tile_straggler_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+def lease_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for the elastic-scheduling acquisition events: a
+    steal or a speculative re-lease is BY CONSTRUCTION a successor
+    generation (the tile had a lease to steal from / speculate against),
+    so ``gen >= 1`` — a 0 means the producer claimed a never-leased tile
+    under the wrong event type.  Non-negativity rides the generic loop."""
+    if not isinstance(rec, dict) or rec.get("ev") not in (
+        "lease_stolen", "tile_speculated"
+    ):
+        return []
+    gen = rec.get("gen")
+    if _num(gen) and gen < 1:
+        return [
+            f"line {lineno}: {rec['ev']}: gen {gen} below 1 (a steal/"
+            "speculation always claims a successor generation)"
+        ]
+    return []
+
+
 #: the alert event's state vocabulary (mirrors
 #: land_trendr_tpu.obs.alerts.ALERT_STATES — asserted equal in
 #: tests/test_fleet.py so the two cannot drift)
@@ -373,6 +398,7 @@ def value_lints():
             + job_slo_value_errors(rec, lineno)
             + span_value_errors(rec, lineno)
             + tile_straggler_value_errors(rec, lineno)
+            + lease_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )

@@ -1,0 +1,474 @@
+"""Elastic pod-scheduling soak: churn (SIGKILL + late join) and slow-host legs.
+
+The acceptance gate for the shared-manifest lease queue
+(:mod:`land_trendr_tpu.runtime.leases`), in two legs:
+
+* **churn** — independent worker processes share one workdir through the
+  lease queue alone (no ``jax.distributed``).  The victim worker is
+  SIGKILLed mid-run while holding leases; a second worker runs start to
+  finish; a third JOINS LATE, after the run is already under way.  The
+  run completes **without any resume**: survivors steal the victim's
+  expired leases, every tile lands durably exactly once (one artifact
+  per tile), and the artifacts are byte-identical to a clean single-host
+  run.
+* **slow-host** — a real two-process ``jax.distributed`` pod (the
+  production driver flow) with an injected slow host (``slow`` fault
+  kind on its compute waits, including one long park), run twice: static
+  ``host_share`` split vs the elastic lease queue with speculation.
+  ``lt_trace``'s analytics prove the collapse: pod busy-union idle gap
+  and ``host_imbalance`` both drop, and the straggler-steered
+  speculation path records at least one WIN (first durable done record
+  belongs to the speculating host).
+
+Full mode writes the ``ELASTIC_*.json`` artifact::
+
+    python tools/elastic_soak.py --out ELASTIC_r13.json
+    python tools/elastic_soak.py --smoke          # smaller, no artifact
+
+``tools/perf_gate.py``'s scheduler leg drives :func:`slow_host_leg` at
+smoke size with the same invariants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _digest_workdir(workdir: str) -> dict:
+    from tools.fault_soak import _digest_workdir as dig
+
+    return dig(workdir)
+
+
+def _manifest_records(workdir: str) -> list:
+    import json as _json
+
+    out = []
+    with open(os.path.join(workdir, "manifest.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _lease_audit(workdir: str) -> dict:
+    """Post-hoc audit of a run's lease log: done coverage, duplicate
+    done records, steal/spec claims, and speculative WINS (the first
+    done record's owner is the spec claimer)."""
+    recs = _manifest_records(workdir)
+    first_done: dict = {}
+    done_counts: dict = {}
+    steals: list = []
+    specs: dict = {}
+    for rec in recs:
+        kind = rec.get("kind")
+        if kind == "tile":
+            tid = rec.get("tile_id")
+            done_counts[tid] = done_counts.get(tid, 0) + 1
+            if tid not in first_done:
+                first_done[tid] = rec.get("owner")
+        elif kind == "lease":
+            if rec.get("mode") == "steal":
+                steals.append((rec.get("tile_id"), rec.get("owner")))
+            elif rec.get("mode") == "spec":
+                # last spec claim per tile wins the bookkeeping; claims
+                # are rare enough that this is exact in practice
+                specs[rec.get("tile_id")] = rec.get("owner")
+    spec_wins = sum(
+        1 for tid, owner in specs.items() if first_done.get(tid) == owner
+    )
+    return {
+        "tiles_done": len(done_counts),
+        "duplicate_done_records": sum(
+            v - 1 for v in done_counts.values() if v > 1
+        ),
+        "steals": len(steals),
+        "speculations": len(specs),
+        "spec_wins": spec_wins,
+        "done_owners": sorted(
+            {o for o in first_done.values() if o is not None}
+        ),
+    }
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn_worker(cfg_path: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, str(REPO / "tools" / "_elastic_worker.py"), cfg_path],
+        env=_worker_env(),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _write_worker_cfg(
+    path: Path, workdir: str, size: int, tile: int, run_kw: dict,
+    summary_path: "str | None" = None, height: "int | None" = None,
+) -> str:
+    """Write one ``tools/_elastic_worker.py`` config (shared with
+    ``fault_soak``'s lease-kill case — one copy of the worker contract)."""
+    cfg = {
+        "workdir": workdir,
+        "out_dir": workdir + "_o",
+        "width": size,
+        "height": size if height is None else height,
+        "tile_size": tile,
+        "seed": 11,
+        "summary_path": summary_path,
+        "run": run_kw,
+    }
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def churn_leg(
+    root: Path, size: int = 80, tile: int = 20, verbose: bool = True
+) -> dict:
+    """SIGKILL one host mid-lease, join one host late; no resume."""
+    root.mkdir(parents=True, exist_ok=True)
+    n_tiles = ((size + tile - 1) // tile) ** 2
+    ttl = 1.0
+
+    # clean single-host elastic reference (also proves 1-host lease mode)
+    clean_wd = str(root / "churn_clean")
+    p = _spawn_worker(_write_worker_cfg(
+        root / "churn_clean.json", clean_wd, size, tile,
+        {"lease_batch": 2, "lease_ttl_s": ttl},
+    ))
+    _, err = p.communicate(timeout=600)
+    if p.returncode != 0:
+        raise RuntimeError(f"clean elastic run failed:\n{err[-4000:]}")
+    clean = _digest_workdir(clean_wd)
+
+    wd = str(root / "churn_pod")
+    # victim A: slow per tile so it is mid-run (holding leases) when
+    # killed; batch 2 so it dies holding more than its in-flight tile
+    a_cfg = _write_worker_cfg(
+        root / "churn_a.json", wd, size, tile,
+        {
+            "lease_batch": 2,
+            "lease_ttl_s": ttl,
+            "fault_schedule": "seed=5,compute.wait%1.0=slow:0.3",
+        },
+    )
+    b_cfg = _write_worker_cfg(
+        root / "churn_b.json", wd, size, tile,
+        {
+            "lease_batch": 2,
+            "lease_ttl_s": ttl,
+            # modestly slow so real work remains when the late joiner's
+            # cold jax startup completes — C must get to claim tiles
+            "fault_schedule": "seed=6,compute.wait%1.0=slow:0.4",
+        },
+        summary_path=str(root / "churn_b_summary.json"),
+    )
+    c_cfg = _write_worker_cfg(
+        root / "churn_c.json", wd, size, tile,
+        {"lease_batch": 2, "lease_ttl_s": ttl},
+        summary_path=str(root / "churn_c_summary.json"),
+    )
+
+    a = _spawn_worker(a_cfg)
+    b = _spawn_worker(b_cfg)
+
+    def _done_records() -> int:
+        try:
+            return sum(
+                1 for r in _manifest_records(wd) if r.get("kind") == "tile"
+            )
+        except OSError:
+            return 0
+
+    def _a_holds_lease() -> bool:
+        return any(
+            r.get("kind") == "lease"
+            and isinstance(r.get("owner"), str)
+            and f":{a.pid}:" in r["owner"]
+            for r in _manifest_records(wd)
+        )
+
+    # kill A once it demonstrably participates (holds leases) and the
+    # run is clearly mid-flight — a kill at the starting line would not
+    # prove steal-on-death
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if a.poll() is not None:
+            raise RuntimeError(
+                "victim worker exited before the kill "
+                f"(rc={a.returncode}): {a.stderr.read()[-2000:]}"
+            )
+        if _done_records() >= 2 and _a_holds_lease():
+            break
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("victim never claimed a lease mid-run")
+    os.kill(a.pid, signal.SIGKILL)
+    a.communicate()
+    t_kill = time.time()
+
+    # late joiner C: enters once the run is demonstrably under way (its
+    # cold jax startup adds several more seconds of genuine lateness)
+    while _done_records() < 1:
+        if b.poll() is not None:
+            break
+        time.sleep(0.05)
+    c = _spawn_worker(c_cfg)
+
+    _, err_b = b.communicate(timeout=600)
+    _, err_c = c.communicate(timeout=600)
+    if b.returncode != 0:
+        raise RuntimeError(f"survivor worker failed:\n{err_b[-4000:]}")
+    if c.returncode != 0:
+        raise RuntimeError(f"late joiner failed:\n{err_c[-4000:]}")
+
+    got = _digest_workdir(wd)
+    if got != clean:
+        raise AssertionError(
+            "churn artifacts differ from the clean run (kill/steal/late-"
+            "join changed bytes)"
+        )
+    audit = _lease_audit(wd)
+    artifacts = len(list(Path(wd).glob("tile_*.npz")))
+    if artifacts != n_tiles or audit["tiles_done"] != n_tiles:
+        raise AssertionError(
+            f"lost tiles: {artifacts} artifacts / {audit['tiles_done']} "
+            f"done ids of {n_tiles}"
+        )
+    if audit["steals"] < 1:
+        raise AssertionError(
+            "no lease was stolen — the victim's death left nothing to "
+            "steal (kill timing regression?)"
+        )
+    # the late joiner must have contributed durable work (ANY done
+    # record of its own — under a tight TTL it may lose first-write
+    # races on stolen tiles and still be a real participant)
+    c_tiles = sum(
+        1
+        for r in _manifest_records(wd)
+        if r.get("kind") == "tile"
+        and isinstance(r.get("owner"), str)
+        and f":{c.pid}:" in r["owner"]
+    )
+    if c_tiles < 1:
+        raise AssertionError("late joiner completed no tiles")
+    leg = {
+        "tiles": n_tiles,
+        "victim_killed_at": t_kill,
+        "artifacts": artifacts,
+        "artifacts_identical": True,
+        "completed_without_resume": True,
+        **{k: v for k, v in audit.items() if k != "done_owners"},
+        "late_joiner_tiles": c_tiles,
+    }
+    if verbose:
+        print(f"  ok: churn leg ({json.dumps(leg, default=str)})")
+    return leg
+
+
+#: the injected slow host's schedule: most compute waits +0.2s, with a
+#: 2.5s park on invocations 3-4 — the flagged stragglers speculation
+#: must rescue.  The park spec comes FIRST (FaultPlan picks the first
+#: matching spec per invocation).
+SLOW_SCHEDULE = (
+    "seed=3,compute.wait@3*2=slow:2.5,compute.wait%0.9=slow:0.2"
+)
+
+
+def slow_host_leg(
+    root: Path, size: int = 120, tile: int = 20, verbose: bool = True
+) -> dict:
+    """Static split vs elastic lease queue under one injected slow host
+    (two-process ``jax.distributed`` pod), proven via ``lt_trace``."""
+    from tests._pod_launch import launch_pod
+
+    from land_trendr_tpu.obs.events import discover_event_files
+    from land_trendr_tpu.obs.spans import assemble_pod_trace
+
+    root.mkdir(parents=True, exist_ok=True)
+    worker = str(REPO / "tests" / "_driver_worker.py")
+    n_tiles = ((size + tile - 1) // tile) ** 2
+    results: dict = {}
+    for mode in ("static", "elastic"):
+        wd = str(root / f"slow_{mode}")
+        common = {
+            "retry_backoff_s": 0.0,
+            "straggler_k": 2.0,
+            "straggler_min_tiles": 3,
+        }
+        if mode == "elastic":
+            common.update(
+                lease_batch=1,
+                lease_ttl_s=20.0,
+                speculate=True,
+                # the sampler thread is the in-flight straggler scanner —
+                # the verdict must fire WHILE the slow host is parked
+                flight=True,
+                sampler_interval_s=0.1,
+            )
+        ov_paths = []
+        for i in range(2):
+            ov = dict(common)
+            if i == 1:
+                ov["fault_schedule"] = SLOW_SCHEDULE
+            p = root / f"slow_{mode}_ov{i}.json"
+            p.write_text(json.dumps(ov))
+            ov_paths.append(str(p))
+        summaries = [str(root / f"slow_{mode}_s{i}.json") for i in range(2)]
+        import shutil
+
+        launch_pod(
+            worker,
+            lambda i: [
+                "2", str(i), wd, summaries[i], str(size), str(tile), "1",
+                ov_paths[i],
+            ],
+            before_attempt=lambda: shutil.rmtree(wd, ignore_errors=True),
+            timeout=900.0,
+        )
+        trace = assemble_pod_trace(discover_event_files(wd, process_count=2))
+        pod_wall = trace["pod"]["wall_s"] or 0.0
+        idle_gap = sum(
+            max(pod_wall - (h.get("busy_s") or 0.0), 0.0)
+            for h in trace["hosts"]
+        )
+        audit = _lease_audit(wd)
+        per = [json.load(open(s)) for s in summaries]
+        results[mode] = {
+            "pod_wall_s": round(pod_wall, 3),
+            "host_walls_s": [h.get("wall_s") for h in trace["hosts"]],
+            "busy_s": [h.get("busy_s") for h in trace["hosts"]],
+            "idle_gap_pod_s": round(idle_gap, 3),
+            "host_imbalance": trace["pod"].get("host_imbalance"),
+            "stragglers": trace["pod"].get("stragglers"),
+            "tiles_stolen": trace["pod"].get("tiles_stolen"),
+            "tiles_speculated": trace["pod"].get("tiles_speculated"),
+            "tiles_done_per_host": [h.get("tiles_done") for h in trace["hosts"]],
+            "spec_wins": audit["spec_wins"],
+            "duplicate_done_records": audit["duplicate_done_records"],
+            "unique_done_tiles": audit["tiles_done"],
+            "pixels_per_host": [s.get("pixels") for s in per],
+        }
+        # exact no-lost-tile invariant, both modes
+        if audit["tiles_done"] != n_tiles:
+            raise AssertionError(
+                f"{mode}: {audit['tiles_done']} unique done tiles of "
+                f"{n_tiles}"
+            )
+        artifacts = len(list(Path(wd).glob("tile_*.npz")))
+        if artifacts != n_tiles:
+            raise AssertionError(
+                f"{mode}: {artifacts} artifacts of {n_tiles} (lost or "
+                "double-written tiles)"
+            )
+        if verbose:
+            print(f"  ok: slow-host {mode} ({json.dumps(results[mode])})")
+
+    st, el = results["static"], results["elastic"]
+    if not (el["idle_gap_pod_s"] < st["idle_gap_pod_s"]):
+        raise AssertionError(
+            f"elastic idle gap {el['idle_gap_pod_s']}s did not collapse "
+            f"vs static {st['idle_gap_pod_s']}s"
+        )
+    if not (
+        st["host_imbalance"] and el["host_imbalance"]
+        and el["host_imbalance"] < st["host_imbalance"]
+    ):
+        raise AssertionError(
+            f"elastic host_imbalance {el['host_imbalance']} did not drop "
+            f"vs static {st['host_imbalance']}"
+        )
+    if el["spec_wins"] < 1:
+        raise AssertionError(
+            "no speculative win: the straggler-steered path never beat "
+            "the parked owner"
+        )
+    results["deltas"] = {
+        "idle_gap_collapse": round(
+            st["idle_gap_pod_s"] / el["idle_gap_pod_s"], 3
+        ) if el["idle_gap_pod_s"] else None,
+        "imbalance_drop": round(
+            st["host_imbalance"] - el["host_imbalance"], 3
+        ),
+        "pod_wall_speedup": round(
+            st["pod_wall_s"] / el["pod_wall_s"], 3
+        ) if el["pod_wall_s"] else None,
+    }
+    if verbose:
+        print(f"  ok: slow-host deltas {json.dumps(results['deltas'])}")
+    return results
+
+
+def soak(
+    smoke: bool = False, keep: "str | None" = None, verbose: bool = True
+) -> dict:
+    root = Path(keep or tempfile.mkdtemp(prefix="lt_elastic_soak_"))
+    root.mkdir(parents=True, exist_ok=True)
+    # sizes are identical in both modes — each leg's scene is already
+    # the smallest that exercises it reliably (the late joiner needs the
+    # run to outlive its cold jax startup; the slow host must run enough
+    # tiles to reach SLOW_SCHEDULE's park with its straggler median
+    # seeded) — smoke only skips the artifact file
+    size_churn, size_slow = 80, 120
+    report = {
+        "smoke": smoke,
+        "churn": churn_leg(root, size=size_churn, verbose=verbose),
+        "slow_host": slow_host_leg(root, size=size_slow, verbose=verbose),
+        "ok": True,
+    }
+    if keep is None:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="smaller scenes, no artifact file")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep workdirs under DIR for post-mortem")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON report here (the ELASTIC_* artifact)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    report = soak(smoke=args.smoke, keep=args.keep)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(json.dumps({"ok": report["ok"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

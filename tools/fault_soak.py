@@ -112,6 +112,15 @@ def _eager_cases(retries: int) -> list[Case]:
             "seed=1,upload.wait@0*3",
             {"upload_packed": True, "max_retries": 4},
         ),
+        # elastic lease queue: the first acquisition fails at the
+        # lease.acquire seam — the host logs, backs off one cycle, and
+        # retries; the run completes with identical artifacts (the lease
+        # log is pure coordination, never a correctness surface)
+        Case(
+            "lease_acquire_fault",
+            "seed=1,lease.acquire@0=io",
+            {"lease_batch": 2, "lease_ttl_s": 10.0},
+        ),
         Case("manifest_enospc", "seed=1,manifest.record@1=enospc", {}, "resume"),
         Case("manifest_torn", "seed=1,manifest.torn@1", {}, "resume"),
         Case(
@@ -612,10 +621,117 @@ def soak(
                 f"({schedule2})"
             )
 
+    def run_lease_kill_case() -> None:
+        """Elastic failure semantics (ISSUE 12): two INDEPENDENT worker
+        processes share one workdir through the shared-manifest lease
+        queue alone; the victim — slow, holding leases — is SIGKILLed
+        mid-run.  The survivor steals the expired leases and finishes
+        the whole scene WITHOUT a resume, artifacts byte-identical to
+        the clean run.  Full mode only: two cold jax processes cost
+        tens of seconds the tier-1 smoke budget does not have (the
+        smoke's lease_acquire case + tests/test_leases.py cover the
+        in-process lease paths).  The worker spawn / config / manifest
+        audit reuse ``tools/elastic_soak.py``'s helpers — one copy of
+        the worker contract."""
+        import os
+        import signal
+
+        from tools.elastic_soak import (
+            _manifest_records,
+            _spawn_worker,
+            _write_worker_cfg,
+        )
+
+        wd = str(root / "eager_lease_kill")
+
+        def cfg_file(name: str, run_kw: dict) -> str:
+            # the eager track's 48×40 scene, so the clean digest is shared
+            return _write_worker_cfg(
+                root / name, wd, 48, 20,
+                {
+                    "params": {
+                        "max_segments": 4, "vertex_count_overshoot": 2,
+                    },
+                    **run_kw,
+                },
+                height=40,
+            )
+
+        lease_kw = {"lease_batch": 2, "lease_ttl_s": 1.0}
+        a = _spawn_worker(cfg_file("lease_kill_a.json", {
+            **lease_kw,
+            # slow per tile: the victim is guaranteed mid-run, leases in
+            # hand, when the kill lands
+            "fault_schedule": "seed=5,compute.wait%1.0=slow:0.3",
+        }))
+        b = _spawn_worker(cfg_file("lease_kill_b.json", dict(lease_kw)))
+
+        def recs() -> list:
+            try:
+                return _manifest_records(wd)
+            except OSError:
+                return []
+
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if a.poll() is not None:
+                raise AssertionError(
+                    "lease-kill victim exited before the kill: "
+                    + a.stderr.read()[-2000:]
+                )
+            rs = recs()
+            holds = any(
+                r.get("kind") == "lease"
+                and isinstance(r.get("owner"), str)
+                and f":{a.pid}:" in r["owner"]
+                for r in rs
+            )
+            if holds and sum(1 for r in rs if r.get("kind") == "tile") >= 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("lease-kill victim never held a lease")
+        os.kill(a.pid, signal.SIGKILL)
+        a.communicate()
+        _, err_b = b.communicate(timeout=600)
+        if b.returncode != 0:
+            raise AssertionError(
+                f"lease-kill survivor failed:\n{err_b[-4000:]}"
+            )
+        got = _digest_workdir(wd)
+        clean = _digest_workdir(str(root / "eager_clean"))
+        if got != clean:
+            raise AssertionError(
+                "lease-kill artifacts differ from the clean run"
+            )
+        steals = [
+            r for r in recs()
+            if r.get("kind") == "lease" and r.get("mode") == "steal"
+        ]
+        if not steals:
+            raise AssertionError(
+                "survivor never stole the dead victim's leases — the run "
+                "completing means the TTL/steal path silently changed"
+            )
+        report["cases"].append({
+            "track": "eager",
+            "case": "lease_kill_steal",
+            "schedule": "SIGKILL victim mid-lease",
+            "steals": len(steals),
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: eager/lease_kill_steal ({len(steals)} steal "
+                "claim(s) after SIGKILL)"
+            )
+
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
     run_straggler_case(eager)
     run_fleet_case(eager)
+    if not smoke:
+        run_lease_kill_case()
     run_serve_track()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a

@@ -193,7 +193,7 @@ def render(snap: dict) -> str:
     lines.append(
         f"{'JOB':<22} {'STATE':<18} {'TENANT':<10} {'PRI':>3} "
         f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'STRAG':>5} "
-        f"{'BKLG f/w/x/u':>12} {'AGE':>6}"
+        f"{'STEAL':>5} {'SPEC':>4} {'BKLG f/w/x/u':>12} {'AGE':>6}"
     )
     for job in snap["jobs"]:
         p = job.get("progress") or {}
@@ -220,7 +220,10 @@ def render(snap: dict) -> str:
             f"{job.get('tenant', '?'):<10} {job.get('priority', 0):>3} "
             f"{p.get('phase', '-'):<9} {tiles:>9} "
             f"{p.get('retries', '-') if p else '-':>5} "
-            f"{p.get('stragglers', '-') if p else '-':>5} {backlog:>12} "
+            f"{p.get('stragglers', '-') if p else '-':>5} "
+            f"{p.get('tiles_stolen', '-') if p else '-':>5} "
+            f"{p.get('tiles_speculated', '-') if p else '-':>4} "
+            f"{backlog:>12} "
             f"{_fmt_age(age):>6}"
         )
     if not snap["jobs"]:

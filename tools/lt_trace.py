@@ -53,7 +53,8 @@ from land_trendr_tpu.obs.spans import assemble_pod_trace  # noqa: E402
 _HOST_KEYS = (
     "host", "process_index", "run_id", "status", "wall_skew_s", "wall_s",
     "busy_s", "idle_gap_s", "tail_ratio", "tiles_done", "pixels",
-    "px_per_s", "retries", "stragglers", "stage_s", "critical_path",
+    "px_per_s", "retries", "stragglers", "tiles_leased", "tiles_stolen",
+    "tiles_speculated", "stage_s", "critical_path",
 )
 
 
@@ -68,6 +69,17 @@ def report_from_trace(trace: dict) -> dict:
             {k: m.get(k) for k in ("tile", "t0", "duration_s", "threshold_s")}
             for m in trace["markers"]
             if m.get("name") == "straggler"
+        ],
+        # the elastic scheduler ACTING on those verdicts (runtime/leases)
+        "steals": [
+            {k: m.get(k) for k in ("tile", "t0", "host", "gen")}
+            for m in trace["markers"]
+            if m.get("name") == "steal"
+        ],
+        "speculations": [
+            {k: m.get(k) for k in ("tile", "t0", "host", "gen")}
+            for m in trace["markers"]
+            if m.get("name") == "speculate"
         ],
         "hosts": [
             {k: h.get(k) for k in _HOST_KEYS} for h in trace["hosts"]
@@ -100,11 +112,14 @@ def trace_events(trace: dict) -> "tuple[list[dict], list[dict]]":
             "kind": "instant",
             "file": m["file"],
             "tid": "compute",
-            "name": f"STRAGGLER tile {m['tile']}",
+            # STRAGGLER / STEAL / SPECULATE instants on one timeline —
+            # verdict and scheduler reaction, side by side
+            "name": f"{str(m.get('name', '?')).upper()} tile {m['tile']}",
             "t0": m["t0"],
             "args": {
-                "duration_s": m.get("duration_s"),
-                "threshold_s": m.get("threshold_s"),
+                k: m[k]
+                for k in ("duration_s", "threshold_s", "gen")
+                if m.get(k) is not None
             },
         })
     return src, trace["hosts"]

@@ -102,7 +102,8 @@ def _fresh_scope() -> dict:
         "counts": {}, "compute_s": [], "px_per_s": [], "record_s": [],
         "pixels": 0, "max_feed_backlog": 0, "max_write_backlog": 0,
         "retries": 0, "failures": 0, "quarantined": 0, "faults_injected": 0,
-        "stalls": 0, "stragglers": 0, "stage_s": {}, "span_s": {},
+        "stalls": 0, "stragglers": 0, "tiles_leased": 0, "tiles_stolen": 0,
+        "tiles_speculated": 0, "stage_s": {}, "span_s": {},
         "intervals": [], "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
@@ -497,6 +498,29 @@ def fold(
                                 "in_flight": rec.get("in_flight"),
                             },
                         })
+                    elif ev == "tile_leased":
+                        cur["tiles_leased"] += 1
+                    elif ev in ("lease_stolen", "tile_speculated"):
+                        # the elastic scheduler acting (runtime/leases):
+                        # steal/speculation instants land on the trace
+                        # next to the straggler verdicts that drove them
+                        tile_id = rec["tile_id"]
+                        cur["tiles_leased"] += 1
+                        key = (
+                            "tiles_stolen" if ev == "lease_stolen"
+                            else "tiles_speculated"
+                        )
+                        cur[key] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno,
+                            "tid": "device-wait",
+                            "name": (
+                                f"{'STEAL' if ev == 'lease_stolen' else 'SPECULATE'}"
+                                f" tile {tile_id}"
+                            ),
+                            "t0": tw,
+                            "args": {"gen": rec.get("gen")},
+                        })
                     elif ev == "tile_start":
                         starts[rec["tile_id"]] = tw
                     elif ev == "tile_done":
@@ -803,6 +827,9 @@ def fold(
             "tiles_done": len(c["compute_s"]),
             "retries": c["retries"],
             "stragglers": c["stragglers"],
+            "tiles_leased": c["tiles_leased"],
+            "tiles_stolen": c["tiles_stolen"],
+            "tiles_speculated": c["tiles_speculated"],
             "stage_s": {
                 k: round(v, 4) for k, v in sorted(c["stage_s"].items())
             },
@@ -835,6 +862,9 @@ def fold(
         "faults_injected": sum(c["faults_injected"] for c in folded),
         "stalls": sum(c["stalls"] for c in folded),
         "stragglers": sum(c["stragglers"] for c in folded),
+        "tiles_leased": sum(c["tiles_leased"] for c in folded),
+        "tiles_stolen": sum(c["tiles_stolen"] for c in folded),
+        "tiles_speculated": sum(c["tiles_speculated"] for c in folded),
         "max_feed_backlog": max((c["max_feed_backlog"] for c in folded), default=0),
         "max_write_backlog": max((c["max_write_backlog"] for c in folded), default=0),
         "stage_s": {k: round(v, 4) for k, v in sorted(stage_s.items())},

@@ -446,9 +446,73 @@ def run_fleet_leg(workdir: str, check) -> None:
     )
 
 
-def run_gate(workdir: str, checks: list) -> None:
+def run_scheduler_leg(workdir: str, check) -> None:
+    """Elastic-scheduler leg: an injected slow-host two-process pod run
+    twice — static ``host_share`` split vs the shared-manifest lease
+    queue with speculation (``tools/elastic_soak.slow_host_leg``) —
+    asserting the structural invariants exactly (no lost tile, no
+    double-counted done id, at least one speculative win) and the
+    analytics directionally (pod busy-union idle gap and
+    ``host_imbalance`` collapse vs the static baseline, via the
+    ``lt_trace`` fold)."""
+    import elastic_soak
+
+    n_tiles = (120 // 20) ** 2
+    try:
+        res = elastic_soak.slow_host_leg(
+            Path(workdir) / "scheduler", size=120, tile=20, verbose=False
+        )
+    except AssertionError as e:
+        # the leg's own invariant assertions ARE the gate's findings
+        check("scheduler.invariants", False, str(e))
+        return
+    except Exception as e:
+        check("scheduler.ran", False, f"slow-host pod soak raised: {e}")
+        return
+    st, el = res["static"], res["elastic"]
+    for mode, r in (("static", st), ("elastic", el)):
+        check(
+            f"scheduler.{mode}_no_lost_tiles",
+            r["unique_done_tiles"] == n_tiles,
+            f"{r['unique_done_tiles']} unique done tiles of {n_tiles}",
+        )
+    check(
+        "scheduler.no_double_count",
+        el["duplicate_done_records"]
+        <= el["tiles_speculated"] + el["tiles_stolen"],
+        f"{el['duplicate_done_records']} duplicate done record(s) vs "
+        f"{el['tiles_speculated']} speculated + {el['tiles_stolen']} "
+        "stolen (duplicates can only come from speculation/steal races)",
+    )
+    check(
+        "scheduler.idle_gap_collapse",
+        el["idle_gap_pod_s"] < st["idle_gap_pod_s"],
+        f"pod busy-union idle gap {el['idle_gap_pod_s']}s elastic vs "
+        f"{st['idle_gap_pod_s']}s static",
+    )
+    check(
+        "scheduler.imbalance_collapse",
+        bool(
+            st["host_imbalance"] and el["host_imbalance"]
+            and el["host_imbalance"] < st["host_imbalance"]
+        ),
+        f"host_imbalance {el['host_imbalance']} elastic vs "
+        f"{st['host_imbalance']} static",
+    )
+    check(
+        "scheduler.speculative_win",
+        el["spec_wins"] >= 1,
+        f"{el['spec_wins']} speculative win(s), "
+        f"{el['tiles_speculated']} speculated",
+    )
+
+
+def run_gate(workdir: str, checks: list, scheduler: bool = True) -> None:
     """Run the bench smokes + the trace-assembly leg; append
-    (name, ok, detail) rows."""
+    (name, ok, detail) rows.  ``scheduler=False`` skips the elastic
+    scheduler leg (two 2-process jax pods, minutes-scale — the tier-1
+    smoke test skips it; the lease invariants stay tier-1-covered by
+    ``tests/test_leases.py`` and ``fault_soak``'s lease case)."""
     import feed_bench
     import fetch_bench
     import flight_overhead
@@ -581,6 +645,8 @@ def run_gate(workdir: str, checks: list) -> None:
 
     run_trace_leg(workdir, check)
     run_fleet_leg(workdir, check)
+    if scheduler:
+        run_scheduler_leg(workdir, check)
 
     # -- flight recorder (ring + sampler overhead) ------------------------
     base = json.loads(FLIGHT_BASELINE.read_text())
@@ -623,6 +689,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the machine-readable verdict only")
     ap.add_argument("--keep", default=None, metavar="DIR",
                     help="keep the smoke artifacts under DIR")
+    ap.add_argument("--skip-scheduler", action="store_true",
+                    help="skip the elastic scheduler leg (two 2-process "
+                    "jax pods, minutes-scale; the tier-1 smoke test "
+                    "passes this — CLI gate runs carry the leg)")
     args = ap.parse_args(argv)
 
     for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE,
@@ -635,7 +705,7 @@ def main(argv: list[str] | None = None) -> int:
     Path(workdir).mkdir(parents=True, exist_ok=True)
     checks: list = []
     try:
-        run_gate(workdir, checks)
+        run_gate(workdir, checks, scheduler=not args.skip_scheduler)
     finally:
         if args.keep is None:
             shutil.rmtree(workdir, ignore_errors=True)
