@@ -532,6 +532,110 @@ def build_parser() -> argparse.ArgumentParser:
                      "(land_trendr_tpu.obs.alerts); default: built-in "
                      "host-staleness + SLO-burn rules")
 
+    rte = sub.add_parser(
+        "route",
+        help="serving-fleet router: one loopback front door over N "
+        "lt-serve replicas (spawned or adopted) with warm-affinity "
+        "routing, per-tenant quotas + weighted fair share, "
+        "retry-on-replica-death re-routing, and SLO-burn-driven "
+        "autoscaling (README §Serving fleet)",
+    )
+    rte.add_argument("--workdir", default="lt_route",
+                     help="router root: its events/metrics stream, the "
+                     "pinned per-job jobs/<id>/{work,out} dirs every "
+                     "replica resumes from, and spawned replica workdirs")
+    rte.add_argument("--route-port", type=int, default=0, metavar="PORT",
+                     help="loopback HTTP JSON API port of the front door "
+                     "(0 = ephemeral, reported in the startup line)")
+    rte.add_argument("--route-host", default="127.0.0.1", metavar="HOST",
+                     help="bind address — loopback ONLY (the router "
+                     "submits arbitrary work to the whole fleet; front "
+                     "it with an authenticated proxy)")
+    rte.add_argument("--replica", action="append", default=[],
+                     metavar="BASE", dest="replicas",
+                     help="ADOPT an already-running replica by base URL "
+                     "(http://127.0.0.1:PORT; repeatable) — "
+                     "health-checked and routed to, never spawned or "
+                     "killed")
+    rte.add_argument("--spawn-replicas", type=int, default=0, metavar="N",
+                     help="SPAWN N replicas via the lt-serve CLI under "
+                     "WORKDIR/replicas (ephemeral ports; the "
+                     "autoscaler's pool)")
+    rte.add_argument("--replica-args", default="", metavar="FLAGS",
+                     help="extra lt-serve flags for every spawned "
+                     "replica, space-separated (e.g. "
+                     "'--ingest-store-mb 256')")
+    rte.add_argument("--replica-inflight", type=int, default=2,
+                     help="per-replica in-flight bound at the router "
+                     "(queued+running routed jobs one replica holds "
+                     "before the router looks elsewhere)")
+    rte.add_argument("--route-queue-depth", type=int, default=64,
+                     help="router-wide queue bound: submissions past it "
+                     "are throttled 429 + Retry-After")
+    rte.add_argument("--tenant-quota", type=int, default=16,
+                     help="per-tenant bound on queued+routed jobs; at "
+                     "the quota the tenant is throttled 429 + "
+                     "Retry-After while others' traffic proceeds")
+    rte.add_argument("--tenant-weights", default=None, metavar="SPEC",
+                     help="weighted fair share, 'tenant=weight,...' — "
+                     "deficit round-robin gives each tenant bandwidth "
+                     "proportional to its weight (unnamed tenants "
+                     "weigh 1)")
+    rte.add_argument("--no-affinity", action="store_true",
+                     help="disable warm-affinity routing (pure "
+                     "least-loaded — the fleet_bench baseline)")
+    rte.add_argument("--route-retries", type=int, default=2,
+                     help="re-routes per job after a dead replica or "
+                     "failed forward before the job goes terminal")
+    rte.add_argument("--health-interval-s", type=float, default=1.0,
+                     metavar="SEC",
+                     help="health-probe + job-poll period")
+    rte.add_argument("--unhealthy-after", type=int, default=3,
+                     help="consecutive failed health probes before a "
+                     "replica is marked unready (its accepted jobs are "
+                     "never failed by a probe)")
+    rte.add_argument("--autoscale", action="store_true",
+                     help="SLO-driven autoscaling of the SPAWNED pool: "
+                     "fold the shared telemetry dir for the pod "
+                     "lt_slo_burn_rate and scale between "
+                     "--min-replicas/--max-replicas with hold-down and "
+                     "drain-before-kill")
+    rte.add_argument("--min-replicas", type=int, default=1,
+                     help="autoscaler floor (spawned replicas)")
+    rte.add_argument("--max-replicas", type=int, default=4,
+                     help="autoscaler ceiling (spawned replicas)")
+    rte.add_argument("--scale-up-burn", type=float, default=0.5,
+                     metavar="RATE",
+                     help="scale up when the pod burn rate holds at or "
+                     "above RATE")
+    rte.add_argument("--scale-down-burn", type=float, default=0.05,
+                     metavar="RATE",
+                     help="scale down when the pod burn rate holds at "
+                     "or below RATE and the router queue is empty")
+    rte.add_argument("--scale-for-s", type=float, default=0.0,
+                     metavar="SEC",
+                     help="the burn condition must hold SEC before a "
+                     "scale action (transients don't scale)")
+    rte.add_argument("--scale-hold-s", type=float, default=30.0,
+                     metavar="SEC",
+                     help="hold-down between scale actions (no "
+                     "flapping)")
+    rte.add_argument("--no-telemetry", action="store_true",
+                     help="disable the router events/metrics stream "
+                     "(on by default)")
+    rte.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="shared fleet telemetry directory (default "
+                     "WORKDIR/telemetry): spawned replicas publish "
+                     "here, the autoscaler folds it, lt_fleet/lt top "
+                     "--dir render it")
+    rte.add_argument("--metrics-interval-s", type=float, default=5.0,
+                     metavar="SEC",
+                     help="router metrics.prom refresh period")
+    rte.add_argument("--fault-schedule", default=None, metavar="SPEC",
+                     help="deterministic fault injection for soak runs "
+                     "(router.forward / replica.health seams); "
+                     "production routers leave this unset")
+
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
 
@@ -852,6 +956,77 @@ def main(argv: list[str] | None = None) -> int:
         except KeyboardInterrupt:
             # Ctrl-C is the documented way to stop an unbounded server:
             # drain state is already durable, exit clean
+            pass
+        return 0
+
+    if args.cmd == "route":
+        from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+
+        try:
+            rcfg = RouterConfig(
+                workdir=args.workdir,
+                route_port=args.route_port,
+                route_host=args.route_host,
+                replicas=tuple(args.replicas),
+                spawn_replicas=args.spawn_replicas,
+                replica_args=tuple(args.replica_args.split()),
+                replica_inflight=args.replica_inflight,
+                route_queue_depth=args.route_queue_depth,
+                tenant_quota=args.tenant_quota,
+                tenant_weights=args.tenant_weights,
+                affinity=not args.no_affinity,
+                route_retries=args.route_retries,
+                health_interval_s=args.health_interval_s,
+                unhealthy_after=args.unhealthy_after,
+                autoscale=args.autoscale,
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                scale_up_burn=args.scale_up_burn,
+                scale_down_burn=args.scale_down_burn,
+                scale_for_s=args.scale_for_s,
+                scale_hold_s=args.scale_hold_s,
+                telemetry=not args.no_telemetry,
+                telemetry_dir=args.telemetry_dir,
+                metrics_interval_s=args.metrics_interval_s,
+                fault_schedule=args.fault_schedule,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        # probe the front-door port NOW (the serve-port preflight)
+        if rcfg.route_port:
+            import socket
+
+            try:
+                with socket.socket() as s:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((rcfg.route_host, rcfg.route_port))
+            except OSError as e:
+                print(
+                    f"error: --route-port {rcfg.route_port} unusable: {e}",
+                    file=sys.stderr,
+                )
+                return 2
+        try:
+            # the router owns its whole teardown: serve_forever's
+            # finally runs _shutdown on every exit path (Ctrl-C
+            # included) and a failed constructor unwinds itself
+            # lt: noqa[LT008]
+            router = FleetRouter(rcfg)
+        except (OSError, RuntimeError) as e:
+            print(f"error: router startup failed: {e}", file=sys.stderr)
+            return 2
+        print(
+            json.dumps(
+                {"routing": True, "port": router.port,
+                 "workdir": rcfg.workdir,
+                 "replicas": len(router.pool)}
+            ),
+            flush=True,
+        )
+        try:
+            router.serve_forever()
+        except KeyboardInterrupt:
             pass
         return 0
 

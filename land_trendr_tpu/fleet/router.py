@@ -1,0 +1,1608 @@
+"""Serving fleet: the warm-affinity router / front door over N replicas.
+
+One :class:`FleetRouter` process owns a pool of ``lt serve`` replicas
+(spawned through the CLI or adopted by base URL), health-checks them
+through ``/healthz``, and shards submitted jobs across them:
+
+* **warm-affinity routing** — every request hashes to its
+  :meth:`~land_trendr_tpu.serve.jobs.JobRequest.affinity_key`; the
+  router keeps a per-replica warm-key table (seeded from ``/healthz``'s
+  ``warm_keys`` list, confirmed by routing feedback, and extended
+  *optimistically* at forward time so the very next same-shape job
+  already sticks) and routes repeat shapes to the replica that holds
+  the compiled programs.  Fallback is least-loaded.  Warm decodes need
+  no affinity at all: the ingest store's ``(path, mtime_ns, ...)``
+  keying makes them safely shareable across replicas on one FS.
+* **tenant fair share + quotas** — jobs queue per tenant and drain
+  through deficit round-robin (``tenant_weights``), so a heavy tenant
+  cannot starve a light one; a tenant at its ``tenant_quota`` (or a
+  full router queue) is throttled with HTTP 429 + ``Retry-After``
+  (``tenant_throttled`` event) instead of building unbounded backlog.
+* **retry-on-replica-death** — the router pins every job's
+  ``workdir``/``out_dir`` under ITS workdir and submits with
+  ``resume=true``, so when a replica dies mid-job the re-routed
+  submission resumes the same manifest on a sibling and completes
+  byte-identically (recorded tiles stay durable; duplicate execution
+  resolves at the manifest's first-write-wins rename).  Zero accepted
+  jobs are lost to a replica SIGKILL — the invariant
+  ``tools/fleet_bench.py`` and the fault soak pin.
+* **SLO-driven autoscaling** — the control loop folds the shared
+  telemetry directory (``obs.aggregate.fold_dir`` over replica
+  snapshots — the PR-11 plane) for the pod ``lt_slo_burn_rate`` and
+  feeds :class:`~land_trendr_tpu.fleet.autoscale.Autoscaler`
+  (AlertEngine rules + bounds + hold-down); scale-up spawns a replica,
+  scale-down **drains before killing**: the victim stops receiving
+  routes, its in-flight jobs finish, then SIGINT gives the ``lt
+  serve`` process its documented clean shutdown — manifests stay
+  resumable throughout.
+
+Failure semantics: a failed forward (``router.forward`` seam) or a
+dead/unready replica re-enters the job into its tenant queue (bounded
+by ``route_retries``); a health-probe failure (``replica.health``
+seam) marks the replica unready WITHOUT failing any accepted job — its
+jobs keep polling and finish wherever they run.  The router's own
+telemetry (``route_decision`` / ``replica_up`` / ``replica_down`` /
+``tenant_throttled`` / ``scale_decision`` events, ``lt_router_*``
+metrics) rides the normal schema/registry, so schema lint,
+``obs_report``, ``lt top`` and ``lt_fleet`` cover the routing plane
+like every other subsystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import http.server
+import json
+import logging
+import os
+import select
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+from land_trendr_tpu.fleet.autoscale import Autoscaler
+from land_trendr_tpu.fleet.config import RouterConfig, parse_tenant_weights
+from land_trendr_tpu.obs.events import EventLog
+from land_trendr_tpu.obs.metrics import MetricsRegistry, PromFileExporter
+from land_trendr_tpu.runtime import faults
+from land_trendr_tpu.serve.jobs import TERMINAL_STATES, JobRequest
+from land_trendr_tpu.serve.server import Rejection
+
+__all__ = ["DOWN_REASONS", "FleetRouter", "RouterJob"]
+
+log = logging.getLogger("land_trendr_tpu.fleet")
+
+#: replica_down reason vocabulary (value-linted by
+#: ``tools/check_events_schema.py`` — the two tables are asserted equal
+#: in tests/test_fleet_serve.py)
+DOWN_REASONS = ("health", "dead", "scale_down", "shutdown")
+
+#: router job-latency histogram buckets (the serve buckets)
+_JOB_BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 300, 1800, 7200, 43200)
+
+#: per-replica warm/sticky key table bound (recency-evicted)
+_WARM_KEYS_MAX = 128
+
+#: HTTP timeout for health probes and job polls, seconds
+_PROBE_TIMEOUT_S = 10.0
+#: HTTP timeout for job forwards (the replica answers from its
+#: admission path — queueing, not execution)
+_FORWARD_TIMEOUT_S = 30.0
+#: how long a spawned replica may take to print its startup line (cold
+#: jax import + port bind)
+_SPAWN_TIMEOUT_S = 180.0
+#: clean-shutdown drain bound: in-flight jobs get this long to finish
+#: before spawned replicas are stopped anyway
+_DRAIN_TIMEOUT_S = 600.0
+
+
+def _http_json(
+    method: str, url: str, payload: "dict | None" = None,
+    timeout: float = _PROBE_TIMEOUT_S,
+) -> "tuple[int, Any]":
+    """One JSON round-trip; returns ``(status, body)``.  4xx/5xx with a
+    JSON body return normally (admission verdicts); transport errors
+    (refused, reset, timeout) raise ``OSError``/``URLError``."""
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (ValueError, OSError):
+            return e.code, {}
+
+
+@dataclasses.dataclass
+class RouterJob:
+    """One accepted job's router-side record (mutated under the router
+    lock; snapshots are JSON-safe copies)."""
+
+    job_id: str
+    payload: dict
+    tenant: str
+    priority: int
+    key: str
+    workdir: str
+    out_dir: str
+    source: str = "http"
+    state: str = "queued"  # queued | routed | TERMINAL_STATES
+    replica: "str | None" = None
+    replica_job_id: "str | None" = None
+    #: forward attempts so far (1 = first route; > 1 = re-routed)
+    attempts: int = 0
+    submitted_t: float = dataclasses.field(default_factory=time.time)
+    routed_t: "float | None" = None
+    finished_t: "float | None" = None
+    error: "str | None" = None
+    #: the replica's last job snapshot (carries summary/outputs at
+    #: terminal — the client's result body)
+    snap: "dict | None" = None
+    poll_fails: int = 0
+    cancel_requested: bool = False
+
+    def status_locked(self) -> dict:
+        out = {
+            "job_id": self.job_id,
+            "state": self.state,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "key": self.key,
+            "replica": self.replica,
+            "replica_job_id": self.replica_job_id,
+            "attempts": self.attempts,
+            "submitted_t": self.submitted_t,
+            "routed_t": self.routed_t,
+            "finished_t": self.finished_t,
+            "workdir": self.workdir,
+            "out_dir": self.out_dir,
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        if self.snap is not None:
+            out["result"] = self.snap
+        return out
+
+
+class _Replica:
+    """One pool member (mutated under the router lock except where
+    noted; the HTTP traffic to it happens outside the lock)."""
+
+    def __init__(
+        self, rid: str, base: str, spawned: bool,
+        proc: "subprocess.Popen | None" = None,
+        workdir: "str | None" = None,
+    ) -> None:
+        self.rid = rid
+        self.base = base.rstrip("/")
+        self.spawned = spawned
+        self.proc = proc
+        self.workdir = workdir
+        #: starting → ready ⇄ unready, draining → stopped
+        self.state = "starting"
+        #: affinity keys warm (confirmed via /healthz or a completed
+        #: job) or sticky (optimistically assigned at forward time) on
+        #: this replica — recency-ordered, bounded
+        self.warm: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict()
+        )
+        #: router job ids currently routed here
+        self.inflight: "set[str]" = set()
+        self.fails = 0
+        self.last_health: "dict | None" = None
+        self.last_health_t: "float | None" = None
+        #: saturation cooldown (monotonic deadline): set when the
+        #: replica answers 429 from its own admission — the router
+        #: skips it until then instead of sleeping the dispatcher
+        self.backoff_until = 0.0
+
+    def note_key_locked(self, key: str) -> None:
+        self.warm[key] = time.time()
+        self.warm.move_to_end(key)
+        while len(self.warm) > _WARM_KEYS_MAX:
+            self.warm.popitem(last=False)
+
+    def row_locked(self) -> dict:
+        h = self.last_health or {}
+        return {
+            "replica": self.rid,
+            "base": self.base,
+            "state": self.state,
+            "spawned": self.spawned,
+            "inflight": len(self.inflight),
+            "warm_keys": len(self.warm),
+            "fails": self.fails,
+            "queue_depth": h.get("queue_depth"),
+            "running": h.get("running"),
+            "warm_program_count": h.get("warm_program_count"),
+            "health_age_s": (
+                round(time.time() - self.last_health_t, 3)
+                if self.last_health_t is not None else None
+            ),
+        }
+
+
+class _RouterTelemetry:
+    """The router's own events scope + ``lt_router_*`` instruments
+    (the serve telemetry bundle's thin sibling: event log, registry,
+    ``metrics.prom`` exporter, optional fleet publisher)."""
+
+    def __init__(self, cfg: RouterConfig, publish_probes=None) -> None:
+        os.makedirs(cfg.workdir, exist_ok=True)
+        # every teardown-touched handle predeclared (the LT008 lesson):
+        # _release() must be callable from any construction depth
+        self._exporter: "PromFileExporter | None" = None
+        self._publisher = None
+        self.events = EventLog(os.path.join(cfg.workdir, "events.jsonl"))
+        try:
+            self.registry = MetricsRegistry()
+            r = self.registry
+            self._routed = r.counter(
+                "lt_router_jobs_routed_total",
+                "job forwards to a replica (re-routes included)",
+            )
+            self._warm_routed = r.counter(
+                "lt_router_warm_routed_total",
+                "forwards whose replica choice was warm-affinity-driven",
+            )
+            self._rerouted = r.counter(
+                "lt_router_rerouted_total",
+                "re-forwards after a failed forward or a dead/unready "
+                "replica (attempt >= 2)",
+            )
+            self._throttled = r.counter(
+                "lt_router_throttled_total",
+                "submissions throttled 429 (tenant quota / queue full)",
+            )
+            self._queue_depth = r.gauge(
+                "lt_router_queue_depth",
+                "jobs queued at the router awaiting a replica",
+            )
+            self._replicas_ready = r.gauge(
+                "lt_router_replicas_ready", "replicas currently routable"
+            )
+            self._replicas_total = r.gauge(
+                "lt_router_replicas",
+                "pool members not yet stopped (spawned + adopted)",
+            )
+            self._queue_wait_hist = r.histogram(
+                "lt_router_queue_wait_seconds",
+                "router queue wait, submit to first forward",
+                buckets=_JOB_BUCKETS,
+            )
+            self._job_hist = r.histogram(
+                "lt_router_job_seconds",
+                "job latency through the router, submit to terminal",
+                buckets=_JOB_BUCKETS,
+            )
+            self._jobs_done: "dict[str, Any]" = {}
+            self._scales: "dict[str, Any]" = {}
+            self.events.run_start(
+                fingerprint="route",
+                process_index=0,
+                process_count=1,
+                tiles_total=0,
+                tiles_todo=0,
+                tiles_skipped_resume=0,
+                mesh_devices=0,
+                impl="route",
+            )
+            self._exporter = PromFileExporter(
+                self.registry,
+                os.path.join(cfg.workdir, "metrics.prom"),
+                interval_s=cfg.metrics_interval_s,
+            ).start()
+            if cfg.telemetry_dir is not None or cfg.spawn_replicas:
+                from land_trendr_tpu.obs.publish import (
+                    TelemetryPublisher,
+                    telemetry_dir,
+                )
+
+                self._publisher = TelemetryPublisher(
+                    cfg.telemetry_dir or telemetry_dir(cfg.workdir),
+                    self.registry,
+                    probes=publish_probes,
+                    interval_s=cfg.health_interval_s * 2,
+                    kind="route",
+                )
+                self._publisher.start()
+        except BaseException:
+            self._release()
+            raise
+
+    def _release(self) -> None:
+        try:
+            if self._publisher is not None:
+                self._publisher.stop()
+                self._publisher = None
+        finally:
+            try:
+                if self._exporter is not None:
+                    self._exporter.stop()
+                    self._exporter = None
+            finally:
+                self.events.close()
+
+    def _done_counter(self, status: str):
+        c = self._jobs_done.get(status)
+        if c is None:
+            c = self._jobs_done[status] = self.registry.counter(
+                "lt_router_jobs_done_total",
+                "router jobs reaching a terminal state, by status",
+                labels={"status": status},
+            )
+        return c
+
+    def _scale_counter(self, direction: str):
+        c = self._scales.get(direction)
+        if c is None:
+            c = self._scales[direction] = self.registry.counter(
+                "lt_router_scale_total",
+                "autoscaler actions, by direction",
+                labels={"direction": direction},
+            )
+        return c
+
+    # -- router hooks ------------------------------------------------------
+    def job_submitted(self, job: RouterJob, queue_depth: int) -> None:
+        self.events.emit(
+            "job_submitted",
+            job_id=job.job_id,
+            tenant=job.tenant,
+            priority=job.priority,
+            queue_depth=queue_depth,
+            source=job.source,
+        )
+        self._queue_depth.set(queue_depth)
+
+    def job_rejected(self, reason: str, queue_depth: int) -> None:
+        self.events.emit(
+            "job_rejected", reason=reason, queue_depth=queue_depth
+        )
+
+    def tenant_throttled(
+        self, tenant: str, reason: str, queue_depth: int
+    ) -> None:
+        self.events.emit(
+            "tenant_throttled",
+            tenant=tenant,
+            reason=reason,
+            queue_depth=queue_depth,
+        )
+        self._throttled.inc()
+
+    def route_decision(
+        self, job: RouterJob, replica: str, warm: bool,
+        queue_depth: int, wait_s: float,
+    ) -> None:
+        self.events.emit(
+            "route_decision",
+            job_id=job.job_id,
+            tenant=job.tenant,
+            replica=replica,
+            warm=bool(warm),
+            key=job.key,
+            attempt=job.attempts,
+            queue_wait_s=round(max(0.0, wait_s), 6),
+            queue_depth=queue_depth,
+        )
+        self._routed.inc()
+        if warm:
+            self._warm_routed.inc()
+        if job.attempts > 1:
+            self._rerouted.inc()
+        else:
+            self._queue_wait_hist.observe(max(0.0, wait_s))
+        self._queue_depth.set(queue_depth)
+
+    def replica_up(self, replica: _Replica) -> None:
+        self.events.emit(
+            "replica_up",
+            replica=replica.rid,
+            base=replica.base,
+            spawned=replica.spawned,
+        )
+
+    def replica_down(self, replica: _Replica, reason: str) -> None:
+        self.events.emit(
+            "replica_down",
+            replica=replica.rid,
+            reason=reason,
+            base=replica.base,
+            inflight=len(replica.inflight),
+        )
+
+    def scale_decision(
+        self, direction: str, burn: float, replicas: int,
+        queue_depth: int, replica: "str | None" = None,
+    ) -> None:
+        fields: dict = {}
+        if replica is not None:
+            fields["replica"] = replica
+        self.events.emit(
+            "scale_decision",
+            direction=direction,
+            burn=round(max(0.0, float(burn)), 6),
+            replicas=replicas,
+            queue_depth=queue_depth,
+            **fields,
+        )
+        self._scale_counter(direction).inc()
+
+    def job_done(self, job: RouterJob, wall_s: float) -> None:
+        fields: dict = {}
+        if job.error:
+            fields["error"] = job.error
+        self.events.emit(
+            "job_done",
+            job_id=job.job_id,
+            status=job.state,
+            wall_s=round(wall_s, 6),
+            **fields,
+        )
+        self._job_hist.observe(wall_s)
+        self._done_counter(job.state).inc()
+
+    def pool_gauges(self, ready: int, total: int) -> None:
+        self._replicas_ready.set(ready)
+        self._replicas_total.set(total)
+
+    def close(self, status: str, wall_s: float) -> None:
+        try:
+            self.events.emit(
+                "run_done",
+                status=status,
+                tiles_done=0,
+                pixels=0,
+                wall_s=round(wall_s, 3),
+                px_per_s=0.0,
+                fit_rate=0.0,
+            )
+        finally:
+            self._release()
+
+
+class FleetRouter:
+    """The serving fleet's front door (see the module docstring)."""
+
+    def __init__(self, cfg: RouterConfig) -> None:
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self._lock = threading.Lock()
+        # the condition WRAPS self._lock (the serve-server discipline)
+        self._cond = threading.Condition(self._lock)
+        self._jobs: "dict[str, RouterJob]" = {}
+        #: per-tenant FIFO queues of queued job ids + the DRR state
+        self._tq: "dict[str, collections.deque]" = {}
+        self._deficit: "dict[str, float]" = {}
+        self._ring: "collections.deque[str]" = collections.deque()
+        self._weights = parse_tenant_weights(cfg.tenant_weights)
+        self._queued = 0
+        self._terminal = 0
+        self._seq = 0
+        self._rid_seq = 0
+        self._stopping = False
+        self.pool: "list[_Replica]" = []
+
+        from land_trendr_tpu.obs.publish import telemetry_dir
+
+        self._telemetry_dir = cfg.telemetry_dir or telemetry_dir(cfg.workdir)
+        self.scaler = (
+            Autoscaler(
+                min_replicas=cfg.min_replicas,
+                max_replicas=cfg.max_replicas,
+                up_burn=cfg.scale_up_burn,
+                down_burn=cfg.scale_down_burn,
+                for_s=cfg.scale_for_s,
+                hold_s=cfg.scale_hold_s,
+            )
+            if cfg.autoscale else None
+        )
+
+        # every teardown-touched handle predeclared, so _shutdown is
+        # callable from any depth of a failed construction (LT008)
+        self.telemetry: "_RouterTelemetry | None" = None
+        self._fault_plan = None
+        self._httpd = None
+        self._http_thread = None
+        self._control_stop = threading.Event()
+        self._control_thread: "threading.Thread | None" = None
+        self._t0 = time.time()
+
+        try:
+            if cfg.telemetry:
+                self.telemetry = _RouterTelemetry(
+                    cfg, publish_probes=self._fleet_probes
+                )
+            if cfg.fault_schedule:
+                self._fault_plan = faults.activate(
+                    faults.parse_schedule(cfg.fault_schedule)
+                )
+                log.warning(
+                    "router fault injection ACTIVE (%s) — this is a "
+                    "soak run", cfg.fault_schedule,
+                )
+            for base in cfg.replicas:
+                self._adopt_replica(base)
+            if cfg.spawn_replicas:
+                self._spawn_replicas(cfg.spawn_replicas)
+
+            self._httpd = _RouterAPIServer(
+                (cfg.route_host, cfg.route_port), self
+            )
+            self.port = int(self._httpd.server_address[1])
+            http_thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="lt-route-http",
+                daemon=True,
+            )
+            # bound only AFTER a successful start: shutdown() keys on it
+            http_thread.start()
+            self._http_thread = http_thread
+
+            self._control_thread = threading.Thread(
+                target=self._control_loop,
+                name="lt-route-control",
+                daemon=True,
+            )
+            self._control_thread.start()
+        except BaseException:
+            self._shutdown(status="aborted")
+            raise
+        log.info(
+            "routing on %s:%d over %d replica(s)%s",
+            cfg.route_host, self.port, len(self.pool),
+            " (autoscale on)" if self.scaler is not None else "",
+        )
+
+    # -- pool construction -------------------------------------------------
+    def _next_rid_locked(self) -> str:
+        self._rid_seq += 1
+        return f"r{self._rid_seq - 1}"
+
+    def _adopt_replica(self, base: str) -> None:
+        with self._lock:
+            rid = self._next_rid_locked()
+            replica = _Replica(rid, base, spawned=False)
+            self.pool.append(replica)
+        # first health probe promotes it to ready (and emits replica_up)
+        self._probe_replica(replica)
+
+    def _spawn_replicas(self, n: int) -> None:
+        """Spawn ``n`` replicas via the ``lt serve`` CLI: launch every
+        process first (their cold jax imports overlap), then read each
+        startup line for the bound port."""
+        started = [self._launch_replica_proc() for _ in range(n)]
+        for replica in started:
+            self._await_replica_start(replica)
+
+    def _launch_replica_proc(self) -> _Replica:
+        with self._lock:
+            rid = self._next_rid_locked()
+        rdir = os.path.join(self.cfg.workdir, "replicas", rid)
+        os.makedirs(rdir, exist_ok=True)
+        cmd = [
+            sys.executable, "-m", "land_trendr_tpu", "serve",
+            "--workdir", rdir, "--serve-port", "0",
+            "--publish", "--telemetry-dir", self._telemetry_dir,
+            "--publish-interval-s", str(max(1.0, self.cfg.health_interval_s)),
+            *self.cfg.replica_args,
+        ]
+        logf = open(os.path.join(rdir, "serve.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=logf, text=True,
+            )
+        finally:
+            # the child inherited the fd; the parent's handle is done
+            logf.close()
+        replica = _Replica(
+            rid, base="pending", spawned=True, proc=proc, workdir=rdir
+        )
+        with self._lock:
+            self.pool.append(replica)
+        return replica
+
+    def _await_replica_start(self, replica: _Replica) -> None:
+        """Read the spawned replica's startup line (``{"serving": true,
+        "port": N, ...}``) and point its base URL at the bound port."""
+        proc = replica.proc
+        assert proc is not None and proc.stdout is not None
+        deadline = time.monotonic() + _SPAWN_TIMEOUT_S
+        line = ""
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            ready, _, _ = select.select(
+                [proc.stdout], [], [], min(1.0, deadline - time.monotonic())
+            )
+            if ready:
+                line = proc.stdout.readline()
+                break
+        try:
+            startup = json.loads(line) if line else None
+        except json.JSONDecodeError:
+            startup = None
+        if not startup or not startup.get("serving"):
+            tail = self._replica_log_tail(replica)
+            raise RuntimeError(
+                f"spawned replica {replica.rid} never reported its port "
+                f"(exit={proc.poll()}); serve.log tail:\n{tail}"
+            )
+        with self._lock:
+            replica.base = f"http://127.0.0.1:{int(startup['port'])}"
+        self._probe_replica(replica)
+
+    def _replica_log_tail(self, replica: _Replica, n: int = 2000) -> str:
+        if not replica.workdir:
+            return ""
+        try:
+            with open(os.path.join(replica.workdir, "serve.log"), "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, payload: dict, source: str = "http") -> dict:
+        """One submission through router admission; returns the queued
+        job's snapshot or raises :class:`~land_trendr_tpu.serve.server.
+        Rejection` (429 carries Retry-After at the HTTP layer)."""
+        try:
+            if not isinstance(payload, dict):
+                raise ValueError(
+                    f"job request must be a JSON object, got "
+                    f"{type(payload).__name__}"
+                )
+            req = JobRequest.from_payload(payload)
+        except ValueError as e:
+            if self.telemetry is not None:
+                with self._lock:
+                    depth = self._queued
+                self.telemetry.job_rejected("bad_request", depth)
+            raise Rejection(400, "bad_request", str(e)) from None
+        key = req.affinity_key()
+        throttle = None
+        snap = depth = job = None
+        with self._lock:
+            depth = self._queued
+            if self._stopping:
+                throttle = (503, "shutting_down", "router is draining")
+            elif depth >= self.cfg.route_queue_depth:
+                throttle = (
+                    429, "queue_full",
+                    f"router queue depth {depth} at the configured "
+                    f"bound {self.cfg.route_queue_depth}; retry later",
+                )
+            else:
+                held = sum(
+                    1 for j in self._jobs.values()
+                    if j.tenant == req.tenant
+                    and j.state in ("queued", "routed")
+                )
+                if held >= self.cfg.tenant_quota:
+                    throttle = (
+                        429, "tenant_quota",
+                        f"tenant {req.tenant!r} holds {held} job(s) at "
+                        f"the configured quota {self.cfg.tenant_quota}; "
+                        "retry later",
+                    )
+            if throttle is None:
+                self._seq += 1
+                job_id = f"rt-{os.getpid()}-{self._seq:05d}"
+                job_root = os.path.join(self.cfg.workdir, "jobs", job_id)
+                job = RouterJob(
+                    job_id=job_id,
+                    payload=dict(payload),
+                    tenant=req.tenant,
+                    priority=req.priority,
+                    key=key,
+                    # the router pins the dirs (unless the client pinned
+                    # its own — the explicit-resume path), so a re-route
+                    # RESUMES the same manifest on the next replica
+                    workdir=req.workdir
+                    or os.path.join(job_root, "work"),
+                    out_dir=req.out_dir or os.path.join(job_root, "out"),
+                    source=source,
+                )
+                self._jobs[job_id] = job
+                self._enqueue_locked(job)
+                depth = self._queued
+                snap = job.status_locked()
+                self._cond.notify_all()
+        if throttle is not None:
+            status, reason, detail = throttle
+            log.warning(
+                "submission throttled (%s, tenant=%s)", reason, req.tenant
+            )
+            if self.telemetry is not None:
+                if status == 429:
+                    self.telemetry.tenant_throttled(req.tenant, reason, depth)
+                else:
+                    self.telemetry.job_rejected(reason, depth)
+            raise Rejection(status, reason, detail)
+        if self.telemetry is not None:
+            self.telemetry.job_submitted(job, depth)
+        return snap
+
+    def _enqueue_locked(self, job: RouterJob, front: bool = False) -> None:
+        q = self._tq.get(job.tenant)
+        if q is None:
+            q = self._tq[job.tenant] = collections.deque()
+        if not q and job.tenant not in self._ring:
+            self._ring.append(job.tenant)
+        (q.appendleft if front else q.append)(job.job_id)
+        self._queued += 1
+
+    # -- fair-share scheduling (deficit round-robin) -----------------------
+    def _weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, 1.0)
+
+    def _pick_job_locked(self) -> "RouterJob | None":
+        """Deficit round-robin over the non-empty tenant queues: each
+        ring visit banks the tenant's weight; a banked deficit >= 1
+        buys one job (cost 1).  Bandwidth is therefore proportional to
+        weight, and any non-empty queue is served within a bounded
+        number of rotations — a heavy tenant cannot starve a light one.
+        """
+        guard = 0
+        while self._ring:
+            guard += 1
+            if guard > 100_000:  # pure defense; unreachable for w > 0
+                break
+            tenant = self._ring[0]
+            q = self._tq.get(tenant)
+            if not q:
+                self._ring.popleft()
+                self._deficit[tenant] = 0.0
+                continue
+            if self._deficit.get(tenant, 0.0) < 1.0:
+                # bank one quantum per ring visit; a sub-1 balance
+                # means this visit buys nothing yet — move on (a
+                # low-weight tenant is served every ceil(1/w) rotations)
+                self._deficit[tenant] = (
+                    self._deficit.get(tenant, 0.0) + self._weight(tenant)
+                )
+                if self._deficit[tenant] < 1.0:
+                    self._ring.rotate(-1)
+                    continue
+            self._deficit[tenant] -= 1.0
+            job_id = q.popleft()
+            self._queued -= 1
+            if not q:
+                # an emptied queue leaves the ring (and forfeits its
+                # bank — DRR's anti-burst rule)
+                self._ring.popleft()
+                self._deficit[tenant] = 0.0
+            elif self._deficit[tenant] < 1.0:
+                # the visit's bank is spent: rotate so the NEXT pick
+                # serves the next tenant (without this, a weight-1
+                # tenant would re-bank on the same visit and be served
+                # continuously — the exact starvation DRR prevents)
+                self._ring.rotate(-1)
+            job = self._jobs[job_id]
+            if job.state != "queued":  # cancelled while queued
+                continue
+            return job
+        return None
+
+    # -- replica choice ----------------------------------------------------
+    def _routable_locked(self, r: _Replica, now: float) -> bool:
+        return (
+            r.state == "ready"
+            and len(r.inflight) < self.cfg.replica_inflight
+            and r.backoff_until <= now
+        )
+
+    def _choose_replica_locked(
+        self, key: str
+    ) -> "tuple[_Replica | None, bool]":
+        now = time.monotonic()
+        ready = [r for r in self.pool if self._routable_locked(r, now)]
+        if not ready:
+            return None, False
+        if self.cfg.affinity:
+            warm = [r for r in ready if key in r.warm]
+            if warm:
+                warm.sort(key=lambda r: (len(r.inflight), r.rid))
+                return warm[0], True
+        ready.sort(key=lambda r: (len(r.inflight), r.rid))
+        return ready[0], False
+
+    # -- the dispatcher ----------------------------------------------------
+    def serve_forever(self) -> None:
+        """Route jobs on THIS thread until stopped, then shut the pool
+        and telemetry down (drain first on a clean stop)."""
+        status = "ok"
+        try:
+            while True:
+                picked = self._next_route()
+                if picked is None:
+                    break
+                self._route_job(*picked)
+        except BaseException:
+            status = "aborted"
+            raise
+        finally:
+            self._shutdown(status=status)
+
+    def _next_route(self) -> "tuple[RouterJob, _Replica, bool] | None":
+        with self._lock:
+            while True:
+                if self._stopping:
+                    return None
+                job = None
+                if self._ring:
+                    # peek capacity BEFORE consuming a queue entry: a
+                    # popped job with no replica to take it would lose
+                    # its DRR slot
+                    now = time.monotonic()
+                    head_ready = any(
+                        self._routable_locked(r, now) for r in self.pool
+                    )
+                    if head_ready:
+                        job = self._pick_job_locked()
+                if job is not None:
+                    replica, warm = self._choose_replica_locked(job.key)
+                    if replica is None:
+                        # capacity vanished between peek and pick: put
+                        # the job back at its queue front and wait
+                        self._enqueue_locked(job, front=True)
+                    else:
+                        job.attempts += 1
+                        job.state = "routed"
+                        job.replica = replica.rid
+                        # optimistic stickiness: the NEXT same-shape job
+                        # must prefer this replica even while this one
+                        # is still compiling there
+                        replica.note_key_locked(job.key)
+                        replica.inflight.add(job.job_id)
+                        return job, replica, warm
+                self._cond.wait(timeout=0.2)
+
+    def _route_job(self, job: RouterJob, replica: _Replica, warm: bool) -> None:
+        """One forward (no lock held during HTTP).  Failure paths:
+        transport error / injected ``router.forward`` fault → the job
+        re-enters its tenant queue (front) bounded by ``route_retries``;
+        a replica-side 429 → requeue without burning a retry (the
+        replica is saturated, not broken); a replica-side 400 → the
+        job is terminally ``config_error`` (no replica will take it)."""
+        payload = dict(job.payload)
+        payload["workdir"] = job.workdir
+        payload["out_dir"] = job.out_dir
+        payload["resume"] = True
+        err: "str | None" = None
+        body = None
+        status = None
+        try:
+            faults.check("router.forward")
+            status, body = _http_json(
+                "POST", replica.base + "/jobs", payload,
+                timeout=_FORWARD_TIMEOUT_S,
+            )
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        now = time.time()
+        if err is None and status == 200 and isinstance(body, dict):
+            with self._lock:
+                job.replica_job_id = body.get("job_id")
+                job.routed_t = now
+                job.snap = body
+                depth = self._queued
+                # a cancel that landed while the forward was in flight
+                # (replica_job_id still None) had nowhere to go — honor
+                # it now that the replica id exists
+                relay_cancel = job.cancel_requested
+            if relay_cancel:
+                try:
+                    _http_json(
+                        "POST",
+                        f"{replica.base}/jobs/{job.replica_job_id}/cancel",
+                        {},
+                    )
+                except Exception as e:
+                    log.warning("deferred cancel forward failed: %s", e)
+            if self.telemetry is not None:
+                self.telemetry.route_decision(
+                    job, replica.rid, warm, depth,
+                    wait_s=now - job.submitted_t,
+                )
+            log.info(
+                "job %s → %s (%s, tenant=%s, attempt %d)",
+                job.job_id, replica.rid, "warm" if warm else "cold",
+                job.tenant, job.attempts,
+            )
+            return
+        if err is None and status == 429:
+            # saturated replica (its own admission): not a route retry —
+            # the job returns to its queue front and the REPLICA gets a
+            # cooldown the choosers skip (never a dispatcher sleep: one
+            # saturated replica must not head-of-line-block routing for
+            # every other tenant and replica)
+            with self._lock:
+                replica.inflight.discard(job.job_id)
+                replica.backoff_until = time.monotonic() + min(
+                    0.5, self.cfg.health_interval_s
+                )
+                if job.state == "routed":  # vs a racing death sweep
+                    job.state = "queued"
+                    job.replica = None
+                    job.attempts -= 1
+                    self._enqueue_locked(job, front=True)
+                self._cond.notify_all()
+            return
+        if err is None and status is not None and 400 <= status < 500:
+            detail = (body or {}).get("detail") or (body or {}).get("error")
+            self._finish_job(
+                job, "config_error",
+                f"replica {replica.rid} refused the request "
+                f"({status}): {detail}",
+                from_replica=replica,
+            )
+            return
+        # transport failure / 5xx / injected fault: the replica is
+        # suspect, the job is NOT lost — re-route it
+        reason = err or f"HTTP {status}"
+        log.warning(
+            "forward of %s to %s failed (%s)", job.job_id, replica.rid,
+            reason,
+        )
+        self._note_replica_failure(replica)
+        self._requeue_job(job, replica, reason)
+
+    def _requeue_job(
+        self, job: RouterJob, replica: "_Replica | None", reason: str
+    ) -> None:
+        """Return a routed job to its tenant queue (front), or finish
+        it ``error`` when its route retries are exhausted."""
+        exhausted = False
+        with self._lock:
+            if replica is not None:
+                replica.inflight.discard(job.job_id)
+            if job.state != "routed":
+                # terminal, or ALREADY requeued by a racing path (the
+                # dispatcher's forward failure vs the control thread's
+                # replica-death sweep): a second enqueue would route the
+                # job twice
+                return
+            if job.attempts >= 1 + self.cfg.route_retries:
+                exhausted = True
+            else:
+                job.state = "queued"
+                job.replica = None
+                job.replica_job_id = None
+                job.poll_fails = 0
+                self._enqueue_locked(job, front=True)
+                self._cond.notify_all()
+        if exhausted:
+            self._finish_job(
+                job, "error",
+                f"route retries exhausted after {job.attempts} "
+                f"attempt(s); last: {reason} — resubmit with "
+                f"\"workdir\": {job.workdir!r} to resume",
+                from_replica=None,
+            )
+
+    def _finish_job(
+        self,
+        job: RouterJob,
+        state: str,
+        error: "str | None",
+        from_replica: "_Replica | None",
+        snap: "dict | None" = None,
+    ) -> None:
+        with self._lock:
+            if job.state in TERMINAL_STATES:
+                return
+            job.state = state
+            job.error = error if error is not None else job.error
+            if snap is not None:
+                job.snap = snap
+            job.finished_t = time.time()
+            self._terminal += 1
+            if from_replica is not None:
+                from_replica.inflight.discard(job.job_id)
+            wall_s = job.finished_t - job.submitted_t
+            self._cond.notify_all()
+        log.info(
+            "job %s %s in %.2fs%s",
+            job.job_id, state, wall_s,
+            f" ({job.error})" if job.error else "",
+        )
+        if self.telemetry is not None:
+            self.telemetry.job_done(job, wall_s)
+
+    # -- the control loop (health, polls, autoscale) -----------------------
+    def _control_loop(self) -> None:
+        while not self._control_stop.wait(self.cfg.health_interval_s):
+            try:
+                self.control_beat()
+            except Exception:
+                # the control plane must never take down the router
+                log.debug("control beat failed", exc_info=True)
+
+    def control_beat(self, now: "float | None" = None) -> None:
+        """One control beat: probe every replica, poll every routed
+        job, feed the autoscaler.  Called from the control thread (and
+        directly by tests, with a pinned ``now``)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            replicas = list(self.pool)
+        for replica in replicas:
+            self._probe_replica(replica)
+        with self._lock:
+            routed = [
+                j for j in self._jobs.values() if j.state == "routed"
+            ]
+            ready = sum(1 for r in self.pool if r.state == "ready")
+            total = sum(1 for r in self.pool if r.state != "stopped")
+        for job in routed:
+            self._poll_job(job)
+        if self.telemetry is not None:
+            self.telemetry.pool_gauges(ready, total)
+        if self.scaler is not None:
+            self.scale_tick(self._pod_burn(now), now)
+        self._reap_draining()
+
+    def _note_replica_failure(self, replica: _Replica) -> None:
+        emit = None
+        with self._lock:
+            replica.fails += 1
+            if (
+                replica.fails >= self.cfg.unhealthy_after
+                and replica.state == "ready"
+            ):
+                # unready ≠ failed jobs: accepted jobs keep polling and
+                # finish wherever they actually run
+                replica.state = "unready"
+                emit = replica
+        if emit is not None and self.telemetry is not None:
+            self.telemetry.replica_down(emit, "health")
+
+    def _probe_replica(self, replica: _Replica) -> None:
+        if replica.state == "stopped":
+            return
+        proc = replica.proc
+        if proc is not None and proc.poll() is not None:
+            self._replica_died(replica, f"process exited {proc.poll()}")
+            return
+        failed = False
+        health: "dict | None" = None
+        try:
+            if faults.fired("replica.health"):
+                failed = True
+            else:
+                status, health = _http_json(
+                    "GET", replica.base + "/healthz"
+                )
+                failed = status != 200 or not isinstance(health, dict)
+        except Exception:
+            failed = True
+        if failed:
+            self._note_replica_failure(replica)
+            return
+        emit_up = None
+        with self._lock:
+            replica.fails = 0
+            replica.last_health = health
+            replica.last_health_t = time.time()
+            for key in health.get("warm_keys") or []:
+                if isinstance(key, str):
+                    replica.note_key_locked(key)
+            if replica.state in ("starting", "unready"):
+                replica.state = "ready"
+                emit_up = replica
+                self._cond.notify_all()
+        if emit_up is not None and self.telemetry is not None:
+            self.telemetry.replica_up(emit_up)
+
+    def _replica_died(self, replica: _Replica, reason: str) -> None:
+        """A spawned replica's process is gone: mark it stopped and
+        re-route every job it held — recorded tiles are durable in the
+        router-pinned workdirs, so the re-routed submissions resume."""
+        orphans: "list[RouterJob]" = []
+        emit = None
+        with self._lock:
+            if replica.state == "stopped":
+                return
+            was_draining = replica.state == "draining"
+            replica.state = "stopped"
+            emit = replica
+            for job_id in sorted(replica.inflight):
+                job = self._jobs.get(job_id)
+                if job is not None and job.state == "routed":
+                    orphans.append(job)
+        if self.telemetry is not None and emit is not None:
+            self.telemetry.replica_down(
+                emit, "scale_down" if was_draining else "dead"
+            )
+        log.warning(
+            "replica %s down (%s); re-routing %d job(s)",
+            replica.rid, reason, len(orphans),
+        )
+        for job in orphans:
+            self._requeue_job(job, replica, f"replica {replica.rid} died")
+
+    def _poll_job(self, job: RouterJob) -> None:
+        with self._lock:
+            if job.state != "routed" or job.replica_job_id is None:
+                return
+            replica = self._replica_locked(job.replica)
+        if replica is None:
+            self._requeue_job(job, None, "replica record vanished")
+            return
+        try:
+            status, snap = _http_json(
+                "GET", f"{replica.base}/jobs/{job.replica_job_id}"
+            )
+        except Exception as e:
+            dead = replica.proc is not None and replica.proc.poll() is not None
+            with self._lock:
+                job.poll_fails += 1
+                fails = job.poll_fails
+                state = replica.state
+            if dead:
+                self._replica_died(replica, f"poll failed: {e}")
+            elif (
+                state in ("unready", "stopped")
+                and fails >= self.cfg.unhealthy_after
+            ):
+                self._requeue_job(
+                    job, replica,
+                    f"replica {replica.rid} unreachable ({e})",
+                )
+            return
+        if status == 404:
+            # the replica restarted (or never accepted it): re-route
+            self._requeue_job(
+                job, replica, f"replica {replica.rid} lost the job"
+            )
+            return
+        if status != 200 or not isinstance(snap, dict):
+            return
+        terminal = snap.get("state") in TERMINAL_STATES
+        with self._lock:
+            job.poll_fails = 0
+            job.snap = snap
+            if terminal and job.state == "routed":
+                # routing FEEDBACK: the shape ran here, its programs
+                # are resident — confirm the sticky key as warm
+                replica.note_key_locked(job.key)
+        if terminal:
+            self._finish_job(
+                job, snap["state"], snap.get("error"),
+                from_replica=replica, snap=snap,
+            )
+
+    def _replica_locked(self, rid: "str | None") -> "_Replica | None":
+        for r in self.pool:
+            if r.rid == rid:
+                return r
+        return None
+
+    # -- autoscaling -------------------------------------------------------
+    def _pod_burn(self, now: float) -> "float | None":
+        """The pod ``lt_slo_burn_rate`` from the shared telemetry
+        directory (the PR-11 fleet plane: replicas publish snapshots,
+        ``fold_dir`` merges them, gauges default to the pod-max policy
+        — the alerting-relevant fold)."""
+        from land_trendr_tpu.obs import aggregate
+
+        try:
+            view = aggregate.fold_dir(
+                self._telemetry_dir, now=now, newer_than=now - 600.0
+            )
+        except Exception:
+            return None
+        for inst in view.get("metrics", []):
+            if inst["name"] == "lt_slo_burn_rate" and not inst.get("labels"):
+                v = inst.get("value")
+                return None if v is None else float(v)
+        return None
+
+    def scale_tick(self, burn: "float | None", now: float) -> "str | None":
+        """Feed one burn observation to the autoscaler and ACT on the
+        decision (spawn / begin a drain).  Split from the control loop
+        so tests and the soak can drive a scripted burn history
+        deterministically; returns the action taken."""
+        if self.scaler is None:
+            return None
+        with self._lock:
+            queue_depth = self._queued
+            spawned_live = [
+                r for r in self.pool
+                if r.spawned and r.state in ("starting", "ready", "unready")
+            ]
+            decision = self.scaler.decide(
+                burn, queue_depth, len(spawned_live), now
+            )
+        if decision == "up":
+            replica = self._launch_replica_proc()
+            if self.telemetry is not None:
+                with self._lock:
+                    n = len([
+                        r for r in self.pool
+                        if r.spawned and r.state != "stopped"
+                    ])
+                self.telemetry.scale_decision(
+                    "up", burn or 0.0, n, queue_depth, replica=replica.rid
+                )
+            # await the startup line OFF the control thread: a cold jax
+            # replica start takes tens of seconds, and blocking here
+            # would stall every health probe, job poll and drain reap
+            # for the duration
+            threading.Thread(
+                target=self._await_scale_up,
+                args=(replica,),
+                name=f"lt-route-spawn-{replica.rid}",
+                daemon=True,
+            ).start()
+            return "up"
+        if decision == "down":
+            victim = None
+            with self._lock:
+                candidates = sorted(
+                    (r for r in spawned_live if r.state == "ready"),
+                    key=lambda r: (len(r.inflight), len(r.warm), r.rid),
+                )
+                if candidates:
+                    victim = candidates[0]
+                    # drain-before-kill: no new routes land here; the
+                    # reaper stops the process once inflight hits zero
+                    victim.state = "draining"
+                    n = len([
+                        r for r in self.pool
+                        if r.spawned and r.state != "stopped"
+                    ])
+            if victim is not None and self.telemetry is not None:
+                self.telemetry.scale_decision(
+                    "down", burn or 0.0, n - 1, queue_depth,
+                    replica=victim.rid,
+                )
+            return "down" if victim is not None else None
+        return None
+
+    def _await_scale_up(self, replica: _Replica) -> None:
+        try:
+            self._await_replica_start(replica)
+        except RuntimeError as e:
+            log.error("scale-up replica failed to start: %s", e)
+            self._replica_died(replica, str(e))
+
+    def _reap_draining(self) -> None:
+        """Stop drained replicas: a ``draining`` member with zero
+        in-flight jobs gets the ``lt serve`` process's documented clean
+        shutdown (SIGINT — its dispatcher finishes teardown, manifests
+        stay resumable)."""
+        with self._lock:
+            drained = [
+                r for r in self.pool
+                if r.state == "draining" and not r.inflight
+            ]
+        for replica in drained:
+            self._stop_replica_proc(replica)
+            with self._lock:
+                replica.state = "stopped"
+            if self.telemetry is not None:
+                self.telemetry.replica_down(replica, "scale_down")
+
+    @staticmethod
+    def _stop_replica_proc(replica: _Replica) -> None:
+        proc = replica.proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.send_signal(signal.SIGINT)
+            proc.wait(timeout=60)
+        except (ProcessLookupError, subprocess.TimeoutExpired):
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+
+    # -- status / cancel ---------------------------------------------------
+    def job_status(self, job_id: str) -> "dict | None":
+        with self._lock:
+            job = self._jobs.get(job_id)
+            return job.status_locked() if job is not None else None
+
+    def jobs(self) -> list:
+        with self._lock:
+            return [j.status_locked() for j in self._jobs.values()]
+
+    def cancel(self, job_id: str) -> "dict | None":
+        """Cancel one router job: a queued job goes terminal here; a
+        routed one has the cancel forwarded to its replica (the poll
+        picks up the terminal state)."""
+        forward_to = None
+        finished = None
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return None
+            job.cancel_requested = True
+            if job.state == "queued":
+                try:
+                    self._tq[job.tenant].remove(job_id)
+                    self._queued -= 1
+                except (KeyError, ValueError):
+                    pass
+                finished = job
+            elif job.state == "routed" and job.replica_job_id is not None:
+                replica = self._replica_locked(job.replica)
+                if replica is not None:
+                    forward_to = (replica, job.replica_job_id)
+            snap = job.status_locked()
+        if finished is not None:
+            self._finish_job(
+                finished, "cancelled", "cancelled while queued",
+                from_replica=None,
+            )
+            snap = self.job_status(job_id)
+        if forward_to is not None:
+            replica, rjid = forward_to
+            try:
+                _http_json("POST", f"{replica.base}/jobs/{rjid}/cancel", {})
+            except Exception as e:
+                log.warning("cancel forward failed: %s", e)
+        return snap
+
+    def stats(self) -> dict:
+        """The router ``/healthz`` body (``"router": true`` marks it so
+        ``lt top`` renders the router view)."""
+        with self._lock:
+            tenants = {
+                t: {
+                    "queued": len(q),
+                    "routed": sum(
+                        1 for j in self._jobs.values()
+                        if j.tenant == t and j.state == "routed"
+                    ),
+                    "weight": self._weight(t),
+                    "deficit": round(self._deficit.get(t, 0.0), 3),
+                }
+                for t, q in sorted(self._tq.items())
+            }
+            for j in self._jobs.values():
+                if j.state == "routed" and j.tenant not in tenants:
+                    tenants[j.tenant] = {
+                        "queued": 0,
+                        "routed": sum(
+                            1 for x in self._jobs.values()
+                            if x.tenant == j.tenant and x.state == "routed"
+                        ),
+                        "weight": self._weight(j.tenant),
+                        "deficit": round(self._deficit.get(j.tenant, 0.0), 3),
+                    }
+            snap = {
+                "ok": True,
+                "router": True,
+                "queue_depth": self._queued,
+                "routed": sum(
+                    1 for j in self._jobs.values() if j.state == "routed"
+                ),
+                "jobs_total": len(self._jobs),
+                "jobs_terminal": self._terminal,
+                "tenants": tenants,
+                "replicas": [r.row_locked() for r in self.pool],
+                # under the lock: scale_tick mutates the engine's alert
+                # state under this same lock, and the Autoscaler's
+                # single-owner contract is exactly that serialization
+                "scaler": self.scaler.state() if self.scaler else None,
+            }
+        snap["uptime_s"] = round(time.time() - self._t0, 3)
+        return snap
+
+    def _fleet_probes(self) -> dict:
+        """The ``state`` block of the router's own fleet snapshot
+        (kind="route"): ``lt_fleet`` / ``lt top --dir`` render the
+        router aggregate straight from the shared directory."""
+        s = self.stats()
+        return {
+            "progress": {
+                "queue_depth": s["queue_depth"],
+                "routed": s["routed"],
+                "jobs_total": s["jobs_total"],
+                "jobs_terminal": s["jobs_terminal"],
+            },
+            "router": {
+                "tenants": s["tenants"],
+                "replicas": s["replicas"],
+                "scaler": s["scaler"],
+            },
+        }
+
+    def stop(self) -> None:
+        """Ask the dispatcher to shut down (clean drain)."""
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+
+    # -- shutdown ----------------------------------------------------------
+    def _drain_routed(self, deadline_s: float) -> None:
+        """Quiesce: poll routed jobs until none remain (or the bound
+        expires) — replicas finish what they accepted, so a clean stop
+        loses nothing."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                routed = [
+                    j for j in self._jobs.values() if j.state == "routed"
+                ]
+            if not routed:
+                return
+            for job in routed:
+                self._poll_job(job)
+            time.sleep(min(0.5, self.cfg.health_interval_s))
+
+    def _shutdown(self, status: str) -> None:
+        """Idempotent reverse-of-construction teardown."""
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+            queued = [
+                j for j in self._jobs.values() if j.state == "queued"
+            ]
+        self._control_stop.set()
+        if self._control_thread is not None:
+            self._control_thread.join(timeout=30)
+            self._control_thread = None
+        httpd = getattr(self, "_httpd", None)
+        thread = getattr(self, "_http_thread", None)
+        if httpd is not None:
+            if thread is not None:
+                httpd.shutdown()
+            httpd.server_close()
+            self._httpd = None
+        if thread is not None:
+            thread.join(timeout=10)
+            self._http_thread = None
+        for job in queued:
+            self._finish_job(
+                job, "cancelled", "router shut down before routing",
+                from_replica=None,
+            )
+        if status == "ok":
+            # the drain contract: in-flight jobs finish on their
+            # replicas before anything is stopped (an abort skips this
+            # — manifests are resumable either way)
+            self._drain_routed(_DRAIN_TIMEOUT_S)
+        with self._lock:
+            spawned = [r for r in self.pool if r.spawned]
+        for replica in spawned:
+            alive = replica.proc is not None and replica.proc.poll() is None
+            self._stop_replica_proc(replica)
+            with self._lock:
+                was_stopped = replica.state == "stopped"
+                replica.state = "stopped"
+            if alive and not was_stopped and self.telemetry is not None:
+                self.telemetry.replica_down(replica, "shutdown")
+        if self._fault_plan is not None:
+            faults.deactivate()
+            self._fault_plan = None
+        if self.telemetry is not None:
+            try:
+                self.telemetry.close(status, time.time() - self._t0)
+            except Exception as exc:
+                log.error("router telemetry close failed: %s", exc)
+            self.telemetry = None
+
+
+class _RouterAPIServer(http.server.ThreadingHTTPServer):
+    """The loopback front door: thin JSON routing over the router."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, router: FleetRouter) -> None:
+        self.lt_router = router
+        super().__init__(addr, _RouterAPIHandler)
+
+    def handle_error(self, request, client_address) -> None:
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
+
+class _RouterAPIHandler(http.server.BaseHTTPRequestHandler):
+    """Routes::
+
+        POST /jobs              submit (JSON body → job snapshot |
+                                429 + Retry-After / 400)
+        GET  /jobs              every router job's snapshot
+        GET  /jobs/<id>         one job (includes the replica's last
+                                snapshot under "result")
+        POST /jobs/<id>/cancel  cancel (queued → terminal; routed →
+                                forwarded to the replica)
+        GET  /healthz           router state: tenant queues, replica
+                                table, scaler state ("router": true)
+        GET  /metrics           the lt_router_* exposition
+    """
+
+    server: _RouterAPIServer
+
+    def _send_json(self, status: int, payload) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if status == 429:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - stdlib API name
+        rt = self.server.lt_router
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/healthz":
+            self._send_json(200, rt.stats())
+        elif path == "/metrics":
+            if rt.telemetry is None:
+                self.send_error(404)
+                return
+            body = rt.telemetry.registry.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/jobs":
+            self._send_json(200, {"jobs": rt.jobs()})
+        elif path.startswith("/jobs/"):
+            snap = rt.job_status(path[len("/jobs/"):])
+            if snap is None:
+                self._send_json(404, {"error": "no such job"})
+            else:
+                self._send_json(200, snap)
+        else:
+            self.send_error(404)
+
+    def do_POST(self) -> None:  # noqa: N802 - stdlib API name
+        rt = self.server.lt_router
+        path = self.path.split("?")[0].rstrip("/")
+        if path == "/jobs":
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(
+                    400, {"error": "bad_request", "detail": f"bad JSON: {e}"}
+                )
+                return
+            try:
+                snap = rt.submit(payload, source="http")
+            except Rejection as e:
+                self._send_json(
+                    e.http_status, {"error": e.reason, "detail": e.detail}
+                )
+                return
+            self._send_json(200, snap)
+        elif path.startswith("/jobs/") and path.endswith("/cancel"):
+            job_id = path[len("/jobs/"):-len("/cancel")]
+            snap = rt.cancel(job_id)
+            if snap is None:
+                self._send_json(404, {"error": "no such job"})
+            else:
+                self._send_json(200, snap)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *a) -> None:  # quiet: no per-request stderr
+        pass

@@ -59,6 +59,15 @@ SERVE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "debug_endpoints": ("no-debug-endpoints",),
 }
 
+ROUTE = "land_trendr_tpu/fleet/config.py"
+
+#: the RouterConfig alias table (the fleet triangle's exceptions)
+ROUTE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
+    "telemetry": ("no-telemetry",),
+    "replicas": ("replica",),
+    "affinity": ("no-affinity",),
+}
+
 #: the coupling triangles this rule checks: each names a config
 #: dataclass, the CLI subcommand projecting it, the README section
 #: documenting it, and the alias table for non-mechanical flags.  A new
@@ -78,6 +87,13 @@ TRIANGLES: tuple[dict, ...] = (
         "subcommand": "serve",
         "section": "## serve configuration",
         "aliases": SERVE_FLAG_ALIASES,
+    },
+    {
+        "file": ROUTE,
+        "cls": "RouterConfig",
+        "subcommand": "route",
+        "section": "## fleet configuration",
+        "aliases": ROUTE_FLAG_ALIASES,
     },
 )
 

@@ -274,6 +274,36 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # carry), emitted through the server's event log so the pod's
     # health timeline rides the normal stream.  Additive event type.
     "fleet_sample": {"hosts": int, "stale_hosts": int},
+    # --- serving fleet router (land_trendr_tpu/fleet) --------------------
+    # the router forwarded one job to a replica: ``warm`` is true when
+    # the choice was affinity-driven (the replica's warm/sticky key set
+    # contained the job's affinity key), false for the least-loaded
+    # fallback.  Emitted once per SUCCESSFUL forward; the optional
+    # ``attempt`` (>= 1) counts every forward TRY, so a job whose first
+    # forward failed lands with one route_decision carrying attempt=2.
+    # Additive event type.
+    "route_decision": {
+        "job_id": str,
+        "tenant": str,
+        "replica": str,
+        "warm": bool,
+    },
+    # a replica joined the routable pool (spawned or adopted, or
+    # recovered from unready).  Additive.
+    "replica_up": {"replica": str},
+    # a replica left the routable pool: ``reason`` is "health" (probe
+    # failures), "dead" (spawned process exited), "scale_down" (drained
+    # by the autoscaler) or "shutdown".  Its accepted jobs are NOT
+    # failed — they re-route or keep polling.  Additive.
+    "replica_down": {"replica": str, "reason": str},
+    # router admission refused a submission with 429 + Retry-After:
+    # ``reason`` is "tenant_quota" (per-tenant queued+routed bound) or
+    # "queue_full" (router-wide queue bound).  Additive.
+    "tenant_throttled": {"tenant": str, "reason": str, "queue_depth": int},
+    # one autoscaler action: ``direction`` is "up" | "down", ``burn``
+    # the pod burn-rate that drove it, ``replicas`` the pool size AFTER
+    # the action was initiated.  Additive.
+    "scale_decision": {"direction": str, "burn": _NUM, "replicas": int},
 }
 
 #: well-known OPTIONAL fields: type-checked when present, never required
@@ -351,6 +381,15 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "alerts_firing": int,
         "history_samples": int,
     },
+    "route_decision": {
+        "key": str,
+        "attempt": int,
+        "queue_wait_s": _NUM,
+        "queue_depth": int,
+    },
+    "replica_up": {"base": str, "spawned": bool},
+    "replica_down": {"base": str, "inflight": int},
+    "scale_decision": {"replica": str, "queue_depth": int},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

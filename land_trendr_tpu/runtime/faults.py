@@ -55,6 +55,14 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     run lives
 ``history.append``  fleet history-ring append (``obs/history.py``):
                     one sample is lost; the ring stays consistent
+``router.forward``  fleet-router job forward (``fleet/router.py``): the
+                    POST to the chosen replica fails; the job re-enters
+                    the router queue and routes again (bounded by
+                    ``route_retries``) — never a lost job
+``replica.health``  fleet-router health probe (behavioral): a live
+                    replica's probe reads as FAILED — enough
+                    consecutive fires mark the replica unready without
+                    failing any accepted job
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -128,6 +136,8 @@ SEAMS = (
     "debug.profile",
     "obs.publish",
     "history.append",
+    "router.forward",
+    "replica.health",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -153,6 +163,8 @@ _DEFAULT_KIND = {
     "debug.profile": "runtime",
     "obs.publish": "io",
     "history.append": "io",
+    "router.forward": "io",
+    "replica.health": "fire",
 }
 
 

@@ -38,6 +38,8 @@ submissions never become jobs (they are answered at admission with the
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import threading
 import time
 
@@ -176,6 +178,39 @@ class JobRequest:
                 f"{reserved}"
             )
         return req
+
+    #: the request fields that select the compiled-program/decoded-block
+    #: set a job needs — the AFFINITY key's inputs.  Tenant, priority,
+    #: deadlines and directory pins deliberately excluded: two requests
+    #: that differ only in those run the SAME programs over the SAME
+    #: blocks, so they must hash identically for warm routing.
+    _AFFINITY_FIELDS = (
+        "stack_dir",
+        "index",
+        "ftv",
+        "params",
+        "tile_size",
+        "products",
+        "lazy",
+        "run_overrides",
+    )
+
+    def affinity_key(self) -> str:
+        """Deterministic warm-affinity key over the shape-relevant
+        request fields (see ``_AFFINITY_FIELDS``).
+
+        This is the routing-layer sibling of
+        :meth:`~land_trendr_tpu.serve.programs.ProgramCache.key_for`:
+        the program-cache key hashes facts only the executing process
+        knows (backend, mesh, padded pixel counts), while this key
+        hashes the REQUEST alone — so a front-end router and a replica
+        compute the same key for the same submission without running
+        it.  Repeat shapes hash identically; ``/healthz`` exposes each
+        replica's recently-run keys (bounded) for the router's affinity
+        table."""
+        facts = {name: getattr(self, name) for name in self._AFFINITY_FIELDS}
+        blob = json.dumps(facts, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     def to_run_config(self, workdir: str, out_dir: str, telemetry: bool):
         """Project this request onto a RunConfig over the job's resolved

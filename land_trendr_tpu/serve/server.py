@@ -80,6 +80,12 @@ _JOB_BUCKETS = (0.5, 1, 2, 5, 10, 30, 60, 300, 1800, 7200, 43200)
 #: ``lt_slo_burn_rate`` window, terminal jobs
 _SLO_WINDOW_JOBS = 100
 
+#: bound on the request-level warm-affinity keys ``/healthz`` exposes
+#: (recency-ordered; the full program residency count stays
+#: ``warm_program_count`` — this list only has to cover the shapes a
+#: router would still route here)
+_WARM_KEYS_MAX = 32
+
 
 class Rejection(Exception):
     """A submission refused at admission: carries the HTTP status and a
@@ -655,6 +661,14 @@ class SegmentationServer:
         self._captures = 0
         self._running_id: "str | None" = None
         self.programs = ProgramCache()
+        #: request-level affinity keys of jobs whose Run actually
+        #: executed here (their programs are resident in this process's
+        #: jit cache) — recency-ordered, bounded, exposed on /healthz so
+        #: a warm-affinity router can rebuild its table from health
+        #: probes alone (adoption, router restart)
+        self._warm_keys: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict()
+        )
 
         # every teardown-touched handle exists BEFORE anything that can
         # fail: _shutdown_shared must be callable from any depth of a
@@ -907,6 +921,14 @@ class SegmentationServer:
             self.telemetry.job_submitted(job, depth)
         return snap
 
+    def _note_warm_key_locked(self, key: str) -> None:
+        """Record one executed shape's affinity key (caller holds the
+        lock); recency-ordered and bounded at ``_WARM_KEYS_MAX``."""
+        self._warm_keys[key] = time.time()
+        self._warm_keys.move_to_end(key)
+        while len(self._warm_keys) > _WARM_KEYS_MAX:
+            self._warm_keys.popitem(last=False)
+
     # -- status / cancel ---------------------------------------------------
     def job_status(self, job_id: str) -> "dict | None":
         with self._lock:
@@ -924,6 +946,12 @@ class SegmentationServer:
                 "running": self._running_id,
                 "jobs_terminal": self._terminal,
                 "jobs_total": len(self._jobs),
+                # the request-level warm-affinity keys (newest last,
+                # bounded): what a warm-affinity router joins its own
+                # JobRequest.affinity_key() against — warm_program_count
+                # alone names no shapes, so a router could not rebuild
+                # its table from it
+                "warm_keys": list(self._warm_keys),
             }
         snap["program_cache"] = self.programs.stats()
         # load-balancer-grade health facts ride /healthz directly so an
@@ -1229,6 +1257,11 @@ class SegmentationServer:
             job.summary = summary
             job.outputs = outputs
             job.finished_t = time.time()
+            if summary is not None:
+                # the Run executed here, so this shape's programs are
+                # resident in the process jit cache: the key is warm for
+                # any router reading /healthz
+                self._note_warm_key_locked(req.affinity_key())
             # release the Run: it pins the job's whole decoded stack
             # (plus manifest/fetcher/uploader) — retained across
             # terminal jobs it would grow the long-lived server by a

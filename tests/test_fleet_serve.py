@@ -1,0 +1,534 @@
+"""Serving-fleet tests (ISSUE 13): router, fair share, autoscaling.
+
+Pins the fleet subsystem's contracts:
+
+* **warm-affinity routing**: repeat shapes land on the replica already
+  holding the compiled program — the second same-shape job routes
+  ``warm`` and runs **zero** jit compiles;
+* **fair share**: deficit round-robin over per-tenant queues — a heavy
+  tenant's burst cannot starve a light tenant's single job;
+* **quotas**: a tenant at its quota (and a full router queue) is
+  throttled 429 + ``Retry-After`` (``tenant_throttled`` event) while
+  other tenants proceed;
+* **replica death**: a replica SIGKILLed mid-job is detected, the job
+  re-routes with its router-pinned workdir, resumes on the survivor
+  and completes **byte-identical** to a clean CLI run — zero accepted
+  jobs lost;
+* **autoscaling**: a scripted burn-rate history drives a deterministic
+  scale-up → hold-down → scale-down sequence, replayed byte-identically;
+* the new ``route_decision``/``replica_up``/``replica_down``/
+  ``tenant_throttled``/``scale_decision`` events schema-lint clean and
+  fold in ``obs_report``'s router rollup; ``lt top`` renders the router
+  aggregate.
+
+Scene shape and params are shared with ``tests/test_serve.py`` so the
+process-wide jit cache keeps in-process replicas warm across the suite.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import main as cli_main
+from land_trendr_tpu.fleet import (
+    DOWN_REASONS,
+    Autoscaler,
+    FleetRouter,
+    RouterConfig,
+    parse_tenant_weights,
+)
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.serve import (
+    Rejection,
+    SegmentationServer,
+    ServeConfig,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+#: the test_serve.py scene/params — identical program-cache keys keep
+#: every in-process replica after the first warm
+_PARAM_FLAGS = ["--max-segments", "4", "--vertex-count-overshoot", "2"]
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("fleet_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+def _digest_workdir(workdir: str) -> dict:
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _job(stack_dir: str, **kw) -> dict:
+    return {
+        "stack_dir": stack_dir,
+        "tile_size": _TILE,
+        "params": dict(_PARAMS),
+        "run_overrides": {"retry_backoff_s": 0.0},
+        **kw,
+    }
+
+
+def _await_terminal(router: FleetRouter, job_id: str,
+                    timeout_s: float = 300.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        s = router.job_status(job_id)
+        if s is not None and s["state"] not in ("queued", "routed"):
+            return s
+        time.sleep(0.05)
+    raise TimeoutError(f"job {job_id} not terminal within {timeout_s}s")
+
+
+def _events(workdir: str) -> list:
+    return [
+        json.loads(line)
+        for line in (Path(workdir) / "events.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+
+
+class _Replicas:
+    """N in-process SegmentationServers on threads (cheap replicas for
+    router tests; the process-wide jit cache is shared, but each server
+    keeps its OWN ProgramCache accounting — exactly what the warm
+    assertions read)."""
+
+    def __init__(self, tmp_path, n: int, **serve_kw) -> None:
+        self.servers = [
+            SegmentationServer(ServeConfig(
+                workdir=str(tmp_path / f"replica{i}"),
+                feed_cache_mb=32,
+                **serve_kw,
+            ))
+            for i in range(n)
+        ]
+        self.threads = [
+            threading.Thread(target=s.serve_forever) for s in self.servers
+        ]
+        for t in self.threads:
+            t.start()
+        self.bases = tuple(
+            f"http://127.0.0.1:{s.port}" for s in self.servers
+        )
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+        for t in self.threads:
+            t.join(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# config / vocabulary validation
+
+
+def test_router_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="loopback"):
+        RouterConfig(replicas=("http://127.0.0.1:1",),
+                     route_host="0.0.0.0")
+    with pytest.raises(ValueError, match="needs replicas"):
+        RouterConfig()
+    with pytest.raises(ValueError, match="base URL"):
+        RouterConfig(replicas=("127.0.0.1:80",))
+    with pytest.raises(ValueError, match="NAME=WEIGHT"):
+        RouterConfig(replicas=("http://x",), tenant_weights="oops")
+    with pytest.raises(ValueError, match="hysteresis"):
+        RouterConfig(spawn_replicas=1, autoscale=True,
+                     scale_down_burn=0.9)
+    with pytest.raises(ValueError, match="SPAWNED"):
+        RouterConfig(replicas=("http://x",), autoscale=True)
+    with pytest.raises(ValueError):  # typo'd seam = config error NOW
+        RouterConfig(replicas=("http://x",),
+                     fault_schedule="router.forwardd@0")
+    assert parse_tenant_weights("a=3,b=1.5") == {"a": 3.0, "b": 1.5}
+    # the CLI maps the same failures to the documented exit 2
+    assert cli_main(["route", "--route-host", "0.0.0.0",
+                     "--replica", "http://127.0.0.1:1",
+                     "--workdir", str(tmp_path / "rt")]) == 2
+    assert cli_main(["route", "--workdir", str(tmp_path / "rt2")]) == 2
+
+
+def test_down_reason_tables_cannot_drift():
+    from check_events_schema import DOWN_REASONS as LINT_REASONS
+    from check_events_schema import SCALE_DIRECTIONS
+
+    assert tuple(LINT_REASONS) == tuple(DOWN_REASONS)
+    assert set(SCALE_DIRECTIONS) == {"up", "down"}
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: scripted burn history, deterministic replay
+
+
+def test_autoscaler_scripted_burn_deterministic():
+    """A scripted burn-rate spike drives scale-up, the hold-down timer
+    suppresses flapping, and the cooled-off burn drives scale-down —
+    the whole sequence replayed byte-identically."""
+
+    def script() -> list:
+        scaler = Autoscaler(
+            min_replicas=1, max_replicas=3, up_burn=0.5, down_burn=0.05,
+            for_s=2.0, hold_s=10.0,
+        )
+        replicas, out = 1, []
+        for t in range(30):
+            burn = 0.9 if t < 10 else 0.0
+            d = scaler.decide(burn, 0, replicas, float(t))
+            if d == "up":
+                replicas += 1
+            elif d == "down":
+                replicas -= 1
+            if d:
+                out.append((t, d, replicas))
+        return out
+
+    run1, run2 = script(), script()
+    assert run1 == run2, "scripted history must replay identically"
+    # burn >= 0.5 from t=0 holds for for_s=2 → up at t=2; hold-down
+    # blocks further actions until t=12; by then the burn has cooled
+    # (<= 0.05 from t=10, for_s=2 → condition ripe at t=12) → down
+    assert run1 == [(2, "up", 2), (12, "down", 1)], run1
+    # bounds: at max_replicas the up decision is withheld
+    scaler = Autoscaler(min_replicas=1, max_replicas=2, up_burn=0.5,
+                        down_burn=0.05, for_s=0.0, hold_s=0.0)
+    assert scaler.decide(0.9, 0, 2, 0.0) is None
+    # a backlogged queue blocks scale-down (shrinking moves burn up)
+    scaler = Autoscaler(min_replicas=1, max_replicas=2, up_burn=0.5,
+                        down_burn=0.05, for_s=0.0, hold_s=0.0)
+    assert scaler.decide(0.0, 5, 2, 0.0) is None
+    assert scaler.decide(0.0, 0, 2, 1.0) == "down"
+    # a dark telemetry plane (burn None) never scales
+    assert scaler.decide(None, 0, 2, 2.0) is None
+    st = scaler.state()
+    assert st["min_replicas"] == 1 and st["burn"] is None
+
+
+# ---------------------------------------------------------------------------
+# warm-affinity routing
+
+
+def test_affinity_routes_repeat_shapes_warm(stack_dir, tmp_path):
+    replicas = _Replicas(tmp_path, 2)
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir, replicas=replicas.bases, health_interval_s=0.2,
+    ))
+    rt_thread = threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    try:
+        s1 = _await_terminal(
+            router, router.submit(_job(stack_dir))["job_id"]
+        )
+        s2 = _await_terminal(
+            router, router.submit(_job(stack_dir))["job_id"]
+        )
+    finally:
+        router.stop()
+        rt_thread.join(timeout=300)
+        replicas.stop()
+    assert s1["state"] == s2["state"] == "done"
+    # the affinity contract: the repeat shape landed on the SAME
+    # replica and ran ZERO jit compiles there
+    assert s2["replica"] == s1["replica"]
+    assert s2["result"]["summary"]["program_cache"]["misses"] == 0
+    assert s2["result"]["summary"]["program_cache"]["hits"] == 1
+    decisions = [e for e in _events(rt_dir) if e["ev"] == "route_decision"]
+    assert len(decisions) == 2
+    assert decisions[0]["warm"] is False
+    assert decisions[1]["warm"] is True
+    assert decisions[1]["key"] == decisions[0]["key"]
+    # schema lint + obs_report router rollup over the router stream
+    from check_events_schema import main as lint_main
+
+    assert lint_main([rt_dir]) == 0
+    import obs_report
+
+    report, _spans = obs_report.fold([os.path.join(rt_dir, "events.jsonl")])
+    assert report["router"]["routed"] == 2
+    assert report["router"]["warm"] == 1
+    assert report["router"]["warm_ratio"] == 0.5
+    # lt top renders the router aggregate from the healthz shape
+    import lt_top
+
+    view = lt_top.render_router(
+        {"healthz": {"router": True, "uptime_s": 1.0, "queue_depth": 0,
+                     "routed": 0, "jobs_total": 2, "jobs_terminal": 2,
+                     "tenants": {"default": {"queued": 0, "routed": 0,
+                                             "weight": 1, "deficit": 0}},
+                     "replicas": [{"replica": "r0", "state": "ready",
+                                   "inflight": 0, "warm_keys": 1,
+                                   "base": "http://x"}],
+                     "scaler": None},
+         "metrics": [], "jobs": [s1, s2]}
+    )
+    assert "REPLICA" in view and "TENANT" in view and "r0" in view
+
+
+def test_healthz_exposes_warm_affinity_keys(stack_dir, tmp_path):
+    """The serve-side satellite: after a job runs, /healthz carries the
+    request-level affinity key (bounded list) a router joins against —
+    not just the opaque warm_program_count."""
+    from land_trendr_tpu.serve.jobs import JobRequest
+
+    server = SegmentationServer(
+        ServeConfig(workdir=str(tmp_path / "srv"), max_jobs=1,
+                    feed_cache_mb=32)
+    )
+    server.submit(_job(stack_dir))
+    server.serve_forever()
+    snap = server.stats()
+    expected = JobRequest.from_payload(_job(stack_dir)).affinity_key()
+    assert snap["warm_keys"] == [expected]
+    assert isinstance(snap["warm_program_count"], int)
+
+
+# ---------------------------------------------------------------------------
+# fair share + quotas
+
+
+def test_fair_share_heavy_tenant_cannot_starve_light(stack_dir, tmp_path):
+    """Four heavy-tenant jobs queued ahead of one light-tenant job:
+    deficit round-robin must serve the light tenant on the second
+    rotation, not after the heavy backlog drains."""
+    replicas = _Replicas(tmp_path, 1)
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir, replicas=replicas.bases, replica_inflight=1,
+        health_interval_s=0.2,
+    ))
+    # queue the whole burst BEFORE the dispatcher starts: the routing
+    # order is then pure scheduler policy
+    heavy = [router.submit(_job(stack_dir, tenant="heavy"))
+             for _ in range(4)]
+    light = router.submit(_job(stack_dir, tenant="light"))
+    rt_thread = threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    try:
+        for snap in (*heavy, light):
+            s = _await_terminal(router, snap["job_id"])
+            assert s["state"] == "done", s.get("error")
+    finally:
+        router.stop()
+        rt_thread.join(timeout=300)
+        replicas.stop()
+    order = [
+        (e["tenant"], e["job_id"])
+        for e in _events(rt_dir) if e["ev"] == "route_decision"
+    ]
+    tenants_in_order = [t for t, _ in order]
+    assert len(order) == 5
+    # round-robin with equal weights: heavy, light, heavy, heavy, heavy
+    assert tenants_in_order[1] == "light", (
+        f"light tenant starved behind the heavy burst: {tenants_in_order}"
+    )
+    assert order[1][1] == light["job_id"]
+
+
+def test_tenant_quota_and_queue_throttle_429(stack_dir, tmp_path):
+    replicas = _Replicas(tmp_path, 1)
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir, replicas=replicas.bases, tenant_quota=2,
+        route_queue_depth=3, health_interval_s=0.2,
+    ))
+    try:
+        router.submit(_job(stack_dir, tenant="a"))
+        router.submit(_job(stack_dir, tenant="a"))
+        # tenant quota: a's third submission throttles, b's proceeds
+        with pytest.raises(Rejection) as exc:
+            router.submit(_job(stack_dir, tenant="a"))
+        assert exc.value.http_status == 429
+        assert exc.value.reason == "tenant_quota"
+        router.submit(_job(stack_dir, tenant="b"))
+        # router queue bound: depth 3 reached, tenant c throttles too
+        with pytest.raises(Rejection) as exc:
+            router.submit(_job(stack_dir, tenant="c"))
+        assert exc.value.reason == "queue_full"
+        # the HTTP contract: 429 + Retry-After header
+        body = json.dumps(_job(stack_dir, tenant="a")).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/jobs", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as httperr:
+            urllib.request.urlopen(req, timeout=30)
+        assert httperr.value.code == 429
+        assert httperr.value.headers.get("Retry-After") is not None
+        # malformed request: 400 job_rejected, not a throttle
+        with pytest.raises(Rejection) as exc:
+            router.submit({"nope": 1})
+        assert exc.value.http_status == 400
+    finally:
+        router.stop()
+        router.serve_forever()  # drains the queued jobs as cancelled
+        replicas.stop()
+    evs = _events(rt_dir)
+    throttled = [e for e in evs if e["ev"] == "tenant_throttled"]
+    assert sorted({e["reason"] for e in throttled}) == [
+        "queue_full", "tenant_quota",
+    ]
+    assert {e["tenant"] for e in throttled} >= {"a"}
+    assert [e for e in evs if e["ev"] == "job_rejected"]
+    from check_events_schema import main as lint_main
+
+    assert lint_main([rt_dir]) == 0
+
+
+# ---------------------------------------------------------------------------
+# replica death: re-route, resume, byte-identical artifacts
+
+
+def test_replica_sigkill_reroutes_and_completes_byte_identical(
+    stack_dir, tmp_path
+):
+    """The zero-lost-jobs contract end-to-end with REAL replica
+    processes: SIGKILL the replica mid-job; the router re-routes the
+    job, the survivor resumes the router-pinned manifest, and the
+    artifacts are byte-identical to a clean CLI run."""
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir,
+        spawn_replicas=2,
+        health_interval_s=0.3,
+        route_retries=3,
+        # pace dispatches so the kill lands mid-job with durable tiles
+        replica_args=(
+            "--feed-cache-mb", "64",
+            "--fault-schedule", "seed=5,dispatch%1.0=slow:0.3",
+        ),
+    ))
+    rt_thread = threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    try:
+        snap = router.submit(_job(stack_dir))
+        wd = Path(snap["workdir"])
+        deadline = time.monotonic() + 240
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            if list(wd.glob("tile_*.npz")):
+                with router._lock:
+                    for r in router.pool:
+                        if (snap["job_id"] in r.inflight
+                                and r.proc is not None
+                                and r.proc.poll() is None):
+                            victim = r
+            if victim is None:
+                time.sleep(0.05)
+        assert victim is not None, "no replica ever held the job"
+        pre_kill = _digest_workdir(str(wd))
+        assert pre_kill, "kill must land after durable work"
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        s = _await_terminal(router, snap["job_id"], timeout_s=240.0)
+    finally:
+        router.stop()
+        rt_thread.join(timeout=600)
+    assert s["state"] == "done", s.get("error")
+    assert s["attempts"] >= 2, "the job was never re-routed"
+    assert s["replica"] != victim.rid
+    # byte-identical to a clean CLI run of the same request — and the
+    # pre-kill tiles were RESUMED, not recomputed
+    resumed = _digest_workdir(str(wd))
+    clean_wd = str(tmp_path / "clean_w")
+    assert cli_main(["segment", stack_dir, "--tile-size", str(_TILE),
+                     "--workdir", clean_wd,
+                     "--out-dir", str(tmp_path / "clean_o"),
+                     *_PARAM_FLAGS]) == 0
+    assert resumed == _digest_workdir(clean_wd)
+    assert all(resumed[k] == v for k, v in pre_kill.items())
+    evs = _events(rt_dir)
+    downs = [e for e in evs if e["ev"] == "replica_down"]
+    assert any(
+        e["replica"] == victim.rid and e["reason"] == "dead" for e in downs
+    ), downs
+    # zero lost jobs: every accepted job reached a terminal job_done
+    dones = [e for e in evs if e["ev"] == "job_done"]
+    assert [e["status"] for e in dones] == ["done"]
+    from check_events_schema import main as lint_main
+
+    assert lint_main([rt_dir]) == 0
+
+
+# ---------------------------------------------------------------------------
+# fixture + lt_fleet rendering
+
+
+def test_router_fixture_stream_lints_clean():
+    """The committed router fixture (precommit's schema-drift guard)
+    stays valid against the live schema."""
+    from check_events_schema import main as lint_main
+
+    fixture = os.path.join(
+        os.path.dirname(__file__), "fixtures", "router.events.jsonl"
+    )
+    assert lint_main([fixture]) == 0
+
+
+def test_lt_fleet_renders_router_snapshot():
+    import lt_fleet
+
+    view = {
+        "counts": {"folded": 1, "stale": 0, "corrupt": 0, "excluded": 0,
+                   "snapshots": 1},
+        "generated_t": 0.0,
+        "hosts": [{
+            "path": "h.1.snap.json", "host": "h", "pid": 1,
+            "kind": "route", "age_s": 0.5, "corrupt": False,
+            "stale": False, "excluded": False,
+            "state": {
+                "progress": {"queue_depth": 2},
+                "router": {
+                    "tenants": {"a": {"queued": 2, "routed": 1,
+                                      "weight": 3.0}},
+                    "replicas": [{"replica": "r0", "state": "ready",
+                                  "inflight": 1, "warm_keys": 2,
+                                  "base": "http://127.0.0.1:9"}],
+                    "scaler": {"burn": 0.1, "min_replicas": 1,
+                               "max_replicas": 4, "firing": []},
+                },
+            },
+        }],
+        "metrics": [
+            {"name": "lt_router_jobs_routed_total", "kind": "counter",
+             "labels": {}, "value": 3.0},
+        ],
+        "conflicts": [],
+        "alerts": [],
+    }
+    text = lt_fleet.render(view)
+    assert "router @ h:1" in text
+    assert "tenant a" in text and "replica r0" in text
+    assert "scaler burn 0.1" in text
+    assert "forwards 3" in text

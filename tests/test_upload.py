@@ -283,9 +283,12 @@ def test_perf_gate_smoke(tmp_path, capsys):
     scheduler leg is skipped here — it spawns two 2-process jax pods
     (minutes-scale, timing-sensitive under suite load); CLI gate runs
     carry it, and the lease invariants stay tier-1-covered by
-    tests/test_leases.py + fault_soak's lease case."""
+    tests/test_leases.py + fault_soak's lease case.  The fleet-router
+    leg is skipped for the same reason (seven jax replica processes);
+    tests/test_fleet_serve.py covers its invariants in-process."""
     import perf_gate
 
-    rc = perf_gate.main(["--keep", str(tmp_path / "gate"), "--skip-scheduler"])
+    rc = perf_gate.main(["--keep", str(tmp_path / "gate"),
+                         "--skip-scheduler", "--skip-router"])
     out = capsys.readouterr()
     assert rc == 0, f"perf gate regressions:\n{out.out}\n{out.err}"

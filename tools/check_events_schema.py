@@ -109,6 +109,14 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "hosts", "stale_hosts", "corrupt_snaps", "alerts_firing",
         "history_samples",
     ),
+    # serving-fleet router (land_trendr_tpu/fleet): queue depths, route
+    # attempts and pool sizes only count up / never negative (the
+    # route_decision attempt >= 1 cross-check lives in
+    # route_decision_value_errors below)
+    "route_decision": ("attempt", "queue_wait_s", "queue_depth"),
+    "replica_down": ("inflight",),
+    "tenant_throttled": ("queue_depth",),
+    "scale_decision": ("burn", "replicas", "queue_depth"),
 }
 
 
@@ -314,6 +322,51 @@ def lease_value_errors(rec, lineno: int) -> list[str]:
     return []
 
 
+#: the router's replica_down reason vocabulary (mirrors
+#: land_trendr_tpu.fleet.router.DOWN_REASONS — asserted equal in
+#: tests/test_fleet_serve.py so the two cannot drift)
+DOWN_REASONS = ("health", "dead", "scale_down", "shutdown")
+
+#: the autoscaler's direction vocabulary
+SCALE_DIRECTIONS = ("up", "down")
+
+
+def route_decision_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for the router events a type check alone cannot
+    pin: a ``route_decision`` is BY DEFINITION at least the first
+    attempt (``attempt >= 1``), a ``replica_down`` carries a known
+    reason, and a ``scale_decision`` a known direction.  Non-negativity
+    rides the generic loop."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    if ev == "route_decision":
+        att = rec.get("attempt")
+        if _num(att) and att < 1:
+            return [
+                f"line {lineno}: route_decision: attempt {att} below 1 "
+                "(a forward is at least the first attempt)"
+            ]
+        return []
+    if ev == "replica_down":
+        reason = rec.get("reason")
+        if isinstance(reason, str) and reason not in DOWN_REASONS:
+            return [
+                f"line {lineno}: replica_down: reason {reason!r} not one "
+                f"of {DOWN_REASONS}"
+            ]
+        return []
+    if ev == "scale_decision":
+        d = rec.get("direction")
+        if isinstance(d, str) and d not in SCALE_DIRECTIONS:
+            return [
+                f"line {lineno}: scale_decision: direction {d!r} not one "
+                f"of {SCALE_DIRECTIONS}"
+            ]
+        return []
+    return []
+
+
 #: the alert event's state vocabulary (mirrors
 #: land_trendr_tpu.obs.alerts.ALERT_STATES — asserted equal in
 #: tests/test_fleet.py so the two cannot drift)
@@ -399,6 +452,7 @@ def value_lints():
             + span_value_errors(rec, lineno)
             + tile_straggler_value_errors(rec, lineno)
             + lease_value_errors(rec, lineno)
+            + route_decision_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )

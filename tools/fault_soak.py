@@ -621,6 +621,235 @@ def soak(
                 f"({schedule2})"
             )
 
+    def run_router_track() -> None:
+        """Fleet-router failure semantics (ISSUE 13), in-process: one
+        real replica (a SegmentationServer on a thread) behind a
+        :class:`~land_trendr_tpu.fleet.router.FleetRouter` whose armed
+        plan fires at the two router seams.
+
+        * ``router.forward@0=io``: the FIRST forward fails — the job
+          re-enters the router queue and routes again (attempt 2), the
+          replica lives, artifacts byte-identical to the clean run.
+        * ``replica.health@1*6``: six consecutive health probes read as
+          failed — the replica is marked unready (``replica_down``
+          reason="health") WITHOUT failing the accepted job, which
+          keeps polling, completes byte-identically, and the replica
+          recovers (``replica_up``) once the probes clear.
+        """
+        import threading as _threading
+
+        from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+        from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+        sdir = str(root / "serve_stack")  # the serve track wrote it
+        clean = _digest_workdir(str(root / "serve_clean"))
+        job = {
+            "stack_dir": sdir,
+            "tile_size": base_kw["tile_size"],
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "max_retries": retries,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+        server = SegmentationServer(
+            ServeConfig(workdir=str(root / "router_replica"),
+                        feed_cache_mb=64)
+        )
+        srv_thread = _threading.Thread(target=server.serve_forever)
+        srv_thread.start()
+        try:
+            for case_name, schedule in (
+                ("forward_fault_rerouted", "seed=1,router.forward@0=io"),
+                # the plan is process-global, so the slow dispatch paces
+                # the IN-PROCESS replica's job long enough that three
+                # health beats (0.2s apart) fail while it runs —
+                # invocation 0 is the adopt-time probe and must succeed
+                ("health_fault_unready_job_survives",
+                 "seed=2,replica.health@1*8,dispatch%1.0=slow:0.3"),
+            ):
+                rt_dir = str(root / f"router_{case_name}")
+                router = FleetRouter(RouterConfig(
+                    workdir=rt_dir,
+                    replicas=(f"http://127.0.0.1:{server.port}",),
+                    health_interval_s=0.2,
+                    route_retries=2,
+                    fault_schedule=schedule,
+                ))
+                rt_thread = _threading.Thread(target=router.serve_forever)
+                rt_thread.start()
+                try:
+                    snap = router.submit(dict(job))
+                    deadline = time.monotonic() + 300
+                    while time.monotonic() < deadline:
+                        s = router.job_status(snap["job_id"])
+                        if s["state"] not in ("queued", "routed"):
+                            break
+                        time.sleep(0.1)
+                    if case_name.startswith("health"):
+                        # the scheduled probe faults are exhausted; wait
+                        # out the recovery probe so the replica_up
+                        # assertion is not a race against stop()
+                        while time.monotonic() < deadline:
+                            pool = router.stats()["replicas"]
+                            if pool and pool[0]["state"] == "ready":
+                                break
+                            time.sleep(0.1)
+                finally:
+                    router.stop()
+                    rt_thread.join(timeout=300)
+                if s["state"] != "done":
+                    raise AssertionError(
+                        f"router/{case_name}: job ended {s['state']} "
+                        f"({s.get('error')})"
+                    )
+                if _digest_workdir(s["workdir"]) != clean:
+                    raise AssertionError(
+                        f"router/{case_name}: artifacts differ from the "
+                        "clean run"
+                    )
+                evs = [
+                    json.loads(line) for line in
+                    (Path(rt_dir) / "events.jsonl").read_text().splitlines()
+                ]
+                kinds = [e["ev"] for e in evs]
+                if case_name == "forward_fault_rerouted":
+                    if s["attempts"] != 2:
+                        raise AssertionError(
+                            f"router/forward: expected 2 route attempts "
+                            f"(fault then re-route), got {s['attempts']}"
+                        )
+                    # route_decision marks the SUCCESSFUL forward; the
+                    # faulted first try leaves only the attempt counter
+                    decisions = [
+                        e for e in evs if e["ev"] == "route_decision"
+                    ]
+                    if len(decisions) != 1 or decisions[0]["attempt"] != 2:
+                        raise AssertionError(
+                            "router/forward: expected exactly the "
+                            f"attempt-2 route_decision, got {decisions}"
+                        )
+                else:
+                    downs = [
+                        e for e in evs if e["ev"] == "replica_down"
+                    ]
+                    if not downs or downs[0]["reason"] != "health":
+                        raise AssertionError(
+                            "router/health: the probe faults never "
+                            f"marked the replica unready ({downs})"
+                        )
+                    if kinds.count("replica_up") < 2:
+                        raise AssertionError(
+                            "router/health: the replica never recovered "
+                            "after the probes cleared"
+                        )
+                report["cases"].append({
+                    "track": "router",
+                    "case": case_name,
+                    "schedule": schedule,
+                    "job": s["state"],
+                    "route_attempts": s["attempts"],
+                    "artifacts_identical": True,
+                })
+                if verbose:
+                    print(f"  ok: router/{case_name} ({schedule})")
+        finally:
+            server.stop()
+            srv_thread.join(timeout=120)
+
+    def run_router_kill_case() -> None:
+        """Full mode: a SPAWNED replica SIGKILLed mid-job.  The router
+        detects the dead process, re-routes the job (its router-pinned
+        workdir resumes on the survivor), and the job completes with
+        artifacts byte-identical to the clean run — zero accepted jobs
+        lost to the kill.  Full mode only: two cold jax replica
+        processes cost tens of seconds the smoke budget does not have
+        (the smoke's router.forward case drives the same re-route code
+        path deterministically)."""
+        import os as _os
+        import signal as _signal
+        import threading as _threading
+
+        from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        rt_dir = str(root / "router_kill")
+        router = FleetRouter(RouterConfig(
+            workdir=rt_dir,
+            spawn_replicas=2,
+            health_interval_s=0.3,
+            route_retries=3,
+            # pace every dispatch so the kill lands mid-job with tiles
+            # already durable — the resume-not-recompute proof
+            replica_args=(
+                "--feed-cache-mb", "64",
+                "--fault-schedule", "seed=5,dispatch%1.0=slow:0.3",
+            ),
+        ))
+        rt_thread = _threading.Thread(target=router.serve_forever)
+        rt_thread.start()
+        try:
+            snap = router.submit({
+                "stack_dir": sdir,
+                "tile_size": base_kw["tile_size"],
+                "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+                "run_overrides": {"retry_backoff_s": 0.0},
+            })
+            wd = Path(snap["workdir"])
+            deadline = time.monotonic() + 300
+            victim = None
+            while time.monotonic() < deadline and victim is None:
+                with router._lock:
+                    for r in router.pool:
+                        if r.inflight and r.proc is not None \
+                                and r.proc.poll() is None:
+                            victim = r
+                if victim is None:
+                    time.sleep(0.05)
+                elif not list(wd.glob("tile_*.npz")):
+                    victim = None  # kill only once work is durable
+                    time.sleep(0.05)
+            if victim is None:
+                raise AssertionError(
+                    "router kill: no replica ever held the job"
+                )
+            pre_kill = len(list(wd.glob("tile_*.npz")))
+            _os.kill(victim.proc.pid, _signal.SIGKILL)
+            while time.monotonic() < deadline:
+                s = router.job_status(snap["job_id"])
+                if s["state"] not in ("queued", "routed"):
+                    break
+                time.sleep(0.1)
+        finally:
+            router.stop()
+            rt_thread.join(timeout=600)
+        if s["state"] != "done":
+            raise AssertionError(
+                f"router kill: job ended {s['state']} ({s.get('error')})"
+            )
+        if s["attempts"] < 2:
+            raise AssertionError(
+                "router kill: the job was never re-routed — the kill "
+                "missed its window"
+            )
+        if _digest_workdir(str(wd)) != clean:
+            raise AssertionError(
+                "router kill: artifacts differ from the clean run"
+            )
+        report["cases"].append({
+            "track": "router",
+            "case": "replica_sigkill_rerouted",
+            "schedule": "SIGKILL replica mid-job",
+            "tiles_durable_before_kill": pre_kill,
+            "route_attempts": s["attempts"],
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: router/replica_sigkill_rerouted "
+                f"({pre_kill} tile(s) durable pre-kill, "
+                f"{s['attempts']} route attempts)"
+            )
+
     def run_lease_kill_case() -> None:
         """Elastic failure semantics (ISSUE 12): two INDEPENDENT worker
         processes share one workdir through the shared-manifest lease
@@ -733,6 +962,9 @@ def soak(
     if not smoke:
         run_lease_kill_case()
     run_serve_track()
+    run_router_track()
+    if not smoke:
+        run_router_kill_case()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a
     # real cache to poison (cases that pin their own feed_cache_mb —

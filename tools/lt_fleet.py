@@ -101,6 +101,38 @@ def render(view: dict) -> str:
             f"{str(p.get('queue_depth', '-')):>5} "
             f"{str(state.get('stragglers', '-')):>5}"
         )
+    # router aggregate: a kind="route" snapshot carries the routing
+    # plane's state block (tenant queues, replica table, scaler) — the
+    # fleet router publishes it so this view needs no HTTP
+    for h in view["hosts"]:
+        router = ((h.get("state") or {}).get("router")) or {}
+        if not router:
+            continue
+        lines.append("")
+        lines.append(f"router @ {h.get('host', '?')}:{h.get('pid', '?')}")
+        tenants = router.get("tenants") or {}
+        for name in sorted(tenants):
+            t = tenants[name]
+            lines.append(
+                f"  tenant {name:<12} queued {t.get('queued', 0):>4} "
+                f"routed {t.get('routed', 0):>4} "
+                f"weight {t.get('weight', 1):g}"
+            )
+        for r in router.get("replicas") or []:
+            lines.append(
+                f"  replica {r.get('replica', '?'):<6} "
+                f"{r.get('state', '?'):<9} inflight "
+                f"{r.get('inflight', 0)} warm {r.get('warm_keys', 0)} "
+                f"({r.get('base', '?')})"
+            )
+        scaler = router.get("scaler")
+        if scaler:
+            lines.append(
+                f"  scaler burn {scaler.get('burn')} bounds "
+                f"[{scaler.get('min_replicas')}, "
+                f"{scaler.get('max_replicas')}] firing "
+                f"{scaler.get('firing') or '-'}"
+            )
     lines.append("")
     agg = []
     for label, name in (
@@ -129,6 +161,19 @@ def render(view: dict) -> str:
             slo.append(f"{label} {v:g}")
     if slo:
         lines.append("slo: " + "  ".join(slo))
+    rt = []
+    for label, name in (
+        ("forwards", "lt_router_jobs_routed_total"),
+        ("warm", "lt_router_warm_routed_total"),
+        ("rerouted", "lt_router_rerouted_total"),
+        ("throttled", "lt_router_throttled_total"),
+        ("replicas-ready", "lt_router_replicas_ready"),
+    ):
+        v = _metric(view, name)
+        if v is not None:
+            rt.append(f"{label} {v:g}")
+    if rt:
+        lines.append("router: " + "  ".join(rt))
     for c in view.get("conflicts", []):
         lines.append(f"merge conflict: {c}")
     lines.append("")

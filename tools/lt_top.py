@@ -240,6 +240,82 @@ def render(snap: dict) -> str:
     return "\n".join(lines)
 
 
+def render_router(snap: dict) -> str:
+    """The router view (``/healthz`` answered ``"router": true``): the
+    fleet aggregate from ONE target — per-tenant queue depths, the
+    replica table, the scaler state, and the router job listing."""
+    h = snap["healthz"]
+    rows = snap["metrics"]
+    now = time.time()
+    lines = [
+        f"lt top — router, uptime {_fmt_age(h.get('uptime_s', 0))}   "
+        f"queue {h.get('queue_depth', '?')}   "
+        f"routed {h.get('routed', '?')}   "
+        f"terminal {h.get('jobs_terminal', '?')}/{h.get('jobs_total', '?')}"
+    ]
+    if rows:
+        lines.append(
+            f"routing: forwards {_metric(rows, 'lt_router_jobs_routed_total'):.0f}  "
+            f"warm {_metric(rows, 'lt_router_warm_routed_total'):.0f}  "
+            f"rerouted {_metric(rows, 'lt_router_rerouted_total'):.0f}  "
+            f"throttled {_metric(rows, 'lt_router_throttled_total'):.0f}"
+        )
+    tenants = h.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines.append(
+            f"{'TENANT':<14} {'QUEUED':>6} {'ROUTED':>6} {'WEIGHT':>6} "
+            f"{'DEFICIT':>7}"
+        )
+        for name in sorted(tenants):
+            t = tenants[name]
+            lines.append(
+                f"{name:<14} {t.get('queued', 0):>6} "
+                f"{t.get('routed', 0):>6} {t.get('weight', 1):>6g} "
+                f"{t.get('deficit', 0):>7}"
+            )
+    lines.append("")
+    lines.append(
+        f"{'REPLICA':<8} {'STATE':<9} {'INFL':>4} {'WARM':>4} "
+        f"{'QUEUE':>5} {'FAILS':>5} {'HEALTH':>7} {'BASE'}"
+    )
+    for r in h.get("replicas") or []:
+        age = r.get("health_age_s")
+        lines.append(
+            f"{r.get('replica', '?'):<8} {r.get('state', '?'):<9} "
+            f"{r.get('inflight', 0):>4} {r.get('warm_keys', 0):>4} "
+            f"{str(r.get('queue_depth', '-')):>5} {r.get('fails', 0):>5} "
+            f"{_fmt_age(age) if isinstance(age, (int, float)) else '-':>7} "
+            f"{r.get('base', '?')}"
+        )
+    scaler = h.get("scaler")
+    if scaler:
+        lines.append("")
+        lines.append(
+            f"scaler: burn {scaler.get('burn')}  bounds "
+            f"[{scaler.get('min_replicas')}, {scaler.get('max_replicas')}]"
+            f"  firing {scaler.get('firing') or '-'}  "
+            f"last action "
+            f"{_fmt_age(now - scaler['last_action_t']) + ' ago' if scaler.get('last_action_t') else 'never'}"
+        )
+    lines.append("")
+    lines.append(
+        f"{'JOB':<16} {'STATE':<18} {'TENANT':<10} {'REPLICA':<8} "
+        f"{'ATT':>3} {'AGE':>6}"
+    )
+    for job in snap["jobs"]:
+        age = now - job.get("submitted_t", now)
+        lines.append(
+            f"{job.get('job_id', '?'):<16} {job.get('state', '?'):<18} "
+            f"{job.get('tenant', '?'):<10} "
+            f"{str(job.get('replica') or '-'):<8} "
+            f"{job.get('attempts', 0):>3} {_fmt_age(age):>6}"
+        )
+    if not snap["jobs"]:
+        lines.append("(no jobs)")
+    return "\n".join(lines)
+
+
 def render_fleet(snaps: list) -> str:
     """N replica snapshots → one view: the AGGREGATE header (instruments
     merged through the fleet plane's per-instrument policy table —
@@ -383,10 +459,13 @@ def main(argv: "list[str] | None" = None) -> int:
         return [snapshot(b) for b in bases]
 
     def show(polled) -> str:
-        return (
-            render(polled) if isinstance(polled, dict)
-            else render_fleet(polled)
-        )
+        if isinstance(polled, dict):
+            # a router target renders the fleet aggregate itself
+            # (per-tenant queues, replica table, scaler state)
+            if polled["healthz"].get("router"):
+                return render_router(polled)
+            return render(polled)
+        return render_fleet(polled)
 
     try:
         if args.json:

@@ -107,7 +107,7 @@ def _fresh_scope() -> dict:
         "intervals": [], "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
-        "slo": None, "resources": None,
+        "slo": None, "resources": None, "router": None,
     }
 
 
@@ -162,6 +162,50 @@ def _serve_scope(cur: dict) -> dict:
             "wait_s": [], "job_s": [],
         }
     return cur["serve"]
+
+
+def _router_scope(cur: dict) -> dict:
+    """The lazily-created router sub-aggregate of one scope (the fleet
+    router's own events file carries the routing plane)."""
+    if cur["router"] is None:
+        cur["router"] = {
+            "routed": 0, "warm": 0, "rerouted": 0, "throttled": {},
+            "replicas_up": 0, "replicas_down": {}, "scales": {},
+            "queue_wait_s": [],
+        }
+    return cur["router"]
+
+
+def _merge_router(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the routing-plane rollups (None when no
+    file's last scope carried router events); derives the warm-route
+    ratio and the queue-wait distribution."""
+    seen = [c["router"] for c in folded if c["router"] is not None]
+    if not seen:
+        return None
+    throttled: dict = {}
+    downs: dict = {}
+    scales: dict = {}
+    for s in seen:
+        for k, v in s["throttled"].items():
+            throttled[k] = throttled.get(k, 0) + v
+        for k, v in s["replicas_down"].items():
+            downs[k] = downs.get(k, 0) + v
+        for k, v in s["scales"].items():
+            scales[k] = scales.get(k, 0) + v
+    routed = sum(s["routed"] for s in seen)
+    warm = sum(s["warm"] for s in seen)
+    return {
+        "routed": routed,
+        "warm": warm,
+        "warm_ratio": round(warm / routed, 4) if routed else None,
+        "rerouted": sum(s["rerouted"] for s in seen),
+        "throttled": dict(sorted(throttled.items())),
+        "replicas_up": sum(s["replicas_up"] for s in seen),
+        "replicas_down": dict(sorted(downs.items())),
+        "scales": dict(sorted(scales.items())),
+        "queue_wait_s": _stats([v for s in seen for v in s["queue_wait_s"]]),
+    }
 
 
 def _merge_serve(folded: list[dict]) -> "dict | None":
@@ -771,6 +815,73 @@ def fold(
                                 "error": rec.get("error"),
                             },
                         })
+                    elif ev == "route_decision":
+                        # routing plane (land_trendr_tpu/fleet): every
+                        # field read FIRST (the job_slo discipline)
+                        rd_job, replica, warm = (
+                            rec["job_id"], rec["replica"], rec["warm"]
+                        )
+                        rt = _router_scope(cur)
+                        rt["routed"] += 1
+                        if warm:
+                            rt["warm"] += 1
+                        if rec.get("attempt", 1) > 1:
+                            rt["rerouted"] += 1
+                        elif isinstance(
+                            rec.get("queue_wait_s"), (int, float)
+                        ):
+                            rt["queue_wait_s"].append(rec["queue_wait_s"])
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": (
+                                f"routed {rd_job} → {replica} "
+                                f"[{'warm' if warm else 'cold'}]"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "tenant": rec.get("tenant"),
+                                "key": rec.get("key"),
+                                "attempt": rec.get("attempt"),
+                            },
+                        })
+                    elif ev == "tenant_throttled":
+                        tt_tenant, tt_reason = rec["tenant"], rec["reason"]
+                        th = _router_scope(cur)["throttled"]
+                        th[tt_reason] = th.get(tt_reason, 0) + 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": f"THROTTLED {tt_tenant} ({tt_reason})",
+                            "t0": tw,
+                            "args": {"queue_depth": rec.get("queue_depth")},
+                        })
+                    elif ev == "replica_up":
+                        _router_scope(cur)["replicas_up"] += 1
+                    elif ev == "replica_down":
+                        rd_reason = rec["reason"]
+                        dn = _router_scope(cur)["replicas_down"]
+                        dn[rd_reason] = dn.get(rd_reason, 0) + 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": (
+                                f"replica {rec['replica']} DOWN "
+                                f"({rd_reason})"
+                            ),
+                            "t0": tw,
+                            "args": {"inflight": rec.get("inflight")},
+                        })
+                    elif ev == "scale_decision":
+                        sc_dir = rec["direction"]
+                        sc = _router_scope(cur)["scales"]
+                        sc[sc_dir] = sc.get(sc_dir, 0) + 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": f"scale {sc_dir}",
+                            "t0": tw,
+                            "args": {
+                                "burn": rec.get("burn"),
+                                "replicas": rec.get("replicas"),
+                            },
+                        })
                     elif ev == "program_cache":
                         # warm-cache verdict: one per job run scope (and a
                         # server-scope aggregate); last wins per scope
@@ -873,6 +984,7 @@ def fold(
         "upload": _merge_xfer(folded, "upload"),
         "ingest_store": _merge_ingest_store(folded),
         "serve": _merge_serve(folded),
+        "router": _merge_router(folded),
         "program_cache": _merge_program_cache(folded),
         "slo": _merge_slo(folded),
         "resources": _merge_resources(folded),

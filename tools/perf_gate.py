@@ -507,12 +507,67 @@ def run_scheduler_leg(workdir: str, check) -> None:
     )
 
 
-def run_gate(workdir: str, checks: list, scheduler: bool = True) -> None:
+def run_router_leg(workdir: str, check) -> None:
+    """Serving-fleet router leg (land_trendr_tpu/fleet +
+    tools/fleet_bench): replay the heavy-tailed multi-tenant trace
+    through 1 vs N spawned replicas and gate the EXACT invariants —
+    warm-affinity hit ratio strictly above the no-affinity baseline,
+    zero lost jobs across a replica SIGKILL (at least one job
+    re-routed), artifacts byte-identical for the same spec across all
+    legs — plus the reported p99s for the record.  Minutes-scale (seven
+    jax replica processes), so the tier-1 smoke passes
+    ``--skip-router``; CLI gate runs carry the leg."""
+    import fleet_bench
+
+    out = str(Path(workdir) / "fleet_smoke.json")
+    if fleet_bench.main(["--smoke", "--out", out]) not in (0, 1):
+        check("router.ran", False, "fleet_bench --smoke errored")
+        return
+    got = json.loads(Path(out).read_text())
+    legs = got.get("legs", {})
+    inv = got.get("invariants", {})
+    aff = legs.get("affinity", {})
+    noaff = legs.get("noaff", {})
+    kill = legs.get("kill", {})
+    check(
+        "router.warm_affinity_above_baseline",
+        inv.get("affinity_warm_above_noaff") is True,
+        f"warm-hit ratio {aff.get('warm_hit_ratio')} (affinity) vs "
+        f"{noaff.get('warm_hit_ratio')} (no-affinity baseline)",
+    )
+    check(
+        "router.zero_lost_jobs_across_kill",
+        inv.get("zero_lost_jobs_across_kill") is True,
+        f"replica {kill.get('killed_replica')} SIGKILLed mid-trace: "
+        f"{kill.get('lost_jobs')} lost, {kill.get('rerouted_jobs')} "
+        "re-routed to completion",
+    )
+    check(
+        "router.parity_across_legs",
+        inv.get("parity_across_legs") is True,
+        "same-spec artifacts byte-identical across single/noaff/"
+        "affinity/kill legs",
+    )
+    check(
+        "router.no_leg_lost_jobs",
+        inv.get("no_leg_lost_jobs") is True,
+        f"p99 latency: single {legs.get('single', {}).get('p99_latency_s')}s, "
+        f"no-affinity {noaff.get('p99_latency_s')}s, "
+        f"affinity {aff.get('p99_latency_s')}s",
+    )
+
+
+def run_gate(
+    workdir: str, checks: list, scheduler: bool = True, router: bool = True
+) -> None:
     """Run the bench smokes + the trace-assembly leg; append
     (name, ok, detail) rows.  ``scheduler=False`` skips the elastic
     scheduler leg (two 2-process jax pods, minutes-scale — the tier-1
     smoke test skips it; the lease invariants stay tier-1-covered by
-    ``tests/test_leases.py`` and ``fault_soak``'s lease case)."""
+    ``tests/test_leases.py`` and ``fault_soak``'s lease case);
+    ``router=False`` likewise skips the fleet-router leg (seven jax
+    replica processes; tier-1 covers the same invariants in-process via
+    ``tests/test_fleet_serve.py``)."""
     import feed_bench
     import fetch_bench
     import flight_overhead
@@ -647,6 +702,8 @@ def run_gate(workdir: str, checks: list, scheduler: bool = True) -> None:
     run_fleet_leg(workdir, check)
     if scheduler:
         run_scheduler_leg(workdir, check)
+    if router:
+        run_router_leg(workdir, check)
 
     # -- flight recorder (ring + sampler overhead) ------------------------
     base = json.loads(FLIGHT_BASELINE.read_text())
@@ -693,6 +750,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="skip the elastic scheduler leg (two 2-process "
                     "jax pods, minutes-scale; the tier-1 smoke test "
                     "passes this — CLI gate runs carry the leg)")
+    ap.add_argument("--skip-router", action="store_true",
+                    help="skip the serving-fleet router leg (seven jax "
+                    "replica processes, minutes-scale; the tier-1 smoke "
+                    "test passes this — CLI gate runs carry the leg)")
     args = ap.parse_args(argv)
 
     for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE,
@@ -705,7 +766,11 @@ def main(argv: list[str] | None = None) -> int:
     Path(workdir).mkdir(parents=True, exist_ok=True)
     checks: list = []
     try:
-        run_gate(workdir, checks, scheduler=not args.skip_scheduler)
+        run_gate(
+            workdir, checks,
+            scheduler=not args.skip_scheduler,
+            router=not args.skip_router,
+        )
     finally:
         if args.keep is None:
             shutil.rmtree(workdir, ignore_errors=True)
