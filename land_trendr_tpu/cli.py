@@ -38,6 +38,12 @@ from land_trendr_tpu.runtime.manifest import ARTIFACT_COMPRESS
 __all__ = ["main", "build_parser"]
 
 
+def _auto_int(s: str):
+    """Tunable-knob flag values: an integer or the 'auto' sentinel (the
+    tuning-store resolution — README §Autotuning)."""
+    return s if s == "auto" else int(s)
+
+
 def _add_param_flags(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("algorithm parameters (reference names)")
     g.add_argument("--params-json", type=str, default=None,
@@ -85,7 +91,11 @@ def build_parser() -> argparse.ArgumentParser:
     seg.add_argument("--index", default="nbr", choices=INDEX_NAMES,
                      help="index driving the segmentation")
     seg.add_argument("--ftv", default="", help="comma-separated FTV indices")
-    seg.add_argument("--tile-size", type=int, default=512)
+    seg.add_argument("--tile-size", type=_auto_int, default=512,
+                     help="tile edge in pixels, or 'auto' (resolve "
+                     "through --tune-store-dir's profile; with no "
+                     "profile, 'auto' falls back to the LIBRARY default "
+                     "256, not this flag's 512)")
     seg.add_argument("--workdir", default="lt_work")
     seg.add_argument("--out-dir", default="lt_out")
     seg.add_argument("--no-resume", action="store_true",
@@ -108,7 +118,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="force the packed fetch path even on CPU "
                           "backends (where np.asarray is zero-copy and "
                           "auto keeps the per-product path)")
-    seg.add_argument("--fetch-depth", type=int, default=2,
+    seg.add_argument("--fetch-depth", type=_auto_int, default=2,
                      help="bound on in-flight async packed fetches: tile "
                           "i's readback lands while tiles up to "
                           "i+fetch_depth compute (raise on high-latency "
@@ -125,7 +135,7 @@ def build_parser() -> argparse.ArgumentParser:
                           "backends (where device_put is near zero-copy "
                           "and auto keeps the per-array path); "
                           "incompatible with --mesh")
-    seg.add_argument("--upload-depth", type=int, default=2,
+    seg.add_argument("--upload-depth", type=_auto_int, default=2,
                      help="bound on in-flight async packed uploads: up "
                           "to this many fed tiles cross the link while "
                           "the tile ahead computes (raise on "
@@ -163,20 +173,21 @@ def build_parser() -> argparse.ArgumentParser:
                      help="segmentation kernel: auto picks the Pallas "
                           "family kernel on TPU backends (round-4 measured "
                           "default), XLA elsewhere")
-    seg.add_argument("--feed-workers", type=int, default=1,
+    seg.add_argument("--feed-workers", type=_auto_int, default=1,
                      help="background tile-feed threads over the threaded "
                      "native gather (~4.1M px/s each; ~3 sustain the 10M "
                      "px/s target); prefetch depth is feed_workers+1")
-    seg.add_argument("--feed-cache-mb", type=int, default=256,
+    seg.add_argument("--feed-cache-mb", type=_auto_int, default=256,
                      help="decoded-block cache budget (MiB) for the "
                      "windowed feed path: tile windows that revisit a "
                      "compressed TIFF block (tile edges, --lazy re-reads, "
                      "resume passes) decode it once; 0 disables the cache "
                      "and reproduces the uncached codec byte for byte")
-    seg.add_argument("--decode-workers", type=int, default=0,
+    seg.add_argument("--decode-workers", type=_auto_int, default=0,
                      help="feed-decode threads (native codec AND the NumPy "
-                     "fallback share this knob): 0 = auto, 1 = serial, "
-                     "N = N threads")
+                     "fallback share this knob): 0 = codec auto-threading, "
+                     "1 = serial, N = N threads, 'auto' = tuning-store "
+                     "resolution")
     seg.add_argument("--no-feed-readahead", action="store_true",
                      help="disable the feed pool's next-tile block-decode "
                      "hint (only meaningful with --lazy and a non-zero "
@@ -330,11 +341,20 @@ def build_parser() -> argparse.ArgumentParser:
                      "(decimal or 0x hex; default: the C2 fill/cloud/shadow "
                      f"set, 0x{DEFAULT_QA_REJECT:x})")
     seg.add_argument("--chunk-px", default=262_144, metavar="N",
-                     type=lambda s: None if s.lower() == "none" else int(s),
+                     type=lambda s: (
+                         None if s.lower() == "none"
+                         else s if s == "auto" else int(s)
+                     ),
                      help="transient-HBM bound: tiles with more pixels run "
                      "the segmentation through the chunked kernel; 'none' "
                      "disables chunking (the kernel working set then grows "
-                     "with the full tile)")
+                     "with the full tile); 'auto' resolves through the "
+                     "tuning store")
+    seg.add_argument("--tune-store-dir", default=None, metavar="DIR",
+                     help="on-disk tuning store (lt tune's output) the "
+                     "'auto' knob values resolve through at run start; "
+                     "key miss or no DIR = hardcoded defaults, "
+                     "byte-identical behavior (README §Autotuning)")
     seg.add_argument("--metrics-interval-s", type=float, default=5.0,
                      metavar="SEC",
                      help="with --telemetry: metrics.prom refresh period "
@@ -477,6 +497,11 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--ingest-store-dir", default=None, metavar="DIR",
                      help="store directory override (default "
                      "WORKDIR/ingest_store)")
+    srv.add_argument("--tune-store-dir", default=None, metavar="DIR",
+                     help="shared tuning store (lt tune's output): every "
+                     "job's 'auto' knobs resolve through it, so the "
+                     "whole replica runs tuned; per-job explicit knobs "
+                     "still win (README §Autotuning)")
     srv.add_argument("--no-telemetry", action="store_true",
                      help="disable the server events/metrics stream AND "
                      "per-job run telemetry (on by default in serve "
@@ -635,6 +660,42 @@ def build_parser() -> argparse.ArgumentParser:
                      help="deterministic fault injection for soak runs "
                      "(router.forward / replica.health seams); "
                      "production routers leave this unset")
+
+    tun = sub.add_parser(
+        "tune",
+        help="autotune the execution knobs: run short per-device "
+        "calibration probes (feed/decode/upload/fetch/dispatch groups), "
+        "persist the winning profile to the on-disk tuning store keyed "
+        "by (device kind, backend, scene shape class), and report it; a "
+        "key already in the store reloads with ZERO probes "
+        "(README §Autotuning)",
+    )
+    tun.add_argument("--store-dir", default="lt_tune_store", metavar="DIR",
+                     help="tuning-store directory the profile persists "
+                     "to / reloads from (point runs and serve replicas "
+                     "at it via --tune-store-dir)")
+    tun.add_argument("--shape", default="512,512,40", metavar="H,W,NY",
+                     help="scene shape class to tune for (height, width, "
+                     "years — bucketed coarsely, so a representative "
+                     "scene stands in for the fleet's workload)")
+    tun.add_argument("--groups", default=None, metavar="G1,G2,...",
+                     help="probe only these knob groups (feed, decode, "
+                     "upload, fetch, dispatch); unnamed groups keep "
+                     "their default knobs")
+    tun.add_argument("--reps", type=int, default=3,
+                     help="timing reps per candidate (median taken; a "
+                     "clearly-losing candidate is cut off after one)")
+    tun.add_argument("--smoke", action="store_true",
+                     help="seconds-scale probe workloads (CI tier)")
+    tun.add_argument("--retune", action="store_true",
+                     help="probe even when the store already holds this "
+                     "key's profile (and overwrite it)")
+    tun.add_argument("--dry-run", action="store_true",
+                     help="probe and report, write NOTHING to the store")
+    tun.add_argument("--workdir", default=None, metavar="DIR",
+                     help="also write tune telemetry (events.jsonl with "
+                     "tune_probe/tune_profile, lt_tune_* metrics) under "
+                     "DIR")
 
     par = sub.add_parser("params", help="print default LTParams JSON")
     _add_param_flags(par)
@@ -813,6 +874,89 @@ def _change_filter_from_args(args, prefix: str = ""):
     )
 
 
+def _run_tune(args: argparse.Namespace) -> int:
+    """``lt tune``: probe (or reload), persist unless --dry-run, report."""
+    import time as _time
+
+    from land_trendr_tpu.tune import TuningStore, autotune
+
+    try:
+        h, w, ny = (int(v) for v in args.shape.split(","))
+    except ValueError:
+        print(f"error: --shape {args.shape!r} is not H,W,NY", file=sys.stderr)
+        return 2
+    groups = (
+        tuple(g.strip() for g in args.groups.split(",") if g.strip())
+        if args.groups else None
+    )
+    telemetry = None
+    if args.workdir:
+        from land_trendr_tpu.obs import Telemetry
+
+        telemetry = Telemetry(args.workdir, fingerprint="tune")
+    t0 = _time.perf_counter()
+    status = "aborted"
+    try:
+        if telemetry is not None:
+            # the stream contract: every scope opens with run_start — a
+            # tune scope is a zero-tile run (impl "tune" names it)
+            telemetry.run_start(
+                fingerprint="tune", process_index=0, process_count=1,
+                tiles_total=0, tiles_todo=0, tiles_skipped_resume=0,
+                mesh_devices=1, impl="tune",
+            )
+        try:
+            profile = autotune(
+                args.store_dir,
+                height=h, width=w, n_years=ny,
+                groups=groups,
+                reps=args.reps,
+                smoke=args.smoke,
+                retune=args.retune,
+                persist=not args.dry_run,
+                telemetry=telemetry,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        status = "ok"
+    finally:
+        if telemetry is not None:
+            wall = _time.perf_counter() - t0
+            try:
+                telemetry.run_done(
+                    status, tiles_done=0, pixels=0,
+                    wall_s=round(wall, 3), px_per_s=0.0, fit_rate=0.0,
+                )
+            finally:
+                # a failed terminal emit (full disk) must not leak the
+                # exporter thread / event fd
+                telemetry.close()
+    report = {
+        "key": profile["key"],
+        "source": profile["source"],
+        "probes": 0 if profile["source"] == "store" else profile["probes"],
+        "knobs": profile["knobs"],
+        "groups": {
+            g: {
+                k: r[k]
+                for k in ("ok", "probes", "default_s", "best_s", "speedup",
+                          "error", "knobs")
+                if k in r
+            }
+            for g, r in profile.get("groups", {}).items()
+        },
+        "store_dir": args.store_dir,
+        "persisted": not args.dry_run and profile["source"] == "probed",
+    }
+    if not args.dry_run and profile["source"] == "probed":
+        report["profile_path"] = TuningStore(args.store_dir).path_for(
+            profile["key"]
+        )
+    print(json.dumps(report, indent=2))
+    return 0
+
+
 def _run_info(args) -> int:
     """Header-only raster inspection; one JSON document for all paths."""
     import numpy as np
@@ -900,6 +1044,7 @@ def main(argv: list[str] | None = None) -> int:
                 decode_workers=args.decode_workers,
                 ingest_store_mb=args.ingest_store_mb,
                 ingest_store_dir=args.ingest_store_dir,
+                tune_store_dir=args.tune_store_dir,
                 telemetry=not args.no_telemetry,
                 metrics_port=args.metrics_port,
                 metrics_host=args.metrics_host,
@@ -1030,6 +1175,9 @@ def main(argv: list[str] | None = None) -> int:
             pass
         return 0
 
+    if args.cmd == "tune":
+        return _run_tune(args)
+
     if args.cmd == "info":
         return _run_info(args)
 
@@ -1060,12 +1208,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "segment":
         # deferred: importing jax before arg validation makes --help slow
         from land_trendr_tpu.runtime import (
+            Run,
             RunConfig,
             StallError,
             TileRetriesExhausted,
             assemble_outputs,
             load_stack_dir,
-            run_stack,
         )
 
         ftv = tuple(s for s in args.ftv.split(",") if s)
@@ -1134,6 +1282,7 @@ def main(argv: list[str] | None = None) -> int:
                 feed_workers=args.feed_workers,
                 feed_cache_mb=args.feed_cache_mb,
                 decode_workers=args.decode_workers,
+                tune_store_dir=args.tune_store_dir,
                 feed_readahead=not args.no_feed_readahead,
                 reject_bits=args.reject_bits,
                 chunk_px=args.chunk_px,
@@ -1226,14 +1375,19 @@ def main(argv: list[str] | None = None) -> int:
         # branch on these): 2 config/usage error, 3 tile(s) exhausted
         # retries / quarantined (retryable: resume re-attempts exactly the
         # failed tiles), 4 stall-watchdog abort (investigate the device)
+        # an explicit Run (not the run_stack one-shot): its RESOLVED
+        # config — "auto" knobs pulled from the tuning store exactly once
+        # at construction — is what assembly below must reuse, so a store
+        # re-probed mid-run cannot re-resolve the sentinels differently
+        run = Run(stack, cfg, mesh=mesh)
         try:
             if args.trace:
                 from land_trendr_tpu.utils.profiling import trace
 
                 with trace(args.trace):
-                    summary = run_stack(stack, cfg, mesh=mesh)
+                    summary = run.execute()
             else:
-                summary = run_stack(stack, cfg, mesh=mesh)
+                summary = run.execute()
         except StallError as e:
             print(f"error: {e}", file=sys.stderr)
             return 4
@@ -1252,11 +1406,11 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 3
-        paths = assemble_outputs(stack, cfg)
+        paths = assemble_outputs(stack, run.cfg)
         if change_filt is not None and args.change_mmu > 1:
             from land_trendr_tpu.ops.change import sieve_change_rasters
 
-            sieve_change_rasters(cfg.out_dir, args.change_mmu)
+            sieve_change_rasters(run.cfg.out_dir, args.change_mmu)
         print(json.dumps({"summary": summary, "outputs": paths}, indent=2))
         return 0
 

@@ -63,6 +63,7 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
     import numpy as np
 
 __all__ = [
+    "config_snapshot",
     "configure",
     "detach_store",
     "store_bytes_snapshot",
@@ -205,6 +206,19 @@ def configure(
         if _budget_bytes == 0:
             _entries.clear()
             _reset_bytes_locked()
+
+
+def config_snapshot() -> dict:
+    """The current process-cache configuration, in :func:`configure`'s
+    keyword shape — ``configure(**config_snapshot())`` restores it.  How
+    a transient reconfigurer (the autotuner's decode probe) guarantees it
+    never skews the run behind it."""
+    with _lock:
+        return {
+            "budget_bytes": _budget_bytes,
+            "workers": _workers,
+            "store": _store,
+        }
 
 
 def _reset_bytes_locked() -> None:

@@ -304,6 +304,22 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # the pod burn-rate that drove it, ``replicas`` the pool size AFTER
     # the action was initiated.  Additive.
     "scale_decision": {"direction": str, "burn": _NUM, "replicas": int},
+    # --- autotuned execution profiles (land_trendr_tpu/tune) -------------
+    # one knob-group calibration probe: ``ok=false`` means the group's
+    # probe failed (the tune.probe fault seam or a real error) and was
+    # SKIPPED — its knobs fell back to defaults; ``probes`` counts the
+    # timed candidate reps the group ran (0 on a skipped group; >= 1 on
+    # a succeeded one — the value lint pins it).  Additive event type.
+    "tune_probe": {"group": str, "ok": bool, "probes": int, "wall_s": _NUM},
+    # one profile verdict: ``source`` is "store" (reloaded on sight —
+    # probes is 0 BY DEFINITION, the value lint pins it), "probed" (a
+    # key miss or --retune ran the probes) or "defaults" (no store / no
+    # profile for the key: the hardcoded knobs, byte-identical
+    # behavior).  ``key`` is the store key
+    # "device_kind|backend|shape_class" ("" for defaults).  Emitted by
+    # `lt tune` and by every Run whose config resolved "auto" knobs.
+    # Additive event type.
+    "tune_profile": {"key": str, "source": str, "probes": int},
 }
 
 #: well-known OPTIONAL fields: type-checked when present, never required
@@ -390,6 +406,8 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     "replica_up": {"base": str, "spawned": bool},
     "replica_down": {"base": str, "inflight": int},
     "scale_decision": {"replica": str, "queue_depth": int},
+    "tune_probe": {"speedup": _NUM, "error": str, "knobs": dict},
+    "tune_profile": {"age_s": _NUM, "knobs": dict, "groups": int},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

@@ -331,6 +331,29 @@ class Telemetry:
         self._is_bytes = r.gauge(
             "lt_ingest_store_bytes", "persistent store occupancy (bytes)"
         )
+        # autotuned execution profiles (land_trendr_tpu/tune): probe
+        # counts advanced per tune_probe emit, store verdicts per
+        # tune_profile emit
+        self._tn_probes = r.counter(
+            "lt_tune_probes_total",
+            "calibration probe reps run by the autotuner",
+        )
+        self._tn_failures = r.counter(
+            "lt_tune_probe_failures_total",
+            "knob-group probes that failed and were skipped (defaults kept)",
+        )
+        self._tn_store_hits = r.counter(
+            "lt_tune_store_hits_total",
+            "tuning-store profile reloads (zero probes run)",
+        )
+        self._tn_store_misses = r.counter(
+            "lt_tune_store_misses_total",
+            "tuning-store key misses (probed or fell back to defaults)",
+        )
+        self._tn_age = r.gauge(
+            "lt_tune_profile_age_seconds",
+            "age of the resolved tuning profile (0 = freshly probed)",
+        )
         if fingerprint:
             r.gauge(
                 "lt_run_info",
@@ -756,6 +779,65 @@ class Telemetry:
         self._is_corrupt.inc(fields.get("corrupt_dropped", 0))
         if "bytes" in fields:
             self._is_bytes.set(fields["bytes"])
+
+    def tune_probe(
+        self,
+        group: str,
+        ok: bool,
+        probes: int,
+        wall_s: float,
+        speedup: "float | None" = None,
+        error: "str | None" = None,
+        knobs: "dict | None" = None,
+    ) -> None:
+        """One autotuner knob-group probe verdict (tune/autotune).
+
+        ``ok=False`` means the group's probe failed — the tune.probe
+        fault seam or a real error — and its knobs fell back to
+        defaults; the tuner and any run behind it live on.
+        """
+        self.events.emit(
+            "tune_probe",
+            group=group,
+            ok=bool(ok),
+            probes=int(probes),
+            wall_s=round(float(wall_s), 6),
+            **({"speedup": round(float(speedup), 3)} if speedup is not None else {}),
+            **({"error": error} if error is not None else {}),
+            **({"knobs": dict(knobs)} if knobs is not None else {}),
+        )
+        self._tn_probes.inc(int(probes))
+        if not ok:
+            self._tn_failures.inc()
+
+    def tune_profile(
+        self,
+        key: str,
+        source: str,
+        probes: int,
+        age_s: "float | None" = None,
+        knobs: "dict | None" = None,
+        groups: "int | None" = None,
+    ) -> None:
+        """One tuning-profile verdict: reloaded from the store (zero
+        probes), freshly probed, or hardcoded defaults (no profile).
+        Emitted by ``lt tune`` and by every Run whose config resolved
+        ``"auto"`` knobs."""
+        self.events.emit(
+            "tune_profile",
+            key=key,
+            source=source,
+            probes=int(probes),
+            **({"age_s": round(float(age_s), 3)} if age_s is not None else {}),
+            **({"knobs": dict(knobs)} if knobs is not None else {}),
+            **({"groups": int(groups)} if groups is not None else {}),
+        )
+        if source == "store":
+            self._tn_store_hits.inc()
+        else:
+            self._tn_store_misses.inc()
+        if age_s is not None:
+            self._tn_age.set(float(age_s))
 
     def program_cache(self, stats: Mapping[str, Any]) -> None:
         """Fold one run's warm-program-cache verdict into the stream.

@@ -65,6 +65,7 @@ from land_trendr_tpu.runtime.manifest import (
     run_fingerprint,
 )
 from land_trendr_tpu.runtime.stack import RasterStack
+from land_trendr_tpu.tune import resolve_config
 from land_trendr_tpu.utils.profiling import StageTimer
 
 __all__ = [
@@ -207,7 +208,12 @@ class RunConfig:
     index: str = "nbr"
     ftv_indices: tuple[str, ...] = ()
     params: LTParams = LTParams()
-    tile_size: int = 256
+    #: scene tiling granularity (pixels per side).  ``"auto"`` resolves
+    #: through the tuning store at Run construction (see
+    #: ``tune_store_dir``) — like every tunable knob below, an explicit
+    #: value always wins and ``"auto"`` with no profile is the default.
+    #: Fingerprinted (via the resolved value): tiling defines artifacts.
+    tile_size: "int | str" = 256
     workdir: str = "lt_work"
     out_dir: str = "lt_out"
     resume: bool = True
@@ -322,8 +328,9 @@ class RunConfig:
     #: by one packed tile buffer plus one fed input (kept for the retry
     #: ladder — an async-fetch device error re-dispatches from it) per
     #: depth step; 2 gives full compute/readback overlap for a
-    #: steady-state pipeline.
-    fetch_depth: int = 2
+    #: steady-state pipeline.  ``"auto"`` resolves through the tuning
+    #: store (a pure execution knob — never fingerprinted).
+    fetch_depth: "int | str" = 2
     #: host→device upload strategy (:mod:`land_trendr_tpu.runtime.feed`):
     #: ``"auto"`` (default) packs every tile's fed band/QA arrays into
     #: ONE contiguous host buffer and issues a single asynchronous
@@ -344,7 +351,8 @@ class RunConfig:
     #: by one packed buffer plus one fed input (retained for the retry
     #: ladder — an upload error surfacing through the async wait
     #: re-dispatches from it on the per-array path) per depth step.
-    upload_depth: int = 2
+    #: ``"auto"`` resolves through the tuning store (execution knob).
+    upload_depth: "int | str" = 2
     #: persistent decoded-block store budget (MiB) for the windowed feed
     #: path (:mod:`land_trendr_tpu.io.blockstore`): decoded TIFF blocks
     #: spill to a memory-mapped on-disk column store under the workdir,
@@ -389,7 +397,8 @@ class RunConfig:
     #: px/s (HOSTPATH_r03.json feed.native), so the 10M px/s north star
     #: needs ~3; the default 1 still overlaps the NEXT tile's gather with
     #: the current tile's device wait (prefetch depth feed_workers + 1).
-    feed_workers: int = 1
+    #: ``"auto"`` resolves through the tuning store (execution knob).
+    feed_workers: "int | str" = 1
     #: decoded-block cache budget (MiB) for the windowed feed path
     #: (:mod:`land_trendr_tpu.io.blockcache`): tile windows that revisit a
     #: compressed TIFF block — tile-boundary overlap, ``LazyBandCube``
@@ -398,12 +407,15 @@ class RunConfig:
     #: cache and reproduces the uncached codec byte for byte.  The cache
     #: is process-wide (like GDAL's block cache) and an execution fact —
     #: NOT fingerprinted; run_stack (re)configures it per run.
-    feed_cache_mb: int = 256
+    #: ``"auto"`` resolves through the tuning store.
+    feed_cache_mb: "int | str" = 256
     #: feed-decode threads (the ``io.blockcache`` knob, governing both
     #: the native codec's C++ threading and the NumPy path's shared
     #: pool): 0 = auto (native auto-threads; NumPy min(8, cores)),
-    #: 1 = fully serial decode, N = N threads.
-    decode_workers: int = 0
+    #: 1 = fully serial decode, N = N threads.  ``"auto"`` resolves
+    #: through the tuning store (execution knob; distinct from 0, the
+    #: codec's own auto-threading).
+    decode_workers: "int | str" = 0
     #: readahead: the feed pool hints the NEXT planned tile's block set
     #: (``LazyBandCube.prefetch_window``) so its decode overlaps the
     #: current tile's device wait.  Only effective with a file-backed
@@ -420,8 +432,10 @@ class RunConfig:
     #: transient-HBM bound for large tiles: tiles with more pixels than this
     #: run the segmentation through the chunked kernel (the kernel's working
     #: set is linear in the pixel axis — a 1024² tile at 40 years exceeds
-    #: what a 256² tile needs by 16×).  ``None`` disables chunking.
-    chunk_px: int | None = 262_144
+    #: what a 256² tile needs by 16×).  ``None`` disables chunking;
+    #: ``"auto"`` resolves through the tuning store.  Fingerprinted (via
+    #: the resolved value): chunking changes f32 fusion knife-edges.
+    chunk_px: "int | str | None" = 262_144
     #: segmentation kernel implementation: "auto" (Pallas family kernel on
     #: a TPU backend, XLA elsewhere — the round-4 measured default, ~3.3×
     #: faster on v5 lite with identical decisions), "pallas", or "xla".
@@ -476,8 +490,35 @@ class RunConfig:
     #: ``<workdir>/telemetry``) — point a pod's processes (or several
     #: runs) at one directory to aggregate them as one fleet
     telemetry_dir: "str | None" = None
+    #: on-disk tuning store (:mod:`land_trendr_tpu.tune`) the ``"auto"``
+    #: knob sentinels resolve through at Run construction: the
+    #: ``lt tune``-probed profile for this ``(device kind, backend,
+    #: scene shape class)`` supplies the knob values; a key miss (or
+    #: ``None``, the default) falls back to the hardcoded defaults —
+    #: byte-identical behavior.  Point a fleet's replicas at one shared
+    #: store so the whole fleet runs tuned.  Resolution is a
+    #: deterministic store read — never a probe — so it is not an
+    #: execution hazard; the RESOLVED knob values are what
+    #: fingerprinting sees.
+    tune_store_dir: "str | None" = None
 
     def __post_init__(self) -> None:
+        from land_trendr_tpu.tune import AUTO
+
+        for name in (
+            "tile_size", "chunk_px", "fetch_depth", "upload_depth",
+            "feed_workers", "decode_workers", "feed_cache_mb",
+        ):
+            v = getattr(self, name)
+            if isinstance(v, str) and v != AUTO:
+                # "auto" is the ONE string spelling (the tuning-store
+                # sentinel); anything else is a config typo, caught at
+                # exit-2 time like every other validation below
+                raise ValueError(
+                    f"{name}={v!r} must be an integer or 'auto'"
+                )
+        if isinstance(self.tile_size, int) and self.tile_size < 1:
+            raise ValueError(f"tile_size={self.tile_size} must be >= 1")
         # fail fast: an invalid choice must not surface only at
         # assemble_outputs, after the whole run's compute
         if self.out_compress not in ("deflate", "lzw", "none"):
@@ -506,7 +547,7 @@ class RunConfig:
             self.impl == "pallas"  # "auto" is validated in run_stack once
             # the backend is known — resolving it here would initialise a
             # JAX client as a side effect of constructing a config
-            and self.chunk_px is not None
+            and isinstance(self.chunk_px, int)  # "auto" re-validates resolved
             and self.chunk_px > PALLAS_BLOCK
             and self.chunk_px % PALLAS_BLOCK
         ):
@@ -516,7 +557,7 @@ class RunConfig:
                 f"chunk_px={self.chunk_px} must be a multiple of "
                 f"{PALLAS_BLOCK} (the Pallas block) when impl='pallas'"
             )
-        if self.chunk_px is not None and self.chunk_px < 1:
+        if isinstance(self.chunk_px, int) and self.chunk_px < 1:
             # 0 is NOT the disable spelling (None is): a zero chunk would
             # divide-by-zero deep in the chunked kernel, minutes into a run
             raise ValueError(
@@ -528,14 +569,14 @@ class RunConfig:
                 f"fetch_packed={self.fetch_packed!r} not one of True, "
                 "False, 'auto'"
             )
-        if self.fetch_depth < 1:
+        if isinstance(self.fetch_depth, int) and self.fetch_depth < 1:
             raise ValueError(f"fetch_depth={self.fetch_depth} must be >= 1")
         if self.upload_packed not in (True, False, "auto"):
             raise ValueError(
                 f"upload_packed={self.upload_packed!r} not one of True, "
                 "False, 'auto'"
             )
-        if self.upload_depth < 1:
+        if isinstance(self.upload_depth, int) and self.upload_depth < 1:
             raise ValueError(f"upload_depth={self.upload_depth} must be >= 1")
         if self.ingest_store_mb < 0:
             raise ValueError(
@@ -549,13 +590,13 @@ class RunConfig:
             )
         if self.write_workers < 1:
             raise ValueError(f"write_workers={self.write_workers} must be >= 1")
-        if self.feed_workers < 1:
+        if isinstance(self.feed_workers, int) and self.feed_workers < 1:
             raise ValueError(f"feed_workers={self.feed_workers} must be >= 1")
-        if self.feed_cache_mb < 0:
+        if isinstance(self.feed_cache_mb, int) and self.feed_cache_mb < 0:
             raise ValueError(
                 f"feed_cache_mb={self.feed_cache_mb} must be >= 0 (0 = off)"
             )
-        if self.decode_workers < 0:
+        if isinstance(self.decode_workers, int) and self.decode_workers < 0:
             raise ValueError(
                 f"decode_workers={self.decode_workers} must be >= 0 (0 = auto)"
             )
@@ -948,6 +989,16 @@ class Run:
         shared_cache: bool = False,
         flight=None,
     ) -> None:
+        # "auto" knob resolution (land_trendr_tpu/tune): any RunConfig
+        # field carrying the "auto" sentinel is replaced HERE, before
+        # anything reads a knob, from the tuning store's profile for
+        # (device kind, backend, scene shape class) — or the hardcoded
+        # defaults when no profile exists (byte-identical behavior).
+        # Deterministic store READ, never a probe; ``tune_info`` is the
+        # tune_profile event execute() emits (None = nothing was auto).
+        cfg, self.tune_info = resolve_config(
+            cfg, scene_shape=(*stack.shape, stack.n_years)
+        )
         self.stack = stack
         self.cfg = cfg
         self.mesh = mesh
@@ -1112,6 +1163,10 @@ class Run:
             "stragglers": self.straggler.stats()["stragglers"],
             "tiles_quarantined": len(self.quarantined),
             "job_id": self.job_id,
+            # which tuning profile (key + age + source) this run resolved
+            # its "auto" knobs through — how lt_fleet / lt top --dir make
+            # a mixed tuned/untuned fleet visible instead of silent
+            **({"tune": self.tune_info} if self.tune_info else {}),
         }
 
     def _dump_flight(self) -> "str | None":
@@ -1924,6 +1979,10 @@ class Run:
                     )
                 except OSError as exc:
                     log.warning("manifest clock-anchor append failed: %s", exc)
+                if self.tune_info is not None:
+                    # which profile this run's "auto" knobs resolved
+                    # through (probes=0 always: resolution never probes)
+                    telemetry.tune_profile(**self.tune_info)
             except BaseException:
                 # a failed run_start emit surfaces before the try/finally
                 # below owns shutdown — unwind here or the exporter thread /
@@ -2534,6 +2593,9 @@ class Run:
             # duration exceeded straggler_k x the rolling median
             "stragglers": self.straggler.stats()["stragglers"],
         }
+        if self.tune_info is not None:
+            # which tuning profile resolved this run's "auto" knobs
+            summary["tune"] = self.tune_info
         if lease_q is not None:
             # elastic scheduling rollup: acquisitions, steals,
             # speculative re-leases and their win count (first durable
@@ -2673,6 +2735,13 @@ def assemble_outputs(stack: RasterStack, cfg: RunConfig) -> dict[str, str]:
     One multi-band GeoTIFF per product; band axis is the per-pixel vector
     axis (vertex slot / segment slot / year).  Returns product → path.
     """
+    # "auto" fallback for STANDALONE assembly (a later process assembling
+    # a finished workdir).  In-process callers (the CLI, the serve job
+    # loop) pass the Run's already-RESOLVED config instead — a store
+    # re-probed between run and assembly must not resolve the same
+    # sentinel to different values (a fingerprint mismatch here reads as
+    # "tiles missing" after a fully successful run).
+    cfg, _ = resolve_config(cfg, scene_shape=(*stack.shape, stack.n_years))
     tiles = plan_tiles(*stack.shape, cfg.tile_size)
     manifest = TileManifest(cfg.workdir, cfg.fingerprint(stack))
     done = manifest.open(resume=True)

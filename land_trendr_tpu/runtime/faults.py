@@ -63,6 +63,10 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     replica's probe reads as FAILED — enough
                     consecutive fires mark the replica unready without
                     failing any accepted job
+``tune.probe``      autotuner calibration probe (``tune/autotune.py``):
+                    the knob group's probe fails and is SKIPPED — its
+                    knobs fall back to defaults (``tune_probe`` event
+                    ``ok=false``); the tuner and the run behind it live
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -138,6 +142,7 @@ SEAMS = (
     "history.append",
     "router.forward",
     "replica.health",
+    "tune.probe",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -165,6 +170,7 @@ _DEFAULT_KIND = {
     "history.append": "io",
     "router.forward": "io",
     "replica.health": "fire",
+    "tune.probe": "runtime",
 }
 
 

@@ -53,6 +53,7 @@ import functools
 import sys
 import threading
 import time
+import warnings
 from typing import TYPE_CHECKING, NamedTuple
 
 import jax
@@ -63,6 +64,16 @@ from land_trendr_tpu.runtime import faults
 
 if TYPE_CHECKING:  # pragma: no cover - type-only import (cycle with driver)
     from land_trendr_tpu.runtime.driver import RunConfig
+
+# unpack_inputs donates its word buffer (see its docstring); on backends
+# where donation is unusable (CPU shares host memory) JAX warns once per
+# compile.  Expected and not actionable wherever this module is used, so
+# the one message-targeted filter installs at import — NOT per call: the
+# filter list is process-global and arrays() runs once per tile on the
+# driver's hot path.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 __all__ = [
     "UploadPlan",
@@ -164,7 +175,7 @@ def _from_words(words: jnp.ndarray, dtype: str, n: int) -> jnp.ndarray:
     return jax.lax.bitcast_convert_type(words, dtype).reshape(-1)[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("plan",))
+@functools.partial(jax.jit, static_argnames=("plan",), donate_argnames=("words",))
 def unpack_inputs(
     words: jnp.ndarray, plan: UploadPlan
 ) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
@@ -173,6 +184,17 @@ def unpack_inputs(
     Compiles once per run — every tile, edge tiles included, shares the
     padded feed pixel count.  XLA fuses the bitcasts/slices, so the
     unpack is effectively free next to the transfer it replaces.
+
+    The packed word buffer is **donated** (SNIPPETS.md [2]'s
+    ``donate_argnames`` dispatch-path pattern): it is dead the moment
+    the unpack reads it — each tile packs a fresh buffer, and the retry
+    ladder re-dispatches from the retained HOST inputs, never from the
+    device words — so XLA may alias its HBM into the outputs instead of
+    holding packed + unpacked copies live per in-flight tile.  The
+    outputs are a bit-exact reinterpretation either way (the
+    ``tests/test_upload.py`` parity matrix pins it), and on backends
+    where donation is unusable (CPU shares host memory) XLA just keeps
+    the copy — behavior, not bytes, is what the hint changes.
     """
     offs, _total = _layout(plan)
     n = plan.px * plan.ny
@@ -262,6 +284,9 @@ class PackedUpload:
         jax.block_until_ready(self._words)
         t1 = time.perf_counter()
         dn, qa = unpack_inputs(self._words, plan=self._uploader.plan)
+        # the donated buffer is consumed: drop the handle so no later
+        # path can touch a deleted array
+        self._words = None
         stats.add(
             wait_s=t1 - t0, unpack_s=time.perf_counter() - t1, tiles=1
         )

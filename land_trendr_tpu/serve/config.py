@@ -77,6 +77,13 @@ class ServeConfig:
     ingest_store_mb: int = 0
     #: store directory override (default ``<workdir>/ingest_store``)
     ingest_store_dir: str | None = None
+    #: shared tuning store (:mod:`land_trendr_tpu.tune`, ``lt tune``'s
+    #: output): every job whose RunConfig carries ``"auto"`` knob
+    #: sentinels (and no store of its own) resolves them through this
+    #: store, so the whole replica — and a fleet of replicas pointed at
+    #: one directory — runs tuned.  Per-job explicit knobs always win;
+    #: ``None`` leaves ``"auto"`` resolving to the hardcoded defaults.
+    tune_store_dir: str | None = None
     #: server + per-job telemetry: the server writes its own
     #: ``events.jsonl`` scope (job lifecycle, admission, program-cache
     #: aggregate) and ``lt_serve_*`` metrics under ``workdir``; each

@@ -87,7 +87,7 @@ class JobRequest:
     index: str = "nbr"
     ftv: tuple[str, ...] = ()
     params: "dict | None" = None
-    tile_size: int = 256
+    tile_size: "int | str" = 256  # "auto" = tuning-store resolution
     products: "tuple[str, ...] | None" = None
     workdir: "str | None" = None  # default <serve workdir>/jobs/<id>/work
     out_dir: "str | None" = None  # default <serve workdir>/jobs/<id>/out
@@ -160,7 +160,15 @@ class JobRequest:
             raise ValueError(f"timeout_s={req.timeout_s} must be > 0")
         if req.deadline_s is not None and req.deadline_s <= 0:
             raise ValueError(f"deadline_s={req.deadline_s} must be > 0")
-        if req.tile_size < 1:
+        if isinstance(req.tile_size, str):
+            # the tuning-store sentinel: resolved at Run construction
+            # through the replica's shared store (README §Autotuning)
+            if req.tile_size != "auto":
+                raise ValueError(
+                    f"tile_size={req.tile_size!r} must be an integer or "
+                    "'auto'"
+                )
+        elif req.tile_size < 1:
             raise ValueError(f"tile_size={req.tile_size} must be >= 1")
         if req.max_retries < 0:
             raise ValueError(

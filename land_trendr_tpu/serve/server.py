@@ -43,6 +43,7 @@ under the job workdir with the ``job_id`` threaded onto every event.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import heapq
 import http.server
 import json
@@ -680,6 +681,10 @@ class SegmentationServer:
         self.store = None
         self.telemetry = None
         self._fault_plan = None
+        #: the tuning-profile resolution of the most recent job whose
+        #: config carried "auto" knobs (key + age + source) — the
+        #: /healthz + fleet-snapshot fact satellite tooling renders
+        self._tune_info: "dict | None" = None
         self._httpd = None
         self._http_thread = None
         self._dropbox_stop = threading.Event()
@@ -818,7 +823,10 @@ class SegmentationServer:
                 "jobs_total": len(self._jobs),
                 "jobs_terminal": self._terminal,
             }
+            tune_info = self._tune_info
         out: dict = {"progress": progress}
+        if tune_info is not None:
+            out["tune"] = tune_info
         tel = self.telemetry
         if tel is not None:
             out["alerts"] = tel.active_alerts()
@@ -952,6 +960,11 @@ class SegmentationServer:
                 # alone names no shapes, so a router could not rebuild
                 # its table from it
                 "warm_keys": list(self._warm_keys),
+                # which tuning profile (key/age/source) the last
+                # auto-knob job resolved through; None = no tuned job
+                # yet (or no store configured — the untuned half of a
+                # mixed fleet shows as exactly that)
+                "tune": self._tune_info,
             }
         snap["program_cache"] = self.programs.stats()
         # load-balancer-grade health facts ride /healthz directly so an
@@ -1186,6 +1199,13 @@ class SegmentationServer:
             cfg = req.to_run_config(
                 job.workdir, job.out_dir, telemetry=self.cfg.telemetry
             )
+            if self.cfg.tune_store_dir and cfg.tune_store_dir is None:
+                # the replica's shared tuning store: "auto" knobs in the
+                # job's config resolve through it (a job naming its OWN
+                # store keeps it — explicit wins, like the knobs)
+                cfg = dataclasses.replace(
+                    cfg, tune_store_dir=self.cfg.tune_store_dir
+                )
             stack = self._open_stack(req)
             run = Run(
                 stack,
@@ -1207,6 +1227,12 @@ class SegmentationServer:
                 ),
             )
             job.run = run
+            if run.tune_info is not None:
+                # which profile this replica's jobs resolve through —
+                # surfaced on /healthz and the fleet snapshot so a mixed
+                # tuned/untuned fleet is visible instead of silent
+                with self._lock:
+                    self._tune_info = dict(run.tune_info)
             summary = run.execute()
             # resuming needs the SAME manifest: fresh submissions get
             # fresh jobs/<id>/work dirs, so every retryable error spells
@@ -1222,7 +1248,10 @@ class SegmentationServer:
                 )
             else:
                 if req.assemble:
-                    outputs = assemble_outputs(stack, cfg)
+                    # the Run's RESOLVED config, not the submitted one: a
+                    # store re-probed mid-job must not re-resolve "auto"
+                    # knobs to different values at assembly time
+                    outputs = assemble_outputs(stack, run.cfg)
                 state = "done"
         except RunCancelled as e:
             state = "stalled" if job.timed_out else "cancelled"

@@ -1,0 +1,36 @@
+"""Autotuned execution profiles (ROADMAP item 4's autotuning half).
+
+Per-device calibration probes (:mod:`~land_trendr_tpu.tune.probes`), a
+persisted tuning store keyed by ``(device_kind, backend, scene shape
+class, schema)`` (:mod:`~land_trendr_tpu.tune.store`), and auto-resolved
+run knobs (:func:`~land_trendr_tpu.tune.autotune.resolve_config` — the
+``RunConfig`` ``"auto"`` sentinel's engine).
+"""
+
+from land_trendr_tpu.tune.autotune import (
+    AUTO,
+    KNOB_DEFAULTS,
+    TUNABLE_KNOBS,
+    autotune,
+    device_identity,
+    resolve_config,
+)
+from land_trendr_tpu.tune.store import (
+    TUNE_SCHEMA,
+    TuningStore,
+    profile_key,
+    shape_class,
+)
+
+__all__ = [
+    "AUTO",
+    "KNOB_DEFAULTS",
+    "TUNABLE_KNOBS",
+    "TUNE_SCHEMA",
+    "TuningStore",
+    "autotune",
+    "device_identity",
+    "profile_key",
+    "resolve_config",
+    "shape_class",
+]

@@ -1,0 +1,278 @@
+"""Autotuned execution profiles: probe, persist, resolve.
+
+The engine's knob space — ``tile_size``, ``chunk_px``, ``fetch_depth``,
+``upload_depth``, ``feed_workers``, ``decode_workers``,
+``feed_cache_mb`` — shipped hardcoded defaults tuned once by hand on one
+host.  This module closes ROADMAP item 4's autotuning half:
+
+* :func:`autotune` runs the staged calibration probes
+  (:mod:`~land_trendr_tpu.tune.probes`, one short probe per knob group,
+  coordinate-wise with median-of-reps timing and early cutoff) and
+  persists the winning profile to the on-disk
+  :class:`~land_trendr_tpu.tune.store.TuningStore` keyed by
+  ``(device_kind, backend, scene shape class, TUNE_SCHEMA)``.  A key
+  already in the store is **reloaded on sight with ZERO probes**
+  (``tune_profile`` event ``source="store"``, ``probes=0``); only a key
+  miss or ``retune=True`` probes again.
+* :func:`resolve_config` makes the knobs *resolve*: ``RunConfig`` fields
+  set to the ``"auto"`` sentinel pull their value from the loaded
+  profile at ``Run`` construction.  Explicit values ALWAYS win; with no
+  store (or no profile for the key) every ``"auto"`` resolves to the
+  hardcoded default — byte-identical to the pre-autotuner behavior.
+  Resolution never probes and never writes: it is a deterministic store
+  read, so two resolutions of the same key give identical knob values.
+
+Fault semantics (the ``tune.probe`` seam, :mod:`land_trendr_tpu.runtime.
+faults`): a probe failure — injected or real — skips THAT knob group
+(its knobs fall back to defaults, the ``tune_probe`` event carries
+``ok=false``) and never fails the tuner or skews the run behind it.
+
+Observability: ``telemetry`` (a :class:`~land_trendr_tpu.obs.telemetry.
+Telemetry`) receives one ``tune_probe`` event per probed group and one
+terminal ``tune_profile`` event per autotune/resolution, and advances
+the ``lt_tune_*`` instruments; ``None`` keeps the tuner silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+from land_trendr_tpu.tune.store import (
+    TUNE_SCHEMA,
+    TuningStore,
+    profile_key,
+    shape_class,
+)
+
+__all__ = [
+    "AUTO",
+    "KNOB_DEFAULTS",
+    "TUNABLE_KNOBS",
+    "autotune",
+    "device_identity",
+    "resolve_config",
+]
+
+#: the RunConfig sentinel ``"auto"`` fields resolve through the profile
+AUTO = "auto"
+
+#: every RunConfig field the tuner may own (the ISSUE's knob space minus
+#: the packed on/off strategies, which already carry their own "auto"
+#: backend resolution in runtime/feed + runtime/fetch)
+TUNABLE_KNOBS = (
+    "tile_size",
+    "chunk_px",
+    "fetch_depth",
+    "upload_depth",
+    "feed_workers",
+    "decode_workers",
+    "feed_cache_mb",
+)
+
+#: the hardcoded RunConfig defaults — what ``"auto"`` means with no
+#: profile.  Mirrors the dataclass defaults; ``tests/test_tune.py``
+#: asserts the two cannot drift (the config module cannot be imported
+#: here: runtime/driver imports this module for resolution).
+KNOB_DEFAULTS: dict[str, Any] = {
+    "tile_size": 256,
+    "chunk_px": 262_144,
+    "fetch_depth": 2,
+    "upload_depth": 2,
+    "feed_workers": 1,
+    "decode_workers": 0,
+    "feed_cache_mb": 256,
+}
+
+
+def device_identity() -> "tuple[str, str]":
+    """``(device_kind, backend)`` of this process's default JAX device —
+    the hardware half of the store key.  Imported lazily: resolution with
+    no ``"auto"`` fields (every pre-existing config) must not initialise
+    a backend as a side effect."""
+    import jax
+
+    backend = jax.default_backend()
+    try:
+        kind = jax.local_devices()[0].device_kind
+    except Exception:
+        kind = backend
+    return str(kind), str(backend)
+
+
+def autotune(
+    store_dir: str,
+    *,
+    height: int,
+    width: int,
+    n_years: int,
+    groups: "tuple[str, ...] | None" = None,
+    reps: int = 3,
+    smoke: bool = False,
+    retune: bool = False,
+    persist: bool = True,
+    telemetry=None,
+    device_kind: "str | None" = None,
+    backend: "str | None" = None,
+) -> dict:
+    """Probe (or reload) the profile for this device + scene class.
+
+    Returns the profile dict; ``profile["probes"] == 0`` means a store
+    hit served it without running anything.  ``persist=False`` is the
+    ``lt tune --dry-run`` contract: probe and report, write nothing.
+    ``groups`` restricts probing to a subset (unnamed groups keep their
+    default knobs); ``smoke`` shrinks every probe workload to seconds
+    scale.  ``device_kind``/``backend`` override the JAX identity — the
+    testing seam key-miss re-probe rides on.
+    """
+    from land_trendr_tpu.runtime import faults
+    from land_trendr_tpu.tune import probes as probemod
+
+    if device_kind is None or backend is None:
+        dk, be = device_identity()
+        device_kind = device_kind or dk
+        backend = backend or be
+    shape_cls = shape_class(height, width, n_years)
+    key = profile_key(device_kind, backend, shape_cls)
+    store = TuningStore(store_dir)
+
+    if not retune:
+        profile = store.load(device_kind, backend, shape_cls)
+        if profile is not None:
+            if telemetry is not None:
+                telemetry.tune_profile(
+                    key=key,
+                    source="store",
+                    probes=0,
+                    age_s=max(0.0, time.time() - float(profile["created_t"])),
+                    knobs=dict(profile["knobs"]),
+                    groups=len(profile.get("groups", {})),
+                )
+            # "source" is EPHEMERAL caller information (store hit = zero
+            # probes ran), never persisted — stored bytes stay canonical
+            return {**profile, "source": "store", "key": key}
+
+    group_names = tuple(groups) if groups is not None else tuple(
+        probemod.PROBE_GROUPS
+    )
+    unknown = [g for g in group_names if g not in probemod.PROBE_GROUPS]
+    if unknown:
+        raise ValueError(
+            f"unknown probe group(s) {unknown}; choose from "
+            f"{tuple(probemod.PROBE_GROUPS)}"
+        )
+
+    knobs = dict(KNOB_DEFAULTS)
+    group_reports: dict[str, dict] = {}
+    total_probes = 0
+    for group in group_names:
+        t0 = time.perf_counter()
+        try:
+            # the tune.probe fault seam: an injected (or real) probe
+            # failure skips THIS group — defaults survive, the tuner and
+            # the run behind it live
+            faults.check("tune.probe")
+            best, report = probemod.probe_group(
+                group, reps=reps, smoke=smoke, defaults=KNOB_DEFAULTS
+            )
+        except Exception as e:
+            wall = time.perf_counter() - t0
+            group_reports[group] = {
+                "ok": False,
+                "probes": 0,
+                "error": str(e),
+                "wall_s": round(wall, 6),
+            }
+            if telemetry is not None:
+                telemetry.tune_probe(
+                    group=group, ok=False, probes=0, wall_s=wall, error=str(e)
+                )
+            continue
+        wall = time.perf_counter() - t0
+        knobs.update(best)
+        total_probes += int(report.get("probes", 0))
+        group_reports[group] = {
+            "ok": True,
+            "knobs": best,
+            "wall_s": round(wall, 6),
+            **report,
+        }
+        if telemetry is not None:
+            telemetry.tune_probe(
+                group=group,
+                ok=True,
+                probes=int(report.get("probes", 0)),
+                wall_s=wall,
+                speedup=report.get("speedup"),
+                knobs=dict(best),
+            )
+
+    profile = {
+        "schema": TUNE_SCHEMA,
+        "device_kind": device_kind,
+        "backend": backend,
+        "shape_class": shape_cls,
+        "created_t": time.time(),
+        "probes": total_probes,
+        "knobs": knobs,
+        "groups": group_reports,
+    }
+    if persist:
+        store.save(profile)
+    if telemetry is not None:
+        telemetry.tune_profile(
+            key=key,
+            source="probed",
+            probes=total_probes,
+            age_s=0.0,
+            knobs=dict(knobs),
+            groups=len(group_reports),
+        )
+    return {**profile, "source": "probed", "key": key}
+
+
+def resolve_config(cfg, scene_shape: "tuple[int, int, int] | None" = None):
+    """Resolve a RunConfig's ``"auto"`` knobs; returns ``(cfg, info)``.
+
+    ``scene_shape`` is ``(height, width, n_years)`` — the shape-class
+    half of the store key.  With no ``"auto"`` field the config passes
+    through untouched (``info=None``, zero overhead, no JAX or store
+    access).  Otherwise each ``"auto"`` field takes the loaded profile's
+    value (store hit) or the hardcoded default (no store configured, key
+    miss, or no shape to key on) — explicit values always win by
+    construction, since only ``"auto"`` fields are replaced.  ``info``
+    is the ``tune_profile`` event payload (``probes`` is always 0 here:
+    resolution never probes).
+    """
+    auto_fields = [f for f in TUNABLE_KNOBS if getattr(cfg, f) == AUTO]
+    if not auto_fields:
+        return cfg, None
+    profile = None
+    key = ""
+    if cfg.tune_store_dir and scene_shape is not None:
+        device_kind, backend = device_identity()
+        shape_cls = shape_class(*scene_shape)
+        key = profile_key(device_kind, backend, shape_cls)
+        profile = TuningStore(cfg.tune_store_dir).load(
+            device_kind, backend, shape_cls
+        )
+    knobs = {
+        f: (
+            profile["knobs"].get(f, KNOB_DEFAULTS[f])
+            if profile is not None
+            else KNOB_DEFAULTS[f]
+        )
+        for f in auto_fields
+    }
+    info: dict[str, Any] = {
+        "key": key,
+        "source": "store" if profile is not None else "defaults",
+        "probes": 0,
+        "knobs": knobs,
+    }
+    if profile is not None:
+        info["age_s"] = round(
+            max(0.0, time.time() - float(profile["created_t"])), 3
+        )
+    return dataclasses.replace(cfg, **knobs), info

@@ -1,0 +1,465 @@
+"""Staged calibration probes, one per knob group.
+
+Each probe measures a short synthetic workload shaped like the real
+subsystem it tunes — the threaded tile gather for ``feed_workers``, the
+windowed deflate decode through the real :mod:`~land_trendr_tpu.io.
+blockcache` for ``decode_workers``/``feed_cache_mb``, the packed
+host↔device transfer pipelines for ``upload_depth``/``fetch_depth``, and
+the host per-tile pipeline overhead for ``tile_size`` (with a sliced
+segment-kernel sweep for ``chunk_px`` in full mode) — and returns the
+winning knob values plus a report.  The search is **coordinate-wise**
+within a group (later knobs sweep with earlier winners held), each
+candidate is timed **median-of-reps**, and a candidate whose FIRST rep
+already exceeds :data:`CUTOFF` × the best median so far is cut off early
+(no point confirming a clear loser to three decimals).
+
+Contract with the autotuner:
+
+* every candidate set CONTAINS the hardcoded default, and ``default_s``
+  is that candidate's median — so ``best_s <= default_s`` holds by
+  construction (a probe can only match or beat the default, never
+  regress it), which is what lets the perf gate pin "tuned ≥ default"
+  structurally.
+* probes never skew the run that follows: anything process-global they
+  touch (the decoded-block cache configuration) is snapshotted and
+  restored in a ``finally``, and all probe inputs are synthetic
+  temporaries.
+* probes are honest about scale: they calibrate *balance points* (worker
+  counts, depths, granularity), not absolute throughput — the knobs
+  whose right values the paper's continental runs show dominate
+  end-to-end wall (arXiv:1807.01751), not kernel FLOPs.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import statistics
+import tempfile
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+import numpy as np
+
+__all__ = ["CUTOFF", "PROBE_GROUPS", "probe_group"]
+
+#: early-cutoff factor: a candidate whose first rep exceeds this multiple
+#: of the best median so far skips its remaining reps
+CUTOFF = 1.5
+
+
+def _median_reps(
+    fn: Callable[[], None], reps: int, best_so_far: "float | None"
+) -> "tuple[float, int]":
+    """(median seconds, reps actually run) with the early cutoff."""
+    times: list[float] = []
+    for i in range(max(1, reps)):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+        if i == 0 and best_so_far is not None and times[0] > CUTOFF * best_so_far:
+            break
+    return statistics.median(times), len(times)
+
+
+def _sweep(
+    candidates: list, make_fn: Callable, reps: int, default
+) -> "tuple[object, dict]":
+    """Time every candidate; return (winner, report).
+
+    ``make_fn(candidate)`` returns the zero-arg workload to time.  The
+    winner is the min median; ``default_s`` is the default candidate's
+    median (always measured in full — the cutoff never skips it, since a
+    skipped default would leave ``best_s <= default_s`` unprovable).
+    """
+    best_val, best_s, default_s = None, None, None
+    probes = 0
+    timings: dict[str, float] = {}
+    order = [default] + [c for c in candidates if c != default]
+    for cand in order:
+        fn = make_fn(cand)
+        cutoff_ref = None if cand == default else best_s
+        med, n = _median_reps(fn, reps, cutoff_ref)
+        probes += n
+        timings[str(cand)] = round(med, 6)
+        if cand == default:
+            default_s = med
+        if best_s is None or med < best_s:
+            best_val, best_s = cand, med
+    return best_val, {
+        "probes": probes,
+        "timings": timings,
+        "default_s": round(default_s, 6),
+        "best_s": round(best_s, 6),
+        "speedup": round(default_s / best_s, 3) if best_s > 0 else 1.0,
+    }
+
+
+# -- feed group: the threaded tile gather ---------------------------------
+
+def probe_feed(reps: int, smoke: bool, defaults: dict) -> "tuple[dict, dict]":
+    """``feed_workers``: threaded native/NumPy tile gather throughput.
+
+    The gather releases the GIL (threaded C++ codec; NumPy copies mostly
+    do too), so worker count tracks real cores — HOSTPATH_r03.json's
+    4.1M px/s/core budget is exactly what this probe localizes.
+    """
+    from land_trendr_tpu.io import native
+
+    ny = 8 if smoke else 16
+    size = 384 if smoke else 768
+    t_sz = 128
+    rng = np.random.default_rng(7)
+    cube = rng.integers(0, 1000, (ny, size, size), dtype=np.int16)
+    tiles = [(y, x) for y in range(0, size, t_sz) for x in range(0, size, t_sz)]
+
+    def gather(t: "tuple[int, int]") -> np.ndarray:
+        y, x = t
+        if native.available():
+            try:
+                return native.gather_tile(cube, y, x, t_sz, t_sz)
+            except native.NativeCodecError:
+                pass
+        win = cube[:, y : y + t_sz, x : x + t_sz]
+        return np.ascontiguousarray(win.reshape(ny, t_sz * t_sz).T)
+
+    cpus = os.cpu_count() or 1
+    cands = sorted({1, 2, min(4, cpus + 1), defaults["feed_workers"]})
+
+    def make_fn(workers: int):
+        def run() -> None:
+            with ThreadPoolExecutor(workers) as ex:
+                deque(ex.map(gather, tiles), maxlen=0)
+        return run
+
+    make_fn(1)()  # warm: page the cube in before anything is timed
+    best, report = _sweep(cands, make_fn, reps, defaults["feed_workers"])
+    return {"feed_workers": int(best)}, report
+
+
+# -- decode group: the real blockcache path -------------------------------
+
+def probe_decode(reps: int, smoke: bool, defaults: dict) -> "tuple[dict, dict]":
+    """``decode_workers`` + ``feed_cache_mb`` over the real windowed
+    deflate decode (:func:`~land_trendr_tpu.io.geotiff.
+    read_geotiff_window` through the process blockcache).
+
+    Coordinate-wise: the worker sweep runs cache-off (pure decode cost),
+    then the cache sweep replays a revisit-heavy window pattern with the
+    chosen workers.  The process cache configuration is snapshotted and
+    restored whatever happens — a probe must never skew the run behind
+    it.
+    """
+    from land_trendr_tpu.io import blockcache
+    from land_trendr_tpu.io.geotiff import read_geotiff_window
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+
+    size = 128 if smoke else 256
+    ny = 3 if smoke else 6
+    tmp = tempfile.mkdtemp(prefix="lt_tune_decode_")
+    snap = blockcache.config_snapshot()
+    try:
+        paths = write_stack(
+            tmp,
+            make_stack(SceneSpec(
+                width=size, height=size,
+                year_start=2000, year_end=2000 + ny - 1,
+            )),
+            tile=64,
+        )
+        win = size - 96
+        windows = [(0, 0), (32, 32), (win, 0), (0, win), (win, win)]
+
+        def read_all() -> None:
+            for p in paths:
+                for y, x in windows:
+                    read_geotiff_window(p, y, x, 96, 96)
+
+        cpus = os.cpu_count() or 1
+        w_cands = sorted({0, 1, min(2, cpus), defaults["decode_workers"]})
+
+        def make_workers_fn(workers: int):
+            def run() -> None:
+                blockcache.configure(budget_bytes=0, workers=workers)
+                read_all()
+            return run
+
+        make_workers_fn(0)()  # warm: the OS file cache, untimed
+        best_w, w_report = _sweep(
+            w_cands, make_workers_fn, reps, defaults["decode_workers"]
+        )
+
+        c_cands = sorted({0, defaults["feed_cache_mb"]})
+
+        def make_cache_fn(mb: int):
+            def run() -> None:
+                blockcache.configure(budget_bytes=mb << 20, workers=best_w)
+                read_all()  # cold pass populates (or not)
+                read_all()  # revisit pass: the cache's whole case
+            return run
+
+        best_c, c_report = _sweep(
+            c_cands, make_cache_fn, reps, defaults["feed_cache_mb"]
+        )
+        report = {
+            "probes": w_report["probes"] + c_report["probes"],
+            "timings": {
+                **{f"workers={k}": v for k, v in w_report["timings"].items()},
+                **{f"cache_mb={k}": v for k, v in c_report["timings"].items()},
+            },
+            "default_s": round(
+                w_report["default_s"] + c_report["default_s"], 6
+            ),
+            "best_s": round(w_report["best_s"] + c_report["best_s"], 6),
+            "speedup": round(
+                (w_report["default_s"] + c_report["default_s"])
+                / max(w_report["best_s"] + c_report["best_s"], 1e-9), 3,
+            ),
+        }
+        return (
+            {"decode_workers": int(best_w), "feed_cache_mb": int(best_c)},
+            report,
+        )
+    finally:
+        blockcache.configure(**snap)
+        blockcache.cache_clear()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- upload / fetch groups: the packed-transfer pipelines ------------------
+
+def _transfer_tiles(smoke: bool) -> "tuple[dict, np.ndarray, int]":
+    px = 64 * 64 if smoke else 128 * 128
+    ny = 8 if smoke else 16
+    rng = np.random.default_rng(11)
+    dn = {
+        "nir": rng.integers(0, 30000, (px, ny), dtype=np.int16),
+        "swir2": rng.integers(0, 30000, (px, ny), dtype=np.int16),
+    }
+    qa = rng.integers(0, 2, (px, ny), dtype=np.uint16)
+    return dn, qa, (4 if smoke else 8)
+
+
+def probe_upload(reps: int, smoke: bool, defaults: dict) -> "tuple[dict, dict]":
+    """``upload_depth``: the packed host→device pipeline at each depth.
+
+    One packed ``device_put`` per tile with up to ``depth`` transfers in
+    flight (the driver's exact double-buffering shape, minus the kernel);
+    a tiny device op stands in for the overlapped compute.  On backends
+    where the transfer is not a real wire (CPU) every depth ties and the
+    default survives — exactly the right answer there.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from land_trendr_tpu.runtime import feed as feedmod
+
+    dn, qa, k_tiles = _transfer_tiles(smoke)
+    plan = feedmod.build_plan(dn, qa)
+    packed = feedmod.pack_inputs(dn, qa, plan=plan)
+
+    def make_fn(depth: int):
+        # the unusable-donation warning (CPU) is filtered once at
+        # runtime/feed.py import — nothing to suppress per sweep
+        def run() -> None:
+            inflight: deque = deque()
+            for _ in range(k_tiles):
+                inflight.append(jax.device_put(packed))
+                while len(inflight) >= depth:
+                    words = inflight.popleft()
+                    out, _qa = feedmod.unpack_inputs(words, plan=plan)
+                    jax.block_until_ready(jnp.sum(out["nir"]))
+            while inflight:
+                out, _qa = feedmod.unpack_inputs(
+                    inflight.popleft(), plan=plan
+                )
+                jax.block_until_ready(jnp.sum(out["nir"]))
+        return run
+
+    cands = sorted({1, 2, 4, defaults["upload_depth"]})
+    # warm the unpack + reduce compiles OUTSIDE the sweep: the first
+    # timed candidate must not carry the jit compile every other one
+    # skips (that asymmetry fabricated a 15x "speedup" in review)
+    make_fn(cands[0])()
+    best, report = _sweep(cands, make_fn, reps, defaults["upload_depth"])
+    return {"upload_depth": int(best)}, report
+
+
+def probe_fetch(reps: int, smoke: bool, defaults: dict) -> "tuple[dict, dict]":
+    """``fetch_depth``: the device→host readback pipeline at each depth —
+    one async ``device_get``-shaped landing per tile with up to ``depth``
+    in flight while a stand-in compute runs ahead."""
+    import jax
+    import jax.numpy as jnp
+
+    px = 64 * 64 if smoke else 128 * 128
+    k_tiles = 4 if smoke else 8
+    base = jax.device_put(np.arange(px, dtype=np.float32))
+    step = jax.jit(lambda a, i: a * (1.0 + i))
+    jax.block_until_ready(step(base, 1.0))
+
+    def make_fn(depth: int):
+        def run() -> None:
+            inflight: deque = deque()
+            for i in range(k_tiles):
+                out = step(base, float(i))
+                inflight.append(out)
+                while len(inflight) >= depth:
+                    np.asarray(inflight.popleft())
+            while inflight:
+                np.asarray(inflight.popleft())
+        return run
+
+    cands = sorted({1, 2, 4, defaults["fetch_depth"]})
+    make_fn(cands[0])()  # warm outside the sweep, like probe_upload
+    best, report = _sweep(cands, make_fn, reps, defaults["fetch_depth"])
+    return {"fetch_depth": int(best)}, report
+
+
+# -- dispatch group: tile granularity + chunking --------------------------
+
+def probe_dispatch(reps: int, smoke: bool, defaults: dict) -> "tuple[dict, dict]":
+    """``tile_size`` (+ ``chunk_px`` in full mode).
+
+    ``tile_size`` is probed through the host per-tile pipeline cost —
+    gather + pack for a FIXED total pixel budget cut at each granularity
+    (smaller tiles pay per-tile overhead more often; larger tiles
+    amortize it) — the cheap, safe signal; kernel px/s is roughly
+    granularity-invariant.  ``chunk_px`` (full mode only) times the
+    sliced segment kernel against the candidate chunk sizes on a small
+    batch; candidates stay within the default HBM bound, because the
+    knob is a memory bound first and a perf knob second.
+    """
+    from land_trendr_tpu.io import native
+    from land_trendr_tpu.runtime import feed as feedmod
+
+    ny = 8 if smoke else 16
+    total = 256 if smoke else 512  # total scene edge the budget covers
+    rng = np.random.default_rng(13)
+    cube = rng.integers(0, 30000, (ny, total, total), dtype=np.int16)
+    qa_cube = rng.integers(0, 2, (ny, total, total), dtype=np.uint16)
+
+    def make_fn(t_sz: int):
+        def run() -> None:
+            plan = None
+            for y in range(0, total, t_sz):
+                for x in range(0, total, t_sz):
+                    if native.available():
+                        nir = native.gather_tile(cube, y, x, t_sz, t_sz)
+                        qa = native.gather_tile(qa_cube, y, x, t_sz, t_sz)
+                    else:
+                        nir = np.ascontiguousarray(
+                            cube[:, y : y + t_sz, x : x + t_sz]
+                            .reshape(ny, t_sz * t_sz).T
+                        )
+                        qa = np.ascontiguousarray(
+                            qa_cube[:, y : y + t_sz, x : x + t_sz]
+                            .reshape(ny, t_sz * t_sz).T
+                        )
+                    dn = {"nir": nir}
+                    if plan is None or plan.px != nir.shape[0]:
+                        plan = feedmod.build_plan(dn, qa)
+                    feedmod.pack_inputs(dn, qa, plan=plan)
+        return run
+
+    cands = sorted({64, 128, 256, 512, defaults["tile_size"]})
+    cands = [c for c in cands if c <= total]
+    best_t, report = _sweep(cands, make_fn, reps, defaults["tile_size"])
+    knobs = {"tile_size": int(best_t), "chunk_px": defaults["chunk_px"]}
+    if not smoke:
+        chunk_knob, chunk_report = _probe_chunk(reps, defaults)
+        knobs["chunk_px"] = chunk_knob
+        report = {
+            "probes": report["probes"] + chunk_report["probes"],
+            "timings": {
+                **{f"tile_size={k}": v for k, v in report["timings"].items()},
+                **{
+                    f"chunk_px={k}": v
+                    for k, v in chunk_report["timings"].items()
+                },
+            },
+            "default_s": round(
+                report["default_s"] + chunk_report["default_s"], 6
+            ),
+            "best_s": round(report["best_s"] + chunk_report["best_s"], 6),
+            "speedup": round(
+                (report["default_s"] + chunk_report["default_s"])
+                / max(report["best_s"] + chunk_report["best_s"], 1e-9), 3,
+            ),
+        }
+    return knobs, report
+
+
+def _probe_chunk(reps: int, defaults: dict) -> "tuple[int, dict]":
+    """Sliced segment-kernel sweep for ``chunk_px`` (full mode only).
+
+    Times the kernel over a fixed pixel batch executed in candidate-sized
+    slices — the ``lax.map``-over-chunks cost shape of the real chunked
+    kernel, at probe scale.  Candidates are scaled stand-ins; the winner
+    maps back to the real knob domain (never above the default bound:
+    the probe tunes the perf side of the knob, the operator owns the
+    memory side).
+    """
+    import jax
+
+    from land_trendr_tpu.config import LTParams
+    from land_trendr_tpu.ops.segment import jax_segment_pixels
+
+    px, ny = 2048, 16
+    rng = np.random.default_rng(17)
+    years = np.arange(2000, 2000 + ny, dtype=np.int32)
+    values = rng.normal(0.4, 0.1, (px, ny)).astype(np.float32)
+    mask = np.ones((px, ny), dtype=bool)
+    params = LTParams(max_segments=4, vertex_count_overshoot=1)
+    # scaled slice candidates; "1" = one slice (unchunked shape)
+    slice_cands = [1, 2, 4]
+    default_slices = 1  # the default bound never engages at probe scale
+
+    def make_fn(n_slices: int):
+        step = px // n_slices
+
+        def run() -> None:
+            outs = []
+            for s in range(n_slices):
+                outs.append(
+                    jax_segment_pixels(
+                        years,
+                        values[s * step : (s + 1) * step],
+                        mask[s * step : (s + 1) * step],
+                        params,
+                    )
+                )
+            jax.block_until_ready(outs)
+        return run
+
+    # warm the compiles outside the timed reps
+    for n in slice_cands:
+        make_fn(n)()
+    best, report = _sweep(slice_cands, make_fn, reps, default_slices)
+    # map: slicing never helped -> keep the default bound; slicing helped
+    # -> halve the bound (a finer chunk at real scale), floor 64k
+    default_chunk = defaults["chunk_px"]
+    if best == 1 or default_chunk is None:
+        return default_chunk, report
+    return max(65536, int(default_chunk) // int(best)), report
+
+
+#: group name → (probe fn, knob names) — the autotuner's schedule.  Order
+#: matters only for reporting; groups are independent by construction.
+PROBE_GROUPS: dict = {
+    "feed": (probe_feed, ("feed_workers",)),
+    "decode": (probe_decode, ("decode_workers", "feed_cache_mb")),
+    "upload": (probe_upload, ("upload_depth",)),
+    "fetch": (probe_fetch, ("fetch_depth",)),
+    "dispatch": (probe_dispatch, ("tile_size", "chunk_px")),
+}
+
+
+def probe_group(
+    group: str, reps: int, smoke: bool, defaults: dict
+) -> "tuple[dict, dict]":
+    """Run one group's probe; returns ``(best knob values, report)``."""
+    fn, _knobs = PROBE_GROUPS[group]
+    return fn(reps=reps, smoke=smoke, defaults=defaults)

@@ -1,0 +1,305 @@
+"""Autotuned execution profiles: store lifecycle, resolution, CLI.
+
+The tuning-store contract (land_trendr_tpu/tune): persist → reload with
+zero re-probes, key-miss re-probe on device-kind change, stale-schema
+invalidation, corrupt/torn profile drop + re-probe, ``"auto"`` vs
+explicit precedence, and the ``lt tune --dry-run`` report-no-write
+contract — plus the drift pins that keep the tuner's default table and
+the schema tool's source enum honest, and the packed-upload buffer
+donation's consumption semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.runtime.driver import RunConfig
+from land_trendr_tpu.tune import (
+    KNOB_DEFAULTS,
+    TUNABLE_KNOBS,
+    TUNE_SCHEMA,
+    TuningStore,
+    autotune,
+    profile_key,
+    resolve_config,
+    shape_class,
+)
+from land_trendr_tpu.tune import probes as probemod
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.fixture()
+def fake_probes(monkeypatch):
+    """Replace the probe schedule with one counting fake group — store
+    lifecycle tests must pin WHEN probes run, not what they measure."""
+    calls: list[str] = []
+
+    def fake_feed(reps, smoke, defaults):
+        calls.append("feed")
+        return {"feed_workers": 3}, {
+            "probes": 2, "timings": {}, "default_s": 1.0, "best_s": 0.5,
+            "speedup": 2.0,
+        }
+
+    monkeypatch.setattr(
+        probemod, "PROBE_GROUPS", {"feed": (fake_feed, ("feed_workers",))}
+    )
+    return calls
+
+
+def _tune(store_dir, **kw):
+    kw.setdefault("height", 512)
+    kw.setdefault("width", 512)
+    kw.setdefault("n_years", 40)
+    kw.setdefault("device_kind", "test-device")
+    kw.setdefault("backend", "cpu")
+    return autotune(str(store_dir), **kw)
+
+
+# -- store lifecycle -------------------------------------------------------
+
+def test_persist_then_reload_runs_zero_probes(tmp_path, fake_probes):
+    p1 = _tune(tmp_path)
+    assert p1["source"] == "probed"
+    assert fake_probes == ["feed"]
+    assert p1["knobs"]["feed_workers"] == 3
+    # defaults survive for every group the (restricted) schedule skipped
+    for knob in TUNABLE_KNOBS:
+        if knob != "feed_workers":
+            assert p1["knobs"][knob] == KNOB_DEFAULTS[knob]
+    p2 = _tune(tmp_path)
+    assert p2["source"] == "store"
+    assert fake_probes == ["feed"], "warm reload must run ZERO probes"
+    assert p2["knobs"] == p1["knobs"], "deterministic reload"
+
+
+def test_key_miss_on_device_kind_change_reprobes(tmp_path, fake_probes):
+    _tune(tmp_path)
+    p2 = _tune(tmp_path, device_kind="other-device")
+    assert p2["source"] == "probed"
+    assert fake_probes == ["feed", "feed"]
+    # both keys now coexist in one store
+    store = TuningStore(str(tmp_path))
+    assert len(store.profiles()) == 2
+
+
+def test_retune_overrides_store_hit(tmp_path, fake_probes):
+    _tune(tmp_path)
+    p2 = _tune(tmp_path, retune=True)
+    assert p2["source"] == "probed"
+    assert fake_probes == ["feed", "feed"]
+
+
+def test_stale_schema_version_invalidates(tmp_path, fake_probes):
+    _tune(tmp_path)
+    store = TuningStore(str(tmp_path))
+    key = profile_key("test-device", "cpu", shape_class(512, 512, 40))
+    path = store.path_for(key)
+    stale = json.loads(Path(path).read_text())
+    stale["schema"] = TUNE_SCHEMA - 1
+    Path(path).write_text(json.dumps(stale))
+    assert store.load("test-device", "cpu", shape_class(512, 512, 40)) is None
+    assert store.stats()["stale_dropped"] == 1
+    assert not Path(path).exists(), "stale profile must be dropped on sight"
+    # and the autotuner re-probes the now-missing key
+    p = _tune(tmp_path)
+    assert p["source"] == "probed"
+    assert fake_probes == ["feed", "feed"]
+
+
+@pytest.mark.parametrize("damage", ["torn", "not-json", "wrong-key"])
+def test_corrupt_profile_dropped_and_reprobed(tmp_path, fake_probes, damage):
+    _tune(tmp_path)
+    store = TuningStore(str(tmp_path))
+    key = profile_key("test-device", "cpu", shape_class(512, 512, 40))
+    path = Path(store.path_for(key))
+    raw = path.read_text()
+    if damage == "torn":
+        path.write_text(raw[: len(raw) // 2])
+    elif damage == "not-json":
+        path.write_bytes(b"\x00\xffnot json")
+    else:  # a foreign profile copied under this key's filename
+        foreign = json.loads(raw)
+        foreign["device_kind"] = "somebody-else"
+        path.write_text(json.dumps(foreign))
+    assert store.load("test-device", "cpu", shape_class(512, 512, 40)) is None
+    assert store.stats()["corrupt_dropped"] == 1
+    assert not path.exists()
+    p = _tune(tmp_path)
+    assert p["source"] == "probed"
+    assert fake_probes == ["feed", "feed"]
+
+
+def test_probe_failure_skips_group_keeps_defaults(tmp_path, monkeypatch):
+    def bad(reps, smoke, defaults):
+        raise RuntimeError("probe exploded")
+
+    def good(reps, smoke, defaults):
+        return {"fetch_depth": 4}, {
+            "probes": 1, "timings": {}, "default_s": 1.0, "best_s": 0.9,
+            "speedup": 1.1,
+        }
+
+    monkeypatch.setattr(
+        probemod, "PROBE_GROUPS",
+        {"feed": (bad, ("feed_workers",)), "fetch": (good, ("fetch_depth",))},
+    )
+    p = _tune(tmp_path)
+    assert p["groups"]["feed"]["ok"] is False
+    assert "probe exploded" in p["groups"]["feed"]["error"]
+    assert p["knobs"]["feed_workers"] == KNOB_DEFAULTS["feed_workers"]
+    assert p["groups"]["fetch"]["ok"] is True
+    assert p["knobs"]["fetch_depth"] == 4
+
+
+# -- "auto" resolution -----------------------------------------------------
+
+def test_explicit_wins_auto_pulls_profile(tmp_path, fake_probes):
+    _tune(tmp_path, device_kind=None, backend=None)  # key on the REAL device
+    cfg = RunConfig(
+        feed_workers="auto",
+        tile_size=64,  # explicit — the profile must not touch it
+        tune_store_dir=str(tmp_path),
+    )
+    resolved, info = resolve_config(cfg, scene_shape=(512, 512, 40))
+    assert resolved.feed_workers == 3
+    assert resolved.tile_size == 64
+    assert info["source"] == "store"
+    assert info["probes"] == 0
+    assert info["knobs"] == {"feed_workers": 3}
+    assert "age_s" in info
+
+
+def test_auto_without_store_is_byte_identical_defaults():
+    cfg = RunConfig(**{k: "auto" for k in TUNABLE_KNOBS})
+    resolved, info = resolve_config(cfg, scene_shape=(256, 256, 30))
+    assert info["source"] == "defaults"
+    assert resolved == RunConfig(), (
+        "'auto' with no store must reproduce the default config exactly"
+    )
+
+
+def test_no_auto_is_identity_passthrough():
+    cfg = RunConfig()
+    resolved, info = resolve_config(cfg, scene_shape=(256, 256, 30))
+    assert resolved is cfg
+    assert info is None
+
+
+def test_auto_key_miss_falls_back_to_defaults(tmp_path):
+    cfg = RunConfig(feed_workers="auto", tune_store_dir=str(tmp_path))
+    resolved, info = resolve_config(cfg, scene_shape=(64, 64, 10))
+    assert resolved.feed_workers == KNOB_DEFAULTS["feed_workers"]
+    assert info["source"] == "defaults"
+
+
+def test_non_auto_string_rejected_at_config_time():
+    with pytest.raises(ValueError, match="integer or 'auto'"):
+        RunConfig(feed_workers="fast")
+
+
+# -- drift pins ------------------------------------------------------------
+
+def test_knob_defaults_match_runconfig():
+    """KNOB_DEFAULTS (the tune module cannot import the driver) must
+    mirror the RunConfig dataclass defaults exactly."""
+    by_name = {f.name: f.default for f in dataclasses.fields(RunConfig)}
+    for knob in TUNABLE_KNOBS:
+        assert KNOB_DEFAULTS[knob] == by_name[knob], knob
+
+
+def test_tune_sources_enum_pinned():
+    from check_events_schema import TUNE_SOURCES
+
+    assert set(TUNE_SOURCES) == {"probed", "store", "defaults"}
+
+
+def test_probe_groups_cover_every_tunable_knob():
+    covered = {
+        k for _fn, knobs in probemod.PROBE_GROUPS.values() for k in knobs
+    }
+    assert covered == set(TUNABLE_KNOBS)
+
+
+def test_shape_class_buckets():
+    # jittered AOIs share a class; a thumbnail and a gigapixel never do
+    assert shape_class(1024, 1024, 30) == shape_class(1400, 1400, 32)
+    assert shape_class(256, 256, 30) != shape_class(8192, 8192, 30)
+    assert shape_class(512, 512, 8) != shape_class(512, 512, 40)
+
+
+# -- the lt tune CLI -------------------------------------------------------
+
+def _cli(tmp_path, *extra):
+    from land_trendr_tpu.cli import main
+
+    return main([
+        "tune", "--store-dir", str(tmp_path / "store"), "--smoke",
+        "--reps", "1", *extra,
+    ])
+
+
+def test_cli_dry_run_reports_but_writes_nothing(tmp_path, capsys, fake_probes):
+    assert _cli(tmp_path, "--dry-run") == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["source"] == "probed"
+    assert report["persisted"] is False
+    assert "feed" in report["groups"]
+    store_dir = tmp_path / "store"
+    assert not list(store_dir.glob("profile-*.json")), (
+        "--dry-run must write nothing to the store"
+    )
+
+
+def test_cli_persists_then_reports_store_hit(tmp_path, capsys, fake_probes):
+    assert _cli(tmp_path) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["persisted"] is True
+    assert Path(report["profile_path"]).exists()
+    assert _cli(tmp_path) == 0
+    warm = json.loads(capsys.readouterr().out)
+    assert warm["source"] == "store"
+    assert warm["probes"] == 0
+    assert warm["knobs"] == report["knobs"]
+    assert fake_probes == ["feed"], "the warm CLI run must probe nothing"
+
+
+# -- packed-upload buffer donation (SNIPPETS [2] satellite) ----------------
+
+def test_unpack_donates_and_consumes_words():
+    """The jitted unpack donates its word buffer: the declaration is
+    pinned in source (behavioral equivalence rides the test_upload
+    parity matrix), a fresh buffer unpacks bit-exactly, and the
+    PackedUpload handle drops its reference once consumed so no later
+    path can touch a deleted array."""
+    import jax
+
+    from land_trendr_tpu.runtime import feed as feedmod
+
+    src = Path(REPO / "land_trendr_tpu/runtime/feed.py").read_text()
+    assert 'donate_argnames=("words",)' in src
+
+    rng = np.random.default_rng(3)
+    dn = {"nir": rng.integers(0, 30000, (64, 5), dtype=np.int16)}
+    qa = rng.integers(0, 4, (64, 5), dtype=np.uint16)
+
+    cfg = RunConfig(upload_packed=True)
+    uploader = feedmod.TileUploader(cfg, packed=True)
+    handle = uploader.start(dn, qa)
+    out_dn, out_qa = handle.arrays()
+    np.testing.assert_array_equal(np.asarray(out_dn["nir"]), dn["nir"])
+    np.testing.assert_array_equal(np.asarray(out_qa), qa)
+    assert handle._words is None, "the donated buffer must be dropped"
+    # a second tile gets a fresh buffer — donation never aliases tiles
+    handle2 = uploader.start(dn, qa)
+    out2, _ = handle2.arrays()
+    np.testing.assert_array_equal(np.asarray(out2["nir"]), dn["nir"])
+    del jax  # imported to assert a backend exists for device_put

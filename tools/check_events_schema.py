@@ -117,6 +117,12 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     "replica_down": ("inflight",),
     "tenant_throttled": ("queue_depth",),
     "scale_decision": ("burn", "replicas", "queue_depth"),
+    # autotuned execution profiles (land_trendr_tpu/tune): probe counts,
+    # walls, speedups and profile ages only go up / never negative (the
+    # source-enum and store-implies-zero-probes checks live in
+    # tune_value_errors below)
+    "tune_probe": ("probes", "wall_s", "speedup"),
+    "tune_profile": ("probes", "age_s", "groups"),
 }
 
 
@@ -367,6 +373,48 @@ def route_decision_value_errors(rec, lineno: int) -> list[str]:
     return []
 
 
+#: the tune_profile source vocabulary (mirrors the autotuner's emit
+#: sites in land_trendr_tpu/tune/autotune.py and the driver's resolution
+#: — asserted non-drifting in tests/test_tune.py)
+TUNE_SOURCES = ("probed", "store", "defaults")
+
+
+def tune_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for the autotuner events a type check alone
+    cannot pin: a ``tune_profile`` carries a known ``source``, and
+    ``source="store"`` is BY DEFINITION a zero-probe reload; a
+    ``tune_probe`` that succeeded (``ok=true``) ran at least one timed
+    rep.  Non-negativity rides the generic loop."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    if ev == "tune_profile":
+        errs = []
+        source = rec.get("source")
+        if isinstance(source, str) and source not in TUNE_SOURCES:
+            errs.append(
+                f"line {lineno}: tune_profile: source {source!r} not one "
+                f"of {TUNE_SOURCES}"
+            )
+        probes = rec.get("probes")
+        if source == "store" and _num(probes) and probes != 0:
+            errs.append(
+                f"line {lineno}: tune_profile: source 'store' with "
+                f"probes {probes} (a store reload runs zero probes by "
+                "definition)"
+            )
+        return errs
+    if ev == "tune_probe":
+        probes = rec.get("probes")
+        if rec.get("ok") is True and _num(probes) and probes < 1:
+            return [
+                f"line {lineno}: tune_probe: ok=true with probes "
+                f"{probes} (a succeeded group ran at least one timed rep)"
+            ]
+        return []
+    return []
+
+
 #: the alert event's state vocabulary (mirrors
 #: land_trendr_tpu.obs.alerts.ALERT_STATES — asserted equal in
 #: tests/test_fleet.py so the two cannot drift)
@@ -453,6 +501,7 @@ def value_lints():
             + tile_straggler_value_errors(rec, lineno)
             + lease_value_errors(rec, lineno)
             + route_decision_value_errors(rec, lineno)
+            + tune_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )

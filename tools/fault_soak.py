@@ -955,9 +955,120 @@ def soak(
                 "claim(s) after SIGKILL)"
             )
 
+    def run_tune_case(stack) -> None:
+        """Autotuner probe-failure semantics (the ``tune.probe`` seam):
+        an injected probe failure skips THAT knob group — its knobs fall
+        back to defaults and the ``tune_probe`` event carries
+        ``ok=false`` — while the other groups probe normally, the stream
+        stays schema-clean, and a subsequent run resolving its "auto"
+        knobs through the resulting profile completes with artifacts
+        byte-identical to the clean run (a failed probe degrades tuning,
+        never correctness)."""
+        from land_trendr_tpu.obs import Telemetry
+        from land_trendr_tpu.obs.events import iter_events, validate_events_file
+        from land_trendr_tpu.runtime import faults
+        from land_trendr_tpu.tune import KNOB_DEFAULTS, autotune
+        from land_trendr_tpu.tune.probes import PROBE_GROUPS
+
+        sys.path.insert(0, str(REPO / "tools"))
+        from check_events_schema import value_lints
+
+        store_dir = str(root / "tune_store")
+        tw = str(root / "tune_events")
+        plan = faults.activate(faults.parse_schedule("seed=1,tune.probe@0"))
+        try:
+            telemetry = Telemetry(tw, fingerprint="tune")
+            try:
+                telemetry.run_start(
+                    fingerprint="tune", process_index=0, process_count=1,
+                    tiles_total=0, tiles_todo=0, tiles_skipped_resume=0,
+                    mesh_devices=1, impl="tune",
+                )
+                h, w = stack.shape
+                profile = autotune(
+                    store_dir, height=h, width=w, n_years=stack.n_years,
+                    smoke=True, reps=1, telemetry=telemetry,
+                )
+                telemetry.run_done(
+                    "ok", tiles_done=0, pixels=0, wall_s=0.0,
+                    px_per_s=0.0, fit_rate=0.0,
+                )
+            finally:
+                telemetry.close()
+        finally:
+            faults.deactivate()
+        if [s for s, _i, _k in plan.injected()] != ["tune.probe"]:
+            raise AssertionError(
+                f"tune.probe seam did not fire exactly once: {plan.injected()}"
+            )
+        skipped = [g for g, r in profile["groups"].items() if not r["ok"]]
+        if len(skipped) != 1:
+            raise AssertionError(
+                f"expected exactly one skipped group, got {skipped}"
+            )
+        for knob in PROBE_GROUPS[skipped[0]][1]:
+            if profile["knobs"][knob] != KNOB_DEFAULTS[knob]:
+                raise AssertionError(
+                    f"skipped group {skipped[0]}: knob {knob} drifted off "
+                    f"its default ({profile['knobs'][knob]})"
+                )
+        events = list(iter_events(str(Path(tw) / "events.jsonl")))
+        failed_probes = [
+            r for r in events if r["ev"] == "tune_probe" and r["ok"] is False
+        ]
+        if len(failed_probes) != 1 or failed_probes[0]["group"] != skipped[0]:
+            raise AssertionError(
+                f"expected one tune_probe ok=false for {skipped[0]}, got "
+                f"{failed_probes}"
+            )
+        lint = validate_events_file(
+            str(Path(tw) / "events.jsonl"), extra=value_lints()
+        )
+        if lint:
+            raise AssertionError(f"tune event stream lint-dirty: {lint[:3]}")
+        # the run behind the degraded profile: "auto" execution knobs
+        # resolve through it; artifacts must match the clean run exactly
+        wd = str(root / "eager_tune")
+        cfg = RunConfig(
+            workdir=wd,
+            out_dir=wd + "_o",
+            feed_workers="auto",
+            decode_workers="auto",
+            feed_cache_mb="auto",
+            fetch_depth="auto",
+            upload_depth="auto",
+            tune_store_dir=store_dir,
+            **base_kw,
+        )
+        summary = _run(stack, cfg)
+        if summary.get("tune", {}).get("source") != "store":
+            raise AssertionError(
+                f"auto knobs did not resolve from the store: "
+                f"{summary.get('tune')}"
+            )
+        got = _digest_workdir(wd)
+        clean = _digest_workdir(str(root / "eager_clean"))
+        if got != clean:
+            raise AssertionError(
+                "tuned-profile run artifacts differ from the clean run"
+            )
+        report["cases"].append({
+            "track": "eager",
+            "case": "tune_probe_fault",
+            "schedule": "seed=1,tune.probe@0",
+            "skipped_group": skipped[0],
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: eager/tune_probe_fault (group {skipped[0]} skipped, "
+                "run byte-identical)"
+            )
+
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
     run_straggler_case(eager)
+    run_tune_case(eager)
     run_fleet_case(eager)
     if not smoke:
         run_lease_kill_case()

@@ -101,6 +101,28 @@ def render(view: dict) -> str:
             f"{str(p.get('queue_depth', '-')):>5} "
             f"{str(state.get('stragglers', '-')):>5}"
         )
+    # tuning profiles: which profile (key + age + source) each host's
+    # "auto" knobs resolved through — rendered so a MIXED tuned/untuned
+    # fleet is visible instead of silent (a host with no tune state in
+    # its snapshot simply ran with explicit/default knobs)
+    tuned = [
+        (h, (h.get("state") or {}).get("tune")) for h in view["hosts"]
+    ]
+    if any(t for _, t in tuned):
+        lines.append("")
+        lines.append("tune profiles:")
+        for h, t in tuned:
+            if not t:
+                continue
+            age = t.get("age_s")
+            lines.append(
+                f"  {h.get('host', '?')}:{h.get('pid', '?')} "
+                f"{t.get('key') or 'defaults'} src {t.get('source', '?')}"
+                + (
+                    f" age {_fmt_age(age)}"
+                    if isinstance(age, (int, float)) else ""
+                )
+            )
     # router aggregate: a kind="route" snapshot carries the routing
     # plane's state block (tenant queues, replica table, scaler) — the
     # fleet router publishes it so this view needs no HTTP

@@ -179,6 +179,17 @@ def render(snap: dict) -> str:
         f"terminal {h.get('jobs_terminal', '?')}/{h.get('jobs_total', '?')}"
         f"   warm programs {h.get('warm_program_count', '?')}"
     )
+    tune = h.get("tune")
+    if tune:
+        age = tune.get("age_s")
+        lines.append(
+            f"tune: {tune.get('key') or 'defaults'} "
+            f"src {tune.get('source', '?')}"
+            + (
+                f" age {_fmt_age(age)}"
+                if isinstance(age, (int, float)) else ""
+            )
+        )
     met = _metric(rows, "lt_slo_met_total")
     missed = _metric(rows, "lt_slo_missed_total")
     burn = _metric(rows, "lt_slo_burn_rate")
@@ -366,6 +377,24 @@ def render_fleet(snaps: list) -> str:
             f"{_metric(rows, 'lt_slo_burn_rate'):>5.2f} "
             f"{len(h.get('alerts') or []):>4}"
         )
+    # which tuning profile each replica's auto-knob jobs resolved
+    # through — the mixed tuned/untuned fleet made visible
+    if any(s["healthz"].get("tune") for s in snaps):
+        lines.append("")
+        lines.append("tune profiles:")
+        for s in snaps:
+            t = s["healthz"].get("tune")
+            if not t:
+                continue
+            age = t.get("age_s")
+            lines.append(
+                f"  {s.get('base', '?')} {t.get('key') or 'defaults'} "
+                f"src {t.get('source', '?')}"
+                + (
+                    f" age {_fmt_age(age)}"
+                    if isinstance(age, (int, float)) else ""
+                )
+            )
     lines.append("")
     jobs = [
         {**job, "_replica": s.get("base", "?")}

@@ -107,7 +107,7 @@ def _fresh_scope() -> dict:
         "intervals": [], "feed_cache": None,
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
-        "slo": None, "resources": None, "router": None,
+        "slo": None, "resources": None, "router": None, "tune": None,
     }
 
 
@@ -299,6 +299,47 @@ def _merge_resources(folded: list[dict]) -> "dict | None":
         if vals:
             out[key] = max(vals)
     return out
+
+
+def _tune_scope(cur: dict) -> dict:
+    """The lazily-created autotuner sub-aggregate of one scope (fed by
+    ``tune_probe`` / ``tune_profile`` — `lt tune` scopes and any run
+    whose config resolved "auto" knobs)."""
+    if cur["tune"] is None:
+        cur["tune"] = {
+            "groups_probed": 0, "groups_skipped": 0, "probes": 0,
+            "best_speedup": None, "profile": None,
+        }
+    return cur["tune"]
+
+
+def _merge_tune(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the autotuner rollups (None when no file's
+    last scope carried one): probe totals summed, the best observed
+    group speedup, and the profile verdicts by source (how many scopes
+    ran store-warm vs freshly probed vs untuned defaults)."""
+    seen = [c["tune"] for c in folded if c["tune"] is not None]
+    if not seen:
+        return None
+    speedups = [
+        s["best_speedup"] for s in seen if s["best_speedup"] is not None
+    ]
+    by_source: dict[str, int] = {}
+    keys: set = set()
+    for s in seen:
+        p = s["profile"]
+        if p is not None:
+            by_source[p["source"]] = by_source.get(p["source"], 0) + 1
+            if p.get("key"):
+                keys.add(p["key"])
+    return {
+        "groups_probed": sum(s["groups_probed"] for s in seen),
+        "groups_skipped": sum(s["groups_skipped"] for s in seen),
+        "probes": sum(s["probes"] for s in seen),
+        "best_speedup": max(speedups) if speedups else None,
+        "profiles_by_source": by_source,
+        "profile_keys": sorted(keys),
+    }
 
 
 def _merge_program_cache(folded: list[dict]) -> "dict | None":
@@ -882,6 +923,33 @@ def fold(
                                 "replicas": rec.get("replicas"),
                             },
                         })
+                    elif ev == "tune_probe":
+                        t = _tune_scope(cur)
+                        ok, probes = rec["ok"], rec["probes"]
+                        t["groups_probed"] += 1
+                        if not ok:
+                            t["groups_skipped"] += 1
+                        t["probes"] += probes
+                        sp = rec.get("speedup")
+                        if isinstance(sp, (int, float)) and not isinstance(
+                            sp, bool
+                        ):
+                            t["best_speedup"] = (
+                                sp if t["best_speedup"] is None
+                                else max(t["best_speedup"], sp)
+                            )
+                    elif ev == "tune_profile":
+                        t = _tune_scope(cur)
+                        # last wins per scope (the terminal verdict)
+                        t["profile"] = {
+                            "key": rec["key"],
+                            "source": rec["source"],
+                            "probes": rec["probes"],
+                            **(
+                                {"age_s": rec["age_s"]}
+                                if "age_s" in rec else {}
+                            ),
+                        }
                     elif ev == "program_cache":
                         # warm-cache verdict: one per job run scope (and a
                         # server-scope aggregate); last wins per scope
@@ -986,6 +1054,7 @@ def fold(
         "serve": _merge_serve(folded),
         "router": _merge_router(folded),
         "program_cache": _merge_program_cache(folded),
+        "tune": _merge_tune(folded),
         "slo": _merge_slo(folded),
         "resources": _merge_resources(folded),
         "hosts": hosts,

@@ -557,6 +557,62 @@ def run_router_leg(workdir: str, check) -> None:
     )
 
 
+def run_tune_leg(workdir: str, check) -> None:
+    """Autotuner leg (land_trendr_tpu/tune + tools/tune_bench).
+
+    Structural, exact: a probed profile round-trips through the store
+    byte-stably, a warm store serves it with ZERO probes and identical
+    knob values, ``"auto"`` resolution is deterministic, and the
+    tuned-vs-default end-to-end runs produce byte-identical artifacts
+    (the tuned knobs are pure execution facts) with the run's
+    ``tune_profile`` event reporting the zero-probe store hit.  Banded:
+    tuned must be ≥ default on at least one probe group — guaranteed by
+    construction (every candidate set contains the default), so a FAIL
+    here means the probe search itself regressed.  Callable on its own
+    (``tests/test_tune.py``) — it needs no bench baselines."""
+    import tune_bench
+
+    out = str(Path(workdir) / "tune_smoke.json")
+    if tune_bench.main(["--smoke", "--out", out]) not in (0, 1):
+        check("tune.ran", False, "tune_bench --smoke errored")
+        return
+    got = json.loads(Path(out).read_text())
+    inv = got.get("invariants", {})
+    check(
+        "tune.profile_roundtrip_stable",
+        inv.get("profile_roundtrip_byte_stable") is True,
+        "store save -> load -> save is byte-identical",
+    )
+    check(
+        "tune.warm_zero_probes",
+        inv.get("warm_zero_probes") is True
+        and inv.get("warm_identical_knobs") is True,
+        "second autotune served from the store: zero probes, identical "
+        "knob values",
+    )
+    check(
+        "tune.resolution_deterministic",
+        inv.get("resolution_deterministic") is True,
+        "two 'auto' resolutions of one key give identical RunConfigs",
+    )
+    check(
+        "tune.parity",
+        inv.get("artifacts_byte_identical") is True
+        and inv.get("run_tune_profile_event") is True,
+        "tuned-profile run artifacts ≡ default run; stream carries the "
+        "probes=0 store verdict",
+    )
+    sp = got.get("max_group_speedup")
+    check(
+        "tune.group_win",
+        inv.get("tuned_never_worse_than_default") is True
+        and inv.get("all_groups_probed") is True
+        and sp is not None and sp >= 1.0,
+        f"tuned ≥ default on every probed group (best group speedup "
+        f"{sp})",
+    )
+
+
 def run_gate(
     workdir: str, checks: list, scheduler: bool = True, router: bool = True
 ) -> None:
@@ -700,6 +756,7 @@ def run_gate(
 
     run_trace_leg(workdir, check)
     run_fleet_leg(workdir, check)
+    run_tune_leg(workdir, check)
     if scheduler:
         run_scheduler_leg(workdir, check)
     if router:
