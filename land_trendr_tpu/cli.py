@@ -537,6 +537,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="flight resource-sampler period (flight_sample "
                      "events: RSS, fds, threads, queue depth, backlogs, "
                      "cache occupancy)")
+    srv.add_argument("--request-ring", type=int, default=64, metavar="N",
+                     help="request-tracing recency bound: how many "
+                     "recent terminal requests (trace id + latency "
+                     "split) GET /debug/requests serves slowest-first; "
+                     "0 disables the ring")
     srv.add_argument("--publish", action="store_true",
                      help="fleet telemetry plane: publish this replica's "
                      "snapshot under TELEMETRY_DIR, fold every snapshot "
@@ -656,6 +661,11 @@ def build_parser() -> argparse.ArgumentParser:
     rte.add_argument("--metrics-interval-s", type=float, default=5.0,
                      metavar="SEC",
                      help="router metrics.prom refresh period")
+    rte.add_argument("--request-ring", type=int, default=64, metavar="N",
+                     help="request-tracing recency bound: how many "
+                     "recent terminal requests (trace id, router blame "
+                     "split, hops) GET /debug/requests serves "
+                     "slowest-first; 0 disables the ring")
     rte.add_argument("--fault-schedule", default=None, metavar="SPEC",
                      help="deterministic fault injection for soak runs "
                      "(router.forward / replica.health seams); "
@@ -1053,6 +1063,7 @@ def main(argv: list[str] | None = None) -> int:
                 debug_endpoints=not args.no_debug_endpoints,
                 flight_ring_events=args.flight_ring_events,
                 sampler_interval_s=args.sampler_interval_s,
+                request_ring=args.request_ring,
                 publish=args.publish,
                 publish_interval_s=args.publish_interval_s,
                 telemetry_dir=args.telemetry_dir,
@@ -1133,6 +1144,7 @@ def main(argv: list[str] | None = None) -> int:
                 telemetry=not args.no_telemetry,
                 telemetry_dir=args.telemetry_dir,
                 metrics_interval_s=args.metrics_interval_s,
+                request_ring=args.request_ring,
                 fault_schedule=args.fault_schedule,
             )
         except ValueError as e:

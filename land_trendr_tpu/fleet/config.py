@@ -148,6 +148,11 @@ class RouterConfig:
     telemetry_dir: "str | None" = None
     #: router ``metrics.prom`` refresh period, seconds
     metrics_interval_s: float = 5.0
+    #: request-tracing recency bound: how many recent TERMINAL requests
+    #: (trace id, router blame split, hops) ``GET /debug/requests``
+    #: serves, slowest-first; the ``/metrics/exemplars`` JSON is the
+    #: machine half of the same loop.  0 disables the ring.
+    request_ring: int = 64
     #: deterministic fault injection for soak runs (``router.forward``
     #: / ``replica.health`` seams plus everything in-process);
     #: production routers leave this unset
@@ -235,6 +240,10 @@ class RouterConfig:
         if self.metrics_interval_s <= 0:
             raise ValueError(
                 f"metrics_interval_s={self.metrics_interval_s} must be > 0"
+            )
+        if self.request_ring < 0:
+            raise ValueError(
+                f"request_ring={self.request_ring} must be >= 0 (0 = off)"
             )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam is a config error at startup (the

@@ -64,6 +64,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import Any
 
 from land_trendr_tpu.fleet.autoscale import Autoscaler
@@ -140,8 +141,18 @@ class RouterJob:
     state: str = "queued"  # queued | routed | TERMINAL_STATES
     replica: "str | None" = None
     replica_job_id: "str | None" = None
-    #: forward attempts so far (1 = first route; > 1 = re-routed)
+    #: the request-tracing correlation id, minted at router admission
+    #: and carried through every forward payload (re-routes keep it)
+    trace_id: str = ""
+    #: forward attempts so far (1 = first route; > 1 = re-routed).
+    #: NOT the trace's hop count: a replica-side 429 deliberately
+    #: refunds the attempt (saturation is not a route failure), so the
+    #: retry-budget counter can move backwards — ``hops`` below is the
+    #: monotone forward-try count the tracing plane reports
     attempts: int = 0
+    #: forward tries EVER made (monotone): the ``request_span`` hop
+    #: ordinal and ``request_done.hops`` — >= 2 means re-routed
+    hops: int = 0
     submitted_t: float = dataclasses.field(default_factory=time.time)
     routed_t: "float | None" = None
     finished_t: "float | None" = None
@@ -151,10 +162,27 @@ class RouterJob:
     snap: "dict | None" = None
     poll_fails: int = 0
     cancel_requested: bool = False
+    # -- request-tracing bookkeeping (mutated under the router lock) ------
+    #: when the CURRENT queue wait began (the t_mono clock — the same
+    #: perf_counter the event log stamps, so spans anchor cleanly)
+    queue_enter_mono: float = dataclasses.field(
+        default_factory=time.perf_counter
+    )
+    #: the pending queue wait is a 429 backoff, not a plain queue wait
+    backoff_pending: bool = False
+    #: router-side blame accumulators (seconds) — the request_done
+    #: split derives from these, replica time is the exact residual
+    blame_acc: dict = dataclasses.field(
+        default_factory=lambda: {
+            "route_queue": 0.0, "throttle_backoff": 0.0,
+            "forward": 0.0, "relay": 0.0,
+        }
+    )
 
     def status_locked(self) -> dict:
         out = {
             "job_id": self.job_id,
+            "trace_id": self.trace_id,
             "state": self.state,
             "tenant": self.tenant,
             "priority": self.priority,
@@ -359,12 +387,62 @@ class _RouterTelemetry:
         self.events.emit(
             "job_submitted",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             tenant=job.tenant,
             priority=job.priority,
             queue_depth=queue_depth,
             source=job.source,
         )
         self._queue_depth.set(queue_depth)
+
+    def request_span(
+        self,
+        job: RouterJob,
+        name: str,
+        start: float,
+        end: float,
+        replica: "str | None" = None,
+        attempt: "int | None" = None,
+        ok: "bool | None" = None,
+    ) -> None:
+        """One router-side segment of the request's journey (``start``/
+        ``end`` on the t_mono clock, the ``span`` convention): queue
+        waits, throttle backoffs, each forward HOP (failed ones too —
+        the re-route story needs both), the terminal result relay."""
+        fields: dict = {}
+        if replica is not None:
+            fields["replica"] = replica
+        if attempt is not None:
+            fields["attempt"] = attempt
+        if ok is not None:
+            fields["ok"] = bool(ok)
+        self.events.emit(
+            "request_span",
+            trace_id=job.trace_id,
+            job_id=job.job_id,
+            name=name,
+            start=round(start, 6),
+            end=round(end, 6),
+            tenant=job.tenant,
+            **fields,
+        )
+
+    def request_done(
+        self, job: RouterJob, latency_s: float, blame: dict, hops: int
+    ) -> None:
+        """The request's terminal record: the router-observed latency
+        and its router-side blame partition (components sum to
+        ``latency_s`` by construction — the value lint pins it)."""
+        self.events.emit(
+            "request_done",
+            trace_id=job.trace_id,
+            job_id=job.job_id,
+            status=job.state,
+            latency_s=round(latency_s, 6),
+            tenant=job.tenant,
+            hops=hops,
+            blame=blame,
+        )
 
     def job_rejected(self, reason: str, queue_depth: int) -> None:
         self.events.emit(
@@ -389,6 +467,7 @@ class _RouterTelemetry:
         self.events.emit(
             "route_decision",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             tenant=job.tenant,
             replica=replica,
             warm=bool(warm),
@@ -403,7 +482,9 @@ class _RouterTelemetry:
         if job.attempts > 1:
             self._rerouted.inc()
         else:
-            self._queue_wait_hist.observe(max(0.0, wait_s))
+            self._queue_wait_hist.observe(
+                max(0.0, wait_s), exemplar=job.trace_id or None
+            )
         self._queue_depth.set(queue_depth)
 
     def replica_up(self, replica: _Replica) -> None:
@@ -447,11 +528,15 @@ class _RouterTelemetry:
         self.events.emit(
             "job_done",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             status=job.state,
             wall_s=round(wall_s, 6),
             **fields,
         )
-        self._job_hist.observe(wall_s)
+        # the exemplar closes the metrics→traces loop: the bucket this
+        # request landed in remembers its trace_id, so the p99 bucket
+        # names requests lt_request can assemble
+        self._job_hist.observe(wall_s, exemplar=job.trace_id or None)
         self._done_counter(job.state).inc()
 
     def pool_gauges(self, ready: int, total: int) -> None:
@@ -494,6 +579,12 @@ class FleetRouter:
         self._rid_seq = 0
         self._stopping = False
         self.pool: "list[_Replica]" = []
+        #: recent TERMINAL requests (trace id, router blame split,
+        #: hops) — the /debug/requests window, newest last, bounded
+        #: (mutated under the router lock; 0 = an always-empty ring)
+        self._recent_requests: "collections.deque" = collections.deque(
+            maxlen=cfg.request_ring
+        )
 
         from land_trendr_tpu.obs.publish import telemetry_dir
 
@@ -709,6 +800,10 @@ class FleetRouter:
                     tenant=req.tenant,
                     priority=req.priority,
                     key=key,
+                    # the request-tracing id is minted HERE, at the
+                    # fleet's admission edge; the client may also pin
+                    # its own (a proxy threading an upstream id)
+                    trace_id=req.trace_id or uuid.uuid4().hex[:16],
                     # the router pins the dirs (unless the client pinned
                     # its own — the explicit-resume path), so a re-route
                     # RESUMES the same manifest on the next replica
@@ -717,11 +812,14 @@ class FleetRouter:
                     out_dir=req.out_dir or os.path.join(job_root, "out"),
                     source=source,
                 )
+                # registered but NOT yet enqueued: the job becomes
+                # routable only after job_submitted is durably in the
+                # stream, or the dispatcher's first request_span could
+                # land ahead of the trace's introduction (the orphan
+                # the referential lint flags)
                 self._jobs[job_id] = job
-                self._enqueue_locked(job)
-                depth = self._queued
+                depth = self._queued + 1  # the enqueue below joins it
                 snap = job.status_locked()
-                self._cond.notify_all()
         if throttle is not None:
             status, reason, detail = throttle
             log.warning(
@@ -733,8 +831,17 @@ class FleetRouter:
                 else:
                     self.telemetry.job_rejected(reason, depth)
             raise Rejection(status, reason, detail)
-        if self.telemetry is not None:
-            self.telemetry.job_submitted(job, depth)
+        try:
+            if self.telemetry is not None:
+                self.telemetry.job_submitted(job, depth)
+        finally:
+            # enqueue even when the emit raised (full disk): an
+            # accepted job must never be orphaned un-routable.  A
+            # cancel that landed in the gap already marked the job
+            # terminal — the pick loop skips non-queued entries.
+            with self._lock:
+                self._enqueue_locked(job)
+                self._cond.notify_all()
         return snap
 
     def _enqueue_locked(self, job: RouterJob, front: bool = False) -> None:
@@ -872,6 +979,21 @@ class FleetRouter:
                         return job, replica, warm
                 self._cond.wait(timeout=0.2)
 
+    def _close_queue_span(self, job: RouterJob, now_m: float) -> None:
+        """Close the job's pending queue wait (route_queue, or
+        throttle_backoff when a replica 429 re-queued it): fold the
+        seconds into the blame accumulator under the lock, emit the
+        ``request_span`` outside it."""
+        with self._lock:
+            q0 = job.queue_enter_mono
+            comp = (
+                "throttle_backoff" if job.backoff_pending else "route_queue"
+            )
+            job.backoff_pending = False
+            job.blame_acc[comp] += max(0.0, now_m - q0)
+        if self.telemetry is not None:
+            self.telemetry.request_span(job, comp, q0, now_m)
+
     def _route_job(self, job: RouterJob, replica: _Replica, warm: bool) -> None:
         """One forward (no lock held during HTTP).  Failure paths:
         transport error / injected ``router.forward`` fault → the job
@@ -879,13 +1001,20 @@ class FleetRouter:
         a replica-side 429 → requeue without burning a retry (the
         replica is saturated, not broken); a replica-side 400 → the
         job is terminally ``config_error`` (no replica will take it)."""
+        self._close_queue_span(job, time.perf_counter())
         payload = dict(job.payload)
         payload["workdir"] = job.workdir
         payload["out_dir"] = job.out_dir
         payload["resume"] = True
+        # the trace context crosses the wire IN the job payload: the
+        # replica's admission validates it into JobRequest.trace_id and
+        # the job's whole run scope carries it — re-route hops forward
+        # the SAME id, so both hops assemble under one trace
+        payload["trace_id"] = job.trace_id
         err: "str | None" = None
         body = None
         status = None
+        f0 = time.perf_counter()
         try:
             faults.check("router.forward")
             status, body = _http_json(
@@ -894,6 +1023,21 @@ class FleetRouter:
             )
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
+        f1 = time.perf_counter()
+        forward_ok = err is None and status == 200
+        with self._lock:
+            job.blame_acc["forward"] += max(0.0, f1 - f0)
+            # the monotone hop ordinal — job.attempts moves backwards
+            # on a 429 refund, so it cannot number the trace's hops
+            job.hops += 1
+            hop = job.hops
+        if self.telemetry is not None:
+            # every forward TRY is a hop span — a failed first hop plus
+            # a succeeded second is exactly the re-route story
+            self.telemetry.request_span(
+                job, "forward", f0, f1,
+                replica=replica.rid, attempt=hop, ok=forward_ok,
+            )
         now = time.time()
         if err is None and status == 200 and isinstance(body, dict):
             with self._lock:
@@ -940,6 +1084,10 @@ class FleetRouter:
                     job.state = "queued"
                     job.replica = None
                     job.attempts -= 1
+                    # the wait until the next forward is a THROTTLE
+                    # backoff, not a plain queue wait — blame it as such
+                    job.queue_enter_mono = time.perf_counter()
+                    job.backoff_pending = True
                     self._enqueue_locked(job, front=True)
                 self._cond.notify_all()
             return
@@ -984,6 +1132,9 @@ class FleetRouter:
                 job.replica = None
                 job.replica_job_id = None
                 job.poll_fails = 0
+                # a fresh queue wait opens for the re-route hop
+                job.queue_enter_mono = time.perf_counter()
+                job.backoff_pending = False
                 self._enqueue_locked(job, front=True)
                 self._cond.notify_all()
         if exhausted:
@@ -995,6 +1146,26 @@ class FleetRouter:
                 from_replica=None,
             )
 
+    @staticmethod
+    def _blame_split(acc: dict, latency_s: float) -> dict:
+        """The router-observed blame partition: the accumulated
+        router-side components (queue waits, backoffs, forward hops,
+        the result relay), with the REPLICA's share the exact residual
+        — so the components sum to ``latency_s`` by construction (the
+        ``request_done`` value lint pins it).  A wall-clock step that
+        leaves the monotonic accumulators over the wall latency scales
+        them down proportionally rather than emitting a negative
+        residual."""
+        comps = {k: v for k, v in acc.items() if v > 1e-9}
+        used = sum(comps.values())
+        latency_s = max(0.0, latency_s)
+        if used > latency_s:
+            scale = latency_s / used if used > 0 else 0.0
+            comps = {k: v * scale for k, v in comps.items()}
+            used = latency_s
+        comps["replica"] = latency_s - used
+        return {k: round(v, 6) for k, v in sorted(comps.items())}
+
     def _finish_job(
         self,
         job: RouterJob,
@@ -1003,9 +1174,23 @@ class FleetRouter:
         from_replica: "_Replica | None",
         snap: "dict | None" = None,
     ) -> None:
+        open_queue: "tuple[float, float, str] | None" = None
         with self._lock:
             if job.state in TERMINAL_STATES:
                 return
+            if job.state == "queued":
+                # terminal while still queued (cancel / shutdown): the
+                # open queue wait closes into the blame here — nothing
+                # else ever will
+                now_m = time.perf_counter()
+                comp = (
+                    "throttle_backoff" if job.backoff_pending
+                    else "route_queue"
+                )
+                job.blame_acc[comp] += max(
+                    0.0, now_m - job.queue_enter_mono
+                )
+                open_queue = (job.queue_enter_mono, now_m, comp)
             job.state = state
             job.error = error if error is not None else job.error
             if snap is not None:
@@ -1015,6 +1200,19 @@ class FleetRouter:
             if from_replica is not None:
                 from_replica.inflight.discard(job.job_id)
             wall_s = job.finished_t - job.submitted_t
+            blame = self._blame_split(job.blame_acc, wall_s)
+            hops = job.hops
+            self._recent_requests.append({
+                "trace_id": job.trace_id,
+                "job_id": job.job_id,
+                "tenant": job.tenant,
+                "status": state,
+                "latency_s": round(wall_s, 6),
+                "blame": blame,
+                "hops": hops,
+                "replica": job.replica,
+                "finished_t": job.finished_t,
+            })
             self._cond.notify_all()
         log.info(
             "job %s %s in %.2fs%s",
@@ -1022,6 +1220,10 @@ class FleetRouter:
             f" ({job.error})" if job.error else "",
         )
         if self.telemetry is not None:
+            if open_queue is not None:
+                self.telemetry.request_span(job, open_queue[2],
+                                            open_queue[0], open_queue[1])
+            self.telemetry.request_done(job, wall_s, blame, hops)
             self.telemetry.job_done(job, wall_s)
 
     # -- the control loop (health, polls, autoscale) -----------------------
@@ -1144,6 +1346,7 @@ class FleetRouter:
         if replica is None:
             self._requeue_job(job, None, "replica record vanished")
             return
+        p0 = time.perf_counter()
         try:
             status, snap = _http_json(
                 "GET", f"{replica.base}/jobs/{job.replica_job_id}"
@@ -1173,7 +1376,9 @@ class FleetRouter:
             return
         if status != 200 or not isinstance(snap, dict):
             return
+        p1 = time.perf_counter()
         terminal = snap.get("state") in TERMINAL_STATES
+        relayed = False
         with self._lock:
             job.poll_fails = 0
             job.snap = snap
@@ -1181,7 +1386,15 @@ class FleetRouter:
                 # routing FEEDBACK: the shape ran here, its programs
                 # are resident — confirm the sticky key as warm
                 replica.note_key_locked(job.key)
+                # the poll that DISCOVERED the terminal state is the
+                # result relay — the last router-side hop of the journey
+                job.blame_acc["relay"] += max(0.0, p1 - p0)
+                relayed = True
         if terminal:
+            if relayed and self.telemetry is not None:
+                self.telemetry.request_span(
+                    job, "relay", p0, p1, replica=replica.rid,
+                )
             self._finish_job(
                 job, snap["state"], snap.get("error"),
                 from_replica=replica, snap=snap,
@@ -1361,6 +1574,20 @@ class FleetRouter:
                 log.warning("cancel forward failed: %s", e)
         return snap
 
+    def debug_requests(self) -> list:
+        """Recent terminal requests, slowest first: each row's
+        ``trace_id`` + router blame split is assemblable into the full
+        cross-layer trace via ``tools/lt_request.py``."""
+        with self._lock:
+            recent = list(self._recent_requests)
+        recent.sort(
+            key=lambda r: -(
+                r["latency_s"]
+                if isinstance(r["latency_s"], (int, float)) else 0.0
+            )
+        )
+        return recent
+
     def stats(self) -> dict:
         """The router ``/healthz`` body (``"router": true`` marks it so
         ``lt top`` renders the router view)."""
@@ -1532,6 +1759,9 @@ class _RouterAPIHandler(http.server.BaseHTTPRequestHandler):
         GET  /healthz           router state: tenant queues, replica
                                 table, scaler state ("router": true)
         GET  /metrics           the lt_router_* exposition
+        GET  /metrics/exemplars histogram bucket → recent trace_id rings
+        GET  /debug/requests    recent terminal requests, slowest first
+                                (trace_id, router blame split, hops)
     """
 
     server: _RouterAPIServer
@@ -1551,6 +1781,15 @@ class _RouterAPIHandler(http.server.BaseHTTPRequestHandler):
         path = self.path.split("?")[0].rstrip("/")
         if path == "/healthz":
             self._send_json(200, rt.stats())
+        elif path == "/metrics/exemplars":
+            if rt.telemetry is None:
+                self.send_error(404)
+                return
+            self._send_json(
+                200, {"exemplars": rt.telemetry.registry.exemplars()}
+            )
+        elif path == "/debug/requests":
+            self._send_json(200, {"requests": rt.debug_requests()})
         elif path == "/metrics":
             if rt.telemetry is None:
                 self.send_error(404)

@@ -49,6 +49,7 @@ __all__ = [
     "COMMON_OPTIONAL_FIELDS",
     "EVENT_FIELDS",
     "OPTIONAL_FIELDS",
+    "REQUEST_SPAN_STAGES",
     "EventLog",
     "events_path",
     "discover_event_files",
@@ -320,7 +321,30 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # `lt tune` and by every Run whose config resolved "auto" knobs.
     # Additive event type.
     "tune_profile": {"key": str, "source": str, "probes": int},
+    # --- end-to-end request tracing (obs/reqtrace) -----------------------
+    # one router-side segment of a request's journey: ``name`` is a
+    # stage from REQUEST_SPAN_STAGES (open vocabulary, like ``span``),
+    # ``start``/``end`` are monotonic-clock values on the emitting
+    # scope's anchor clock (the ``span`` convention), and ``trace_id``
+    # is the request correlation id minted at router (or serve)
+    # admission.  A ``forward`` span is ONE hop: it carries the target
+    # ``replica``, the ``attempt`` ordinal, and ``ok`` (a failed
+    # forward is a span too — the re-route story needs both hops).
+    # Additive event type.
+    "request_span": {"trace_id": str, "name": str, "start": _NUM, "end": _NUM},
+    # the request's terminal record at the router: the router-observed
+    # end-to-end ``latency_s`` (admission to terminal) and the
+    # router-side ``blame`` split — a consecutive partition of that
+    # latency (route_queue / throttle_backoff / forward / replica), so
+    # the components SUM to ``latency_s`` by construction (the value
+    # lint pins it).  ``hops`` counts forward attempts (>= 2 means the
+    # request was re-routed).  Additive event type.
+    "request_done": {"trace_id": str, "status": str, "latency_s": _NUM},
 }
+
+#: the request-span stage vocabulary, in journey order (open like
+#: SPAN_STAGES — unknown names still validate; consumers group by name)
+REQUEST_SPAN_STAGES = ("route_queue", "throttle_backoff", "forward", "relay")
 
 #: well-known OPTIONAL fields: type-checked when present, never required
 OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
@@ -408,13 +432,19 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     "scale_decision": {"replica": str, "queue_depth": int},
     "tune_probe": {"speedup": _NUM, "error": str, "knobs": dict},
     "tune_profile": {"age_s": _NUM, "knobs": dict, "groups": int},
+    "request_span": {"replica": str, "attempt": int, "tenant": str, "ok": bool},
+    "request_done": {"tenant": str, "hops": int, "blame": dict},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the
 #: serve layer stamps onto a whole run scope (``EventLog`` common
 #: fields), so any tile/write/rollup event can be attributed to the job
-#: that caused it.  Type-checked when present, never required.
-COMMON_OPTIONAL_FIELDS: dict[str, Any] = {"job_id": str}
+#: that caused it.  ``trace_id`` is ``job_id``'s cross-layer sibling:
+#: minted once at router (or serve) admission and carried through the
+#: forward payload into the job's run scope, so router spans, serve
+#: lifecycle events, and per-tile run events all join on one id.
+#: Type-checked when present, never required.
+COMMON_OPTIONAL_FIELDS: dict[str, Any] = {"job_id": str, "trace_id": str}
 
 
 def events_path(workdir: str, process_index: int = 0, process_count: int = 1) -> str:

@@ -41,6 +41,7 @@ __all__ = [
     "PromFileExporter",
     "MetricsHTTPServer",
     "DEFAULT_LATENCY_BUCKETS",
+    "EXEMPLAR_RING",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -158,12 +159,25 @@ class Gauge(_Metric):
         return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self._value)}"]
 
 
+#: per-bucket exemplar-ring bound: enough recent trace ids to resolve
+#: "the p99 bucket" to concrete requests, small enough that exemplar
+#: state stays O(buckets) per histogram
+EXEMPLAR_RING = 4
+
+
 class Histogram(_Metric):
     """Fixed-bucket histogram (Prometheus ``histogram``).
 
     Buckets are chosen at construction (no dynamic rebinning — exposition
     must stay append-consistent across scrapes); observations above the
     last bound land in ``+Inf`` only, per the exposition contract.
+
+    Observations may carry an **exemplar** (a trace id): each bucket
+    keeps a bounded ring of the most recent ``(exemplar, value)`` pairs
+    it absorbed, so a tail bucket names concrete requests an operator
+    can go assemble (``tools/lt_request.py``) instead of an anonymous
+    count.  Exemplar state is created lazily on the first exemplar'd
+    observation — plain ``observe(v)`` paths pay nothing.
     """
 
     kind = "histogram"
@@ -179,17 +193,48 @@ class Histogram(_Metric):
         self._counts = [0] * (len(bounds) + 1)  # +Inf last
         self._sum = 0.0
         self._count = 0
+        #: lazily-created per-bucket exemplar rings (newest last)
+        self._ex: "list[list] | None" = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: "str | None" = None) -> None:
         v = float(v)
         with self._lock:
             self._sum += v
             self._count += 1
+            idx = len(self.bounds)
             for i, b in enumerate(self.bounds):
                 if v <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+                    idx = i
+                    break
+            self._counts[idx] += 1
+            if exemplar is not None:
+                if self._ex is None:
+                    self._ex = [[] for _ in range(len(self.bounds) + 1)]
+                ring = self._ex[idx]
+                ring.append((str(exemplar), v))
+                if len(ring) > EXEMPLAR_RING:
+                    del ring[0]
+
+    def _exemplars_locked(self) -> "dict[str, list] | None":
+        """The ring→JSON shaping (caller holds the shared lock) — ONE
+        copy serving both the per-metric accessor and the registry dump
+        (which cannot re-take the shared non-reentrant lock)."""
+        if self._ex is None:
+            return None
+        out: "dict[str, list]" = {}
+        for i, ring in enumerate(self._ex):
+            if not ring:
+                continue
+            le = _fmt(self.bounds[i]) if i < len(self.bounds) else "+Inf"
+            out[le] = [{"trace_id": t, "value": v} for t, v in ring]
+        return out or None
+
+    def exemplars(self) -> "dict[str, list] | None":
+        """Per-bucket exemplar rings, ``le`` string → newest-last
+        ``[{"trace_id", "value"}, ...]`` (buckets with none omitted;
+        None when no observation ever carried an exemplar)."""
+        with self._lock:
+            return self._exemplars_locked()
 
     @property
     def count(self) -> int:
@@ -316,6 +361,28 @@ class MetricsRegistry:
                 else:
                     d["value"] = m._value
                 out.append(d)
+        out.sort(key=lambda d: (d["name"], sorted(d["labels"].items())))
+        return out
+
+    def exemplars(self) -> list:
+        """The ``/metrics``-adjacent exemplar JSON: one entry per
+        histogram that ever absorbed an exemplar'd observation —
+        ``name`` / ``labels`` / ``exemplars`` (``le`` → newest-last
+        ``[{"trace_id", "value"}, ...]``).  Uses the histograms'
+        ``_exemplars_locked`` under the shared lock, like
+        :meth:`snapshot` (the per-metric accessor would re-take the
+        same non-reentrant lock)."""
+        out: list = []
+        with self._lock:
+            for (name, _), m in self._metrics.items():
+                locked = getattr(m, "_exemplars_locked", None)
+                rings = locked() if locked is not None else None
+                if rings:
+                    out.append({
+                        "name": name,
+                        "labels": dict(m.labels),
+                        "exemplars": rings,
+                    })
         out.sort(key=lambda d: (d["name"], sorted(d["labels"].items())))
         return out
 

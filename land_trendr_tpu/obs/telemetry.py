@@ -60,6 +60,7 @@ class Telemetry:
         metrics_host: str = "",
         metrics_interval_s: float = 5.0,
         job_id: str | None = None,
+        trace_id: str | None = None,
         flight=None,
         publish_dir: str | None = None,
         publish_interval_s: float = 5.0,
@@ -75,17 +76,25 @@ class Telemetry:
         self._publish_interval_s = publish_interval_s
         self._publish_probes = publish_probes
         self._publisher = None
-        # serve mode threads the job id onto EVERY event of this run's
-        # scope (an EventLog common field — schema-optional everywhere),
-        # so a cross-job fold can attribute tile traffic per request.
-        # ``flight`` (an obs.flight.FlightRecorder) mirrors every emit
-        # into the in-memory ring behind the /debug surface — the run's
-        # own ring on --flight runs, the SERVER's shared ring in serve
-        # mode (so job tile traffic shows up in /debug/flight live).
+        # serve mode threads the job id — and the fleet-wide trace id
+        # minted at router/serve admission — onto EVERY event of this
+        # run's scope (EventLog common fields, schema-optional
+        # everywhere), so a cross-job fold attributes tile traffic per
+        # request and tools/lt_request.py joins the run scope to the
+        # router's request spans.  ``flight`` (an
+        # obs.flight.FlightRecorder) mirrors every emit into the
+        # in-memory ring behind the /debug surface — the run's own ring
+        # on --flight runs, the SERVER's shared ring in serve mode (so
+        # job tile traffic shows up in /debug/flight live).
         self.flight = flight
+        common: dict | None = {}
+        if job_id:
+            common["job_id"] = job_id
+        if trace_id:
+            common["trace_id"] = trace_id
         self.events = EventLog(
             events_path(workdir, process_index, process_count),
-            common={"job_id": job_id} if job_id else None,
+            common=common or None,
             mirror=flight.record if flight is not None else None,
         )
         try:

@@ -983,6 +983,7 @@ class Run:
         mesh: "jax.sharding.Mesh | None" = None,
         *,
         job_id: "str | None" = None,
+        trace_id: "str | None" = None,
         cancel: "threading.Event | None" = None,
         programs=None,
         shared_store=None,
@@ -1007,6 +1008,10 @@ class Run:
             else plan_tiles(*stack.shape, cfg.tile_size)
         )
         self.job_id = job_id
+        #: the request-tracing correlation id (minted at router/serve
+        #: admission) — stamped beside job_id onto every event of this
+        #: run's scope, never part of the config or the fingerprint
+        self.trace_id = trace_id
         self.cancel = cancel
         self.programs = programs
         self.shared_store = shared_store
@@ -1915,10 +1920,13 @@ class Run:
                     metrics_port=metrics_port,
                     metrics_host=cfg.metrics_host,
                     metrics_interval_s=cfg.metrics_interval_s,
-                    # serve mode: the job id rides EVERY event of this
-                    # run's scope, so a fleet-wide fold can attribute
-                    # tile traffic to the request that caused it
+                    # serve mode: the job id (and the fleet-wide trace
+                    # id) rides EVERY event of this run's scope, so a
+                    # fleet-wide fold can attribute tile traffic to the
+                    # request that caused it and lt_request can join
+                    # the run scope to the router's request spans
                     job_id=self.job_id,
+                    trace_id=self.trace_id,
                     flight=self.flight,
                     # fleet publish: the per-process snapshot feed the
                     # pod aggregate folds (lifecycle owned by the

@@ -118,6 +118,12 @@ class ServeConfig:
     flight_ring_events: int = 2048
     #: flight resource-sampler period, seconds
     sampler_interval_s: float = 5.0
+    #: request-tracing recency bound: how many recent TERMINAL requests
+    #: (trace id, latency split, status) ``GET /debug/requests`` serves,
+    #: slowest-first — the human half of the exemplar loop (the
+    #: ``/metrics/exemplars`` JSON is the machine half).  0 disables
+    #: the ring (the endpoint then answers an empty list).
+    request_ring: int = 64
     #: fleet telemetry plane (:mod:`land_trendr_tpu.obs` publish /
     #: aggregate / history / alerts): with ``telemetry``, the server
     #: periodically (1) snapshots its registry + queue/SLO state into
@@ -226,6 +232,10 @@ class ServeConfig:
         if self.sampler_interval_s <= 0:
             raise ValueError(
                 f"sampler_interval_s={self.sampler_interval_s} must be > 0"
+            )
+        if self.request_ring < 0:
+            raise ValueError(
+                f"request_ring={self.request_ring} must be >= 0 (0 = off)"
             )
         if self.publish and not self.telemetry:
             raise ValueError(

@@ -109,6 +109,13 @@ class JobRequest:
     #: ``workdir`` (fresh submissions get fresh jobs/<id>/work dirs)
     resume: bool = True
     run_overrides: "dict | None" = None
+    #: request-tracing correlation id: stamped by the fleet router at
+    #: ITS admission and carried through the forward payload, so a
+    #: re-routed submission keeps the original id; a direct submission
+    #: leaves it None and the server mints one at serve admission.
+    #: Deliberately EXCLUDED from the affinity key — two requests that
+    #: differ only in identity run the same programs.
+    trace_id: "str | None" = None
 
     #: the per-run knobs the server owns (shared cache/store) or that
     #: cannot mean anything inside a server process — rejected even via
@@ -176,6 +183,10 @@ class JobRequest:
             )
         if not req.tenant or not isinstance(req.tenant, str):
             raise ValueError("tenant must be a non-empty string")
+        if req.trace_id is not None and (
+            not isinstance(req.trace_id, str) or not req.trace_id
+        ):
+            raise ValueError("trace_id must be a non-empty string")
         overrides = req.run_overrides or {}
         if not isinstance(overrides, dict):
             raise ValueError("run_overrides must be a JSON object")
@@ -262,6 +273,9 @@ class Job:
     job_id: str
     request: JobRequest
     source: str = "http"  # "http" | "dropbox"
+    #: the request-tracing correlation id: the request's own (router
+    #: forwards carry it) or minted at serve admission for direct jobs
+    trace_id: str = ""
     state: str = "queued"
     submitted_t: float = dataclasses.field(default_factory=time.time)
     started_t: "float | None" = None
@@ -320,6 +334,7 @@ class Job:
         """JSON-safe snapshot; caller holds the server lock."""
         out = {
             "job_id": self.job_id,
+            "trace_id": self.trace_id,
             "state": self.state,
             "tenant": self.request.tenant,
             "priority": self.request.priority,

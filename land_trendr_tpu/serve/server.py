@@ -51,6 +51,7 @@ import logging
 import os
 import threading
 import time
+import uuid
 from typing import Any
 
 from land_trendr_tpu.io import blockcache
@@ -493,6 +494,7 @@ class _ServeTelemetry:
         self.events.emit(
             "job_submitted",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             tenant=job.request.tenant,
             priority=job.request.priority,
             queue_depth=queue_depth,
@@ -519,6 +521,7 @@ class _ServeTelemetry:
         self.events.emit(
             "job_start",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             tenant=job.request.tenant,
             wait_s=round(wait_s, 6),
         )
@@ -535,12 +538,16 @@ class _ServeTelemetry:
         self.events.emit(
             "job_done",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             status=job.state,
             wall_s=round(wall_s, 6),
             **fields,
         )
         self._running.set(0)
-        self._job_hist.observe(wall_s)
+        # the exemplar closes the metrics→traces loop: the latency
+        # bucket this job landed in remembers its trace_id, so "the
+        # p99 bucket" resolves to requests lt_request can assemble
+        self._job_hist.observe(wall_s, exemplar=job.trace_id or None)
         self._done_counter(job.state).inc()
 
     def job_slo(self, job: Job, slo: dict) -> None:
@@ -551,11 +558,13 @@ class _ServeTelemetry:
         self.events.emit(
             "job_slo",
             job_id=job.job_id,
+            trace_id=job.trace_id,
             tenant=job.request.tenant,
             **slo,
         )
-        self._queue_wait_hist.observe(slo["queue_wait_s"])
-        self._exec_hist.observe(slo["exec_s"])
+        ex = job.trace_id or None
+        self._queue_wait_hist.observe(slo["queue_wait_s"], exemplar=ex)
+        self._exec_hist.observe(slo["exec_s"], exemplar=ex)
         (self._slo_met if slo["met"] else self._slo_missed).inc()
         if "deadline_s" in slo:
             self._slo_window.append(bool(slo["met"]))
@@ -669,6 +678,12 @@ class SegmentationServer:
         #: probes alone (adoption, router restart)
         self._warm_keys: "collections.OrderedDict[str, float]" = (
             collections.OrderedDict()
+        )
+        #: recent TERMINAL requests (trace id, latency split, status) —
+        #: the /debug/requests window, newest last, bounded by
+        #: ``request_ring`` (mutated under the server lock)
+        self._recent_requests: collections.deque = collections.deque(
+            maxlen=cfg.request_ring  # 0 = an always-empty ring
         )
 
         # every teardown-touched handle exists BEFORE anything that can
@@ -890,7 +905,17 @@ class SegmentationServer:
                 if rejection is None:
                     self._seq += 1
                     job_id = f"job-{os.getpid()}-{self._seq:05d}"
-                    job = Job(job_id=job_id, request=req, source=source)
+                    job = Job(
+                        job_id=job_id,
+                        request=req,
+                        source=source,
+                        # the fleet router minted one at ITS admission
+                        # (the forward payload carries it, re-routes
+                        # included); a direct job mints here — either
+                        # way every event of the job's journey carries
+                        # ONE correlation id
+                        trace_id=req.trace_id or uuid.uuid4().hex[:16],
+                    )
                     job_root = os.path.join(
                         self.cfg.workdir, "jobs", job_id
                     )
@@ -983,19 +1008,68 @@ class SegmentationServer:
         return snap
 
     # -- the /debug surface ------------------------------------------------
-    def flight_snapshot(self, n: "int | None" = None) -> "dict | None":
+    def flight_snapshot(
+        self, n: "int | None" = None, trace_id: "str | None" = None
+    ) -> "dict | None":
         """The flight ring's recent window (None when telemetry or the
         ring is off): ring stats plus the newest ``n`` (default: all
         held) mirrored event records, oldest first.  ``held`` preserves
         the ring's occupancy (stats' integer ``events``), which the
-        record list — possibly truncated to ``n`` — replaces."""
+        record list — possibly truncated to ``n`` — replaces.  With
+        ``trace_id``, only records stamped with that id are kept (the
+        ring mirrors every emit, so a job's whole recent story filters
+        out of the shared window) — the filter applies BEFORE the ``n``
+        truncation, so "the last 50 events of THIS trace" means what it
+        says."""
         flight = self.telemetry.flight if self.telemetry is not None else None
         if flight is None:
             return None
         stats = flight.stats()
         stats["held"] = stats["events"]
-        stats["events"] = flight.snapshot(n)
+        recs = flight.snapshot()
+        if trace_id is not None:
+            recs = [
+                r for r in recs
+                if isinstance(r, dict) and r.get("trace_id") == trace_id
+            ]
+            stats["trace_id"] = trace_id
+            stats["matched"] = len(recs)
+        if n is not None and n > 0:
+            recs = recs[-n:]
+        stats["events"] = recs
         return stats
+
+    def _note_request_locked(self, job: Job, slo: dict) -> None:
+        """Fold one terminal job into the /debug/requests ring (caller
+        holds the server lock): the trace id, the replica-side latency
+        split (this server IS the replica — queue wait + exec is its
+        whole view), and the terminal status."""
+        self._recent_requests.append({
+            "trace_id": job.trace_id,
+            "job_id": job.job_id,
+            "tenant": job.request.tenant,
+            "status": job.state,
+            "latency_s": slo["latency_s"],
+            "blame": {
+                "replica_queue": slo["queue_wait_s"],
+                "exec": slo["exec_s"],
+            },
+            "finished_t": job.finished_t,
+        })
+
+    def debug_requests(self) -> list:
+        """Recent terminal requests, slowest first — the human half of
+        the exemplar loop (each row's ``trace_id`` is assemblable via
+        ``tools/lt_request.py``)."""
+        with self._lock:
+            recent = list(self._recent_requests)
+        recent.sort(
+            key=lambda r: -(
+                r["latency_s"]
+                if isinstance(r["latency_s"], (int, float)) else 0.0
+            )
+        )
+        return recent
 
     def debug_jobs(self) -> list:
         """Per-job live state: the status snapshot plus — for a running
@@ -1091,12 +1165,13 @@ class SegmentationServer:
                 job.cancel.set()
             snap = job.status_locked()
         if finished is not None:
+            with self._lock:
+                slo = finished.slo_locked()
+                self._note_request_locked(finished, slo)
             if self.telemetry is not None:
                 self.telemetry.job_done(
                     finished, finished.finished_t - finished.submitted_t
                 )
-                with self._lock:
-                    slo = finished.slo_locked()
                 self.telemetry.job_slo(finished, slo)
             self._write_result(finished)
         with self._lock:
@@ -1211,6 +1286,7 @@ class SegmentationServer:
                 stack,
                 cfg,
                 job_id=job.job_id,
+                trace_id=job.trace_id,
                 cancel=job.cancel,
                 programs=self.programs,
                 shared_store=self.store,
@@ -1304,10 +1380,11 @@ class SegmentationServer:
             "job %s %s in %.2fs%s",
             job.job_id, state, wall_s, f" ({error})" if error else "",
         )
+        with self._lock:
+            slo = job.slo_locked()
+            self._note_request_locked(job, slo)
         if self.telemetry is not None:
             self.telemetry.job_done(job, wall_s)
-            with self._lock:
-                slo = job.slo_locked()
             self.telemetry.job_slo(job, slo)
             self.telemetry.program_cache(self.programs.stats())
         self._write_result(job)
@@ -1491,9 +1568,13 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
         POST /jobs/<id>/cancel  cancel (queued → terminal; running → event)
         GET  /healthz           liveness + queue/uptime/warm-program stats
         GET  /metrics           the lt_serve_* exposition
-        GET  /debug/flight      the flight ring's recent events (?n=100)
+        GET  /metrics/exemplars histogram bucket → recent trace_id rings
+        GET  /debug/flight      the flight ring's recent events
+                                (?n=100, ?trace=<trace_id> filter)
         GET  /debug/stacks      all-thread tracebacks (sys._current_frames)
         GET  /debug/jobs        per-job live state incl. run progress
+        GET  /debug/requests    recent terminal requests, slowest first
+                                (trace_id + replica-side latency split)
         POST /debug/profile     on-demand bounded jax.profiler capture
 
     The ``/debug`` surface shares the job API's loopback-only bind (it
@@ -1530,19 +1611,23 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
                 self.send_error(404)
                 return
             if path == "/debug/flight":
-                n = None
+                n = trace = None
                 try:
                     from urllib.parse import parse_qs
 
-                    raw = parse_qs(query).get("n")
+                    params = parse_qs(query)
+                    raw = params.get("n")
                     if raw:
                         n = max(1, int(raw[0]))
+                    rawt = params.get("trace")
+                    if rawt:
+                        trace = rawt[0]
                 except ValueError:
                     self._send_json(
                         400, {"error": "bad_request", "detail": "n must be int"}
                     )
                     return
-                snap = srv.flight_snapshot(n)
+                snap = srv.flight_snapshot(n, trace_id=trace)
                 if snap is None:
                     self._send_json(
                         404,
@@ -1557,8 +1642,18 @@ class _JobAPIHandler(http.server.BaseHTTPRequestHandler):
                 self._send_json(200, {"threads": thread_stacks()})
             elif path == "/debug/jobs":
                 self._send_json(200, {"jobs": srv.debug_jobs()})
+            elif path == "/debug/requests":
+                self._send_json(200, {"requests": srv.debug_requests()})
             else:
                 self.send_error(404)
+            return
+        if path == "/metrics/exemplars":
+            if srv.telemetry is None:
+                self.send_error(404)
+                return
+            self._send_json(
+                200, {"exemplars": srv.telemetry.registry.exemplars()}
+            )
             return
         if path == "/healthz":
             self._send_json(200, {"ok": True, **srv.stats()})

@@ -625,7 +625,8 @@ def test_burn_rate_window_survives_ring_flood(tmp_path):
     try:
         def job(i):
             return SimpleNamespace(
-                job_id=f"j{i}", request=SimpleNamespace(tenant="default")
+                job_id=f"j{i}", trace_id=f"trace{i:012d}",
+                request=SimpleNamespace(tenant="default"),
             )
 
         def slo(met, deadline=True):

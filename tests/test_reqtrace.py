@@ -1,0 +1,462 @@
+"""End-to-end request tracing tests (ISSUE 15).
+
+Pins the request-tracing plane's contracts:
+
+* **propagation**: a ``trace_id`` minted at router admission crosses
+  the real router→replica HTTP path into the job's run scope — every
+  event of the journey (router request spans, serve lifecycle, per-tile
+  run events) carries ONE id, and the second (warm) job's trace is just
+  as complete as the cold one's;
+* **blame algebra**: the priority-sweep partition assigns every instant
+  of the window to exactly one component, so the components sum to the
+  window length by construction — overlap, clipping, and gap cases;
+* **exemplars**: histogram observations carry trace ids into bounded
+  per-bucket rings, exposed as ``/metrics``-adjacent JSON, and a tail
+  bucket's exemplar resolves to an assemblable trace;
+* **lints**: the ``request_span``/``request_done`` value lints and the
+  stateful orphan-trace referential check (positives AND negatives);
+* the committed two-hop fixture stays schema-clean and assembles; the
+  ``lt_request``/``lt top`` CLIs smoke.
+
+Scene shape and params are shared with ``tests/test_serve.py`` /
+``tests/test_fleet_serve.py`` so the process-wide jit cache keeps the
+in-process replica warm across the suite.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.obs.events import (
+    REQUEST_SPAN_STAGES,
+    validate_events_file,
+)
+from land_trendr_tpu.obs.metrics import EXEMPLAR_RING, MetricsRegistry
+from land_trendr_tpu.obs.reqtrace import (
+    BLAME_PRIORITY,
+    assemble_request,
+    blame_partition,
+    discover_request_files,
+    list_requests,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+_FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "reqtrace.events.jsonl"
+)
+_FIXTURE_TRACE = "tr2hop0fixture01"
+
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("reqtrace_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+# ---------------------------------------------------------------------------
+# blame algebra
+
+
+def test_blame_partition_sums_exactly():
+    """The partition property: whatever the interval soup, the
+    components sum to the window length — it is a partition, not a sum
+    of overlapping stage totals."""
+    iv = [
+        ("forward", 1.0, 2.0),
+        ("compute", 1.5, 4.0),     # overlaps forward: forward wins 1.5-2
+        ("feed", 3.5, 6.0),        # overlaps compute: compute wins to 4
+        ("write", 100.0, 101.0),   # outside the window: clipped away
+    ]
+    b = blame_partition(iv, 0.0, 8.0)
+    assert abs(sum(b.values()) - 8.0) < 1e-12
+    assert b["forward"] == pytest.approx(1.0)
+    assert b["compute"] == pytest.approx(2.0)   # 2.0-4.0
+    assert b["feed"] == pytest.approx(2.0)      # 4.0-6.0
+    assert "write" in b or b.get("write") is None  # clipped → absent
+    assert "write" not in b
+    # uncovered instants are 'other': [0,1) + [6,8) = 3s
+    assert b["other"] == pytest.approx(3.0)
+
+
+def test_blame_partition_priority_and_edges():
+    # higher-priority component claims the overlap regardless of order
+    b = blame_partition(
+        [("feed", 0.0, 10.0), ("compute", 2.0, 4.0)], 0.0, 10.0
+    )
+    assert b["compute"] == pytest.approx(2.0)
+    assert b["feed"] == pytest.approx(8.0)
+    # empty/degenerate windows
+    assert blame_partition([], 5.0, 5.0) == {}
+    assert blame_partition([("feed", 0, 1)], 5.0, 4.0) == {}
+    # unknown components are ignored, not crashed on
+    b = blame_partition([("martian", 0.0, 1.0)], 0.0, 1.0)
+    assert b == {"other": pytest.approx(1.0)}
+    # every documented component is rankable
+    for comp in BLAME_PRIORITY:
+        assert blame_partition([(comp, 0.0, 1.0)], 0.0, 1.0) == {
+            comp: pytest.approx(1.0)
+        }
+
+
+# ---------------------------------------------------------------------------
+# histogram exemplars
+
+
+def test_histogram_exemplar_buckets_and_ring_bound():
+    reg = MetricsRegistry()
+    h = reg.histogram("lt_t_seconds", "t", buckets=(1.0, 10.0))
+    h.observe(0.5, exemplar="t-low")
+    h.observe(5.0, exemplar="t-mid")
+    h.observe(50.0, exemplar="t-inf")
+    ex = h.exemplars()
+    assert ex["1.0"][0]["trace_id"] == "t-low"
+    assert ex["10.0"][0]["trace_id"] == "t-mid"
+    assert ex["+Inf"][0]["trace_id"] == "t-inf"
+    # the ring is bounded: only the newest EXEMPLAR_RING survive
+    for i in range(EXEMPLAR_RING + 3):
+        h.observe(0.5, exemplar=f"t-{i}")
+    ring = h.exemplars()["1.0"]
+    assert len(ring) == EXEMPLAR_RING
+    assert ring[-1]["trace_id"] == f"t-{EXEMPLAR_RING + 2}"
+    # counts unaffected by exemplars; a plain observe records none
+    assert h.count == 3 + EXEMPLAR_RING + 3
+    h2 = reg.histogram("lt_plain_seconds", "p", buckets=(1.0,))
+    h2.observe(0.5)
+    assert h2.exemplars() is None
+    # registry-level dump lists only exemplar'd histograms
+    names = {e["name"] for e in reg.exemplars()}
+    assert names == {"lt_t_seconds"}
+
+
+# ---------------------------------------------------------------------------
+# schema + value lints
+
+
+def _lint(lines: list) -> list:
+    import tempfile
+
+    from check_events_schema import value_lints
+
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+        path = f.name
+    try:
+        return validate_events_file(path, extra=value_lints())
+    finally:
+        os.unlink(path)
+
+
+def _rs(**extra) -> dict:
+    return {
+        "ev": "run_start", "t_wall": 1.0, "t_mono": 1.0, "schema": 1,
+        "fingerprint": "route", "pid": 1, "host": "h",
+        "process_index": 0, "process_count": 1, "tiles_total": 0,
+        "tiles_todo": 0, "tiles_skipped_resume": 0, "mesh_devices": 0,
+        "impl": "route", **extra,
+    }
+
+
+def test_request_value_lints_positive_and_negative():
+    sub = {"ev": "job_submitted", "t_wall": 2.0, "t_mono": 2.0,
+           "job_id": "j1", "trace_id": "t1", "tenant": "a",
+           "priority": 0, "queue_depth": 1}
+    span = {"ev": "request_span", "t_wall": 3.0, "t_mono": 3.0,
+            "trace_id": "t1", "name": "forward", "start": 2.0,
+            "end": 3.0, "replica": "r0", "attempt": 1, "ok": True}
+    done = {"ev": "request_done", "t_wall": 4.0, "t_mono": 4.0,
+            "trace_id": "t1", "status": "done", "latency_s": 2.0,
+            "hops": 1,
+            "blame": {"forward": 1.0, "route_queue": 0.5,
+                      "replica": 0.5}}
+    assert _lint([_rs(), sub, span, done]) == []
+    # a span closing before it opens flags
+    bad = dict(span, start=5.0, end=4.0)
+    assert any("precedes start" in e for e in _lint([_rs(), sub, bad]))
+    # blame components NOT summing to the latency flag
+    bad = dict(done, blame={"forward": 0.1})
+    assert any("partition" in e for e in _lint([_rs(), sub, span, bad]))
+    # a routed request with no forward component flags
+    bad = dict(done, blame={"replica": 2.0})
+    assert any("'forward'" in e for e in _lint([_rs(), sub, span, bad]))
+    # negative blame components flag
+    bad = dict(done, blame={"forward": 3.0, "replica": -1.0})
+    assert any("negative" in e for e in _lint([_rs(), sub, span, bad]))
+
+
+def test_orphan_trace_lint():
+    span = {"ev": "request_span", "t_wall": 3.0, "t_mono": 3.0,
+            "trace_id": "t-orphan", "name": "forward", "start": 2.0,
+            "end": 3.0}
+    # an un-introduced trace_id on a span is an orphan
+    errs = _lint([_rs(), span])
+    assert any("orphan" in e for e in errs)
+    # introduction via job_submitted clears it
+    sub = {"ev": "job_submitted", "t_wall": 2.0, "t_mono": 2.0,
+           "job_id": "j1", "trace_id": "t-orphan", "tenant": "a",
+           "priority": 0, "queue_depth": 1}
+    assert _lint([_rs(), sub, span]) == []
+    # introduction via route_decision clears it too
+    rd = {"ev": "route_decision", "t_wall": 2.0, "t_mono": 2.0,
+          "job_id": "j1", "trace_id": "t-orphan", "tenant": "a",
+          "replica": "r0", "warm": False}
+    assert _lint([_rs(), rd, span]) == []
+    # a run scope's common-field stamp introduces via run_start (the
+    # job-run stream case: tile spans carry the id, run_start admits it)
+    tile_span = {"ev": "span", "t_wall": 3.0, "t_mono": 3.0,
+                 "trace_id": "t-run", "name": "feed", "tile_id": 0,
+                 "start": 2.0, "end": 3.0}
+    assert _lint([_rs(trace_id="t-run"), tile_span]) == []
+    assert any("orphan" in e for e in _lint([_rs(), tile_span]))
+    # a NEW scope resets the known set — the stale id orphans again
+    errs = _lint([_rs(), sub, span, _rs(), span])
+    assert any("orphan" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# the committed fixture + CLI smokes
+
+
+def test_fixture_lints_clean_and_assembles_two_hops():
+    from check_events_schema import main as lint_main
+
+    assert lint_main([_FIXTURE]) == 0
+    rec = assemble_request([_FIXTURE], _FIXTURE_TRACE)
+    assert rec["found"]
+    assert [h["replica"] for h in rec["hops"]] == ["r0", "r1"]
+    assert rec["hops"][0]["ok"] is False
+    assert rec["hops"][1]["ok"] is True
+    assert rec["latency_s"] == pytest.approx(5.1)
+    assert rec["blame_sum_s"] == pytest.approx(rec["latency_s"])
+    assert rec["router_blame"]["forward"] == pytest.approx(0.5)
+    # router-only streams assemble but are not COMPLETE (no run events)
+    assert rec["complete"] is False
+    # the request_done index finds it (slowest-first contract)
+    idx = list_requests([_FIXTURE])
+    assert idx[0]["trace_id"] == _FIXTURE_TRACE
+
+
+def test_lt_request_cli_smokes(tmp_path, capsys):
+    import lt_request
+
+    # assemble by id
+    assert lt_request.main([_FIXTURE_TRACE, _FIXTURE]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["trace_id"] == _FIXTURE_TRACE
+    assert len(rec["hops"]) == 2
+    # --list and --slowest need no id
+    assert lt_request.main(["--list", _FIXTURE]) == 0
+    idx = json.loads(capsys.readouterr().out)["requests"]
+    assert idx and idx[0]["trace_id"] == _FIXTURE_TRACE
+    chrome = str(tmp_path / "req_trace.json")
+    assert lt_request.main(["--slowest", _FIXTURE, "--trace", chrome]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["trace"]["events"] > 0
+    exported = json.loads(Path(chrome).read_text())
+    assert any(e.get("ph") == "X" for e in exported["traceEvents"])
+    # unknown trace → exit 1; missing path → exit 2
+    assert lt_request.main(["nope", _FIXTURE]) == 1
+    capsys.readouterr()
+    assert lt_request.main(["nope", str(tmp_path / "absent")]) == 2
+
+
+def test_obs_report_request_rollup():
+    import obs_report
+
+    report, spans = obs_report.fold([_FIXTURE])
+    rq = report["request"]
+    assert rq["requests"] == 1
+    assert rq["rerouted"] == 1
+    assert rq["by_status"] == {"done": 1}
+    assert rq["latency_s"]["p99"] == pytest.approx(5.1)
+    assert rq["by_component"]["forward"]["p50"] == pytest.approx(0.5)
+    # request spans ride the Chrome trace as req:* slices
+    tids = {s.get("tid") for s in spans}
+    assert "req:forward" in tids and "req:route_queue" in tids
+
+
+def test_lt_top_renders_trace_column():
+    import lt_top
+
+    view = lt_top.render_router({
+        "healthz": {"router": True, "uptime_s": 1.0, "queue_depth": 0,
+                    "routed": 0, "jobs_total": 1, "jobs_terminal": 1,
+                    "tenants": {}, "replicas": [], "scaler": None},
+        "metrics": [],
+        "jobs": [{"job_id": "rt-1-00001", "trace_id": _FIXTURE_TRACE,
+                  "state": "done", "tenant": "a", "replica": "r0",
+                  "attempts": 2, "submitted_t": time.time()}],
+        "requests": [{"trace_id": _FIXTURE_TRACE, "status": "done",
+                      "latency_s": 5.1, "hops": 2,
+                      "blame": {"forward": 0.5, "replica": 4.6}}],
+    })
+    assert "TRACE" in view
+    assert _FIXTURE_TRACE[:10] in view
+    assert "SLOWEST REQUESTS" in view and "forward=0.50s" in view
+
+
+def test_perf_gate_reqtrace_leg(tmp_path):
+    """The CI leg end-to-end: synthetic fleet streams lint clean, the
+    re-routed trace assembles two-hop with an exact blame sum, the
+    exemplar resolves, stamping stays inside the noise band."""
+    import perf_gate
+
+    checks: list = []
+    perf_gate.run_reqtrace_leg(
+        str(tmp_path),
+        lambda name, ok, detail: checks.append(
+            {"check": name, "ok": bool(ok), "detail": detail}
+        ),
+    )
+    failed = [c for c in checks if not c["ok"]]
+    assert not failed, failed
+    assert len(checks) == 7
+
+
+# ---------------------------------------------------------------------------
+# propagation end-to-end over the real router+replica HTTP path
+
+
+def test_request_propagation_end_to_end(stack_dir, tmp_path):
+    """Two same-shape jobs through a real FleetRouter over a real
+    (in-process) replica: ONE trace_id per request crosses router →
+    forward payload → serve admission → run scope; the warm second
+    job's trace is complete too; exemplars and /debug/requests resolve;
+    every stream lints clean (orphan lint included)."""
+    import threading as _threading
+
+    from check_events_schema import main as lint_main
+
+    from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+    from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+    server = SegmentationServer(ServeConfig(
+        workdir=str(tmp_path / "replica0"), feed_cache_mb=32,
+    ))
+    srv_thread = _threading.Thread(target=server.serve_forever)
+    srv_thread.start()
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir,
+        replicas=(f"http://127.0.0.1:{server.port}",),
+        health_interval_s=0.2,
+    ))
+    rt_thread = _threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    job = {"stack_dir": stack_dir, "tile_size": _TILE,
+           "params": dict(_PARAMS),
+           "run_overrides": {"retry_backoff_s": 0.0}}
+    try:
+        snaps = []
+        for _ in range(2):
+            snap = router.submit(dict(job))
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                s = router.job_status(snap["job_id"])
+                if s["state"] not in ("queued", "routed"):
+                    break
+                time.sleep(0.05)
+            snaps.append(s)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics/exemplars",
+            timeout=10,
+        ) as r:
+            exemplars = json.loads(r.read())["exemplars"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/requests", timeout=10
+        ) as r:
+            recent = json.loads(r.read())["requests"]
+    finally:
+        router.stop()
+        rt_thread.join(timeout=300)
+        server.stop()
+        srv_thread.join(timeout=120)
+
+    assert [s["state"] for s in snaps] == ["done", "done"]
+    traces = [s["trace_id"] for s in snaps]
+    assert len(set(traces)) == 2 and all(traces)
+    # every stream of the journey lints clean — the orphan-trace lint
+    # proves every stamped span resolves to its introduction
+    streams = [rt_dir, str(tmp_path / "replica0"),
+               *(s["workdir"] for s in snaps)]
+    assert lint_main(streams) == 0
+
+    files = [
+        f for root in streams for f in discover_request_files(root)
+    ]
+    for s in snaps:
+        rec = assemble_request(files, s["trace_id"])
+        assert rec["complete"], rec
+        assert len(rec["hops"]) == 1 and rec["hops"][0]["ok"] is True
+        # components are individually rounded to 6 dp, so the sum can
+        # sit a few microseconds off the independently-rounded latency
+        assert rec["blame_sum_s"] == pytest.approx(
+            rec["latency_s"], abs=1e-3
+        )
+        assert rec["tiles_done"] >= 1
+        # the run scope contributed pipeline components
+        assert {"compute", "forward"} <= set(rec["blame"])
+    # the WARM job (second) ran zero compiles yet its trace is complete
+    warm = snaps[1]["result"]["summary"]["program_cache"]
+    assert warm["misses"] == 0 and warm["hits"] == 1
+    warm_rec = assemble_request(files, traces[1])
+    assert warm_rec["complete"] and "compile" not in warm_rec["blame"]
+    # the run scope stamped the id on EVERY event (common-field check)
+    run_events = [
+        json.loads(line)
+        for line in Path(snaps[0]["workdir"], "events.jsonl")
+        .read_text().splitlines()
+    ]
+    assert run_events and all(
+        e.get("trace_id") == traces[0] for e in run_events
+    )
+    # exemplars: every ring entry is one of our traces, and the ring's
+    # trace assembles
+    ids = {
+        e2["trace_id"]
+        for entry in exemplars
+        for ring in entry["exemplars"].values()
+        for e2 in ring
+    }
+    assert ids and ids <= set(traces)
+    # /debug/requests: slowest-first rows with router blame splits
+    assert {r["trace_id"] for r in recent} == set(traces)
+    assert all(
+        abs(sum(r["blame"].values()) - r["latency_s"]) < 5e-3
+        for r in recent
+    )
+    lats = [r["latency_s"] for r in recent]
+    assert lats == sorted(lats, reverse=True)
+    # the request-span vocabulary showed up in the router stream
+    router_events = [
+        json.loads(line)
+        for line in Path(rt_dir, "events.jsonl").read_text().splitlines()
+    ]
+    span_names = {
+        e["name"] for e in router_events if e["ev"] == "request_span"
+    }
+    assert {"route_queue", "forward", "relay"} <= span_names
+    assert span_names <= set(REQUEST_SPAN_STAGES)
+    dones = [e for e in router_events if e["ev"] == "request_done"]
+    assert {e["trace_id"] for e in dones} == set(traces)

@@ -123,6 +123,12 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     # tune_value_errors below)
     "tune_probe": ("probes", "wall_s", "speedup"),
     "tune_profile": ("probes", "age_s", "groups"),
+    # end-to-end request tracing (obs/reqtrace): monotonic span bounds,
+    # end-to-end latencies and hop counts only go up / never negative
+    # (the blame-sum and orphan-trace checks live in
+    # request_value_errors / TraceRefLint below)
+    "request_span": ("start", "end", "attempt"),
+    "request_done": ("latency_s", "hops"),
 }
 
 
@@ -415,6 +421,110 @@ def tune_value_errors(rec, lineno: int) -> list[str]:
     return []
 
 
+#: slack for the request_done blame-sum cross-check: the blame is a
+#: partition whose components were each rounded to 6 dp (≤ 5 of them),
+#: and latency_s is rounded independently
+_BLAME_SLACK_S = 5e-3
+
+
+def request_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for the request-tracing events: a
+    ``request_span`` closes after it opens (same monotonic clock — the
+    ``span`` rule), and a ``request_done``'s blame components are a
+    PARTITION of its latency, so they must sum to ``latency_s`` within
+    rounding slack (the router computes the replica share as the exact
+    residual — a larger gap means a broken split).  Non-negativity
+    rides the generic loop."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    if ev == "request_span":
+        s, e = rec.get("start"), rec.get("end")
+        if _num(s) and _num(e) and e < s - _SPAN_SLACK_S:
+            return [
+                f"line {lineno}: request_span: end {e} precedes start "
+                f"{s} (a span closes after it opens)"
+            ]
+        return []
+    if ev == "request_done":
+        errs = []
+        hops = rec.get("hops")
+        blame, lat = rec.get("blame"), rec.get("latency_s")
+        if isinstance(blame, dict) and _num(lat):
+            vals = list(blame.values())
+            if all(_num(v) for v in vals):
+                for k, v in blame.items():
+                    if v < 0:
+                        errs.append(
+                            f"line {lineno}: request_done: blame "
+                            f"component {k!r} is negative ({v})"
+                        )
+                total = sum(vals)
+                if abs(total - lat) > _BLAME_SLACK_S:
+                    errs.append(
+                        f"line {lineno}: request_done: blame components "
+                        f"sum to {total} but latency_s is {lat} (the "
+                        "blame is a partition of the latency)"
+                    )
+        if _num(hops) and hops >= 1 and isinstance(blame, dict) \
+                and "forward" not in blame:
+            # a routed request spent time forwarding by definition
+            # (zero-hop requests — cancelled while queued — are the
+            # only blame splits without a forward component)
+            errs.append(
+                f"line {lineno}: request_done: hops {hops} with no "
+                "'forward' blame component"
+            )
+        return errs
+    return []
+
+
+class TraceRefLint:
+    """Referential-integrity lint for ``trace_id``, one instance per
+    file.
+
+    Stateful because the invariant is cross-event: every
+    ``trace_id``-stamped span in a stream must resolve to the event
+    that INTRODUCED that id — a ``job_submitted`` or ``route_decision``
+    carrying it (router and serve scopes), or the scope's own
+    ``run_start`` (a job run scope stamps the id as a common field, so
+    its ``run_start`` is the introduction).  An orphan span means a
+    producer stamped an id the stream never admitted — a broken
+    propagation chain.  ``run_start`` opens a new scope and resets the
+    known set (seeding it with its own stamp).
+    """
+
+    #: events that introduce a trace id into the scope
+    _INTRODUCERS = ("job_submitted", "route_decision")
+    #: span-like events whose trace_id must resolve
+    _CHECKED = ("request_span", "request_done", "span")
+
+    def __init__(self) -> None:
+        self._known: set = set()
+
+    def __call__(self, rec, lineno: int) -> list[str]:
+        if not isinstance(rec, dict):
+            return []
+        ev = rec.get("ev")
+        tid = rec.get("trace_id")
+        if ev == "run_start":
+            self._known.clear()
+            if isinstance(tid, str):
+                self._known.add(tid)
+            return []
+        if ev in self._INTRODUCERS and isinstance(tid, str):
+            self._known.add(tid)
+            return []
+        if ev in self._CHECKED and isinstance(tid, str) \
+                and tid not in self._known:
+            return [
+                f"line {lineno}: {ev}: trace_id {tid!r} was never "
+                "introduced in this scope (no job_submitted / "
+                "route_decision / run_start carries it — orphan trace)"
+            ]
+        return []
+
+
 #: the alert event's state vocabulary (mirrors
 #: land_trendr_tpu.obs.alerts.ALERT_STATES — asserted equal in
 #: tests/test_fleet.py so the two cannot drift)
@@ -490,6 +600,7 @@ def value_lints():
     """Fresh per-file ``extra`` hook chaining every value-level lint."""
     fetch_lint = FetchValueLint()
     alert_lint = AlertValueLint()
+    trace_lint = TraceRefLint()
 
     def extra(rec, lineno: int) -> list[str]:
         return (
@@ -502,7 +613,9 @@ def value_lints():
             + lease_value_errors(rec, lineno)
             + route_decision_value_errors(rec, lineno)
             + tune_value_errors(rec, lineno)
+            + request_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
+            + trace_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)
         )
 

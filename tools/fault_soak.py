@@ -80,6 +80,69 @@ def _run(stack, cfg):
     return run_stack(stack, cfg)
 
 
+def _assert_two_hop_trace(
+    trace_id: str, roots: list, expect_failed_first: bool
+) -> dict:
+    """Request-tracing assertions for a re-routed job (ISSUE 15): the
+    streams under ``roots`` must assemble into ONE complete trace with
+    exactly two forward hops under ``trace_id`` — the first failed
+    (forward fault) or succeeded (replica killed after accepting) per
+    ``expect_failed_first`` — and a blame partition that sums to the
+    router-observed latency with the re-route visible in it.  Returns
+    the assembled record (the soak report carries its highlights)."""
+    from tools.lt_request import expand_paths
+
+    from land_trendr_tpu.obs.reqtrace import assemble_request
+
+    # the CLI's expansion (fleet-layout discovery + ordered dedupe) —
+    # the soak must scan exactly the file set an operator's lt_request
+    # invocation would
+    files = expand_paths([str(r) for r in roots])
+    rec = assemble_request(files, trace_id)
+    if not rec["complete"]:
+        raise AssertionError(
+            f"reqtrace: trace {trace_id} did not assemble complete "
+            f"from {len(files)} stream(s): {rec}"
+        )
+    hops = rec["hops"]
+    if expect_failed_first:
+        # the deterministic forward-fault schedule: exactly two hops,
+        # the faulted try then the re-route
+        if len(hops) != 2:
+            raise AssertionError(
+                f"reqtrace: expected BOTH forward hops under one "
+                f"trace_id, got {hops}"
+            )
+        if hops[0]["ok"] is not False or hops[1]["ok"] is not True:
+            raise AssertionError(
+                f"reqtrace: expected failed-then-ok hops, got {hops}"
+            )
+    else:
+        # the SIGKILL path: >= 2 hops (a poll-retry may add one), the
+        # journey starting on the killed replica and ending elsewhere
+        if len(hops) < 2:
+            raise AssertionError(
+                f"reqtrace: expected a re-route hop under one "
+                f"trace_id, got {hops}"
+            )
+        if hops[0]["replica"] == hops[-1]["replica"]:
+            raise AssertionError(
+                f"reqtrace: re-route landed on the SAME replica: {hops}"
+            )
+    if abs(rec["blame_sum_s"] - rec["latency_s"]) > 5e-3:
+        raise AssertionError(
+            f"reqtrace: blame {rec['blame']} sums to "
+            f"{rec['blame_sum_s']} vs latency {rec['latency_s']}"
+        )
+    # the re-route is IN the blame: the second hop's queue wait and
+    # both forwards were partitioned out of the latency
+    if rec["blame"].get("forward", 0.0) <= 0:
+        raise AssertionError(
+            f"reqtrace: no forward share in the blame: {rec['blame']}"
+        )
+    return rec
+
+
 @dataclasses.dataclass
 class Case:
     name: str
@@ -727,6 +790,17 @@ def soak(
                             "router/forward: expected exactly the "
                             f"attempt-2 route_decision, got {decisions}"
                         )
+                    # request tracing (ISSUE 15): the re-routed request
+                    # assembles as ONE trace with BOTH forward hops —
+                    # the faulted first try ok=false, the re-route
+                    # ok=true — and a blame split summing to the
+                    # router-observed latency
+                    _assert_two_hop_trace(
+                        s["trace_id"],
+                        [rt_dir, str(root / "router_replica"),
+                         s["workdir"]],
+                        expect_failed_first=True,
+                    )
                 else:
                     downs = [
                         e for e in evs if e["ev"] == "replica_down"
@@ -835,6 +909,14 @@ def soak(
             raise AssertionError(
                 "router kill: artifacts differ from the clean run"
             )
+        # request tracing (ISSUE 15): the SIGKILLed job assembles as
+        # ONE trace — both forward hops (the killed replica's and the
+        # survivor's, distinct targets) under one trace_id, the
+        # re-route attributed in a blame split that sums to the
+        # router-observed latency; artifacts above stayed byte-identical
+        trace = _assert_two_hop_trace(
+            s["trace_id"], [rt_dir], expect_failed_first=False,
+        )
         report["cases"].append({
             "track": "router",
             "case": "replica_sigkill_rerouted",
@@ -842,6 +924,9 @@ def soak(
             "tiles_durable_before_kill": pre_kill,
             "route_attempts": s["attempts"],
             "artifacts_identical": True,
+            "trace_id": s["trace_id"],
+            "trace_hops": [h["replica"] for h in trace["hops"]],
+            "trace_blame": trace["blame"],
         })
         if verbose:
             print(

@@ -1,0 +1,179 @@
+"""Assemble ONE request's cross-layer trace: router → replica → tiles.
+
+The request-level consumer of the trace context
+(:mod:`land_trendr_tpu.obs.reqtrace`): give it a ``trace_id`` and the
+event streams it crossed — a router workdir expands to its own stream
+plus every spawned replica's and every pinned job workdir's — and it
+emits
+
+* a JSON **record** on stdout: the journey timeline (router queue wait
+  → route decision → each forward HOP with its target replica → replica
+  admission queue → compile → per-tile feed/upload/compute/fetch/write),
+  the hop list (a re-routed request shows BOTH forwards under the one
+  id), and the **blame decomposition** — a priority-sweep PARTITION of
+  the router-observed latency whose components sum to it by
+  construction (``blame_sum_s == latency_s``);
+* with ``--trace OUT.json``, a **Chrome trace-event file** of the
+  journey on one wall-aligned timeline (one trace process per stream,
+  one thread per blame component — ``obs_report.export_trace``, the
+  same writer ``lt_trace`` uses);
+* with ``--list``, the ``request_done`` index (slowest first) instead —
+  "which trace do I assemble": the bridge from a p99 histogram bucket's
+  exemplar ring (``/metrics/exemplars``, ``/debug/requests``) to a
+  concrete journey.
+
+Exit codes: 0 ok, 1 trace not found in the given streams, 2 usage/IO.
+
+Usage:
+    python tools/lt_request.py TRACE_ID ROUTER_WORKDIR [PATHS...]
+    python tools/lt_request.py --list ROUTER_WORKDIR
+    python tools/lt_request.py --slowest ROUTER_WORKDIR --trace out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import obs_report  # noqa: E402  (the shared Chrome-trace exporter)
+
+from land_trendr_tpu.obs.reqtrace import (  # noqa: E402
+    assemble_request,
+    discover_request_files,
+    list_requests,
+)
+
+
+def expand_paths(paths: "list[str]") -> "list[str]":
+    """CLI arguments → event streams: files pass through, a directory
+    expands to the fleet layout's streams (its own ``events*.jsonl``,
+    ``replicas/*/``, ``jobs/*/work/``).  Raises ``FileNotFoundError``
+    for a missing path or a stream-less directory."""
+    out: "list[str]" = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = discover_request_files(p)
+            if not found:
+                raise FileNotFoundError(f"no events*.jsonl under {p}")
+            out.extend(found)
+        elif os.path.exists(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(f"{p} does not exist")
+    # dedupe, keep order (a workdir given twice must not double-fold)
+    seen: set = set()
+    uniq = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+def trace_events(record: dict) -> "tuple[list[dict], list[dict]]":
+    """An assembled request → the ``obs_report.export_trace`` source
+    shape: one slice per timeline entry, keyed by source stream, with
+    the blame component as the trace thread."""
+    src: "list[dict]" = []
+    files = sorted({e["file"] for e in record.get("timeline", [])})
+    index = {f: i for i, f in enumerate(files)}
+    for e in record.get("timeline", []):
+        name = e["component"]
+        if e.get("tile") is not None:
+            name = f"{e['component']} tile {e['tile']}"
+        elif e.get("replica") is not None:
+            name = f"{e['component']} → {e['replica']}"
+        src.append({
+            "kind": "slice",
+            "file": index[e["file"]],
+            "tid": e["component"],
+            "name": name,
+            "t0": e["t0"],
+            "dur": e["dur"],
+            "args": {
+                k: e[k]
+                for k in ("replica", "attempt", "ok", "tile", "job_id")
+                if e.get(k) is not None
+            },
+        })
+    hosts = [{"process_index": f, "host": None} for f in files]
+    return src, hosts
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_id", nargs="?", default=None,
+                    help="the request correlation id to assemble (from "
+                    "/debug/requests, /metrics/exemplars, lt top's TRACE "
+                    "column, or a job status snapshot)")
+    ap.add_argument("paths", nargs="+",
+                    help="event streams: events*.jsonl files, or a "
+                    "router/serve workdir (expands to its own stream + "
+                    "replicas/*/ + jobs/*/work/)")
+    ap.add_argument("--list", action="store_true",
+                    help="list every request_done in the streams, "
+                    "slowest first, instead of assembling one")
+    ap.add_argument("--slowest", action="store_true",
+                    help="assemble the slowest request_done found "
+                    "(no trace_id needed — the p99 hunt's default)")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also export the journey as a chrome://tracing "
+                    "/ Perfetto trace")
+    args = ap.parse_args(argv)
+
+    if args.list or args.slowest:
+        # no trace_id needed: the first positional (if any) is a path
+        raw = [p for p in (args.trace_id, *args.paths) if p is not None]
+        try:
+            files = expand_paths(raw)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        index = list_requests(files)
+        if args.list:
+            print(json.dumps({"requests": index}, indent=2))
+            return 0
+        if not index:
+            print("error: no request_done in the given streams",
+                  file=sys.stderr)
+            return 1
+        trace_id = index[0]["trace_id"]
+    else:
+        if args.trace_id is None:
+            print("error: a TRACE_ID is required (or --list/--slowest)",
+                  file=sys.stderr)
+            return 2
+        trace_id = args.trace_id
+        try:
+            files = expand_paths(args.paths)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    record = assemble_request(files, trace_id)
+    if not record["found"]:
+        print(
+            f"error: trace {trace_id!r} not found in {len(files)} "
+            "stream(s)", file=sys.stderr,
+        )
+        return 1
+    if args.trace:
+        src, hosts = trace_events(record)
+        record["trace"] = {
+            "path": args.trace,
+            "events": obs_report.export_trace(src, hosts, args.trace),
+        }
+    record["files_scanned"] = files
+    print(json.dumps(record, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

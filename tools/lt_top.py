@@ -154,6 +154,12 @@ def snapshot(base: str) -> dict:
     except urllib.error.HTTPError:
         # debug surface off: fall back to the plain jobs listing
         snap["jobs"] = _get_json(base, "/jobs")["jobs"]
+    try:
+        # request tracing: recent terminal requests, slowest first
+        # (trace ids + blame splits; absent on debug-walled servers)
+        snap["requests"] = _get_json(base, "/debug/requests")["requests"]
+    except (urllib.error.HTTPError, KeyError):
+        snap["requests"] = []
     return snap
 
 
@@ -202,7 +208,8 @@ def render(snap: dict) -> str:
         )
     lines.append("")
     lines.append(
-        f"{'JOB':<22} {'STATE':<18} {'TENANT':<10} {'PRI':>3} "
+        f"{'JOB':<22} {'TRACE':<10} {'STATE':<18} {'TENANT':<10} "
+        f"{'PRI':>3} "
         f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'STRAG':>5} "
         f"{'STEAL':>5} {'SPEC':>4} {'BKLG f/w/x/u':>12} {'AGE':>6}"
     )
@@ -227,7 +234,8 @@ def render(snap: dict) -> str:
             state += "!SLO"
         age = now - job.get("submitted_t", now)
         lines.append(
-            f"{job.get('job_id', '?'):<22} {state:<18} "
+            f"{job.get('job_id', '?'):<22} "
+            f"{str(job.get('trace_id') or '-')[:10]:<10} {state:<18} "
             f"{job.get('tenant', '?'):<10} {job.get('priority', 0):>3} "
             f"{p.get('phase', '-'):<9} {tiles:>9} "
             f"{p.get('retries', '-') if p else '-':>5} "
@@ -311,19 +319,37 @@ def render_router(snap: dict) -> str:
         )
     lines.append("")
     lines.append(
-        f"{'JOB':<16} {'STATE':<18} {'TENANT':<10} {'REPLICA':<8} "
-        f"{'ATT':>3} {'AGE':>6}"
+        f"{'JOB':<16} {'TRACE':<10} {'STATE':<18} {'TENANT':<10} "
+        f"{'REPLICA':<8} {'ATT':>3} {'AGE':>6}"
     )
     for job in snap["jobs"]:
         age = now - job.get("submitted_t", now)
         lines.append(
-            f"{job.get('job_id', '?'):<16} {job.get('state', '?'):<18} "
+            f"{job.get('job_id', '?'):<16} "
+            f"{str(job.get('trace_id') or '-')[:10]:<10} "
+            f"{job.get('state', '?'):<18} "
             f"{job.get('tenant', '?'):<10} "
             f"{str(job.get('replica') or '-'):<8} "
             f"{job.get('attempts', 0):>3} {_fmt_age(age):>6}"
         )
     if not snap["jobs"]:
         lines.append("(no jobs)")
+    slow = (snap.get("requests") or [])[:5]
+    if slow:
+        lines.append("")
+        lines.append("SLOWEST REQUESTS (lt_request <trace> <workdir>):")
+        for r in slow:
+            blame = r.get("blame") or {}
+            split = " ".join(
+                f"{k}={v:.2f}s" for k, v in sorted(blame.items())
+                if isinstance(v, (int, float)) and v > 0
+            )
+            lines.append(
+                f"  {str(r.get('trace_id') or '?'):<18} "
+                f"{r.get('status', '?'):<10} "
+                f"{r.get('latency_s', 0):>8.2f}s  "
+                f"hops {r.get('hops', '-')}  {split}"
+            )
     return "\n".join(lines)
 
 

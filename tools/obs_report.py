@@ -12,7 +12,9 @@ per-process files) and it emits
   ``effective_gb_per_s`` — wire bytes over blocking wait seconds), the
   ingest-store rollup (store hits/puts with a derived hit rate), the
   per-tenant SLO rollup (p50/p95/p99 queue-wait and exec latency,
-  deadline hit-rate — from ``job_slo`` events), the resource high-water
+  deadline hit-rate — from ``job_slo`` events), the request-tracing
+  rollup (end-to-end latency distribution, re-route counts, p50/p95/p99
+  per blame component — from ``request_done``), the resource high-water
   section (RSS / fd / thread / backlog watermarks from the flight
   sampler's ``flight_sample`` series), and per-host rollups — schema
   lint and fold run in a SINGLE pass per file
@@ -108,6 +110,7 @@ def _fresh_scope() -> dict:
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
         "slo": None, "resources": None, "router": None, "tune": None,
+        "request": None,
     }
 
 
@@ -205,6 +208,45 @@ def _merge_router(folded: list[dict]) -> "dict | None":
         "replicas_down": dict(sorted(downs.items())),
         "scales": dict(sorted(scales.items())),
         "queue_wait_s": _stats([v for s in seen for v in s["queue_wait_s"]]),
+    }
+
+
+def _request_scope(cur: dict) -> dict:
+    """The lazily-created request-tracing sub-aggregate of one scope
+    (fed by ``request_done`` — the router's terminal request records)."""
+    if cur["request"] is None:
+        cur["request"] = {
+            "latency_s": [], "rerouted": 0, "by_status": {},
+            "blame": {},
+        }
+    return cur["request"]
+
+
+def _merge_request(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the request-tracing rollups (None when no
+    file's last scope carried a ``request_done``): the end-to-end
+    latency distribution, re-route counts, and p50/p95/p99 per blame
+    component — "where do slow requests spend their time", fleet-wide,
+    straight from the stream."""
+    seen = [c["request"] for c in folded if c["request"] is not None]
+    if not seen:
+        return None
+    by_status: dict = {}
+    blame: dict = {}
+    for s in seen:
+        for k, v in s["by_status"].items():
+            by_status[k] = by_status.get(k, 0) + v
+        for comp, vals in s["blame"].items():
+            blame.setdefault(comp, []).extend(vals)
+    lats = [v for s in seen for v in s["latency_s"]]
+    return {
+        "requests": len(lats),
+        "rerouted": sum(s["rerouted"] for s in seen),
+        "by_status": dict(sorted(by_status.items())),
+        "latency_s": _stats(lats),
+        "by_component": {
+            comp: _stats(vals) for comp, vals in sorted(blame.items())
+        },
     }
 
 
@@ -923,6 +965,67 @@ def fold(
                                 "replicas": rec.get("replicas"),
                             },
                         })
+                    elif ev == "request_span":
+                        # one router-side segment of a request's
+                        # journey (obs/reqtrace): start/end are
+                        # monotonic values on the scope's anchor clock
+                        rq_name = rec["name"]
+                        s0, s1 = rec["start"], rec["end"]
+                        dur = max(s1 - s0, 0.0)
+                        t0 = _mono_anchored(scopes, s0, tw - dur)
+                        cur["intervals"].append((t0, t0 + dur))
+                        spans.append({
+                            "kind": "slice", "file": fileno,
+                            "tid": f"req:{rq_name}",
+                            "name": (
+                                f"{rec.get('trace_id', '?')} "
+                                f"{rq_name}"
+                            ),
+                            "t0": t0, "dur": dur,
+                            "args": {
+                                k: rec.get(k)
+                                for k in (
+                                    "trace_id", "replica", "attempt", "ok",
+                                )
+                                if rec.get(k) is not None
+                            },
+                        })
+                    elif ev == "request_done":
+                        rd_lat, rd_status = (
+                            rec["latency_s"], rec["status"]
+                        )
+                        rq = _request_scope(cur)
+                        rq["latency_s"].append(rd_lat)
+                        rq["by_status"][rd_status] = (
+                            rq["by_status"].get(rd_status, 0) + 1
+                        )
+                        hops = rec.get("hops")
+                        if isinstance(hops, int) and not isinstance(
+                            hops, bool
+                        ) and hops > 1:
+                            rq["rerouted"] += 1
+                        bl = rec.get("blame")
+                        if isinstance(bl, dict):
+                            for comp, v in bl.items():
+                                if isinstance(v, (int, float)) and not \
+                                        isinstance(v, bool):
+                                    rq["blame"].setdefault(
+                                        comp, []
+                                    ).append(v)
+                        spans.append({
+                            "kind": "instant", "file": fileno,
+                            "tid": "jobs",
+                            "name": (
+                                f"REQUEST {rd_status} "
+                                f"{rec.get('trace_id', '?')}"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "latency_s": rd_lat,
+                                "hops": rec.get("hops"),
+                                "blame": bl,
+                            },
+                        })
                     elif ev == "tune_probe":
                         t = _tune_scope(cur)
                         ok, probes = rec["ok"], rec["probes"]
@@ -1053,6 +1156,7 @@ def fold(
         "ingest_store": _merge_ingest_store(folded),
         "serve": _merge_serve(folded),
         "router": _merge_router(folded),
+        "request": _merge_request(folded),
         "program_cache": _merge_program_cache(folded),
         "tune": _merge_tune(folded),
         "slo": _merge_slo(folded),

@@ -241,6 +241,276 @@ def run_trace_leg(workdir: str, check) -> None:
     )
 
 
+#: request-tracing leg: synthetic fleet shape (router stream + replica
+#: serve stream + pinned job run stream, ONE re-routed trace among many
+#: single-hop ones) and the bands.  The assembler floor reuses the
+#: trace leg's 5k ev/s convention — it fails an accidentally-quadratic
+#: fold, not a noisy container; the stamp-overhead ceiling is the
+#: FLIGHT baseline's documented noise band (trace stamping rides the
+#: same emit path the flight artifact bounded).
+REQTRACE_TRACES = 40
+REQTRACE_TILES = 20
+REQTRACE_MIN_EVENTS_PER_S = TRACE_MIN_EVENTS_PER_S
+
+
+def _synth_reqtrace_streams(workdir: str) -> "tuple[list[str], str]":
+    """Write a deterministic router + replica + run stream set:
+    REQTRACE_TRACES requests, the LAST one re-routed (two forward hops,
+    the first ok=false), every trace's run scope stamped with its id.
+    Returns ``(stream paths, the re-routed trace_id)``."""
+    import json as _json
+
+    aw, am = 1.75e9, 500.0
+    rt, sv, rn = [], [], []
+
+    def ev(recs, evname, dt, **fields):
+        recs.append({
+            "ev": evname, "t_wall": round(aw + dt, 6),
+            "t_mono": round(am + dt, 6), **fields,
+        })
+
+    def rs(recs, fp, **extra):
+        ev(recs, "run_start", extra.pop("dt", 0.0), schema=1,
+           fingerprint=fp, pid=7000, host="gate-fleet",
+           process_index=0, process_count=1, tiles_total=0, tiles_todo=0,
+           tiles_skipped_resume=0, mesh_devices=1, impl=fp,
+           run_id=f"gatereq{fp}", anchor_wall=aw, anchor_mono=am, **extra)
+
+    rs(rt, "route")
+    rs(sv, "serve")
+    rerouted_id = ""
+    t = 1.0
+    for i in range(REQTRACE_TRACES):
+        tid = f"gatetrace{i:04d}aaaa"
+        jid = f"rt-7000-{i:05d}"
+        two_hop = i == REQTRACE_TRACES - 1
+        if two_hop:
+            rerouted_id = tid
+        ev(rt, "job_submitted", t, job_id=jid, trace_id=tid,
+           tenant="agency", priority=0, queue_depth=1, source="http")
+        ev(rt, "request_span", t + 0.01, trace_id=tid, job_id=jid,
+           name="route_queue", start=round(am + t, 6),
+           end=round(am + t + 0.01, 6))
+        ev(rt, "request_span", t + 0.02, trace_id=tid, job_id=jid,
+           name="forward", start=round(am + t + 0.01, 6),
+           end=round(am + t + 0.02, 6), replica="r0", attempt=1,
+           ok=not two_hop)
+        fwd = 0.01
+        rq = 0.01
+        if two_hop:
+            ev(rt, "request_span", t + 0.04, trace_id=tid, job_id=jid,
+               name="route_queue", start=round(am + t + 0.02, 6),
+               end=round(am + t + 0.04, 6))
+            ev(rt, "request_span", t + 0.05, trace_id=tid, job_id=jid,
+               name="forward", start=round(am + t + 0.04, 6),
+               end=round(am + t + 0.05, 6), replica="r1", attempt=2,
+               ok=True)
+            fwd += 0.01
+            rq += 0.02
+        ev(rt, "route_decision", t + 0.05, job_id=jid, trace_id=tid,
+           tenant="agency", replica="r1" if two_hop else "r0",
+           warm=not two_hop, key="gatekey00000000",
+           attempt=2 if two_hop else 1)
+        # the replica side: admission + exec window
+        ev(sv, "job_submitted", t + 0.06, job_id=f"job-7000-{i:05d}",
+           trace_id=tid, tenant="agency", priority=0, queue_depth=1,
+           source="http")
+        ev(sv, "job_start", t + 0.08, job_id=f"job-7000-{i:05d}",
+           trace_id=tid, tenant="agency", wait_s=0.02)
+        ev(sv, "job_done", t + 0.48, job_id=f"job-7000-{i:05d}",
+           trace_id=tid, status="done", wall_s=0.42)
+        # terminal relay + request_done: blame is the router partition
+        ev(rt, "request_span", t + 0.5, trace_id=tid, job_id=jid,
+           name="relay", start=round(am + t + 0.49, 6),
+           end=round(am + t + 0.5, 6),
+           replica="r1" if two_hop else "r0")
+        lat = 0.5
+        blame = {
+            "route_queue": round(rq, 6), "forward": round(fwd, 6),
+            "relay": 0.01,
+        }
+        blame["replica"] = round(lat - sum(blame.values()), 6)
+        ev(rt, "request_done", t + lat, trace_id=tid, job_id=jid,
+           status="done", latency_s=lat, tenant="agency",
+           hops=2 if two_hop else 1, blame=blame)
+        ev(rt, "job_done", t + lat, job_id=jid, trace_id=tid,
+           status="done", wall_s=lat)
+        # the pinned run scope: a fresh scope per trace in ONE file
+        # (the resume-append layout lt_request folds), every event
+        # stamped with the trace id
+        rs(rn, "xla", dt=t + 0.1, job_id=f"job-7000-{i:05d}",
+           trace_id=tid)
+        tt = t + 0.1
+        for tile in range(REQTRACE_TILES):
+            ev(rn, "span", tt + 0.002, name="feed", tile_id=tile,
+               start=round(am + tt, 6), end=round(am + tt + 0.002, 6),
+               job_id=f"job-7000-{i:05d}", trace_id=tid)
+            ev(rn, "tile_start", tt + 0.003, tile_id=tile, attempt=1,
+               job_id=f"job-7000-{i:05d}", trace_id=tid)
+            ev(rn, "tile_done", tt + 0.015, tile_id=tile, px=400,
+               compute_s=0.012, px_per_s=33333.3, feed_backlog=0,
+               write_backlog=0, job_id=f"job-7000-{i:05d}",
+               trace_id=tid)
+            ev(rn, "write_done", tt + 0.017, tile_id=tile, bytes=1024,
+               record_s=0.002, job_id=f"job-7000-{i:05d}",
+               trace_id=tid)
+            tt += 0.018
+        ev(rn, "run_done", t + 0.47, status="ok",
+           tiles_done=REQTRACE_TILES, pixels=400 * REQTRACE_TILES,
+           wall_s=0.37, px_per_s=21621.6, fit_rate=0.8,
+           job_id=f"job-7000-{i:05d}", trace_id=tid)
+        t += 0.6
+
+    paths = []
+    for fname, recs in (
+        ("gate_req_router.events.jsonl", rt),
+        ("gate_req_serve.events.jsonl", sv),
+        ("gate_req_run.events.jsonl", rn),
+    ):
+        p = str(Path(workdir) / fname)
+        with open(p, "w") as f:
+            for r in recs:
+                f.write(_json.dumps(r, separators=(",", ":")) + "\n")
+        paths.append(p)
+    return paths, rerouted_id
+
+
+def run_reqtrace_leg(workdir: str, check) -> None:
+    """Request-tracing checks (obs/reqtrace + tools/lt_request).
+
+    Structural, exact: the synthetic fleet streams lint clean (orphan
+    lint included), the re-routed request assembles as ONE trace with
+    two forward hops on distinct replicas, the blame partition sums to
+    the router-observed latency exactly, and a histogram exemplar's
+    trace_id resolves to a complete assembled trace.  Banded: assembler
+    throughput (the trace leg's 5k ev/s convention) and the emit-path
+    cost of trace stamping inside the FLIGHT baseline's documented
+    noise band.  Callable on its own (``tests/test_reqtrace.py``)."""
+    import time as _time
+
+    from check_events_schema import value_lints
+
+    from land_trendr_tpu.obs.events import EventLog, validate_events_file
+    from land_trendr_tpu.obs.metrics import MetricsRegistry
+    from land_trendr_tpu.obs.reqtrace import assemble_request
+
+    stream_paths, rerouted_id = _synth_reqtrace_streams(workdir)
+    n_events = sum(
+        sum(1 for _ in open(p)) for p in stream_paths
+    )
+    lint_errs = [
+        e for p in stream_paths
+        for e in validate_events_file(p, extra=value_lints())
+    ]
+    check(
+        "reqtrace.streams_schema_valid", not lint_errs,
+        f"{n_events} synthetic fleet events lint clean "
+        f"({lint_errs[:2]})",
+    )
+    t0 = _time.perf_counter()
+    rec = assemble_request(stream_paths, rerouted_id)
+    assemble_s = _time.perf_counter() - t0
+    hops = rec.get("hops", [])
+    check(
+        "reqtrace.two_hop_structure",
+        rec.get("complete") is True and len(hops) == 2
+        and hops[0].get("ok") is False and hops[1].get("ok") is True
+        and hops[0].get("replica") != hops[1].get("replica"),
+        f"re-routed trace {rerouted_id}: {len(hops)} hop(s) "
+        f"{[h.get('replica') for h in hops]}, complete="
+        f"{rec.get('complete')}",
+    )
+    check(
+        "reqtrace.blame_sums_exact",
+        rec.get("latency_s") is not None
+        and abs(rec["blame_sum_s"] - rec["latency_s"]) <= 1e-3
+        and all(v >= 0 for v in rec["blame"].values()),
+        f"blame {rec.get('blame')} sums to {rec.get('blame_sum_s')} vs "
+        f"router-observed latency {rec.get('latency_s')}",
+    )
+    comps = set(rec.get("blame", {}))
+    check(
+        "reqtrace.blame_components_cross_layer",
+        {"forward", "route_queue", "replica_queue", "compute"} <= comps,
+        f"components span router AND replica layers: {sorted(comps)}",
+    )
+    ev_per_s = n_events / assemble_s if assemble_s > 0 else float("inf")
+    check(
+        "reqtrace.assembler_throughput",
+        ev_per_s >= REQTRACE_MIN_EVENTS_PER_S,
+        f"assembled across {n_events} events in {assemble_s:.3f}s "
+        f"({ev_per_s:,.0f} ev/s vs floor "
+        f"{REQTRACE_MIN_EVENTS_PER_S:,})",
+    )
+    # exemplar → trace loop: the bucket ring's trace_id must assemble
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "lt_gate_req_seconds", "g", buckets=(0.1, 1.0, 10.0)
+    )
+    for i in range(REQTRACE_TRACES):
+        hist.observe(0.5, exemplar=f"gatetrace{i:04d}aaaa")
+    hist.observe(5.0, exemplar=rerouted_id)  # the tail bucket
+    ex = {e["name"]: e["exemplars"] for e in reg.exemplars()}
+    tail = (ex.get("lt_gate_req_seconds") or {}).get("10.0") or []
+    resolved = (
+        assemble_request(stream_paths, tail[-1]["trace_id"])
+        if tail else {}
+    )
+    check(
+        "reqtrace.exemplar_resolves_to_trace",
+        bool(tail) and resolved.get("complete") is True,
+        f"tail-bucket exemplar {tail[-1]['trace_id'] if tail else None} "
+        "assembles to a complete cross-layer trace",
+    )
+    # stamp overhead: the trace context is two extra common fields on
+    # the emit path — min-of-reps cost vs the unstamped log must stay
+    # inside the flight artifact's documented noise band.  The legs
+    # INTERLEAVE (plain, stamped, plain, ...) so container scheduler
+    # drift hits both alike, and min-of-reps takes the cost floor
+    # (jitter only inflates wall time; a real regression — extra
+    # serialization work per emit — inflates the floor itself).
+    base = json.loads(FLIGHT_BASELINE.read_text())
+    band = float(base["noise_band_pct"])
+    reps, n_emit = 5, 3000
+    stamp = {"job_id": "job-1-00001", "trace_id": "gatetrace0000aaaa"}
+    plain_costs: "list[float]" = []
+    stamped_costs: "list[float]" = []
+    for r in range(reps):
+        for label, common, costs in (
+            ("plain", None, plain_costs),
+            ("stamped", stamp, stamped_costs),
+        ):
+            p = str(Path(workdir) / f"stamp_{label}_{r}.jsonl")
+            log = EventLog(p, common=common)
+            t0 = _time.perf_counter()
+            for i in range(n_emit):
+                # a production-shaped event (tile_done's field count):
+                # the stamping cost is judged against the events that
+                # actually dominate a run's stream
+                log.emit(
+                    "tile_done", tile_id=i, px=400, compute_s=0.012,
+                    px_per_s=33333.3, feed_backlog=1, write_backlog=0,
+                )
+            costs.append(_time.perf_counter() - t0)
+            log.close()
+    plain, stamped = min(plain_costs), min(stamped_costs)
+    delta_us = max(0.0, (stamped - plain) / n_emit * 1e6)
+    # the RUN-level claim (the FLIGHT artifact's framing): a tile emits
+    # ~7 events (the trace leg's convention), so the stamping cost per
+    # tile is delta x 7 — judged against even a FAST 10ms tile, it must
+    # sit inside the flight noise band.  (A per-emit ratio would gate
+    # json-serializer noise, not the run overhead the band is about.)
+    per_tile_pct = 100.0 * (delta_us * 1e-6 * 7) / 0.010
+    check(
+        "reqtrace.stamp_overhead",
+        per_tile_pct <= band,
+        f"trace stamping adds {delta_us:.1f}us/emit (min of {reps} "
+        f"interleaved reps x {n_emit} tile_done emits) — "
+        f"{per_tile_pct:.2f}% of a fast 10ms tile at ~7 events/tile, "
+        f"vs the FLIGHT noise band {band}%",
+    )
+
+
 #: fleet-telemetry leg: synthetic pod shape and the bands.  The
 #: aggregator floor is an order of magnitude under a cold local
 #: measurement (the fold parses 16 small JSON files): it fails an
@@ -755,6 +1025,7 @@ def run_gate(
         )
 
     run_trace_leg(workdir, check)
+    run_reqtrace_leg(workdir, check)
     run_fleet_leg(workdir, check)
     run_tune_leg(workdir, check)
     if scheduler:

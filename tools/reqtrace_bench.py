@@ -1,0 +1,317 @@
+"""Request-tracing bench: a fleet replay with a mid-trace replica kill.
+
+The acceptance driver for the request-tracing plane (ISSUE 15): replay
+a short multi-tenant job trace through a real
+:class:`~land_trendr_tpu.fleet.router.FleetRouter` over real spawned
+``lt serve`` replica processes, SIGKILL the replica holding in-flight
+work once its job has durable tiles, and prove — from the streams
+alone — that
+
+* the killed job reconstructs as **one trace with two forward hops**
+  (the killed replica's and the survivor's) under a single
+  ``trace_id``, with the re-route visible in its blame split;
+* every terminal request's **blame components sum to the
+  router-observed latency** (the partition property, checked per
+  request against the ``request_done`` record AND the full cross-layer
+  assembly);
+* the **p99 exemplar** closes the metrics→traces loop: the tail bucket
+  of ``lt_router_job_seconds`` (via ``/metrics/exemplars``) names a
+  ``trace_id`` that assembles to a complete cross-layer trace;
+* artifacts stay **byte-identical** across the kill (trace stamping is
+  pure observation — the fault_soak/fleet_bench contract).
+
+Writes the ``REQTRACE_*.json`` artifact of record.  Minutes-scale (two
+cold jax replica processes), like ``fleet_bench``:
+
+    python tools/reqtrace_bench.py --out REQTRACE_r16.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+from fleet_bench import _digest_workdir, _percentile  # noqa: E402
+
+from land_trendr_tpu.obs.reqtrace import (  # noqa: E402
+    assemble_request,
+    discover_request_files,
+)
+
+#: the fixed replay: (tenant, big scene?) in submission order — enough
+#: volume for a latency distribution, one heavy-tail job
+_TRACE = [
+    ("agency", False), ("agency", False), ("alerts", False),
+    ("agency", True), ("research", False), ("agency", False),
+    ("alerts", False), ("agency", False),
+]
+
+
+def run_bench(root: Path, size: int, years: int, tile: int) -> dict:
+    from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+    from land_trendr_tpu.io.synthetic import (
+        SceneSpec,
+        make_stack,
+        write_stack,
+    )
+
+    scenes = {}
+    for name, edge in (("small", size), ("big", size * 2)):
+        d = str(root / f"stack_{name}")
+        write_stack(d, make_stack(SceneSpec(
+            width=edge, height=edge, year_start=2000,
+            year_end=2000 + years - 1, seed=13,
+        )))
+        scenes[name] = d
+
+    rt_dir = str(root / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir,
+        spawn_replicas=2,
+        health_interval_s=0.3,
+        route_retries=3,
+        # pace dispatches so the kill lands mid-job with durable tiles
+        replica_args=(
+            "--feed-cache-mb", "64",
+            "--fault-schedule", "seed=5,dispatch%1.0=slow:0.3",
+        ),
+    ))
+    thread = threading.Thread(target=router.serve_forever)
+    thread.start()
+    killed_rid = killed_trace = None
+    submits: list = []
+    try:
+        deadline = time.monotonic() + 900
+        for idx, (tenant, big) in enumerate(_TRACE):
+            snap = router.submit({
+                "stack_dir": scenes["big" if big else "small"],
+                "tile_size": tile,
+                "tenant": tenant,
+                "params": {"max_segments": 4,
+                           "vertex_count_overshoot": 2},
+                "run_overrides": {"retry_backoff_s": 0.0},
+            })
+            submits.append(snap)
+            if idx == len(_TRACE) // 3 and killed_rid is None:
+                # SIGKILL the replica holding in-flight work, but only
+                # once a held job has durable tiles (the resume proof)
+                victim = vjob = None
+                while time.monotonic() < deadline and victim is None:
+                    with router._lock:
+                        for r in router.pool:
+                            if not (r.inflight and r.proc is not None
+                                    and r.proc.poll() is None):
+                                continue
+                            for jid in sorted(r.inflight):
+                                j = router._jobs.get(jid)
+                                if j is not None and list(
+                                    Path(j.workdir).glob("tile_*.npz")
+                                ):
+                                    victim, vjob = r, j
+                                    break
+                            if victim is not None:
+                                break
+                    if victim is None:
+                        time.sleep(0.05)
+                if victim is None:
+                    raise RuntimeError(
+                        "kill: no replica ever held a durable job"
+                    )
+                killed_rid, killed_trace = victim.rid, vjob.trace_id
+                victim.proc.send_signal(signal.SIGKILL)
+        # await every job terminal
+        pending = {s["job_id"] for s in submits}
+        results: dict = {}
+        while pending and time.monotonic() < deadline:
+            for jid in sorted(pending):
+                s = router.job_status(jid)
+                if s and s["state"] not in ("queued", "routed"):
+                    results[jid] = s
+            pending -= set(results)
+            if pending:
+                time.sleep(0.1)
+        if pending:
+            raise TimeoutError(f"jobs never finished: {pending}")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics/exemplars",
+            timeout=10,
+        ) as r:
+            exemplars = json.loads(r.read())["exemplars"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/debug/requests", timeout=10
+        ) as r:
+            recent = json.loads(r.read())["requests"]
+    finally:
+        router.stop()
+        thread.join(timeout=600)
+
+    # -- fold --------------------------------------------------------------
+    streams = discover_request_files(rt_dir)
+    states = [results[s["job_id"]] for s in submits]
+    lost = [s for s in states if s["state"] != "done"]
+    latencies = [r["latency_s"] for r in recent]
+
+    killed_job = next(
+        s for s in states if s["trace_id"] == killed_trace
+    )
+    killed_rec = assemble_request(streams, killed_trace)
+    hop_replicas = [h["replica"] for h in killed_rec["hops"]]
+
+    # per-request blame-sum check over EVERY terminal request: both the
+    # router's request_done split and the full cross-layer partition
+    blame_sums_ok = all(
+        abs(sum(r["blame"].values()) - r["latency_s"]) <= 5e-3
+        for r in recent
+    )
+    assembled = {
+        s["trace_id"]: assemble_request(streams, s["trace_id"])
+        for s in states
+    }
+    assembly_sums_ok = all(
+        abs(rec["blame_sum_s"] - rec["latency_s"]) <= 5e-3
+        for rec in assembled.values()
+    )
+    complete_ok = all(rec["complete"] for rec in assembled.values())
+
+    # the p99 exemplar: the highest occupied bucket of the router's
+    # job-latency histogram names a trace that must assemble complete
+    job_ex = next(
+        (e["exemplars"] for e in exemplars
+         if e["name"] == "lt_router_job_seconds"), {},
+    )
+    def _le(le: str) -> float:
+        return float("inf") if le == "+Inf" else float(le)
+    tail_le = max(job_ex, key=_le, default=None)
+    p99_trace = job_ex[tail_le][-1]["trace_id"] if tail_le else None
+    p99_rec = assembled.get(p99_trace) or (
+        assemble_request(streams, p99_trace) if p99_trace else {}
+    )
+
+    # artifact parity across the kill: the same spec's tiles are
+    # byte-identical wherever (and however many times) they ran
+    parity_ok = True
+    ref: dict = {}
+    for s in states:
+        spec = s["key"]
+        d = _digest_workdir(s["workdir"])
+        if not d:
+            parity_ok = False
+        elif spec not in ref:
+            ref[spec] = d
+        elif ref[spec] != d:
+            parity_ok = False
+
+    invariants = {
+        "zero_lost_jobs": not lost,
+        "killed_job_two_hops": (
+            len(killed_rec["hops"]) >= 2
+            and hop_replicas[0] == killed_rid
+            and hop_replicas[-1] != killed_rid
+        ),
+        "killed_job_one_trace": (
+            killed_job["attempts"] >= 2
+            and killed_rec["complete"] is True
+        ),
+        "blame_sums_to_latency": bool(
+            blame_sums_ok and assembly_sums_ok
+        ),
+        "all_traces_assemble_complete": complete_ok,
+        "p99_exemplar_assembles": (
+            p99_rec.get("complete") is True
+        ),
+        "artifact_parity_across_kill": bool(parity_ok and ref),
+    }
+    return {
+        "workload": {
+            "jobs": len(_TRACE),
+            "tenants": sorted({t for t, _ in _TRACE}),
+            "scene_small_px": size * size,
+            "scene_big_px": (size * 2) ** 2,
+            "years": years,
+            "tile_size": tile,
+            "replicas": 2,
+        },
+        "killed_replica": killed_rid,
+        "killed_trace": {
+            "trace_id": killed_trace,
+            "status": killed_job["state"],
+            "route_attempts": killed_job["attempts"],
+            "hops": killed_rec["hops"],
+            "latency_s": killed_rec["latency_s"],
+            "blame": killed_rec["blame"],
+            "blame_sum_s": killed_rec["blame_sum_s"],
+            "tiles_done": killed_rec["tiles_done"],
+        },
+        "p99_exemplar": {
+            "bucket_le": tail_le,
+            "trace_id": p99_trace,
+            "complete": p99_rec.get("complete"),
+            "latency_s": p99_rec.get("latency_s"),
+            "blame": p99_rec.get("blame"),
+        },
+        "latency": {
+            "p50_s": _percentile(latencies, 0.50),
+            "p99_s": _percentile(latencies, 0.99),
+        },
+        "requests_folded": len(recent),
+        "streams": len(streams),
+        "invariants": invariants,
+        "ok": all(invariants.values()),
+    }
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--size", type=int, default=40,
+                    help="small-scene edge px (big is 2x)")
+    ap.add_argument("--years", type=int, default=7)
+    ap.add_argument("--tile", type=int, default=20)
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep the bench workdirs under DIR")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON artifact here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    root = Path(args.keep or tempfile.mkdtemp(prefix="lt_reqtrace_"))
+    root.mkdir(parents=True, exist_ok=True)
+    try:
+        report = run_bench(root, args.size, args.years, args.tile)
+    finally:
+        if args.keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(json.dumps({
+        "ok": report["ok"],
+        "killed_replica": report["killed_replica"],
+        "killed_trace_hops": [
+            h["replica"] for h in report["killed_trace"]["hops"]
+        ],
+        "p99_exemplar": report["p99_exemplar"]["trace_id"],
+        "p99_s": report["latency"]["p99_s"],
+        "invariants": report["invariants"],
+    }))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
