@@ -666,10 +666,76 @@ def build_parser() -> argparse.ArgumentParser:
                      "recent terminal requests (trace id, router blame "
                      "split, hops) GET /debug/requests serves "
                      "slowest-first; 0 disables the ring")
+    rte.add_argument("--decision-log", action="store_true",
+                     help="record every dispatcher/autoscaler decision "
+                     "to WORKDIR/decisions.jsonl — the capacity "
+                     "planner's offline-replay source (soak/bench runs; "
+                     "grows with traffic)")
     rte.add_argument("--fault-schedule", default=None, metavar="SPEC",
                      help="deterministic fault injection for soak runs "
                      "(router.forward / replica.health seams); "
                      "production routers leave this unset")
+
+    lod = sub.add_parser(
+        "load",
+        help="load-generation rig: drive a running lt-route front door "
+        "with a seeded deterministic trace — open- or closed-loop "
+        "arrivals, heavy-tailed tenant mix, diurnal rate schedule — "
+        "and report every request's pinned trace id (README §Capacity "
+        "planning)",
+    )
+    lod.add_argument("--router-url", required=True, metavar="BASE",
+                     help="front-door base URL of the running router "
+                     "(http://127.0.0.1:PORT)")
+    lod.add_argument("--stack-dir", required=True, metavar="DIR",
+                     help="Landsat stack directory every submitted job "
+                     "segments (lt synth writes one)")
+    lod.add_argument("--tile-size", type=int, default=32,
+                     help="tile size of the submitted jobs")
+    lod.add_argument("--mode", default="closed",
+                     choices=["open", "closed"],
+                     help="arrival process: open (seeded Poisson "
+                     "schedule, offered rate independent of "
+                     "completions) or closed (submit → await terminal "
+                     "→ think → repeat)")
+    lod.add_argument("--duration-s", type=float, default=10.0,
+                     metavar="SEC", help="run length")
+    lod.add_argument("--qps", type=float, default=2.0, metavar="RATE",
+                     help="open-loop mean offered rate, requests/s "
+                     "(the diurnal wave modulates around it)")
+    lod.add_argument("--requests", type=int, default=0, metavar="N",
+                     help="total request budget; 0 = unbounded within "
+                     "--duration-s")
+    lod.add_argument("--workers", type=int, default=2, metavar="N",
+                     help="closed-loop virtual clients / open-loop "
+                     "dispatch width")
+    lod.add_argument("--seed", type=int, default=0,
+                     help="trace seed: the same seed+config "
+                     "regenerates the same arrivals, tenants and "
+                     "trace ids byte for byte")
+    lod.add_argument("--tenants", type=int, default=3, metavar="N",
+                     help="tenant population size (t0..tN-1)")
+    lod.add_argument("--tenant-skew", type=float, default=1.0,
+                     metavar="EXP",
+                     help="heavy-tail exponent of the tenant mix "
+                     "(weight of the k-th tenant is 1/k**EXP; 0 = "
+                     "uniform)")
+    lod.add_argument("--wave-amp", type=float, default=0.0,
+                     metavar="AMP",
+                     help="diurnal-wave amplitude in [0,1): rate is "
+                     "qps*(1+AMP*sin(2*pi*t/period)); 0 = flat")
+    lod.add_argument("--wave-period-s", type=float, default=60.0,
+                     metavar="SEC", help="diurnal-wave period")
+    lod.add_argument("--think-s", type=float, default=0.0, metavar="SEC",
+                     help="closed-loop think time between a completion "
+                     "and the next submission")
+    lod.add_argument("--timeout-s", type=float, default=120.0,
+                     metavar="SEC",
+                     help="per-request patience: a job not terminal "
+                     "after SEC counts failed")
+    lod.add_argument("--out", default=None, metavar="PATH",
+                     help="also write the full per-request outcome "
+                     "report JSON here")
 
     tun = sub.add_parser(
         "tune",
@@ -1145,6 +1211,7 @@ def main(argv: list[str] | None = None) -> int:
                 telemetry_dir=args.telemetry_dir,
                 metrics_interval_s=args.metrics_interval_s,
                 request_ring=args.request_ring,
+                decision_log=args.decision_log,
                 fault_schedule=args.fault_schedule,
             )
         except ValueError as e:
@@ -1186,6 +1253,78 @@ def main(argv: list[str] | None = None) -> int:
         except KeyboardInterrupt:
             pass
         return 0
+
+    if args.cmd == "load":
+        from land_trendr_tpu.loadgen import (
+            HttpClient,
+            LoadConfig,
+            LoadRunner,
+        )
+        from land_trendr_tpu.loadgen.trace import SHAPE_PARAMS
+
+        try:
+            lcfg = LoadConfig(
+                mode=args.mode,
+                duration_s=args.duration_s,
+                qps=args.qps,
+                requests=args.requests,
+                workers=args.workers,
+                seed=args.seed,
+                tenants=args.tenants,
+                tenant_skew=args.tenant_skew,
+                wave_amp=args.wave_amp,
+                wave_period_s=args.wave_period_s,
+                think_s=args.think_s,
+                timeout_s=args.timeout_s,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+        def _load_payload(req) -> dict:
+            return {
+                "stack_dir": args.stack_dir,
+                "tile_size": args.tile_size,
+                "tenant": req.tenant,
+                "params": dict(SHAPE_PARAMS[req.shape]),
+                "trace_id": req.trace_id,
+                "run_overrides": {"retry_backoff_s": 0.0},
+            }
+
+        runner = LoadRunner(
+            lcfg, HttpClient(args.router_url), _load_payload
+        )
+        report = runner.run(phase="load")
+        summary = {
+            "mode": report.mode,
+            "offered": report.offered,
+            "done": report.done,
+            "failed": report.failed,
+            "rejected": report.rejected,
+            "wall_s": round(report.wall_s, 3),
+            "trace_ids": report.trace_ids,
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(
+                    {
+                        **summary,
+                        "outcomes": [
+                            {
+                                "trace_id": o.trace_id,
+                                "tenant": o.tenant,
+                                "shape": o.shape,
+                                "outcome": o.outcome,
+                                "reason": o.reason,
+                                "latency_s": o.latency_s,
+                            }
+                            for o in report.outcomes
+                        ],
+                    },
+                    f, indent=2,
+                )
+        print(json.dumps(summary))
+        return 0 if report.failed == 0 else 1
 
     if args.cmd == "tune":
         return _run_tune(args)

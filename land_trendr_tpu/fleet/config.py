@@ -153,6 +153,11 @@ class RouterConfig:
     #: serves, slowest-first; the ``/metrics/exemplars`` JSON is the
     #: machine half of the same loop.  0 disables the ring.
     request_ring: int = 64
+    #: record every dispatcher/autoscaler decision (inputs AND outputs)
+    #: to ``<workdir>/decisions.jsonl`` — the capacity planner's replay
+    #: source (``land_trendr_tpu.fleet.capacity``); off by default: the
+    #: log grows with traffic and exists for soak/bench runs
+    decision_log: bool = False
     #: deterministic fault injection for soak runs (``router.forward``
     #: / ``replica.health`` seams plus everything in-process);
     #: production routers leave this unset

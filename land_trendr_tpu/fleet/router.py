@@ -69,6 +69,12 @@ from typing import Any
 
 from land_trendr_tpu.fleet.autoscale import Autoscaler
 from land_trendr_tpu.fleet.config import RouterConfig, parse_tenant_weights
+from land_trendr_tpu.fleet.scheduling import (
+    DECISIONS_NAME,
+    DecisionLog,
+    DrrQueue,
+    choose_replica,
+)
 from land_trendr_tpu.obs.events import EventLog
 from land_trendr_tpu.obs.metrics import MetricsRegistry, PromFileExporter
 from land_trendr_tpu.runtime import faults
@@ -449,6 +455,18 @@ class _RouterTelemetry:
             "job_rejected", reason=reason, queue_depth=queue_depth
         )
 
+    # the capacity rig's emitters, borrowed from the serve Telemetry
+    # bundle (they only touch ``self.events``): the load runner and
+    # sweep analyzer report through whichever plane drives them, and
+    # the single emit-site definition stays under the LT005 producer
+    # check in obs/telemetry.py
+    from land_trendr_tpu.obs.telemetry import Telemetry as _T
+
+    load_phase = _T.load_phase
+    sweep_point = _T.sweep_point
+    sim_replay = _T.sim_replay
+    del _T
+
     def tenant_throttled(
         self, tenant: str, reason: str, queue_depth: int
     ) -> None:
@@ -568,12 +586,11 @@ class FleetRouter:
         # the condition WRAPS self._lock (the serve-server discipline)
         self._cond = threading.Condition(self._lock)
         self._jobs: "dict[str, RouterJob]" = {}
-        #: per-tenant FIFO queues of queued job ids + the DRR state
-        self._tq: "dict[str, collections.deque]" = {}
-        self._deficit: "dict[str, float]" = {}
-        self._ring: "collections.deque[str]" = collections.deque()
+        #: tenant fair-share scheduling: the shared pure DRR core
+        #: (fleet/scheduling.py — the capacity simulator replays the
+        #: SAME class from the recorded decision log)
         self._weights = parse_tenant_weights(cfg.tenant_weights)
-        self._queued = 0
+        self._drr = DrrQueue(self._weights)
         self._terminal = 0
         self._seq = 0
         self._rid_seq = 0
@@ -604,6 +621,7 @@ class FleetRouter:
         # every teardown-touched handle predeclared, so _shutdown is
         # callable from any depth of a failed construction (LT008)
         self.telemetry: "_RouterTelemetry | None" = None
+        self._decisions: "DecisionLog | None" = None
         self._fault_plan = None
         self._httpd = None
         self._http_thread = None
@@ -615,6 +633,28 @@ class FleetRouter:
             if cfg.telemetry:
                 self.telemetry = _RouterTelemetry(
                     cfg, publish_probes=self._fleet_probes
+                )
+            if cfg.decision_log:
+                # recorded decision inputs+outputs — what the capacity
+                # replay simulator re-executes byte-identically
+                self._decisions = DecisionLog(
+                    os.path.join(cfg.workdir, DECISIONS_NAME)
+                )
+                self._decisions.record(
+                    "config",
+                    weights=self._weights,
+                    affinity=cfg.affinity,
+                    autoscale=(
+                        {
+                            "min_replicas": cfg.min_replicas,
+                            "max_replicas": cfg.max_replicas,
+                            "up_burn": cfg.scale_up_burn,
+                            "down_burn": cfg.scale_down_burn,
+                            "for_s": cfg.scale_for_s,
+                            "hold_s": cfg.scale_hold_s,
+                        }
+                        if cfg.autoscale else None
+                    ),
                 )
             if cfg.fault_schedule:
                 self._fault_plan = faults.activate(
@@ -761,14 +801,14 @@ class FleetRouter:
         except ValueError as e:
             if self.telemetry is not None:
                 with self._lock:
-                    depth = self._queued
+                    depth = self._drr.depth
                 self.telemetry.job_rejected("bad_request", depth)
             raise Rejection(400, "bad_request", str(e)) from None
         key = req.affinity_key()
         throttle = None
         snap = depth = job = None
         with self._lock:
-            depth = self._queued
+            depth = self._drr.depth
             if self._stopping:
                 throttle = (503, "shutting_down", "router is draining")
             elif depth >= self.cfg.route_queue_depth:
@@ -818,7 +858,7 @@ class FleetRouter:
                 # land ahead of the trace's introduction (the orphan
                 # the referential lint flags)
                 self._jobs[job_id] = job
-                depth = self._queued + 1  # the enqueue below joins it
+                depth = self._drr.depth + 1  # the enqueue below joins it
                 snap = job.status_locked()
         if throttle is not None:
             status, reason, detail = throttle
@@ -845,65 +885,35 @@ class FleetRouter:
         return snap
 
     def _enqueue_locked(self, job: RouterJob, front: bool = False) -> None:
-        q = self._tq.get(job.tenant)
-        if q is None:
-            q = self._tq[job.tenant] = collections.deque()
-        if not q and job.tenant not in self._ring:
-            self._ring.append(job.tenant)
-        (q.appendleft if front else q.append)(job.job_id)
-        self._queued += 1
+        self._drr.enqueue(job.tenant, job.job_id, front=front)
+        if self._decisions is not None:
+            # decision records stamp WALL time: the autoscale loop's
+            # convention, so one log's nows share a clock domain and the
+            # replay's recorded-span/speedup math is meaningful
+            self._decisions.record(
+                "enqueue", tenant=job.tenant, job_id=job.job_id,
+                front=front, now=time.time(),
+            )
 
     # -- fair-share scheduling (deficit round-robin) -----------------------
-    def _weight(self, tenant: str) -> float:
-        return self._weights.get(tenant, 1.0)
-
     def _pick_job_locked(self) -> "RouterJob | None":
-        """Deficit round-robin over the non-empty tenant queues: each
-        ring visit banks the tenant's weight; a banked deficit >= 1
-        buys one job (cost 1).  Bandwidth is therefore proportional to
-        weight, and any non-empty queue is served within a bounded
-        number of rotations — a heavy tenant cannot starve a light one.
-        """
-        guard = 0
-        while self._ring:
-            guard += 1
-            if guard > 100_000:  # pure defense; unreachable for w > 0
-                break
-            tenant = self._ring[0]
-            q = self._tq.get(tenant)
-            if not q:
-                self._ring.popleft()
-                self._deficit[tenant] = 0.0
-                continue
-            if self._deficit.get(tenant, 0.0) < 1.0:
-                # bank one quantum per ring visit; a sub-1 balance
-                # means this visit buys nothing yet — move on (a
-                # low-weight tenant is served every ceil(1/w) rotations)
-                self._deficit[tenant] = (
-                    self._deficit.get(tenant, 0.0) + self._weight(tenant)
-                )
-                if self._deficit[tenant] < 1.0:
-                    self._ring.rotate(-1)
-                    continue
-            self._deficit[tenant] -= 1.0
-            job_id = q.popleft()
-            self._queued -= 1
-            if not q:
-                # an emptied queue leaves the ring (and forfeits its
-                # bank — DRR's anti-burst rule)
-                self._ring.popleft()
-                self._deficit[tenant] = 0.0
-            elif self._deficit[tenant] < 1.0:
-                # the visit's bank is spent: rotate so the NEXT pick
-                # serves the next tenant (without this, a weight-1
-                # tenant would re-bank on the same visit and be served
-                # continuously — the exact starvation DRR prevents)
-                self._ring.rotate(-1)
-            job = self._jobs[job_id]
-            if job.state != "queued":  # cancelled while queued
-                continue
-            return job
-        return None
+        """Deficit round-robin over the non-empty tenant queues —
+        delegated to the shared pure core
+        (:class:`~land_trendr_tpu.fleet.scheduling.DrrQueue`, the one
+        copy the capacity replay simulator also runs).  Entries whose
+        job is no longer ``queued`` (cancelled in the submit gap) are
+        skipped; the skip consumes the queue slot."""
+        picked = self._drr.pick(
+            live=lambda jid: self._jobs[jid].state == "queued"
+        )
+        if picked is None:
+            return None
+        tenant, job_id = picked
+        if self._decisions is not None:
+            self._decisions.record(
+                "pick", tenant=tenant, job_id=job_id, now=time.time()
+            )
+        return self._jobs[job_id]
 
     # -- replica choice ----------------------------------------------------
     def _routable_locked(self, r: _Replica, now: float) -> bool:
@@ -918,15 +928,21 @@ class FleetRouter:
     ) -> "tuple[_Replica | None, bool]":
         now = time.monotonic()
         ready = [r for r in self.pool if self._routable_locked(r, now)]
-        if not ready:
+        # the choice itself is the shared pure function over the
+        # routable-candidate snapshot (fleet/scheduling.py) — the
+        # capacity simulator replays the SAME function on the recorded
+        # candidates
+        cands = [(r.rid, len(r.inflight), key in r.warm) for r in ready]
+        rid, warm = choose_replica(cands, self.cfg.affinity)
+        if self._decisions is not None and cands:
+            self._decisions.record(
+                "choose", key=key, affinity=self.cfg.affinity,
+                candidates=[list(c) for c in cands],
+                chosen=rid, warm=warm, now=time.time(),
+            )
+        if rid is None:
             return None, False
-        if self.cfg.affinity:
-            warm = [r for r in ready if key in r.warm]
-            if warm:
-                warm.sort(key=lambda r: (len(r.inflight), r.rid))
-                return warm[0], True
-        ready.sort(key=lambda r: (len(r.inflight), r.rid))
-        return ready[0], False
+        return next(r for r in ready if r.rid == rid), warm
 
     # -- the dispatcher ----------------------------------------------------
     def serve_forever(self) -> None:
@@ -951,7 +967,7 @@ class FleetRouter:
                 if self._stopping:
                     return None
                 job = None
-                if self._ring:
+                if self._drr.pending:
                     # peek capacity BEFORE consuming a queue entry: a
                     # popped job with no replica to take it would lose
                     # its DRR slot
@@ -1044,7 +1060,7 @@ class FleetRouter:
                 job.replica_job_id = body.get("job_id")
                 job.routed_t = now
                 job.snap = body
-                depth = self._queued
+                depth = self._drr.depth
                 # a cancel that landed while the forward was in flight
                 # (replica_job_id still None) had nowhere to go — honor
                 # it now that the replica id exists
@@ -1434,7 +1450,7 @@ class FleetRouter:
         if self.scaler is None:
             return None
         with self._lock:
-            queue_depth = self._queued
+            queue_depth = self._drr.depth
             spawned_live = [
                 r for r in self.pool
                 if r.spawned and r.state in ("starting", "ready", "unready")
@@ -1442,6 +1458,12 @@ class FleetRouter:
             decision = self.scaler.decide(
                 burn, queue_depth, len(spawned_live), now
             )
+            if self._decisions is not None:
+                self._decisions.record(
+                    "autoscale", burn=burn, queue_depth=queue_depth,
+                    replicas=len(spawned_live), now=now,
+                    decision=decision,
+                )
         if decision == "up":
             replica = self._launch_replica_proc()
             if self.telemetry is not None:
@@ -1549,11 +1571,12 @@ class FleetRouter:
                 return None
             job.cancel_requested = True
             if job.state == "queued":
-                try:
-                    self._tq[job.tenant].remove(job_id)
-                    self._queued -= 1
-                except (KeyError, ValueError):
-                    pass
+                removed = self._drr.remove(job.tenant, job_id)
+                if self._decisions is not None:
+                    self._decisions.record(
+                        "remove", tenant=job.tenant, job_id=job_id,
+                        removed=removed, now=time.time(),
+                    )
                 finished = job
             elif job.state == "routed" and job.replica_job_id is not None:
                 replica = self._replica_locked(job.replica)
@@ -1594,15 +1617,15 @@ class FleetRouter:
         with self._lock:
             tenants = {
                 t: {
-                    "queued": len(q),
+                    "queued": self._drr.queued(t),
                     "routed": sum(
                         1 for j in self._jobs.values()
                         if j.tenant == t and j.state == "routed"
                     ),
-                    "weight": self._weight(t),
-                    "deficit": round(self._deficit.get(t, 0.0), 3),
+                    "weight": self._drr.weight(t),
+                    "deficit": round(self._drr.deficit(t), 3),
                 }
-                for t, q in sorted(self._tq.items())
+                for t in self._drr.known_tenants()
             }
             for j in self._jobs.values():
                 if j.state == "routed" and j.tenant not in tenants:
@@ -1612,13 +1635,13 @@ class FleetRouter:
                             1 for x in self._jobs.values()
                             if x.tenant == j.tenant and x.state == "routed"
                         ),
-                        "weight": self._weight(j.tenant),
-                        "deficit": round(self._deficit.get(j.tenant, 0.0), 3),
+                        "weight": self._drr.weight(j.tenant),
+                        "deficit": round(self._drr.deficit(j.tenant), 3),
                     }
             snap = {
                 "ok": True,
                 "router": True,
-                "queue_depth": self._queued,
+                "queue_depth": self._drr.depth,
                 "routed": sum(
                     1 for j in self._jobs.values() if j.state == "routed"
                 ),
@@ -1721,6 +1744,9 @@ class FleetRouter:
         if self._fault_plan is not None:
             faults.deactivate()
             self._fault_plan = None
+        if self._decisions is not None:
+            self._decisions.close()
+            self._decisions = None
         if self.telemetry is not None:
             try:
                 self.telemetry.close(status, time.time() - self._t0)

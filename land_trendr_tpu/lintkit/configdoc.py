@@ -68,6 +68,11 @@ ROUTE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "affinity": ("no-affinity",),
 }
 
+LOADGEN = "land_trendr_tpu/loadgen/config.py"
+
+#: the LoadConfig alias table — every field projects mechanically
+LOAD_FLAG_ALIASES: dict[str, tuple[str, ...]] = {}
+
 #: the coupling triangles this rule checks: each names a config
 #: dataclass, the CLI subcommand projecting it, the README section
 #: documenting it, and the alias table for non-mechanical flags.  A new
@@ -94,6 +99,13 @@ TRIANGLES: tuple[dict, ...] = (
         "subcommand": "route",
         "section": "## fleet configuration",
         "aliases": ROUTE_FLAG_ALIASES,
+    },
+    {
+        "file": LOADGEN,
+        "cls": "LoadConfig",
+        "subcommand": "load",
+        "section": "## load configuration",
+        "aliases": LOAD_FLAG_ALIASES,
     },
 )
 

@@ -62,6 +62,7 @@ __all__ = [
     "fold",
     "fold_dir",
     "gauge_policy",
+    "histogram_quantile",
     "load_snapshots",
     "merge_instruments",
     "pod_sample",
@@ -235,6 +236,37 @@ def merge_instruments(per_host: "Iterable[tuple[float, list]]") -> "tuple[list, 
         key=lambda d: (d["name"], sorted(d["labels"].items())),
     )
     return out, sorted(set(conflicts))
+
+
+def histogram_quantile(inst: dict, q: float) -> "float | None":
+    """Estimate the ``q``-quantile (``0 < q <= 1``) of one histogram
+    instrument dict (the :func:`merge_instruments` shape: ``bounds`` and
+    per-bucket NON-cumulative ``buckets``, ``+Inf`` last).
+
+    Prometheus ``histogram_quantile`` semantics: linear interpolation
+    inside the target bucket between its lower and upper bound (the
+    first bucket interpolates from 0); a quantile landing in the
+    ``+Inf`` bucket answers the highest finite bound — the honest cap
+    of what bucketed data can say.  ``None`` when the histogram is
+    empty or shapeless.
+    """
+    bounds = list(inst.get("bounds") or [])
+    buckets = list(inst.get("buckets") or [])
+    count = int(inst.get("count", 0))
+    if not bounds or len(buckets) != len(bounds) + 1 or count <= 0:
+        return None
+    rank = q * count
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(bounds):  # +Inf bucket: cap at the last bound
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (rank - prev) / c
+    return float(bounds[-1])
 
 
 def fold(

@@ -340,6 +340,47 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # lint pins it).  ``hops`` counts forward attempts (>= 2 means the
     # request was re-routed).  Additive event type.
     "request_done": {"trace_id": str, "status": str, "latency_s": _NUM},
+    # --- fleet-scale load harness + capacity planner (loadgen/,
+    # --- fleet/capacity) -------------------------------------------------
+    # one load-rig phase transition: ``phase`` names the schedule
+    # segment (free-form — "warmup" / "steady" / "wave" / "drain" /
+    # "sweep@<qps>"...), ``mode`` is the arrival process ("open" =
+    # offered-rate Poisson, "closed" = fixed-concurrency).  The offered
+    # rate rides as the OPTIONAL ``offered_qps`` (strictly positive
+    # when present — the value lint pins it): a closed-loop phase has
+    # no offered rate by definition, only achieved throughput.
+    # Additive event type.
+    "load_phase": {"phase": str, "mode": str},
+    # one capacity sweep point: a fixed ``replicas`` count driven at
+    # ``offered_qps`` for one window, folded to the achieved rate, the
+    # latency quantiles (p99 >= p50 — the value lint pins it), and
+    # ``goodput_qps`` (terminal ``done`` per second — rejected and
+    # failed submissions are throughput, not goodput).  The OPTIONAL
+    # ``knee`` marks the detected knee of this replica count's curve
+    # and ``knee_blame`` names the dominant assembled blame component
+    # there (∈ the PR-15 blame vocabulary + "other").  Additive.
+    "sweep_point": {
+        "replicas": int,
+        "offered_qps": _NUM,
+        "achieved_qps": _NUM,
+        "p50_s": _NUM,
+        "p99_s": _NUM,
+        "goodput_qps": _NUM,
+        "done": int,
+        "failed": int,
+        "rejected": int,
+    },
+    # one offline replay of a recorded decision log
+    # (fleet/capacity.replay_decisions): ``decisions`` recorded,
+    # ``matched`` reproduced byte-identically (``match`` ⇔ all of them
+    # — the value lint pins the implication), and ``speedup_x`` =
+    # recorded wall span / replay wall.  Additive event type.
+    "sim_replay": {
+        "decisions": int,
+        "matched": int,
+        "match": bool,
+        "speedup_x": _NUM,
+    },
 }
 
 #: the request-span stage vocabulary, in journey order (open like
@@ -434,6 +475,24 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
     "tune_profile": {"age_s": _NUM, "knobs": dict, "groups": int},
     "request_span": {"replica": str, "attempt": int, "tenant": str, "ok": bool},
     "request_done": {"tenant": str, "hops": int, "blame": dict},
+    "load_phase": {
+        "offered_qps": _NUM,
+        "requests": int,
+        "workers": int,
+        "duration_s": _NUM,
+        "seed": int,
+    },
+    "sweep_point": {
+        "knee": bool,
+        "knee_blame": str,
+        "window_s": _NUM,
+        "assembled": int,
+    },
+    "sim_replay": {
+        "recorded_span_s": _NUM,
+        "replay_wall_s": _NUM,
+        "mismatch_seq": int,
+    },
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

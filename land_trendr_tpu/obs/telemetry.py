@@ -915,6 +915,118 @@ class Telemetry:
                 labels={"stage": name.removesuffix("_s")},
             ).set(secs)
 
+    def load_phase(
+        self,
+        phase: str,
+        mode: str,
+        offered_qps: "float | None" = None,
+        requests: "int | None" = None,
+        workers: "int | None" = None,
+        duration_s: "float | None" = None,
+        seed: "int | None" = None,
+    ) -> None:
+        """One load-rig phase boundary (``start``/``done``/a schedule
+        segment).  ``offered_qps`` only exists for open-loop phases — a
+        closed loop has no offered rate, its arrival rate IS the
+        completion rate."""
+        self.events.emit(
+            "load_phase",
+            phase=phase,
+            mode=mode,
+            **(
+                {"offered_qps": round(float(offered_qps), 6)}
+                if offered_qps is not None
+                else {}
+            ),
+            **({"requests": int(requests)} if requests is not None else {}),
+            **({"workers": int(workers)} if workers is not None else {}),
+            **(
+                {"duration_s": round(float(duration_s), 6)}
+                if duration_s is not None
+                else {}
+            ),
+            **({"seed": int(seed)} if seed is not None else {}),
+        )
+
+    def sweep_point(
+        self,
+        replicas: int,
+        offered_qps: float,
+        achieved_qps: float,
+        p50_s: float,
+        p99_s: float,
+        goodput_qps: float,
+        done: int,
+        failed: int,
+        rejected: int,
+        knee: "bool | None" = None,
+        knee_blame: "str | None" = None,
+        window_s: "float | None" = None,
+        assembled: "int | None" = None,
+    ) -> None:
+        """One point of a capacity scaling curve: a (replica count,
+        offered rate) cell measured by the load rig and assembled
+        through the request-trace store.  ``knee``/``knee_blame`` are
+        stamped by the analyzer on the point where the latency curve
+        bends, naming the dominant blame component there."""
+        self.events.emit(
+            "sweep_point",
+            replicas=int(replicas),
+            offered_qps=round(float(offered_qps), 6),
+            achieved_qps=round(float(achieved_qps), 6),
+            p50_s=round(float(p50_s), 6),
+            p99_s=round(float(p99_s), 6),
+            goodput_qps=round(float(goodput_qps), 6),
+            done=int(done),
+            failed=int(failed),
+            rejected=int(rejected),
+            **({"knee": bool(knee)} if knee is not None else {}),
+            **({"knee_blame": knee_blame} if knee_blame is not None else {}),
+            **(
+                {"window_s": round(float(window_s), 6)}
+                if window_s is not None
+                else {}
+            ),
+            **({"assembled": int(assembled)} if assembled is not None else {}),
+        )
+
+    def sim_replay(
+        self,
+        decisions: int,
+        matched: int,
+        match: bool,
+        speedup_x: float,
+        recorded_span_s: "float | None" = None,
+        replay_wall_s: "float | None" = None,
+        mismatch_seq: "int | None" = None,
+    ) -> None:
+        """One offline-replay verdict: a recorded dispatcher/autoscaler
+        decision log re-driven through the same pure functions.
+        ``match`` means every recorded decision was reproduced
+        byte-identically; ``mismatch_seq`` pins the first divergence."""
+        self.events.emit(
+            "sim_replay",
+            decisions=int(decisions),
+            matched=int(matched),
+            match=bool(match),
+            speedup_x=round(float(speedup_x), 3),
+            **(
+                {"recorded_span_s": round(float(recorded_span_s), 6)}
+                if recorded_span_s is not None
+                else {}
+            ),
+            **(
+                {"replay_wall_s": round(float(replay_wall_s), 6)}
+                if replay_wall_s is not None
+                else {}
+            ),
+            **(
+                {"mismatch_seq": int(mismatch_seq)}
+                if mismatch_seq is not None
+                else {}
+            ),
+        )
+
     def close(self) -> None:
         """Flush the final exposition, stop the exporters, close the log.
 

@@ -143,6 +143,7 @@ SEAMS = (
     "router.forward",
     "replica.health",
     "tune.probe",
+    "loadgen.tick",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -171,6 +172,7 @@ _DEFAULT_KIND = {
     "router.forward": "io",
     "replica.health": "fire",
     "tune.probe": "runtime",
+    "loadgen.tick": "fire",
 }
 
 

@@ -35,6 +35,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-scale cases (spawned fleet processes) — tier-1 "
+        "deselects with -m 'not slow'; CLI gate runs carry them",
+    )
+
+
 @pytest.fixture()
 def rng(request):
     """Per-test deterministic stream: seed derives from the test's own id, so

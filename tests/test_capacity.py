@@ -1,0 +1,375 @@
+"""Capacity-planner tests (ISSUE 16): the load rig's deterministic
+half, the knee algebra, and the offline replay equivalence.
+
+Pins the contracts the live capacity bench rests on, without spawning a
+fleet (everything here is seconds-scale and jax-free):
+
+* **seeded traces**: the same :class:`LoadConfig` regenerates the same
+  trace byte for byte — arrival times, tenant sequence, pinned trace
+  ids — and the heavy-tail / diurnal-wave shape knobs do what they say;
+* **knee algebra**: the Kneedle construction on synthetic curves — a
+  hockey stick knees at the bend, a straight line has no knee, and
+  :func:`mark_knee` stamps the blame name from the assembled split;
+* **offline replay**: a scripted decision history replays
+  byte-identically through the same pure machines, and ONE tampered
+  byte is caught with its seq pinned — the simulator is an equivalence
+  check, not a formality;
+* **vocabulary non-drift**: the events-lint copies of the mode and
+  blame vocabularies stay equal to their owning modules';
+* **fleet-top aggregation**: histogram reconstruction from exposition
+  text round-trips through :func:`merge_instruments` into the
+  :func:`histogram_quantile` header numbers;
+* the committed fixture stays schema-clean and the committed
+  ``CAPACITY_r*.json`` validates (the perf gate's curve leg re-checks
+  the replay claims against the live simulator).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from land_trendr_tpu.fleet.capacity import (
+    REPORT_SCHEMA,
+    assemble_sweep,
+    dominant_blame,
+    find_knee,
+    mark_knee,
+    percentile,
+    replay_decisions,
+    validate_report,
+    write_scripted_history,
+)
+from land_trendr_tpu.loadgen import LoadConfig, build_trace
+from land_trendr_tpu.loadgen.config import LOAD_MODES as CFG_LOAD_MODES
+from land_trendr_tpu.loadgen.trace import SHAPE_PARAMS, SHAPES, rate_at, tenant_weights
+from land_trendr_tpu.obs.aggregate import (
+    histogram_quantile,
+    merge_instruments,
+)
+from land_trendr_tpu.obs.events import validate_events_file
+from land_trendr_tpu.obs.reqtrace import BLAME_PRIORITY
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import check_events_schema as ces  # noqa: E402
+import lt_top  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "capacity.events.jsonl")
+
+
+# -- seeded traces ---------------------------------------------------------
+def test_trace_regenerates_byte_identical():
+    cfg = LoadConfig(
+        mode="open", duration_s=120.0, qps=3.0, seed=42, tenants=4,
+        tenant_skew=1.0, wave_amp=0.4, wave_period_s=30.0,
+    )
+    assert build_trace(cfg) == build_trace(cfg)
+    # a different seed is a different trace (ids AND arrivals)
+    other = build_trace(LoadConfig(**{
+        **{f.name: getattr(cfg, f.name) for f in cfg.__dataclass_fields__.values()},
+        "seed": 43,
+    }))
+    assert other != build_trace(cfg)
+
+
+def test_trace_ids_pin_seed_and_ordinal():
+    cfg = LoadConfig(mode="closed", duration_s=5.0, requests=10, seed=0xBEEF)
+    trace = build_trace(cfg)
+    assert len(trace) == 10
+    assert [r.trace_id for r in trace] == [
+        f"lg0000beef{i:06x}" for i in range(10)
+    ]
+    assert len({r.trace_id for r in trace}) == 10
+    # closed-loop entries arrive when a worker frees up, not on a clock
+    assert all(r.at_s == 0.0 for r in trace)
+
+
+def test_open_loop_arrivals_sorted_inside_window():
+    cfg = LoadConfig(mode="open", duration_s=200.0, qps=2.0, seed=7)
+    trace = build_trace(cfg)
+    ats = [r.at_s for r in trace]
+    assert ats == sorted(ats)
+    assert all(0.0 <= t < cfg.duration_s for t in ats)
+    # a Poisson window this long lands near its mean offered count
+    assert 0.5 * cfg.qps * cfg.duration_s < len(trace) < 1.5 * cfg.qps * cfg.duration_s
+    # the requests budget truncates, preserving the prefix
+    cut = build_trace(LoadConfig(
+        mode="open", duration_s=200.0, qps=2.0, seed=7, requests=5,
+    ))
+    assert cut == trace[:5]
+
+
+def test_tenant_mix_heavy_tailed():
+    cfg = LoadConfig(mode="closed", duration_s=5.0, requests=400,
+                     seed=3, tenants=4, tenant_skew=1.0)
+    counts: dict = {}
+    for r in build_trace(cfg):
+        counts[r.tenant] = counts.get(r.tenant, 0) + 1
+    # 1/k weights: t0 strictly dominates, the tail is still present
+    assert counts["t0"] > counts["t3"]
+    assert set(counts) == {"t0", "t1", "t2", "t3"}
+    assert tenant_weights(cfg) == [1.0, 0.5, 1.0 / 3.0, 0.25]
+    uniform = LoadConfig(mode="closed", duration_s=5.0, tenants=4,
+                         tenant_skew=0.0)
+    assert tenant_weights(uniform) == [1.0] * 4
+
+
+def test_diurnal_wave_bounds_and_flat_schedule():
+    cfg = LoadConfig(mode="open", qps=4.0, wave_amp=0.5, wave_period_s=60.0)
+    rates = [rate_at(cfg, t) for t in range(0, 120, 5)]
+    assert all(cfg.qps * 0.5 <= r <= cfg.qps * 1.5 for r in rates)
+    assert max(rates) > cfg.qps * 1.3 and min(rates) < cfg.qps * 0.7
+    flat = LoadConfig(mode="open", qps=4.0, wave_amp=0.0)
+    assert all(rate_at(flat, t) == 4.0 for t in range(0, 120, 7))
+
+
+def test_config_rejects_nonsense():
+    with pytest.raises(ValueError):
+        LoadConfig(mode="bursty")
+    with pytest.raises(ValueError):
+        LoadConfig(wave_amp=1.0)  # negative trough rate
+    with pytest.raises(ValueError):
+        LoadConfig(qps=0.0)
+    with pytest.raises(ValueError):
+        LoadConfig(workers=0)
+
+
+def test_shape_vocabulary_maps_to_params():
+    assert set(SHAPES) == set(SHAPE_PARAMS)
+    assert all("max_segments" in p for p in SHAPE_PARAMS.values())
+
+
+# -- knee algebra ----------------------------------------------------------
+def test_find_knee_hockey_stick():
+    # flat then exploding p99: the knee is the last flat point
+    pts = [(0.5, 1.0), (1.0, 1.1), (2.0, 1.3), (4.0, 9.0)]
+    assert find_knee(pts) == 2
+
+
+def test_find_knee_degenerate_cases():
+    assert find_knee([(1.0, 1.0), (2.0, 2.0)]) is None  # < 3 points
+    assert find_knee([(1.0, 5.0), (2.0, 5.0), (3.0, 5.0)]) is None  # flat
+    # straight line: no interior point rises above the chord
+    assert find_knee([(1.0, 1.0), (2.0, 2.0), (3.0, 3.0)]) is None
+
+
+def test_mark_knee_stamps_blame_from_split():
+    points = [
+        {"offered_qps": 0.5, "p99_s": 1.0, "blame": {"compute": 3.0}},
+        {"offered_qps": 1.0, "p99_s": 1.1,
+         "blame": {"replica_queue": 9.0, "compute": 2.0}},
+        {"offered_qps": 2.0, "p99_s": 1.3, "blame": {"compute": 2.0}},
+        {"offered_qps": 4.0, "p99_s": 9.0, "blame": {"compute": 2.0}},
+    ]
+    idx = mark_knee(points)
+    assert idx == 2
+    assert points[2]["knee"] is True
+    assert points[2]["knee_blame"] == "compute"
+    assert "knee" not in points[1]
+
+
+def test_dominant_blame_priority_tiebreak():
+    assert dominant_blame({}) == "other"
+    assert dominant_blame({"compute": 5.0, "fetch": 1.0}) == "compute"
+    # equal seconds: the earlier PR-15 priority component wins
+    assert dominant_blame({"compute": 2.0, "route_queue": 2.0}) == "route_queue"
+
+
+def test_percentile_interpolates():
+    assert percentile([], 99.0) == 0.0
+    assert percentile([4.0], 50.0) == 4.0
+    assert percentile([1.0, 2.0, 3.0, 4.0], 50.0) == 2.5
+    assert percentile([1.0, 2.0, 3.0, 4.0], 100.0) == 4.0
+
+
+# -- offline replay --------------------------------------------------------
+def test_scripted_history_replays_byte_identical(tmp_path):
+    path = str(tmp_path / "decisions.jsonl")
+    meta = write_scripted_history(path, seed=23, events=400)
+    assert meta["records"] == 400
+    rep = replay_decisions(path)
+    assert rep.match and rep.mismatch_seq is None
+    assert rep.decisions == rep.matched > 0
+    assert rep.recorded_span_s > 0
+    # same seed → same log, byte for byte
+    path2 = str(tmp_path / "again.jsonl")
+    write_scripted_history(path2, seed=23, events=400)
+    assert open(path).read() == open(path2).read()
+
+
+def test_tampered_history_caught_with_seq(tmp_path):
+    path = str(tmp_path / "decisions.jsonl")
+    write_scripted_history(path, seed=5, events=300)
+    recs = [json.loads(line) for line in open(path)]
+    victim = next(r for r in recs if r["kind"] == "pick")
+    victim["job_id"] += "-tampered"
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    rep = replay_decisions(path)
+    assert not rep.match
+    assert rep.mismatch_seq == victim["seq"]
+    assert rep.mismatch["kind"] == "pick"
+
+
+def test_assemble_sweep_empty_store(tmp_path):
+    # no trace files at all: nothing assembles, nothing crashes
+    out = assemble_sweep(str(tmp_path), ["lg00000000000000"])
+    assert out == {"assembled": 0, "latencies": [], "blame": {}}
+
+
+# -- vocabulary non-drift --------------------------------------------------
+def test_lint_vocabularies_track_owners():
+    assert ces.LOAD_MODES == CFG_LOAD_MODES
+    assert ces.KNEE_BLAME_COMPONENTS == (*BLAME_PRIORITY, "other")
+
+
+def test_capacity_value_lints_positive_and_negative():
+    ok = {"ev": "sweep_point", "replicas": 2, "offered_qps": 1.0,
+          "achieved_qps": 1.0, "p50_s": 1.0, "p99_s": 2.0,
+          "goodput_qps": 1.0, "done": 5, "failed": 0, "rejected": 0}
+    assert ces.capacity_value_errors(ok, 1) == []
+    bad_q = dict(ok, p99_s=0.5)
+    assert any("p99_s" in e for e in ces.capacity_value_errors(bad_q, 1))
+    bad_b = dict(ok, knee_blame="gremlins")
+    assert any("vocabulary" in e for e in ces.capacity_value_errors(bad_b, 1))
+    zero = {"ev": "load_phase", "phase": "x_start", "mode": "open",
+            "offered_qps": 0.0}
+    assert any("strictly positive" in e
+               for e in ces.capacity_value_errors(zero, 1))
+    lying = {"ev": "sim_replay", "decisions": 10, "matched": 9,
+             "match": True, "speedup_x": 5.0}
+    assert ces.capacity_value_errors(lying, 1)
+
+
+def test_capacity_fixture_schema_clean():
+    assert validate_events_file(FIXTURE, extra=ces.value_lints()) == []
+
+
+# -- report schema ---------------------------------------------------------
+def _minimal_point(**over):
+    p = {"replicas": 1, "offered_qps": 1.0, "achieved_qps": 1.0,
+         "p50_s": 1.0, "p99_s": 2.0, "goodput_qps": 1.0,
+         "done": 3, "failed": 0, "rejected": 0}
+    p.update(over)
+    return p
+
+
+def test_validate_report_positive_and_negative():
+    good = {
+        "schema": REPORT_SCHEMA,
+        "curves": [{"replicas": 1, "points": [_minimal_point()]}],
+        "replay": {"decisions": 1, "matched": 1, "match": True,
+                   "speedup_x": 500.0},
+    }
+    assert validate_report(good) == []
+    assert validate_report({"schema": "nope"})
+    assert any("p99_s below" in e for e in validate_report({
+        "schema": REPORT_SCHEMA,
+        "curves": [{"replicas": 1,
+                    "points": [_minimal_point(p99_s=0.1)]}],
+        "replay": good["replay"],
+    }))
+    assert any("knee_blame" in e for e in validate_report({
+        "schema": REPORT_SCHEMA,
+        "curves": [{"replicas": 1,
+                    "points": [_minimal_point(knee_blame="gremlins")]}],
+        "replay": good["replay"],
+    }))
+    assert any("replay" in e for e in validate_report({
+        "schema": REPORT_SCHEMA,
+        "curves": [{"replicas": 1, "points": [_minimal_point()]}],
+    }))
+
+
+def test_committed_capacity_report_validates():
+    path = os.path.join(REPO, "CAPACITY_r17.json")
+    report = json.load(open(path))
+    assert validate_report(report) == []
+    replicas = [c["replicas"] for c in report["curves"]]
+    assert len(set(replicas)) >= 3
+    for curve in report["curves"]:
+        knees = [p for p in curve["points"] if p.get("knee")]
+        assert knees and all(
+            p["knee_blame"] in (*BLAME_PRIORITY, "other") for p in knees
+        )
+    assert report["replay"]["match"] is True
+    assert report["scripted_replay"]["match"] is True
+    assert report["scripted_replay"]["speedup_x"] >= 100.0
+
+
+# -- fleet-top histogram aggregation ---------------------------------------
+_EXPO = """\
+# TYPE lt_serve_job_seconds histogram
+lt_serve_job_seconds_bucket{le="0.5"} 1
+lt_serve_job_seconds_bucket{le="2.0"} 3
+lt_serve_job_seconds_bucket{le="+Inf"} 4
+lt_serve_job_seconds_sum 5.5
+lt_serve_job_seconds_count 4
+"""
+
+
+def test_prom_instruments_reconstructs_histogram():
+    insts = lt_top.prom_instruments(_EXPO)
+    hist = next(m for m in insts if m["kind"] == "histogram")
+    assert hist["name"] == "lt_serve_job_seconds"
+    assert hist["bounds"] == [0.5, 2.0]
+    assert hist["buckets"] == [1, 2, 1]  # de-cumulated, +Inf last
+    assert hist["count"] == 4 and hist["sum"] == 5.5
+
+
+def test_prom_instruments_drops_torn_series():
+    torn = _EXPO.replace('le="2.0"} 3', 'le="2.0"} 0')  # cum must not dip
+    assert not [m for m in lt_top.prom_instruments(torn)
+                if m["kind"] == "histogram"]
+
+
+def test_merged_histogram_quantiles():
+    insts = lt_top.prom_instruments(_EXPO)
+    merged, conflicts = merge_instruments([(1.0, insts), (2.0, insts)])
+    assert conflicts == []
+    hist = next(m for m in merged if m["kind"] == "histogram")
+    assert hist["count"] == 8 and hist["buckets"] == [2, 4, 2]
+    p50 = histogram_quantile(hist, 0.50)
+    assert 0.5 <= p50 <= 2.0
+    # the +Inf bucket answers with the highest finite bound
+    assert histogram_quantile(hist, 0.99) == 2.0
+
+
+def test_lt_load_cli_parses_shape_flags():
+    from land_trendr_tpu.cli import build_parser
+
+    args = build_parser().parse_args([
+        "load", "--router-url", "http://127.0.0.1:1", "--stack-dir", "x",
+        "--mode", "open", "--qps", "3", "--wave-amp", "0.4",
+        "--tenant-skew", "1.5", "--seed", "7",
+    ])
+    assert args.cmd == "load"
+    assert (args.mode, args.qps, args.wave_amp) == ("open", 3.0, 0.4)
+
+
+@pytest.mark.slow
+def test_capacity_bench_smoke_cli(tmp_path):
+    """The full smoke leg: live 2-fleet sweep + knees + replay.  Slow
+    (spawned jax replica processes) — CLI gate runs carry it."""
+    import capacity_bench
+
+    out = tmp_path / "cap.json"
+    assert capacity_bench.main([
+        "--smoke", "--keep", str(tmp_path / "wd"), "--out", str(out),
+    ]) == 0
+    rep = json.loads(out.read_text())
+    assert rep["ok"] is True and rep["smoke"] is True
+    assert validate_report(rep) == []
+
+
+def test_histogram_quantile_edge_cases():
+    assert histogram_quantile({"bounds": [], "buckets": [], "count": 0},
+                              0.5) is None
+    assert histogram_quantile({"bounds": [1.0], "buckets": [2],
+                               "count": 2}, 0.5) is None  # shape mismatch
+    one = {"bounds": [1.0, 2.0], "buckets": [0, 4, 0], "count": 4}
+    assert histogram_quantile(one, 0.5) == pytest.approx(1.5)

@@ -494,8 +494,10 @@ def test_lt_top_dir_mode(publish_run, capsys):
 
 def test_lt_top_prom_instruments_merge_policy():
     """The multi-url aggregate header shares obs.aggregate's merge
-    policy: counters sum, burn-rate gauges take the max, histogram
-    sum/count series sum."""
+    policy: counters sum, burn-rate gauges take the max, and histogram
+    families RECONSTRUCT from their cumulative ``_bucket``/``_sum``/
+    ``_count`` rows into mergeable instruments (the aggregate header's
+    percentile source)."""
     import lt_top
 
     text = (
@@ -505,6 +507,7 @@ def test_lt_top_prom_instruments_merge_policy():
         "lt_slo_burn_rate 0.25\n"
         "# TYPE lt_serve_job_seconds histogram\n"
         'lt_serve_job_seconds_bucket{le="1"} 2\n'
+        'lt_serve_job_seconds_bucket{le="+Inf"} 2\n'
         "lt_serve_job_seconds_sum 1.5\n"
         "lt_serve_job_seconds_count 2\n"
     )
@@ -515,12 +518,16 @@ def test_lt_top_prom_instruments_merge_policy():
         (1.0, lt_top.prom_instruments(text2)),
     ])
     assert conflicts == []
-    by = {m["name"]: m["value"] for m in merged}
-    assert by["lt_slo_met_total"] == 7
-    assert by["lt_slo_burn_rate"] == 0.75
-    assert by["lt_serve_job_seconds_sum"] == 3.0
-    assert by["lt_serve_job_seconds_count"] == 4
-    assert "lt_serve_job_seconds_bucket" not in by  # cumulative rows skipped
+    by = {m["name"]: m for m in merged}
+    assert by["lt_slo_met_total"]["value"] == 7
+    assert by["lt_slo_burn_rate"]["value"] == 0.75
+    hist = by["lt_serve_job_seconds"]
+    assert hist["kind"] == "histogram"
+    assert hist["sum"] == 3.0 and hist["count"] == 4
+    assert hist["bounds"] == [1.0] and hist["buckets"] == [4, 0]
+    # the scalar siblings fold INTO the histogram, not beside it
+    assert "lt_serve_job_seconds_sum" not in by
+    assert "lt_serve_job_seconds_bucket" not in by
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,381 @@
+"""Capacity bench: scaling curves + the byte-identical replay proof.
+
+One run sweeps replica counts x offered QPS with the seeded load rig
+(``land_trendr_tpu.loadgen``) against live ``lt route`` fleets, then:
+
+* assembles every sweep cell's latency truth through the PR-15
+  request-trace store (``obs.reqtrace`` — fleet event streams, not
+  client clocks), folding p50/p99/goodput per cell;
+* finds the knee of each replica count's offered-QPS-vs-p99 curve and
+  names the dominant blame component there
+  (``land_trendr_tpu.fleet.capacity``);
+* replays every leg's recorded decision log (``--decision-log``)
+  through fresh pure machines and byte-compares the outputs — plus a
+  scripted autoscaler/dispatcher history for the clock-free speedup
+  number — the "the simulator IS the dispatcher" proof.
+
+The report lands as ``CAPACITY_r17.json``; ``tools/perf_gate.py``'s
+capacity leg re-checks the replay and schema on every gate run.
+
+Usage::
+
+    python tools/capacity_bench.py --smoke --out /tmp/cap.json
+    python tools/capacity_bench.py --out CAPACITY_r17.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from land_trendr_tpu.fleet.capacity import (
+    REPORT_SCHEMA,
+    ReplayReport,
+    assemble_sweep,
+    dominant_blame,
+    mark_knee,
+    percentile,
+    replay_decisions,
+    validate_report,
+    write_scripted_history,
+)
+from land_trendr_tpu.fleet.scheduling import DECISIONS_NAME
+from land_trendr_tpu.loadgen import InProcClient, LoadConfig, LoadRunner
+from land_trendr_tpu.loadgen.trace import SHAPE_PARAMS
+
+
+def _write_scene(root: Path, size: int, years: int) -> str:
+    from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+
+    d = str(root / "stack")
+    write_stack(
+        d,
+        make_stack(SceneSpec(
+            width=size, height=size, year_start=2000,
+            year_end=2000 + years - 1, seed=13,
+        )),
+    )
+    return d
+
+
+def _payload_fn(stack_dir: str, tile: int):
+    def fn(req) -> dict:
+        return {
+            "stack_dir": stack_dir,
+            "tile_size": tile,
+            "tenant": req.tenant,
+            "params": dict(SHAPE_PARAMS[req.shape]),
+            "trace_id": req.trace_id,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+    return fn
+
+
+def _start_router(workdir: str, n_replicas: int, autoscale: bool = False):
+    from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+
+    cfg = RouterConfig(
+        workdir=workdir,
+        spawn_replicas=n_replicas,
+        health_interval_s=0.5,
+        route_queue_depth=512,
+        tenant_quota=256,
+        route_retries=3,
+        decision_log=True,
+        replica_args=("--feed-cache-mb", "64"),
+        **(
+            {
+                "autoscale": True, "min_replicas": n_replicas,
+                "max_replicas": n_replicas + 2, "scale_hold_s": 0.5,
+            }
+            if autoscale else {}
+        ),
+    )
+    router = FleetRouter(cfg)
+    thread = threading.Thread(
+        target=router.serve_forever, name=f"capacity-{Path(workdir).name}"
+    )
+    thread.start()
+    return router, thread
+
+
+def run_curve_leg(
+    root: Path, stack_dir: str, tile: int, n_replicas: int,
+    qps_steps: "list[float]", window_s: float, timeout_s: float,
+    seed: int,
+) -> "tuple[dict, ReplayReport]":
+    """One replica count's curve: a fresh fleet, one open-loop phase
+    per offered rate (the fleet drains between phases — the runner
+    polls every request to terminal), every cell assembled through the
+    trace store, then the leg's decision log replayed."""
+    workdir = str(root / f"rt_{n_replicas}r")
+    router, thread = _start_router(workdir, n_replicas)
+    points: "list[dict]" = []
+    try:
+        for step, qps in enumerate(qps_steps):
+            cfg = LoadConfig(
+                mode="open", duration_s=window_s, qps=qps,
+                workers=4, seed=seed + step, tenants=3,
+                tenant_skew=1.0, wave_amp=0.3,
+                wave_period_s=max(window_s, 1.0),
+                timeout_s=timeout_s,
+            )
+            runner = LoadRunner(
+                cfg, InProcClient(router), _payload_fn(stack_dir, tile),
+                telemetry=router.telemetry,
+            )
+            report = runner.run(phase=f"r{n_replicas}_q{qps}")
+            sweep = assemble_sweep(workdir, report.trace_ids)
+            lat = sweep["latencies"]
+            point = {
+                "replicas": n_replicas,
+                "offered_qps": qps,
+                "achieved_qps": round(report.done / max(report.wall_s, 1e-6), 4),
+                "p50_s": round(percentile(lat, 50.0), 4),
+                "p99_s": round(percentile(lat, 99.0), 4),
+                "goodput_qps": round(report.done / max(report.wall_s, 1e-6), 4),
+                "done": report.done,
+                "failed": report.failed,
+                "rejected": report.rejected,
+                "assembled": sweep["assembled"],
+                "window_s": round(report.wall_s, 3),
+                "blame": sweep["blame"],
+            }
+            points.append(point)
+            if router.telemetry is not None:
+                router.telemetry.sweep_point(**{
+                    k: v for k, v in point.items() if k != "blame"
+                })
+        knee_idx = mark_knee(points)
+        if knee_idx is None and points:
+            # no interior knee in the measured range: the saturation
+            # point stands in (stamped so every curve names a blame)
+            knee_idx = len(points) - 1
+            points[knee_idx]["knee"] = True
+            points[knee_idx]["knee_blame"] = dominant_blame(
+                points[knee_idx].get("blame") or {}
+            )
+        if knee_idx is not None and router.telemetry is not None:
+            p = points[knee_idx]
+            router.telemetry.sweep_point(**{
+                k: v for k, v in p.items() if k != "blame"
+            })
+    finally:
+        router.stop()
+        thread.join(timeout=300)
+    replay = replay_decisions(os.path.join(workdir, DECISIONS_NAME))
+    curve = {
+        "replicas": n_replicas,
+        "points": points,
+        "knee_index": knee_idx,
+        "knee_offered_qps": (
+            points[knee_idx]["offered_qps"] if knee_idx is not None else None
+        ),
+        "knee_blame": (
+            points[knee_idx].get("knee_blame")
+            if knee_idx is not None else None
+        ),
+        "replay": replay.to_json(),
+    }
+    return curve, replay
+
+
+def run_autoscale_leg(
+    root: Path, stack_dir: str, tile: int, timeout_s: float, seed: int,
+) -> "tuple[dict, ReplayReport]":
+    """An autoscaled fleet under closed-loop load with a scripted burn
+    history driven through ``scale_tick`` — the leg that puts REAL
+    autoscale records (with real spawns/drains behind them) into the
+    decision log the replay must reproduce."""
+    workdir = str(root / "rt_autoscale")
+    router, thread = _start_router(workdir, 1, autoscale=True)
+    try:
+        cfg = LoadConfig(
+            mode="closed", duration_s=6.0, requests=8, workers=2,
+            seed=seed, tenants=2, timeout_s=timeout_s,
+        )
+        runner = LoadRunner(
+            cfg, InProcClient(router), _payload_fn(stack_dir, tile),
+            telemetry=router.telemetry,
+        )
+        done = {}
+
+        def _drive() -> None:
+            done["report"] = runner.run(phase="autoscale")
+
+        t = threading.Thread(target=_drive)
+        t.start()
+        # scripted burn history: pressure up, hold, release — recorded
+        # decisions include real up/down actions between the bounds
+        # (wall clock: the decision log's one time domain)
+        now = time.time()
+        script = [0.9, 0.9, 0.9, 0.7, 0.4, 0.02, 0.02, 0.02, 0.02]
+        for i, burn in enumerate(script):
+            router.scale_tick(burn, now + i * 0.7)
+            time.sleep(0.7)
+        t.join(timeout=timeout_s + 60)
+        report = done.get("report")
+    finally:
+        router.stop()
+        thread.join(timeout=300)
+    replay = replay_decisions(os.path.join(workdir, DECISIONS_NAME))
+    leg = {
+        "done": report.done if report else None,
+        "failed": report.failed if report else None,
+        "scripted_burns": len(script),
+        "replay": replay.to_json(),
+    }
+    return leg, replay
+
+
+def run_bench(
+    smoke: bool, root: str, size: int, years: int, tile: int,
+) -> dict:
+    rootp = Path(root)
+    stack_dir = _write_scene(rootp, size, years)
+    replica_counts = [1, 2] if smoke else [1, 2, 3]
+    qps_steps = [0.5, 1.0, 2.0] if smoke else [0.5, 1.0, 2.0, 4.0]
+    window_s = 5.0 if smoke else 15.0
+    timeout_s = 120.0 if smoke else 240.0
+
+    curves: "list[dict]" = []
+    replays: "list[ReplayReport]" = []
+    for i, n in enumerate(replica_counts):
+        curve, replay = run_curve_leg(
+            rootp, stack_dir, tile, n, qps_steps, window_s, timeout_s,
+            seed=100 + 10 * i,
+        )
+        curves.append(curve)
+        replays.append(replay)
+
+    autoscale_leg, as_replay = run_autoscale_leg(
+        rootp, stack_dir, tile, timeout_s, seed=7
+    )
+    replays.append(as_replay)
+
+    # the clock-free speedup proof: a scripted 2-minute-span history
+    # replayed in milliseconds (live-leg spans are short by design, so
+    # their speedup_x is bounded by the bench budget, not the machine)
+    script_path = str(rootp / "scripted_decisions.jsonl")
+    write_scripted_history(script_path, seed=23, events=2000)
+    scripted = replay_decisions(script_path)
+
+    live_decisions = sum(r.decisions for r in replays)
+    live_matched = sum(r.matched for r in replays)
+    replay_summary = {
+        "decisions": live_decisions,
+        "matched": live_matched,
+        "match": bool(live_decisions > 0 and live_matched == live_decisions),
+        "speedup_x": round(
+            min((r.speedup_x for r in replays if r.decisions), default=0.0),
+            3,
+        ),
+        "legs": len(replays),
+    }
+    invariants = {
+        "curves_all_counts": len(curves) == len(replica_counts),
+        "points_per_curve": all(
+            len(c["points"]) == len(qps_steps) for c in curves
+        ),
+        "knee_named_per_curve": all(
+            c["knee_blame"] is not None for c in curves
+        ),
+        "live_replay_match": replay_summary["match"],
+        "scripted_replay_match": scripted.match,
+        "scripted_replay_100x": scripted.speedup_x >= 100.0,
+        "every_cell_assembled": all(
+            p["assembled"] > 0 for c in curves for p in c["points"]
+        ),
+    }
+    report = {
+        "schema": REPORT_SCHEMA,
+        "smoke": smoke,
+        "workload": {
+            "scene_px": size * size,
+            "years": years,
+            "tile_size": tile,
+            "replica_counts": replica_counts,
+            "qps_steps": qps_steps,
+            "window_s": window_s,
+            "mode": "open",
+            "wave_amp": 0.3,
+        },
+        "curves": curves,
+        "autoscale_leg": autoscale_leg,
+        "replay": replay_summary,
+        "scripted_replay": scripted.to_json(),
+        "invariants": invariants,
+        "ok": all(invariants.values()),
+    }
+    schema_errs = validate_report(report)
+    report["invariants"]["schema_valid"] = not schema_errs
+    if schema_errs:
+        report["schema_errors"] = schema_errs
+        report["ok"] = False
+    return report
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="minutes-scale gate mode (2 replica counts, 3 "
+                    "QPS steps, short windows)")
+    ap.add_argument("--size", type=int, default=None,
+                    help="scene edge px (default: 40 smoke / 48 full)")
+    ap.add_argument("--years", type=int, default=None,
+                    help="stack years (default: 7)")
+    ap.add_argument("--tile", type=int, default=None,
+                    help="tile size (default: 20 smoke / 24 full)")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep the bench workdirs under DIR")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    size = args.size or (40 if args.smoke else 48)
+    years = args.years or 7
+    tile = args.tile or (20 if args.smoke else 24)
+
+    root = args.keep or tempfile.mkdtemp(prefix="lt_capacity_bench_")
+    Path(root).mkdir(parents=True, exist_ok=True)
+    try:
+        report = run_bench(args.smoke, root, size, years, tile)
+    finally:
+        if args.keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(json.dumps({
+        "ok": report["ok"],
+        "knees": {
+            str(c["replicas"]): {
+                "offered_qps": c["knee_offered_qps"],
+                "blame": c["knee_blame"],
+            }
+            for c in report["curves"]
+        },
+        "replay_match": report["replay"]["match"],
+        "scripted_speedup_x": report["scripted_replay"]["speedup_x"],
+    }, indent=2))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -129,6 +129,23 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
     # request_value_errors / TraceRefLint below)
     "request_span": ("start", "end", "attempt"),
     "request_done": ("latency_s", "hops"),
+    # fleet-scale load harness + capacity planner (loadgen/,
+    # fleet/capacity): rates, quantiles, counts and replay walls only
+    # go up / never negative (the strict positivity, quantile-order,
+    # blame-vocabulary and replay-implication checks live in
+    # capacity_value_errors below)
+    "load_phase": (
+        "offered_qps", "requests", "workers", "duration_s", "seed",
+    ),
+    "sweep_point": (
+        "replicas", "offered_qps", "achieved_qps", "p50_s", "p99_s",
+        "goodput_qps", "done", "failed", "rejected", "window_s",
+        "assembled",
+    ),
+    "sim_replay": (
+        "decisions", "matched", "speedup_x", "recorded_span_s",
+        "replay_wall_s", "mismatch_seq",
+    ),
 }
 
 
@@ -479,6 +496,89 @@ def request_value_errors(rec, lineno: int) -> list[str]:
     return []
 
 
+#: the load-rig arrival-process vocabulary (mirrors
+#: land_trendr_tpu.loadgen.config.LOAD_MODES — asserted equal in
+#: tests/test_capacity.py so the two cannot drift)
+LOAD_MODES = ("open", "closed")
+
+#: the knee-blame vocabulary: the PR-15 blame priority
+#: (land_trendr_tpu.obs.reqtrace.BLAME_PRIORITY) + the assembler's
+#: "other" bucket for uncovered time — asserted equal in
+#: tests/test_capacity.py so the two cannot drift
+KNEE_BLAME_COMPONENTS = (
+    "forward", "relay", "throttle_backoff", "route_queue",
+    "replica_queue", "compile", "compute", "fetch", "upload", "feed",
+    "write", "other",
+)
+
+
+def capacity_value_errors(rec, lineno: int) -> list[str]:
+    """Value-level lint for the capacity-planner events: an offered
+    rate is strictly positive when present (a zero-rate phase/sweep
+    point measures nothing), a sweep point's quantiles are ordered
+    (p99 >= p50 by definition), its ``knee_blame`` names a component of
+    the PR-15 blame vocabulary, and a ``sim_replay`` that claims
+    ``match`` reproduced every recorded decision.  Non-negativity rides
+    the generic loop."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    if ev == "load_phase":
+        errs = []
+        mode = rec.get("mode")
+        if isinstance(mode, str) and mode not in LOAD_MODES:
+            errs.append(
+                f"line {lineno}: load_phase: mode {mode!r} not one of "
+                f"{LOAD_MODES}"
+            )
+        qps = rec.get("offered_qps")
+        if _num(qps) and qps <= 0:
+            errs.append(
+                f"line {lineno}: load_phase: offered_qps {qps} not "
+                "strictly positive (a zero-rate phase measures nothing)"
+            )
+        return errs
+    if ev == "sweep_point":
+        errs = []
+        qps = rec.get("offered_qps")
+        if _num(qps) and qps <= 0:
+            errs.append(
+                f"line {lineno}: sweep_point: offered_qps {qps} not "
+                "strictly positive (a zero-rate sweep point measures "
+                "nothing)"
+            )
+        p50, p99 = rec.get("p50_s"), rec.get("p99_s")
+        if _num(p50) and _num(p99) and p99 < p50:
+            errs.append(
+                f"line {lineno}: sweep_point: p99_s {p99} below p50_s "
+                f"{p50} (quantiles are ordered by definition)"
+            )
+        blame = rec.get("knee_blame")
+        if isinstance(blame, str) and blame not in KNEE_BLAME_COMPONENTS:
+            errs.append(
+                f"line {lineno}: sweep_point: knee_blame {blame!r} not "
+                f"in the blame vocabulary {KNEE_BLAME_COMPONENTS}"
+            )
+        return errs
+    if ev == "sim_replay":
+        errs = []
+        dec, matched = rec.get("decisions"), rec.get("matched")
+        if _num(dec) and _num(matched) and matched > dec:
+            errs.append(
+                f"line {lineno}: sim_replay: matched {matched} exceeds "
+                f"decisions {dec}"
+            )
+        if rec.get("match") is True and _num(dec) and _num(matched) \
+                and matched != dec:
+            errs.append(
+                f"line {lineno}: sim_replay: match=true with matched "
+                f"{matched} != decisions {dec} (match means every "
+                "recorded decision was reproduced)"
+            )
+        return errs
+    return []
+
+
 class TraceRefLint:
     """Referential-integrity lint for ``trace_id``, one instance per
     file.
@@ -614,6 +714,7 @@ def value_lints():
             + route_decision_value_errors(rec, lineno)
             + tune_value_errors(rec, lineno)
             + request_value_errors(rec, lineno)
+            + capacity_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + trace_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)

@@ -935,6 +935,188 @@ def soak(
                 f"{s['attempts']} route attempts)"
             )
 
+    def run_loadgen_churn_case() -> None:
+        """Load-rig churn semantics (the ``loadgen.tick`` seam): a
+        seeded closed-loop soak against a 2-replica spawned fleet whose
+        churn hook SIGKILLs the busiest replica mid-soak, driven from
+        the rig's own scheduler tick.  Zero offered jobs are lost (every
+        one reaches ``done``), every pinned trace id still assembles
+        through the request-trace store into a sweep point, the job
+        artifacts stay byte-identical to the clean run, AND the leg's
+        recorded decision log replays byte-identically through the
+        offline simulator — churn must not cost correctness on any of
+        the three planes.  Full mode only: three cold jax replica
+        processes (two spawned + the respawn-free survivor path) cost
+        tens of seconds the smoke budget does not have."""
+        import signal as _signal
+        import threading as _threading
+
+        from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+        from land_trendr_tpu.fleet.capacity import (
+            assemble_sweep,
+            percentile,
+            replay_decisions,
+        )
+        from land_trendr_tpu.loadgen import (
+            InProcClient,
+            LoadConfig,
+            LoadRunner,
+        )
+        from land_trendr_tpu.obs.events import validate_events_file
+        from land_trendr_tpu.runtime import faults
+
+        sys.path.insert(0, str(REPO / "tools"))
+        from check_events_schema import value_lints
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        rt_dir = str(root / "router_loadgen_churn")
+        router = FleetRouter(RouterConfig(
+            workdir=rt_dir,
+            spawn_replicas=2,
+            health_interval_s=0.3,
+            route_retries=3,
+            decision_log=True,
+            # pace every dispatch so the kill lands mid-job
+            replica_args=(
+                "--feed-cache-mb", "64",
+                "--fault-schedule", "seed=5,dispatch%1.0=slow:0.3",
+            ),
+        ))
+        rt_thread = _threading.Thread(target=router.serve_forever)
+        rt_thread.start()
+        killed: list = []
+
+        def churn() -> None:
+            # first firing tick with a busy live replica: SIGKILL it
+            if killed:
+                return
+            with router._lock:
+                for r in router.pool:
+                    if r.inflight and r.proc is not None \
+                            and r.proc.poll() is None:
+                        r.proc.send_signal(_signal.SIGKILL)
+                        killed.append(r.rid)
+                        return
+
+        def payload_fn(req) -> dict:
+            # one shape for every request: the soak's identity check is
+            # against ONE clean digest, so params must not vary
+            return {
+                "stack_dir": sdir,
+                "tile_size": base_kw["tile_size"],
+                "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+                "trace_id": req.trace_id,
+                "run_overrides": {"retry_backoff_s": 0.0},
+            }
+
+        plan = faults.activate(
+            faults.parse_schedule("seed=9,loadgen.tick%1.0")
+        )
+        try:
+            runner = LoadRunner(
+                LoadConfig(
+                    mode="closed", duration_s=120.0, requests=6,
+                    workers=2, seed=11, tenants=2, timeout_s=240.0,
+                ),
+                InProcClient(router), payload_fn,
+                telemetry=router.telemetry, churn=churn,
+            )
+            rep = runner.run(phase="fault_soak")
+            # assemble + emit the sweep point while the router's
+            # telemetry scope is still open
+            sweep = assemble_sweep(rt_dir, rep.trace_ids)
+            if router.telemetry is not None:
+                router.telemetry.sweep_point(
+                    replicas=2, offered_qps=rep.offered / max(rep.wall_s, 1e-6),
+                    achieved_qps=rep.done / max(rep.wall_s, 1e-6),
+                    p50_s=percentile(sweep["latencies"], 50.0),
+                    p99_s=percentile(sweep["latencies"], 99.0),
+                    goodput_qps=rep.done / max(rep.wall_s, 1e-6),
+                    done=rep.done, failed=rep.failed,
+                    rejected=rep.rejected, assembled=sweep["assembled"],
+                    window_s=rep.wall_s,
+                )
+        finally:
+            faults.deactivate()
+            router.stop()
+            rt_thread.join(timeout=600)
+        if not killed:
+            raise AssertionError(
+                "loadgen churn: the tick seam never found a busy "
+                "replica to kill"
+            )
+        if rep.churned < 1:
+            raise AssertionError(
+                "loadgen churn: the loadgen.tick seam never fired"
+            )
+        if not (rep.offered == rep.done == 6
+                and rep.failed == 0 and rep.rejected == 0):
+            raise AssertionError(
+                f"loadgen churn: lost jobs — offered {rep.offered}, "
+                f"done {rep.done}, failed {rep.failed}, rejected "
+                f"{rep.rejected} ({[o for o in rep.outcomes if o.outcome != 'done']})"
+            )
+        if sweep["assembled"] != 6 or len(sweep["latencies"]) != 6:
+            raise AssertionError(
+                f"loadgen churn: sweep point incomplete after the kill "
+                f"— {sweep['assembled']} assembled, "
+                f"{len(sweep['latencies'])} latencies of 6"
+            )
+        # the kill is VISIBLE in the trace store: at least one request
+        # re-routed (two forward hops)
+        evs = [
+            json.loads(line) for line in
+            (Path(rt_dir) / "events.jsonl").read_text().splitlines()
+        ]
+        rerouted = [
+            e for e in evs
+            if e["ev"] == "route_decision" and e.get("attempt", 1) >= 2
+        ]
+        if not rerouted:
+            raise AssertionError(
+                "loadgen churn: no re-routed job — the SIGKILL missed "
+                "every inflight window"
+            )
+        for jwd in sorted(Path(rt_dir).glob("jobs/*/work")):
+            if _digest_workdir(str(jwd)) != clean:
+                raise AssertionError(
+                    f"loadgen churn: {jwd} artifacts differ from the "
+                    "clean run"
+                )
+        lint = validate_events_file(
+            str(Path(rt_dir) / "events.jsonl"), extra=value_lints()
+        )
+        if lint:
+            raise AssertionError(
+                f"loadgen churn: router stream lint-dirty: {lint[:3]}"
+            )
+        replay = replay_decisions(str(Path(rt_dir) / "decisions.jsonl"))
+        if not replay.match:
+            raise AssertionError(
+                f"loadgen churn: decision replay diverged at seq "
+                f"{replay.mismatch_seq}: {replay.mismatch}"
+            )
+        report["cases"].append({
+            "track": "router",
+            "case": "loadgen_tick_churn_sigkill",
+            "schedule": "seed=9,loadgen.tick%1.0",
+            "killed_replica": killed[0],
+            "churn_ticks": rep.churned,
+            "rerouted_jobs": len(rerouted),
+            "done": rep.done,
+            "sweep_assembled": sweep["assembled"],
+            "artifacts_identical": True,
+            "replay_decisions": replay.decisions,
+            "replay_match": True,
+        })
+        if verbose:
+            print(
+                f"  ok: router/loadgen_tick_churn_sigkill "
+                f"(killed {killed[0]}, {len(rerouted)} re-route(s), "
+                f"{replay.decisions} decisions replayed)"
+            )
+
     def run_lease_kill_case() -> None:
         """Elastic failure semantics (ISSUE 12): two INDEPENDENT worker
         processes share one workdir through the shared-manifest lease
@@ -1161,6 +1343,7 @@ def soak(
     run_router_track()
     if not smoke:
         run_router_kill_case()
+        run_loadgen_churn_case()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a
     # real cache to poison (cases that pin their own feed_cache_mb —

@@ -104,30 +104,67 @@ def prom_instruments(text: str) -> list:
     """Exposition text → the instrument-dict shape
     ``land_trendr_tpu.obs.aggregate.merge_instruments`` folds.
 
-    Histogram series are carried as their ``_sum`` / ``_count``
-    counters (summing those across replicas IS the histogram merge for
-    the header's purposes; the cumulative ``_bucket`` rows are
-    skipped — re-deriving raw buckets from N cumulative series belongs
-    to the snapshot path, which ships them raw).
+    Histogram series are reconstructed whole: the cumulative
+    ``_bucket`` rows of one ``(family, labels)`` series de-cumulate
+    back into the per-bucket counts ``merge_instruments`` sums
+    elementwise, with ``_sum`` / ``_count`` riding along — so a fleet
+    header can answer percentiles from the MERGED distribution
+    (:func:`~land_trendr_tpu.obs.aggregate.histogram_quantile`), not
+    just totals.  A series whose rows are torn (no ``+Inf`` bucket, or
+    bucket counts that decumulate negative) is dropped rather than
+    folded wrong.
     """
     types: dict = {}
     rows = parse_prom(text, types=types)
     out: list = []
+    hists: dict = {}  # (family, labels-sans-le) → {les, sum, count}
     for name, labels, value in rows:
         kind = types.get(name)
         if kind is None:
             kind = "gauge"  # untyped rows merge conservatively
             for suffix in ("_bucket", "_sum", "_count"):
-                if (
-                    name.endswith(suffix)
-                    and types.get(name[: -len(suffix)]) == "histogram"
-                ):
-                    kind = None if suffix == "_bucket" else "counter"
+                family = name[: -len(suffix)] if name.endswith(suffix) else None
+                if family is not None and types.get(family) == "histogram":
+                    lab = {k: v for k, v in labels.items() if k != "le"}
+                    key = (family, tuple(sorted(lab.items())))
+                    h = hists.setdefault(
+                        key, {"labels": lab, "les": {}, "sum": 0.0,
+                              "count": 0},
+                    )
+                    if suffix == "_bucket":
+                        h["les"][labels.get("le", "+Inf")] = value
+                    elif suffix == "_sum":
+                        h["sum"] = value
+                    else:
+                        h["count"] = int(value)
+                    kind = None
                     break
             if kind is None:
-                continue  # cumulative bucket rows: not mergeable as-is
+                continue  # histogram row: folded into hists above
         out.append({"name": name, "kind": kind, "labels": labels,
                     "value": value})
+    for (family, _), h in sorted(hists.items()):
+        les = h["les"]
+        if "+Inf" not in les:
+            continue  # torn series: no total bucket to close against
+        try:
+            by_val = {float(le): v for le, v in les.items() if le != "+Inf"}
+        except ValueError:
+            continue  # an unparseable le label: drop the torn series
+        bounds = sorted(by_val)
+        cum = [by_val[b] for b in bounds]
+        cum.append(les["+Inf"])
+        buckets, prev = [], 0.0
+        for c in cum:
+            buckets.append(int(c - prev))
+            prev = c
+        if any(b < 0 for b in buckets):
+            continue  # torn series: cumulative counts went backwards
+        out.append({
+            "name": family, "kind": "histogram", "labels": h["labels"],
+            "sum": h["sum"], "count": h["count"], "bounds": bounds,
+            "buckets": buckets,
+        })
     return out
 
 
@@ -359,7 +396,10 @@ def render_fleet(snaps: list) -> str:
     ``obs.aggregate.merge_instruments``, the single copy of that
     logic), per-replica rows, every replica's jobs, and the union of
     active alerts."""
-    from land_trendr_tpu.obs.aggregate import merge_instruments
+    from land_trendr_tpu.obs.aggregate import (
+        histogram_quantile,
+        merge_instruments,
+    )
 
     merged, _ = merge_instruments(
         (float(i), prom_instruments(s.get("metrics_text", "")))
@@ -382,6 +422,22 @@ def render_fleet(snaps: list) -> str:
         f"burn(max) {agg('lt_slo_burn_rate'):.2f}   "
         f"rejections {agg('lt_serve_rejections_total'):.0f}"
     ]
+    # fleet-wide latency percentiles from the MERGED job-seconds
+    # distribution (per-replica percentiles don't average; merged
+    # buckets are the one honest fold)
+    job_hist = next(
+        (m for m in merged
+         if m["name"] == "lt_serve_job_seconds"
+         and m.get("kind") == "histogram" and not m.get("labels")),
+        None,
+    )
+    if job_hist is not None and job_hist.get("count", 0) > 0:
+        p50 = histogram_quantile(job_hist, 0.50)
+        p99 = histogram_quantile(job_hist, 0.99)
+        lines.append(
+            f"latency (merged, {job_hist['count']} jobs): "
+            f"p50 ~{p50:.2f}s  p99 ~{p99:.2f}s"
+        )
     lines.append("")
     lines.append(
         f"{'REPLICA':<28} {'UP':>6} {'QUEUE':>5} {'RUN':>3} "

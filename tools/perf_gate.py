@@ -42,6 +42,7 @@ FETCH_BASELINE = REPO / "FETCH_r08.json"
 UPLOAD_BASELINE = REPO / "UPLOAD_r10.json"
 SERVE_BASELINE = REPO / "SERVE_r11.json"
 FLIGHT_BASELINE = REPO / "FLIGHT_r12.json"
+CAPACITY_BASELINE = REPO / "CAPACITY_r17.json"
 
 #: a smoke ratio must reach this fraction of its committed value — loose
 #: enough for a 2-core container's noise, tight enough that a regression
@@ -883,6 +884,117 @@ def run_tune_leg(workdir: str, check) -> None:
     )
 
 
+#: capacity-planner leg: the scripted decision history (a seeded drive
+#: of the live pure machines — no fleet processes) and the replay
+#: bands.  The ≥100x throughput floor is the acceptance bound the
+#: capacity artifact documents; the replayer re-derives decisions at
+#: CPU iteration speed, so the bound is loose by orders of magnitude —
+#: it fails a replayer that started doing real-time waits, not a noisy
+#: container.
+CAPACITY_SCRIPT_SEED = 23
+CAPACITY_SCRIPT_EVENTS = 1500
+CAPACITY_MIN_SPEEDUP_X = 100.0
+
+
+def run_capacity_leg(workdir: str, check) -> None:
+    """Capacity-planner checks (fleet/capacity + the committed curve).
+
+    Structural, exact: a seeded scripted decision history replays
+    byte-identically through fresh pure machines (every recorded
+    pick/choose/remove/autoscale output re-derived and matched), a
+    tampered copy of the same history is DETECTED (the equivalence
+    check is falsifiable, not a tautology), and the committed
+    ``CAPACITY_r17.json`` passes the exact report schema with >= 3
+    replica counts and a named knee blame per curve.  Banded: replay
+    throughput >= 100x the recorded span.  Callable on its own
+    (``tests/test_capacity.py``) — it needs no fleet processes."""
+    from land_trendr_tpu.fleet.capacity import (
+        replay_decisions,
+        validate_report,
+        write_scripted_history,
+    )
+    from land_trendr_tpu.obs.reqtrace import BLAME_PRIORITY
+
+    hist = str(Path(workdir) / "capacity_scripted.decisions.jsonl")
+    script = write_scripted_history(
+        hist, seed=CAPACITY_SCRIPT_SEED, events=CAPACITY_SCRIPT_EVENTS
+    )
+    rep = replay_decisions(hist)
+    check(
+        "capacity.scripted_replay_match",
+        rep.match and rep.mismatch_seq is None,
+        f"{rep.matched}/{rep.decisions} decisions replayed "
+        f"byte-identically over a {script['span_s']:.1f}s recorded span "
+        f"(first mismatch seq {rep.mismatch_seq})",
+    )
+    check(
+        "capacity.replay_throughput",
+        rep.match and rep.speedup_x >= CAPACITY_MIN_SPEEDUP_X,
+        f"replayed a {rep.recorded_span_s:.1f}s span in "
+        f"{rep.replay_wall_s * 1e3:.1f}ms ({rep.speedup_x:,.0f}x vs "
+        f"floor {CAPACITY_MIN_SPEEDUP_X:.0f}x)",
+    )
+    # falsifiability: flip one recorded output and the replay must
+    # notice — a replayer that echoes the log would pass the match
+    # check vacuously
+    tampered = str(Path(workdir) / "capacity_tampered.decisions.jsonl")
+    lines = Path(hist).read_text().splitlines()
+    flipped = False
+    for i, line in enumerate(lines):
+        rec = json.loads(line)
+        if rec.get("kind") == "pick":
+            rec["job_id"] = rec["job_id"] + "-tampered"
+            lines[i] = json.dumps(rec, sort_keys=True)
+            flipped = True
+            break
+    Path(tampered).write_text("\n".join(lines) + "\n")
+    trep = replay_decisions(tampered) if flipped else None
+    check(
+        "capacity.tamper_detected",
+        flipped and trep is not None and not trep.match
+        and trep.mismatch_seq is not None
+        and trep.mismatch is not None and trep.mismatch["kind"] == "pick",
+        f"one flipped pick output caught at seq "
+        f"{trep.mismatch_seq if trep else None}",
+    )
+    base = json.loads(CAPACITY_BASELINE.read_text())
+    errs = validate_report(base)
+    curves = base.get("curves") or []
+    counts = sorted(
+        c.get("replicas") for c in curves if isinstance(c, dict)
+    )
+    check(
+        "capacity.curve_schema",
+        not errs and len(counts) >= 3 and len(set(counts)) == len(counts),
+        f"committed curve valid for replica counts {counts} "
+        f"({errs[:2]})",
+    )
+    vocab = (*BLAME_PRIORITY, "other")
+    knees = [
+        next(
+            (p.get("knee_blame") for p in c.get("points", [])
+             if p.get("knee")),
+            None,
+        )
+        for c in curves
+    ]
+    check(
+        "capacity.knees_named",
+        bool(knees) and all(b in vocab for b in knees),
+        f"every committed curve names its knee blame: {knees}",
+    )
+    crep = base.get("replay") or {}
+    srep = base.get("scripted_replay") or {}
+    check(
+        "capacity.committed_replay_stands",
+        crep.get("match") is True and srep.get("match") is True
+        and float(srep.get("speedup_x", 0)) >= CAPACITY_MIN_SPEEDUP_X,
+        f"committed artifact's live replay {crep.get('matched')}/"
+        f"{crep.get('decisions')} matched; scripted at "
+        f"{srep.get('speedup_x')}x",
+    )
+
+
 def run_gate(
     workdir: str, checks: list, scheduler: bool = True, router: bool = True
 ) -> None:
@@ -1028,6 +1140,7 @@ def run_gate(
     run_reqtrace_leg(workdir, check)
     run_fleet_leg(workdir, check)
     run_tune_leg(workdir, check)
+    run_capacity_leg(workdir, check)
     if scheduler:
         run_scheduler_leg(workdir, check)
     if router:
@@ -1085,7 +1198,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE,
-              SERVE_BASELINE, FLIGHT_BASELINE):
+              SERVE_BASELINE, FLIGHT_BASELINE, CAPACITY_BASELINE):
         if not p.exists():
             print(f"error: committed baseline {p.name} missing", file=sys.stderr)
             return 2
