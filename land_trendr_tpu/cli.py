@@ -561,6 +561,26 @@ def build_parser() -> argparse.ArgumentParser:
                      help="alert-rules JSON for the fleet loop "
                      "(land_trendr_tpu.obs.alerts); default: built-in "
                      "host-staleness + SLO-burn rules")
+    srv.add_argument("--batch", default="auto",
+                     choices=("auto", "on", "off"),
+                     help="cross-job continuous batching: coalesce "
+                     "queued same-affinity jobs behind one shared "
+                     "launch and demux byte-identical artifacts to "
+                     "each (README §Continuous batching); 'auto' "
+                     "resolves through --tune-store-dir, defaulting on")
+    srv.add_argument("--batch-window-ms", type=float, default=50.0,
+                     metavar="MS",
+                     help="how long the dispatcher holds a batch window "
+                     "open for same-affinity stragglers; closes early "
+                     "when a non-matching job reaches the queue front "
+                     "or the queue is empty (0 = batch only what is "
+                     "already queued)")
+    srv.add_argument("--batch-max-tiles", type=int, default=0,
+                     metavar="N",
+                     help="batch size bound in total coalesced tiles "
+                     "(jobs x tiles per job); members past the bound "
+                     "run solo in their normal queue turn (0 = "
+                     "unbounded)")
 
     rte = sub.add_parser(
         "route",
@@ -1134,6 +1154,12 @@ def main(argv: list[str] | None = None) -> int:
                 publish_interval_s=args.publish_interval_s,
                 telemetry_dir=args.telemetry_dir,
                 alert_rules=args.alert_rules,
+                batch=(
+                    "auto" if args.batch == "auto"
+                    else args.batch == "on"
+                ),
+                batch_window_ms=args.batch_window_ms,
+                batch_max_tiles=args.batch_max_tiles,
             )
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)

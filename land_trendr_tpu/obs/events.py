@@ -381,6 +381,22 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
         "match": bool,
         "speedup_x": _NUM,
     },
+    # --- cross-job continuous batching (serve/batching) ------------------
+    # one coalesced device launch: ``jobs`` same-affinity member jobs
+    # (>= 1 — a degenerate batch of one is today's path) whose tile
+    # union (``tiles`` >= ``jobs`` when every member has work — the
+    # value lint pins tiles >= jobs >= 1) runs through ONE warm
+    # pipeline.  Stamped with the LEADER's job_id/trace_id; the optional
+    # occupancy (useful px / padded px, 0 < occupancy <= 1 — pinned) and
+    # window_wait_s (time spent holding the batch window open) carry the
+    # packing efficiency story.  Additive event type.
+    "batch_launch": {"jobs": int, "tiles": int},
+    # batched results demuxed back to ONE member's manifest: ``tiles``
+    # durable tile artifacts this member received from the shared launch
+    # (byte-identical to a solo run's writes).  Stamped with the
+    # MEMBER's job_id/trace_id, so PR-15 blame attribution still
+    # partitions each request exactly.  Additive event type.
+    "batch_demux": {"tiles": int},
 }
 
 #: the request-span stage vocabulary, in journey order (open like
@@ -453,6 +469,10 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "stragglers": int,
         "tiles_stolen": int,
         "tiles_speculated": int,
+        # cross-job batching live state (the running leader's progress)
+        "batch_jobs": int,
+        "batch_tiles": int,
+        "batch_occupancy": _NUM,
     },
     "profile_captured": {"error": str, "bytes": int},
     "job_slo": {"deadline_s": _NUM},
@@ -493,6 +513,12 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "replay_wall_s": _NUM,
         "mismatch_seq": int,
     },
+    "batch_launch": {
+        "padded_px": int,
+        "occupancy": _NUM,
+        "window_wait_s": _NUM,
+    },
+    "batch_demux": {"member_jobs": int},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

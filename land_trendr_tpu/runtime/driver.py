@@ -989,6 +989,7 @@ class Run:
         shared_store=None,
         shared_cache: bool = False,
         flight=None,
+        on_tile_durable=None,
     ) -> None:
         # "auto" knob resolution (land_trendr_tpu/tune): any RunConfig
         # field carrying the "auto" sentinel is replaced HERE, before
@@ -1058,7 +1059,15 @@ class Run:
             "write_backlog": 0,
             "fetch_backlog": 0,
             "upload_backlog": 0,
+            "batch_jobs": 0,
+            "batch_tiles": 0,
+            "batch_occupancy": 0.0,
         }
+        #: durability callback (serve/batching demux): invoked on the
+        #: writer thread AFTER a tile's artifact is durable, with
+        #: (tile, arrays, meta).  Callback errors are swallowed — a
+        #: consumer's failure must never fail this run's tile.
+        self.on_tile_durable = on_tile_durable
         #: live straggler detector (obs/spans): the driver registers
         #: every dispatched attempt and checks completions; the flight
         #: sampler additionally scans in-flight tiles, so a tile wedging
@@ -1570,6 +1579,16 @@ class Run:
                 manifest.record(
                     t.tile_id, arrays, meta, compress=cfg.manifest_compress
                 )
+            if self.on_tile_durable is not None:
+                # cross-job demux (serve/batching): the artifact is durable;
+                # a consumer failure is ITS problem, never this tile's
+                try:
+                    self.on_tile_durable(t, arrays, meta)
+                except Exception:
+                    log.warning(
+                        "on_tile_durable callback failed for tile %d",
+                        t.tile_id, exc_info=True,
+                    )
             log.info(
                 "tile %d (%d,%d %dx%d): %.2fM px/s, no-fit %.1f%%",
                 t.tile_id, t.y0, t.x0, t.h, t.w,

@@ -67,6 +67,13 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     the knob group's probe fails and is SKIPPED — its
                     knobs fall back to defaults (``tune_probe`` event
                     ``ok=false``); the tuner and the run behind it live
+``batch.pack``      cross-job batch membership claim (``serve/batching``):
+                    the candidate job is EXCLUDED from the batch and runs
+                    solo later; the batch and its other members live
+``batch.demux``     batched-result demux to one member's manifest
+                    (``serve/batching``): that member stops receiving
+                    demuxed tiles and recomputes them in its own run
+                    (byte-identical); batch-mates are untouched
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -144,6 +151,8 @@ SEAMS = (
     "replica.health",
     "tune.probe",
     "loadgen.tick",
+    "batch.pack",
+    "batch.demux",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -173,6 +182,8 @@ _DEFAULT_KIND = {
     "replica.health": "fire",
     "tune.probe": "runtime",
     "loadgen.tick": "fire",
+    "batch.pack": "io",
+    "batch.demux": "io",
 }
 
 

@@ -148,6 +148,24 @@ class ServeConfig:
     #: rule is a startup error, not a dead rule discovered after the
     #: incident.
     alert_rules: str | None = None
+    #: cross-job continuous batching (:mod:`land_trendr_tpu.serve.
+    #: batching`): coalesce queued same-affinity jobs behind one shared
+    #: device launch — compute once, demux byte-identical artifacts to
+    #: every member.  ``True``/``False`` force it; ``"auto"`` resolves
+    #: through the replica's tuning store (``tune_store_dir``) at batch
+    #: time, defaulting ON (batching changes packing, never bytes or
+    #: fairness ordering).
+    batch: bool | str = "auto"
+    #: how long the dispatcher holds the batch window open (milliseconds)
+    #: for same-affinity stragglers to join the popped leader before
+    #: launching — the window closes EARLY the moment a non-matching job
+    #: reaches the queue front (batching must never delay the fairness
+    #: order).  0 batches only what is already queued.
+    batch_window_ms: float = 50.0
+    #: batch size bound, total coalesced tiles (member jobs × tiles per
+    #: job); members past the bound run solo in their normal queue turn.
+    #: 0 = unbounded.
+    batch_max_tiles: int = 0
 
     def __post_init__(self) -> None:
         if not (0 <= self.serve_port <= 65535):
@@ -268,6 +286,23 @@ class ServeConfig:
                 raise ValueError(
                     f"alert_rules file unreadable: {e}"
                 ) from None
+        if not (
+            self.batch is True or self.batch is False or self.batch == "auto"
+        ):
+            raise ValueError(
+                f"batch={self.batch!r} must be True, False or 'auto' "
+                "(tuning-store resolution)"
+            )
+        if self.batch_window_ms < 0:
+            raise ValueError(
+                f"batch_window_ms={self.batch_window_ms} must be >= 0 "
+                "(0 = batch only what is already queued)"
+            )
+        if self.batch_max_tiles < 0:
+            raise ValueError(
+                f"batch_max_tiles={self.batch_max_tiles} must be >= 0 "
+                "(0 = unbounded)"
+            )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam is a config error at startup, not
             # a dead injection discovered after the soak run (the same

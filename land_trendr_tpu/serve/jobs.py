@@ -290,6 +290,11 @@ class Job:
         default_factory=threading.Event
     )
     timed_out: bool = False
+    #: set by the dispatcher when a shared launch fully demuxed this
+    #: job's manifest: its own queue turn is a pure resume, so the
+    #: dispatcher holds no batch window for it and never re-packs it
+    #: (a batch behind a no-work leader demuxes nothing)
+    batch_demuxed: bool = False
     dropbox_path: "str | None" = None
     #: the live Run object while the job executes (the /debug/jobs
     #: progress feed); RELEASED at terminal — a Run pins the job's whole

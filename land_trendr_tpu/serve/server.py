@@ -247,6 +247,26 @@ class _ServeTelemetry:
             "terminal jobs that missed their deadline (jobs without a "
             "deadline_s never enter the window)",
         )
+        # cross-job continuous batching (serve/batching): how often the
+        # dispatcher coalesces, how much it coalesces, and how full the
+        # shared launches run (batch_launch/batch_demux events carry the
+        # same numbers per batch)
+        self._batch_launches = r.counter(
+            "lt_batch_launches_total",
+            "shared launches coalescing >= 2 same-affinity jobs",
+        )
+        self._batch_jobs_coalesced = r.counter(
+            "lt_batch_jobs_coalesced_total",
+            "jobs that shared a launch (leader + members, per batch)",
+        )
+        self._batch_demux_tiles = r.counter(
+            "lt_batch_demux_tiles_total",
+            "durable tile artifacts demuxed to batch members' manifests",
+        )
+        self._batch_occupancy = r.gauge(
+            "lt_batch_occupancy",
+            "useful px / padded px of the most recent shared launch",
+        )
         #: burn-rate window: the last N deadlined terminal jobs' met
         #: verdicts.  A dedicated deque, NOT the flight ring — one busy
         #: job's tile events would evict every prior ``job_slo`` record
@@ -571,6 +591,36 @@ class _ServeTelemetry:
             window = list(self._slo_window)
             self._slo_burn.set(window.count(False) / len(window))
 
+    def batch_launch(self, job: Job, stats: dict) -> None:
+        """One coalesced launch: stamped with the LEADER's identity so
+        blame attribution keeps partitioning each request exactly —
+        members get their own ``batch_demux`` on the same scope."""
+        self.events.emit(
+            "batch_launch",
+            job_id=job.job_id,
+            trace_id=job.trace_id,
+            jobs=int(stats["jobs"]),
+            tiles=int(stats["tiles"]),
+            padded_px=int(stats["padded_px"]),
+            occupancy=float(stats["occupancy"]),
+            window_wait_s=float(stats["window_wait_s"]),
+        )
+        self._batch_launches.inc()
+        self._batch_jobs_coalesced.inc(int(stats["jobs"]))
+        self._batch_occupancy.set(float(stats["occupancy"]))
+
+    def batch_demux(self, job: Job, tiles: int) -> None:
+        """One member's share of a shared launch, stamped with the
+        MEMBER's identity (its run scope then resumes over the demuxed
+        manifest with near-zero device work)."""
+        self.events.emit(
+            "batch_demux",
+            job_id=job.job_id,
+            trace_id=job.trace_id,
+            tiles=int(tiles),
+        )
+        self._batch_demux_tiles.inc(int(tiles))
+
     def profile_captured(
         self,
         ok: bool,
@@ -821,9 +871,12 @@ class SegmentationServer:
                 for k in (
                     "feed_backlog", "write_backlog", "fetch_backlog",
                     "upload_backlog", "stragglers", "tiles_stolen",
-                    "tiles_speculated",
+                    "tiles_speculated", "batch_jobs", "batch_tiles",
                 ):
                     out[k] = int(p.get(k, 0))
+                out["batch_occupancy"] = float(
+                    p.get("batch_occupancy", 0.0)
+                )
         return out
 
     def _fleet_probes(self) -> dict:
@@ -1194,7 +1247,7 @@ class SegmentationServer:
                 job = self._next_job()
                 if job is None:
                     break
-                self._run_job(job)
+                self._run_job(job, batch=self._collect_batch(job))
         except BaseException:
             status = "aborted"
             raise
@@ -1225,6 +1278,91 @@ class SegmentationServer:
                     return job
                 self._cond.wait(timeout=0.2)
 
+    def _batch_front_locked(self, key: str) -> "tuple[list, bool]":
+        """The contiguous same-affinity front of the fairness-ordered
+        queue (caller holds the lock): member candidates in pop order,
+        plus whether a NON-matching job blocks the front.  Batching
+        takes exactly the next jobs the scheduler would have run anyway
+        — it changes packing, never fairness ordering."""
+        members: list = []
+        blocked = False
+        for _, _, job_id in sorted(self._queue):
+            job = self._jobs.get(job_id)
+            if job is None or job.state != "queued":
+                continue  # cancelled while queued (stale heap entry)
+            req = job.request
+            # a member must resume its manifest to see its demuxed
+            # tiles; resume=False opts the job out of co-batching
+            if req.affinity_key() == key and req.resume:
+                if job.batch_demuxed:
+                    # an earlier batch already filled its manifest:
+                    # nothing left to demux into it — skip it (it pops
+                    # before the members anyway and resumes solo)
+                    continue
+                members.append(job)
+            else:
+                blocked = True
+                break
+        return members, blocked
+
+    def _collect_batch(self, leader: Job):
+        """Collect the leader's batch (cross-job continuous batching):
+        hold the window open up to ``batch_window_ms`` for
+        same-affinity stragglers, closing EARLY when a non-matching job
+        reaches the queue front (fairness must never wait on packing)
+        or the queue is empty (a single-job fleet keeps today's path
+        and today's latency).  Returns a
+        :class:`~land_trendr_tpu.serve.batching.CrossJobBatch` or
+        ``None`` (solo — the stock path)."""
+        cfg = self.cfg
+        if cfg.batch is False:
+            return None
+        if leader.batch_demuxed:
+            # an earlier batch fully demuxed this job: its run is a
+            # pure resume (every tile already durable), so a window
+            # could only delay it — and a batch behind a no-work
+            # leader demuxes nothing.  Solo, stock path.
+            return None
+        key = leader.request.affinity_key()
+        deadline = time.monotonic() + cfg.batch_window_ms / 1000.0
+        t0 = time.monotonic()
+        with self._lock:
+            while True:
+                members, blocked = self._batch_front_locked(key)
+                now = time.monotonic()
+                if (
+                    self._stopping
+                    or blocked
+                    or not members
+                    or now >= deadline
+                    or leader.cancel.is_set()
+                ):
+                    break
+                self._cond.wait(timeout=min(deadline - now, 0.05))
+        if not members:
+            return None
+        window_wait_s = time.monotonic() - t0
+        # the batch.pack seam: an injected pack failure excludes THAT
+        # candidate from the batch — it runs solo in its normal queue
+        # turn; the batch and its other members live
+        packed = []
+        for m in members:
+            try:
+                faults.check("batch.pack")
+                packed.append(m)
+            except Exception as e:
+                log.warning(
+                    "batch pack excluded job %s: %s (it runs solo)",
+                    m.job_id, e,
+                )
+        if not packed:
+            return None
+        from land_trendr_tpu.serve.batching import CrossJobBatch
+
+        batch = CrossJobBatch(leader, packed)
+        batch.window_wait_s = window_wait_s
+        return batch
+
     def _open_stack(self, req: JobRequest):
         from land_trendr_tpu.ops.indices import required_bands
 
@@ -1237,7 +1375,7 @@ class SegmentationServer:
 
         return load_stack_dir(req.stack_dir, bands=bands)
 
-    def _run_job(self, job: Job) -> None:
+    def _run_job(self, job: Job, batch=None) -> None:
         from land_trendr_tpu.runtime import (
             Run,
             RunCancelled,
@@ -1301,8 +1439,39 @@ class SegmentationServer:
                     if self.telemetry is not None
                     else None
                 ),
+                # cross-job batching: every durable tile of this leader
+                # run demuxes into its batch-mates' manifests (None on
+                # the stock solo path)
+                on_tile_durable=(
+                    batch.demux_tile if batch is not None else None
+                ),
             )
             job.run = run
+            if batch is not None:
+                from land_trendr_tpu.serve.batching import resolve_batch
+
+                # "auto" resolves through the replica's tuning store
+                # now that the scene shape is known; an explicit True
+                # skips the store (the knob contract)
+                if resolve_batch(
+                    self.cfg.batch,
+                    self.cfg.tune_store_dir,
+                    (*stack.shape, stack.n_years),
+                ):
+                    stats = batch.open(
+                        run,
+                        max_tiles=self.cfg.batch_max_tiles,
+                        window_wait_s=getattr(batch, "window_wait_s", 0.0),
+                    )
+                    if batch.members and self.telemetry is not None:
+                        self.telemetry.batch_launch(job, stats)
+                    if not batch.members:
+                        # batch_max_tiles trimmed everyone: stock path
+                        run.on_tile_durable = None
+                        batch = None
+                else:
+                    run.on_tile_durable = None
+                    batch = None
             if run.tune_info is not None:
                 # which profile this replica's jobs resolve through —
                 # surfaced on /healthz and the fleet snapshot so a mixed
@@ -1355,6 +1524,26 @@ class SegmentationServer:
         finally:
             if timer is not None:
                 timer.cancel()
+
+        if batch is not None:
+            # per-member demux accounting, stamped with EACH member's
+            # identity (blame attribution still partitions each request
+            # exactly); emitted even after a leader failure — whatever
+            # demuxed before the abort is durable, and each member's
+            # own queued run completes the rest byte-identically
+            for mjob, tiles, merr, complete in batch.finalize():
+                if self.telemetry is not None:
+                    self.telemetry.batch_demux(mjob, tiles)
+                # a fully-demuxed member's queue turn is a pure resume:
+                # flag it so the dispatcher never holds a batch window
+                # for it (a batch behind a no-work leader demuxes
+                # nothing — the window could only delay the flood)
+                mjob.batch_demuxed = complete
+                if merr:
+                    log.info(
+                        "batch member %s fell back to solo after %d "
+                        "demuxed tile(s): %s", mjob.job_id, tiles, merr,
+                    )
 
         with self._lock:
             job.state = state

@@ -1,0 +1,295 @@
+"""Cross-job continuous batching: packing changes launches, never bytes.
+
+The contract under test (``land_trendr_tpu/serve/batching.py`` plus the
+server's dispatcher hooks):
+
+* a flood of same-affinity jobs coalesces behind shared launches and
+  every job's artifacts stay **byte-identical** to one-run-per-job
+  execution;
+* mixed-affinity jobs never co-batch, and a non-matching job at the
+  queue front closes the window EARLY — batching changes packing,
+  never the fairness order;
+* a single-job fleet keeps today's path (no batch events at all);
+* a member cancelled while queued drops out of the batch without
+  harming its batch-mates;
+* the ``batch_launch`` value lints catch impossible packings.
+
+The fault seams (``batch.pack`` / ``batch.demux``) and SIGKILL
+mid-batch recovery are ``tools/fault_soak.py``'s cases; the speedup
+claim is ``tools/batch_bench.py`` + the perf gate's banded leg.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+from land_trendr_tpu.serve.batching import resolve_batch
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+#: one scene shape for the whole module: identical program-cache keys
+#: across tests keep every server after the first warm
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("batch_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+@pytest.fixture(scope="module")
+def reference(stack_dir, tmp_path_factory) -> dict:
+    """One batch=False run of the canonical job: the one-run-per-job
+    artifact digests every batched job must reproduce byte-for-byte."""
+    srv_dir = str(tmp_path_factory.mktemp("batch_ref") / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=1, feed_cache_mb=32,
+                    batch=False)
+    )
+    snap = server.submit(_job(stack_dir))
+    server.serve_forever()
+    snap = server.job_status(snap["job_id"])
+    assert snap["state"] == "done"
+    ref = _digest_workdir(snap["workdir"])
+    assert ref, "reference run produced no artifacts"
+    return ref
+
+
+def _digest_workdir(workdir: str) -> dict:
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _job(stack_dir: str, **kw) -> dict:
+    return {
+        "stack_dir": stack_dir,
+        "tile_size": _TILE,
+        "params": dict(_PARAMS),
+        **kw,
+    }
+
+
+def _batch_events(srv_dir: str) -> tuple[list, list]:
+    launches, demuxes = [], []
+    with open(Path(srv_dir) / "events.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("ev") == "batch_launch":
+                launches.append(rec)
+            elif rec.get("ev") == "batch_demux":
+                demuxes.append(rec)
+    return launches, demuxes
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+
+
+def test_resolve_batch_explicit_wins_and_auto_defaults_on(tmp_path):
+    assert resolve_batch(True) is True
+    assert resolve_batch(False) is False
+    # no store, no profile: batching is byte-identical packing, so
+    # "auto" defaults ON
+    assert resolve_batch("auto") is True
+    assert resolve_batch("auto", tune_store_dir=str(tmp_path),
+                         scene_shape=(40, 40, 9)) is True
+    with pytest.raises(ValueError, match="batch"):
+        resolve_batch("yes")
+
+
+def test_resolve_batch_auto_consults_tuning_store(tmp_path):
+    """A stored profile carrying a ``batch`` knob pins the verdict for
+    its (device, backend, shape class) — the PR-14 autotuner contract."""
+    from land_trendr_tpu.tune.autotune import device_identity
+    from land_trendr_tpu.tune.store import (
+        TUNE_SCHEMA,
+        TuningStore,
+        shape_class,
+    )
+
+    device_kind, backend = device_identity()
+    store = TuningStore(str(tmp_path))
+    store.save({
+        "schema": TUNE_SCHEMA,
+        "device_kind": device_kind,
+        "backend": backend,
+        "shape_class": shape_class(40, 40, 9),
+        "knobs": {"batch": False},
+        "created_t": time.time(),
+    })
+    assert resolve_batch("auto", tune_store_dir=str(tmp_path),
+                         scene_shape=(40, 40, 9)) is False
+    # a DIFFERENT shape class misses the profile and keeps the default
+    assert resolve_batch("auto", tune_store_dir=str(tmp_path),
+                         scene_shape=(4000, 4000, 9)) is True
+    # the explicit knob never consults the store
+    assert resolve_batch(False, tune_store_dir=str(tmp_path),
+                         scene_shape=(40, 40, 9)) is False
+
+
+# ---------------------------------------------------------------------------
+# the headline contract: coalesced launches, byte-identical artifacts
+
+
+def test_flood_coalesces_and_matches_one_run_per_job(
+    stack_dir, reference, tmp_path
+):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=3, feed_cache_mb=32,
+                    batch=True, batch_window_ms=200.0)
+    )
+    # all three queued BEFORE the dispatcher starts: the leader's
+    # window sees the whole flood
+    snaps = [server.submit(_job(stack_dir)) for _ in range(3)]
+    server.serve_forever()
+
+    for snap in snaps:
+        s = server.job_status(snap["job_id"])
+        assert s["state"] == "done", s.get("error")
+        assert _digest_workdir(s["workdir"]) == reference
+
+    launches, demuxes = _batch_events(srv_dir)
+    # ONE launch packs the leader plus both queued members (its
+    # identity is the LEADER's); the fully-demuxed members then resume
+    # solo — no window held, no re-pack, no further batch events
+    assert len(launches) == 1
+    assert launches[0]["jobs"] == 3
+    assert launches[0]["tiles"] == 3 * len(reference)
+    assert 0 < launches[0]["occupancy"] <= 1
+    assert launches[0]["job_id"] == snaps[0]["job_id"]
+    # each member got one batch_demux carrying its demuxed tile count
+    assert sum(d["tiles"] for d in demuxes) == 2 * len(reference)
+    member_ids = {d["job_id"] for d in demuxes}
+    assert member_ids == {snaps[1]["job_id"], snaps[2]["job_id"]}
+
+    # the event stream is schema- and value-lint clean (batch lints
+    # included via check_events_schema.value_lints)
+    from check_events_schema import main as lint_main
+
+    assert lint_main([srv_dir]) == 0
+
+
+def test_single_job_fleet_keeps_stock_path(stack_dir, reference, tmp_path):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=1, feed_cache_mb=32,
+                    batch=True, batch_window_ms=200.0)
+    )
+    snap = server.submit(_job(stack_dir))
+    server.serve_forever()
+    s = server.job_status(snap["job_id"])
+    assert s["state"] == "done"
+    assert _digest_workdir(s["workdir"]) == reference
+    launches, demuxes = _batch_events(srv_dir)
+    assert launches == [] and demuxes == [], (
+        "a solo job must not pay (or log) any batch machinery"
+    )
+
+
+def test_mixed_affinity_never_co_batches_and_keeps_order(
+    stack_dir, reference, tmp_path
+):
+    """A non-matching job at the queue front closes the window early:
+    nothing co-batches across affinity keys, and completion follows the
+    fairness order exactly as if batching did not exist."""
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=3, feed_cache_mb=32,
+                    batch=True, batch_window_ms=200.0)
+    )
+    a = server.submit(_job(stack_dir))
+    b = server.submit(_job(stack_dir, tile_size=10))  # different affinity
+    c = server.submit(_job(stack_dir))
+    server.serve_forever()
+
+    sa, sb, sc = (
+        server.job_status(s["job_id"]) for s in (a, b, c)
+    )
+    assert sa["state"] == sb["state"] == sc["state"] == "done"
+    launches, demuxes = _batch_events(srv_dir)
+    assert launches == [] and demuxes == [], (
+        "jobs with different affinity keys must never share a launch"
+    )
+    # fairness preserved: a < b < c by completion, the submit order
+    assert sa["finished_t"] <= sb["finished_t"] <= sc["finished_t"]
+    assert _digest_workdir(sa["workdir"]) == reference
+    assert _digest_workdir(sc["workdir"]) == reference
+
+
+def test_cancelled_member_drops_out_without_harming_batch_mates(
+    stack_dir, reference, tmp_path
+):
+    srv_dir = str(tmp_path / "srv")
+    server = SegmentationServer(
+        ServeConfig(workdir=srv_dir, max_jobs=3, feed_cache_mb=32,
+                    batch=True, batch_window_ms=200.0)
+    )
+    snaps = [server.submit(_job(stack_dir)) for _ in range(3)]
+    # the middle job leaves the queue before the dispatcher starts
+    cancelled = server.cancel(snaps[1]["job_id"])
+    assert cancelled["state"] == "cancelled"
+    server.serve_forever()
+
+    s0 = server.job_status(snaps[0]["job_id"])
+    s2 = server.job_status(snaps[2]["job_id"])
+    assert s0["state"] == s2["state"] == "done"
+    assert server.job_status(snaps[1]["job_id"])["state"] == "cancelled"
+    assert _digest_workdir(s0["workdir"]) == reference
+    assert _digest_workdir(s2["workdir"]) == reference
+    launches, demuxes = _batch_events(srv_dir)
+    # the survivors still coalesce — just without the cancelled member
+    assert launches and launches[0]["jobs"] == 2
+    assert {d["job_id"] for d in demuxes} == {snaps[2]["job_id"]}
+
+
+# ---------------------------------------------------------------------------
+# value lints: impossible packings are schema errors, not silent data
+
+
+def test_batch_launch_value_lints():
+    from check_events_schema import batch_value_errors
+
+    good = {"ev": "batch_launch", "jobs": 3, "tiles": 12,
+            "occupancy": 0.87}
+    assert batch_value_errors(good, 1) == []
+    assert batch_value_errors({"ev": "job_done"}, 1) == []
+
+    assert batch_value_errors(
+        {"ev": "batch_launch", "jobs": 0, "tiles": 0, "occupancy": 0.5}, 1
+    ), "jobs < 1 must lint (a launch coalesces at least its leader)"
+    assert batch_value_errors(
+        {"ev": "batch_launch", "jobs": 3, "tiles": 2, "occupancy": 0.5}, 1
+    ), "tiles < jobs must lint (every job brings at least one tile)"
+    for occ in (0, 1.5, -0.1):
+        assert batch_value_errors(
+            {"ev": "batch_launch", "jobs": 2, "tiles": 8, "occupancy": occ},
+            1,
+        ), f"occupancy {occ} must lint (not a fraction of the batch)"

@@ -1,0 +1,410 @@
+"""Cross-job continuous-batching bench: a flood of small jobs, packed.
+
+Replays the fleet's dominant workload — MANY identical small-AOI
+segmentation jobs — through the PR-16 loadgen rig (closed loop, every
+virtual client submits at once) against two real
+:class:`~land_trendr_tpu.serve.server.SegmentationServer` instances
+over the same synthetic stack:
+
+* the **base** leg runs with ``batch=False``: one job = one run = one
+  pipeline, so every tiny job pays its own dispatch, padding and
+  pipeline-drain overhead (today's path);
+* the **batched** leg runs with ``batch=True``: the dispatcher
+  coalesces the queued same-affinity jobs behind ONE shared launch and
+  demuxes each durable tile into every member's own manifest, so the
+  members' queue turns are near-zero-work resumes.
+
+A discarded **warmup** job runs first so BOTH legs measure warm
+steady state — the program cache compiled, the stack touched.  Fleet
+floods are steady-state traffic; cold-start amortization is
+``tools/serve_bench.py``'s story, and folding it into either leg here
+would credit batching with a compile it didn't remove.  (This also
+makes the report deterministic across contexts: standalone and inside
+the perf gate's long-lived process read the same numbers.)
+
+The speedup is never bought with correctness: every job workdir in
+BOTH legs is digest-compared against one reference (all jobs are
+identical, so all artifacts must be byte-identical, batched or not).
+Device-side packing quality is read back from the batched server's
+``batch_launch``/``batch_demux`` events (jobs per launch, padded-pixel
+occupancy, demuxed tiles).
+
+The report also carries a capacity-planner comparison: a closed-loop
+flood measures each leg's saturation throughput, which is (to first
+order) where the open-loop p99 knee sits on the
+``tools/capacity_bench.py`` replicas-vs-QPS curve — so
+``knee_shift_x`` says how far right batching moves the knee for this
+one-replica, small-AOI workload.
+
+    python tools/batch_bench.py --smoke --out /tmp/batch_smoke.json
+    python tools/batch_bench.py --out BATCH_r18.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+def _digest_workdir(workdir: str) -> dict:
+    """tile_id → {array name → sha256} (array-content identity, like
+    fault_soak: npz zip metadata legitimately differs run to run)."""
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+class _ServerClient:
+    """Loadgen client driving one :class:`SegmentationServer` in
+    process (the :class:`InProcClient` shape, pointed at a server
+    instead of a router): submissions go through the server's real
+    admission control, and status polls keep working after the bounded
+    server closes its HTTP socket — losing the race to one final GET
+    is not a bench failure.  Records every accepted job id so the
+    bench can digest each job's workdir afterwards."""
+
+    def __init__(self, server) -> None:
+        self._server = server
+        self.job_ids: "list[str]" = []
+        self._lock = threading.Lock()
+
+    def submit(self, payload: dict) -> "tuple[str | None, str | None]":
+        from land_trendr_tpu.serve.server import Rejection
+
+        try:
+            snap = self._server.submit(payload, source="loadgen")
+        except Rejection as e:
+            return None, e.reason
+        with self._lock:
+            self.job_ids.append(snap["job_id"])
+        return snap["job_id"], None
+
+    def status(self, job_id: str) -> "str | None":
+        snap = self._server.job_status(job_id)
+        return None if snap is None else snap.get("state")
+
+
+def _batch_events(workdir: str) -> "tuple[list, list]":
+    """(batch_launch records, batch_demux records) from the server's
+    events stream — the packing-quality ground truth."""
+    launches: list = []
+    demuxes: list = []
+    path = Path(workdir) / "events.jsonl"
+    if not path.exists():
+        return launches, demuxes
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line: not this bench's concern
+            if rec.get("ev") == "batch_launch":
+                launches.append(rec)
+            elif rec.get("ev") == "batch_demux":
+                demuxes.append(rec)
+    return launches, demuxes
+
+
+def run_leg(
+    name: str,
+    root: str,
+    stack_dir: str,
+    *,
+    tile: int,
+    n_jobs: int,
+    batch: bool,
+    window_ms: float,
+) -> dict:
+    """One flood: ``n_jobs`` identical small jobs, closed loop with
+    ``n_jobs`` virtual clients (everything queues at once — the
+    batched dispatcher sees the whole flood), drained to terminal."""
+    from land_trendr_tpu.fleet.capacity import percentile
+    from land_trendr_tpu.loadgen import LoadConfig, LoadRunner
+    from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+    cfg = ServeConfig(
+        workdir=str(Path(root) / name),
+        serve_port=0,
+        max_jobs=n_jobs,
+        # the whole flood must queue at once for the dispatcher to see
+        # it — admission caps are the router/capacity benches' story
+        tenant_max_inflight=n_jobs,
+        feed_cache_mb=64,
+        batch=batch,
+        batch_window_ms=window_ms,
+    )
+    server = SegmentationServer(cfg)
+    client = _ServerClient(server)
+
+    def payload_fn(req) -> dict:
+        # one small-AOI preset for the whole flood: identical payloads
+        # → identical affinity keys → the batched leg may coalesce
+        # every queued job (req.shape is ignored on purpose)
+        return {
+            "stack_dir": stack_dir,
+            "tile_size": tile,
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "tenant": req.tenant,
+            "trace_id": req.trace_id,
+        }
+
+    runner = LoadRunner(
+        LoadConfig(
+            mode="closed",
+            duration_s=900.0,
+            requests=n_jobs,
+            workers=n_jobs,
+            seed=18,
+            tenants=2,
+            timeout_s=600.0,
+        ),
+        client,
+        payload_fn,
+    )
+    box: dict = {}
+    errors: list = []
+
+    def drive() -> None:
+        try:
+            box["report"] = runner.run(phase=name)
+        except Exception as e:  # surfaces in the report, fails the bench
+            errors.append(f"{type(e).__name__}: {e}")
+            server.stop()
+
+    t = threading.Thread(target=drive, name=f"batch-bench-{name}")
+    t.start()
+    # let the flood actually queue before the first pop: the workload
+    # under test is a standing backlog, not a trickle — and BOTH legs
+    # pay the same beat, so the comparison is untouched
+    time.sleep(0.1)
+    server.serve_forever()  # drains the flood, then shuts down
+    t.join(timeout=60)
+    if errors:
+        raise RuntimeError(f"bench client failed: {errors[0]}")
+    rep = box["report"]
+
+    workdirs = []
+    for job_id in client.job_ids:
+        snap = server.job_status(job_id)
+        if snap is None or snap["state"] != "done":
+            state = None if snap is None else snap.get("state")
+            raise RuntimeError(
+                f"{name}: job {job_id} ended {state}: "
+                f"{None if snap is None else snap.get('error')}"
+            )
+        workdirs.append(snap["workdir"])
+
+    launches, demuxes = _batch_events(cfg.workdir)
+    lat = sorted(o.latency_s for o in rep.outcomes if o.latency_s)
+    leg = {
+        "batch": batch,
+        "jobs": n_jobs,
+        "done": rep.done,
+        "failed": rep.failed,
+        "rejected": rep.rejected,
+        "wall_s": round(rep.wall_s, 4),
+        "throughput_jobs_s": round(rep.done / rep.wall_s, 4)
+        if rep.wall_s
+        else None,
+        "p50_s": round(percentile(lat, 50.0), 4) if lat else None,
+        "p99_s": round(percentile(lat, 99.0), 4) if lat else None,
+        "launches": len(launches),
+        "jobs_coalesced": sum(r["jobs"] for r in launches),
+        "jobs_per_launch": round(
+            sum(r["jobs"] for r in launches) / len(launches), 2
+        )
+        if launches
+        else None,
+        "occupancy": round(
+            sum(r["occupancy"] for r in launches) / len(launches), 4
+        )
+        if launches
+        else None,
+        "demuxed_tiles": sum(r["tiles"] for r in demuxes),
+    }
+    return {"leg": leg, "workdirs": workdirs}
+
+
+def run_bench(
+    size: int, years: int, tile: int, n_jobs: int, window_ms: float, root: str
+) -> dict:
+    from land_trendr_tpu.io.synthetic import (
+        SceneSpec,
+        make_stack,
+        write_stack_c2,
+    )
+
+    stack_dir = str(Path(root) / "stack")
+    write_stack_c2(
+        stack_dir,
+        make_stack(
+            SceneSpec(
+                width=size,
+                height=size,
+                year_start=2000,
+                year_end=2000 + years - 1,
+                seed=18,
+            )
+        ),
+    )
+
+    # one discarded solo job: compile + first-touch land here, so both
+    # measured legs read warm steady state (see the module docstring)
+    warmup = run_leg(
+        "warmup", root, stack_dir,
+        tile=tile, n_jobs=1, batch=False, window_ms=window_ms,
+    )
+
+    base = run_leg(
+        "base", root, stack_dir,
+        tile=tile, n_jobs=n_jobs, batch=False, window_ms=window_ms,
+    )
+    batched = run_leg(
+        "batched", root, stack_dir,
+        tile=tile, n_jobs=n_jobs, batch=True, window_ms=window_ms,
+    )
+
+    # parity: every job in BOTH legs must match one non-empty
+    # reference — all payloads are identical, so batching may change
+    # packing, never bytes
+    reference = _digest_workdir(base["workdirs"][0])
+    parity_ok = bool(reference) and all(
+        _digest_workdir(wd) == reference
+        for leg in (base, batched)
+        for wd in leg["workdirs"]
+    )
+
+    b, p = base["leg"], batched["leg"]
+    speedup = (
+        round(p["throughput_jobs_s"] / b["throughput_jobs_s"], 2)
+        if b["throughput_jobs_s"] and p["throughput_jobs_s"]
+        else None
+    )
+    report = {
+        "schema": "lt-batch-bench-v1",
+        "workload": {
+            "scene_px": size * size,
+            "years": years,
+            "tile_size": tile,
+            "tiles_per_job": ((size + tile - 1) // tile) ** 2,
+            "jobs": n_jobs,
+            "batch_window_ms": window_ms,
+            "mode": "closed",
+            "warmup_s": warmup["leg"]["wall_s"],
+        },
+        "base": b,
+        "batched": p,
+        # the headline: packing the flood behind shared launches
+        "speedup_batched": speedup,
+        # a closed-loop flood measures saturation throughput — to
+        # first order, where the open-loop p99 knee sits on the
+        # capacity planner's one-replica curve (CAPACITY_r17.json)
+        "capacity": {
+            "base_knee_qps_est": b["throughput_jobs_s"],
+            "batched_knee_qps_est": p["throughput_jobs_s"],
+            "knee_shift_x": speedup,
+        },
+        "invariants": {
+            "all_done": b["done"] == n_jobs and p["done"] == n_jobs
+            and b["failed"] == p["failed"] == 0
+            and b["rejected"] == p["rejected"] == 0,
+            "base_never_batches": b["launches"] == 0,
+            "batched_coalesces": p["launches"] >= 1
+            and (p["jobs_per_launch"] or 0) > 1,
+            "batched_faster": (speedup or 0) > 1.0,
+            "p99_lower": b["p99_s"] is not None
+            and p["p99_s"] is not None
+            and p["p99_s"] < b["p99_s"],
+        },
+        "parity_ok": parity_ok,
+    }
+    return report
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale tier-1 mode (tiny flood)")
+    ap.add_argument("--size", type=int, default=None,
+                    help="scene edge px (default: 96 smoke / 128 full)")
+    ap.add_argument("--years", type=int, default=None,
+                    help="stack years (default: 12 smoke / 16 full)")
+    ap.add_argument("--tile", type=int, default=None,
+                    help="tile size (default: 32 smoke / 32 full)")
+    ap.add_argument("--jobs", type=int, default=None,
+                    help="flood size (default: 8 smoke / 12 full)")
+    ap.add_argument("--window-ms", type=float, default=None,
+                    help="batch window (default: 150 smoke / 300 full)")
+    ap.add_argument("--keep", default=None, metavar="DIR",
+                    help="keep the bench workdirs under DIR")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.config.jax_platforms or "cpu")
+
+    # warm per-job device work must dominate the fixed resume cost a
+    # member's queue turn still pays, or the flood measures dispatcher
+    # overhead instead of packing — hence scenes this size, not 64px
+    size = args.size or (96 if args.smoke else 128)
+    years = args.years or (12 if args.smoke else 16)
+    tile = args.tile or 32
+    n_jobs = args.jobs or (8 if args.smoke else 12)
+    window_ms = args.window_ms or (150.0 if args.smoke else 300.0)
+
+    root = args.keep or tempfile.mkdtemp(prefix="lt_batch_bench_")
+    Path(root).mkdir(parents=True, exist_ok=True)
+    try:
+        report = run_bench(size, years, tile, n_jobs, window_ms, root)
+    finally:
+        if args.keep is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+    report["smoke"] = bool(args.smoke)
+    ok = report["parity_ok"] and all(report["invariants"].values())
+    report["ok"] = ok
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    print(
+        json.dumps(
+            {
+                "ok": ok,
+                "base_jobs_s": report["base"]["throughput_jobs_s"],
+                "batched_jobs_s": report["batched"]["throughput_jobs_s"],
+                "speedup_batched": report["speedup_batched"],
+                "p99_s": [report["base"]["p99_s"], report["batched"]["p99_s"]],
+                "jobs_per_launch": report["batched"]["jobs_per_launch"],
+                "occupancy": report["batched"]["occupancy"],
+                "invariants": report["invariants"],
+                "parity_ok": report["parity_ok"],
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -146,6 +146,14 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "decisions", "matched", "speedup_x", "recorded_span_s",
         "replay_wall_s", "mismatch_seq",
     ),
+    # cross-job continuous batching (serve/batching): coalesced job/tile
+    # counts, padded pixels, occupancy and window waits only go up /
+    # never negative (the tiles >= jobs >= 1 and 0 < occupancy <= 1
+    # cross-checks live in batch_value_errors below)
+    "batch_launch": (
+        "jobs", "tiles", "padded_px", "occupancy", "window_wait_s",
+    ),
+    "batch_demux": ("tiles", "member_jobs"),
 }
 
 
@@ -678,6 +686,43 @@ class AlertValueLint:
         return errs
 
 
+def batch_value_errors(rec, lineno: int) -> list[str]:
+    """Value lint for ``batch_launch`` records: a shared launch
+    coalesces at least its leader (``jobs >= 1``), every member brings
+    at least one tile (``tiles >= jobs``), and occupancy is a fraction
+    of the padded batch (``0 < occupancy <= 1`` — zero useful pixels
+    means no launch to account for).  Non-negativity rides the generic
+    NONNEG_FIELDS loop."""
+    if not isinstance(rec, dict) or rec.get("ev") != "batch_launch":
+        return []
+    errs = []
+    jobs, tiles = rec.get("jobs"), rec.get("tiles")
+    if isinstance(jobs, int) and not isinstance(jobs, bool) and jobs < 1:
+        errs.append(
+            f"line {lineno}: batch_launch: jobs {jobs} < 1 (a launch "
+            "coalesces at least its leader)"
+        )
+    if (
+        isinstance(jobs, int) and isinstance(tiles, int)
+        and not isinstance(jobs, bool) and not isinstance(tiles, bool)
+        and tiles < jobs
+    ):
+        errs.append(
+            f"line {lineno}: batch_launch: tiles {tiles} < jobs {jobs} "
+            "(every coalesced job brings at least one tile)"
+        )
+    occ = rec.get("occupancy")
+    if (
+        isinstance(occ, (int, float)) and not isinstance(occ, bool)
+        and not (0 < occ <= 1)
+    ):
+        errs.append(
+            f"line {lineno}: batch_launch: occupancy {occ} outside "
+            "(0, 1] (useful px over padded px)"
+        )
+    return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
     (the robustness events, the ingest-store rollup, the flight-sampler
@@ -715,6 +760,7 @@ def value_lints():
             + tune_value_errors(rec, lineno)
             + request_value_errors(rec, lineno)
             + capacity_value_errors(rec, lineno)
+            + batch_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + trace_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)

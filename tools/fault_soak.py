@@ -684,6 +684,229 @@ def soak(
                 f"({schedule2})"
             )
 
+    def run_batch_track() -> None:
+        """Cross-job batching failure semantics (ISSUE 18): with the
+        server's armed plan firing at ``batch.pack`` (the first
+        candidate is EXCLUDED from the batch — it runs solo in its
+        normal queue turn) and at ``batch.demux`` (one member stops
+        receiving demuxed tiles at tile 0 and its own run recomputes
+        them), every job of a 3-job same-shape flood still completes
+        with artifacts byte-identical to the clean run, and the batch
+        events on the stream stay schema- and value-lint clean — a
+        batching fault degrades packing, never correctness."""
+        from land_trendr_tpu.obs.events import validate_events_file
+        from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+        sys.path.insert(0, str(REPO / "tools"))
+        from check_events_schema import value_lints
+
+        sdir = str(root / "serve_stack")  # the serve track wrote it
+        clean = _digest_workdir(str(root / "serve_clean"))
+        schedule = "seed=3,batch.pack@0=io,batch.demux@0=io"
+        srv_wd = str(root / "serve_batch")
+        server = SegmentationServer(
+            ServeConfig(
+                workdir=srv_wd,
+                max_jobs=3,
+                feed_cache_mb=64,
+                batch=True,
+                batch_window_ms=150.0,
+                fault_schedule=schedule,
+            )
+        )
+        job = {
+            "stack_dir": sdir,
+            "tile_size": base_kw["tile_size"],
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "max_retries": retries,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+        subs = [server.submit(dict(job)) for _ in range(3)]
+        server.serve_forever()  # drains all three jobs, then shuts down
+        for snap in subs:
+            s = server.job_status(snap["job_id"])
+            if s["state"] != "done":
+                raise AssertionError(
+                    f"batch track: job {snap['job_id']} ended "
+                    f"{s['state']} ({s.get('error')})"
+                )
+            if _digest_workdir(s["workdir"]) != clean:
+                raise AssertionError(
+                    f"batch track: job {snap['job_id']} artifacts differ "
+                    "from the clean run"
+                )
+        evs = [
+            json.loads(line) for line in
+            (Path(srv_wd) / "events.jsonl").read_text().splitlines()
+            if line.strip()
+        ]
+        launches = [e for e in evs if e["ev"] == "batch_launch"]
+        demuxes = [e for e in evs if e["ev"] == "batch_demux"]
+        if not launches:
+            raise AssertionError(
+                "batch track: no batch_launch — the window never "
+                "coalesced the queued siblings"
+            )
+        # pack@0 fires on the FIRST candidate of the first collect: the
+        # first launch coalesces leader + ONE member, not both
+        if launches[0]["jobs"] != 2:
+            raise AssertionError(
+                f"batch.pack@0 should have excluded one candidate from "
+                f"the first launch, got jobs={launches[0]['jobs']}"
+            )
+        # demux@0 fires on the first demuxed tile: that member's demux
+        # stops at 0 tiles (its own run recomputes); a later batch must
+        # still demux normally somewhere on the stream
+        if not any(d["tiles"] == 0 for d in demuxes):
+            raise AssertionError(
+                "batch.demux@0 never stopped a member's demux at tile 0: "
+                f"{demuxes}"
+            )
+        if not any(d["tiles"] > 0 for d in demuxes):
+            raise AssertionError(
+                "no member ever received demuxed tiles — batching is "
+                f"not actually demuxing: {demuxes}"
+            )
+        lint = validate_events_file(
+            str(Path(srv_wd) / "events.jsonl"), extra=value_lints()
+        )
+        if lint:
+            raise AssertionError(
+                f"batch track: server stream lint-dirty: {lint[:3]}"
+            )
+        report["cases"].append({
+            "track": "serve",
+            "case": "batch_pack_and_demux_faults",
+            "schedule": schedule,
+            "launches": len(launches),
+            "first_launch_jobs": launches[0]["jobs"],
+            "demux_tiles": [d["tiles"] for d in demuxes],
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: serve/batch_pack_and_demux_faults ({schedule}; "
+                f"{len(launches)} launch(es), demux tiles "
+                f"{[d['tiles'] for d in demuxes]})"
+            )
+
+    def run_batch_kill_case() -> None:
+        """Full mode: a batching server SIGKILLed MID-BATCH — leader
+        still computing, members already holding demuxed tiles.  Each
+        job's pinned workdir then resumes independently (the stock
+        per-job resume — no batch machinery in the recovery path),
+        skipping exactly its durable tiles, and finishes byte-identical
+        to the clean run.  Full mode only: a cold jax subprocess costs
+        tens of seconds the smoke budget does not have (the smoke's
+        batch track drives the same isolation seams deterministically).
+        """
+        import os as _os
+        import signal as _signal
+        import subprocess as _subprocess
+
+        from land_trendr_tpu.ops.indices import required_bands
+        from land_trendr_tpu.runtime import load_stack_dir
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        n_tiles = len(clean)
+        wds = [str(root / f"batch_kill_job{i}") for i in range(3)]
+        payloads = [
+            {
+                "stack_dir": sdir,
+                "tile_size": base_kw["tile_size"],
+                "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+                "max_retries": retries,
+                "workdir": wd,
+                "out_dir": wd + "_o",
+                "run_overrides": {"retry_backoff_s": 0.0},
+            }
+            for wd in wds
+        ]
+        cfg_path = root / "batch_kill_jobs.json"
+        cfg_path.write_text(json.dumps(payloads))
+        script = root / "batch_kill_server.py"
+        # every dispatch paced slow so the kill lands with the leader
+        # mid-scene and members partially demuxed
+        script.write_text(
+            "import json, sys\n"
+            f"sys.path.insert(0, {str(REPO)!r})\n"
+            "from land_trendr_tpu.serve import SegmentationServer, "
+            "ServeConfig\n"
+            "server = SegmentationServer(ServeConfig(\n"
+            f"    workdir={str(root / 'batch_kill_srv')!r}, max_jobs=3,\n"
+            "    feed_cache_mb=64, batch=True, batch_window_ms=300.0,\n"
+            "    fault_schedule='seed=5,dispatch%1.0=slow:0.3',\n"
+            "))\n"
+            "for p in json.load(open(sys.argv[1])):\n"
+            "    server.submit(p)\n"
+            "server.serve_forever()\n"
+        )
+        proc = _subprocess.Popen(
+            [sys.executable, str(script), str(cfg_path)],
+            stdout=_subprocess.PIPE, stderr=_subprocess.PIPE, text=True,
+        )
+        deadline = time.monotonic() + 300
+        killed = False
+        try:
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    _, err = proc.communicate()
+                    raise AssertionError(
+                        "batch-kill server exited before the kill:\n"
+                        + err[-4000:]
+                    )
+                lead = len(list(Path(wds[0]).glob("tile_*.npz")))
+                mem = max(
+                    len(list(Path(w).glob("tile_*.npz"))) for w in wds[1:]
+                )
+                if mem >= 1 and lead < n_tiles:
+                    _os.kill(proc.pid, _signal.SIGKILL)
+                    killed = True
+                    break
+                time.sleep(0.05)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.communicate()
+        if not killed:
+            raise AssertionError(
+                "batch-kill: the mid-batch window never opened — no "
+                "member held a demuxed tile while the leader was short"
+            )
+        pre = [len(list(Path(w).glob("tile_*.npz"))) for w in wds]
+        stack = load_stack_dir(sdir, bands=required_bands("nbr", ()))
+        for wd, durable in zip(wds, pre):
+            summary = _run(
+                stack,
+                RunConfig(workdir=wd, out_dir=wd + "_o", **base_kw),
+            )
+            # a manifest-readable pre-kill tile must resume, not
+            # recompute — the demuxed artifacts ARE the durable state
+            if summary["tiles_skipped_resume"] < max(durable - 1, 0):
+                raise AssertionError(
+                    f"batch-kill: {wd} resumed only "
+                    f"{summary['tiles_skipped_resume']} of {durable} "
+                    "durable tile(s) — demuxed artifacts did not resume"
+                )
+            if _digest_workdir(wd) != clean:
+                raise AssertionError(
+                    f"batch-kill: {wd} artifacts differ from the clean "
+                    "run after resume"
+                )
+        report["cases"].append({
+            "track": "serve",
+            "case": "batch_sigkill_mid_batch_resume",
+            "schedule": "SIGKILL server mid-batch",
+            "tiles_durable_before_kill": pre,
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: serve/batch_sigkill_mid_batch_resume "
+                f"({pre} tile(s) durable pre-kill)"
+            )
+
     def run_router_track() -> None:
         """Fleet-router failure semantics (ISSUE 13), in-process: one
         real replica (a SegmentationServer on a thread) behind a
@@ -1340,8 +1563,10 @@ def soak(
     if not smoke:
         run_lease_kill_case()
     run_serve_track()
+    run_batch_track()
     run_router_track()
     if not smoke:
+        run_batch_kill_case()
         run_router_kill_case()
         run_loadgen_churn_case()
     lazy = _make_lazy(str(root / "c2"), 96)

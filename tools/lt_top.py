@@ -243,12 +243,25 @@ def render(snap: dict) -> str:
             f"burn {burn:.2f}   rejections {rej:.0f}   "
             f"warm-hit {_metric(rows, 'lt_serve_warm_hit_ratio'):.2f}"
         )
+    launches = _metric(rows, "lt_batch_launches_total")
+    if launches:
+        # cross-job batching (serve/batching): how much per-launch
+        # overhead the dispatcher is amortising right now
+        lines.append(
+            f"batch: launches {launches:.0f}  "
+            f"jobs coalesced "
+            f"{_metric(rows, 'lt_batch_jobs_coalesced_total'):.0f}  "
+            f"demuxed tiles "
+            f"{_metric(rows, 'lt_batch_demux_tiles_total'):.0f}  "
+            f"occupancy {_metric(rows, 'lt_batch_occupancy'):.2f}"
+        )
     lines.append("")
     lines.append(
         f"{'JOB':<22} {'TRACE':<10} {'STATE':<18} {'TENANT':<10} "
         f"{'PRI':>3} "
         f"{'PHASE':<9} {'TILES':>9} {'RETRY':>5} {'STRAG':>5} "
-        f"{'STEAL':>5} {'SPEC':>4} {'BKLG f/w/x/u':>12} {'AGE':>6}"
+        f"{'STEAL':>5} {'SPEC':>4} {'BKLG f/w/x/u':>12} {'BATCH':>7} "
+        f"{'AGE':>6}"
     )
     for job in snap["jobs"]:
         p = job.get("progress") or {}
@@ -269,6 +282,14 @@ def render(snap: dict) -> str:
         state = job.get("state", "?")
         if job.get("deadline_exceeded"):
             state += "!SLO"
+        # the running leader's live batch state: jobs sharing its
+        # launch and the padded-pixel occupancy ("3@0.87"); solo and
+        # queued jobs show "-"
+        bj = p.get("batch_jobs", 0) if p else 0
+        batch = (
+            f"{bj}@{p.get('batch_occupancy', 0.0):.2f}"
+            if isinstance(bj, int) and bj > 1 else "-"
+        )
         age = now - job.get("submitted_t", now)
         lines.append(
             f"{job.get('job_id', '?'):<22} "
@@ -280,6 +301,7 @@ def render(snap: dict) -> str:
             f"{p.get('tiles_stolen', '-') if p else '-':>5} "
             f"{p.get('tiles_speculated', '-') if p else '-':>4} "
             f"{backlog:>12} "
+            f"{batch:>7} "
             f"{_fmt_age(age):>6}"
         )
     if not snap["jobs"]:

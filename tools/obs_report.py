@@ -14,7 +14,10 @@ per-process files) and it emits
   per-tenant SLO rollup (p50/p95/p99 queue-wait and exec latency,
   deadline hit-rate — from ``job_slo`` events), the request-tracing
   rollup (end-to-end latency distribution, re-route counts, p50/p95/p99
-  per blame component — from ``request_done``), the resource high-water
+  per blame component — from ``request_done``), the cross-job batching
+  rollup (launch/job/tile totals, jobs-per-launch, occupancy and
+  window-wait distributions — from ``batch_launch``/``batch_demux``),
+  the resource high-water
   section (RSS / fd / thread / backlog watermarks from the flight
   sampler's ``flight_sample`` series), and per-host rollups — schema
   lint and fold run in a SINGLE pass per file
@@ -110,7 +113,7 @@ def _fresh_scope() -> dict:
         "fetch": None, "upload": None, "ingest_store": None,
         "serve": None, "program_cache": None,
         "slo": None, "resources": None, "router": None, "tune": None,
-        "request": None,
+        "request": None, "batching": None,
     }
 
 
@@ -381,6 +384,45 @@ def _merge_tune(folded: list[dict]) -> "dict | None":
         "best_speedup": max(speedups) if speedups else None,
         "profiles_by_source": by_source,
         "profile_keys": sorted(keys),
+    }
+
+
+def _batching_scope(cur: dict) -> dict:
+    """The lazily-created cross-job-batching sub-aggregate of one scope
+    (fed by ``batch_launch`` / ``batch_demux`` — the serve dispatcher's
+    coalescing stream)."""
+    if cur["batching"] is None:
+        cur["batching"] = {
+            "launches": 0, "jobs": 0, "tiles": 0, "padded_px": 0,
+            "occupancy": [], "window_wait_s": [], "demuxed_tiles": 0,
+            "demuxed_members": 0,
+        }
+    return cur["batching"]
+
+
+def _merge_batching(folded: list[dict]) -> "dict | None":
+    """Cross-file merge of the cross-job-batching rollups (None when no
+    file's last scope carried a batch event): launch/job/tile totals,
+    the occupancy and window-wait distributions, and the derived
+    ``jobs_per_launch`` — how much per-launch overhead the coalescing
+    actually amortised."""
+    seen = [c["batching"] for c in folded if c["batching"] is not None]
+    if not seen:
+        return None
+    launches = sum(s["launches"] for s in seen)
+    jobs = sum(s["jobs"] for s in seen)
+    return {
+        "launches": launches,
+        "jobs": jobs,
+        "jobs_per_launch": round(jobs / launches, 2) if launches else None,
+        "tiles": sum(s["tiles"] for s in seen),
+        "padded_px": sum(s["padded_px"] for s in seen),
+        "occupancy": _stats([v for s in seen for v in s["occupancy"]]),
+        "window_wait_s": _stats(
+            [v for s in seen for v in s["window_wait_s"]]
+        ),
+        "demuxed_tiles": sum(s["demuxed_tiles"] for s in seen),
+        "demuxed_members": sum(s["demuxed_members"] for s in seen),
     }
 
 
@@ -1026,6 +1068,50 @@ def fold(
                                 "blame": bl,
                             },
                         })
+                    elif ev == "batch_launch":
+                        # one coalesced launch (serve/batching): every
+                        # field read FIRST (the job_slo discipline)
+                        bl_jobs, bl_tiles = rec["jobs"], rec["tiles"]
+                        bt = _batching_scope(cur)
+                        bt["launches"] += 1
+                        bt["jobs"] += bl_jobs
+                        bt["tiles"] += bl_tiles
+                        bt["padded_px"] += rec.get("padded_px", 0)
+                        for k, dst in (
+                            ("occupancy", "occupancy"),
+                            ("window_wait_s", "window_wait_s"),
+                        ):
+                            v = rec.get(k)
+                            if isinstance(v, (int, float)) and not \
+                                    isinstance(v, bool):
+                                bt[dst].append(v)
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": (
+                                f"BATCH {rec.get('job_id', '?')} "
+                                f"x{bl_jobs}"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "jobs": bl_jobs, "tiles": bl_tiles,
+                                "occupancy": rec.get("occupancy"),
+                                "window_wait_s": rec.get("window_wait_s"),
+                            },
+                        })
+                    elif ev == "batch_demux":
+                        bd_tiles = rec["tiles"]
+                        bt = _batching_scope(cur)
+                        bt["demuxed_tiles"] += bd_tiles
+                        bt["demuxed_members"] += 1
+                        spans.append({
+                            "kind": "instant", "file": fileno, "tid": "jobs",
+                            "name": (
+                                f"demux {rec.get('job_id', '?')} "
+                                f"({bd_tiles} tiles)"
+                            ),
+                            "t0": tw,
+                            "args": {"tiles": bd_tiles},
+                        })
                     elif ev == "tune_probe":
                         t = _tune_scope(cur)
                         ok, probes = rec["ok"], rec["probes"]
@@ -1157,6 +1243,7 @@ def fold(
         "serve": _merge_serve(folded),
         "router": _merge_router(folded),
         "request": _merge_request(folded),
+        "batching": _merge_batching(folded),
         "program_cache": _merge_program_cache(folded),
         "tune": _merge_tune(folded),
         "slo": _merge_slo(folded),

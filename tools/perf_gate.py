@@ -43,6 +43,7 @@ UPLOAD_BASELINE = REPO / "UPLOAD_r10.json"
 SERVE_BASELINE = REPO / "SERVE_r11.json"
 FLIGHT_BASELINE = REPO / "FLIGHT_r12.json"
 CAPACITY_BASELINE = REPO / "CAPACITY_r17.json"
+BATCH_BASELINE = REPO / "BATCH_r18.json"
 
 #: a smoke ratio must reach this fraction of its committed value — loose
 #: enough for a 2-core container's noise, tight enough that a regression
@@ -51,6 +52,10 @@ RATIO_BAND = 1 / 3
 #: speedup floor even when the band would dip below it (a "speedup" of
 #: 1.0 means the optimization is off, whatever the baseline said)
 SPEEDUP_FLOOR = 1.15
+#: batched-launch padded-pixel occupancy floor: the bench's identical
+#: small-AOI flood tiles evenly, so real packing sits at ~1.0 — well
+#: under-filled launches mean the batch shape regressed
+BATCH_OCCUPANCY_FLOOR = 0.9
 
 
 def _hit_rate(stats: dict) -> float | None:
@@ -1006,6 +1011,7 @@ def run_gate(
     ``router=False`` likewise skips the fleet-router leg (seven jax
     replica processes; tier-1 covers the same invariants in-process via
     ``tests/test_fleet_serve.py``)."""
+    import batch_bench
     import feed_bench
     import fetch_bench
     import flight_overhead
@@ -1136,6 +1142,46 @@ def run_gate(
             f"{band:.2f} (committed {base['speedup_warm']})",
         )
 
+    # -- cross-job continuous batching (shared launches) ------------------
+    base = json.loads(BATCH_BASELINE.read_text())
+    out = str(Path(workdir) / "batch_smoke.json")
+    if batch_bench.main(["--smoke", "--out", out]) != 0:
+        check("batch.ran", False, "batch_bench --smoke exited nonzero")
+    else:
+        got = json.loads(Path(out).read_text())
+        check(
+            "batch.parity", got["parity_ok"] is True,
+            "every job's artifacts ≡ the one-run-per-job reference, "
+            "batched or not",
+        )
+        # structural, exact: the flood coalesces (>1 job per launch),
+        # the batch=False leg never emits a launch, and nothing is
+        # rejected/failed — packing must never cost admission or jobs
+        inv = got["invariants"]
+        check(
+            "batch.coalesced",
+            inv["batched_coalesces"] is True
+            and inv["base_never_batches"] is True
+            and inv["all_done"] is True,
+            f"{got['batched']['launches']} launch(es), "
+            f"{got['batched']['jobs_per_launch']} jobs/launch over "
+            f"{got['workload']['jobs']} jobs",
+        )
+        check(
+            "batch.occupancy",
+            (got["batched"]["occupancy"] or 0) >= BATCH_OCCUPANCY_FLOOR,
+            f"padded-px occupancy {got['batched']['occupancy']} vs "
+            f"floor {BATCH_OCCUPANCY_FLOOR}",
+        )
+        band = max(SPEEDUP_FLOOR, base["speedup_batched"] * RATIO_BAND)
+        check(
+            "batch.speedup",
+            got["speedup_batched"] is not None
+            and got["speedup_batched"] >= band,
+            f"smoke batched speedup {got['speedup_batched']} vs band "
+            f"{band:.2f} (committed {base['speedup_batched']})",
+        )
+
     run_trace_leg(workdir, check)
     run_reqtrace_leg(workdir, check)
     run_fleet_leg(workdir, check)
@@ -1198,7 +1244,8 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     for p in (FEED_BASELINE, FETCH_BASELINE, UPLOAD_BASELINE,
-              SERVE_BASELINE, FLIGHT_BASELINE, CAPACITY_BASELINE):
+              SERVE_BASELINE, FLIGHT_BASELINE, CAPACITY_BASELINE,
+              BATCH_BASELINE):
         if not p.exists():
             print(f"error: committed baseline {p.name} missing", file=sys.stderr)
             return 2
