@@ -1331,24 +1331,26 @@ def main(argv: list[str] | None = None) -> int:
             "trace_ids": report.trace_ids,
         }
         if args.out:
-            with open(args.out, "w") as f:
-                json.dump(
+            payload = {
+                **summary,
+                "outcomes": [
                     {
-                        **summary,
-                        "outcomes": [
-                            {
-                                "trace_id": o.trace_id,
-                                "tenant": o.tenant,
-                                "shape": o.shape,
-                                "outcome": o.outcome,
-                                "reason": o.reason,
-                                "latency_s": o.latency_s,
-                            }
-                            for o in report.outcomes
-                        ],
-                    },
-                    f, indent=2,
-                )
+                        "trace_id": o.trace_id,
+                        "tenant": o.tenant,
+                        "shape": o.shape,
+                        "outcome": o.outcome,
+                        "reason": o.reason,
+                        "latency_s": o.latency_s,
+                    }
+                    for o in report.outcomes
+                ],
+            }
+            # tmp + os.replace: a SIGKILL mid-dump must not tear the
+            # report an operator's tooling then reads
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2)
+            os.replace(tmp, args.out)
         print(json.dumps(summary))
         return 0 if report.failed == 0 else 1
 

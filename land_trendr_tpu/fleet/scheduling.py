@@ -27,6 +27,7 @@ import threading
 
 __all__ = [
     "DECISIONS_NAME",
+    "PURE_MACHINES",
     "DecisionLog",
     "DrrQueue",
     "choose_replica",
@@ -35,6 +36,29 @@ __all__ = [
 
 #: the decision-log file name under the router workdir
 DECISIONS_NAME = "decisions.jsonl"
+
+#: The pure decision machines of the fleet replay contract, as
+#: ``(file, symbol)`` data — lt-lint LT009's single source (the
+#: ``NONNEG_FIELDS`` shared-table pattern): everything listed here must
+#: stay a pure function of its arguments (``now`` and seeds included),
+#: transitively — no clock reads, no randomness, no environment, no
+#: file IO, no global mutation — or the byte-identity replay proof
+#: (``CAPACITY_r17.json``) silently stops meaning anything.  A class
+#: name covers every method; ``obs/alerts.py`` exports the
+#: observability-side half of the registry in the same shape.
+#: ``tests/test_lint.py`` pins this table against the symbols
+#: ``fleet/capacity.py::replay_decisions`` actually dispatches to.
+#: NOTE: :class:`DecisionLog` is deliberately absent — it is the
+#: *recording* half (O_APPEND file IO by design), never replayed — and
+#: so is ``replay_decisions`` itself: it is the replay *shell* (reads
+#: the log file, stamps the replay's own wall time, emits telemetry);
+#: the machines it re-derives decisions THROUGH are what must stay pure.
+PURE_MACHINES = (
+    ("land_trendr_tpu/fleet/scheduling.py", "DrrQueue"),
+    ("land_trendr_tpu/fleet/scheduling.py", "choose_replica"),
+    ("land_trendr_tpu/fleet/autoscale.py", "Autoscaler.decide"),
+    ("land_trendr_tpu/fleet/capacity.py", "find_knee"),
+)
 
 
 class DrrQueue:

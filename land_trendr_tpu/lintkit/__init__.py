@@ -1,8 +1,9 @@
 """lt-lint: AST-based invariant checks for the concurrent subsystems.
 
-Eight repo-specific rules over a parent-linked-AST framework
-(:mod:`.core`) and an interprocedural call-graph engine
-(:mod:`.callgraph`); the CLI is ``tools/lt_lint.py`` (``--json``,
+Twelve repo-specific rules over a parent-linked-AST framework
+(:mod:`.core`), an interprocedural call-graph engine
+(:mod:`.callgraph`) and an intra-procedural taint/value-flow engine
+(:mod:`.dataflow`); the CLI is ``tools/lt_lint.py`` (``--json``,
 ``--sarif``, ``--changed``, ``--prune-baseline``, exit 1 on any finding
 not suppressed by an inline ``# lt: noqa[rule]`` or a reasoned
 ``LINT_BASELINE.json`` entry):
@@ -16,16 +17,24 @@ LT005     Telemetry emit-site fields vs the event schema
 LT006     lock-order cycles in the acquired-while-held graph
 LT007     blocking operation reachable while a lock is held
 LT008     resource not discharged (close/stop/shutdown) on every path
+LT009     registered pure decision machine reaches an impure primitive
+LT010     wall/monotonic clock domains mixed (taint through dataflow)
+LT011     fault-seam registry / fire-site / soak-coverage drift
+LT012     non-atomic write into a durable artifact tree
 ========  ==========================================================
 
 LT001–LT005 are statement-local; LT006–LT008 share one project call
 graph per run (resolved within the package, method dispatch approximated
-by receiver-type inference + attribute-name/class-index matching).  See
-README.md §Static analysis for the rule table with rationale and
-example findings.
+by receiver-type inference + attribute-name/class-index matching);
+LT009–LT012 are the distributed-determinism generation, driven by the
+:mod:`.dataflow` value-flow engine composed with the same call graph
+and the data registries the checked modules export (``PURE_MACHINES``,
+``SEAMS``, ``SOAK_COVERED_SEAMS``).  See README.md §Static analysis for
+the rule table with rationale and example findings.
 """
 
 from land_trendr_tpu.lintkit.blocking import BlockingUnderLockChecker
+from land_trendr_tpu.lintkit.clockdomain import ClockDomainChecker
 from land_trendr_tpu.lintkit.configdoc import ConfigDocChecker
 from land_trendr_tpu.lintkit.core import (
     Baseline,
@@ -36,12 +45,15 @@ from land_trendr_tpu.lintkit.core import (
     RepoCtx,
     run_rules,
 )
+from land_trendr_tpu.lintkit.durablewrite import DurableWriteChecker
 from land_trendr_tpu.lintkit.eventschema import EventSchemaChecker
 from land_trendr_tpu.lintkit.hostsync import HostSyncChecker
 from land_trendr_tpu.lintkit.jitpurity import JitPurityChecker
 from land_trendr_tpu.lintkit.lifecycle import ResourceLifecycleChecker
 from land_trendr_tpu.lintkit.lockorder import LockOrderChecker
 from land_trendr_tpu.lintkit.locks import LockDisciplineChecker
+from land_trendr_tpu.lintkit.replaypurity import ReplayPurityChecker
+from land_trendr_tpu.lintkit.seamcover import SeamCoverageChecker
 
 __all__ = [
     "ALL_CHECKERS",
@@ -49,7 +61,9 @@ __all__ = [
     "BaselineError",
     "BlockingUnderLockChecker",
     "Checker",
+    "ClockDomainChecker",
     "ConfigDocChecker",
+    "DurableWriteChecker",
     "EventSchemaChecker",
     "FileCtx",
     "Finding",
@@ -58,7 +72,9 @@ __all__ = [
     "LockDisciplineChecker",
     "LockOrderChecker",
     "RepoCtx",
+    "ReplayPurityChecker",
     "ResourceLifecycleChecker",
+    "SeamCoverageChecker",
     "default_checkers",
     "run_rules",
 ]
@@ -73,6 +89,10 @@ ALL_CHECKERS = (
     LockOrderChecker,
     BlockingUnderLockChecker,
     ResourceLifecycleChecker,
+    ReplayPurityChecker,
+    ClockDomainChecker,
+    SeamCoverageChecker,
+    DurableWriteChecker,
 )
 
 

@@ -1,0 +1,267 @@
+"""LT010 — wall-clock and monotonic-clock values must not mix.
+
+The repo's clock convention (README §Clock domains, the PR-10/PR-16
+principle): **monotonic** for spans and intervals measured within one
+process, **wall** for anything that crosses hosts or lands in a durable
+record, and the only sanctioned way between them is an ``(anchor_wall,
+anchor_mono)`` pair — ``wall = anchor_wall + (t_mono - anchor_mono)``.
+PR 16 fixed, by hand, a decision record that stored a monotonic ``now``
+where the replay expected wall time; this rule is that bug class made
+un-reintroducible.
+
+Mechanics (:mod:`.dataflow`): ``time.time()`` seeds the ``wall`` label,
+``time.monotonic()`` / ``perf_counter()`` seed ``mono``, and identifier
+convention (``*_wall*`` / ``*mono*`` names) seeds both across function
+boundaries the graph cannot resolve.  Labels flow through assignments,
+arithmetic, tuple/dict stores and returns (resolved calls contribute
+their callees' return labels via :class:`.dataflow.ReturnLabels`).  The
+subtraction algebra is what makes the anchor idiom *naturally* clean:
+``mono - mono`` and ``wall - wall`` are durations and drop both labels,
+so ``anchor_wall + (t_mono - anchor_mono)`` never trips the rule —
+only a genuine cross-domain ``-``/``+``/comparison does.
+
+Findings:
+
+* arithmetic or comparison between a pure-wall and a pure-mono value;
+* the same record field (constant dict key / subscript / keyword /
+  attribute) stored with pure-wall at one site and pure-mono at
+  another, within a file — the "taint crosses a dict store" case;
+* a field whose *name* declares a domain (``*_wall*`` / ``*mono*``)
+  stored with a value from the other domain.
+
+Values that carry BOTH labels (an anchor pair travelling as a tuple)
+are ambiguous, not mixed — they never flag, so precision is lost toward
+silence, never toward noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.callgraph import get_graph
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+from land_trendr_tpu.lintkit.dataflow import (
+    EMPTY,
+    FunctionFlow,
+    ReturnLabels,
+    dotted_call,
+)
+
+__all__ = ["ClockDomainChecker"]
+
+WALL = "wall"
+MONO = "mono"
+
+_WALL_CALLS = {"time.time", "time.time_ns"}
+_MONO_CALLS = {
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+}
+
+_WALL_NAME = re.compile(r"(^|_)wall(_|$|s$)")
+_MONO_NAME = re.compile(r"(^|_)mono(tonic)?(_|$|s$)|(^|_)perf(_|$)")
+
+#: predicate/flag identifiers are ABOUT a clock, not OF one: ``has_wall``
+#: / ``is_mono`` / ``use_wall`` hold booleans and must not seed a domain
+_PREDICATE_NAME = re.compile(r"^(has|is|use|want|need|with)_")
+
+
+def _name_domain(ident: str) -> frozenset:
+    low = ident.lower()
+    if _PREDICATE_NAME.match(low):
+        return EMPTY
+    if _MONO_NAME.search(low):
+        return frozenset((MONO,))
+    if _WALL_NAME.search(low):
+        return frozenset((WALL,))
+    return EMPTY
+
+
+def _seeds(node: ast.AST) -> frozenset:
+    if isinstance(node, ast.Call):
+        name = dotted_call(node)
+        if name in _WALL_CALLS:
+            return frozenset((WALL,))
+        if name in _MONO_CALLS:
+            return frozenset((MONO,))
+        return EMPTY
+    if isinstance(node, ast.Name):
+        return _name_domain(node.id)
+    if isinstance(node, ast.Attribute):
+        return _name_domain(node.attr)
+    return EMPTY
+
+
+def _pure(labels: frozenset) -> "str | None":
+    """The one domain ``labels`` carries, or None (empty or ambiguous)."""
+    if labels & {WALL, MONO} == {WALL}:
+        return WALL
+    if labels & {WALL, MONO} == {MONO}:
+        return MONO
+    return None
+
+
+def _combine(node: ast.AST, left: frozenset, right: frozenset) -> frozenset:
+    """BinOp label algebra: same-domain subtraction yields a duration
+    (labels drop), everything else unions (a cross-domain op stays
+    poisoned so the *site* flags, see :meth:`ClockDomainChecker`)."""
+    if isinstance(node, (ast.BinOp, ast.AugAssign)) and isinstance(
+        node.op, ast.Sub
+    ):
+        lp, rp = _pure(left), _pure(right)
+        if lp is not None and lp == rp:
+            return (left | right) - {WALL, MONO}
+    return left | right
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        return "<expr>"
+    return text if len(text) <= 60 else text[:57] + "..."
+
+
+def _own_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``func``'s body without descending into nested defs (those
+    are graph functions of their own)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ClockDomainChecker(Checker):
+    rule_id = "LT010"
+    title = "wall/monotonic clock domains mixed"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        return {f for f in repo.py_files if not f.startswith("tests/")}
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        graph = get_graph(repo)
+        returns = ReturnLabels(graph, _seeds, _combine)
+        # field -> domain -> first (file, line, src) witness, per file
+        file_fields: dict[str, dict] = {}
+        for info in graph.functions():
+            if info.file.startswith("tests/"):
+                continue
+            flow = FunctionFlow(
+                info.node, _seeds, combine=_combine,
+                calls=lambda c, _i=info: returns.call_labels(_i, c),
+            )
+            symbol = f"{info.cls}.{info.name}" if info.cls else info.name
+            yield from self._check_arith(info, flow, symbol)
+            fields = file_fields.setdefault(info.file, {})
+            yield from self._check_stores(info, flow, symbol, fields)
+        yield from self._cross_function(file_fields)
+
+    # -- arithmetic / comparison sites -------------------------------------
+    def _check_arith(self, info, flow, symbol) -> Iterator[Finding]:
+        for n in _own_nodes(info.node):
+            if isinstance(n, ast.BinOp) and isinstance(
+                n.op, (ast.Add, ast.Sub)
+            ):
+                pairs = [(n.left, n.right)]
+            elif isinstance(n, ast.Compare):
+                operands = [n.left, *n.comparators]
+                pairs = list(zip(operands, operands[1:]))
+            else:
+                continue
+            for left, right in pairs:
+                lp = _pure(flow.labels(left))
+                rp = _pure(flow.labels(right))
+                if lp is None or rp is None or lp == rp:
+                    continue
+                op = (
+                    "compared with"
+                    if isinstance(n, ast.Compare)
+                    else "combined with"
+                )
+                yield Finding(
+                    file=info.file,
+                    line=n.lineno,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"{lp}-clock value '{_src(left)}' {op} "
+                        f"{rp}-clock value '{_src(right)}' — convert "
+                        "through an (anchor_wall, anchor_mono) pair "
+                        "instead"
+                    ),
+                    symbol=symbol,
+                )
+
+    # -- record-field stores ----------------------------------------------
+    def _check_stores(self, info, flow, symbol, fields) -> Iterator[Finding]:
+        local: dict[str, dict] = {}
+        for store, labels in flow.field_stores():
+            dom = _pure(labels)
+            if dom is None:
+                continue
+            witness = (info.file, store.node.lineno, _src(store.node),
+                       symbol)
+            declared = _pure(_name_domain(store.field))
+            if declared is not None and declared != dom:
+                yield Finding(
+                    file=info.file,
+                    line=store.node.lineno,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"field '{store.field}' declares the {declared} "
+                        f"domain but is stored a {dom}-clock value "
+                        f"'{_src(store.node)}'"
+                    ),
+                    symbol=symbol,
+                )
+                continue
+            key = store.field
+            local.setdefault(key, {}).setdefault(dom, witness)
+            fields.setdefault(key, {}).setdefault(dom, witness)
+        for field, doms in local.items():
+            if WALL in doms and MONO in doms:
+                wfile, wline, wsrc, _ = doms[WALL]
+                _, mline, msrc, _ = doms[MONO]
+                yield Finding(
+                    file=wfile,
+                    line=max(wline, mline),
+                    rule_id=self.rule_id,
+                    message=(
+                        f"record field '{field}' stores wall-clock "
+                        f"'{wsrc}' (line {wline}) and monotonic "
+                        f"'{msrc}' (line {mline}) — one field, one "
+                        "clock domain"
+                    ),
+                    symbol=symbol,
+                )
+                # reported locally; do not re-report at file level
+                doms.pop(MONO, None)
+                if field in fields:
+                    fields[field].pop(MONO, None)
+
+    def _cross_function(self, file_fields) -> Iterator[Finding]:
+        for file, fields in sorted(file_fields.items()):
+            for field, doms in sorted(fields.items()):
+                if WALL not in doms or MONO not in doms:
+                    continue
+                wfile, wline, wsrc, wsym = doms[WALL]
+                _, mline, msrc, msym = doms[MONO]
+                if (wsym, wline) == (msym, mline):
+                    continue
+                yield Finding(
+                    file=file,
+                    line=max(wline, mline),
+                    rule_id=self.rule_id,
+                    message=(
+                        f"record field '{field}' stores wall-clock "
+                        f"'{wsrc}' in {wsym} (line {wline}) but "
+                        f"monotonic '{msrc}' in {msym} (line {mline}) "
+                        "— readers cannot tell which clock they got"
+                    ),
+                    symbol=msym if mline >= wline else wsym,
+                )

@@ -1,0 +1,423 @@
+"""Intra-procedural taint/value-flow analysis over the parent-linked AST.
+
+The third lint generation (LT009–LT012) checks *where values come from*,
+not just what a statement looks like: a monotonic-clock read subtracted
+from a wall-clock read three assignments later, a final artifact path
+handed to a bare ``open(.., "w")``, a ``time.time()`` call two resolved
+calls below a registered pure decision machine.  Statement-local walks
+cannot see any of those; this module is the shared engine that can.
+
+The model is deliberately small — a flow-insensitive fixpoint over one
+function body:
+
+* every variable (``x``), attribute cell (``self.x``) and constant-key
+  subscript cell (``rec["t"]``) holds a **set of labels**;
+* labels enter at leaves through a caller-supplied ``seeds`` hook (a
+  ``time.monotonic()`` call seeds ``{"mono"}``, a string constant seeds
+  its own text for path-fragment flow);
+* labels propagate through assignments, tuple unpacking, augmented
+  assignment, ``for``/``with`` bindings, arithmetic, f-strings,
+  conditional expressions, container literals and constant-key subscript
+  stores/loads, with a caller-supplied ``combine`` hook deciding what a
+  ``BinOp`` does to its operand labels (the clock rule's algebra lives
+  there: ``mono - mono`` is a duration and drops both labels);
+* a ``calls`` hook lets a rule graft **interprocedural reach** on top:
+  :class:`ReturnLabels` composes this engine with the PR-8
+  :mod:`.callgraph` summaries, so a helper that returns
+  ``time.monotonic()`` taints its (resolved) call sites one summary at a
+  time, memoized across the whole run.
+
+Iteration is bounded (label sets only grow, and the lattice is finite
+per function), so the fixpoint terminates without widening.  Everything
+is stdlib-only and jax-free, like the rest of lintkit.
+
+:func:`module_literal` is the companion registry reader: LT009/LT011
+consume data tables exported by heavy modules (``fleet/scheduling.py``'s
+``PURE_MACHINES``, ``tools/fault_soak.py``'s ``SOAK_COVERED_SEAMS``)
+by literal-evaluating the module-level assignment out of the AST — the
+PR-4 ``NONNEG_FIELDS`` shared-table idea, without importing numpy into
+the linter.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "FieldStore",
+    "FunctionFlow",
+    "ReturnLabels",
+    "dotted_call",
+    "module_literal",
+]
+
+EMPTY: frozenset = frozenset()
+
+#: builtins transparent to value flow: the result carries its arguments'
+#: labels (``float(t_mono)`` is still a monotonic value, ``str(path)``
+#: still names the same file)
+_TRANSPARENT_CALLS = {
+    "float", "int", "str", "abs", "min", "max", "round", "sum",
+    "sorted", "list", "tuple", "set", "dict", "copy", "deepcopy",
+}
+
+#: receiver methods that MUTATE the receiver with their arguments'
+#: labels (``d.update(other)``, ``xs.append(t)``) — the "taint crosses a
+#: dict store" cases that are not syntactic assignments
+_MUTATOR_METHODS = {"append", "add", "update", "setdefault", "insert",
+                    "extend", "put"}
+
+
+def dotted_call(node: ast.Call) -> str:
+    """Best-effort dotted name of a call's callee: ``time.monotonic``,
+    ``os.path.join``, ``open``, ``self._plan.check`` → ``"time.
+    monotonic"`` / … / ``"self._plan.check"``; ``""`` when the callee is
+    not a name/attribute chain (a call on a call, a subscript)."""
+    parts: list[str] = []
+    cur: ast.AST = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_literal(tree: "ast.AST | None", name: str):
+    """Literal value of the module-level ``NAME = <literal>`` assignment
+    in ``tree``, or ``None`` when absent/non-literal.  This is how the
+    lint reads data registries exported by modules it must not import
+    (``tools/fault_soak.py`` imports numpy at module level; the linter
+    stays stdlib-only)."""
+    if tree is None:
+        return None
+    for stmt in tree.body:
+        value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name) and t.id == name:
+                value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            if isinstance(stmt.target, ast.Name) and stmt.target.id == name:
+                value = stmt.value
+        if value is not None:
+            try:
+                return ast.literal_eval(value)
+            except ValueError:
+                return None
+    return None
+
+
+def _target_cell(node: ast.AST) -> "str | None":
+    """Environment cell name for an assignment target / load expression:
+    ``x`` → ``"x"``, ``self.x`` → ``"self.x"``, ``rec["t"]`` →
+    ``"rec['t']"`` (constant keys only); None for anything richer."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        if isinstance(node.slice, ast.Constant):
+            return f"{node.value.id}[{node.slice.value!r}]"
+    return None
+
+
+class FieldStore:
+    """One record-field store event: ``rec["t"] = v``, ``self.t0 = v``,
+    a ``{"t": v}`` dict-literal entry, or an ``emit(..., t=v)`` keyword
+    argument.  ``container`` is the receiver's source form (``"rec"``,
+    ``"self"``, the callee for keywords), ``field`` the constant key /
+    attribute / keyword name, ``node`` the stored value expression."""
+
+    __slots__ = ("container", "field", "node", "kind")
+
+    def __init__(self, container: str, field: str, node: ast.AST,
+                 kind: str) -> None:
+        self.container = container
+        self.field = field
+        self.node = node
+        self.kind = kind  # "subscript" | "attribute" | "dict" | "keyword"
+
+
+class FunctionFlow:
+    """Label flow through one function body (flow-insensitive fixpoint).
+
+    ``seeds(node)`` → labels introduced at any expression node;
+    ``combine(node, left, right)`` → labels of a ``BinOp`` (default:
+    union); ``calls(node)`` → labels of a call's result beyond its
+    transparent-builtin propagation (the interprocedural hook).
+    After construction, :meth:`labels` answers for any expression in the
+    body and :meth:`field_stores` yields every record-field store with
+    its stored labels.
+    """
+
+    MAX_PASSES = 10
+
+    def __init__(
+        self,
+        func: ast.AST,
+        seeds: "Callable[[ast.AST], frozenset]",
+        combine: "Callable[[ast.AST, frozenset, frozenset], frozenset] | None" = None,
+        calls: "Callable[[ast.Call], frozenset] | None" = None,
+    ) -> None:
+        self.func = func
+        self._seeds = seeds
+        self._combine = combine or (lambda node, a, b: a | b)
+        self._calls = calls or (lambda node: EMPTY)
+        self.env: dict[str, frozenset] = {}
+        self._stores: dict[int, FieldStore] = {}
+        self.returns: frozenset = EMPTY
+        self._run()
+
+    # -- fixpoint ----------------------------------------------------------
+    def _run(self) -> None:
+        body = getattr(self.func, "body", [])
+        for _ in range(self.MAX_PASSES):
+            before = {k: v for k, v in self.env.items()}
+            returns_before = self.returns
+            for stmt in body:
+                self._stmt(stmt)
+            if self.env == before and self.returns == returns_before:
+                break
+
+    def _stmt(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes analyze separately
+        if isinstance(stmt, ast.Assign):
+            v = self.labels(stmt.value)
+            for t in stmt.targets:
+                self._bind(t, v, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, self.labels(stmt.value), stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            cell = _target_cell(stmt.target)
+            cur = self.env.get(cell, EMPTY) if cell else EMPTY
+            v = self._combine(stmt, cur, self.labels(stmt.value))
+            self._bind(stmt.target, v, stmt.value, replace=True)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns |= self.labels(stmt.value)
+        elif isinstance(stmt, ast.For):
+            self._bind(stmt.target, self.labels(stmt.iter), stmt.iter)
+            self._block(stmt.body + stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                v = self.labels(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, v, item.context_expr)
+            self._block(stmt.body)
+        elif isinstance(stmt, ast.If):
+            self._block(stmt.body + stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._block(stmt.body + stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            self._block(stmt.body + stmt.orelse + stmt.finalbody)
+            for h in stmt.handlers:
+                self._block(h.body)
+        elif isinstance(stmt, ast.Expr):
+            self.labels(stmt.value)  # record stores/mutators inside
+            self._mutator(stmt.value)
+
+    def _block(self, stmts: "list[ast.AST]") -> None:
+        for s in stmts:
+            self._stmt(s)
+
+    def _mutator(self, expr: ast.AST) -> None:
+        """``d.update(x)`` / ``xs.append(t)`` taints the receiver."""
+        if not (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in _MUTATOR_METHODS):
+            return
+        cell = _target_cell(expr.func.value)
+        if cell is None:
+            return
+        v = EMPTY
+        for a in expr.args:
+            v |= self.labels(a)
+        for kw in expr.keywords:
+            v |= self.labels(kw.value)
+        if v:
+            self.env[cell] = self.env.get(cell, EMPTY) | v
+
+    def _bind(self, target: ast.AST, labels: frozenset, value: ast.AST,
+              replace: bool = False) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = None
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                elts = value.elts
+            for i, t in enumerate(target.elts):
+                if elts is not None:
+                    self._bind(t, self.labels(elts[i]), elts[i])
+                else:
+                    self._bind(t, labels, value)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind(target.value, labels, value)
+            return
+        cell = _target_cell(target)
+        if cell is not None:
+            if replace:
+                self.env[cell] = labels
+            else:
+                self.env[cell] = self.env.get(cell, EMPTY) | labels
+        if isinstance(target, ast.Subscript) and isinstance(
+            target.value, ast.Name
+        ):
+            # the container itself is tainted too (unknown-key loads)
+            base = target.value.id
+            self.env[base] = self.env.get(base, EMPTY) | labels
+            if isinstance(target.slice, ast.Constant) and isinstance(
+                target.slice.value, str
+            ):
+                self._note_store(target.value.id, target.slice.value,
+                                 value, "subscript")
+        elif isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ):
+            self._note_store(target.value.id, target.attr, value,
+                             "attribute")
+
+    def _note_store(self, container: str, field: str, node: ast.AST,
+                    kind: str) -> None:
+        self._stores[id(node)] = FieldStore(container, field, node, kind)
+
+    # -- expression labels -------------------------------------------------
+    def labels(self, expr: ast.AST) -> frozenset:
+        """Label set of ``expr`` under the current environment."""
+        out = frozenset(self._seeds(expr))
+        if isinstance(expr, ast.Name) or isinstance(
+            expr, (ast.Attribute, ast.Subscript)
+        ):
+            cell = _target_cell(expr)
+            if cell is not None and cell in self.env:
+                out |= self.env[cell]
+            if isinstance(expr, (ast.Attribute, ast.Subscript)):
+                if cell is None or cell not in self.env:
+                    # unknown member of a tainted container
+                    out |= self.labels(expr.value)
+        elif isinstance(expr, ast.BinOp):
+            out |= self._combine(
+                expr, self.labels(expr.left), self.labels(expr.right)
+            )
+        elif isinstance(expr, ast.UnaryOp):
+            out |= self.labels(expr.operand)
+        elif isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                out |= self.labels(v)
+        elif isinstance(expr, ast.IfExp):
+            out |= self.labels(expr.body) | self.labels(expr.orelse)
+        elif isinstance(expr, ast.Call):
+            out |= self._call_labels(expr)
+        elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            for e in expr.elts:
+                out |= self.labels(e)
+        elif isinstance(expr, ast.Dict):
+            for k, v in zip(expr.keys, expr.values):
+                out |= self.labels(v)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    self._note_store("{}", k.value, v, "dict")
+        elif isinstance(expr, ast.JoinedStr):
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    out |= self.labels(v.value)
+                else:
+                    out |= frozenset(self._seeds(v))
+        elif isinstance(expr, ast.FormattedValue):
+            out |= self.labels(expr.value)
+        elif isinstance(expr, ast.NamedExpr):
+            v = self.labels(expr.value)
+            self._bind(expr.target, v, expr.value)
+            out |= v
+        elif isinstance(expr, ast.Starred):
+            out |= self.labels(expr.value)
+        elif isinstance(expr, ast.Compare):
+            pass  # a bool carries no value labels
+        return out
+
+    def _call_labels(self, call: ast.Call) -> frozenset:
+        out = frozenset(self._calls(call))
+        name = dotted_call(call)
+        terminal = name.rsplit(".", 1)[-1]
+        if terminal in _TRANSPARENT_CALLS or terminal in ("join", "format"):
+            for a in call.args:
+                out |= self.labels(a)
+            for kw in call.keywords:
+                out |= self.labels(kw.value)
+        if isinstance(call.func, ast.Attribute):
+            # a method result on a tainted receiver stays tainted
+            # (str(path).strip(), d.get("t")) — coarse but safe
+            out |= self.labels(call.func.value)
+        for kw in call.keywords:
+            if kw.arg:
+                self._note_store(name or "<call>", kw.arg, kw.value,
+                                 "keyword")
+        return out
+
+    def field_stores(self) -> Iterator[tuple]:
+        """Yield ``(FieldStore, labels)`` for every record-field store
+        seen in the body, with labels evaluated at the fixpoint.
+        Labeling a stored expression can itself discover nested stores
+        (a dict literal inside a keyword argument), so drain until no
+        new store appears rather than iterating the dict live."""
+        seen: set[int] = set()
+        while True:
+            pending = [s for i, s in self._stores.items() if i not in seen]
+            if not pending:
+                return
+            for store in pending:
+                seen.add(id(store.node))
+                yield store, self.labels(store.node)
+
+
+class ReturnLabels:
+    """Memoized per-function *return-label* summaries over the project
+    call graph — the interprocedural composition layer.
+
+    ``of(qname)`` runs the callee's own :class:`FunctionFlow` (with a
+    ``calls`` hook that recurses through the graph's resolved edges,
+    cycle-guarded to the empty set) and returns the labels its return
+    statements carry.  LT010 uses this so ``def _stamp(): return
+    time.monotonic()`` taints every resolved ``_stamp()`` call site.
+    """
+
+    def __init__(self, graph, seeds, combine=None) -> None:
+        self.graph = graph
+        self._seeds = seeds
+        self._combine = combine
+        self._memo: dict[str, frozenset] = {}
+        self._in_progress: set[str] = set()
+
+    def of(self, qname: str) -> frozenset:
+        if qname in self._memo:
+            return self._memo[qname]
+        if qname in self._in_progress:
+            return EMPTY  # recursion: converge from below
+        info = self.graph.funcs.get(qname)
+        if info is None:
+            return EMPTY
+        self._in_progress.add(qname)
+        try:
+            flow = FunctionFlow(
+                info.node, self._seeds, combine=self._combine,
+                calls=lambda c, _i=info: self.call_labels(_i, c),
+            )
+            self._memo[qname] = flow.returns
+        finally:
+            self._in_progress.discard(qname)
+        return self._memo[qname]
+
+    def call_labels(self, info, call: ast.Call) -> frozenset:
+        """Labels a call inside ``info`` returns, via resolved callees."""
+        out = EMPTY
+        for site in info.calls:
+            if site.line != call.lineno:
+                continue
+            for q in site.resolved:
+                if q:
+                    out |= self.of(q)
+        return out

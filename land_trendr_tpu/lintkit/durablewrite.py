@@ -1,0 +1,204 @@
+"""LT012 — durable artifacts land atomically (tmp + rename) or not at all.
+
+The repo's crash-consistency story is one idiom applied everywhere:
+write the bytes to a sibling ``*.tmp`` path, ``os.replace`` onto the
+final name — rename *is* the commit (manifest artifacts, tune store,
+snapshot publish, history compaction, block-store segments, the lint
+baseline itself).  A direct ``open(final, "w")`` into an artifact tree
+re-introduces the torn-file window those helpers exist to close: a
+SIGKILL mid-``json.dump`` leaves a half-written manifest/report that a
+resume or the perf gate then *reads*.
+
+A write is a finding when it is **non-atomic** — plain ``open(path,
+"w"/"wb"/"x")`` or ``Path.write_text``/``write_bytes`` — AND it targets
+a durable artifact, recognized two ways through :mod:`.dataflow` string
+flow:
+
+* a constant path fragment naming the artifact trees: ``manifest``,
+  ``snapshot``/``.snap``, ``store``, ``result``, ``profile``,
+  ``decisions``, ``baseline``, committed ``CAPACITY_*``/``PERF_*``/
+  ``FAULTSOAK_*``-style reports;
+* a report-output sink by name: ``args.out`` / ``out_path`` / ``out``
+  — the benchmark ``--out`` artifacts the perf gate and the committed
+  baselines consume.
+
+Blessed, i.e. never a finding:
+
+* the path carries a scratch fragment (``tmp``/``.part``) or flows from
+  ``tempfile`` (``mkstemp``/``mkdtemp``/``NamedTemporaryFile``);
+* the written path flows into an ``os.replace``/``os.rename`` *source*
+  argument in the same function (the write IS the tmp leg of the
+  idiom);
+* append mode (``"a"``) — the O_APPEND line-atomic log discipline is a
+  different, also-sanctioned contract;
+* ``tests/`` (fixtures model torn files on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import (
+    Checker,
+    FileCtx,
+    Finding,
+    enclosing_function,
+)
+from land_trendr_tpu.lintkit.dataflow import (
+    EMPTY,
+    FunctionFlow,
+    dotted_call,
+)
+
+__all__ = ["DurableWriteChecker"]
+
+_ARTIFACT_RE = re.compile(
+    r"manifest|snapshot|\.snap|store|result|profile|decision|baseline"
+    r"|capacity|faultsoak|perf_|ident",
+    re.IGNORECASE,
+)
+
+_SCRATCH_RE = re.compile(r"tmp|\.part", re.IGNORECASE)
+
+_TMP_LABEL = "<tempfile>"
+
+_TEMPFILE_CALLS = {
+    "mkstemp", "mkdtemp", "mktemp", "NamedTemporaryFile",
+    "TemporaryDirectory", "TemporaryFile",
+}
+
+#: path expressions that ARE the report-output sink by name
+_OUT_NAME_RE = re.compile(r"(^|_)(out|output)(_path|_file|_json)?$")
+
+
+def _seeds(node: ast.AST) -> frozenset:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return frozenset((node.value,))
+    if isinstance(node, ast.Call):
+        name = dotted_call(node)
+        if name.rsplit(".", 1)[-1] in _TEMPFILE_CALLS:
+            return frozenset((_TMP_LABEL,))
+    return EMPTY
+
+
+def _write_mode(call: ast.Call) -> "str | None":
+    """The constant mode of an ``open()`` call, or None when absent or
+    non-constant (non-constant modes are not this rule's business)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _terminal_ident(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+class DurableWriteChecker(Checker):
+    rule_id = "LT012"
+    title = "non-atomic write into a durable artifact tree"
+
+    def check_file(self, ctx: FileCtx) -> Iterator[Finding]:
+        if ctx.path.startswith("tests/"):
+            return
+        tree = ctx.tree
+        if tree is None:
+            return
+        flows: dict[int, FunctionFlow] = {}
+
+        def flow_for(node: ast.AST) -> FunctionFlow:
+            scope = enclosing_function(node) or tree
+            key = id(scope)
+            if key not in flows:
+                flows[key] = FunctionFlow(scope, _seeds)
+            return flows[key]
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path_expr = self._written_path(node)
+            if path_expr is None:
+                continue
+            flow = flow_for(node)
+            frags = flow.labels(path_expr)
+            if _TMP_LABEL in frags:
+                continue
+            if any(_SCRATCH_RE.search(f) for f in frags):
+                continue
+            artifact = [
+                f for f in frags
+                if f != _TMP_LABEL and _ARTIFACT_RE.search(f)
+            ]
+            sink = _OUT_NAME_RE.search(_terminal_ident(path_expr) or "")
+            if not artifact and sink is None:
+                continue
+            if self._flows_into_replace(node, path_expr, flow):
+                continue
+            what = (
+                f"artifact path fragment {artifact[0]!r}"
+                if artifact
+                else f"report output sink '{_terminal_ident(path_expr)}'"
+            )
+            yield Finding(
+                file=ctx.path,
+                line=node.lineno,
+                rule_id=self.rule_id,
+                message=(
+                    f"non-atomic write into a durable artifact tree "
+                    f"({what}) — write a sibling .tmp and os.replace() "
+                    "onto the final name (rename is the commit)"
+                ),
+            )
+
+    # -- write-site recognition -------------------------------------------
+    def _written_path(self, call: ast.Call) -> "ast.AST | None":
+        """The path expression this call writes non-atomically, if any."""
+        name = dotted_call(call)
+        if name == "open" and call.args:
+            mode = _write_mode(call)
+            if mode is not None and any(c in mode for c in "wx"):
+                return call.args[0]
+            return None
+        # keyed on the attribute, not the dotted name: the receiver is
+        # often not a name chain at all — ``(root / "x.json").write_text``
+        if isinstance(call.func, ast.Attribute) and call.func.attr in (
+            "write_text", "write_bytes"
+        ):
+            return call.func.value
+        return None
+
+    def _flows_into_replace(
+        self, write: ast.Call, path_expr: ast.AST, flow: FunctionFlow
+    ) -> bool:
+        """True when the written path is the SOURCE of an ``os.replace``
+        / ``os.rename`` in the same function — the blessed tmp leg."""
+        scope = enclosing_function(write)
+        if scope is None:
+            return False
+        path_frags = flow.labels(path_expr)
+        path_name = _terminal_ident(path_expr)
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if dotted_call(node) not in ("os.replace", "os.rename"):
+                continue
+            src = node.args[0]
+            if path_name and _terminal_ident(src) == path_name:
+                return True
+            src_frags = flow.labels(src)
+            if path_frags and path_frags & src_frags:
+                return True
+        return False

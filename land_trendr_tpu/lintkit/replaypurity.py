@@ -1,0 +1,258 @@
+"""LT009 — registered pure decision machines must stay replayable.
+
+The capacity planner's byte-identity proof (``CAPACITY_r17.json``) holds
+ONLY because the decision machines it replays — the DRR queue, the
+warm-affinity replica choice, the autoscaler policy, the alert
+lifecycle engine, the Kneedle fold — are pure functions of ``(state,
+now)``: no clock reads, no randomness, no environment, no file IO, no
+module-global mutation.  ``now`` and every seed arrive as *parameters*.
+One stray ``time.time()`` three calls down and a replay diverges from
+the live run on no reproducible schedule; PR 16 fixed exactly that bug
+class by hand.
+
+The registry is data, not prose: ``PURE_MACHINES`` tuples exported by
+``fleet/scheduling.py`` and ``obs/alerts.py`` (the ``NONNEG_FIELDS``
+shared-table pattern) name ``(file, symbol)`` roots — a bare function,
+a ``Class.method``, a whole class (every method), or an ``fnmatch``
+pattern (``*_value_errors`` covers the event value-lint folds).  This
+rule expands each root through the PR-8 call graph's resolved edges and
+walks every transitively reached body for impurity primitives; findings
+attribute to the *registered root* with the full call chain spelled
+out, so the baseline keys on the machine, not on whichever helper the
+impurity happens to hide in today.
+
+A registry entry that matches nothing is itself a finding — a renamed
+machine must take its registration with it.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.callgraph import get_graph
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+from land_trendr_tpu.lintkit.dataflow import dotted_call, module_literal
+
+__all__ = ["ReplayPurityChecker", "REGISTRY_FILES"]
+
+#: modules exporting a ``PURE_MACHINES`` registry (missing files are
+#: tolerated so fixture mini-repos can carry just one)
+REGISTRY_FILES = (
+    "land_trendr_tpu/fleet/scheduling.py",
+    "land_trendr_tpu/obs/alerts.py",
+)
+
+#: dotted call names that read a clock / randomness / the environment
+_IMPURE_CALLS = {
+    "time.time": "wall-clock read",
+    "time.time_ns": "wall-clock read",
+    "time.monotonic": "monotonic-clock read",
+    "time.monotonic_ns": "monotonic-clock read",
+    "time.perf_counter": "monotonic-clock read",
+    "time.perf_counter_ns": "monotonic-clock read",
+    "time.sleep": "clock-dependent sleep",
+    "datetime.now": "wall-clock read",
+    "datetime.utcnow": "wall-clock read",
+    "datetime.datetime.now": "wall-clock read",
+    "datetime.datetime.utcnow": "wall-clock read",
+    "os.getenv": "environment read",
+    "os.environ.get": "environment read",
+    "os.urandom": "randomness",
+    "uuid.uuid1": "randomness",
+    "uuid.uuid4": "randomness",
+}
+
+#: module prefixes whose every call is impure (unseeded randomness)
+_IMPURE_PREFIXES = ("random.", "secrets.")
+
+#: file-IO call names (reads included: a pure machine's inputs arrive
+#: as arguments, not as files it opens behind the replay's back)
+_IO_CALLS = {
+    "open": "file IO (open)",
+    "os.open": "file IO (os.open)",
+    "os.write": "file IO (os.write)",
+    "os.read": "file IO (os.read)",
+    "os.remove": "file IO (os.remove)",
+    "os.replace": "file IO (os.replace)",
+    "os.rename": "file IO (os.rename)",
+    "os.makedirs": "file IO (os.makedirs)",
+    "os.fsync": "file IO (os.fsync)",
+}
+
+#: method terminals that are file IO on any receiver worth flagging
+_IO_METHODS = {
+    "write_text": "file IO (write_text)",
+    "write_bytes": "file IO (write_bytes)",
+    "read_text": "file IO (read_text)",
+    "read_bytes": "file IO (read_bytes)",
+}
+
+
+def _impurity(call: ast.Call) -> "str | None":
+    name = dotted_call(call)
+    if not name:
+        return None
+    if name in _IMPURE_CALLS:
+        return f"{_IMPURE_CALLS[name]} ({name}())"
+    for prefix in _IMPURE_PREFIXES:
+        if name.startswith(prefix):
+            return f"unseeded randomness ({name}())"
+    if name in _IO_CALLS:
+        return _IO_CALLS[name]
+    terminal = name.rsplit(".", 1)[-1]
+    if terminal in _IO_METHODS and "." in name:
+        return _IO_METHODS[terminal]
+    return None
+
+
+def _scan_body(node: ast.AST) -> "list[tuple[int, str]]":
+    """(line, description) impurity primitives directly in one body."""
+    out: list[tuple[int, str]] = []
+    globals_declared: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Global):
+            globals_declared.update(n.names)
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            desc = _impurity(n)
+            if desc is not None:
+                out.append((n.lineno, desc))
+        elif isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = (
+                n.targets if isinstance(n, ast.Assign) else [n.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in globals_declared:
+                    out.append(
+                        (n.lineno, f"module-global mutation ({t.id})")
+                    )
+        elif isinstance(n, ast.Attribute) and n.attr == "environ":
+            if isinstance(n.value, ast.Name) and n.value.id == "os":
+                out.append((n.lineno, "environment read (os.environ)"))
+    return out
+
+
+class ReplayPurityChecker(Checker):
+    rule_id = "LT009"
+    title = "registered pure decision machine reaches an impure primitive"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        return {f for f in repo.py_files if not f.startswith("tests/")}
+
+    # -- registry ----------------------------------------------------------
+    def _registry(self, repo: RepoCtx) -> "list[tuple[str, str]]":
+        entries: list[tuple[str, str]] = []
+        for relpath in REGISTRY_FILES:
+            if not repo.exists(relpath):
+                continue
+            machines = module_literal(repo.file(relpath).tree,
+                                      "PURE_MACHINES")
+            if machines:
+                entries.extend((str(f), str(s)) for f, s in machines)
+        return entries
+
+    def _expand(self, graph, file: str, symbol: str) -> "list[str]":
+        """Registry entry → root qnames in the call graph."""
+        roots: list[str] = []
+        if "*" in symbol or "?" in symbol:
+            for qname, info in graph.funcs.items():
+                if info.file != file:
+                    continue
+                local = f"{info.cls}.{info.name}" if info.cls else info.name
+                if fnmatch.fnmatch(local, symbol):
+                    roots.append(qname)
+            return roots
+        direct = f"{file}::{symbol}"
+        if direct in graph.funcs:
+            return [direct]
+        # a bare class name registers every method
+        for qname, info in graph.funcs.items():
+            if info.file == file and info.cls == symbol:
+                roots.append(qname)
+        return roots
+
+    # -- the rule ----------------------------------------------------------
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        graph = get_graph(repo)
+        registry = self._registry(repo)
+        impure_cache: dict[str, list] = {}
+
+        def direct(qname: str) -> list:
+            if qname not in impure_cache:
+                info = graph.funcs.get(qname)
+                impure_cache[qname] = (
+                    _scan_body(info.node) if info is not None else []
+                )
+            return impure_cache[qname]
+
+        for file, symbol in registry:
+            roots = self._expand(graph, file, symbol)
+            if not roots:
+                yield Finding(
+                    file=file,
+                    line=1,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"PURE_MACHINES entry ({file!r}, {symbol!r}) "
+                        "matches no function — the registry drifted from "
+                        "the code"
+                    ),
+                    symbol="<registry>",
+                )
+                continue
+            for root in roots:
+                yield from self._check_root(graph, root, direct)
+
+    def _check_root(self, graph, root: str, direct) -> Iterator[Finding]:
+        info = graph.funcs[root]
+        root_symbol = f"{info.cls}.{info.name}" if info.cls else info.name
+        # BFS over resolved call edges, remembering one parent per node
+        # so every finding carries a concrete witness chain
+        parent: dict[str, "str | None"] = {root: None}
+        order = [root]
+        i = 0
+        while i < len(order):
+            q = order[i]
+            i += 1
+            qi = graph.funcs.get(q)
+            if qi is None:
+                continue
+            for site in qi.calls:
+                for callee in site.resolved:
+                    if callee and callee not in parent:
+                        parent[callee] = q
+                        order.append(callee)
+        reported: set = set()
+        for q in order:
+            for line, desc in direct(q):
+                chain: list[str] = []
+                cur: "str | None" = q
+                while cur is not None:
+                    ci = graph.funcs[cur]
+                    chain.append(
+                        f"{ci.cls}.{ci.name}" if ci.cls else ci.name
+                    )
+                    cur = parent[cur]
+                chain.reverse()
+                qi = graph.funcs[q]
+                key = (desc, qi.file, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                via = " -> ".join(chain)
+                where = (
+                    f" at {qi.file}:{line}" if q != root else ""
+                )
+                yield Finding(
+                    file=info.file,
+                    line=info.node.lineno,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"pure decision machine '{root_symbol}' reaches "
+                        f"{desc} via {via}{where} — replay determinism "
+                        "requires clocks/seeds as parameters"
+                    ),
+                    symbol=root_symbol,
+                )

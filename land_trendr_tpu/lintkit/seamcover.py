@@ -1,0 +1,200 @@
+"""LT011 — the fault-seam registry, the tree, and the soak must agree.
+
+A fault seam is only as real as three facts staying true at once:
+
+1. every seam string *fired* in the tree (``faults.check("dispatch")``,
+   ``fault_check("feed.decode")``, ``plan.fired(...)``) is registered in
+   ``runtime/faults.py``'s ``SEAMS`` — an unregistered name is a
+   silently dead injection (``FaultPlan`` validates *schedules*, but a
+   host-side typo just never fires);
+2. every registered seam is fired somewhere in ``land_trendr_tpu/`` —
+   a seam nobody fires is documentation, not coverage;
+3. every registered seam is exercised by a ``tools/fault_soak.py`` case
+   — cross-checked against the tool's exported
+   ``SOAK_COVERED_SEAMS`` data table (the ``NONNEG_FIELDS`` pattern;
+   the linter literal-evals it rather than importing a numpy-loading
+   tool) — or carries a baselined reason.  Zero silent gaps.
+
+The soak table is itself checked both ways: a ``SOAK_COVERED_SEAMS``
+entry naming an unregistered seam is stale and flagged
+(``tests/test_faults.py`` pins the table against the soak's actual case
+schedules from the other side).
+
+PAPERS.md's *Massively-Parallel Break Detection* is the
+ROADMAP-item-2 algorithm about to multiply emit sites and seams; this
+rule exists so each new one arrives registered, fired and soaked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from land_trendr_tpu.lintkit.core import Checker, Finding, RepoCtx
+from land_trendr_tpu.lintkit.dataflow import dotted_call, module_literal
+
+__all__ = ["SeamCoverageChecker"]
+
+REGISTRY_FILE = "land_trendr_tpu/runtime/faults.py"
+SOAK_FILE = "tools/fault_soak.py"
+
+#: call forms that fire a seam with a constant first argument: the
+#: module-level / plan-method APIs and the io-layer hook names
+#: (``blockcache.fault_check`` / ``fault_corrupt``)
+_FIRE_TERMINALS = {"check", "fired", "corrupt", "fault_check",
+                   "fault_corrupt"}
+
+#: receivers trusted to be a faults module / plan when the terminal is
+#: the generic check/fired/corrupt (a bare ``check(...)`` in some tool
+#: is NOT a seam fire)
+_FIRE_RECEIVERS = ("faults", "plan", "_plan", "fault")
+
+
+def _fire_site(call: ast.Call) -> "str | None":
+    """The seam string this call fires, or None when it is not a
+    seam-firing form."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+        return None
+    name = dotted_call(call)
+    if not name:
+        return None
+    parts = name.split(".")
+    terminal = parts[-1]
+    if terminal not in _FIRE_TERMINALS:
+        return None
+    if terminal in ("fault_check", "fault_corrupt"):
+        return arg.value
+    receiver = parts[-2] if len(parts) >= 2 else ""
+    if any(r in receiver for r in _FIRE_RECEIVERS) or receiver == "self":
+        return arg.value
+    return None
+
+
+def _assign_line(tree: "ast.AST | None", name: str) -> int:
+    if tree is not None:
+        for stmt in tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return stmt.lineno
+    return 1
+
+
+class SeamCoverageChecker(Checker):
+    rule_id = "LT011"
+    title = "fault-seam registry / fire-site / soak-coverage drift"
+
+    def inputs(self, repo: RepoCtx) -> "set[str] | None":
+        return {
+            f for f in repo.py_files
+            if f.startswith("land_trendr_tpu/") or f == SOAK_FILE
+        }
+
+    def check(self, repo: RepoCtx) -> Iterator[Finding]:
+        if not repo.exists(REGISTRY_FILE):
+            return
+        reg_tree = repo.file(REGISTRY_FILE).tree
+        seams = module_literal(reg_tree, "SEAMS")
+        if not seams:
+            yield Finding(
+                file=REGISTRY_FILE, line=1, rule_id=self.rule_id,
+                message="SEAMS registry missing or not a literal tuple",
+                symbol="<registry>",
+            )
+            return
+        seams = tuple(seams)
+        reg_line = _assign_line(reg_tree, "SEAMS")
+
+        # -- 1. every fire site names a registered seam --------------------
+        fired: dict[str, list] = {}
+        for relpath in repo.py_files:
+            if not relpath.startswith("land_trendr_tpu/"):
+                continue
+            if relpath == REGISTRY_FILE:
+                continue  # the registry's own APIs take the seam as a param
+            ctx = repo.file(relpath)
+            if ctx.tree is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                seam = _fire_site(node)
+                if seam is None:
+                    continue
+                fired.setdefault(seam, []).append((relpath, node.lineno))
+                if seam not in seams:
+                    yield Finding(
+                        file=relpath,
+                        line=node.lineno,
+                        rule_id=self.rule_id,
+                        message=(
+                            f"fires unregistered fault seam {seam!r} — "
+                            "add it to runtime/faults.py SEAMS or fix "
+                            "the typo (an unregistered seam is a "
+                            "silently dead injection)"
+                        ),
+                    )
+
+        # -- 2. every registered seam is fired somewhere -------------------
+        for seam in seams:
+            if seam not in fired:
+                yield Finding(
+                    file=REGISTRY_FILE,
+                    line=reg_line,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"registered seam {seam!r} is never fired in "
+                        "land_trendr_tpu/ — dead registry entry"
+                    ),
+                    symbol="<registry>",
+                )
+
+        # -- 3. soak coverage ---------------------------------------------
+        if not repo.exists(SOAK_FILE):
+            return
+        soak_tree = repo.file(SOAK_FILE).tree
+        covered = module_literal(soak_tree, "SOAK_COVERED_SEAMS")
+        soak_line = _assign_line(soak_tree, "SOAK_COVERED_SEAMS")
+        if covered is None:
+            yield Finding(
+                file=SOAK_FILE, line=1, rule_id=self.rule_id,
+                message=(
+                    "SOAK_COVERED_SEAMS data table missing — LT011 "
+                    "cannot cross-check soak coverage"
+                ),
+                symbol="<registry>",
+            )
+            return
+        covered = tuple(covered)
+        for seam in seams:
+            if seam not in covered:
+                yield Finding(
+                    file=SOAK_FILE,
+                    line=soak_line,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"registered seam {seam!r} has no fault_soak "
+                        "case (not in SOAK_COVERED_SEAMS) — back-fill "
+                        "a case or baseline this with the reason"
+                    ),
+                    symbol="<registry>",
+                )
+        for seam in covered:
+            if seam not in seams:
+                yield Finding(
+                    file=SOAK_FILE,
+                    line=soak_line,
+                    rule_id=self.rule_id,
+                    message=(
+                        f"SOAK_COVERED_SEAMS names {seam!r} which is "
+                        "not a registered seam — stale table entry"
+                    ),
+                    symbol="<registry>",
+                )

@@ -43,11 +43,32 @@ __all__ = [
     "ALERT_KINDS",
     "ALERT_STATES",
     "DEFAULT_RULES",
+    "PURE_MACHINES",
     "AlertEngine",
     "AlertRule",
     "load_rules",
     "parse_rules",
 ]
+
+#: The observability-side pure decision machines, as ``(file, symbol)``
+#: data — the other half of lt-lint LT009's registry (see
+#: ``fleet/scheduling.py`` for the fleet half and the rationale).  The
+#: alert lifecycle engine is replayed against scripted histories by the
+#: perf gate; the event value-lint folds (``*_value_errors`` and the
+#: stateful lint classes in ``tools/check_events_schema.py``) fold the
+#: same stream to the same verdicts on every host, which is the same
+#: purity obligation.  ``load_rules``/``parse_rules`` are deliberately
+#: absent: loading a rules FILE is configuration, not a replayed
+#: decision.
+PURE_MACHINES = (
+    ("land_trendr_tpu/obs/alerts.py", "AlertEngine.evaluate"),
+    ("land_trendr_tpu/obs/alerts.py", "AlertEngine._rule_value"),
+    ("land_trendr_tpu/obs/alerts.py", "AlertEngine._transition"),
+    ("tools/check_events_schema.py", "*_value_errors"),
+    ("tools/check_events_schema.py", "FetchValueLint"),
+    ("tools/check_events_schema.py", "TraceRefLint"),
+    ("tools/check_events_schema.py", "AlertValueLint"),
+)
 
 ALERT_KINDS = ("threshold", "rate", "slo_burn", "absent")
 

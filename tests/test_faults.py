@@ -562,3 +562,33 @@ def test_fault_soak_smoke(tmp_path):
     assert {"decode_transient", "cache_corrupt"} <= {
         c for t, c in cases if t == "lazy"
     }
+    # the seam-coverage backfill cases (LT011): forced lease steal,
+    # dead-peer partial merge, job-start fault + resubmit
+    assert {"lease_forced_steal", "merge_peer_partial",
+            "job_fault_then_resubmit"} <= {c for _, c in cases}
+
+
+def test_soak_covered_seams_table_pins_registry_and_schedules():
+    """The LT011 satellite pin from the soak's side: the exported
+    ``SOAK_COVERED_SEAMS`` data table must name exactly the registered
+    ``SEAMS`` — zero silent coverage gaps, zero stale rows — and every
+    table entry must actually be ARMED by some schedule in the soak
+    source (the ``seam@`` / ``seam%`` arming syntax), so the table
+    cannot bless coverage the soak never exercises."""
+    import re
+
+    from tools.fault_soak import SOAK_COVERED_SEAMS
+
+    assert len(SOAK_COVERED_SEAMS) == len(set(SOAK_COVERED_SEAMS))
+    assert set(SOAK_COVERED_SEAMS) == set(faults.SEAMS)
+    soak_src_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "fault_soak.py",
+    )
+    with open(soak_src_path) as f:
+        src = f.read()
+    for seam in SOAK_COVERED_SEAMS:
+        assert re.search(re.escape(seam) + r"[@%]", src), (
+            f"SOAK_COVERED_SEAMS lists {seam!r} but no soak schedule "
+            "arms it — back-fill a case before blessing coverage"
+        )

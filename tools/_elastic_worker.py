@@ -70,8 +70,11 @@ def main() -> int:
     summary = run_stack(rs, cfg)
     out = cfg_json.get("summary_path")
     if out:
-        with open(out, "w") as f:
-            json.dump(summary, f)
+        from tools._measure import write_json_atomic
+
+        # the soak's poll loop reads this file the instant it appears;
+        # rename-as-commit means it never reads a torn summary
+        write_json_atomic(out, summary, indent=None, trailing_newline=False)
     return 0
 
 

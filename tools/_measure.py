@@ -12,6 +12,34 @@ def rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def write_text_atomic(path: str, text: str) -> None:
+    """Commit ``text`` to ``path`` via the repo's one durable-write
+    idiom: write a sibling ``.tmp``, then ``os.replace`` onto the final
+    name — rename IS the commit, so a SIGKILL mid-write leaves the old
+    artifact intact instead of a torn one (lt-lint LT012's contract)."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_json_atomic(
+    path: str,
+    obj,
+    indent: "int | None" = 2,
+    trailing_newline: bool = True,
+) -> None:
+    """JSON flavor of :func:`write_text_atomic` — the benchmark/report
+    ``--out`` artifacts the perf gate and committed baselines consume.
+    ``indent``/``trailing_newline`` mirror each tool's historical output
+    bytes so regenerated artifacts diff clean."""
+    text = json.dumps(obj, indent=indent)
+    if trailing_newline:
+        text += "\n"
+    write_text_atomic(path, text)
+
+
 def merge_json(path: str, key: str, rec: dict) -> None:
     """Merge ``rec`` under ``key`` into the JSON document at ``path`` and
     echo the addition (the committed-artifact update pattern)."""
@@ -20,7 +48,5 @@ def merge_json(path: str, key: str, rec: dict) -> None:
         with open(path) as f:
             doc = json.load(f)
     doc[key] = rec
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
-        f.write("\n")
+    write_json_atomic(path, doc, indent=1)
     print(json.dumps({key: rec}))

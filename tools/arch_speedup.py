@@ -138,8 +138,9 @@ def main() -> int:
     except (OSError, ValueError):
         pass
 
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, rec, trailing_newline=False)
     print(json.dumps(rec))
     return 0
 

@@ -385,8 +385,9 @@ def main(argv: "list[str] | None" = None) -> int:
     ok = report["parity_ok"] and all(report["invariants"].values())
     report["ok"] = ok
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(report, f, indent=2)
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(args.out, report, trailing_newline=False)
         print(f"wrote {args.out}")
     print(
         json.dumps(

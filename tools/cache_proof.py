@@ -94,9 +94,9 @@ def main() -> int:
                 "run 1's on-disk jax_compilation_cache_dir"
             ),
         }
-        with open(out_path, "w") as f:
-            json.dump(rec, f, indent=1)
-            f.write("\n")
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(out_path, rec, indent=1)
         print(json.dumps(rec))
         return 0 if rec["ok"] else 1
     finally:

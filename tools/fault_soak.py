@@ -53,6 +53,42 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+#: Every registered seam a soak case exercises, as data — the coverage
+#: half of lt-lint LT011's three-way cross-check (the ``NONNEG_FIELDS``
+#: shared-table pattern): the linter literal-evals this table (it never
+#: imports a numpy-loading tool) and flags any ``runtime/faults.py``
+#: ``SEAMS`` entry missing here as an uncovered seam, and any entry
+#: here that is not registered as stale.  ``tests/test_faults.py`` pins
+#: the table against the schedules the cases actually arm from the
+#: other side, so a seam cannot be "covered" by table edit alone.
+SOAK_COVERED_SEAMS = (
+    "feed",                # eager feed_transient
+    "feed.decode",         # lazy decode_transient
+    "cache.corrupt",       # lazy cache_corrupt
+    "store.corrupt",       # lazy store_corrupt
+    "upload.wait",         # eager upload_wait_fault / upload_demotion
+    "dispatch",            # eager dispatch_fault / quarantine
+    "compute.wait",        # eager compute_wait_fault / straggler_slow
+    "fetch.wait",          # eager fetch_wait_fault / fetch_demotion
+    "manifest.record",     # eager manifest_enospc (abort → resume)
+    "manifest.torn",       # eager manifest_torn (abort → resume)
+    "lease.acquire",       # eager lease_acquire_fault
+    "lease.steal",         # eager lease_forced_steal
+    "lease.expire",        # eager lease_forced_steal
+    "merge.peer",          # merge_peer_partial (dead-peer bounded wait)
+    "serve.submit",        # serve submit_reject_and_sibling_quarantine
+    "serve.job",           # serve job_fault_then_resubmit
+    "debug.profile",       # serve debug_stacks_under_hang_and_profile_fault
+    "obs.publish",         # fleet telemetry case (swallowed STOP flush)
+    "history.append",      # fleet telemetry case (lossy ring append)
+    "router.forward",      # router forward-fault re-route case
+    "replica.health",      # router health-flap case
+    "tune.probe",          # tune_probe_fault (degraded-profile run)
+    "loadgen.tick",        # loadgen churn case
+    "batch.pack",          # batch pack/demux fault case
+    "batch.demux",         # batch pack/demux fault case
+)
+
 import numpy as np  # noqa: E402
 
 
@@ -373,6 +409,148 @@ def soak(
                 f"{len(stragglers)} tile_straggler event(s))"
             )
 
+    def run_lease_steal_case(stack) -> None:
+        """Deterministic steal-under-a-living-owner (the ``lease.expire``
+        and ``lease.steal`` seams): the workdir is pre-seeded with a
+        LIVE foreign lease — a ghost owner holding tile 0 on a 1-hour
+        TTL under the run's own manifest fingerprint — so the elastic
+        runner starts blocked on that tile.  ``lease.expire%1.0`` forces
+        every blocked probe to read expired; the first forced steal the
+        runner actually picks hits ``lease.steal@0=io`` — the acquire
+        raises, the host backs off and retries (the documented lease
+        contract) — and the retry steals for real.  One process, no
+        SIGKILL choreography (that is full-mode ``lease_kill_steal``),
+        completes without a resume, artifacts byte-identical."""
+        wd = str(root / "eager_lease_steal")
+        cfg = RunConfig(
+            workdir=wd,
+            out_dir=wd + "_o",
+            fault_schedule="seed=1,lease.expire%1.0,lease.steal@0=io",
+            lease_batch=2,
+            lease_ttl_s=10.0,
+            **base_kw,
+        )
+        Path(wd).mkdir(parents=True, exist_ok=True)
+        header = {
+            "kind": "header",
+            "fingerprint": cfg.fingerprint(stack),
+            # must match the resuming run's execution context exactly or
+            # open(resume=True) rejects the workdir as foreign
+            "context": {"mesh_devices": 1, "impl": "xla"},
+            "run_id": "ghost-run",
+        }
+        ghost = {
+            "kind": "lease",
+            "tile_id": 0,
+            "gen": 0,
+            "owner": "ghost:1:g",
+            "host": "ghost",
+            "pid": 1,
+            "ttl_s": 3600.0,
+            "t_wall": time.time(),
+            "mode": "claim",
+        }
+        # a pre-seeded fixture, not a durable artifact: the run's own
+        # manifest machinery takes over the file from here
+        (Path(wd) / "manifest.jsonl").write_text(  # lt: noqa[LT012]
+            json.dumps(header) + "\n" + json.dumps(ghost) + "\n"
+        )
+        summary = _run(stack, cfg)
+        seams = {f["seam"] for f in summary.get("faults_injected", [])}
+        if not {"lease.expire", "lease.steal"} <= seams:
+            raise AssertionError(
+                "lease_forced_steal: expected both lease.expire and "
+                f"lease.steal to fire, got {sorted(seams)}"
+            )
+        got = _digest_workdir(wd)
+        clean = _digest_workdir(str(root / "eager_clean"))
+        if got != clean:
+            raise AssertionError(
+                "lease_forced_steal: artifacts differ from the clean run"
+            )
+        report["cases"].append({
+            "track": "eager",
+            "case": "lease_forced_steal",
+            "schedule": cfg.fault_schedule,
+            "seams_fired": sorted(
+                s for s in seams if s.startswith("lease.")
+            ),
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: eager/lease_forced_steal ({cfg.fault_schedule})"
+            )
+
+    def run_merge_peer_case() -> None:
+        """Dead-peer merge semantics (the ``merge.peer`` seam): with the
+        seam armed at probability 1.0 every tail probe reads
+        not-terminal, so the primary's bounded wait expires and it
+        returns the PARTIAL merge of the streams that exist — never a
+        hang, never a crash.  Disarmed, the same merge resolves
+        immediately with every host terminal."""
+        from land_trendr_tpu.obs.events import EventLog, events_path
+        from land_trendr_tpu.parallel.multihost import merge_host_event_logs
+        from land_trendr_tpu.runtime import faults
+
+        wd = str(root / "merge_peer")
+        Path(wd).mkdir(parents=True, exist_ok=True)
+        for i in range(2):
+            with EventLog(events_path(wd, i, 2)) as elog:
+                elog.run_start(
+                    fingerprint="f" * 16, process_index=i, process_count=2,
+                    tiles_total=2, tiles_todo=2, tiles_skipped_resume=0,
+                    mesh_devices=1, impl="xla",
+                )
+                elog.emit(
+                    "run_done", status="ok", tiles_done=1, pixels=10,
+                    wall_s=0.1, px_per_s=100.0, fit_rate=1.0,
+                )
+        plan = faults.activate(faults.parse_schedule("seed=1,merge.peer%1.0"))
+        try:
+            t0 = time.monotonic()
+            merged = merge_host_event_logs(
+                wd, expect_hosts=2, timeout_s=0.4, poll_s=0.05
+            )
+            waited = time.monotonic() - t0
+        finally:
+            faults.deactivate()
+        if "merge.peer" not in {s for s, _i, _k in plan.injected()}:
+            raise AssertionError(
+                "merge_peer_partial: the armed seam never fired — "
+                f"{plan.injected()}"
+            )
+        if not 0.3 < waited < 30.0:
+            raise AssertionError(
+                f"merge_peer_partial: expected the bounded wait to "
+                f"expire (~0.4s), waited {waited:.3f}s"
+            )
+        if len(merged) != 2:
+            raise AssertionError(
+                f"merge_peer_partial: partial merge should still fold "
+                f"what exists (2 streams), got {len(merged)}"
+            )
+        merged = merge_host_event_logs(
+            wd, expect_hosts=2, timeout_s=5.0, poll_s=0.05
+        )
+        if [m["status"] for m in merged] != ["ok", "ok"]:
+            raise AssertionError(
+                f"merge_peer_partial: clean merge did not resolve both "
+                f"hosts terminal: {merged}"
+            )
+        report["cases"].append({
+            "track": "merge",
+            "case": "merge_peer_partial",
+            "schedule": "seed=1,merge.peer%1.0",
+            "waited_s": round(waited, 3),
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: merge/merge_peer_partial (bounded wait "
+                f"{waited:.2f}s, partial merge folded)"
+            )
+
     def run_fleet_case(stack) -> None:
         """Fleet-telemetry failure semantics (ISSUE 11): with the
         ``obs.publish`` seam armed, the run's START snapshot lands
@@ -420,6 +598,9 @@ def soak(
                 f"expected exactly the start snapshot, found "
                 f"{[s.name for s in snaps]}"
             )
+        # a torn snapshot IS the fixture: the aggregator must flag it
+        # corrupt without crashing the fold — atomicity would defeat it
+        # lt: noqa[LT012]
         (tel_dir / "torn-host.4242.snap.json").write_text(
             '{"schema": 1, "host": "torn-host", "pid": 4242, "t_w'
         )
@@ -683,6 +864,69 @@ def soak(
                 f"  ok: serve/debug_stacks_under_hang_and_profile_fault "
                 f"({schedule2})"
             )
+
+    def run_serve_job_case() -> None:
+        """Job-level failure isolation (the ``serve.job`` seam): the
+        armed job fails at execution START — before its run config is
+        even built — and goes terminal ``error``.  The SAME request
+        resubmitted to the same server completes with artifacts
+        byte-identical to the serve track's clean run: a job-start
+        failure burns the job, never the server or the request.  Runs
+        after :func:`run_serve_track` (reuses its on-disk stack and
+        clean digest)."""
+        from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        schedule = "seed=1,serve.job@0"
+        # the serve loop drains jobs serially on one thread, so
+        # invocation 0 is deterministically the FIRST submission's
+        # execution start; max_jobs=2 counts the errored job as one of
+        # the two terminal states (max_jobs=1 would shut down on it)
+        server = SegmentationServer(
+            ServeConfig(
+                workdir=str(root / "serve_jobfault"),
+                max_jobs=2,
+                feed_cache_mb=64,
+                fault_schedule=schedule,
+            )
+        )
+        job = {
+            "stack_dir": sdir,
+            "tile_size": base_kw["tile_size"],
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "max_retries": retries,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+        a = server.submit(dict(job))
+        b = server.submit(dict(job))
+        server.serve_forever()
+        sa = server.job_status(a["job_id"])
+        sb = server.job_status(b["job_id"])
+        if sa["state"] != "error":
+            raise AssertionError(
+                f"serve.job@0: expected the first job terminal 'error', "
+                f"got {sa['state']} ({sa.get('error')})"
+            )
+        if sb["state"] != "done":
+            raise AssertionError(
+                f"serve.job resubmit: expected done, got {sb['state']} "
+                f"({sb.get('error')})"
+            )
+        if _digest_workdir(sb["workdir"]) != clean:
+            raise AssertionError(
+                "serve.job resubmit artifacts differ from the clean run"
+            )
+        report["cases"].append({
+            "track": "serve",
+            "case": "job_fault_then_resubmit",
+            "schedule": schedule,
+            "job_a": sa["state"],
+            "job_b": sb["state"],
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(f"  ok: serve/job_fault_then_resubmit ({schedule})")
 
     def run_batch_track() -> None:
         """Cross-job batching failure semantics (ISSUE 18): with the
@@ -1558,11 +1802,14 @@ def soak(
     eager = _make_eager(40, 48)
     run_track("eager", eager, _eager_cases(retries), tile_size=20)
     run_straggler_case(eager)
+    run_lease_steal_case(eager)
+    run_merge_peer_case()
     run_tune_case(eager)
     run_fleet_case(eager)
     if not smoke:
         run_lease_kill_case()
     run_serve_track()
+    run_serve_job_case()
     run_batch_track()
     run_router_track()
     if not smoke:
@@ -1638,8 +1885,9 @@ def main(argv: "list[str] | None" = None) -> int:
 
     report = soak(smoke=args.smoke, seeds=args.seeds, keep=args.keep)
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump(report, f, indent=2)
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(args.out, report, trailing_newline=False)
         print(f"wrote {args.out}")
     print(json.dumps({"ok": report["ok"], "cases": len(report["cases"])}))
     return 0

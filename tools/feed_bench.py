@@ -234,9 +234,9 @@ def main(argv: list[str] | None = None) -> int:
             "parity_windows_checked": parity_checked,
             "parity_ok": True,
         }
-        with open(args.out, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(args.out, result)
 
         if args.events_dir:
             from land_trendr_tpu.obs import Telemetry

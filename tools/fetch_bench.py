@@ -323,9 +323,9 @@ def main(argv: list[str] | None = None) -> int:
             "ok": True,
         },
     }
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(args.out, result)
     print(json.dumps(result, indent=2))
     return 0
 

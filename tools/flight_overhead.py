@@ -188,8 +188,9 @@ def run_bench(smoke: bool, out_path: "str | None") -> dict:
     finally:
         shutil.rmtree(root, ignore_errors=True)
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=2)
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(out_path, result, trailing_newline=False)
     return result
 
 

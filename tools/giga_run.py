@@ -270,7 +270,9 @@ def cmd_all(args) -> dict:
         ) if p.get("peak_rss_mib")
     )
     out_path = REPO / "GIGA_r05.json"
-    out_path.write_text(json.dumps(result, indent=1) + "\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, result, indent=1)
     print(json.dumps(result, indent=1))
     return result
 

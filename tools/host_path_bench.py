@@ -184,8 +184,9 @@ def main() -> None:
 
     shutil.rmtree(workdir, ignore_errors=True)
 
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(args.out, result, trailing_newline=False)
     print(json.dumps(result["stages"], indent=2))
 
 

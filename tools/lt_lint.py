@@ -1,6 +1,6 @@
 """lt-lint CLI: run the repo's AST invariant checks (CI seam).
 
-Runs the eight LT rules (``land_trendr_tpu/lintkit``) over the tree and
+Runs the twelve LT rules (``land_trendr_tpu/lintkit``) over the tree and
 exits 1 on any finding that is neither ``# lt: noqa[rule]``-suppressed
 inline nor recorded (with a reason) in ``LINT_BASELINE.json``.  Exit 0 =
 clean, 2 = usage/configuration error (including a baseline entry with no
@@ -15,8 +15,9 @@ reason — an exception nobody wrote down is not an exception).
 
 ``--changed`` is the pre-commit invocation (README §Static analysis):
 per-file rules run only on modified/untracked Python files; the
-repo-level rules (LT004/LT005 coupling, LT006–LT008 interprocedural)
-run whenever one of their source files changed.  ``--sarif`` writes a
+repo-level rules (LT004/LT005 coupling, the LT006–LT009/LT011
+interprocedural and registry-driven family) run whenever one of their
+source files changed.  ``--sarif`` writes a
 SARIF 2.1.0 log alongside whatever else was requested (``-`` =
 stdout) — active findings as ``error`` results, baselined ones as
 suppressed results carrying their written justification — so CI can
@@ -52,6 +53,12 @@ from land_trendr_tpu.lintkit import (  # noqa: E402
 )
 
 BASELINE_FILE = "LINT_BASELINE.json"
+
+#: wall-time bound on a full twelve-rule run, shared by the tier-1 gate
+#: (tests/test_lint.py) and the perf-gate lint leg — a full run measures
+#: ~12s in this container; the bound leaves slack for load, not for an
+#: accidentally quadratic rule
+LINT_BUDGET_S = 30.0
 
 
 def sarif_report(report: dict, files_checked: int) -> dict:
@@ -243,16 +250,53 @@ def main(argv: "list[str] | None" = None) -> int:
                 )
                 return 2
 
-    repo = RepoCtx(str(REPO), files=files)
+    if args.sarif and args.sarif != "-":
+        # probe the artifact path BEFORE the run: an unwritable --sarif
+        # target is a CONFIG error (exit 2), and discovering it after a
+        # ~12s twelve-rule pass wastes the whole run
+        try:
+            with open(args.sarif, "a"):
+                pass
+        except OSError as e:
+            print(f"error: --sarif {args.sarif}: {e}", file=sys.stderr)
+            return 2
 
-    only: "set[str] | None" = None
+    partial = bool(args.paths) or args.changed
+    if args.prune_baseline:
+        # refused up front — staleness is only meaningful over the full
+        # tree, so there is no point paying for a partial run first
+        if partial:
+            print(
+                "error: --prune-baseline needs a full run (no paths, no "
+                "--changed) — a partial run cannot tell stale from "
+                "unvisited", file=sys.stderr,
+            )
+            return 2
+        if args.no_baseline:
+            print(
+                "error: --prune-baseline without a baseline in effect",
+                file=sys.stderr,
+            )
+            return 2
+
+    repo = RepoCtx(str(REPO))
+
+    # positional paths scope the run exactly like --changed: per-file
+    # rules parse and walk just the named files, while repo-level rules
+    # (the registry-driven LT004/LT005/LT009/LT011 and the call-graph
+    # family) still see the whole tree — a one-file run must not
+    # misread PURE_MACHINES/SEAMS as drifted merely because the
+    # machines were outside the file list
+    only: "set[str] | None" = set(files) if files is not None else None
     if args.changed:
-        only = changed_files(REPO)
-        if only is None:
+        changed = changed_files(REPO)
+        if changed is None:
             print(
                 "warning: git unavailable; --changed falling back to a "
                 "full run", file=sys.stderr,
             )
+        else:
+            only = changed if only is None else (only & changed)
 
     baseline = None
     if not args.no_baseline:
@@ -270,21 +314,13 @@ def main(argv: "list[str] | None" = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    partial = bool(args.paths) or only is not None
-    if partial:
+    if only is not None:
         # partial runs trivially leave other files' baseline entries
         # unmatched — staleness is only meaningful over the full tree
         report["unused_baseline"] = []
 
     if args.prune_baseline:
-        if partial:
-            print(
-                "error: --prune-baseline needs a full run (no paths, no "
-                "--changed) — a partial run cannot tell stale from "
-                "unvisited", file=sys.stderr,
-            )
-            return 2
-        if args.no_baseline or baseline is None:
+        if baseline is None:
             print(
                 "error: --prune-baseline without a baseline in effect",
                 file=sys.stderr,
@@ -299,8 +335,15 @@ def main(argv: "list[str] | None" = None) -> int:
         )
         report["unused_baseline"] = []
 
+    # the per-file walk count: scoped runs report their scope, not the
+    # tree the repo-level rules happened to consult
+    n_checked = (
+        len(repo.py_files) if only is None
+        else len(only & set(repo.py_files))
+    )
+
     if args.sarif:
-        sarif = sarif_report(report, len(repo.py_files))
+        sarif = sarif_report(report, n_checked)
         if args.sarif == "-":
             print(json.dumps(sarif, indent=2))
         else:
@@ -326,7 +369,7 @@ def main(argv: "list[str] | None" = None) -> int:
                 ],
                 "noqa_suppressed": report["noqa_suppressed"],
                 "unused_baseline": report["unused_baseline"],
-                "files_checked": len(repo.py_files),
+                "files_checked": n_checked,
             },
             indent=2,
         ))
@@ -342,7 +385,7 @@ def main(argv: "list[str] | None" = None) -> int:
         print(
             f"lt-lint: {len(findings)} finding(s), {n_base} baselined, "
             f"{report['noqa_suppressed']} noqa-suppressed over "
-            f"{len(repo.py_files)} files",
+            f"{n_checked} files",
             # SARIF-on-stdout owns stdout; the human summary moves aside
             file=sys.stderr if args.sarif == "-" else sys.stdout,
         )

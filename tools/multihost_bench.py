@@ -142,9 +142,9 @@ def main() -> int:
             "agreement is expected ~1.0 but not bit-contractual"
         ),
     }
-    with open(args.out, "w") as f:
-        json.dump(rec, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(args.out, rec)
     print(json.dumps(rec, indent=2))
     shutil.rmtree(args.workroot, ignore_errors=True)
     return 0

@@ -1303,8 +1303,14 @@ def export_trace(spans: list[dict], hosts: list[dict], out_path: str) -> int:
                 "ph": "C", "pid": s["file"], "tid": 0, "name": s["name"],
                 "ts": ts, "args": s.get("args", {}),
             })
-    with open(out_path, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(
+        out_path,
+        {"traceEvents": events, "displayTimeUnit": "ms"},
+        indent=None,
+        trailing_newline=False,
+    )
     return len(events)
 
 

@@ -216,9 +216,9 @@ def main() -> int:
         "fitted_abs_delta_p99_max": max(fitted_delta_p99),
         "elapsed_s": round(time.time() - t0, 1),
     }
-    with open(out_path, "w") as f:
-        json.dump(record, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, record)
     print(json.dumps(record, indent=2))
     return 0
 

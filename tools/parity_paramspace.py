@@ -150,9 +150,9 @@ def main() -> int:
         "mismatches": mismatches,
         "elapsed_s": round(time.time() - t0, 1),
     }
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, rec)
     print(json.dumps({k: rec[k] for k in ("pixels_total", "exact_rate", "elapsed_s")}))
     return 0 if exact == total else 1
 

@@ -569,12 +569,17 @@ def _synth_fleet_snaps(directory: str, now: float) -> dict:
             "state": {"progress": {"phase": "pipeline", "tiles_done": tiles}},
         }
         p = _os.path.join(directory, f"fleet-host-{i:02d}.1000.snap.json")
-        with open(p, "w") as f:
+        # synthetic aggregator fixtures, not durable artifacts: the very
+        # next block plants a deliberately TORN sibling, so the pair
+        # stays plain writes
+        with open(p, "w") as f:  # lt: noqa[LT012]
             f.write(_json.dumps(snap, separators=(",", ":")))
         # mtime pinned to the snapshot's own stamp: staleness is judged
         # on the FRESHER of t_wall and the shared-FS mtime, and the
         # synthetic `now` is decoupled from the real clock
         _os.utime(p, (snap["t_wall"], snap["t_wall"]))
+    # lt: noqa[LT012] — a torn snapshot IS the fixture: the aggregator
+    # leg asserts it is flagged corrupt without crashing the fold
     with open(_os.path.join(directory, "torn-host.999.snap.json"), "w") as f:
         f.write('{"schema": 1, "host": "torn-host", "pid": 999, "t_wa')
     return {
@@ -1000,6 +1005,54 @@ def run_capacity_leg(workdir: str, check) -> None:
     )
 
 
+def run_lint_leg(workdir: str, check) -> None:
+    """lt-lint leg: the tree must be clean (zero unbaselined findings)
+    and the full twelve-rule run must stay inside its wall-time budget.
+
+    Both checks are structural, not banded: a finding that is neither
+    noqa'd nor baselined-with-a-reason is a regression exactly like a
+    failed parity flag, and a run that blows ``LINT_BUDGET_S`` means an
+    interprocedural pass went quadratic — the same gate tier-1 applies
+    via ``tests/test_lint.py::test_repo_tree_is_clean``, enforced here
+    too so a perf-gate-only CI lane cannot ship lint drift."""
+    import subprocess
+    import time as _time
+
+    from lt_lint import LINT_BUDGET_S
+
+    t0 = _time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lt_lint.py"), "--json"],
+        capture_output=True, text=True, cwd=str(REPO),
+        timeout=LINT_BUDGET_S * 4,
+    )
+    elapsed = _time.monotonic() - t0
+    try:
+        report = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        check(
+            "lint.clean", False,
+            f"lt_lint --json exited {proc.returncode} with unparseable "
+            f"output: {proc.stderr.strip()[:200]}",
+        )
+        return
+    findings = report.get("findings", [])
+    check(
+        "lint.clean",
+        proc.returncode == 0 and report.get("clean") is True and not findings,
+        f"{len(findings)} unbaselined finding(s) over "
+        f"{report.get('files_checked')} files "
+        f"({len(report.get('baselined', []))} baselined, "
+        f"{report.get('noqa_suppressed')} noqa-suppressed)",
+    )
+    check(
+        "lint.budget",
+        elapsed < LINT_BUDGET_S,
+        f"full twelve-rule run took {elapsed:.1f}s vs "
+        f"{LINT_BUDGET_S:.0f}s budget",
+    )
+
+
 def run_gate(
     workdir: str, checks: list, scheduler: bool = True, router: bool = True
 ) -> None:
@@ -1225,6 +1278,11 @@ def run_gate(
             f"{got['overhead_pct']}%) vs documented noise band {band}% "
             f"(committed {base['overhead_min_pct']}%)",
         )
+
+    # LAST on purpose: the lint subprocess is ~12s of pure CPU churn,
+    # and the flight leg's overhead micro-bench must not inherit a
+    # warm-throttled cgroup from it
+    run_lint_leg(workdir, check)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,12 +1,14 @@
 #!/bin/sh
 # Pre-commit gate (README §Failure semantics / §Static analysis):
 #
-#   1. tools/lt_lint.py --changed  — the eight LT AST invariant rules over
-#      files modified vs HEAD (repo-level rules — LT004/LT005 coupling,
-#      LT006-LT008 interprocedural — run whenever one of their sources
-#      changed).  A SARIF 2.1.0 log lands at $LT_LINT_SARIF (default
-#      .git/lt-lint.sarif, untracked) so CI annotators can consume the
-#      findings without parsing our JSON;
+#   1. tools/lt_lint.py --changed  — the twelve LT AST invariant rules
+#      over files modified vs HEAD (repo-level rules — LT004/LT005
+#      coupling, LT006-LT008 interprocedural, LT009 replay purity and
+#      LT011 seam coverage registries — run whenever one of their
+#      sources changed).  A SARIF 2.1.0 log declaring all twelve rules
+#      lands at $LT_LINT_SARIF (default .git/lt-lint.sarif, untracked)
+#      so CI annotators can consume the findings without parsing our
+#      JSON;
 #   2. tools/check_events_schema.py over the COMMITTED event-stream
 #      fixtures under tests/ (*.events.jsonl) — a fixture drifting from
 #      the current schema (a renamed/removed field, a new required one)

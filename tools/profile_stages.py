@@ -119,8 +119,9 @@ def main() -> int:
     if os.environ.get("LT_PROFILE_DUMP_HLO"):
         # the optimized HLO the Pallas decision rule inspects for layout/
         # copy/transpose fusions (ops/segment.py "TPU-profile trigger")
-        with open(out_path + ".hlo.txt", "w") as f:
-            f.write(compiled.as_text())
+        from tools._measure import write_text_atomic
+
+        write_text_atomic(out_path + ".hlo.txt", compiled.as_text())
         print(f"profile_stages: HLO dumped to {out_path}.hlo.txt", file=sys.stderr)
     scope_map = build_scope_map(compiled.as_text(), tuple(STAGE_SCOPES))
     print(
@@ -192,9 +193,9 @@ def main() -> int:
         },
         "runtime_overhead_s": round(runtime_s, 4),
     }
-    with open(out_path, "w") as f:
-        json.dump(record, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, record)
     print(json.dumps(record, indent=2))
     return 0
 

@@ -216,8 +216,9 @@ def run_bench(smoke: bool, out_path: "str | None", keep: "str | None" = None) ->
         checked.pop("group_speedup_floor_met", None)
     report["ok"] = all(checked.values())
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(report, f, indent=2)
+        from tools._measure import write_json_atomic
+
+        write_json_atomic(out_path, report, trailing_newline=False)
     return report
 
 

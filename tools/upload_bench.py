@@ -430,9 +430,9 @@ def main(argv: list[str] | None = None) -> int:
             blockcache.configure(0, None)
             shutil.rmtree(tmp, ignore_errors=True)
 
-    with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(args.out, result)
     print(json.dumps(result, indent=2))
     return 0
 

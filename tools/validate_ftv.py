@@ -120,9 +120,9 @@ def main() -> int:
               file=sys.stderr)
 
     report["pass"] = ok
-    with open(out_path, "w") as f:
-        json.dump(report, f, indent=2)
-        f.write("\n")
+    from tools._measure import write_json_atomic
+
+    write_json_atomic(out_path, report)
     print(json.dumps(report))
     return 0 if ok else 1
 
