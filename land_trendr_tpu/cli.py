@@ -44,6 +44,13 @@ def _auto_int(s: str):
     return s if s == "auto" else int(s)
 
 
+def _sigterm_to_interrupt(signum, frame):
+    """SIGTERM → KeyboardInterrupt: the long-running servers drain on
+    an orchestrator stop exactly like Ctrl-C (`lt route` writes its
+    journal clean-shutdown marker on this path)."""
+    raise KeyboardInterrupt
+
+
 def _add_param_flags(p: argparse.ArgumentParser) -> None:
     g = p.add_argument_group("algorithm parameters (reference names)")
     g.add_argument("--params-json", type=str, default=None,
@@ -686,6 +693,15 @@ def build_parser() -> argparse.ArgumentParser:
                      "recent terminal requests (trace id, router blame "
                      "split, hops) GET /debug/requests serves "
                      "slowest-first; 0 disables the ring")
+    rte.add_argument("--no-journal", action="store_true",
+                     help="disable the write-ahead admission journal "
+                     "(WORKDIR/journal/): no crash recovery, no "
+                     "idempotent resubmission — bench baselines only")
+    rte.add_argument("--journal-segment-mb", type=int, default=4,
+                     metavar="MB",
+                     help="journal segment rotation size; rotation "
+                     "compacts the fully-terminal segment prefix so "
+                     "replay cost stays bounded by the live working set")
     rte.add_argument("--decision-log", action="store_true",
                      help="record every dispatcher/autoscaler decision "
                      "to WORKDIR/decisions.jsonl — the capacity "
@@ -1237,6 +1253,8 @@ def main(argv: list[str] | None = None) -> int:
                 telemetry_dir=args.telemetry_dir,
                 metrics_interval_s=args.metrics_interval_s,
                 request_ring=args.request_ring,
+                journal=not args.no_journal,
+                journal_segment_mb=args.journal_segment_mb,
                 decision_log=args.decision_log,
                 fault_schedule=args.fault_schedule,
             )
@@ -1274,6 +1292,13 @@ def main(argv: list[str] | None = None) -> int:
             ),
             flush=True,
         )
+        # SIGTERM (the orchestrator's stop signal) drains exactly like
+        # Ctrl-C: serve_forever's finally runs _shutdown, which writes
+        # the journal's clean marker after a full drain — a SIGTERM'd
+        # router restarts without reconciliation probes
+        import signal as _signal
+
+        _signal.signal(_signal.SIGTERM, _sigterm_to_interrupt)
         try:
             router.serve_forever()
         except KeyboardInterrupt:

@@ -10,12 +10,15 @@ pool on the fleet telemetry plane's SLO burn-rate signal.  See
 
 from land_trendr_tpu.fleet.autoscale import Autoscaler
 from land_trendr_tpu.fleet.config import RouterConfig, parse_tenant_weights
+from land_trendr_tpu.fleet.journal import AdmissionJournal, JournalError
 from land_trendr_tpu.fleet.router import DOWN_REASONS, FleetRouter, RouterJob
 
 __all__ = [
+    "AdmissionJournal",
     "Autoscaler",
     "DOWN_REASONS",
     "FleetRouter",
+    "JournalError",
     "RouterConfig",
     "RouterJob",
     "parse_tenant_weights",

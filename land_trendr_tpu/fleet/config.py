@@ -153,6 +153,17 @@ class RouterConfig:
     #: serves, slowest-first; the ``/metrics/exemplars`` JSON is the
     #: machine half of the same loop.  0 disables the ring.
     request_ring: int = 64
+    #: write-ahead admission journal under ``<workdir>/journal/``: every
+    #: accepted job is durably recorded BEFORE the client sees 200, and
+    #: a restart on the same workdir replays it — queues rebuilt in
+    #: admission order, non-terminal jobs reconciled against their
+    #: replicas, duplicates deduplicated by idempotency key.  Off trades
+    #: crash-safety for zero admission-path I/O (bench baselines only).
+    journal: bool = True
+    #: journal segment rotation size, MiB; at rotation (and restart) the
+    #: fully-terminal segment prefix is compacted away, bounding replay
+    #: cost by the live working set
+    journal_segment_mb: int = 4
     #: record every dispatcher/autoscaler decision (inputs AND outputs)
     #: to ``<workdir>/decisions.jsonl`` — the capacity planner's replay
     #: source (``land_trendr_tpu.fleet.capacity``); off by default: the
@@ -249,6 +260,10 @@ class RouterConfig:
         if self.request_ring < 0:
             raise ValueError(
                 f"request_ring={self.request_ring} must be >= 0 (0 = off)"
+            )
+        if self.journal_segment_mb < 1:
+            raise ValueError(
+                f"journal_segment_mb={self.journal_segment_mb} must be >= 1"
             )
         if self.fault_schedule is not None:
             # parse NOW: a typo'd seam is a config error at startup (the

@@ -35,14 +35,32 @@ through ``/healthz``, and shards submitted jobs across them:
   routes, its in-flight jobs finish, then SIGINT gives the ``lt
   serve`` process its documented clean shutdown — manifests stay
   resumable throughout.
+* **crash-safe admission** — every accepted job is appended to a
+  write-ahead journal (:class:`~land_trendr_tpu.fleet.journal.
+  AdmissionJournal`) BEFORE the client sees 200, with ``forwarded`` and
+  ``terminal`` records following.  A restart on the same workdir
+  replays the journal (queues rebuilt in admission order, duplicate
+  idempotency keys answered with the existing job), re-adopts live
+  spawned replicas from ``replicas/*/replica.json`` + ``/healthz``,
+  and reconciles each non-terminal job against its replica: terminal →
+  relay the result, running → re-attach, unknown → requeue with the
+  pinned workdir so the resumed run completes byte-identically under
+  the preserved trace id.  Submissions during the reconciliation
+  window answer 503 + Retry-After; an uninterrupted drain leaves a
+  clean-shutdown marker so the next start skips the probes.
 
 Failure semantics: a failed forward (``router.forward`` seam) or a
 dead/unready replica re-enters the job into its tenant queue (bounded
 by ``route_retries``); a health-probe failure (``replica.health``
 seam) marks the replica unready WITHOUT failing any accepted job — its
-jobs keep polling and finish wherever they run.  The router's own
-telemetry (``route_decision`` / ``replica_up`` / ``replica_down`` /
-``tenant_throttled`` / ``scale_decision`` events, ``lt_router_*``
+jobs keep polling and finish wherever they run.  A journal append
+failure at admission (``router.journal`` seam) fails THAT submission
+loudly (503 ``journal_error``) rather than accept a job a crash would
+orphan; a reconciliation probe failure (``router.recover`` seam)
+requeues the replayed job — resume makes the fallback safe.  The
+router's own telemetry (``route_decision`` / ``replica_up`` /
+``replica_down`` / ``tenant_throttled`` / ``scale_decision`` /
+``journal_append`` / ``router_recovered`` events, ``lt_router_*``
 metrics) rides the normal schema/registry, so schema lint,
 ``obs_report``, ``lt top`` and ``lt_fleet`` cover the routing plane
 like every other subsystem.
@@ -69,6 +87,7 @@ from typing import Any
 
 from land_trendr_tpu.fleet.autoscale import Autoscaler
 from land_trendr_tpu.fleet.config import RouterConfig, parse_tenant_weights
+from land_trendr_tpu.fleet.journal import AdmissionJournal, JournalError
 from land_trendr_tpu.fleet.scheduling import (
     DECISIONS_NAME,
     DecisionLog,
@@ -131,6 +150,14 @@ def _http_json(
             return e.code, {}
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
 @dataclasses.dataclass
 class RouterJob:
     """One accepted job's router-side record (mutated under the router
@@ -150,6 +177,10 @@ class RouterJob:
     #: the request-tracing correlation id, minted at router admission
     #: and carried through every forward payload (re-routes keep it)
     trace_id: str = ""
+    #: the client's resubmission token, remembered in the admission
+    #: journal: a duplicate submission (before OR after a router
+    #: restart) returns THIS job instead of double-running
+    idempotency_key: "str | None" = None
     #: forward attempts so far (1 = first route; > 1 = re-routed).
     #: NOT the trace's hop count: a replica-side 429 deliberately
     #: refunds the attempt (saturation is not a route failure), so the
@@ -202,6 +233,8 @@ class RouterJob:
             "workdir": self.workdir,
             "out_dir": self.out_dir,
         }
+        if self.idempotency_key is not None:
+            out["idempotency_key"] = self.idempotency_key
         if self.error is not None:
             out["error"] = self.error
         if self.snap is not None:
@@ -233,6 +266,9 @@ class _Replica:
         )
         #: router job ids currently routed here
         self.inflight: "set[str]" = set()
+        #: a re-adopted replica's recorded pid (the previous router
+        #: incarnation spawned it; this one owns no Popen handle)
+        self.adopted_pid: "int | None" = None
         self.fails = 0
         self.last_health: "dict | None" = None
         self.last_health_t: "float | None" = None
@@ -455,6 +491,42 @@ class _RouterTelemetry:
             "job_rejected", reason=reason, queue_depth=queue_depth
         )
 
+    def journal_append(
+        self, rec: str, segment: int, nbytes: int,
+        job_id: "str | None" = None, trace_id: "str | None" = None,
+    ) -> None:
+        """One durably-committed admission-journal record."""
+        fields: dict = {}
+        if job_id:
+            fields["job_id"] = job_id
+        if trace_id:
+            fields["trace_id"] = trace_id
+        self.events.emit(
+            "journal_append",
+            rec=rec,
+            segment=segment,
+            bytes=nbytes,
+            **fields,
+        )
+
+    def router_recovered(
+        self, replayed: int, relayed: int, requeued: int,
+        reattached: int, deduped: int, recovery_s: float, clean: bool,
+    ) -> None:
+        """The restart-reconciliation summary: every replayed
+        non-terminal job landed in exactly one of relay / re-attach /
+        requeue (the value lint pins the arithmetic)."""
+        self.events.emit(
+            "router_recovered",
+            replayed=replayed,
+            relayed=relayed,
+            requeued=requeued,
+            reattached=reattached,
+            deduped=deduped,
+            recovery_s=round(max(0.0, recovery_s), 6),
+            clean=bool(clean),
+        )
+
     # the capacity rig's emitters, borrowed from the serve Telemetry
     # bundle (they only touch ``self.events``): the load runner and
     # sweep analyzer report through whichever plane drives them, and
@@ -595,6 +667,20 @@ class FleetRouter:
         self._seq = 0
         self._rid_seq = 0
         self._stopping = False
+        #: recovery-window gate: while a restarted router reconciles
+        #: its journal, submissions answer 503 + Retry-After
+        self._recovering = False
+        #: idempotency-key → job_id (journal-replayed: survives restarts)
+        self._idempotency: "dict[str, str]" = {}
+        #: replayed non-terminal jobs awaiting reconciliation, in
+        #: admission order: (job, folded journal record)
+        self._pending_recovery: "list[tuple[RouterJob, dict]]" = []
+        #: set by _replay_journal when the journal held any state —
+        #: {"replayed": n, "deduped": keys_restored}
+        self._replay_stats: "dict | None" = None
+        #: the last recovery's summary (stats() serves it; lt top
+        #: renders the RECOVERY line from it)
+        self.recovery: "dict | None" = None
         self.pool: "list[_Replica]" = []
         #: recent TERMINAL requests (trace id, router blame split,
         #: hops) — the /debug/requests window, newest last, bounded
@@ -622,6 +708,7 @@ class FleetRouter:
         # callable from any depth of a failed construction (LT008)
         self.telemetry: "_RouterTelemetry | None" = None
         self._decisions: "DecisionLog | None" = None
+        self._journal: "AdmissionJournal | None" = None
         self._fault_plan = None
         self._httpd = None
         self._http_thread = None
@@ -664,6 +751,16 @@ class FleetRouter:
                     "router fault injection ACTIVE (%s) — this is a "
                     "soak run", cfg.fault_schedule,
                 )
+            if cfg.journal:
+                # the journal opens AFTER the fault plan activates (its
+                # appends fire the router.journal seam) and replays
+                # BEFORE any admission can land
+                self._journal = AdmissionJournal(
+                    os.path.join(cfg.workdir, "journal"),
+                    segment_bytes=cfg.journal_segment_mb * 2 ** 20,
+                )
+                self._replay_journal()
+            self._readopt_replicas()
             for base in cfg.replicas:
                 self._adopt_replica(base)
             if cfg.spawn_replicas:
@@ -688,6 +785,11 @@ class FleetRouter:
                 daemon=True,
             )
             self._control_thread.start()
+            # reconciliation runs with the front door ALREADY serving
+            # (503 + Retry-After during the window): by the time the
+            # constructor returns, replayed jobs are relayed,
+            # re-attached, or requeued-with-resume
+            self._recover()
         except BaseException:
             self._shutdown(status="aborted")
             raise
@@ -773,6 +875,7 @@ class FleetRouter:
             )
         with self._lock:
             replica.base = f"http://127.0.0.1:{int(startup['port'])}"
+        self._persist_replica_meta(replica)
         self._probe_replica(replica)
 
     def _replica_log_tail(self, replica: _Replica, n: int = 2000) -> str:
@@ -785,6 +888,332 @@ class FleetRouter:
                 return f.read().decode(errors="replace")
         except OSError:
             return ""
+
+    def _persist_replica_meta(self, replica: _Replica) -> None:
+        """Record the spawned replica's base URL + pid (tmp + rename) so
+        a restarted router can re-adopt the still-running process."""
+        if not replica.workdir:
+            return
+        path = os.path.join(replica.workdir, "replica.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "base": replica.base,
+                        "pid": (
+                            replica.proc.pid if replica.proc is not None
+                            else replica.adopted_pid
+                        ),
+                    },
+                    f,
+                )
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning(
+                "replica meta persist failed for %s: %s", replica.rid, e
+            )
+
+    def _readopt_replicas(self) -> None:
+        """Re-adopt live spawned replicas a crashed router left behind:
+        scan ``replicas/*/replica.json``, keep the members whose
+        recorded pid is alive AND whose ``/healthz`` answers, under
+        their original rids.  The rid sequence advances past every
+        existing dir first, so fresh spawns never collide with a
+        re-adopted member's workdir."""
+        root = os.path.join(self.cfg.workdir, "replicas")
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            return
+        with self._lock:
+            for name in names:
+                if name.startswith("r") and name[1:].isdigit():
+                    self._rid_seq = max(self._rid_seq, int(name[1:]) + 1)
+        for name in names:
+            rdir = os.path.join(root, name)
+            try:
+                with open(
+                    os.path.join(rdir, "replica.json"), encoding="utf-8"
+                ) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            base, pid = meta.get("base"), meta.get("pid")
+            if not isinstance(base, str) or not isinstance(pid, int):
+                continue
+            if not _pid_alive(pid):
+                continue
+            try:
+                status, _body = _http_json("GET", base + "/healthz")
+            except Exception:
+                continue
+            if status != 200:
+                continue
+            replica = _Replica(
+                name, base, spawned=True, proc=None, workdir=rdir
+            )
+            replica.adopted_pid = pid
+            with self._lock:
+                self.pool.append(replica)
+            self._probe_replica(replica)
+            log.info(
+                "re-adopted replica %s at %s (pid %d)", name, base, pid
+            )
+
+    # -- crash recovery (journal replay + reconciliation) ------------------
+    def _replay_journal(self) -> None:
+        """Fold the journal into the job table: terminal jobs
+        re-register (status GETs and idempotency dedupe keep answering
+        across the restart), non-terminal ones queue for reconciliation
+        in admission order."""
+        folded = self._journal.replay()
+        if not folded:
+            return
+        pending: "list[tuple[RouterJob, dict]]" = []
+        keys = 0
+        with self._lock:
+            for jid, rec in folded.items():
+                payload = rec.get("payload")
+                if not isinstance(payload, dict):
+                    continue
+                job = RouterJob(
+                    job_id=jid,
+                    payload=payload,
+                    tenant=str(rec.get("tenant") or "default"),
+                    priority=int(rec.get("priority") or 0),
+                    key=str(rec.get("key") or ""),
+                    workdir=str(rec.get("workdir") or ""),
+                    out_dir=str(rec.get("out_dir") or ""),
+                    source=str(rec.get("source") or "journal"),
+                    trace_id=str(rec.get("trace_id") or jid),
+                )
+                ikey = rec.get("idempotency_key")
+                if isinstance(ikey, str) and ikey:
+                    job.idempotency_key = ikey
+                    self._idempotency[ikey] = jid
+                    keys += 1
+                t = rec.get("t")
+                if isinstance(t, (int, float)):
+                    job.submitted_t = float(t)
+                if rec["status"] == "terminal":
+                    job.state = str(rec.get("state") or "error")
+                    job.error = rec.get("error")
+                    self._terminal += 1
+                else:
+                    job.replica_job_id = rec.get("replica_job_id")
+                    if rec["status"] == "forwarded":
+                        # one forward happened in the previous life —
+                        # the trace's hop ordinal continues from it
+                        job.attempts = job.hops = 1
+                    pending.append((job, rec))
+                self._jobs[jid] = job
+            self._pending_recovery = pending
+            self._replay_stats = {"replayed": len(pending), "deduped": keys}
+            self._recovering = bool(pending)
+        log.info(
+            "journal replay: %d job(s), %d non-terminal to reconcile",
+            len(folded), len(pending),
+        )
+
+    def _recover(self) -> None:
+        """Reconcile every replayed non-terminal job against the pool;
+        the recovery-window 503 lifts when this returns.  Per job:
+        terminal at its replica (status poll, or the durable
+        ``jobs/<id>/result.json`` of a dead spawned replica) → relay
+        the result; still running → re-attach (the poll loop takes
+        over); unknown/unreachable (or an injected ``router.recover``
+        fault) → requeue with the pinned workdir, so the resumed run
+        completes byte-identically under the preserved trace id."""
+        with self._lock:
+            pending = self._pending_recovery
+            self._pending_recovery = []
+        if self._journal is None or self._replay_stats is None:
+            with self._lock:
+                self._recovering = False
+            return
+        t0 = time.perf_counter()
+        counts = {"relayed": 0, "requeued": 0, "reattached": 0}
+        try:
+            for job, rec in pending:
+                if self.telemetry is not None:
+                    # re-introduce the trace id in THIS run's stream
+                    # before any span can land under it
+                    with self._lock:
+                        depth = self._drr.depth
+                    self.telemetry.job_submitted(job, depth)
+                try:
+                    outcome = self._reconcile_job(job, rec)
+                except Exception as e:
+                    log.warning(
+                        "reconciliation of %s failed (%s); requeue+resume",
+                        job.job_id, e,
+                    )
+                    outcome = self._requeue_recovered(job)
+                counts[outcome] += 1
+        finally:
+            with self._lock:
+                self._recovering = False
+                self._cond.notify_all()
+            summary = {
+                "replayed": self._replay_stats["replayed"],
+                "deduped": self._replay_stats["deduped"],
+                "recovery_s": round(time.perf_counter() - t0, 6),
+                "clean": bool(self._journal.was_clean),
+                **counts,
+            }
+            self.recovery = summary
+            if self.telemetry is not None:
+                self.telemetry.router_recovered(**summary)
+            log.info("recovery complete: %s", summary)
+        try:
+            # compaction now bounds the NEXT restart's replay
+            self._journal.compact()
+        except (OSError, JournalError) as e:
+            log.warning("journal compaction failed: %s", e)
+
+    def _reconcile_job(self, job: RouterJob, rec: dict) -> str:
+        """One job's reconciliation; returns its outcome bucket
+        (``relayed`` | ``reattached`` | ``requeued``)."""
+        if self._journal.was_clean:
+            # an uninterrupted drain left nothing running: route without
+            # probing (a drained restart normally has no pending jobs at
+            # all — this is the belt under that suspender)
+            return self._requeue_recovered(job)
+        try:
+            faults.check("router.recover")
+            replica, snap, p0, p1 = self._probe_recovered(rec)
+        except Exception as e:
+            log.warning(
+                "recovery probe for %s failed (%s); requeue+resume",
+                job.job_id, e,
+            )
+            return self._requeue_recovered(job)
+        if snap is None:
+            return self._requeue_recovered(job)
+        terminal = snap.get("state") in TERMINAL_STATES
+        if not terminal and replica is None:
+            return self._requeue_recovered(job)
+        with self._lock:
+            job.snap = snap
+            job.state = "routed"
+            job.routed_t = time.time()
+            if replica is not None:
+                job.replica = replica.rid
+            if terminal:
+                # the probe that answered IS the result relay
+                job.blame_acc["relay"] += max(0.0, p1 - p0)
+            else:
+                replica.inflight.add(job.job_id)
+        if terminal:
+            if self.telemetry is not None and replica is not None:
+                self.telemetry.request_span(
+                    job, "relay", p0, p1, replica=replica.rid,
+                )
+            self._finish_job(
+                job, snap["state"], snap.get("error"),
+                from_replica=replica, snap=snap,
+            )
+            return "relayed"
+        log.info(
+            "re-attached %s to %s (replica job %s)",
+            job.job_id, job.replica, job.replica_job_id,
+        )
+        return "reattached"
+
+    def _probe_recovered(
+        self, rec: dict
+    ) -> "tuple[_Replica | None, dict | None, float, float]":
+        """Ask the journal's recorded replica what became of a job;
+        falls back to the dead spawned replica's durable
+        ``jobs/<id>/result.json``.  Returns ``(replica, snap, p0, p1)``
+        with ``snap=None`` for unknown."""
+        base = rec.get("replica_base")
+        rjid = rec.get("replica_job_id")
+        p0 = p1 = time.perf_counter()
+        if not base or not rjid:
+            return None, None, p0, p1  # never forwarded: plain requeue
+        with self._lock:
+            replica = next(
+                (
+                    r for r in self.pool
+                    if r.base == base and r.state != "stopped"
+                ),
+                None,
+            )
+        if replica is not None:
+            status, snap = _http_json("GET", f"{replica.base}/jobs/{rjid}")
+            p1 = time.perf_counter()
+            if status == 200 and isinstance(snap, dict):
+                return replica, snap, p0, p1
+            return replica, None, p0, p1
+        snap = self._result_from_disk(rjid)
+        p1 = time.perf_counter()
+        return None, snap, p0, p1
+
+    def _result_from_disk(self, rjid: str) -> "dict | None":
+        """A dead spawned replica's terminal verdict, if it got as far
+        as the atomic ``result.json`` write before dying."""
+        root = os.path.join(self.cfg.workdir, "replicas")
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            return None
+        for name in names:
+            path = os.path.join(root, name, "jobs", rjid, "result.json")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if (
+                isinstance(snap, dict)
+                and snap.get("state") in TERMINAL_STATES
+            ):
+                return snap
+        return None
+
+    def _requeue_recovered(self, job: RouterJob) -> str:
+        """Queue a replayed job for (re-)routing with a fresh retry
+        budget.  Back-enqueue ON PURPOSE: recovery iterates in admission
+        order into queues no new submission can reach (the 503 window),
+        so FIFO here IS front-of-line relative to post-recovery traffic
+        — a front-enqueue would reverse the replayed order instead."""
+        with self._lock:
+            job.state = "queued"
+            job.replica = None
+            job.replica_job_id = None
+            job.attempts = 0
+            job.poll_fails = 0
+            job.queue_enter_mono = time.perf_counter()
+            job.backoff_pending = False
+            self._enqueue_locked(job)
+            self._cond.notify_all()
+        return "requeued"
+
+    def _journal_record(self, kind: str, job: RouterJob, **fields) -> None:
+        """Append one journal record + its ``journal_append`` event.
+        ``admitted`` failures propagate (the admission must fail
+        loudly); ``forwarded``/``terminal`` failures degrade to a log
+        line — the job is already durable, and recovery treats a
+        missing record as unknown → requeue + resume."""
+        if self._journal is None:
+            return
+        try:
+            seg, nbytes = self._journal.append(kind, job.job_id, **fields)
+        except JournalError:
+            if kind == "admitted":
+                raise
+            log.warning(
+                "journal %s append failed for %s (recovery degrades to "
+                "requeue+resume)", kind, job.job_id,
+            )
+            return
+        if self.telemetry is not None:
+            self.telemetry.journal_append(
+                kind, seg, nbytes,
+                job_id=job.job_id, trace_id=job.trace_id,
+            )
 
     # -- admission ---------------------------------------------------------
     def submit(self, payload: dict, source: str = "http") -> dict:
@@ -807,9 +1236,28 @@ class FleetRouter:
         key = req.affinity_key()
         throttle = None
         snap = depth = job = None
+        dedup = False
         with self._lock:
             depth = self._drr.depth
-            if self._stopping:
+            prior = (
+                self._jobs.get(self._idempotency.get(req.idempotency_key))
+                if req.idempotency_key else None
+            )
+            if prior is not None:
+                # idempotent resubmission: the journal remembered the
+                # key (across restarts too) — answer with the EXISTING
+                # job instead of double-running, whatever else is going
+                # on (dedupe costs no queue slot, so no ladder applies)
+                snap = prior.status_locked()
+                snap["deduped"] = True
+                dedup = True
+            elif self._recovering:
+                throttle = (
+                    503, "recovering",
+                    "router is reconciling its admission journal after "
+                    "a restart; retry shortly",
+                )
+            elif self._stopping:
                 throttle = (503, "shutting_down", "router is draining")
             elif depth >= self.cfg.route_queue_depth:
                 throttle = (
@@ -830,7 +1278,7 @@ class FleetRouter:
                         f"the configured quota {self.cfg.tenant_quota}; "
                         "retry later",
                     )
-            if throttle is None:
+            if throttle is None and not dedup:
                 self._seq += 1
                 job_id = f"rt-{os.getpid()}-{self._seq:05d}"
                 job_root = os.path.join(self.cfg.workdir, "jobs", job_id)
@@ -852,14 +1300,50 @@ class FleetRouter:
                     out_dir=req.out_dir or os.path.join(job_root, "out"),
                     source=source,
                 )
+                job.idempotency_key = req.idempotency_key
+                # the WRITE-AHEAD contract: the admitted record commits
+                # BEFORE the job is registered or the client sees 200 —
+                # a job the journal cannot make durable is never
+                # admitted (503 journal_error), and a crash after this
+                # line replays the job instead of orphaning it
+                try:
+                    self._journal_record(
+                        "admitted", job,
+                        payload=job.payload,
+                        tenant=job.tenant,
+                        priority=job.priority,
+                        key=job.key,
+                        trace_id=job.trace_id,
+                        idempotency_key=job.idempotency_key,
+                        workdir=job.workdir,
+                        out_dir=job.out_dir,
+                        source=job.source,
+                        t=job.submitted_t,
+                    )
+                except JournalError as e:
+                    throttle = (
+                        503, "journal_error",
+                        f"admission journal append failed ({e}); the "
+                        "job was NOT accepted — retry later",
+                    )
+                    job = None
+            if job is not None and throttle is None and not dedup:
+                if job.idempotency_key:
+                    self._idempotency[job.idempotency_key] = job.job_id
                 # registered but NOT yet enqueued: the job becomes
                 # routable only after job_submitted is durably in the
                 # stream, or the dispatcher's first request_span could
                 # land ahead of the trace's introduction (the orphan
                 # the referential lint flags)
-                self._jobs[job_id] = job
+                self._jobs[job.job_id] = job
                 depth = self._drr.depth + 1  # the enqueue below joins it
                 snap = job.status_locked()
+        if dedup:
+            log.info(
+                "idempotent resubmission answered with %s (key=%s)",
+                snap["job_id"], req.idempotency_key,
+            )
+            return snap
         if throttle is not None:
             status, reason, detail = throttle
             log.warning(
@@ -955,6 +1439,12 @@ class FleetRouter:
                 if picked is None:
                     break
                 self._route_job(*picked)
+        except KeyboardInterrupt:
+            # Ctrl-C — and SIGTERM, which ``lt route`` maps here — IS
+            # the orchestrator's clean stop: keep status "ok" so
+            # _shutdown drains routed jobs and the journal earns its
+            # clean marker (a second interrupt aborts the drain itself)
+            pass
         except BaseException:
             status = "aborted"
             raise
@@ -1065,6 +1555,15 @@ class FleetRouter:
                 # (replica_job_id still None) had nowhere to go — honor
                 # it now that the replica id exists
                 relay_cancel = job.cancel_requested
+            # durable AFTER the replica accepted, BEFORE anything else:
+            # a crash past this line reconciles by asking THIS replica
+            self._journal_record(
+                "forwarded", job,
+                replica_base=replica.base,
+                replica_job_id=job.replica_job_id,
+                replica=replica.rid,
+                t=now,
+            )
             if relay_cancel:
                 try:
                     _http_json(
@@ -1230,6 +1729,10 @@ class FleetRouter:
                 "finished_t": job.finished_t,
             })
             self._cond.notify_all()
+        self._journal_record(
+            "terminal", job, state=state, error=job.error,
+            t=job.finished_t,
+        )
         log.info(
             "job %s %s in %.2fs%s",
             job.job_id, state, wall_s,
@@ -1296,6 +1799,13 @@ class FleetRouter:
         proc = replica.proc
         if proc is not None and proc.poll() is not None:
             self._replica_died(replica, f"process exited {proc.poll()}")
+            return
+        if (
+            proc is None
+            and replica.adopted_pid is not None
+            and not _pid_alive(replica.adopted_pid)
+        ):
+            self._replica_died(replica, "re-adopted process exited")
             return
         failed = False
         health: "dict | None" = None
@@ -1537,7 +2047,16 @@ class FleetRouter:
     @staticmethod
     def _stop_replica_proc(replica: _Replica) -> None:
         proc = replica.proc
-        if proc is None or proc.poll() is not None:
+        if proc is None:
+            # re-adopted after a restart: not our child — send the
+            # recorded pid the same documented clean shutdown
+            if replica.adopted_pid is not None:
+                try:
+                    os.kill(replica.adopted_pid, signal.SIGINT)
+                except OSError:
+                    pass
+            return
+        if proc.poll() is not None:
             return
         try:
             proc.send_signal(signal.SIGINT)
@@ -1649,12 +2168,17 @@ class FleetRouter:
                 "jobs_terminal": self._terminal,
                 "tenants": tenants,
                 "replicas": [r.row_locked() for r in self.pool],
+                "recovering": self._recovering,
+                "recovery": self.recovery,
                 # under the lock: scale_tick mutates the engine's alert
                 # state under this same lock, and the Autoscaler's
                 # single-owner contract is exactly that serialization
                 "scaler": self.scaler.state() if self.scaler else None,
             }
         snap["uptime_s"] = round(time.time() - self._t0, 3)
+        # the journal keeps its own (leaf) lock — read it outside ours
+        journal = self._journal
+        snap["journal"] = journal.stats() if journal is not None else None
         return snap
 
     def _fleet_probes(self) -> dict:
@@ -1734,13 +2258,36 @@ class FleetRouter:
         with self._lock:
             spawned = [r for r in self.pool if r.spawned]
         for replica in spawned:
-            alive = replica.proc is not None and replica.proc.poll() is None
+            alive = (
+                replica.proc is not None and replica.proc.poll() is None
+            ) or (
+                replica.proc is None
+                and replica.adopted_pid is not None
+                and _pid_alive(replica.adopted_pid)
+            )
             self._stop_replica_proc(replica)
             with self._lock:
                 was_stopped = replica.state == "stopped"
                 replica.state = "stopped"
             if alive and not was_stopped and self.telemetry is not None:
                 self.telemetry.replica_down(replica, "shutdown")
+        if self._journal is not None:
+            with self._lock:
+                all_terminal = all(
+                    j.state in TERMINAL_STATES
+                    for j in self._jobs.values()
+                ) and not self._pending_recovery
+            if status == "ok" and all_terminal:
+                # the clean-shutdown marker: the next start on this
+                # workdir skips reconciliation probes.  Only a FULLY
+                # drained stop earns it — anything non-terminal means
+                # the restart must reconcile.
+                try:
+                    self._journal.mark_clean()
+                except OSError as e:
+                    log.warning("clean-shutdown marker failed: %s", e)
+            self._journal.close()
+            self._journal = None
         if self._fault_plan is not None:
             faults.deactivate()
             self._fault_plan = None
@@ -1797,7 +2344,9 @@ class _RouterAPIHandler(http.server.BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
-        if status == 429:
+        if status in (429, 503):
+            # 503s are transient here too: recovery window, drain,
+            # journal hiccup — the client should come back
             self.send_header("Retry-After", "1")
         self.end_headers()
         self.wfile.write(body)

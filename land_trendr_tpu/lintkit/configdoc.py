@@ -66,6 +66,7 @@ ROUTE_FLAG_ALIASES: dict[str, tuple[str, ...]] = {
     "telemetry": ("no-telemetry",),
     "replicas": ("replica",),
     "affinity": ("no-affinity",),
+    "journal": ("no-journal",),
 }
 
 LOADGEN = "land_trendr_tpu/loadgen/config.py"

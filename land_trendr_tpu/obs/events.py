@@ -397,6 +397,35 @@ EVENT_FIELDS: dict[str, dict[str, Any]] = {
     # MEMBER's job_id/trace_id, so PR-15 blame attribution still
     # partitions each request exactly.  Additive event type.
     "batch_demux": {"tiles": int},
+    # --- crash-safe control plane (fleet/journal) ------------------------
+    # one durably committed admission-journal record: ``rec`` is the
+    # record kind (∈ journal.RECORD_KINDS — "admitted" / "forwarded" /
+    # "terminal"), ``segment`` the 1-based segment it landed in and
+    # ``bytes`` the committed line size (both >= 1 — the value lint
+    # pins them).  Emitted AFTER the os.write returns: an append the
+    # seam or the disk failed never produces this event (the 503
+    # ``journal_error`` rejection does not either — the job was never
+    # admitted).  Additive event type.
+    "journal_append": {"rec": str, "segment": int, "bytes": int},
+    # one router restart's recovery summary: ``replayed`` non-terminal
+    # jobs rebuilt from the journal, split into ``relayed`` (replica
+    # finished during the outage — result relayed from its terminal
+    # snapshot), ``requeued`` (replica gone — re-enqueued front-of-line
+    # with resume semantics) and the optional ``reattached`` (replica
+    # still running the job — polling resumed); the split sums to
+    # ``replayed`` (the value lint pins relayed + requeued [+
+    # reattached] <= replayed).  ``deduped`` counts idempotency keys
+    # restored to the dedupe table, ``clean`` whether the previous
+    # process wrote the clean-shutdown marker (probes skipped).
+    # Additive event type.
+    "router_recovered": {
+        "replayed": int,
+        "relayed": int,
+        "requeued": int,
+        "deduped": int,
+        "recovery_s": _NUM,
+        "clean": bool,
+    },
 }
 
 #: the request-span stage vocabulary, in journey order (open like
@@ -519,6 +548,7 @@ OPTIONAL_FIELDS: dict[str, dict[str, Any]] = {
         "window_wait_s": _NUM,
     },
     "batch_demux": {"member_jobs": int},
+    "router_recovered": {"reattached": int},
 }
 
 #: fields optional on EVERY event type — request-scoped threading the

@@ -74,6 +74,16 @@ Seams (the public contract — hosts call :func:`check` / :func:`fired` /
                     (``serve/batching``): that member stops receiving
                     demuxed tiles and recomputes them in its own run
                     (byte-identical); batch-mates are untouched
+``router.journal``  admission-journal append (``fleet/journal.py``): the
+                    record cannot be made durable, so THAT admission
+                    fails loudly (503 ``journal_error``) instead of
+                    accepting a job a crash would orphan; a resubmit
+                    after the fault clears completes normally
+``router.recover``  post-restart reconciliation probe (``fleet/router``):
+                    the replica answer is unavailable, so the replayed
+                    job is requeued front with ``resume=true`` — the
+                    pinned workdir resumes byte-identically under the
+                    preserved trace id; never a lost or doubled job
 =================== =======================================================
 
 Schedules are strings (CLI ``--fault-schedule``) or :class:`FaultSpec`
@@ -153,6 +163,8 @@ SEAMS = (
     "loadgen.tick",
     "batch.pack",
     "batch.demux",
+    "router.journal",
+    "router.recover",
 )
 
 #: error kinds that RAISE at the seam (vs behavioral kinds)
@@ -184,6 +196,8 @@ _DEFAULT_KIND = {
     "loadgen.tick": "fire",
     "batch.pack": "io",
     "batch.demux": "io",
+    "router.journal": "io",
+    "router.recover": "io",
 }
 
 

@@ -116,6 +116,12 @@ class JobRequest:
     #: Deliberately EXCLUDED from the affinity key — two requests that
     #: differ only in identity run the same programs.
     trace_id: "str | None" = None
+    #: client-chosen resubmission token: the fleet router remembers it in
+    #: the admission journal, so a duplicate submission (a retry after a
+    #: timed-out 200, before OR after a router restart) returns the
+    #: EXISTING job instead of double-running.  Like ``trace_id``,
+    #: identity only — excluded from the affinity key.
+    idempotency_key: "str | None" = None
 
     #: the per-run knobs the server owns (shared cache/store) or that
     #: cannot mean anything inside a server process — rejected even via
@@ -187,6 +193,11 @@ class JobRequest:
             not isinstance(req.trace_id, str) or not req.trace_id
         ):
             raise ValueError("trace_id must be a non-empty string")
+        if req.idempotency_key is not None and (
+            not isinstance(req.idempotency_key, str)
+            or not req.idempotency_key
+        ):
+            raise ValueError("idempotency_key must be a non-empty string")
         overrides = req.run_overrides or {}
         if not isinstance(overrides, dict):
             raise ValueError("run_overrides must be a JSON object")

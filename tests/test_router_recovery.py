@@ -1,0 +1,471 @@
+"""Crash-safe control plane tests (ISSUE 20): journal + router recovery.
+
+Pins the durable-admission contracts:
+
+* **journal semantics**: append-only JSONL segments fold to identical
+  per-job state across close/reopen; a torn final line (the only damage
+  an O_APPEND line-commit crash can inflict) is GC'd at reopen without
+  touching committed records, while garbage anywhere earlier raises
+  ``JournalError``; prefix compaction never drops a live job; the
+  clean-shutdown marker is consumed so only an uninterrupted drain
+  counts;
+* **write-ahead admission**: a ``router.journal`` append fault fails
+  the admission loudly — 503 ``journal_error``, the job is never
+  registered — and the resubmission lands normally;
+* **recovery window**: while the router reconciles its journal,
+  submissions answer 503 ``recovering`` (+ ``Retry-After`` at the HTTP
+  front door) but idempotent resubmissions still dedupe — answering
+  about an already-admitted job costs no queue slot;
+* **restart replay**: a cleanly-drained router leaves the marker; the
+  next incarnation re-registers terminal jobs so idempotency keys keep
+  deduping across the restart.  A crash journal forwarded to a DEAD
+  replica base requeues the job with its pinned workdir and the resumed
+  run completes byte-identically under the preserved trace id;
+* the ``journal_append`` / ``router_recovered`` value lints accept the
+  emitted shapes and reject kind/arithmetic violations.
+
+Scene shape and params are shared with ``tests/test_fleet_serve.py`` so
+the process-wide jit cache keeps in-process replicas warm.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from land_trendr_tpu.cli import _sigterm_to_interrupt
+from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+from land_trendr_tpu.fleet.journal import AdmissionJournal, JournalError
+from land_trendr_tpu.io.synthetic import SceneSpec, make_stack, write_stack
+from land_trendr_tpu.runtime import faults
+from land_trendr_tpu.serve import Rejection, SegmentationServer, ServeConfig
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+_PARAMS = {"max_segments": 4, "vertex_count_overshoot": 2}
+_TILE = 20
+
+
+@pytest.fixture(scope="module")
+def stack_dir(tmp_path_factory) -> str:
+    d = str(tmp_path_factory.mktemp("recovery_stack") / "stack")
+    write_stack(
+        d,
+        make_stack(
+            SceneSpec(width=40, height=40, year_start=2000, year_end=2008,
+                      seed=3)
+        ),
+    )
+    return d
+
+
+def _digest_workdir(workdir: str) -> dict:
+    out: dict = {}
+    for p in sorted(Path(workdir).glob("tile_*.npz")):
+        with np.load(p) as z:
+            out[p.name] = {
+                name: hashlib.sha256(
+                    np.ascontiguousarray(z[name]).tobytes()
+                ).hexdigest()
+                for name in sorted(z.files)
+            }
+    return out
+
+
+def _job(stack_dir: str, **kw) -> dict:
+    return {
+        "stack_dir": stack_dir,
+        "tile_size": _TILE,
+        "params": dict(_PARAMS),
+        "run_overrides": {"retry_backoff_s": 0.0},
+        **kw,
+    }
+
+
+def _await_terminal(router: FleetRouter, job_id: str,
+                    timeout_s: float = 300.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        s = router.job_status(job_id)
+        if s is not None and s["state"] not in ("queued", "routed"):
+            return s
+        time.sleep(0.05)
+    raise TimeoutError(f"job {job_id} not terminal within {timeout_s}s")
+
+
+def _events(workdir: str) -> list:
+    return [
+        json.loads(line)
+        for line in (Path(workdir) / "events.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+
+
+def _fold(journal: AdmissionJournal) -> str:
+    return json.dumps(journal.replay(), sort_keys=True)
+
+
+class _OneReplica:
+    """One in-process SegmentationServer on a thread."""
+
+    def __init__(self, tmp_path) -> None:
+        self.server = SegmentationServer(ServeConfig(
+            workdir=str(tmp_path / "replica"), feed_cache_mb=32,
+        ))
+        self.thread = threading.Thread(target=self.server.serve_forever)
+        self.thread.start()
+        self.bases = (f"http://127.0.0.1:{self.server.port}",)
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.thread.join(timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# journal unit semantics
+
+
+def test_journal_roundtrip_replays_identically(tmp_path):
+    root = str(tmp_path / "j")
+    j = AdmissionJournal(root)
+    assert j.was_clean is False  # no prior drain: nothing to consume
+    for i in range(3):
+        jid = f"job-{i}"
+        j.append("admitted", jid, payload={"n": i}, trace_id=f"t{i}")
+        j.append("forwarded", jid, replica_base="http://x",
+                 replica_job_id=f"r{i}")
+    j.append("terminal", "job-0", state="done", error=None)
+    folded = j.replay()
+    assert folded["job-0"]["status"] == "terminal"
+    assert folded["job-0"]["state"] == "done"
+    assert folded["job-1"]["status"] == "forwarded"
+    assert folded["job-1"]["replica_job_id"] == "r1"
+    assert folded["job-1"]["payload"] == {"n": 1}
+    st = j.stats()
+    assert st["appends"] == 7 and st["segments"] == 1
+    before = _fold(j)
+    j.close()
+    # a closed journal refuses appends rather than losing them silently
+    with pytest.raises(JournalError, match="closed"):
+        j.append("terminal", "job-1", state="done")
+    j2 = AdmissionJournal(root)
+    assert _fold(j2) == before, "fold must be stable across close/reopen"
+    j2.close()
+
+
+def test_journal_torn_tail_gc_and_corruption(tmp_path):
+    root = str(tmp_path / "j")
+    j = AdmissionJournal(root)
+    j.append("admitted", "keep-1", payload={})
+    before = _fold(j)
+    j.close()
+    seg = Path(root) / "seg-00000001.jsonl"
+    with open(seg, "ab") as f:
+        f.write(b'{"rec":"admitted","job_id":"torn-')  # mid-crash tear
+    j2 = AdmissionJournal(root)
+    assert _fold(j2) == before, "committed records must survive the GC"
+    assert "torn-" not in j2.replay()
+    j2.close()
+    assert seg.read_bytes().endswith(b"\n"), "tail rewritten line-clean"
+    # garbage BEFORE the final line is corruption, not crash residue
+    seg.write_bytes(b"not json\n" + seg.read_bytes())
+    with pytest.raises(JournalError, match="corrupt"):
+        AdmissionJournal(root)
+
+
+def test_journal_rotation_compaction_keeps_live_jobs(tmp_path):
+    root = str(tmp_path / "j")
+    j = AdmissionJournal(root, segment_bytes=1)  # floor clamps to 64KiB
+    j.append("admitted", "live-0", payload={})
+    i = 0
+    while j.stats()["segment"] < 3:  # force >= 2 rotations
+        jid = f"dead-{i:05d}"
+        j.append("admitted", jid, payload={"fill": "x" * 64})
+        j.append("terminal", jid, state="done")
+        i += 1
+    folded = j.replay()
+    assert folded["live-0"]["status"] == "admitted"
+    j.compact()
+    after = j.replay()
+    # live-0 pins segment 1, so prefix-only compaction drops NOTHING —
+    # replay order can never be reordered around a live admission
+    assert json.dumps(folded, sort_keys=True) == \
+        json.dumps(after, sort_keys=True)
+    assert j.stats()["segments"] >= 3
+    # terminal-ise the pin: now the fully-terminal prefix goes away
+    j.append("terminal", "live-0", state="done")
+    dropped = j.compact()
+    assert dropped >= 1
+    assert j.stats()["segments"] + dropped >= 3
+    assert all(
+        s["status"] == "terminal" for s in j.replay().values()
+    )
+    j.close()
+
+
+def test_journal_clean_marker_consumed_at_reopen(tmp_path):
+    root = str(tmp_path / "j")
+    j = AdmissionJournal(root)
+    j.mark_clean()
+    j.close()
+    assert (Path(root) / "clean").exists()
+    j2 = AdmissionJournal(root)
+    assert j2.was_clean is True
+    assert not (Path(root) / "clean").exists(), "marker must be consumed"
+    j2.close()
+    # the NEXT reopen (no new drain) must not still look clean
+    j3 = AdmissionJournal(root)
+    assert j3.was_clean is False
+    j3.close()
+
+
+def test_journal_append_fault_raises_journal_error(tmp_path):
+    j = AdmissionJournal(str(tmp_path / "j"))
+    faults.activate(faults.parse_schedule("seed=1,router.journal@0=io"))
+    try:
+        with pytest.raises(JournalError):
+            j.append("admitted", "a-1", payload={})
+    finally:
+        faults.deactivate()
+    assert j.stats()["appends"] == 0, "a failed append is NOT written"
+    j.append("admitted", "a-1", payload={})
+    assert j.replay()["a-1"]["status"] == "admitted"
+    j.close()
+
+
+# ---------------------------------------------------------------------------
+# write-ahead admission: journal fault → 503, job never admitted
+
+
+def test_journal_fault_503_then_resubmit_lands(stack_dir, tmp_path):
+    replica = _OneReplica(tmp_path)
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir, replicas=replica.bases, health_interval_s=0.2,
+        fault_schedule="seed=1,router.journal@0=io",
+    ))
+    rt_thread = threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    try:
+        with pytest.raises(Rejection) as exc:
+            router.submit(_job(stack_dir))
+        assert exc.value.http_status == 503
+        assert exc.value.reason == "journal_error"
+        assert router.jobs() == [], "an un-durable job is never admitted"
+        s = _await_terminal(router, router.submit(_job(stack_dir))["job_id"])
+        assert s["state"] == "done", s.get("error")
+    finally:
+        router.stop()
+        rt_thread.join(timeout=300)
+        replica.stop()
+    evs = _events(rt_dir)
+    rejected = [e for e in evs if e.get("ev") == "job_rejected"]
+    assert [e["reason"] for e in rejected] == ["journal_error"]
+    kinds = sorted({e["rec"] for e in evs if e.get("ev") == "journal_append"})
+    assert kinds == ["admitted", "forwarded", "terminal"]
+
+
+# ---------------------------------------------------------------------------
+# recovery window: 503 + Retry-After, dedupe still answers
+
+
+def test_recovery_window_503_but_dedupe_answers(stack_dir, tmp_path):
+    replica = _OneReplica(tmp_path)
+    rt_dir = str(tmp_path / "rt")
+    router = FleetRouter(RouterConfig(
+        workdir=rt_dir, replicas=replica.bases, health_interval_s=0.2,
+    ))
+    try:
+        first = router.submit(_job(stack_dir, idempotency_key="win-1"))
+        # deterministic stand-in for the reconciliation window (the
+        # constructor holds it only while _recover probes replicas)
+        router._recovering = True
+        with pytest.raises(Rejection) as exc:
+            router.submit(_job(stack_dir))
+        assert (exc.value.http_status, exc.value.reason) == \
+            (503, "recovering")
+        # the HTTP front door maps the window to 503 + Retry-After
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/jobs",
+            data=json.dumps(_job(stack_dir)).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as http_exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert http_exc.value.code == 503
+        assert http_exc.value.headers["Retry-After"] is not None
+        assert json.loads(http_exc.value.read())["error"] == "recovering"
+        # idempotent resubmission dedupes THROUGH the window: no queue
+        # slot is consumed answering about an already-admitted job
+        again = router.submit(_job(stack_dir, idempotency_key="win-1"))
+        assert again["deduped"] is True
+        assert again["job_id"] == first["job_id"]
+        router._recovering = False
+        router.submit(_job(stack_dir))  # window lifted: admission resumes
+    finally:
+        router.stop()
+        router.serve_forever()  # drains the queued jobs as cancelled
+        replica.stop()
+
+
+# ---------------------------------------------------------------------------
+# restart replay: clean-drain dedupe, crash requeue → resume
+
+
+def test_clean_restart_dedupes_across_incarnations(stack_dir, tmp_path):
+    replica = _OneReplica(tmp_path)
+    rt_dir = str(tmp_path / "rt")
+    cfg = dict(
+        workdir=rt_dir, replicas=replica.bases, health_interval_s=0.2,
+    )
+    router = FleetRouter(RouterConfig(**cfg))
+    rt_thread = threading.Thread(target=router.serve_forever)
+    rt_thread.start()
+    try:
+        snap = router.submit(_job(stack_dir, idempotency_key="restart-1"))
+        s = _await_terminal(router, snap["job_id"])
+        assert s["state"] == "done", s.get("error")
+    finally:
+        router.stop()
+        rt_thread.join(timeout=300)
+    assert (Path(rt_dir) / "journal" / "clean").exists(), \
+        "a fully-drained stop earns the clean-shutdown marker"
+    router2 = FleetRouter(RouterConfig(**cfg))
+    try:
+        assert router2.recovery is not None
+        assert router2.recovery["clean"] is True
+        assert router2.recovery["replayed"] == 0, \
+            "a drained journal has nothing to reconcile"
+        assert router2.recovery["deduped"] == 1
+        again = router2.submit(
+            _job(stack_dir, idempotency_key="restart-1")
+        )
+        assert again["deduped"] is True
+        assert again["job_id"] == snap["job_id"]
+        assert again["state"] == "done"
+    finally:
+        router2.stop()
+        router2.serve_forever()
+        replica.stop()
+
+
+def test_crash_recovery_requeues_and_resumes_byte_identical(
+    stack_dir, tmp_path
+):
+    """A fabricated crash journal (admitted + forwarded to a DEAD
+    replica base) must requeue the job with its pinned workdir; the
+    resumed run completes under the preserved trace id with artifacts
+    byte-identical to a clean routed run, and the idempotency key still
+    dedupes against the replayed job."""
+    replica = _OneReplica(tmp_path)
+    clean_wd = str(tmp_path / "clean_wd")
+    jwd = str(tmp_path / "crash_wd")
+    jid = "rt-0-00001"
+    payload = _job(stack_dir, workdir=jwd, out_dir=jwd + "_o")
+    try:
+        router = FleetRouter(RouterConfig(
+            workdir=str(tmp_path / "rt_clean"), replicas=replica.bases,
+            health_interval_s=0.2,
+        ))
+        rt_thread = threading.Thread(target=router.serve_forever)
+        rt_thread.start()
+        try:
+            s = _await_terminal(router, router.submit(
+                _job(stack_dir, workdir=clean_wd)
+            )["job_id"])
+            assert s["state"] == "done", s.get("error")
+        finally:
+            router.stop()
+            rt_thread.join(timeout=300)
+
+        rt_crash = tmp_path / "rt_crash"
+        (rt_crash / "journal").mkdir(parents=True)
+        (rt_crash / "journal" / "seg-00000001.jsonl").write_text(
+            json.dumps({
+                "rec": "admitted", "job_id": jid, "payload": payload,
+                "tenant": "t", "priority": 0, "key": "k",
+                "trace_id": "testrecover00001",
+                "idempotency_key": "crash-1", "workdir": jwd,
+                "out_dir": jwd + "_o", "source": "http", "t": 0.0,
+            }) + "\n" + json.dumps({
+                "rec": "forwarded", "job_id": jid,
+                "replica_base": "http://127.0.0.1:9",
+                "replica_job_id": "gone-1", "t": 0.0,
+            }) + "\n"
+        )
+        router2 = FleetRouter(RouterConfig(
+            workdir=str(rt_crash), replicas=replica.bases,
+            health_interval_s=0.2,
+        ))
+        rt_thread = threading.Thread(target=router2.serve_forever)
+        rt_thread.start()
+        try:
+            assert router2.recovery["replayed"] == 1
+            assert router2.recovery["requeued"] == 1
+            assert router2.recovery["clean"] is False
+            s = _await_terminal(router2, jid)
+            assert s["state"] == "done", s.get("error")
+            assert s["trace_id"] == "testrecover00001", \
+                "the resumed run keeps the admission's trace id"
+            again = router2.submit(
+                {**payload, "idempotency_key": "crash-1"}
+            )
+            assert again["deduped"] is True and again["job_id"] == jid
+        finally:
+            router2.stop()
+            rt_thread.join(timeout=300)
+    finally:
+        replica.stop()
+    assert _digest_workdir(jwd) == _digest_workdir(clean_wd)
+    assert _digest_workdir(jwd), "parity over zero tiles proves nothing"
+    recovered = [
+        e for e in _events(str(tmp_path / "rt_crash"))
+        if e.get("ev") == "router_recovered"
+    ]
+    assert len(recovered) == 1
+    assert recovered[0]["requeued"] == 1
+    assert recovered[0]["relayed"] + recovered[0]["requeued"] \
+        + recovered[0]["reattached"] <= recovered[0]["replayed"]
+
+
+# ---------------------------------------------------------------------------
+# value lints + SIGTERM drain hook
+
+
+def test_journal_event_value_lints():
+    from check_events_schema import journal_value_errors
+
+    from land_trendr_tpu.obs.events import EVENT_FIELDS
+
+    assert "journal_append" in EVENT_FIELDS
+    assert "router_recovered" in EVENT_FIELDS
+    ja = {"ev": "journal_append", "rec": "admitted",
+          "segment": 1, "bytes": 120}
+    assert journal_value_errors(ja, 1) == []
+    assert journal_value_errors({**ja, "rec": "committed"}, 1)
+    assert journal_value_errors({**ja, "bytes": 0}, 1)
+    assert journal_value_errors({**ja, "segment": 0}, 1)
+    rr = {"ev": "router_recovered", "replayed": 2, "relayed": 1,
+          "requeued": 1, "reattached": 0, "deduped": 0,
+          "recovery_s": 0.01, "clean": False}
+    assert journal_value_errors(rr, 1) == []
+    assert journal_value_errors({**rr, "requeued": 2}, 1), \
+        "the reconciliation split cannot exceed what was replayed"
+    # bools are not counts: the guard must not arithmetic over them
+    assert journal_value_errors({**rr, "replayed": True}, 1) == []
+
+
+def test_sigterm_drains_like_sigint():
+    with pytest.raises(KeyboardInterrupt):
+        _sigterm_to_interrupt(signal.SIGTERM, None)

@@ -154,6 +154,15 @@ NONNEG_FIELDS: dict[str, tuple[str, ...]] = {
         "jobs", "tiles", "padded_px", "occupancy", "window_wait_s",
     ),
     "batch_demux": ("tiles", "member_jobs"),
+    # crash-safe control plane (fleet/journal): segment indices, record
+    # sizes and recovery counters only go up / never negative (the
+    # record-kind enum, >= 1 floors and recovery-split cross-checks live
+    # in journal_value_errors below)
+    "journal_append": ("segment", "bytes"),
+    "router_recovered": (
+        "replayed", "relayed", "requeued", "deduped", "recovery_s",
+        "reattached",
+    ),
 }
 
 
@@ -723,6 +732,52 @@ def batch_value_errors(rec, lineno: int) -> list[str]:
     return errs
 
 
+def journal_value_errors(rec, lineno: int) -> list[str]:
+    """Value lint for the crash-safe control plane: a ``journal_append``
+    names a known record kind and landed somewhere real (``segment`` and
+    ``bytes`` both >= 1 — a zero-byte commit is a broken append path),
+    and a ``router_recovered`` reconciliation split can only partition
+    what was replayed (``relayed + requeued [+ reattached] <=
+    replayed``).  Non-negativity rides the generic NONNEG_FIELDS loop."""
+    if not isinstance(rec, dict):
+        return []
+    ev = rec.get("ev")
+    errs = []
+    if ev == "journal_append":
+        kind = rec.get("rec")
+        if isinstance(kind, str) and kind not in (
+            "admitted", "forwarded", "terminal"
+        ):
+            errs.append(
+                f"line {lineno}: journal_append: rec {kind!r} is not a "
+                "journal record kind (admitted/forwarded/terminal)"
+            )
+        for name in ("segment", "bytes"):
+            v = rec.get(name)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 1:
+                errs.append(
+                    f"line {lineno}: journal_append: {name} {v} < 1 "
+                    "(a committed record has a segment and a size)"
+                )
+    elif ev == "router_recovered":
+        parts = [rec.get(k) for k in ("relayed", "requeued", "reattached")]
+        replayed = rec.get("replayed")
+        ok = [
+            v for v in parts
+            if isinstance(v, int) and not isinstance(v, bool)
+        ]
+        if (
+            isinstance(replayed, int) and not isinstance(replayed, bool)
+            and sum(ok) > replayed
+        ):
+            errs.append(
+                f"line {lineno}: router_recovered: reconciliation split "
+                f"{sum(ok)} exceeds replayed {replayed} (relayed + "
+                "requeued + reattached partition the replayed jobs)"
+            )
+    return errs
+
+
 def generic_nonneg_errors(rec, lineno: int) -> list[str]:
     """Non-negativity for the event types without a dedicated lint class
     (the robustness events, the ingest-store rollup, the flight-sampler
@@ -761,6 +816,7 @@ def value_lints():
             + request_value_errors(rec, lineno)
             + capacity_value_errors(rec, lineno)
             + batch_value_errors(rec, lineno)
+            + journal_value_errors(rec, lineno)
             + alert_lint(rec, lineno)
             + trace_lint(rec, lineno)
             + generic_nonneg_errors(rec, lineno)

@@ -87,6 +87,8 @@ SOAK_COVERED_SEAMS = (
     "loadgen.tick",        # loadgen churn case
     "batch.pack",          # batch pack/demux fault case
     "batch.demux",         # batch pack/demux fault case
+    "router.journal",      # journal append fault → 503, resubmit lands
+    "router.recover",      # recovery probe fault → requeue + resume
 )
 
 import numpy as np  # noqa: E402
@@ -1402,6 +1404,411 @@ def soak(
                 f"{s['attempts']} route attempts)"
             )
 
+    def run_journal_track() -> None:
+        """Crash-safe control plane (ISSUE 20), in-process: the two
+        journal seams against one real replica.
+
+        * ``router.journal@0=io``: the FIRST admission's journal append
+          fails — the submission is refused 503 ``journal_error`` (a
+          job the journal cannot make durable is never accepted), the
+          router lives, and the resubmission completes byte-identically.
+        * ``router.recover@0=io``: a fabricated crash journal (admitted
+          + forwarded to a dead replica base) replays at startup; the
+          armed recovery-probe fault degrades the job to the requeue
+          path — it re-routes to the live replica, resumes, and
+          finishes byte-identically with ONE complete trace under the
+          preserved trace id.  An idempotent resubmission after the
+          restart dedupes onto the recovered job.
+        """
+        import threading as _threading
+
+        from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+        from land_trendr_tpu.obs.reqtrace import assemble_request
+        from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+        from land_trendr_tpu.serve.server import Rejection
+        from tools.lt_request import expand_paths
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        job = {
+            "stack_dir": sdir,
+            "tile_size": base_kw["tile_size"],
+            "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+            "max_retries": retries,
+            "run_overrides": {"retry_backoff_s": 0.0},
+        }
+        server = SegmentationServer(
+            ServeConfig(workdir=str(root / "journal_replica"),
+                        feed_cache_mb=64)
+        )
+        srv_thread = _threading.Thread(target=server.serve_forever)
+        srv_thread.start()
+        try:
+            # -- case 1: append fault → 503, resubmission lands --------
+            case_name = "journal_fault_503_then_resubmit"
+            schedule = "seed=1,router.journal@0=io"
+            rt_dir = str(root / "router_journal_fault")
+            router = FleetRouter(RouterConfig(
+                workdir=rt_dir,
+                replicas=(f"http://127.0.0.1:{server.port}",),
+                health_interval_s=0.2,
+                fault_schedule=schedule,
+            ))
+            rt_thread = _threading.Thread(target=router.serve_forever)
+            rt_thread.start()
+            try:
+                try:
+                    router.submit(dict(job))
+                    raise AssertionError(
+                        "journal fault: the un-durable submission was "
+                        "ACCEPTED"
+                    )
+                except Rejection as e:
+                    if e.http_status != 503 or e.reason != "journal_error":
+                        raise AssertionError(
+                            f"journal fault: expected 503 journal_error, "
+                            f"got {e.http_status} {e.reason}"
+                        )
+                snap = router.submit(dict(job))
+                deadline = time.monotonic() + 300
+                while time.monotonic() < deadline:
+                    s = router.job_status(snap["job_id"])
+                    if s["state"] not in ("queued", "routed"):
+                        break
+                    time.sleep(0.1)
+            finally:
+                router.stop()
+                rt_thread.join(timeout=300)
+            if s["state"] != "done":
+                raise AssertionError(
+                    f"journal fault: resubmitted job ended {s['state']} "
+                    f"({s.get('error')})"
+                )
+            if _digest_workdir(s["workdir"]) != clean:
+                raise AssertionError(
+                    "journal fault: artifacts differ from the clean run"
+                )
+            evs = [
+                json.loads(line) for line in
+                (Path(rt_dir) / "events.jsonl").read_text().splitlines()
+            ]
+            rejects = [
+                e for e in evs
+                if e["ev"] == "job_rejected"
+                and e.get("reason") == "journal_error"
+            ]
+            if len(rejects) != 1:
+                raise AssertionError(
+                    f"journal fault: expected one journal_error "
+                    f"rejection event, got {rejects}"
+                )
+            appended = [e for e in evs if e["ev"] == "journal_append"]
+            kinds = sorted({e["rec"] for e in appended})
+            if kinds != ["admitted", "forwarded", "terminal"]:
+                raise AssertionError(
+                    f"journal fault: the resubmitted job should journal "
+                    f"all three record kinds, got {kinds}"
+                )
+            report["cases"].append({
+                "track": "router",
+                "case": case_name,
+                "schedule": schedule,
+                "job": s["state"],
+                "artifacts_identical": True,
+            })
+            if verbose:
+                print(f"  ok: router/{case_name} ({schedule})")
+
+            # -- case 2: crash journal replays; probe fault → requeue --
+            case_name = "recover_probe_fault_requeued_resume"
+            schedule = "seed=1,router.recover@0=io"
+            rt_dir = str(root / "router_recover")
+            jid, trace_id = "rt-0-00001", "soakrecover00001"
+            jwd = str(root / "router_recover_job")
+            payload = dict(job)
+            payload["workdir"] = jwd
+            payload["out_dir"] = jwd + "_o"
+            jdir = Path(rt_dir) / "journal"
+            jdir.mkdir(parents=True)
+            (jdir / "seg-00000001.jsonl").write_text(
+                "\n".join(json.dumps(r) for r in (
+                    {
+                        "rec": "admitted", "job_id": jid,
+                        "payload": payload, "tenant": "soak",
+                        "priority": 0, "key": "soak-key",
+                        "trace_id": trace_id,
+                        "idempotency_key": "soak-recover-1",
+                        "workdir": jwd, "out_dir": jwd + "_o",
+                        "source": "http", "t": time.time(),
+                    },
+                    {
+                        "rec": "forwarded", "job_id": jid,
+                        # a base nothing listens on: the dead incarnation
+                        "replica_base": "http://127.0.0.1:9",
+                        "replica_job_id": "gone-1", "replica": "r0",
+                        "t": time.time(),
+                    },
+                )) + "\n"
+            )
+            router = FleetRouter(RouterConfig(
+                workdir=rt_dir,
+                replicas=(f"http://127.0.0.1:{server.port}",),
+                health_interval_s=0.2,
+                fault_schedule=schedule,
+            ))
+            rt_thread = _threading.Thread(target=router.serve_forever)
+            rt_thread.start()
+            try:
+                rec = router.recovery
+                if not rec or rec["requeued"] != 1 or rec["replayed"] != 1:
+                    raise AssertionError(
+                        f"recover: expected the one forwarded job "
+                        f"requeued, got {rec}"
+                    )
+                dedup = router.submit(
+                    {**payload, "idempotency_key": "soak-recover-1"}
+                )
+                if not dedup.get("deduped") or dedup["job_id"] != jid:
+                    raise AssertionError(
+                        f"recover: idempotent resubmission did not "
+                        f"dedupe onto the recovered job: {dedup}"
+                    )
+                deadline = time.monotonic() + 300
+                while time.monotonic() < deadline:
+                    s = router.job_status(jid)
+                    if s["state"] not in ("queued", "routed"):
+                        break
+                    time.sleep(0.1)
+            finally:
+                router.stop()
+                rt_thread.join(timeout=300)
+            if s["state"] != "done":
+                raise AssertionError(
+                    f"recover: replayed job ended {s['state']} "
+                    f"({s.get('error')})"
+                )
+            if s["trace_id"] != trace_id:
+                raise AssertionError(
+                    f"recover: trace id not preserved: {s['trace_id']}"
+                )
+            if _digest_workdir(jwd) != clean:
+                raise AssertionError(
+                    "recover: artifacts differ from the clean run"
+                )
+            evs = [
+                json.loads(line) for line in
+                (Path(rt_dir) / "events.jsonl").read_text().splitlines()
+            ]
+            recovered = [e for e in evs if e["ev"] == "router_recovered"]
+            if len(recovered) != 1 or recovered[0]["requeued"] != 1:
+                raise AssertionError(
+                    f"recover: expected one router_recovered with "
+                    f"requeued=1, got {recovered}"
+                )
+            # ONE complete trace under the preserved id, blame summing
+            # to the router-observed latency (the PR-15 contract holds
+            # across the restart)
+            files = expand_paths(
+                [rt_dir, str(root / "journal_replica"), jwd]
+            )
+            tr = assemble_request(files, trace_id)
+            if not tr["complete"]:
+                raise AssertionError(
+                    f"recover: trace {trace_id} did not assemble "
+                    f"complete: {tr}"
+                )
+            if abs(tr["blame_sum_s"] - tr["latency_s"]) > 5e-3:
+                raise AssertionError(
+                    f"recover: blame {tr['blame']} sums to "
+                    f"{tr['blame_sum_s']} vs latency {tr['latency_s']}"
+                )
+            report["cases"].append({
+                "track": "router",
+                "case": case_name,
+                "schedule": schedule,
+                "job": s["state"],
+                "recovery": {
+                    k: recovered[0].get(k)
+                    for k in ("replayed", "requeued", "relayed", "deduped")
+                },
+                "trace_id": trace_id,
+                "artifacts_identical": True,
+            })
+            if verbose:
+                print(f"  ok: router/{case_name} ({schedule})")
+        finally:
+            server.stop()
+            srv_thread.join(timeout=120)
+
+    def run_router_restart_kill_case() -> None:
+        """Full mode: the ROUTER process SIGKILLed mid-trace, restarted
+        on the same workdir.  The crash-safety contract end to end:
+        zero accepted jobs lost (the journal replays the in-flight
+        job), the still-running spawned replica is re-adopted (not
+        respawned cold), the job completes with artifacts byte-identical
+        to the clean run under its preserved trace id, an idempotent
+        resubmission dedupes onto it, and a SIGTERM drain leaves the
+        clean-shutdown marker.  Full mode only: a cold `lt route`
+        process (plus its spawned replica, plus one fresh spawn at
+        restart) costs tens of seconds the smoke budget does not have —
+        the smoke's journal/recover cases drive the same replay and
+        reconcile paths deterministically in-process."""
+        import os as _os
+        import signal as _signal
+        import subprocess as _subprocess
+        import sys as _sys
+        import urllib.request as _rq
+
+        from land_trendr_tpu.obs.reqtrace import assemble_request
+        from tools.lt_request import expand_paths
+
+        def _launch(rt_dir: str) -> "tuple[_subprocess.Popen, int]":
+            proc = _subprocess.Popen(
+                [
+                    _sys.executable, "-m", "land_trendr_tpu", "route",
+                    "--workdir", rt_dir,
+                    "--route-port", "0",
+                    "--spawn-replicas", "1",
+                    "--health-interval-s", "0.3",
+                    "--replica-args",
+                    "--feed-cache-mb 64 "
+                    "--fault-schedule seed=5,dispatch%1.0=slow:0.3",
+                ],
+                stdout=_subprocess.PIPE,
+                stderr=_subprocess.DEVNULL,
+                text=True,
+            )
+            line = proc.stdout.readline()
+            startup = json.loads(line) if line.strip() else {}
+            if not startup.get("routing"):
+                proc.kill()
+                raise AssertionError(
+                    f"router restart: startup line unreadable: {line!r}"
+                )
+            return proc, int(startup["port"])
+
+        def _http(method: str, url: str, payload=None) -> dict:
+            data = (
+                json.dumps(payload).encode() if payload is not None
+                else None
+            )
+            req = _rq.Request(url, data=data, method=method)
+            req.add_header("Content-Type", "application/json")
+            with _rq.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())
+
+        sdir = str(root / "serve_stack")
+        clean = _digest_workdir(str(root / "serve_clean"))
+        rt_dir = str(root / "router_restart")
+        trace_id = "soakrestart00001"
+        proc, port = _launch(rt_dir)
+        try:
+            snap = _http("POST", f"http://127.0.0.1:{port}/jobs", {
+                "stack_dir": sdir,
+                "tile_size": base_kw["tile_size"],
+                "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+                "run_overrides": {"retry_backoff_s": 0.0},
+                "trace_id": trace_id,
+                "idempotency_key": "soak-restart-1",
+            })
+            jid = snap["job_id"]
+            wd = Path(snap["workdir"])
+            # kill only once work is durable — the resume-not-recompute
+            # proof rides on tiles written before the crash
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline \
+                    and not list(wd.glob("tile_*.npz")):
+                time.sleep(0.05)
+            pre_kill = len(list(wd.glob("tile_*.npz")))
+            if not pre_kill:
+                raise AssertionError(
+                    "router restart: no tile ever became durable"
+                )
+            _os.kill(proc.pid, _signal.SIGKILL)
+            proc.wait(timeout=60)
+        except BaseException:
+            proc.kill()
+            raise
+        proc, port = _launch(rt_dir)
+        try:
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                s = _http("GET", f"http://127.0.0.1:{port}/jobs/{jid}")
+                if s["state"] not in ("queued", "routed"):
+                    break
+                time.sleep(0.2)
+            if s["state"] != "done":
+                raise AssertionError(
+                    f"router restart: job ended {s['state']} "
+                    f"({s.get('error')}) — an accepted job was lost"
+                )
+            if s["trace_id"] != trace_id:
+                raise AssertionError(
+                    f"router restart: trace id not preserved: "
+                    f"{s['trace_id']}"
+                )
+            dedup = _http("POST", f"http://127.0.0.1:{port}/jobs", {
+                "stack_dir": sdir,
+                "tile_size": base_kw["tile_size"],
+                "idempotency_key": "soak-restart-1",
+            })
+            if not dedup.get("deduped") or dedup["job_id"] != jid:
+                raise AssertionError(
+                    f"router restart: resubmission did not dedupe onto "
+                    f"the recovered job: {dedup}"
+                )
+            health = _http("GET", f"http://127.0.0.1:{port}/healthz")
+            rec = health.get("recovery")
+            if not rec or rec.get("replayed") != 1:
+                raise AssertionError(
+                    f"router restart: no recovery summary: {rec}"
+                )
+        finally:
+            # SIGTERM = the documented drain (satellite: `lt route`
+            # handles it like Ctrl-C) — the clean marker must follow
+            proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=120)
+            except _subprocess.TimeoutExpired:
+                proc.kill()
+                raise
+        if _digest_workdir(str(wd)) != clean:
+            raise AssertionError(
+                "router restart: artifacts differ from the clean run"
+            )
+        if not (Path(rt_dir) / "journal" / "clean").exists():
+            raise AssertionError(
+                "router restart: SIGTERM drain left no clean-shutdown "
+                "marker"
+            )
+        # the whole journey — pre-kill forward included — is ONE trace
+        tr = assemble_request(expand_paths([rt_dir]), trace_id)
+        if not tr["complete"]:
+            raise AssertionError(
+                f"router restart: trace {trace_id} did not assemble "
+                f"complete: {tr}"
+            )
+        if abs(tr["blame_sum_s"] - tr["latency_s"]) > 5e-3:
+            raise AssertionError(
+                f"router restart: blame {tr['blame']} sums to "
+                f"{tr['blame_sum_s']} vs latency {tr['latency_s']}"
+            )
+        report["cases"].append({
+            "track": "router",
+            "case": "router_sigkill_restart_recovered",
+            "schedule": "SIGKILL router mid-trace, restart same workdir",
+            "tiles_durable_before_kill": pre_kill,
+            "recovery": rec,
+            "trace_id": trace_id,
+            "artifacts_identical": True,
+        })
+        if verbose:
+            print(
+                f"  ok: router/router_sigkill_restart_recovered "
+                f"({pre_kill} tile(s) durable pre-kill, "
+                f"recovery {rec})"
+            )
+
     def run_loadgen_churn_case() -> None:
         """Load-rig churn semantics (the ``loadgen.tick`` seam): a
         seeded closed-loop soak against a 2-replica spawned fleet whose
@@ -1812,9 +2219,11 @@ def soak(
     run_serve_job_case()
     run_batch_track()
     run_router_track()
+    run_journal_track()
     if not smoke:
         run_batch_kill_case()
         run_router_kill_case()
+        run_router_restart_kill_case()
         run_loadgen_churn_case()
     lazy = _make_lazy(str(root / "c2"), 96)
     # lazy windows revisit strips across tiles: give the decode seams a

@@ -376,6 +376,24 @@ def render_router(snap: dict) -> str:
             f"last action "
             f"{_fmt_age(now - scaler['last_action_t']) + ' ago' if scaler.get('last_action_t') else 'never'}"
         )
+    rec = h.get("recovery")
+    if h.get("recovering") or rec:
+        jrn = h.get("journal") or {}
+        lines.append("")
+        if h.get("recovering"):
+            lines.append("RECOVERY: in progress (submissions answer 503)")
+        else:
+            lines.append(
+                f"RECOVERY: replayed {rec.get('replayed', 0)}  "
+                f"relayed {rec.get('relayed', 0)}  "
+                f"requeued {rec.get('requeued', 0)}  "
+                f"reattached {rec.get('reattached', 0)}  "
+                f"deduped {rec.get('deduped', 0)}  "
+                f"in {rec.get('recovery_s', 0):.3f}s"
+                f"{'  (clean shutdown)' if rec.get('clean') else ''}  "
+                f"journal seg {jrn.get('segment', '?')} "
+                f"({jrn.get('segments', '?')} on disk)"
+            )
     lines.append("")
     lines.append(
         f"{'JOB':<16} {'TRACE':<10} {'STATE':<18} {'TENANT':<10} "

@@ -178,6 +178,9 @@ def _router_scope(cur: dict) -> dict:
             "routed": 0, "warm": 0, "rerouted": 0, "throttled": {},
             "replicas_up": 0, "replicas_down": {}, "scales": {},
             "queue_wait_s": [],
+            # crash-safe control plane (fleet/journal): durable appends
+            # and the restart-recovery splits
+            "journal_appends": 0, "recovery": {},
         }
     return cur["router"]
 
@@ -192,6 +195,7 @@ def _merge_router(folded: list[dict]) -> "dict | None":
     throttled: dict = {}
     downs: dict = {}
     scales: dict = {}
+    recovery: dict = {}
     for s in seen:
         for k, v in s["throttled"].items():
             throttled[k] = throttled.get(k, 0) + v
@@ -199,6 +203,8 @@ def _merge_router(folded: list[dict]) -> "dict | None":
             downs[k] = downs.get(k, 0) + v
         for k, v in s["scales"].items():
             scales[k] = scales.get(k, 0) + v
+        for k, v in s.get("recovery", {}).items():
+            recovery[k] = recovery.get(k, 0) + v
     routed = sum(s["routed"] for s in seen)
     warm = sum(s["warm"] for s in seen)
     return {
@@ -211,6 +217,8 @@ def _merge_router(folded: list[dict]) -> "dict | None":
         "replicas_down": dict(sorted(downs.items())),
         "scales": dict(sorted(scales.items())),
         "queue_wait_s": _stats([v for s in seen for v in s["queue_wait_s"]]),
+        "journal_appends": sum(s.get("journal_appends", 0) for s in seen),
+        "recovery": dict(sorted(recovery.items())) or None,
     }
 
 
@@ -1005,6 +1013,41 @@ def fold(
                             "args": {
                                 "burn": rec.get("burn"),
                                 "replicas": rec.get("replicas"),
+                            },
+                        })
+                    elif ev == "journal_append":
+                        # crash-safe control plane: one durable
+                        # admission-journal commit (counted, not
+                        # per-record spanned — the append rate rides
+                        # the rollup, not the timeline)
+                        _router_scope(cur)["journal_appends"] += 1
+                    elif ev == "router_recovered":
+                        rv = _router_scope(cur)["recovery"]
+                        rv["restarts"] = rv.get("restarts", 0) + 1
+                        for k in (
+                            "replayed", "relayed", "requeued",
+                            "reattached", "deduped",
+                        ):
+                            v = rec.get(k)
+                            if isinstance(v, int) and not isinstance(
+                                v, bool
+                            ):
+                                rv[k] = rv.get(k, 0) + v
+                        spans.append({
+                            "kind": "instant", "file": fileno,
+                            "tid": "jobs",
+                            "name": (
+                                f"ROUTER RECOVERED "
+                                f"({rec.get('replayed', 0)} replayed)"
+                            ),
+                            "t0": tw,
+                            "args": {
+                                "relayed": rec.get("relayed"),
+                                "requeued": rec.get("requeued"),
+                                "reattached": rec.get("reattached"),
+                                "deduped": rec.get("deduped"),
+                                "recovery_s": rec.get("recovery_s"),
+                                "clean": rec.get("clean"),
                             },
                         })
                     elif ev == "request_span":

@@ -56,6 +56,12 @@ SPEEDUP_FLOOR = 1.15
 #: small-AOI flood tiles evenly, so real packing sits at ~1.0 — well
 #: under-filled launches mean the batch shape regressed
 BATCH_OCCUPANCY_FLOOR = 0.9
+#: admission-journal commit bound, min-of-reps milliseconds per append:
+#: one json.dumps + one O_APPEND os.write on a local disk sits well
+#: under a millisecond — a 5ms min-of-reps means the append path grew
+#: real work (fsync, lock convoy, rotation on every record), while a
+#: loaded single-core box's scheduler noise stays inside the band
+JOURNAL_APPEND_MAX_MS = 5.0
 
 
 def _hit_rate(stats: dict) -> float | None:
@@ -1005,6 +1011,196 @@ def run_capacity_leg(workdir: str, check) -> None:
     )
 
 
+def run_recovery_leg(workdir: str, check) -> None:
+    """Crash-safe control plane leg (fleet/journal + router recovery).
+
+    Structural, exact: the admission journal folds byte-stably across
+    close/reopen, prefix compaction never drops a live job, a torn tail
+    is GC'd without losing a committed record, and a router recovered
+    from a fabricated crash journal (forwarded to a dead replica base)
+    requeues the in-flight job and finishes it with artifacts
+    byte-identical to a clean routed run.  Banded: min-of-reps
+    per-append commit wall under ``JOURNAL_APPEND_MAX_MS``.  In-process
+    (one serve replica on a thread) — seconds-scale, so the tier-1
+    smoke runs it."""
+    import hashlib
+    import threading
+    import time as _time
+
+    import numpy as _np
+
+    from land_trendr_tpu.fleet import FleetRouter, RouterConfig
+    from land_trendr_tpu.fleet.journal import AdmissionJournal
+    from land_trendr_tpu.io.synthetic import (
+        SceneSpec,
+        make_stack,
+        write_stack,
+    )
+    from land_trendr_tpu.serve import SegmentationServer, ServeConfig
+
+    # -- journal fold stability / compaction / torn tail ------------------
+    jroot = str(Path(workdir) / "recovery_journal")
+    j = AdmissionJournal(jroot, segment_bytes=64 * 1024)
+    for i in range(300):
+        jid = f"j{i:04d}"
+        j.append("admitted", jid, payload={"n": i}, t=float(i))
+        j.append(
+            "forwarded", jid,
+            replica_base="http://127.0.0.1:9", replica_job_id=jid,
+        )
+        if i < 250:
+            j.append("terminal", jid, state="done", t=float(i))
+    first = j.replay()
+    j.close()
+    j = AdmissionJournal(jroot, segment_bytes=64 * 1024)
+    second = j.replay()
+    check(
+        "recovery.replay_stable",
+        json.dumps(first, sort_keys=True)
+        == json.dumps(second, sort_keys=True),
+        f"{len(second)} folded job(s) identical across close/reopen",
+    )
+    live = {
+        jid for jid, st in second.items() if st["status"] != "terminal"
+    }
+    dropped = j.compact()
+    after = j.replay()
+    check(
+        "recovery.compaction_safe",
+        live <= set(after)
+        and all(after[jid]["status"] != "terminal" for jid in live),
+        f"{dropped} fully-terminal segment(s) dropped; all {len(live)} "
+        "live job(s) survive the compaction",
+    )
+    j.close()
+    segs = sorted(Path(jroot).glob("seg-*.jsonl"))
+    with open(segs[-1], "ab") as f:
+        f.write(b'{"rec":"admitted","job_id":"torn-')  # mid-crash tear
+    j = AdmissionJournal(jroot, segment_bytes=64 * 1024)
+    third = j.replay()
+    check(
+        "recovery.torn_tail_dropped",
+        json.dumps(after, sort_keys=True)
+        == json.dumps(third, sort_keys=True)
+        and "torn-" not in third,
+        "half-written final line dropped at reopen, committed records "
+        "untouched",
+    )
+    # -- per-append overhead (min-of-reps: scheduler noise filtered) ------
+    reps = []
+    for _ in range(5):
+        t0 = _time.perf_counter()
+        for i in range(50):
+            j.append("terminal", f"bench{i}", state="done", t=0.0)
+        reps.append((_time.perf_counter() - t0) / 50)
+    j.close()
+    per_ms = min(reps) * 1e3
+    check(
+        "recovery.append_overhead",
+        per_ms < JOURNAL_APPEND_MAX_MS,
+        f"min-of-reps journal append {per_ms:.3f}ms vs "
+        f"{JOURNAL_APPEND_MAX_MS}ms bound",
+    )
+
+    # -- recovered-vs-clean artifact parity -------------------------------
+    def digest(wd: str) -> dict:
+        out: dict = {}
+        for p in sorted(Path(wd).glob("tile_*.npz")):
+            with _np.load(p) as z:
+                out[p.name] = {
+                    name: hashlib.sha256(
+                        _np.ascontiguousarray(z[name]).tobytes()
+                    ).hexdigest()
+                    for name in sorted(z.files)
+                }
+        return out
+
+    stack_dir = str(Path(workdir) / "recovery_stack")
+    write_stack(
+        stack_dir,
+        make_stack(SceneSpec(
+            width=48, height=40, year_start=1990, year_end=2013, seed=11,
+        )),
+    )
+    job = {
+        "stack_dir": stack_dir,
+        "tile_size": 20,
+        "params": {"max_segments": 4, "vertex_count_overshoot": 2},
+        "run_overrides": {"retry_backoff_s": 0.0},
+    }
+    server = SegmentationServer(ServeConfig(
+        workdir=str(Path(workdir) / "recovery_replica"), feed_cache_mb=64,
+    ))
+    srv_thread = threading.Thread(target=server.serve_forever)
+    srv_thread.start()
+
+    def routed(rt_dir: str, submit: "dict | None", jid: "str | None"):
+        router = FleetRouter(RouterConfig(
+            workdir=rt_dir,
+            replicas=(f"http://127.0.0.1:{server.port}",),
+            health_interval_s=0.2,
+        ))
+        rt_thread = threading.Thread(target=router.serve_forever)
+        rt_thread.start()
+        try:
+            if submit is not None:
+                jid = router.submit(submit)["job_id"]
+            deadline = _time.monotonic() + 300
+            while _time.monotonic() < deadline:
+                s = router.job_status(jid)
+                if s["state"] not in ("queued", "routed"):
+                    break
+                _time.sleep(0.1)
+            return s, router.recovery
+        finally:
+            router.stop()
+            rt_thread.join(timeout=300)
+
+    try:
+        clean_s, _ = routed(
+            str(Path(workdir) / "recovery_router_clean"), dict(job), None
+        )
+        rt_crash = Path(workdir) / "recovery_router_crash"
+        jwd = str(Path(workdir) / "recovery_job_wd")
+        jid = "rt-0-00001"
+        payload = dict(job)
+        payload["workdir"] = jwd
+        payload["out_dir"] = jwd + "_o"
+        (rt_crash / "journal").mkdir(parents=True)
+        (rt_crash / "journal" / "seg-00000001.jsonl").write_text(
+            json.dumps({
+                "rec": "admitted", "job_id": jid, "payload": payload,
+                "tenant": "gate", "priority": 0, "key": "gate-key",
+                "trace_id": "gaterecover00001", "workdir": jwd,
+                "out_dir": jwd + "_o", "source": "http", "t": 0.0,
+            }) + "\n" + json.dumps({
+                "rec": "forwarded", "job_id": jid,
+                "replica_base": "http://127.0.0.1:9",
+                "replica_job_id": "gone-1", "t": 0.0,
+            }) + "\n"
+        )
+        rec_s, recovery = routed(str(rt_crash), None, jid)
+    finally:
+        server.stop()
+        srv_thread.join(timeout=120)
+    check(
+        "recovery.replayed_job_completes",
+        clean_s["state"] == "done" and rec_s["state"] == "done"
+        and recovery is not None and recovery.get("replayed") == 1
+        and recovery.get("requeued") == 1,
+        f"clean {clean_s['state']}, recovered {rec_s['state']} "
+        f"(recovery {recovery})",
+    )
+    check(
+        "recovery.artifact_parity",
+        clean_s["state"] == "done" and rec_s["state"] == "done"
+        and digest(clean_s["workdir"]) == digest(jwd)
+        and len(digest(jwd)) > 0,
+        "recovered job's artifacts byte-identical to the clean routed "
+        "run",
+    )
+
+
 def run_lint_leg(workdir: str, check) -> None:
     """lt-lint leg: the tree must be clean (zero unbaselined findings)
     and the full twelve-rule run must stay inside its wall-time budget.
@@ -1240,6 +1436,9 @@ def run_gate(
     run_fleet_leg(workdir, check)
     run_tune_leg(workdir, check)
     run_capacity_leg(workdir, check)
+    # unconditional: in-process and seconds-scale, unlike the
+    # multi-process scheduler/router legs below
+    run_recovery_leg(workdir, check)
     if scheduler:
         run_scheduler_leg(workdir, check)
     if router:
